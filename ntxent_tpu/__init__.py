@@ -1,0 +1,42 @@
+"""ntxent_tpu — TPU-native contrastive-learning framework.
+
+Built from scratch in JAX/XLA/Pallas with the capabilities of the reference
+CUDA framework (sanowl/CUDA-NT-Xent-MPI-NCCL-SimCLR). This top-level module
+exports the loss core: the jnp oracles, the fused Pallas NT-Xent kernel with
+exact custom-VJP gradients, and the reference-compatible
+forward/backward/check_tensor_core_support API; ``ntxent_tpu.utils`` holds
+the capability/memory/profiling helpers. See SURVEY.md at the repo root for
+the full mapping to the reference.
+"""
+
+from ntxent_tpu.api import backward, check_tensor_core_support, forward, ntxent
+from ntxent_tpu.ops.ntxent_pallas import (
+    ntxent_loss_and_lse,
+    ntxent_loss_fused,
+    ntxent_partial_fused,
+)
+from ntxent_tpu.ops.oracle import (
+    cosine_normalize,
+    info_nce_loss,
+    ntxent_loss,
+    ntxent_loss_compat,
+    ntxent_loss_paired,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "forward",
+    "backward",
+    "check_tensor_core_support",
+    "ntxent",
+    "ntxent_loss",
+    "ntxent_loss_paired",
+    "ntxent_loss_compat",
+    "ntxent_loss_fused",
+    "ntxent_loss_and_lse",
+    "ntxent_partial_fused",
+    "cosine_normalize",
+    "info_nce_loss",
+    "__version__",
+]

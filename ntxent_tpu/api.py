@@ -1,0 +1,115 @@
+"""Reference-compatible Python API surface.
+
+Mirrors the reference's pybind11 bindings
+(/root/reference/src/binding_new.cpp:4-21): ``forward(z, temperature,
+use_mixed_precision=False)``, ``backward(z, softmax_output, grad_output,
+temperature, use_mixed_precision=False)`` and ``check_tensor_core_support()``
+— dispatching to the JAX/Pallas path instead of CUDA.
+
+Differences from the reference, all deliberate (SURVEY.md §2.3):
+
+* Semantics are **canonical** NT-Xent by default (z is (2N, D) stacked views,
+  positives at offset N, diagonal masked). Pass ``compat="reference"`` to get
+  the reference's as-written behavior (z is (B, D), duplicated, diagonal
+  treated as positive — D10) for comparison.
+* ``forward`` can return the softmax residual the reference's backward
+  demanded but its forward discarded (D9) via ``return_softmax=True``.
+* ``backward`` computes the **exact dense gradient** and honors
+  ``grad_output``; the reference kept only a wrong diagonal term and ignored
+  grad_output entirely (D8). It accepts the softmax residual for signature
+  parity but can recompute from z alone.
+* ``use_mixed_precision`` actually does something: it runs the similarity
+  matmul in bfloat16 with fp32 softmax accumulation (the reference accepted
+  and ignored the flag — D11).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ops import oracle
+from .ops.ntxent_pallas import ntxent_loss_fused
+from .utils.capability import check_tensor_core_support
+
+__all__ = ["forward", "backward", "check_tensor_core_support", "ntxent"]
+
+
+def _prep(z: jax.Array, use_mixed_precision: bool) -> jax.Array:
+    if use_mixed_precision:
+        return z.astype(jnp.bfloat16)
+    return z
+
+
+def forward(
+    z: jax.Array,
+    temperature: float = 0.07,
+    use_mixed_precision: bool = False,
+    *,
+    return_softmax: bool = False,
+    compat: str = "canonical",
+    fused: bool = True,
+):
+    """NT-Xent forward. Returns the scalar loss (matching binding_new.cpp:5-9),
+    or (loss, softmax) with ``return_softmax=True`` (the intended contract).
+    """
+    z = _prep(z, use_mixed_precision)
+    if compat == "reference":
+        loss = oracle.ntxent_loss_compat(z, temperature)
+        if return_softmax:
+            z_cat = jnp.concatenate([z, z], axis=0)
+            logits = oracle.similarity_matrix(z_cat, temperature)
+            return loss, jax.nn.softmax(logits, axis=-1)
+        return loss
+    if compat != "canonical":
+        raise ValueError(f"unknown compat mode: {compat!r}")
+    if return_softmax:
+        return oracle.ntxent_loss_and_softmax(z, temperature)
+    if fused:
+        return ntxent_loss_fused(z, float(temperature))
+    return oracle.ntxent_loss(z, temperature)
+
+
+def backward(
+    z: jax.Array,
+    softmax_output: jax.Array | None = None,
+    grad_output: jax.Array | float = 1.0,
+    temperature: float = 0.07,
+    use_mixed_precision: bool = False,
+):
+    """NT-Xent backward: exact gradients (fixing D8).
+
+    Signature parity with binding_new.cpp:11-17. Returns (grad_z,
+    grad_logits) like the reference's {grad_z, grad_logits} pair
+    (ntxent_kernel.cu:238). ``softmax_output`` is accepted for signature
+    parity and ignored — gradients are recomputed exactly from ``z``.
+    """
+    z = _prep(z, use_mixed_precision)
+    del softmax_output  # recomputed exactly; kept for signature parity
+    g = jnp.asarray(grad_output, jnp.float32)
+    zf = z.astype(jnp.float32)
+
+    logits, _ = oracle._masked_logits(zf, temperature)
+    p = jax.nn.softmax(logits, axis=-1)
+    two_n = z.shape[0]
+    rows = jnp.arange(two_n)
+    pos = (rows + two_n // 2) % two_n
+    e = jnp.zeros_like(p).at[rows, pos].set(1.0)
+    grad_logits = (p - e) / two_n * g
+    # d loss/d z = (1/T) (G + G^T) z with G = grad_logits: each z_k receives
+    # a row term (its own loss row) and a column term (every other row's
+    # softmax over it). G's diagonal is 0 (masked), so the mask constant
+    # contributes nothing.
+    grad_z = (grad_logits + grad_logits.T) @ zf / temperature
+    return grad_z.astype(z.dtype), grad_logits
+
+
+class _NtxentModule:
+    """Object-style access mirroring the pybind11 module: ``ntxent.forward``."""
+
+    forward = staticmethod(forward)
+    backward = staticmethod(backward)
+    check_tensor_core_support = staticmethod(check_tensor_core_support)
+
+
+ntxent = _NtxentModule()

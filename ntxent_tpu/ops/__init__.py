@@ -1,0 +1,15 @@
+from ntxent_tpu.ops import oracle
+from ntxent_tpu.ops.blocks import choose_blocks
+from ntxent_tpu.ops.ntxent_pallas import (
+    ntxent_loss_and_lse,
+    ntxent_loss_fused,
+    ntxent_partial_fused,
+)
+
+__all__ = [
+    "oracle",
+    "choose_blocks",
+    "ntxent_loss_fused",
+    "ntxent_loss_and_lse",
+    "ntxent_partial_fused",
+]

@@ -1,0 +1,66 @@
+"""Block-shape selection for the fused NT-Xent Pallas kernels.
+
+TPU-native replacement for the reference's ``get_optimal_block_size``
+(/root/reference/include/ntxent_kernel.cuh:80-96, which picked a CUDA block
+size as min(nextPowerOf2(n), 1024) — with nextPowerOf2 never defined,
+SURVEY.md §2.3-D2). Here the tunable is the (row, col) tile of the similarity
+matrix: tiles must respect TPU tiling (sublane multiples of 8, lane multiples
+of 128 for fp32) and the working set must fit VMEM (~16 MB/core) with room
+for double buffering.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["choose_blocks", "round_up", "VMEM_BUDGET_BYTES"]
+
+# Leave headroom below the ~16 MB/core VMEM for pipeline double-buffering.
+VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+
+_SUBLANE = 8
+_LANE = 128
+
+
+def round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def _working_set_bytes(br: int, bc: int, dim: int, itemsize: int) -> int:
+    # row block + col block + fp32 similarity tile + fp32 (BR, D) grad accum.
+    return (br * dim + bc * dim) * itemsize + br * bc * 4 + br * dim * 4
+
+
+def choose_blocks(
+    rows: int,
+    cols: int,
+    dim: int,
+    dtype=jnp.float32,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+) -> tuple[int, int]:
+    """Pick (block_rows, block_cols) for a rows x cols similarity computation.
+
+    Explicit overrides are honored (rounded to hardware multiples). Defaults
+    favor wide column tiles (the contraction that feeds the MXU) and shrink
+    until the working set fits the VMEM budget.
+    """
+    itemsize = jnp.dtype(dtype).itemsize
+    br = block_rows if block_rows is not None else min(256, round_up(rows, _SUBLANE))
+    bc = block_cols if block_cols is not None else min(512, round_up(cols, _LANE))
+    br = max(_SUBLANE, round_up(min(br, round_up(rows, _SUBLANE)), _SUBLANE))
+    bc = max(_LANE, round_up(min(bc, round_up(cols, _LANE)), _LANE))
+    # Shrink whichever dimensions were NOT explicitly pinned until the
+    # working set fits; explicit overrides are the caller's responsibility.
+    while _working_set_bytes(br, bc, dim, itemsize) > VMEM_BUDGET_BYTES:
+        can_shrink_bc = block_cols is None and bc > _LANE
+        can_shrink_br = block_rows is None and br > _SUBLANE
+        if can_shrink_bc and (bc >= br or not can_shrink_br):
+            bc //= 2
+        elif can_shrink_br:
+            br //= 2
+        else:
+            break
+        br = round_up(br, _SUBLANE)
+        bc = round_up(bc, _LANE)
+    return br, bc

@@ -1,0 +1,532 @@
+"""Fused blockwise NT-Xent loss as Pallas TPU kernels with exact custom VJP.
+
+TPU-native re-design of the reference's CUDA pipeline
+(/root/reference/src/ntxent_kernel.cu): where the reference materializes the
+full (2N, 2N) similarity matrix in HBM and walks it in four passes
+(cuBLAS SGEMM :165-173, row_max_kernel :8-51, softmax_kernel :53-103,
+compute_loss_kernel :105-134), this implementation tiles the similarity
+matrix into VMEM blocks and runs a **single fused pass**: each (row-block x
+col-block) tile is produced on the MXU and immediately folded into
+flash-attention-style online-softmax statistics (running max m, running sum
+l) plus the positive-pair logit — the (2N, 2N) matrix never exists in HBM.
+Residuals are O(N): only the per-row logsumexp survives the forward pass.
+
+The backward pass recomputes similarity tiles (flash-style) and produces the
+**exact dense gradient** — fixing the reference's backward, which kept only a
+(wrong) diagonal term and ignored the upstream gradient entirely
+(ntxent_kernel.cu:205-239; SURVEY.md §2.3-D8). For the symmetric single-array
+case, both gradient contributions (z_i as row and as column of the similarity
+matrix) fold into one kernel using the identity
+``grad_z[a] = (1/T) sum_b [p[a,b] + p~[a,b] - 2*onehot_pos] z[b]`` where
+``p[a,b] = exp(s[a,b] - lse[a])`` and ``p~[a,b] = exp(s[a,b] - lse[b])``
+(s is symmetric and the positive mapping is an involution).
+
+The general (rows != cols) variant powers the distributed data-parallel path:
+each device computes its local-row block of the global similarity matrix
+against the all-gathered column embeddings (SURVEY.md §5.7/§5.8), with
+explicit global row indices so diagonal masking and positive lookup stay
+correct under sharding.
+
+Semantics are canonical NT-Xent (positives at (i+N) mod 2N, diagonal masked;
+see ops/oracle.py and SURVEY.md §2.3-D10 for the reference's deviation).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .blocks import choose_blocks, round_up
+
+__all__ = [
+    "ntxent_loss_fused",
+    "ntxent_partial_fused",
+    "ntxent_loss_and_lse",
+]
+
+_NEG_INF = -1e30
+
+
+def _default_interpret() -> bool:
+    platform = jax.devices()[0].platform
+    return platform not in ("tpu", "axon")
+
+
+def _tile_ids(i, j, br: int, bc: int):
+    """Global (row, col) index grids for the current (BR, BC) tile."""
+    rid = jax.lax.broadcasted_iota(jnp.int32, (br, 1), 0) + i * br
+    cid = jax.lax.broadcasted_iota(jnp.int32, (br, bc), 1) + j * bc
+    return rid, cid
+
+
+def _masked_sim_tile(zr, zc, row_gid, cid, inv_t, cols_actual):
+    """Scaled similarity tile with self-pair and padded columns masked."""
+    s = jax.lax.dot_general(
+        zr, zc,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * inv_t
+    mask = jnp.logical_or(cid == row_gid, cid >= cols_actual)
+    return jnp.where(mask, _NEG_INF, s), s
+
+
+def _pos_gid(row_gid, n_half: int):
+    """Positive-pair column for each global row id: (gid + N) mod 2N."""
+    return jnp.where(row_gid < n_half, row_gid + n_half, row_gid - n_half)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel (general rows x cols)
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(zr_ref, zc_ref, gid_ref, loss_ref, lse_ref, m_ref, l_ref, p_ref,
+                *, br, bc, inv_t, cols_actual, n_half):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(jnp.logical_and(i == 0, j == 0))
+    def _():
+        loss_ref[0, 0] = jnp.float32(0.0)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full((br, 1), _NEG_INF, jnp.float32)
+        l_ref[:] = jnp.zeros((br, 1), jnp.float32)
+        p_ref[:] = jnp.zeros((br, 1), jnp.float32)
+
+    row_gid = gid_ref[:]                      # (BR, 1) global row ids
+    _, cid = _tile_ids(i, j, br, bc)
+    s_masked, s_raw = _masked_sim_tile(
+        zr_ref[:], zc_ref[:], row_gid, cid, inv_t, cols_actual
+    )
+
+    # Positive-pair logit (unmasked: the positive is never the diagonal).
+    pos_hit = cid == _pos_gid(row_gid, n_half)
+    p_ref[:] += jnp.sum(jnp.where(pos_hit, s_raw, 0.0), axis=1, keepdims=True)
+
+    # Online softmax update.
+    m_old = m_ref[:]
+    m_new = jnp.maximum(m_old, jnp.max(s_masked, axis=1, keepdims=True))
+    l_ref[:] = l_ref[:] * jnp.exp(m_old - m_new) + jnp.sum(
+        jnp.exp(s_masked - m_new), axis=1, keepdims=True
+    )
+    m_ref[:] = m_new
+
+    @pl.when(j == nj - 1)
+    def _():
+        lse = m_ref[:] + jnp.log(l_ref[:])
+        lse_ref[:] = lse
+        valid = row_gid < cols_actual
+        loss_ref[0, 0] += jnp.sum(jnp.where(valid, lse - p_ref[:], 0.0))
+
+
+def _fwd_call(z_rows, z_cols, row_gid, *, br, bc, inv_t, cols_actual, n_half,
+              interpret):
+    rp, d = z_rows.shape
+    cp = z_cols.shape[0]
+    grid = (rp // br, cp // bc)
+    kernel = functools.partial(
+        _fwd_kernel, br=br, bc=bc, inv_t=inv_t,
+        cols_actual=cols_actual, n_half=n_half,
+    )
+    loss_sum, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rp, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((br, 1), jnp.float32),
+            pltpu.VMEM((br, 1), jnp.float32),
+            pltpu.VMEM((br, 1), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * rp * cp * d,
+            bytes_accessed=(rp * d + (rp // br) * cp * d) * z_rows.dtype.itemsize,
+            transcendentals=rp * cp,
+        ),
+        interpret=interpret,
+    )(z_rows, z_cols, row_gid)
+    return loss_sum[0, 0], lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _bwd_sym_kernel(z_row_ref, z_col_ref, gid_ref, lse_r_ref, lse_c_ref,
+                    grad_ref, *, br, bc, inv_t, cols_actual, n_half):
+    """Symmetric-case backward: both row and column gradient terms per tile.
+
+    ``lse_c_ref`` is the same logsumexp vector pre-transposed to (1, Rp) so
+    the column-side broadcast needs no in-kernel transpose.
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        grad_ref[:] = jnp.zeros(grad_ref.shape, grad_ref.dtype)
+
+    row_gid = gid_ref[:]
+    _, cid = _tile_ids(i, j, br, bc)
+    s_masked, _ = _masked_sim_tile(
+        z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t, cols_actual
+    )
+    p_row = jnp.exp(s_masked - lse_r_ref[:])          # exp(s - lse[row])
+    p_col = jnp.exp(s_masked - lse_c_ref[:])          # exp(s - lse[col]), (1, BC)
+    pos = (cid == _pos_gid(row_gid, n_half)).astype(jnp.float32)
+    valid_row = (row_gid < cols_actual).astype(jnp.float32)
+    valid_col = (cid < cols_actual).astype(jnp.float32)
+    g = (p_row - pos) * valid_row + (p_col - pos) * valid_col
+    grad_ref[:] += jax.lax.dot_general(
+        g, z_col_ref[:].astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _bwd_rows_kernel(z_row_ref, z_col_ref, gid_ref, lse_r_ref, grad_ref,
+                     *, br, bc, inv_t, cols_actual, n_half):
+    """General case: d(loss_sum)/d(z_rows) = (P - E) @ z_cols."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        grad_ref[:] = jnp.zeros(grad_ref.shape, grad_ref.dtype)
+
+    row_gid = gid_ref[:]
+    _, cid = _tile_ids(i, j, br, bc)
+    s_masked, _ = _masked_sim_tile(
+        z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t, cols_actual
+    )
+    p = jnp.exp(s_masked - lse_r_ref[:])
+    pos = (cid == _pos_gid(row_gid, n_half)).astype(jnp.float32)
+    valid_row = (row_gid < cols_actual).astype(jnp.float32)
+    g = (p - pos) * valid_row
+    grad_ref[:] += jax.lax.dot_general(
+        g, z_col_ref[:].astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _bwd_cols_kernel(z_row_ref, z_col_ref, gid_ref, lse_r_ref, grad_ref,
+                     *, br, bc, inv_t, cols_actual, n_half):
+    """General case: d(loss_sum)/d(z_cols) = (P - E)^T @ z_rows.
+
+    Grid is (col_block, row_block) with rows innermost so each output column
+    block accumulates over all row blocks in consecutive grid steps.
+    """
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        grad_ref[:] = jnp.zeros(grad_ref.shape, grad_ref.dtype)
+
+    row_gid = gid_ref[:]
+    _, cid = _tile_ids(i, j, br, bc)
+    s_masked, _ = _masked_sim_tile(
+        z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t, cols_actual
+    )
+    p = jnp.exp(s_masked - lse_r_ref[:])
+    pos = (cid == _pos_gid(row_gid, n_half)).astype(jnp.float32)
+    valid_row = (row_gid < cols_actual).astype(jnp.float32)
+    g = (p - pos) * valid_row                         # (BR, BC)
+    grad_ref[:] += jax.lax.dot_general(
+        g, z_row_ref[:].astype(jnp.float32),
+        dimension_numbers=(((0,), (0,)), ((), ())),   # (BC, D)
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _bwd_sym_call(z, row_gid, lse, *, br, bc, inv_t, cols_actual, n_half,
+                  interpret):
+    rp, d = z.shape
+    grid = (rp // br, rp // bc)
+    kernel = functools.partial(
+        _bwd_sym_kernel, br=br, bc=bc, inv_t=inv_t,
+        cols_actual=cols_actual, n_half=n_half,
+    )
+    lse_t = lse.reshape(1, rp)  # column-side broadcast layout
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bc), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((br, d), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rp, d), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * rp * rp * d,
+            bytes_accessed=2 * rp * d * 4,
+            transcendentals=2 * rp * rp,
+        ),
+        interpret=interpret,
+    )(z, z, row_gid, lse, lse_t)
+
+
+def _bwd_general_call(z_rows, z_cols, row_gid, lse, *, br, bc, inv_t,
+                      cols_actual, n_half, interpret):
+    rp, d = z_rows.shape
+    cp = z_cols.shape[0]
+    row_kernel = functools.partial(
+        _bwd_rows_kernel, br=br, bc=bc, inv_t=inv_t,
+        cols_actual=cols_actual, n_half=n_half,
+    )
+    grad_rows = pl.pallas_call(
+        row_kernel,
+        grid=(rp // br, cp // bc),
+        in_specs=[
+            pl.BlockSpec((br, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((br, d), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rp, d), jnp.float32),
+        interpret=interpret,
+    )(z_rows, z_cols, row_gid, lse)
+
+    col_kernel = functools.partial(
+        _bwd_cols_kernel, br=br, bc=bc, inv_t=inv_t,
+        cols_actual=cols_actual, n_half=n_half,
+    )
+    grad_cols = pl.pallas_call(
+        col_kernel,
+        grid=(cp // bc, rp // br),
+        in_specs=[
+            pl.BlockSpec((br, d), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, d), lambda j, i: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bc, d), lambda j, i: (j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((cp, d), jnp.float32),
+        interpret=interpret,
+    )(z_rows, z_cols, row_gid, lse)
+    return grad_rows, grad_cols
+
+
+# ---------------------------------------------------------------------------
+# Padding helpers
+# ---------------------------------------------------------------------------
+
+
+def _pad_rows(x: jax.Array, multiple: int) -> jax.Array:
+    r = x.shape[0]
+    rp = round_up(r, multiple)
+    if rp == r:
+        return x
+    return jnp.pad(x, ((0, rp - r),) + ((0, 0),) * (x.ndim - 1))
+
+
+def _gid_column(row_gid: jax.Array, multiple: int, sentinel: int) -> jax.Array:
+    """Pad a 1-D global-row-id vector and shape it (Rp, 1) for the kernel."""
+    r = row_gid.shape[0]
+    rp = round_up(r, multiple)
+    padded = jnp.full((rp, 1), sentinel, jnp.int32)
+    return padded.at[:r, 0].set(row_gid.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Public API: symmetric (single-array) fused loss
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _ntxent_sym(z, temperature, br, bc, interpret):
+    return _ntxent_sym_fwd(z, temperature, br, bc, interpret)[0]
+
+
+def _ntxent_sym_fwd(z, temperature, br, bc, interpret):
+    two_n, _ = z.shape
+    pad = math.lcm(br, bc)  # one padded array serves as both rows and columns
+    zp = _pad_rows(z, pad)
+    gid = _gid_column(jnp.arange(zp.shape[0]), pad, sentinel=two_n)
+    loss_sum, lse = _fwd_call(
+        zp, zp, gid,
+        br=br, bc=bc, inv_t=1.0 / temperature,
+        cols_actual=two_n, n_half=two_n // 2, interpret=interpret,
+    )
+    return loss_sum, (z, lse)
+
+
+def _ntxent_sym_bwd(temperature, br, bc, interpret, res, g):
+    z, lse = res
+    two_n, _ = z.shape
+    pad = math.lcm(br, bc)
+    zp = _pad_rows(z, pad)
+    gid = _gid_column(jnp.arange(zp.shape[0]), pad, sentinel=two_n)
+    grad = _bwd_sym_call(
+        zp, gid, lse,
+        br=br, bc=bc, inv_t=1.0 / temperature,
+        cols_actual=two_n, n_half=two_n // 2, interpret=interpret,
+    )
+    grad = grad[:two_n] * (g / temperature)
+    return (grad.astype(z.dtype),)
+
+
+_ntxent_sym.defvjp(_ntxent_sym_fwd, _ntxent_sym_bwd)
+
+
+def ntxent_loss_fused(
+    z: jax.Array,
+    temperature: float = 0.07,
+    *,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused canonical NT-Xent mean loss over stacked views z: (2N, D).
+
+    Drop-in fused equivalent of ``ops.oracle.ntxent_loss`` — same semantics,
+    O(N) memory, exact gradients via custom VJP. ``temperature`` must be a
+    static Python float (it is baked into the kernel).
+    """
+    two_n = z.shape[0]
+    if two_n % 2 != 0:
+        raise ValueError(f"NT-Xent needs an even number of rows, got {two_n}")
+    br, bc = choose_blocks(two_n, two_n, z.shape[1], z.dtype,
+                           block_rows, block_cols)
+    if interpret is None:
+        interpret = _default_interpret()
+    loss_sum = _ntxent_sym(z, float(temperature), br, bc, interpret)
+    return loss_sum / two_n
+
+
+# ---------------------------------------------------------------------------
+# Public API: general (rows x cols) partial loss for the distributed path
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ntxent_partial(z_rows, z_cols, row_gid, temperature, br, bc, interpret):
+    return _ntxent_partial_fwd(z_rows, z_cols, row_gid, temperature, br, bc,
+                               interpret)[0]
+
+
+def _ntxent_partial_prepare(z_rows, z_cols, row_gid, br, bc):
+    two_n = z_cols.shape[0]
+    zr = _pad_rows(z_rows, br)
+    zc = _pad_rows(z_cols, bc)
+    gid = _gid_column(row_gid, br, sentinel=two_n)
+    return zr, zc, gid, two_n
+
+
+def _ntxent_partial_fwd(z_rows, z_cols, row_gid, temperature, br, bc, interpret):
+    zr, zc, gid, two_n = _ntxent_partial_prepare(z_rows, z_cols, row_gid, br, bc)
+    loss_sum, lse = _fwd_call(
+        zr, zc, gid,
+        br=br, bc=bc, inv_t=1.0 / temperature,
+        cols_actual=two_n, n_half=two_n // 2, interpret=interpret,
+    )
+    return loss_sum, (z_rows, z_cols, row_gid, lse)
+
+
+def _ntxent_partial_bwd(temperature, br, bc, interpret, res, g):
+    z_rows, z_cols, row_gid, lse = res
+    zr, zc, gid, two_n = _ntxent_partial_prepare(z_rows, z_cols, row_gid, br, bc)
+    grad_rows, grad_cols = _bwd_general_call(
+        zr, zc, gid, lse,
+        br=br, bc=bc, inv_t=1.0 / temperature,
+        cols_actual=two_n, n_half=two_n // 2, interpret=interpret,
+    )
+    scale = g / temperature
+    grad_rows = (grad_rows[: z_rows.shape[0]] * scale).astype(z_rows.dtype)
+    grad_cols = (grad_cols[: z_cols.shape[0]] * scale).astype(z_cols.dtype)
+    return grad_rows, grad_cols, None
+
+
+_ntxent_partial.defvjp(_ntxent_partial_fwd, _ntxent_partial_bwd)
+
+
+def ntxent_partial_fused(
+    z_rows: jax.Array,
+    z_cols: jax.Array,
+    row_gid: jax.Array,
+    temperature: float = 0.07,
+    *,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Partial NT-Xent loss **sum** over a set of rows of the global matrix.
+
+    z_rows: (R, D) local embeddings (this shard's rows of the similarity
+        matrix); z_cols: (2N, D) global (gathered) embeddings; row_gid: (R,)
+        global index of each local row in the [0, 2N) stacked-view order.
+    Returns sum_i (logsumexp_j s_ij - s_i,pos(i)) over the local rows —
+    divide by 2N (after psum across shards) for the global mean loss.
+    Differentiable w.r.t. both z_rows and z_cols (the z_cols gradient is what
+    flows back through ``lax.all_gather`` as a reduce-scatter).
+    """
+    if z_cols.shape[0] % 2 != 0:
+        raise ValueError(
+            f"NT-Xent needs an even global row count, got {z_cols.shape[0]}"
+        )
+    br, bc = choose_blocks(z_rows.shape[0], z_cols.shape[0], z_rows.shape[1],
+                           z_rows.dtype, block_rows, block_cols)
+    if interpret is None:
+        interpret = _default_interpret()
+    return _ntxent_partial(z_rows, z_cols, row_gid.astype(jnp.int32),
+                           float(temperature), br, bc, interpret)
+
+
+def ntxent_loss_and_lse(
+    z: jax.Array,
+    temperature: float = 0.07,
+    *,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Mean loss plus per-row logsumexp residuals (no VJP wiring).
+
+    The O(N) analog of the reference's intended "(loss, softmax) residual"
+    contract (SURVEY.md §2.3-D9): from lse the full masked softmax row i is
+    ``exp(s_i - lse_i)`` — materialize it lazily instead of storing (2N)^2.
+    """
+    two_n = z.shape[0]
+    if two_n % 2 != 0:
+        raise ValueError(f"NT-Xent needs an even number of rows, got {two_n}")
+    br, bc = choose_blocks(two_n, two_n, z.shape[1], z.dtype,
+                           block_rows, block_cols)
+    if interpret is None:
+        interpret = _default_interpret()
+    pad = math.lcm(br, bc)
+    zp = _pad_rows(z, pad)
+    gid = _gid_column(jnp.arange(zp.shape[0]), pad, sentinel=two_n)
+    loss_sum, lse = _fwd_call(
+        zp, zp, gid,
+        br=br, bc=bc, inv_t=1.0 / float(temperature),
+        cols_actual=two_n, n_half=two_n // 2, interpret=interpret,
+    )
+    return loss_sum / two_n, lse[:two_n, 0]

@@ -1,0 +1,145 @@
+"""Pure-jnp NT-Xent oracles: the gold standard every kernel is tested against.
+
+This module is the TPU-native re-design of the reference's loss semantics
+(reference: /root/reference/src/ntxent_kernel.cu:138-239). Two semantics are
+provided:
+
+* ``ntxent_loss`` / ``ntxent_loss_paired`` — **canonical** SimCLR NT-Xent
+  (Chen et al. 2020): input is 2N embeddings of N positive pairs, the positive
+  of row i sits at ``(i + N) mod 2N``, and the self-similarity diagonal is
+  masked to -inf. This is the *intended* capability of the reference (its
+  as-written code deviates; see SURVEY.md §2.3-D10).
+
+* ``ntxent_loss_compat`` — the reference's **as-written** behavior for
+  comparison: ``z_cat = concat([z, z])`` duplicates the same B embeddings
+  (ntxent_kernel.cu:161) and the *diagonal* is treated as the positive with no
+  masking (compute_loss_kernel, ntxent_kernel.cu:105-134), i.e.
+  ``-mean_i log softmax(sim)_ii``.
+
+All oracles are differentiable; ``jax.grad`` of these functions is the
+gradient gold standard the reference's backward never was (SURVEY.md §2.3-D8:
+the reference keeps only an incorrect diagonal term and ignores grad_out).
+
+Everything here runs through XLA on CPU/GPU/TPU unchanged — one correctness
+suite for all backends.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "cosine_normalize",
+    "similarity_matrix",
+    "ntxent_loss",
+    "ntxent_loss_paired",
+    "ntxent_loss_and_softmax",
+    "ntxent_loss_compat",
+    "ntxent_grad_oracle",
+    "info_nce_loss",
+]
+
+_NEG_INF = -1e30  # large-negative mask value; avoids inf-inf NaN pitfalls
+
+
+def cosine_normalize(z: jax.Array, axis: int = -1, eps: float = 1e-12) -> jax.Array:
+    """L2-normalize embeddings (mirror of tests/test_utils.hpp:7-14)."""
+    norm = jnp.sqrt(jnp.sum(jnp.square(z), axis=axis, keepdims=True))
+    return z / jnp.maximum(norm, eps)
+
+
+def similarity_matrix(z: jax.Array, temperature: float | jax.Array) -> jax.Array:
+    """(2N, 2N) scaled cosine-similarity Gram matrix ``z @ z.T / T``.
+
+    The correct form of the reference's cuBLAS SGEMM
+    (ntxent_kernel.cu:165-173, which mis-strides for D != 2B; SURVEY §2.3-D7).
+    Accumulates in fp32 on the MXU regardless of input dtype.
+    """
+    logits = jnp.dot(z, z.T, preferred_element_type=jnp.float32)
+    return logits / jnp.asarray(temperature, dtype=jnp.float32)
+
+
+def _masked_logits(z: jax.Array, temperature) -> tuple[jax.Array, jax.Array]:
+    """Return (masked logits, positive-pair logits) for canonical NT-Xent."""
+    two_n = z.shape[0]
+    if two_n % 2 != 0:
+        raise ValueError(f"canonical NT-Xent needs an even row count, got {two_n}")
+    n = two_n // 2
+    logits = similarity_matrix(z, temperature)
+    rows = jnp.arange(two_n)
+    # Self-similarity masked out (canonical; reference failed to, D10).
+    logits = logits.at[rows, rows].set(_NEG_INF)
+    pos_idx = (rows + n) % two_n
+    positives = logits[rows, pos_idx]
+    return logits, positives
+
+
+def ntxent_loss(z: jax.Array, temperature: float | jax.Array = 0.07) -> jax.Array:
+    """Canonical NT-Xent on stacked views ``z = concat([view1, view2])``.
+
+    z: (2N, D) L2-normalized embeddings; positive of row i at (i+N) mod 2N.
+    Returns the scalar mean loss ``mean_i [logsumexp_{j!=i} s_ij - s_i,pos(i)]``.
+    """
+    logits, positives = _masked_logits(z, temperature)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    return jnp.mean(lse - positives)
+
+
+def ntxent_loss_paired(
+    z1: jax.Array, z2: jax.Array, temperature: float | jax.Array = 0.07
+) -> jax.Array:
+    """Canonical NT-Xent on the two augmented views separately (N, D) + (N, D)."""
+    return ntxent_loss(jnp.concatenate([z1, z2], axis=0), temperature)
+
+
+def ntxent_loss_and_softmax(
+    z: jax.Array, temperature: float | jax.Array = 0.07
+) -> tuple[jax.Array, jax.Array]:
+    """Loss plus the (2N, 2N) masked softmax matrix.
+
+    Implements the residual-saving contract the reference *intended* but broke:
+    its forward computes softmax_output then discards it (ntxent_kernel.cu:202)
+    while backward demands it as input (ntxent_kernel.cuh:46-52; SURVEY §2.3-D9).
+    """
+    logits, positives = _masked_logits(z, temperature)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    softmax = jnp.exp(logits - lse[:, None])
+    return jnp.mean(lse - positives), softmax
+
+
+def ntxent_loss_compat(z: jax.Array, temperature: float | jax.Array = 0.07) -> jax.Array:
+    """Reference as-written semantics (SURVEY §2.3-D10), for comparison only.
+
+    z: (B, D). Duplicates rows (z_cat = [z; z], ntxent_kernel.cu:161), no
+    diagonal mask, positive = self: ``-mean_i log softmax(sim)_ii``.
+    """
+    z_cat = jnp.concatenate([z, z], axis=0)
+    logits = similarity_matrix(z_cat, temperature)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    diag = jnp.diagonal(logits)
+    return jnp.mean(lse - diag)
+
+
+def ntxent_grad_oracle(
+    z: jax.Array, temperature: float | jax.Array = 0.07
+) -> jax.Array:
+    """Exact ``d ntxent_loss / d z`` via autodiff — the gradient gold standard."""
+    return jax.grad(lambda zz: ntxent_loss(zz, temperature))(z)
+
+
+def info_nce_loss(
+    za: jax.Array, zb: jax.Array, temperature: float | jax.Array = 0.07
+) -> jax.Array:
+    """Cross-modal InfoNCE (CLIP-style): positives on the a↔b diagonal.
+
+    za, zb: (N, D) normalized embeddings from the two modalities. Symmetric
+    cross-entropy over ``za @ zb.T / T`` rows and columns. This is the
+    BASELINE.json configs[4] workload (CLIP text-image, global batch 32768).
+    """
+    logits = jnp.dot(za, zb.T, preferred_element_type=jnp.float32)
+    logits = logits / jnp.asarray(temperature, dtype=jnp.float32)
+    diag = jnp.diagonal(logits)
+    loss_a = jnp.mean(jax.nn.logsumexp(logits, axis=1) - diag)
+    loss_b = jnp.mean(jax.nn.logsumexp(logits, axis=0) - diag)
+    return 0.5 * (loss_a + loss_b)

@@ -1,0 +1,24 @@
+from ntxent_tpu.utils.capability import (
+    check_tensor_core_support,
+    device_kind,
+    has_mxu,
+    supports_bf16_matmul,
+    verify_accelerator_requirements,
+)
+from ntxent_tpu.utils.logging_utils import setup_logging
+from ntxent_tpu.utils.memory import DeviceMemoryTracker, device_memory_mb
+from ntxent_tpu.utils.profiling import BenchmarkResults, time_fn, trace
+
+__all__ = [
+    "check_tensor_core_support",
+    "device_kind",
+    "has_mxu",
+    "supports_bf16_matmul",
+    "verify_accelerator_requirements",
+    "setup_logging",
+    "DeviceMemoryTracker",
+    "device_memory_mb",
+    "BenchmarkResults",
+    "time_fn",
+    "trace",
+]

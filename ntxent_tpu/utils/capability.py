@@ -1,0 +1,73 @@
+"""Accelerator capability probes.
+
+TPU-native re-design of the reference's CUDA capability utilities
+(/root/reference/include/ntxent_kernel.cuh:79-110): ``get_optimal_block_size``
+becomes a (rows, dim, dtype)-keyed block-shape table in ops/blocks.py, and
+``check_tensor_core_support`` (compute capability >= 7.0, i.e. "has tensor
+cores") becomes "has a matrix unit": TPU MXU, or GPU with tensor cores.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+__all__ = [
+    "check_tensor_core_support",
+    "device_kind",
+    "has_mxu",
+    "supports_bf16_matmul",
+    "verify_accelerator_requirements",
+]
+
+
+@functools.cache
+def device_kind(backend: str | None = None) -> str:
+    """Human-readable kind of the default device (e.g. 'TPU v5 lite')."""
+    return jax.devices(backend)[0].device_kind if jax.devices(backend) else "none"
+
+
+@functools.cache
+def has_mxu(backend: str | None = None) -> bool:
+    """True when the default device has a hardware matrix unit."""
+    devices = jax.devices(backend)
+    if not devices:
+        return False
+    platform = devices[0].platform
+    if platform == "tpu" or platform == "axon":
+        return True  # every TPU generation JAX supports has an MXU
+    if platform == "gpu":
+        # Mirror of the reference's CC >= 7.0 test (ntxent_kernel.cuh:98-110).
+        cc = getattr(devices[0], "compute_capability", None)
+        try:
+            return cc is not None and float(cc) >= 7.0
+        except (TypeError, ValueError):
+            return False
+    return False
+
+
+def check_tensor_core_support() -> bool:
+    """Reference-compatible probe (binding_new.cpp:19-20): matrix unit present?"""
+    return has_mxu()
+
+
+def supports_bf16_matmul() -> bool:
+    """bf16 is native on all TPUs and Ampere+ GPUs; fp32-emulated on CPU."""
+    platform = jax.devices()[0].platform
+    return platform in ("tpu", "axon", "gpu")
+
+
+def verify_accelerator_requirements(require_accelerator: bool = True) -> None:
+    """Mirror of python/test.py:42-55 (verify_gpu_requirements).
+
+    Raises RuntimeError unless an accelerator with a matrix unit is present.
+    """
+    if not require_accelerator:
+        return
+    if not has_mxu():
+        raise RuntimeError(
+            "No accelerator with a matrix unit found "
+            f"(default device: {device_kind()!r}); NT-Xent kernels require "
+            "a TPU or a tensor-core GPU (reference gate: CC >= 7.0)."
+        )

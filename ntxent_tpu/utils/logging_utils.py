@@ -1,0 +1,16 @@
+"""Logging setup mirroring the reference harness (python/test.py:18-23)."""
+
+from __future__ import annotations
+
+import logging
+
+__all__ = ["setup_logging"]
+
+
+def setup_logging(level: int = logging.INFO) -> logging.Logger:
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s - %(levelname)s - %(message)s",
+        force=False,
+    )
+    return logging.getLogger("ntxent_tpu")

@@ -1,0 +1,57 @@
+"""Device-memory tracking, mirroring the reference's GPUMemoryTracker
+(/root/reference/python/test.py:25-40): per-step allocated/reserved samples
+dumped to ``memory_profile.json``. On TPU the numbers come from
+``Device.memory_stats()`` (bytes_in_use / bytes_limit)."""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DeviceMemoryTracker", "device_memory_mb"]
+
+
+def device_memory_mb(device: jax.Device | None = None) -> dict[str, float]:
+    """Current memory usage of one device, in MB. Empty dict if unsupported."""
+    device = device or jax.local_devices()[0]
+    stats = device.memory_stats() or {}
+    out: dict[str, float] = {}
+    if "bytes_in_use" in stats:
+        out["allocated_mb"] = stats["bytes_in_use"] / 1024**2
+    if "peak_bytes_in_use" in stats:
+        out["peak_allocated_mb"] = stats["peak_bytes_in_use"] / 1024**2
+    if "bytes_limit" in stats:
+        out["reserved_mb"] = stats["bytes_limit"] / 1024**2
+    return out
+
+
+class DeviceMemoryTracker:
+    """Samples device memory at named steps; saves a JSON profile.
+
+    API mirror of GPUMemoryTracker (python/test.py:25-40): ``log_memory(step)``
+    appends a sample and logs it; ``save_profile(path)`` dumps JSON.
+    """
+
+    def __init__(self, device: jax.Device | None = None):
+        self.device = device or jax.local_devices()[0]
+        self.snapshots: list[dict] = []
+
+    def log_memory(self, step: str) -> dict:
+        sample = {"step": step, **device_memory_mb(self.device)}
+        self.snapshots.append(sample)
+        alloc = sample.get("allocated_mb")
+        if alloc is not None:
+            logger.info("Memory at %s: %.1f MB allocated", step, alloc)
+        else:
+            logger.info("Memory at %s: stats unavailable on %s", step, self.device)
+        return sample
+
+    def save_profile(self, path: str | Path = "memory_profile.json") -> Path:
+        path = Path(path)
+        path.write_text(json.dumps(self.snapshots, indent=2))
+        return path

@@ -1,0 +1,63 @@
+"""Timing / profiling helpers for the re-hosted benchmark harnesses.
+
+The reference times with per-iteration ``cudaDeviceSynchronize``
+(/root/reference/src/benchmark.cpp:30-39) and brackets timed regions with
+``torch.cuda.synchronize`` (python/test.py:109-121). The JAX equivalents are
+``jax.block_until_ready`` per iteration and ``jax.profiler`` traces in place
+of nvprof/-lineinfo builds (SURVEY.md §5.1)."""
+
+from __future__ import annotations
+
+import contextlib
+import statistics
+import time
+from dataclasses import dataclass, asdict
+
+import jax
+
+__all__ = ["BenchmarkResults", "time_fn", "trace"]
+
+
+@dataclass
+class BenchmarkResults:
+    """Mirror of the C++ BenchmarkResults struct (benchmark.cpp:9-14)."""
+
+    mean_ms: float
+    std_ms: float
+    min_ms: float
+    max_ms: float
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+
+def time_fn(fn, *args, warmup: int = 10, runs: int = 100) -> BenchmarkResults:
+    """Time ``fn(*args)`` with device sync per iteration.
+
+    Mirrors the reference's protocol: warmup iterations then ``runs`` timed
+    iterations, each ending in a full device sync (benchmark.cpp:25-39 uses
+    warmup=1, runs=100; python/test.py:97-121 uses warmup=10, runs=100).
+    """
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times_ms = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times_ms.append((time.perf_counter() - t0) * 1e3)
+    return BenchmarkResults(
+        mean_ms=statistics.fmean(times_ms),
+        std_ms=statistics.pstdev(times_ms) if len(times_ms) > 1 else 0.0,
+        min_ms=min(times_ms),
+        max_ms=max(times_ms),
+    )
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/ntxent_tpu_trace"):
+    """jax.profiler trace context (TensorBoard/XProf viewable)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()

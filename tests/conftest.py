@@ -1,0 +1,45 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh.
+
+The reference's tests hard-require a physical GPU and cannot run otherwise
+(/root/reference/tests/test_forward.cpp:8-11) — a gap this suite closes
+(SURVEY.md §4.3): JAX's forced host-platform device count gives 8 virtual CPU
+devices, so single-chip kernels run in Pallas interpret mode and the
+distributed mesh/collective paths run for real, with no TPU needed. The same
+tests run unchanged on a real ICI mesh.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402  (import after env setup)
+
+# A site plugin may have forced another platform at interpreter startup
+# (jax_platforms config wins over the env var) — force CPU back for tests.
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture()
+def rng():
+    return jax.random.PRNGKey(42)
+
+
+def make_embeddings(key, rows, dim, dtype=jnp.float32, scale=1.0):
+    """randn + L2-normalize, mirroring tests/test_utils.hpp:7-14."""
+    from ntxent_tpu.ops.oracle import cosine_normalize
+
+    z = jax.random.normal(key, (rows, dim), jnp.float32)
+    return (cosine_normalize(z) * scale).astype(dtype)

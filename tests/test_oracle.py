@@ -1,0 +1,119 @@
+"""Oracle correctness: closed-form checks of the canonical NT-Xent loss.
+
+The reference had no numerical comparison against any ground truth (SURVEY.md
+§4: "no numerical comparison against a reference implementation anywhere") —
+these tests are that missing ground truth, built from independent math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ntxent_tpu.ops import oracle
+
+from conftest import make_embeddings
+
+
+def numpy_ntxent(z: np.ndarray, t: float) -> float:
+    """Independent NumPy implementation (no shared code with the oracle)."""
+    two_n, _ = z.shape
+    n = two_n // 2
+    sim = (z @ z.T) / t
+    total = 0.0
+    for i in range(two_n):
+        pos = (i + n) % two_n
+        row = np.delete(sim[i], i)  # mask self
+        m = row.max()
+        lse = m + np.log(np.exp(row - m).sum())
+        total += lse - sim[i, pos]
+    return total / two_n
+
+
+@pytest.mark.parametrize("two_n,dim", [(8, 16), (32, 64), (64, 48)])
+@pytest.mark.parametrize("t", [0.07, 0.5])
+def test_oracle_matches_numpy(rng, two_n, dim, t):
+    z = make_embeddings(rng, two_n, dim)
+    expected = numpy_ntxent(np.asarray(z), t)
+    got = float(oracle.ntxent_loss(z, t))
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
+def test_paired_equals_stacked(rng):
+    k1, k2 = jax.random.split(rng)
+    z1 = make_embeddings(k1, 16, 32)
+    z2 = make_embeddings(k2, 16, 32)
+    stacked = oracle.ntxent_loss(jnp.concatenate([z1, z2]), 0.1)
+    paired = oracle.ntxent_loss_paired(z1, z2, 0.1)
+    np.testing.assert_allclose(float(stacked), float(paired), rtol=1e-6)
+
+
+def test_perfect_alignment_beats_random(rng):
+    """Loss is lower when the two views are identical (perfect positives)."""
+    z = make_embeddings(rng, 32, 64)
+    aligned = oracle.ntxent_loss_paired(z, z, 0.07)
+    shuffled = oracle.ntxent_loss_paired(z, jnp.roll(z, 1, axis=0), 0.07)
+    assert float(aligned) < float(shuffled)
+
+def test_loss_positive_and_finite(rng):
+    """Smoke parity with the reference's BasicForward (test_forward.cpp:19-27)."""
+    z = make_embeddings(rng, 64, 128)
+    loss = oracle.ntxent_loss(z, 0.07)
+    assert float(loss) > 0.0
+    assert bool(jnp.isfinite(loss))
+
+
+@pytest.mark.parametrize("batch", [16, 32, 64, 128])
+def test_different_batch_sizes(rng, batch):
+    """Mirror of DifferentBatchSizes (test_forward.cpp:40-52)."""
+    z = make_embeddings(rng, batch, 128)
+    loss = oracle.ntxent_loss(z, 0.07)
+    assert bool(jnp.isfinite(loss)) and float(loss) > 0
+
+
+def test_compat_mode_semantics(rng):
+    """Reference as-written semantics (D10): softmax-NLL of the diagonal on
+    duplicated embeddings. Checked against a direct construction."""
+    z = make_embeddings(rng, 16, 32)
+    got = float(oracle.ntxent_loss_compat(z, 0.07))
+    z_cat = np.concatenate([np.asarray(z), np.asarray(z)])
+    sim = (z_cat @ z_cat.T) / 0.07
+    p = np.exp(sim - sim.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    expected = -np.mean(np.log(np.diagonal(p)))
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
+def test_loss_and_softmax_residual(rng):
+    """The (loss, softmax) contract the reference intended but broke (D9)."""
+    z = make_embeddings(rng, 24, 32)
+    loss, softmax = oracle.ntxent_loss_and_softmax(z, 0.07)
+    np.testing.assert_allclose(float(loss), float(oracle.ntxent_loss(z, 0.07)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(softmax.sum(axis=1)), 1.0, rtol=1e-5)
+    assert float(jnp.max(jnp.diagonal(softmax))) < 1e-8  # diagonal masked
+
+
+def test_grad_matches_finite_differences(rng):
+    """The gradcheck the reference's GradientCheck wanted to be
+    (test_forward.cpp:29-38 — non-functional there, SURVEY.md §3.5)."""
+    z = make_embeddings(rng, 12, 8).astype(jnp.float64) \
+        if jax.config.read("jax_enable_x64") else make_embeddings(rng, 12, 8)
+    g = oracle.ntxent_grad_oracle(z, 0.2)
+    eps = 1e-3
+    idx = [(0, 0), (3, 5), (11, 7)]
+    for i, j in idx:
+        zp = z.at[i, j].add(eps)
+        zm = z.at[i, j].add(-eps)
+        fd = (oracle.ntxent_loss(zp, 0.2) - oracle.ntxent_loss(zm, 0.2)) / (2 * eps)
+        np.testing.assert_allclose(float(g[i, j]), float(fd), rtol=2e-2, atol=2e-4)
+
+
+def test_info_nce_cross_modal(rng):
+    """CLIP-style InfoNCE: zero temperature-scaled identity should give low loss."""
+    k1, k2 = jax.random.split(rng)
+    za = make_embeddings(k1, 32, 64)
+    aligned = oracle.info_nce_loss(za, za, 0.01)
+    random = oracle.info_nce_loss(za, make_embeddings(k2, 32, 64), 0.01)
+    assert float(aligned) < float(random)
+    assert bool(jnp.isfinite(aligned)) and bool(jnp.isfinite(random))

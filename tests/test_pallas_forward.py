@@ -1,0 +1,101 @@
+"""Fused Pallas forward kernel vs the jnp oracle.
+
+Runs in Pallas interpret mode on CPU (the memory-safety/debug oracle,
+SURVEY.md §5.2) over the reference benchmark grids (benchmark.cpp:68-71);
+identical code compiles for TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ntxent_tpu.ops import oracle
+from ntxent_tpu.ops.ntxent_pallas import (
+    ntxent_loss_and_lse,
+    ntxent_loss_fused,
+    ntxent_partial_fused,
+)
+
+from conftest import make_embeddings
+
+
+# Reference C++ benchmark grid B in {32..1024}, D in {64,128,256}
+# (benchmark.cpp:68-71) — trimmed for interpret-mode runtime; the full grid
+# runs in benchmarks/.
+@pytest.mark.parametrize("two_n,dim", [(32, 64), (64, 128), (128, 256), (256, 128)])
+def test_fused_matches_oracle(rng, two_n, dim):
+    z = make_embeddings(rng, two_n, dim)
+    expected = float(oracle.ntxent_loss(z, 0.07))
+    got = float(ntxent_loss_fused(z, 0.07))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("t", [0.01, 0.07, 1.0])
+def test_fused_temperature_grid(rng, t):
+    z = make_embeddings(rng, 64, 32)
+    np.testing.assert_allclose(
+        float(ntxent_loss_fused(z, t)), float(oracle.ntxent_loss(z, t)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_fused_ragged_shapes(rng):
+    """Shapes that don't divide the block sizes exercise the padding path."""
+    for two_n, dim in [(10, 8), (50, 40), (130, 100), (258, 72)]:
+        z = make_embeddings(rng, two_n, dim)
+        np.testing.assert_allclose(
+            float(ntxent_loss_fused(z, 0.07)), float(oracle.ntxent_loss(z, 0.07)),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_fused_explicit_blocks(rng):
+    z = make_embeddings(rng, 128, 64)
+    got = ntxent_loss_fused(z, 0.07, block_rows=32, block_cols=128)
+    np.testing.assert_allclose(
+        float(got), float(oracle.ntxent_loss(z, 0.07)), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_fused_bf16_path(rng):
+    """Real mixed precision (the reference's flag was dead — D11): bf16
+    inputs, fp32 softmax accumulation."""
+    z = make_embeddings(rng, 128, 64, dtype=jnp.bfloat16)
+    got = float(ntxent_loss_fused(z, 0.07))
+    expected = float(oracle.ntxent_loss(z.astype(jnp.float32), 0.07))
+    np.testing.assert_allclose(got, expected, rtol=2e-2)
+
+
+def test_loss_and_lse_residual(rng):
+    z = make_embeddings(rng, 64, 32)
+    loss, lse = ntxent_loss_and_lse(z, 0.07)
+    logits, _ = oracle._masked_logits(z, 0.07)
+    np.testing.assert_allclose(
+        np.asarray(lse), np.asarray(jax.nn.logsumexp(logits, axis=-1)),
+        rtol=1e-5, atol=1e-5,
+    )
+    np.testing.assert_allclose(float(loss), float(oracle.ntxent_loss(z, 0.07)),
+                               rtol=1e-5)
+
+
+def test_partial_rows_sum_to_full(rng):
+    """Sharded-rows decomposition: partial sums over disjoint row sets equal
+    the full loss — the invariant the distributed path is built on."""
+    two_n, dim = 96, 48
+    z = make_embeddings(rng, two_n, dim)
+    gid = jnp.arange(two_n)
+    cuts = [0, 20, 64, two_n]
+    total = sum(
+        float(ntxent_partial_fused(z[a:b], z, gid[a:b], 0.07))
+        for a, b in zip(cuts[:-1], cuts[1:])
+    )
+    np.testing.assert_allclose(total / two_n, float(oracle.ntxent_loss(z, 0.07)),
+                               rtol=1e-5)
+
+
+def test_fused_under_jit_and_vmap_composition(rng):
+    z = make_embeddings(rng, 64, 32)
+    jitted = jax.jit(lambda zz: ntxent_loss_fused(zz, 0.07))
+    np.testing.assert_allclose(float(jitted(z)), float(oracle.ntxent_loss(z, 0.07)),
+                               rtol=1e-5, atol=1e-6)

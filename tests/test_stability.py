@@ -1,0 +1,44 @@
+"""Numerical-stability envelope, re-hosting python/test.py:57-79.
+
+Grid: input scale in {1e-5, 1, 1e5} x temperature in {0.01, 0.07, 1.0} at
+B=128 (2N), D=256 — loss and gradients must be finite everywhere. Extended
+beyond the reference with bf16 and non-normalized inputs.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ntxent_tpu.ops import oracle
+from ntxent_tpu.ops.ntxent_pallas import ntxent_loss_fused
+
+from conftest import make_embeddings
+
+SCALES = [1e-5, 1.0, 1e5]
+TEMPS = [0.01, 0.07, 1.0]
+
+
+@pytest.mark.parametrize("scale", SCALES)
+@pytest.mark.parametrize("t", TEMPS)
+def test_stability_grid(rng, scale, t):
+    # Normalized embeddings scaled afterwards, as in python/test.py:64-66.
+    z = make_embeddings(rng, 128, 256) * scale
+    loss, grad = jax.value_and_grad(lambda zz: ntxent_loss_fused(zz, t))(z)
+    assert bool(jnp.isfinite(loss)), f"NaN/Inf loss at scale={scale}, T={t}"
+    assert bool(jnp.all(jnp.isfinite(grad))), f"NaN/Inf grad at scale={scale}, T={t}"
+    l_ref = oracle.ntxent_loss(z, t)
+    assert bool(jnp.isfinite(l_ref))
+
+
+@pytest.mark.parametrize("t", TEMPS)
+def test_stability_bf16(rng, t):
+    z = make_embeddings(rng, 128, 256, dtype=jnp.bfloat16)
+    loss = ntxent_loss_fused(z, t)
+    assert bool(jnp.isfinite(loss))
+
+
+def test_extreme_logit_range(rng):
+    """Rows with one dominating similarity: online softmax must not overflow."""
+    z = make_embeddings(rng, 64, 32)
+    loss = ntxent_loss_fused(z, 1e-4)  # logits up to ~1e4
+    assert bool(jnp.isfinite(loss))
