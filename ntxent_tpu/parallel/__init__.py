@@ -1,0 +1,23 @@
+from ntxent_tpu.parallel.dist_loss import make_sharded_ntxent, ntxent_loss_distributed
+from ntxent_tpu.parallel.mesh import (
+    create_mesh,
+    data_sharding,
+    init_distributed,
+    local_row_gids,
+    process_info,
+    replicated_sharding,
+)
+from ntxent_tpu.parallel.ring import make_ring_ntxent, ntxent_loss_ring
+
+__all__ = [
+    "create_mesh",
+    "data_sharding",
+    "init_distributed",
+    "local_row_gids",
+    "process_info",
+    "replicated_sharding",
+    "make_sharded_ntxent",
+    "ntxent_loss_distributed",
+    "make_ring_ntxent",
+    "ntxent_loss_ring",
+]

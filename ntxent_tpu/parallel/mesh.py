@@ -1,0 +1,146 @@
+"""Device-mesh and multi-host initialization: the MPI-launcher role.
+
+The reference declared MPI (process launch/rendezvous) and NCCL (collectives)
+support as link-only CMake options with zero call sites
+(/root/reference/CMakeLists.txt:13-14,41-47,115-121; SURVEY.md §0.1, §2.2).
+This module realizes that declared capability TPU-natively:
+
+* ``init_distributed`` replaces ``mpirun`` + ``MPI_Init``:
+  ``jax.distributed.initialize`` performs rendezvous (auto-detecting
+  coordinator/process count on Cloud TPU; explicit args elsewhere).
+* ``create_mesh`` builds the ``jax.sharding.Mesh`` whose axes XLA lowers
+  collectives onto — ICI links intra-slice, DCN across slices — replacing
+  NCCL communicator construction.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "init_distributed",
+    "create_mesh",
+    "data_sharding",
+    "replicated_sharding",
+    "local_row_gids",
+    "process_info",
+]
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids: Sequence[int] | None = None,
+) -> None:
+    """Multi-host rendezvous (the ``mpirun``/``MPI_Init`` role).
+
+    On Cloud TPU all arguments auto-detect from the environment; pass them
+    explicitly elsewhere. Safe to call when already initialized or when
+    running single-process with no coordinator configured (both are no-ops
+    with a log line); explicit-argument failures propagate.
+
+    NOTE: deliberately does NOT touch ``jax.process_count()``/``jax.devices()``
+    before initializing — those calls initialize the XLA backends, after
+    which ``jax.distributed.initialize`` refuses to run.
+    """
+    from jax._src import distributed as _distributed
+
+    if _distributed.global_state.client is not None:
+        logger.info("jax.distributed already initialized")
+        return
+    explicit = coordinator_address is not None
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+        logger.info("distributed init: process %d/%d, %d local devices",
+                    jax.process_index(), jax.process_count(),
+                    jax.local_device_count())
+    except (RuntimeError, ValueError):
+        if explicit:
+            raise  # a configured coordinator that fails is a real error
+        # Auto-detection found no cluster: single-process is a supported mode.
+        logger.info("no cluster environment detected; single-process mode")
+
+
+def create_mesh(
+    shape: Sequence[int] | None = None,
+    axis_names: Sequence[str] = ("data",),
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a Mesh over the available devices.
+
+    Default: a 1-D ``('data',)`` mesh over all devices — the classic SimCLR
+    data-parallel layout where ``lax.all_gather`` of embeddings rides ICI.
+    Pass ``shape``/``axis_names`` for hybrid layouts, e.g.
+    ``shape=(4, 2), axis_names=('data', 'model')`` for the ViT/CLIP configs.
+
+    When no explicit device list is given, devices are ordered by
+    ``mesh_utils.create_device_mesh`` so mesh-adjacent devices sit on
+    adjacent ICI links (raw ``jax.devices()`` order does not guarantee that
+    on multi-dim TPU topologies).
+    """
+    if devices is None:
+        n = jax.device_count()
+        if shape is None:
+            shape = (n,)
+        if int(np.prod(shape)) != n:
+            raise ValueError(f"mesh shape {tuple(shape)} != {n} devices")
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(tuple(shape))
+        return Mesh(dev_array, tuple(axis_names))
+    devices = list(devices)
+    if shape is None:
+        shape = (len(devices),)
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(f"mesh shape {tuple(shape)} != {len(devices)} devices")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def data_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Batch-dim sharding: rows split across ``axis``, features replicated."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_row_gids(axis: str, n_local: int, num_devices: int):
+    """Global row indices of this shard's rows in the stacked-view layout.
+
+    Global layout is ``[view1 of all devices; view2 of all devices]`` (the
+    order ``lax.all_gather`` + concat produces): device d's view-1 rows are
+    ``d*n_local + [0, n_local)`` and its view-2 rows are ``N + d*n_local +
+    [0, n_local)`` with ``N = n_local * num_devices``. Call inside
+    ``shard_map``.
+    """
+    import jax.numpy as jnp
+
+    d = jax.lax.axis_index(axis)
+    n_total = n_local * num_devices
+    base = d * n_local + jnp.arange(n_local, dtype=jnp.int32)
+    return jnp.concatenate([base, n_total + base])
+
+
+def process_info() -> dict:
+    """Rank/world-size style info (what MPI_Comm_rank/size reported)."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }

@@ -1,0 +1,111 @@
+"""Ring NT-Xent: the ring-attention analog for contrastive loss.
+
+The framework's sequence/context-parallel story (SURVEY.md §2.2, §5.7): the
+quadratic object here is the (2N, 2N) similarity matrix, so "long context"
+means global batches whose gathered embeddings don't fit per-chip memory. The
+ring variant never gathers: each device's embedding block circulates around
+the ICI ring via ``lax.ppermute`` while every device folds each visiting
+block into flash-style online-softmax statistics (running max m, running sum
+l) for its local rows. After P steps each device has seen all 2N columns:
+memory is O(N/P) per chip, bandwidth rides neighbor ICI links only, and the
+compute/communication pattern is exactly ring attention's (blockwise
+accumulate + neighbor ppermute), minus the value accumulation.
+
+Gradients come from ``jax.grad`` through the ``lax.scan`` of ppermute steps:
+the VJP of ppermute is the reverse-direction ppermute, so the backward pass
+is itself a ring pass — the hand-written reverse-ring NCCL code this replaces.
+
+Scale target: BASELINE.json configs[4] (global batch 32768 CLIP/InfoNCE).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import local_row_gids
+
+__all__ = ["ntxent_loss_ring", "make_ring_ntxent"]
+
+_NEG_INF = -1e30
+
+
+def _ring_body(z1_local, z2_local, temperature, axis, num_devices):
+    n_local, dim = z1_local.shape
+    two_n_local = 2 * n_local
+    two_n = 2 * n_local * num_devices
+    inv_t = 1.0 / temperature
+
+    z_local = jnp.concatenate([z1_local, z2_local], axis=0)
+    my_gid = local_row_gids(axis, n_local, num_devices)
+
+    # Positive similarities are device-local in the stacked-view layout:
+    # view-1 row i pairs with view-2 row i of the same device.
+    pos = jnp.sum(z1_local * z2_local, axis=-1, dtype=jnp.float32) * inv_t
+    pos = jnp.concatenate([pos, pos])
+
+    perm = [(i, (i + 1) % num_devices) for i in range(num_devices)]
+
+    def fold(block, block_gid, m, l):
+        """Fold one visiting column block into the online-softmax stats."""
+        s = jnp.dot(z_local, block.T, preferred_element_type=jnp.float32)
+        s = s * inv_t
+        mask = my_gid[:, None] == block_gid[None, :]
+        s = jnp.where(mask, _NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(s - m_new[:, None]), axis=1)
+        return m_new, l
+
+    def step(carry, _):
+        block, block_gid, m, l = carry
+        m, l = fold(block, block_gid, m, l)
+        block = jax.lax.ppermute(block, axis, perm)
+        block_gid = jax.lax.ppermute(block_gid, axis, perm)
+        return (block, block_gid, m, l), None
+
+    # pcast to 'varying': the m/l statistics start device-invariant but
+    # become varying across the ring axis inside the scan; the scan carry
+    # types must agree.
+    init = (
+        z_local,
+        my_gid,
+        jax.lax.pcast(jnp.full((two_n_local,), _NEG_INF, jnp.float32),
+                      (axis,), to="varying"),
+        jax.lax.pcast(jnp.zeros((two_n_local,), jnp.float32),
+                      (axis,), to="varying"),
+    )
+    # P-1 exchanges suffice: fold the final visiting block outside the scan
+    # instead of permuting it back to its origin (a wasted ICI hop).
+    (block, block_gid, m, l), _ = jax.lax.scan(
+        step, init, None, length=num_devices - 1
+    )
+    m, l = fold(block, block_gid, m, l)
+    lse = m + jnp.log(l)
+    loss_sum = jnp.sum(lse - pos)
+    return jax.lax.psum(loss_sum, axis) / two_n
+
+
+def make_ring_ntxent(mesh: Mesh, temperature: float = 0.07, axis: str = "data"):
+    """Build a jit-able ring NT-Xent over ``mesh`` (see module docstring)."""
+    body = functools.partial(
+        _ring_body,
+        temperature=float(temperature),
+        axis=axis,
+        num_devices=mesh.shape[axis],
+    )
+    return jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis)),
+                         out_specs=P())
+
+
+def ntxent_loss_ring(
+    z1: jax.Array,
+    z2: jax.Array,
+    mesh: Mesh,
+    temperature: float = 0.07,
+    axis: str = "data",
+) -> jax.Array:
+    """Global-batch NT-Xent without ever gathering the global batch."""
+    return make_ring_ntxent(mesh, temperature, axis)(z1, z2)
