@@ -1,0 +1,45 @@
+"""Headline benchmark: fused NT-Xent forward+backward at 4096x128.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Baseline target (BASELINE.json north star): < 2 ms/step fwd+bwd at
+N x D = 4096 x 128; vs_baseline = target_ms / measured_ms (>1 beats it).
+
+Protocol mirrors the reference harnesses: warmup then timed runs with a
+device sync per iteration (src/benchmark.cpp:25-39 used warmup 1 + 100 runs
+with cudaDeviceSynchronize; python/test.py:97-121 used warmup 10 + 100 runs)
+— here jax.block_until_ready plays the sync role.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+
+TARGET_MS = 2.0
+ROWS, DIM = 4096, 128
+TEMPERATURE = 0.07
+WARMUP, RUNS = 10, 100
+
+
+def main() -> None:
+    from ntxent_tpu.ops.ntxent_pallas import ntxent_loss_fused
+    from ntxent_tpu.utils.profiling import time_fn
+
+    key = jax.random.PRNGKey(0)
+    z = jax.random.normal(key, (ROWS, DIM), jnp.float32)
+    z = z / jnp.linalg.norm(z, axis=-1, keepdims=True)
+
+    fwd_bwd = jax.jit(jax.value_and_grad(
+        lambda zz: ntxent_loss_fused(zz, TEMPERATURE)))
+    result = time_fn(fwd_bwd, z, warmup=WARMUP, runs=RUNS)
+
+    print(json.dumps({
+        "metric": f"ntxent_fused_fwd_bwd_ms_{ROWS}x{DIM}",
+        "value": round(result.mean_ms, 4),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_MS / result.mean_ms, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

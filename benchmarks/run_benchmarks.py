@@ -1,0 +1,147 @@
+"""Re-hosted benchmark + stability harness (one suite, all backends).
+
+Mirrors both reference harnesses against the JAX/Pallas implementation so
+results stay comparable (SURVEY.md §6):
+
+* C++ grid  (src/benchmark.cpp:68-71):   B in {32..1024} x D in {64,128,256},
+  T=0.07, forward only, warmup 1 + 100 timed runs, sync per iteration.
+* Py grid   (python/test.py:141-142):    B in {32..512} x D in {64..512},
+  fp32 vs mixed precision (real bf16 here — the reference's flag was dead,
+  D11), warmup 10 + 100 runs, with device-memory sampling.
+* Stability (python/test.py:57-79):      scale x temperature grid, NaN/Inf gate.
+
+Outputs: stdout tables (benchmark.cpp:74-88 style) + JSON artifacts
+(benchmark_results/results_<ts>.json and memory_profile.json, as
+python/test.py:178,196-203 wrote). Run with --quick for CI-sized grids.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+from ntxent_tpu.ops.ntxent_pallas import ntxent_loss_fused
+from ntxent_tpu.utils import (
+    DeviceMemoryTracker,
+    device_kind,
+    setup_logging,
+    time_fn,
+)
+
+logger = logging.getLogger("ntxent_tpu.bench")
+
+CPP_GRID_B = [32, 64, 128, 256, 512, 1024]
+CPP_GRID_D = [64, 128, 256]
+PY_GRID_B = [32, 64, 128, 256, 512]
+PY_GRID_D = [64, 128, 256, 512]
+STABILITY_SCALES = [1e-5, 1.0, 1e5]
+STABILITY_TEMPS = [0.01, 0.07, 1.0]
+
+
+def make_embeddings(b: int, d: int, dtype=jnp.float32):
+    z = jax.random.normal(jax.random.PRNGKey(0), (b, d), jnp.float32)
+    z = z / jnp.linalg.norm(z, axis=-1, keepdims=True)
+    return z.astype(dtype)
+
+
+def bench_forward(b: int, d: int, dtype, warmup: int, runs: int):
+    z = make_embeddings(b, d, dtype)
+    fwd = jax.jit(lambda zz: ntxent_loss_fused(zz, 0.07))
+    return time_fn(fwd, z, warmup=warmup, runs=runs)
+
+
+def bench_fwd_bwd(b: int, d: int, dtype, warmup: int, runs: int):
+    z = make_embeddings(b, d, dtype)
+    step = jax.jit(jax.value_and_grad(lambda zz: ntxent_loss_fused(zz, 0.07)))
+    return time_fn(step, z, warmup=warmup, runs=runs)
+
+
+def run_cpp_grid(quick: bool, results: dict, tracker: DeviceMemoryTracker):
+    bs = CPP_GRID_B[:3] if quick else CPP_GRID_B
+    ds = CPP_GRID_D[:2] if quick else CPP_GRID_D
+    runs = 10 if quick else 100
+    print(f"\n=== forward grid (reference benchmark.cpp protocol) on "
+          f"{device_kind()} ===")
+    print(f"{'B':>6} {'D':>5} {'mean ms':>10} {'std':>8} {'min':>8} {'max':>8}")
+    for b in bs:
+        for d in ds:
+            r = bench_forward(b, d, jnp.float32, warmup=1, runs=runs)
+            print(f"{b:>6} {d:>5} {r.mean_ms:>10.4f} {r.std_ms:>8.4f} "
+                  f"{r.min_ms:>8.4f} {r.max_ms:>8.4f}")
+            results.setdefault("forward_grid", []).append(
+                {"B": b, "D": d, **r.as_dict()})
+    tracker.log_memory("cpp_grid_done")
+
+
+def run_py_grid(quick: bool, results: dict, tracker: DeviceMemoryTracker):
+    bs = PY_GRID_B[:2] if quick else PY_GRID_B
+    ds = PY_GRID_D[:2] if quick else PY_GRID_D
+    warmup, runs = (2, 10) if quick else (10, 100)
+    print("\n=== fwd+bwd grid, fp32 vs bf16 (reference python/test.py "
+          "protocol) ===")
+    print(f"{'B':>6} {'D':>5} {'fp32 ms':>10} {'bf16 ms':>10} {'speedup':>8}")
+    for b in bs:
+        for d in ds:
+            r32 = bench_fwd_bwd(b, d, jnp.float32, warmup, runs)
+            r16 = bench_fwd_bwd(b, d, jnp.bfloat16, warmup, runs)
+            print(f"{b:>6} {d:>5} {r32.mean_ms:>10.4f} {r16.mean_ms:>10.4f} "
+                  f"{r32.mean_ms / max(r16.mean_ms, 1e-9):>8.2f}x")
+            results.setdefault("fwd_bwd_grid", []).append({
+                "B": b, "D": d, "fp32": r32.as_dict(), "bf16": r16.as_dict()})
+            tracker.log_memory(f"py_grid_B{b}_D{d}")
+
+
+def run_stability(results: dict):
+    print("\n=== numerical stability grid ===")
+    ok = True
+    for scale in STABILITY_SCALES:
+        for t in STABILITY_TEMPS:
+            z = make_embeddings(128, 256) * scale
+            loss, grad = jax.value_and_grad(
+                lambda zz: ntxent_loss_fused(zz, t))(z)
+            finite = bool(jnp.isfinite(loss)) and bool(
+                jnp.all(jnp.isfinite(grad)))
+            ok &= finite
+            print(f"scale={scale:<8g} T={t:<5g} loss={float(loss):<12.6f} "
+                  f"finite={finite}")
+            results.setdefault("stability", []).append(
+                {"scale": scale, "T": t, "loss": float(loss),
+                 "finite": finite})
+    results["stability_pass"] = ok
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true", help="CI-sized grids")
+    parser.add_argument("--out", default="benchmark_results")
+    args = parser.parse_args()
+
+    setup_logging()
+    tracker = DeviceMemoryTracker()
+    tracker.log_memory("start")
+    results: dict = {
+        "device": device_kind(),
+        "backend": jax.default_backend(),
+        "timestamp": time.strftime("%Y%m%d_%H%M%S"),
+    }
+
+    run_cpp_grid(args.quick, results, tracker)
+    run_py_grid(args.quick, results, tracker)
+    run_stability(results)
+
+    out_dir = Path(args.out)
+    out_dir.mkdir(exist_ok=True)
+    out_path = out_dir / f"results_{results['timestamp']}.json"
+    out_path.write_text(json.dumps(results, indent=2))
+    tracker.save_profile(out_dir / "memory_profile.json")
+    print(f"\nresults -> {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+// Native benchmark harness over the canonical NT-Xent C++ core.
+//
+// Re-hosts the reference's C++ benchmark protocol
+// (/root/reference/src/benchmark.cpp: warmup + 100 timed runs with a full
+// sync per iteration, grid B in {32..1024} x D in {64,128,256}, T=0.07,
+// mean/std/min/max reporting) against this framework's native host
+// implementation — the native-surface counterpart of benchmarks/
+// run_benchmarks.py, so the C++ layer has the same measurable contract the
+// reference's native layer had. CPU sync is implicit (synchronous calls).
+
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <random>
+#include <vector>
+
+extern "C" {
+int ntxent_forward_cpu(const float* z, int64_t two_n, int64_t dim,
+                       float temperature, float* loss_out, float* lse_out);
+int ntxent_backward_cpu(const float* z, const float* lse, int64_t two_n,
+                        int64_t dim, float temperature, float grad_output,
+                        float* grad_out);
+int ntxent_native_threads(void);
+}
+
+namespace {
+
+struct Stats {
+  double mean_ms, std_ms, min_ms, max_ms;
+};
+
+std::vector<float> make_embeddings(int64_t rows, int64_t dim, uint32_t seed) {
+  std::mt19937 gen(seed);
+  std::normal_distribution<float> dist(0.0f, 1.0f);
+  std::vector<float> z(rows * dim);
+  for (auto& v : z) v = dist(gen);
+  for (int64_t i = 0; i < rows; ++i) {
+    float norm = 0.0f;
+    for (int64_t k = 0; k < dim; ++k) norm += z[i * dim + k] * z[i * dim + k];
+    norm = std::sqrt(std::max(norm, 1e-12f));
+    for (int64_t k = 0; k < dim; ++k) z[i * dim + k] /= norm;
+  }
+  return z;
+}
+
+template <typename F>
+Stats time_runs(F&& fn, int warmup, int runs) {
+  for (int i = 0; i < warmup; ++i) fn();
+  std::vector<double> ms;
+  ms.reserve(runs);
+  for (int i = 0; i < runs; ++i) {
+    auto t0 = std::chrono::steady_clock::now();
+    fn();
+    auto t1 = std::chrono::steady_clock::now();
+    ms.push_back(std::chrono::duration<double, std::milli>(t1 - t0).count());
+  }
+  double sum = 0.0, mn = ms[0], mx = ms[0];
+  for (double v : ms) {
+    sum += v;
+    mn = std::min(mn, v);
+    mx = std::max(mx, v);
+  }
+  double mean = sum / ms.size();
+  double var = 0.0;
+  for (double v : ms) var += (v - mean) * (v - mean);
+  return {mean, std::sqrt(var / ms.size()), mn, mx};
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const int runs = std::max(1, argc > 1 ? std::atoi(argv[1]) : 100);
+  const float t = 0.07f;
+  std::printf("ntxent_tpu native harness: %d threads, %d runs/config\n",
+              ntxent_native_threads(), runs);
+  std::printf("%6s %5s | %10s %8s %8s %8s | %10s\n", "2N", "D", "fwd mean",
+              "std", "min", "max", "bwd mean");
+
+  const int64_t grid_b[] = {32, 64, 128, 256, 512, 1024};
+  const int64_t grid_d[] = {64, 128, 256};
+  for (int64_t b : grid_b) {
+    for (int64_t d : grid_d) {
+      auto z = make_embeddings(b, d, 42);
+      std::vector<float> lse(b), grad(b * d);
+      float loss = 0.0f;
+      auto fwd = time_runs(
+          [&] { ntxent_forward_cpu(z.data(), b, d, t, &loss, lse.data()); },
+          1, runs);
+      auto bwd = time_runs(
+          [&] {
+            ntxent_backward_cpu(z.data(), lse.data(), b, d, t, 1.0f,
+                                grad.data());
+          },
+          1, runs);
+      std::printf("%6lld %5lld | %10.4f %8.4f %8.4f %8.4f | %10.4f\n",
+                  static_cast<long long>(b), static_cast<long long>(d),
+                  fwd.mean_ms, fwd.std_ms, fwd.min_ms, fwd.max_ms,
+                  bwd.mean_ms);
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,165 @@
+// Native host implementation of canonical NT-Xent (C ABI shared library).
+//
+// Role in the framework (SURVEY.md §7.1): the reference's native surface is a
+// CUDA/C++ host op (+ cuBLAS) behind pybind11 (/root/reference/src/*.cu,
+// binding*.cpp). The TPU build's hot path is the Pallas kernel; this file is
+// the native-host counterpart: a portable, threaded, blockwise C++
+// implementation with the SAME canonical semantics (positives at (i+N) mod
+// 2N, diagonal masked) used as (a) a cross-language golden reference the
+// Python/Pallas stack is tested against, (b) the compute core of the native
+// benchmark harness, and (c) a CPU fallback callable from any host runtime
+// via ctypes/dlopen — no Python required.
+//
+// Design notes (deliberately NOT the reference's): no 2N x 2N matrix is
+// materialized (the reference allocated logits + softmax of that size,
+// ntxent_kernel.cu:154-158); each row block streams over column blocks with
+// an online-softmax fold (running max / running sum), exactly like the
+// Pallas kernel's VMEM tiling. Backward recomputes tiles flash-style and
+// produces the exact dense gradient (the reference's backward was wrong and
+// ignored grad_output; SURVEY.md §2.3-D8).
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr float kNegInf = -1e30f;
+
+inline int num_threads() {
+  unsigned hw = std::thread::hardware_concurrency();
+  return hw == 0 ? 1 : static_cast<int>(hw);
+}
+
+// Parallel-for over row blocks.
+template <typename F>
+void parallel_rows(int rows, F&& fn) {
+  int nt = std::min(num_threads(), rows);
+  if (nt <= 1) {
+    fn(0, rows);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(nt);
+  int chunk = (rows + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    int lo = t * chunk;
+    int hi = std::min(rows, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back([lo, hi, &fn] { fn(lo, hi); });
+  }
+  for (auto& th : pool) th.join();
+}
+
+inline float dot(const float* a, const float* b, int dim) {
+  float acc = 0.0f;
+  for (int k = 0; k < dim; ++k) acc += a[k] * b[k];
+  return acc;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Canonical NT-Xent forward.
+//   z:    (two_n, dim) row-major embeddings (caller normalizes if desired)
+//   loss_out: scalar mean loss
+//   lse_out:  optional (two_n) per-row logsumexp residuals (may be null)
+// Returns 0 on success, nonzero on invalid arguments.
+int ntxent_forward_cpu(const float* z, int64_t two_n, int64_t dim,
+                       float temperature, float* loss_out, float* lse_out) {
+  if (z == nullptr || loss_out == nullptr || two_n <= 0 || dim <= 0 ||
+      (two_n % 2) != 0 || temperature <= 0.0f) {
+    return 1;
+  }
+  const int64_t n = two_n / 2;
+  const float inv_t = 1.0f / temperature;
+
+  std::vector<double> partial(two_n, 0.0);
+  parallel_rows(static_cast<int>(two_n), [&](int lo, int hi) {
+    for (int i = lo; i < hi; ++i) {
+      const float* zi = z + static_cast<int64_t>(i) * dim;
+      float m = kNegInf;
+      float l = 0.0f;
+      for (int64_t j = 0; j < two_n; ++j) {
+        if (j == i) continue;  // masked diagonal
+        float s = dot(zi, z + j * dim, static_cast<int>(dim)) * inv_t;
+        if (s > m) {
+          l = l * std::exp(m - s) + 1.0f;
+          m = s;
+        } else {
+          l += std::exp(s - m);
+        }
+      }
+      const int64_t pos = (i + n) % two_n;
+      const float s_pos =
+          dot(zi, z + pos * dim, static_cast<int>(dim)) * inv_t;
+      const float lse = m + std::log(l);
+      if (lse_out != nullptr) lse_out[i] = lse;
+      partial[i] = static_cast<double>(lse) - static_cast<double>(s_pos);
+    }
+  });
+
+  double total = 0.0;
+  for (double p : partial) total += p;
+  *loss_out = static_cast<float>(total / static_cast<double>(two_n));
+  return 0;
+}
+
+// Exact dense gradient of the mean loss w.r.t. z, scaled by grad_output.
+//   lse: per-row logsumexp from forward (pass null to recompute internally).
+//   grad_out: (two_n, dim), overwritten.
+int ntxent_backward_cpu(const float* z, const float* lse, int64_t two_n,
+                        int64_t dim, float temperature, float grad_output,
+                        float* grad_out) {
+  if (z == nullptr || grad_out == nullptr || two_n <= 0 || dim <= 0 ||
+      (two_n % 2) != 0 || temperature <= 0.0f) {
+    return 1;
+  }
+  const int64_t n = two_n / 2;
+  const float inv_t = 1.0f / temperature;
+
+  std::vector<float> lse_local;
+  if (lse == nullptr) {
+    lse_local.resize(two_n);
+    float loss;
+    int rc = ntxent_forward_cpu(z, two_n, dim, temperature, &loss,
+                                lse_local.data());
+    if (rc != 0) return rc;
+    lse = lse_local.data();
+  }
+
+  const float scale = grad_output * inv_t / static_cast<float>(two_n);
+  // grad_z[a] = scale * sum_b (p[a,b] + p[b,a] - 2*1{b=pos(a)}) z[b]
+  // with p[a,b] = exp(s_ab - lse[a]) (s symmetric, diagonal masked).
+  parallel_rows(static_cast<int>(two_n), [&](int lo, int hi) {
+    for (int a = lo; a < hi; ++a) {
+      const float* za = z + static_cast<int64_t>(a) * dim;
+      float* ga = grad_out + static_cast<int64_t>(a) * dim;
+      std::memset(ga, 0, sizeof(float) * dim);
+      const int64_t pos_a = (a + n) % two_n;
+      for (int64_t b = 0; b < two_n; ++b) {
+        if (b == a) continue;
+        const float* zb = z + b * dim;
+        const float s = dot(za, zb, static_cast<int>(dim)) * inv_t;
+        float w = std::exp(s - lse[a]) + std::exp(s - lse[b]);
+        if (b == pos_a) w -= 2.0f;
+        w *= scale;
+        for (int64_t k = 0; k < dim; ++k) ga[k] += w * zb[k];
+      }
+    }
+  });
+  return 0;
+}
+
+// Capability probe (native analog of check_tensor_core_support,
+// binding_new.cpp:19-20): reports host SIMD/thread facts.
+int ntxent_native_threads(void) { return num_threads(); }
+
+const char* ntxent_native_version(void) { return "ntxent_tpu-native-0.1.0"; }
+
+}  // extern "C"

@@ -1,0 +1,176 @@
+// Native unit tests for the C++ NT-Xent core (no GPU, no GTest dependency).
+//
+// Covers what the reference's GTest suite attempted
+// (/root/reference/tests/test_forward.cpp, test_backward.cpp) — smoke
+// positivity/finiteness, batch-size sweep, gradient norm bounds — PLUS the
+// checks it lacked entirely (SURVEY.md §4): a closed-form value check and a
+// finite-difference gradient check. Unlike the reference's suite, which
+// hard-required a physical CUDA device (test_forward.cpp:8-11) and could not
+// compile (D5), this runs anywhere.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+#include <vector>
+
+extern "C" {
+int ntxent_forward_cpu(const float* z, int64_t two_n, int64_t dim,
+                       float temperature, float* loss_out, float* lse_out);
+int ntxent_backward_cpu(const float* z, const float* lse, int64_t two_n,
+                        int64_t dim, float temperature, float grad_output,
+                        float* grad_out);
+}
+
+namespace {
+
+int failures = 0;
+
+#define CHECK(cond, msg)                                        \
+  do {                                                          \
+    if (!(cond)) {                                              \
+      std::printf("FAIL %s:%d: %s\n", __FILE__, __LINE__, msg); \
+      ++failures;                                               \
+    }                                                           \
+  } while (0)
+
+std::vector<float> random_embeddings(int64_t rows, int64_t dim,
+                                     uint32_t seed) {
+  std::mt19937 gen(seed);
+  std::normal_distribution<float> dist(0.0f, 1.0f);
+  std::vector<float> z(rows * dim);
+  for (auto& v : z) v = dist(gen);
+  for (int64_t i = 0; i < rows; ++i) {
+    float norm = 0.0f;
+    for (int64_t k = 0; k < dim; ++k) norm += z[i * dim + k] * z[i * dim + k];
+    norm = std::sqrt(std::max(norm, 1e-12f));
+    for (int64_t k = 0; k < dim; ++k) z[i * dim + k] /= norm;
+  }
+  return z;
+}
+
+float forward(const std::vector<float>& z, int64_t two_n, int64_t dim,
+              float t) {
+  float loss = -1.0f;
+  int rc = ntxent_forward_cpu(z.data(), two_n, dim, t, &loss, nullptr);
+  CHECK(rc == 0, "forward rc");
+  return loss;
+}
+
+void test_basic_forward() {
+  // Smoke parity with BasicForward (test_forward.cpp:19-27): loss > 0, finite.
+  auto z = random_embeddings(64, 128, 1);
+  float loss = forward(z, 64, 128, 0.07f);
+  CHECK(loss > 0.0f, "loss positive");
+  CHECK(std::isfinite(loss), "loss finite");
+}
+
+void test_batch_sizes() {
+  // Mirror of DifferentBatchSizes (test_forward.cpp:40-52).
+  for (int64_t b : {16, 32, 64, 128}) {
+    auto z = random_embeddings(b, 128, 2);
+    float loss = forward(z, b, 128, 0.07f);
+    CHECK(std::isfinite(loss) && loss > 0.0f, "batch sweep finite/positive");
+  }
+}
+
+void test_closed_form_two_pairs() {
+  // 2N=4 hand-checkable case: orthonormal pairs. For unit rows with
+  // z0.z2 = 1 (identical), z0.z1 = z0.z3 = 0:
+  // row0: masked lse over {s01=0, s02=1/T, s03=0}; pos(0)=2 -> s=1/T.
+  const float t = 0.5f;
+  std::vector<float> z = {
+      1, 0,  // z0
+      0, 1,  // z1
+      1, 0,  // z2 = z0 (its positive)
+      0, 1,  // z3 = z1
+  };
+  float loss = forward(z, 4, 2, t);
+  const float inv_t = 1.0f / t;
+  // each row: lse = log(exp(inv_t) + 2*exp(0)), pos sim = inv_t
+  const float expected = std::log(std::exp(inv_t) + 2.0f) - inv_t;
+  CHECK(std::fabs(loss - expected) < 1e-5f, "closed-form value");
+}
+
+void test_invalid_arguments() {
+  float loss;
+  auto z = random_embeddings(8, 4, 3);
+  CHECK(ntxent_forward_cpu(nullptr, 8, 4, 0.07f, &loss, nullptr) != 0,
+        "null z rejected");
+  CHECK(ntxent_forward_cpu(z.data(), 7, 4, 0.07f, &loss, nullptr) != 0,
+        "odd rows rejected");
+  CHECK(ntxent_forward_cpu(z.data(), 8, 4, -1.0f, &loss, nullptr) != 0,
+        "bad temperature rejected");
+}
+
+void test_backward_finite_and_norm() {
+  // Mirror of BasicBackward + GradientNorm (test_backward.cpp:19-49):
+  // finite grads, 0 < ||g|| < 100 at 2N=64, D=128.
+  auto z = random_embeddings(64, 128, 4);
+  std::vector<float> grad(64 * 128);
+  int rc = ntxent_backward_cpu(z.data(), nullptr, 64, 128, 0.07f, 1.0f,
+                               grad.data());
+  CHECK(rc == 0, "backward rc");
+  double norm = 0.0;
+  bool finite = true;
+  for (float g : grad) {
+    finite &= std::isfinite(g);
+    norm += static_cast<double>(g) * g;
+  }
+  norm = std::sqrt(norm);
+  CHECK(finite, "grads finite");
+  CHECK(norm > 0.0 && norm < 100.0, "grad norm in (0, 100)");
+}
+
+void test_backward_finite_difference() {
+  // The gradcheck the reference never had (SURVEY.md §2.3-D8).
+  const int64_t two_n = 8, dim = 6;
+  const float t = 0.2f;
+  auto z = random_embeddings(two_n, dim, 5);
+  std::vector<float> grad(two_n * dim);
+  CHECK(ntxent_backward_cpu(z.data(), nullptr, two_n, dim, t, 1.0f,
+                            grad.data()) == 0,
+        "backward rc");
+  const float eps = 1e-3f;
+  const int64_t probes[][2] = {{0, 0}, {3, 2}, {7, 5}};
+  for (auto& p : probes) {
+    auto zp = z, zm = z;
+    zp[p[0] * dim + p[1]] += eps;
+    zm[p[0] * dim + p[1]] -= eps;
+    float fd = (forward(zp, two_n, dim, t) - forward(zm, two_n, dim, t)) /
+               (2 * eps);
+    float an = grad[p[0] * dim + p[1]];
+    CHECK(std::fabs(fd - an) < 5e-3f * std::max(1.0f, std::fabs(fd)),
+          "finite-difference gradient match");
+  }
+}
+
+void test_grad_output_scaling() {
+  // grad_output is honored (the reference ignored it, D8).
+  auto z = random_embeddings(16, 8, 6);
+  std::vector<float> g1(16 * 8), g3(16 * 8);
+  ntxent_backward_cpu(z.data(), nullptr, 16, 8, 0.07f, 1.0f, g1.data());
+  ntxent_backward_cpu(z.data(), nullptr, 16, 8, 0.07f, 3.0f, g3.data());
+  for (size_t i = 0; i < g1.size(); ++i) {
+    CHECK(std::fabs(g3[i] - 3.0f * g1[i]) < 1e-4f, "grad_output scaling");
+  }
+}
+
+}  // namespace
+
+int main() {
+  test_basic_forward();
+  test_batch_sizes();
+  test_closed_form_two_pairs();
+  test_invalid_arguments();
+  test_backward_finite_and_norm();
+  test_backward_finite_difference();
+  test_grad_output_scaling();
+  if (failures == 0) {
+    std::printf("native tests: ALL PASS\n");
+    return 0;
+  }
+  std::printf("native tests: %d FAILURES\n", failures);
+  return 1;
+}

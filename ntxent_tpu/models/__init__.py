@@ -1,0 +1,37 @@
+from ntxent_tpu.models.clip import CLIPModel, TextTransformer
+from ntxent_tpu.models.projection import ProjectionHead, SimCLRModel
+from ntxent_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet50x2,
+    ResNet101,
+    ResNet152,
+)
+from ntxent_tpu.models.vit import (
+    ViT_B16,
+    ViT_L16,
+    ViT_S16,
+    ViT_Ti16,
+    VisionTransformer,
+)
+
+__all__ = [
+    "CLIPModel",
+    "TextTransformer",
+    "ProjectionHead",
+    "SimCLRModel",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet50x2",
+    "ResNet101",
+    "ResNet152",
+    "VisionTransformer",
+    "ViT_Ti16",
+    "ViT_S16",
+    "ViT_B16",
+    "ViT_L16",
+]

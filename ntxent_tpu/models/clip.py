@@ -1,0 +1,86 @@
+"""CLIP-style dual encoder for cross-modal InfoNCE.
+
+Workload named by BASELINE.json configs[4] (CLIP text-image InfoNCE, global
+batch 32768). Image tower: any encoder from models/ (ResNet or ViT); text
+tower: a small causal-free transformer over token ids with EOT pooling.
+The loss is ``ops.oracle.info_nce_loss`` (or its distributed/ring analogs)
+on the two L2-normalized embeddings plus a learnable logit scale.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.oracle import cosine_normalize
+from .vit import EncoderBlock
+
+__all__ = ["TextTransformer", "CLIPModel"]
+
+
+class TextTransformer(nn.Module):
+    vocab_size: int = 49408
+    max_len: int = 77
+    hidden_dim: int = 512
+    depth: int = 12
+    num_heads: int = 8
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        b, t = tokens.shape
+        x = nn.Embed(self.vocab_size, self.hidden_dim,
+                     param_dtype=jnp.float32, dtype=self.dtype)(tokens)
+        pos = self.param("pos_embed", nn.initializers.normal(stddev=0.01),
+                         (1, self.max_len, self.hidden_dim), jnp.float32)
+        x = x + pos[:, :t].astype(self.dtype)
+        # Causal mask (CLIP-standard): keeps the EOT feature independent of
+        # trailing pad tokens — position i attends only to positions <= i.
+        causal = nn.make_causal_mask(tokens)
+        for i in range(self.depth):
+            x = EncoderBlock(self.num_heads, self.hidden_dim * 4, self.dtype,
+                             name=f"block_{i}")(x, mask=causal)
+        x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x)
+        # EOT pooling: feature at each sequence's last non-pad position
+        # (pad id assumed 0; argmax of position*mask finds the last token).
+        mask = (tokens != 0).astype(jnp.int32)
+        last = jnp.maximum(jnp.sum(mask, axis=1) - 1, 0)
+        return x[jnp.arange(b), last].astype(jnp.float32)
+
+
+class CLIPModel(nn.Module):
+    """Dual encoder -> (image_embeds, text_embeds, logit_scale)."""
+
+    image_encoder: Callable[..., nn.Module]
+    text_encoder: Callable[..., nn.Module] = TextTransformer
+    embed_dim: int = 512
+
+    def setup(self):
+        self.image_tower = self.image_encoder()
+        self.text_tower = self.text_encoder()
+        self.image_proj = nn.Dense(self.embed_dim, use_bias=False,
+                                   param_dtype=jnp.float32, name="image_proj")
+        self.text_proj = nn.Dense(self.embed_dim, use_bias=False,
+                                  param_dtype=jnp.float32, name="text_proj")
+        # CLIP-standard init: temperature 0.07 as log scale, clamped in loss.
+        self.logit_scale = self.param(
+            "logit_scale",
+            lambda key: jnp.asarray(np.log(1.0 / 0.07), jnp.float32),
+        )
+
+    def __call__(self, images, tokens, train: bool = True):
+        zi = cosine_normalize(self.image_proj(self.image_tower(images, train=train)))
+        zt = cosine_normalize(self.text_proj(self.text_tower(tokens, train=train)))
+        scale = jnp.clip(jnp.exp(self.logit_scale), 0.0, 100.0)
+        return zi, zt, scale
+
+    def encode_image(self, images):
+        return cosine_normalize(
+            self.image_proj(self.image_tower(images, train=False)))
+
+    def encode_text(self, tokens):
+        return cosine_normalize(
+            self.text_proj(self.text_tower(tokens, train=False)))

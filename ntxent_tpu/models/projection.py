@@ -1,0 +1,67 @@
+"""SimCLR projection head and full contrastive model wrappers.
+
+SimCLR (Chen et al. 2020) applies a small MLP g(.) on encoder features and
+computes NT-Xent on its L2-normalized output — the (2N, D) embeddings the
+reference's kernel consumed as its input `z` (ntxent_kernel.cuh:31-35).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.oracle import cosine_normalize
+
+__all__ = ["ProjectionHead", "SimCLRModel"]
+
+
+class ProjectionHead(nn.Module):
+    """2-layer MLP (hidden -> BN+ReLU -> out), SimCLR-standard."""
+
+    hidden_dim: int = 2048
+    out_dim: int = 128
+    dtype: jnp.dtype = jnp.bfloat16
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Dense(self.hidden_dim, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="fc1")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         use_fast_variance=False,
+                         dtype=self.dtype, param_dtype=jnp.float32,
+                         axis_name=self.axis_name if train else None,
+                         name="bn1")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.out_dim, use_bias=False, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="fc2")(x)
+        return x.astype(jnp.float32)
+
+
+class SimCLRModel(nn.Module):
+    """Encoder + projection head -> L2-normalized contrastive embeddings."""
+
+    encoder: Callable[..., nn.Module]
+    proj_hidden_dim: int = 2048
+    proj_dim: int = 128
+    axis_name: str | None = None
+    dtype: jnp.dtype = jnp.bfloat16  # projection-head compute dtype
+
+    def setup(self):
+        self.backbone = self.encoder()
+        self.projector = ProjectionHead(
+            hidden_dim=self.proj_hidden_dim, out_dim=self.proj_dim,
+            axis_name=self.axis_name, dtype=self.dtype,
+        )
+
+    def __call__(self, x, train: bool = True):
+        h = self.backbone(x, train=train)
+        z = self.projector(h, train=train)
+        return cosine_normalize(z)
+
+    def features(self, x, train: bool = False):
+        """Encoder features for linear evaluation (no projection)."""
+        return self.backbone(x, train=train)

@@ -1,0 +1,132 @@
+"""Flax ResNet (v1.5) encoders for SimCLR pretraining.
+
+The reference framework names SimCLR but contains no model code (SURVEY.md
+§0.2); BASELINE.json's north star specifies ResNet-50 SimCLR pretraining
+(configs[1-2]). This is a TPU-first implementation:
+
+* NHWC layout (TPU conv-native) with bf16 activations / fp32 params and
+  fp32 batch-norm statistics.
+* ``axis_name``-aware BatchNorm: pass the mesh data axis to get cross-replica
+  (global) batch statistics — the distributed-BN SimCLR needs at large batch
+  (hand-rolled as SyncBN/NCCL elsewhere; here it is one argument, lowered to
+  an XLA psum over ICI).
+* stride-2 3x3 in the bottleneck's middle conv (v1.5), SimCLR-standard.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Sequence
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
+           "ResNet152", "ResNet50x2"]
+
+ModuleDef = Callable
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides,) * 2)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)  # zero-init last BN
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 strides=(self.strides,) * 2,
+                                 name="proj_conv")(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return self.act(y + residual)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides,) * 2)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1),
+                                 strides=(self.strides,) * 2,
+                                 name="proj_conv")(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return self.act(y + residual)
+
+
+class ResNet(nn.Module):
+    """Returns pooled (B, width*512*expansion-ish) features — no classifier.
+
+    ``axis_name``: mesh axis for cross-replica BN statistics (None = local).
+    ``small_images``: CIFAR stem (3x3/1 conv, no maxpool) vs ImageNet stem.
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: type = BottleneckBlock
+    width_multiplier: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+    axis_name: str | None = None
+    small_images: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       use_fast_variance=False,
+                       param_dtype=jnp.float32,
+                       axis_name=self.axis_name if train else None)
+        act = nn.relu
+
+        x = x.astype(self.dtype)
+        width = 64 * self.width_multiplier
+        if self.small_images:
+            x = conv(width, (3, 3), name="stem_conv")(x)
+        else:
+            x = conv(width, (7, 7), strides=(2, 2), name="stem_conv")(x)
+        x = norm(name="stem_bn")(x)
+        x = act(x)
+        if not self.small_images:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        for i, num_blocks in enumerate(self.stage_sizes):
+            for j in range(num_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    filters=width * 2**i, strides=strides,
+                    conv=conv, norm=norm, act=act,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return x.astype(jnp.float32)
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=BottleneckBlock)
+ResNet152 = partial(ResNet, stage_sizes=(3, 8, 36, 3), block_cls=BottleneckBlock)
+ResNet50x2 = partial(ResNet, stage_sizes=(3, 4, 6, 3),
+                     block_cls=BottleneckBlock, width_multiplier=2)

@@ -1,0 +1,92 @@
+"""Flax Vision Transformer encoder (ViT-B/16 class) for SimCLR / CLIP.
+
+Workload named by BASELINE.json configs[3] (ViT-B/16 SimCLR, global batch
+8192 on v5p-64). TPU-first choices: bf16 activations with fp32 params and
+fp32 LayerNorm/softmax, patchify as a strided conv (lowers to one MXU
+matmul), sequence length 197 padded naturally by XLA, fused-friendly MLP.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["VisionTransformer", "ViT_Ti16", "ViT_S16", "ViT_B16", "ViT_L16"]
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.gelu(x)
+        return nn.Dense(d, dtype=self.dtype, param_dtype=jnp.float32)(x)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, dtype=self.dtype,
+            param_dtype=jnp.float32,
+        )(y, y, mask=mask)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        return x + MlpBlock(self.mlp_dim, self.dtype)(y)
+
+
+class VisionTransformer(nn.Module):
+    """Returns (B, hidden) CLS-token features — no classifier head."""
+
+    patch_size: int = 16
+    hidden_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b, h, w, _ = x.shape
+        x = x.astype(self.dtype)
+        # Patchify = conv with kernel == stride == patch: one big MXU matmul.
+        x = nn.Conv(self.hidden_dim, (self.patch_size,) * 2,
+                    strides=(self.patch_size,) * 2, padding="VALID",
+                    dtype=self.dtype, param_dtype=jnp.float32,
+                    name="patch_embed")(x)
+        x = x.reshape(b, -1, self.hidden_dim)
+
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, self.hidden_dim), jnp.float32)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.hidden_dim)
+                                              ).astype(self.dtype), x], axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, x.shape[1], self.hidden_dim), jnp.float32)
+        x = x + pos.astype(self.dtype)
+
+        for i in range(self.depth):
+            x = EncoderBlock(self.num_heads, self.mlp_dim, self.dtype,
+                             name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x)
+        return x[:, 0].astype(jnp.float32)  # CLS token
+
+
+ViT_Ti16 = partial(VisionTransformer, hidden_dim=192, depth=12, num_heads=3,
+                   mlp_dim=768)
+ViT_S16 = partial(VisionTransformer, hidden_dim=384, depth=12, num_heads=6,
+                  mlp_dim=1536)
+ViT_B16 = partial(VisionTransformer, hidden_dim=768, depth=12, num_heads=12,
+                  mlp_dim=3072)
+ViT_L16 = partial(VisionTransformer, hidden_dim=1024, depth=24, num_heads=16,
+                  mlp_dim=4096)

@@ -1,0 +1,145 @@
+"""ctypes bridge to the native C++ NT-Xent core (native/).
+
+The binding role the reference gave pybind11 (src/binding*.cpp), done with
+ctypes against a C ABI so no torch/pybind build dependency exists. Provides
+``forward_cpu``/``backward_cpu`` (the cross-language golden reference used by
+tests/test_native.py) and ``build_native()`` to compile the library with
+cmake+ninja on first use."""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+__all__ = ["build_native", "load_library", "forward_cpu", "backward_cpu",
+           "native_available"]
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+_BUILD_DIR = _NATIVE_DIR / "build"
+_LIB = None
+
+
+def _sources_mtime() -> float:
+    files = list((_NATIVE_DIR / "src").glob("*.cpp")) + \
+        [_NATIVE_DIR / "CMakeLists.txt"]
+    return max((f.stat().st_mtime for f in files if f.exists()), default=0.0)
+
+
+def _run_logged(cmd: list[str]) -> None:
+    proc = subprocess.run(cmd, cwd=_BUILD_DIR, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build step failed: {' '.join(cmd)}\n"
+            f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}")
+
+
+def build_native(force: bool = False) -> Path:
+    """Compile the native library (cmake + ninja/make). Returns the .so path.
+
+    Rebuilds automatically when any native source is newer than the library.
+    """
+    lib = _find_lib()
+    if lib is not None and not force \
+            and lib.stat().st_mtime >= _sources_mtime():
+        return lib
+    _BUILD_DIR.mkdir(exist_ok=True)
+    gen = ["-G", "Ninja"] if _have("ninja") else []
+    _run_logged(["cmake", *gen, ".."])
+    _run_logged(["cmake", "--build", ".", "-j"])
+    lib = _find_lib()
+    if lib is None:
+        raise RuntimeError(f"native build produced no library in {_BUILD_DIR}")
+    return lib
+
+
+def _have(tool: str) -> bool:
+    from shutil import which
+
+    return which(tool) is not None
+
+
+def _find_lib() -> Path | None:
+    for name in ("libntxent_cpu.so", "libntxent_cpu.dylib"):
+        p = _BUILD_DIR / name
+        if p.exists():
+            return p
+    return None
+
+
+def native_available() -> bool:
+    return _find_lib() is not None or _have("cmake")
+
+
+def load_library(build_if_missing: bool = True) -> ctypes.CDLL:
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    if build_if_missing:
+        lib_path = build_native()  # no-op when fresh; rebuilds when stale
+    else:
+        lib_path = _find_lib()
+        if lib_path is None:
+            raise FileNotFoundError("native library not built; call "
+                                    "build_native() or run cmake in native/")
+    lib = ctypes.CDLL(str(lib_path))
+    lib.ntxent_forward_cpu.restype = ctypes.c_int
+    lib.ntxent_forward_cpu.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_float, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.ntxent_backward_cpu.restype = ctypes.c_int
+    lib.ntxent_backward_cpu.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.ntxent_native_threads.restype = ctypes.c_int
+    _LIB = lib
+    return lib
+
+
+def _as_float_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def forward_cpu(z: np.ndarray, temperature: float = 0.07,
+                return_lse: bool = False):
+    """Native canonical NT-Xent forward. z: (2N, D) float32."""
+    lib = load_library()
+    z = np.ascontiguousarray(z, dtype=np.float32)
+    two_n, dim = z.shape
+    loss = ctypes.c_float(-1.0)
+    lse = np.empty(two_n, np.float32) if return_lse else None
+    rc = lib.ntxent_forward_cpu(
+        _as_float_ptr(z), two_n, dim, ctypes.c_float(temperature),
+        ctypes.byref(loss),
+        _as_float_ptr(lse) if lse is not None else None,
+    )
+    if rc != 0:
+        raise ValueError(f"ntxent_forward_cpu failed (rc={rc}); check shapes "
+                         f"({two_n}x{dim}) and temperature {temperature}")
+    return (float(loss.value), lse) if return_lse else float(loss.value)
+
+
+def backward_cpu(z: np.ndarray, temperature: float = 0.07,
+                 grad_output: float = 1.0,
+                 lse: np.ndarray | None = None) -> np.ndarray:
+    """Native exact gradient of the mean loss w.r.t. z."""
+    lib = load_library()
+    z = np.ascontiguousarray(z, dtype=np.float32)
+    two_n, dim = z.shape
+    grad = np.empty_like(z)
+    rc = lib.ntxent_backward_cpu(
+        _as_float_ptr(z),
+        _as_float_ptr(np.ascontiguousarray(lse, np.float32))
+        if lse is not None else None,
+        two_n, dim, ctypes.c_float(temperature),
+        ctypes.c_float(grad_output), _as_float_ptr(grad),
+    )
+    if rc != 0:
+        raise ValueError(f"ntxent_backward_cpu failed (rc={rc})")
+    return grad

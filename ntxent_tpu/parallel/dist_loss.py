@@ -28,12 +28,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops.ntxent_pallas import ntxent_partial_fused
 from .mesh import local_row_gids
 
-__all__ = ["ntxent_loss_distributed", "make_sharded_ntxent"]
+__all__ = ["ntxent_loss_distributed", "make_sharded_ntxent",
+           "local_ntxent_allgather"]
 
 
-def _local_partial(z1_local, z2_local, temperature, axis, num_devices,
-                   interpret):
-    """Per-device body (runs inside shard_map): gather, fused partial, psum."""
+def local_ntxent_allgather(z1_local, z2_local, temperature, axis, num_devices,
+                           interpret=None):
+    """Per-device global-batch NT-Xent body (call inside shard_map/psum
+    context): all-gather both views, fused local-rows x global-cols partial
+    loss, psum to the global mean. Shared by the standalone distributed loss
+    below and the trainer's sharded train step."""
     n_local = z1_local.shape[0]
     # tiled=True concatenates shards along axis 0: (n_local, D) -> (N, D).
     z1_g = jax.lax.all_gather(z1_local, axis, tiled=True)
@@ -62,7 +66,7 @@ def make_sharded_ntxent(
     num_devices = mesh.shape[axis]
 
     body = functools.partial(
-        _local_partial,
+        local_ntxent_allgather,
         temperature=float(temperature),
         axis=axis,
         num_devices=num_devices,

@@ -1,0 +1,44 @@
+from ntxent_tpu.training.augment import augment_batch_pair, augment_pair
+from ntxent_tpu.training.checkpoint import CheckpointManager
+from ntxent_tpu.training.data import (
+    ArrayDataset,
+    PrefetchIterator,
+    synthetic_images,
+    two_view_iterator,
+)
+from ntxent_tpu.training.lars import (
+    cosine_warmup_schedule,
+    create_lars,
+    simclr_learning_rate,
+)
+from ntxent_tpu.training.trainer import (
+    TrainerConfig,
+    TrainState,
+    create_train_state,
+    estimate_mfu,
+    make_sharded_train_step,
+    make_train_step,
+    shard_batch,
+    train_loop,
+)
+
+__all__ = [
+    "augment_batch_pair",
+    "augment_pair",
+    "CheckpointManager",
+    "ArrayDataset",
+    "PrefetchIterator",
+    "synthetic_images",
+    "two_view_iterator",
+    "cosine_warmup_schedule",
+    "create_lars",
+    "simclr_learning_rate",
+    "TrainerConfig",
+    "TrainState",
+    "create_train_state",
+    "estimate_mfu",
+    "make_sharded_train_step",
+    "make_train_step",
+    "shard_batch",
+    "train_loop",
+]

@@ -1,0 +1,144 @@
+"""SimCLR two-view augmentation pipeline, pure JAX (runs on device).
+
+The reference contains no augmentation code (SURVEY.md §0.2); SimCLR's
+recipe (Chen et al. 2020, §A) is: random resized crop + horizontal flip +
+color jitter (brightness/contrast/saturation/hue, p=0.8) + grayscale (p=0.2)
++ Gaussian blur (p=0.5). Everything here is jit/vmap-friendly with static
+shapes: crops use ``jax.image.scale_and_translate`` (traced scale/offset,
+static output), hue rotates chroma in YIQ space, blur is a separable
+depthwise conv — so the whole two-view pipeline fuses into the device step
+instead of bottlenecking host CPU (the ">=50% MFU is input-bound territory"
+risk called out in SURVEY.md §7.4)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["augment_pair", "augment_batch_pair", "random_resized_crop",
+           "color_jitter", "random_grayscale", "gaussian_blur",
+           "random_flip"]
+
+_RGB_TO_Y = jnp.array([0.299, 0.587, 0.114])
+
+
+def random_resized_crop(key, image, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+    """Crop a random area/aspect box and resize back to the input size."""
+    h, w, _ = image.shape
+    k_area, k_ratio, k_x, k_y = jax.random.split(key, 4)
+    area = jax.random.uniform(k_area, (), minval=scale[0], maxval=scale[1])
+    log_ratio = jax.random.uniform(
+        k_ratio, (), minval=jnp.log(ratio[0]), maxval=jnp.log(ratio[1]))
+    aspect = jnp.exp(log_ratio)
+    crop_h = jnp.clip(jnp.sqrt(area / aspect) * h, 1.0, h)
+    crop_w = jnp.clip(jnp.sqrt(area * aspect) * w, 1.0, w)
+    y0 = jax.random.uniform(k_y, (), maxval=1.0) * (h - crop_h)
+    x0 = jax.random.uniform(k_x, (), maxval=1.0) * (w - crop_w)
+    # Map the crop box back onto the full canvas: out = scale*in + translate.
+    sy, sx = h / crop_h, w / crop_w
+    return jax.image.scale_and_translate(
+        image, (h, w, image.shape[2]), (0, 1),
+        jnp.array([sy, sx]), jnp.array([-y0 * sy, -x0 * sx]),
+        method="bilinear",
+    )
+
+
+def random_flip(key, image):
+    return jnp.where(jax.random.bernoulli(key), image[:, ::-1, :], image)
+
+
+def _adjust_saturation(image, factor):
+    gray = jnp.tensordot(image, _RGB_TO_Y, axes=1)[..., None]
+    return gray + factor * (image - gray)
+
+
+def _adjust_hue(image, delta):
+    """Rotate chroma in YIQ space by ``delta`` (radians-scale factor)."""
+    yiq_from_rgb = jnp.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.322],
+                              [0.211, -0.523, 0.312]])
+    rgb_from_yiq = jnp.linalg.inv(yiq_from_rgb)
+    yiq = image @ yiq_from_rgb.T
+    cos, sin = jnp.cos(delta), jnp.sin(delta)
+    zero, one = jnp.float32(0.0), jnp.float32(1.0)
+    rot = jnp.stack([
+        jnp.stack([one, zero, zero]),
+        jnp.stack([zero, cos, -sin]),
+        jnp.stack([zero, sin, cos]),
+    ])
+    return (yiq @ rot.T) @ rgb_from_yiq.T
+
+
+def color_jitter(key, image, strength: float = 1.0):
+    """SimCLR color jitter: brightness/contrast/saturation 0.8s, hue 0.2s,
+    applied in random order (order randomization approximated by fixed order
+    with independent strengths — the distortion family is the same)."""
+    kb, kc, ks, kh = jax.random.split(key, 4)
+    b = 0.8 * strength
+    image = image * jax.random.uniform(kb, (), minval=1 - b, maxval=1 + b)
+    mean = jnp.mean(jnp.tensordot(image, _RGB_TO_Y, axes=1))
+    image = mean + (image - mean) * jax.random.uniform(
+        kc, (), minval=1 - b, maxval=1 + b)
+    image = _adjust_saturation(image, jax.random.uniform(
+        ks, (), minval=1 - b, maxval=1 + b))
+    # torchvision hue=h rotates by h * 2*pi radians (SimCLR uses h=0.2*s).
+    image = _adjust_hue(image, jax.random.uniform(
+        kh, (), minval=-0.2 * strength, maxval=0.2 * strength) * 2 * jnp.pi)
+    return jnp.clip(image, 0.0, 1.0)
+
+
+def random_grayscale(key, image, p: float = 0.2):
+    gray = jnp.tensordot(image, _RGB_TO_Y, axes=1)[..., None]
+    gray = jnp.broadcast_to(gray, image.shape)
+    return jnp.where(jax.random.bernoulli(key, p), gray, image)
+
+
+def gaussian_blur(key, image, kernel_size: int = 0, p: float = 0.5):
+    """Separable Gaussian blur with sigma ~ U(0.1, 2.0), SimCLR-standard.
+    kernel_size defaults to ~10% of image size (odd)."""
+    h = image.shape[0]
+    if kernel_size <= 0:
+        kernel_size = max(3, (h // 10) | 1)
+    k_sigma, k_apply = jax.random.split(key)
+    sigma = jax.random.uniform(k_sigma, (), minval=0.1, maxval=2.0)
+    r = kernel_size // 2
+    xs = jnp.arange(-r, r + 1, dtype=jnp.float32)
+    kern = jnp.exp(-0.5 * (xs / sigma) ** 2)
+    kern = kern / jnp.sum(kern)
+    img = jnp.moveaxis(image, -1, 0)[:, None]  # (C, 1, H, W)
+    blurred = jax.lax.conv_general_dilated(
+        img, kern.reshape(1, 1, -1, 1), (1, 1), "SAME")
+    blurred = jax.lax.conv_general_dilated(
+        blurred, kern.reshape(1, 1, 1, -1), (1, 1), "SAME")
+    blurred = jnp.moveaxis(blurred[:, 0], 0, -1)
+    return jnp.where(jax.random.bernoulli(k_apply, p), blurred, image)
+
+
+def augment_one(key, image, strength: float = 1.0, blur: bool = True):
+    """One SimCLR view from one image (H, W, C) in [0, 1]."""
+    k_crop, k_flip, k_jit, k_jit_p, k_gray, k_blur = jax.random.split(key, 6)
+    image = random_resized_crop(k_crop, image)
+    image = random_flip(k_flip, image)
+    jittered = color_jitter(k_jit, image, strength)
+    image = jnp.where(jax.random.bernoulli(k_jit_p, 0.8), jittered, image)
+    image = random_grayscale(k_gray, image)
+    if blur:
+        image = gaussian_blur(k_blur, image)
+    return image
+
+
+def augment_pair(key, image, strength: float = 1.0, blur: bool = True):
+    """Two independent SimCLR views of one image."""
+    k1, k2 = jax.random.split(key)
+    return (augment_one(k1, image, strength, blur),
+            augment_one(k2, image, strength, blur))
+
+
+@partial(jax.jit, static_argnames=("strength", "blur"))
+def augment_batch_pair(key, images, strength: float = 1.0, blur: bool = True):
+    """Two views for a batch (B, H, W, C) -> ((B, H, W, C), (B, H, W, C))."""
+    keys = jax.random.split(key, images.shape[0])
+    return jax.vmap(partial(augment_pair, strength=strength, blur=blur)
+                    )(keys, images)

@@ -1,0 +1,57 @@
+"""Orbax checkpoint/resume for multi-day pretraining runs.
+
+The reference has no persistence beyond benchmark JSON (SURVEY.md §5.4);
+the BASELINE.json configs[2-4] runs (ImageNet/v5e-32 and up) require real
+checkpoint/resume. Orbax handles multi-host coordination and atomic writes."""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CheckpointManager"]
+
+
+class CheckpointManager:
+    """Thin wrapper over orbax CheckpointManager for TrainState pytrees."""
+
+    def __init__(self, directory: str | Path, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        self.directory = Path(directory).absolute()
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                create=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        saved = self.manager.save(
+            step, args=ocp.args.StandardSave(state), force=force)
+        if saved:
+            logger.info("checkpoint saved at step %d -> %s", step,
+                        self.directory)
+        return saved
+
+    def restore(self, state_template: Any, step: int | None = None) -> Any:
+        step = step if step is not None else self.manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        return self.manager.restore(
+            step, args=ocp.args.StandardRestore(state_template))
+
+    def latest_step(self) -> int | None:
+        return self.manager.latest_step()
+
+    def wait_until_finished(self):
+        self.manager.wait_until_finished()
+
+    def close(self):
+        self.manager.close()

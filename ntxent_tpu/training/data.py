@@ -1,0 +1,130 @@
+"""Input pipelines: array-backed datasets with on-device augmentation.
+
+The reference has no data code (SURVEY.md §0.2). Design: the host only
+shuffles indices and slices raw uint8 arrays; the SimCLR two-view
+augmentation runs on device inside jit (training/augment.py), keeping the
+host off the critical path (the input-bound-MFU risk, SURVEY.md §7.4).
+
+Sources: in-memory arrays (.npz / numpy / anything array-like, e.g. CIFAR-10
+batches loaded by the user) and a synthetic generator for benchmarks and
+tests (no dataset downloads are assumed available)."""
+
+from __future__ import annotations
+
+import threading
+import queue as queue_mod
+from collections.abc import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .augment import augment_batch_pair
+
+__all__ = ["ArrayDataset", "synthetic_images", "two_view_iterator",
+           "PrefetchIterator"]
+
+
+def synthetic_images(num: int, size: int = 32, channels: int = 3,
+                     seed: int = 0) -> np.ndarray:
+    """Deterministic fake image corpus in [0,1], uint8-backed like real data."""
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, (num, size, size, channels),
+                        dtype=np.uint8)
+
+
+class ArrayDataset:
+    """Shuffling batch sampler over a (N, H, W, C) uint8/float array."""
+
+    def __init__(self, images: np.ndarray, batch_size: int, seed: int = 0,
+                 drop_remainder: bool = True):
+        if len(images) < batch_size:
+            raise ValueError(f"dataset of {len(images)} < batch {batch_size}")
+        self.images = images
+        self.batch_size = batch_size
+        self.rng = np.random.default_rng(seed)
+        self.drop_remainder = drop_remainder
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:  # epoch loop
+            order = self.rng.permutation(len(self.images))
+            end = (len(order) // self.batch_size) * self.batch_size \
+                if self.drop_remainder else len(order)
+            for start in range(0, end, self.batch_size):
+                yield self.images[order[start:start + self.batch_size]]
+
+
+def _to_float(batch: np.ndarray) -> jnp.ndarray:
+    x = jnp.asarray(batch)
+    if x.dtype == jnp.uint8:
+        x = x.astype(jnp.float32) / 255.0
+    return x
+
+
+def two_view_iterator(dataset: ArrayDataset, key: jax.Array,
+                      blur: bool = True) -> Iterator[tuple]:
+    """Yields (view1, view2) device batches with on-device augmentation."""
+    for batch in dataset:
+        key, sub = jax.random.split(key)
+        yield augment_batch_pair(sub, _to_float(batch), blur=blur)
+
+
+class PrefetchIterator:
+    """Host-thread prefetch: keeps ``depth`` batches in flight so device
+    steps never wait on host slicing (the role a native async loader plays
+    in CUDA frameworks; JAX dispatch is already async once arrays are up)."""
+
+    def __init__(self, iterator: Iterator, depth: int = 2):
+        self.iterator = iterator
+        self.queue: queue_mod.Queue = queue_mod.Queue(maxsize=depth)
+        self.done = object()
+        self.error: BaseException | None = None
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._fill, daemon=True)
+        self.thread.start()
+
+    def _fill(self):
+        try:
+            for item in self.iterator:
+                while not self._stop.is_set():
+                    try:
+                        self.queue.put(item, timeout=0.25)
+                        break
+                    except queue_mod.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # surfaced on the consumer thread
+            self.error = e
+        finally:
+            try:
+                self.queue.put_nowait(self.done)
+            except queue_mod.Full:
+                pass  # consumer stopped; nothing is waiting for the sentinel
+
+    def close(self):
+        """Stop the producer thread and release buffered batches."""
+        self._stop.set()
+        while True:  # drain so the producer can observe the stop flag
+            try:
+                self.queue.get_nowait()
+            except queue_mod.Empty:
+                break
+        self.thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self.queue.get()
+        if item is self.done:
+            if self.error is not None:
+                raise RuntimeError("prefetch producer failed") from self.error
+            raise StopIteration
+        return item

@@ -1,0 +1,79 @@
+"""LARS optimizer + SimCLR learning-rate schedule.
+
+SimCLR's large-batch recipe: LARS with weight decay and trust-ratio scaling,
+excluding batch-norm parameters and biases from both, under a linear-warmup
+cosine-decay schedule scaled by batch size. Built on optax (the reference
+has no optimizer code — SURVEY.md §0.2)."""
+
+from __future__ import annotations
+
+import re
+
+import flax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["create_lars", "cosine_warmup_schedule", "simclr_learning_rate"]
+
+
+def _is_excluded(path: tuple[str, ...]) -> bool:
+    """BN params and biases are excluded from weight decay and trust ratio.
+
+    Matched on whole path segments (a module named "subnet" must not trip a
+    substring "bn" test): any segment that is/starts/ends with a batch-norm
+    marker, or a leaf named bias / BN's scale companions.
+    """
+    names = [str(p).lower() for p in path]
+
+    def is_bn_segment(s: str) -> bool:
+        # bn, bn1, bn_2, batchnorm_0, batch_norm, stem_bn, proj_bn ...
+        return bool(re.fullmatch(r"(bn|batch_?norm)[_\d]*", s)) \
+            or s.endswith("_bn") or "batchnorm" in s
+
+    return any(is_bn_segment(s) for s in names) or names[-1] == "bias"
+
+
+def exclusion_mask(params):
+    """True where weight decay / trust ratio APPLY (i.e. not excluded)."""
+    flat = flax.traverse_util.flatten_dict(params)
+    mask = {k: not _is_excluded(k) for k in flat}
+    return flax.traverse_util.unflatten_dict(mask)
+
+
+def cosine_warmup_schedule(
+    base_lr: float, warmup_steps: int, total_steps: int
+) -> optax.Schedule:
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=base_lr,
+        warmup_steps=max(warmup_steps, 1),
+        decay_steps=max(total_steps, warmup_steps + 1),
+    )
+
+
+def simclr_learning_rate(batch_size: int, base: float = 0.3) -> float:
+    """SimCLR linear scaling: lr = base * batch/256 (sqrt scaling for LARS
+    uses base=0.075 * sqrt(batch); linear is the paper's LARS default)."""
+    return base * batch_size / 256.0
+
+
+def create_lars(
+    learning_rate: float | optax.Schedule,
+    weight_decay: float = 1e-6,
+    momentum: float = 0.9,
+    trust_coefficient: float = 0.001,
+    params=None,
+) -> optax.GradientTransformation:
+    """LARS with SimCLR's exclusion rules.
+
+    If ``params`` is given, a mask excluding BN/bias leaves is computed from
+    it; otherwise a callable mask derives it per-update (optax accepts both).
+    """
+    mask = exclusion_mask(params) if params is not None else exclusion_mask
+    return optax.lars(
+        learning_rate=learning_rate,
+        weight_decay=weight_decay,
+        weight_decay_mask=mask,
+        trust_coefficient=trust_coefficient,
+        trust_ratio_mask=mask,
+        momentum=momentum,
+    )

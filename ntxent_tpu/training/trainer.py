@@ -1,0 +1,197 @@
+"""SimCLR trainer: train state, fused-loss train step, sharded train step.
+
+The training loop the reference promised by name but never contained
+(SURVEY.md §0.2). Single-chip path jits model fwd + fused Pallas NT-Xent +
+LARS update; the distributed path wraps the same step in ``shard_map`` over
+the mesh's data axis: batch sharded, params replicated, embeddings
+all-gathered into the fused partial loss (parallel/dist_loss.py), gradients
+``psum``-reduced — the all-reduce role the reference assigned to NCCL.
+
+Metrics include steps/sec and MFU accounting (BASELINE.json north star:
+>=50% MFU on ResNet-50 at global batch 4096)."""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from collections.abc import Callable
+from typing import Any
+
+import flax
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.ntxent_pallas import ntxent_loss_fused
+from ..parallel.dist_loss import local_ntxent_allgather
+from .lars import cosine_warmup_schedule, create_lars, simclr_learning_rate
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["TrainState", "create_train_state", "make_train_step",
+           "make_sharded_train_step", "train_loop", "TrainerConfig"]
+
+
+class TrainState(train_state.TrainState):
+    batch_stats: Any = None
+
+
+@flax.struct.dataclass
+class TrainerConfig:
+    batch_size: int = 256
+    temperature: float = 0.1
+    base_lr: float = 0.3
+    weight_decay: float = 1e-6
+    warmup_steps: int = 100
+    total_steps: int = 1000
+
+    @property
+    def learning_rate(self) -> float:
+        return simclr_learning_rate(self.batch_size, self.base_lr)
+
+
+def create_train_state(
+    model,
+    rng: jax.Array,
+    input_shape: tuple[int, ...],
+    config: TrainerConfig,
+    tx: optax.GradientTransformation | None = None,
+) -> TrainState:
+    variables = model.init(rng, jnp.zeros(input_shape, jnp.float32),
+                           train=False)
+    params = variables["params"]
+    if tx is None:
+        schedule = cosine_warmup_schedule(
+            config.learning_rate, config.warmup_steps, config.total_steps)
+        tx = create_lars(schedule, config.weight_decay, params=params)
+    return TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx,
+        batch_stats=variables.get("batch_stats", flax.core.freeze({})),
+    )
+
+
+def _apply_two_views(state: TrainState, params, v1, v2, train: bool = True):
+    """Run both views through the model in ONE batched forward (2B on the
+    batch axis keeps the MXU fed and BN statistics shared across views)."""
+    both = jnp.concatenate([v1, v2], axis=0)
+    variables = {"params": params, "batch_stats": state.batch_stats}
+    z, updates = state.apply_fn(
+        variables, both, train=train, mutable=["batch_stats"])
+    n = v1.shape[0]
+    return z[:n], z[n:], updates["batch_stats"]
+
+
+def make_train_step(temperature: float = 0.1) -> Callable:
+    """Single-device train step: fused Pallas loss, donated state."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, v1, v2):
+        def loss_fn(params):
+            z1, z2, new_stats = _apply_two_views(state, params, v1, v2)
+            z = jnp.concatenate([z1, z2], axis=0)
+            return ntxent_loss_fused(z, temperature), new_stats
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        state = state.apply_gradients(grads=grads)
+        state = state.replace(batch_stats=new_stats)
+        return state, {"loss": loss}
+
+    return train_step
+
+
+def make_sharded_train_step(
+    mesh: Mesh,
+    temperature: float = 0.1,
+    axis: str = "data",
+    interpret: bool | None = None,
+) -> Callable:
+    """Distributed train step over the mesh's data axis.
+
+    Batch sharded along ``axis``; params/opt-state replicated. Inside the
+    per-device body: forward on the local shard (BN stats psum'd via the
+    model's ``axis_name``), ``lax.all_gather`` of embeddings into the fused
+    partial loss, ``psum`` of gradients — i.e. the complete NCCL-SimCLR
+    collective pattern compiled onto ICI by XLA.
+    """
+    num_devices = mesh.shape[axis]
+
+    def per_device_step(state: TrainState, v1, v2):
+        def loss_fn(params):
+            z1, z2, new_stats = _apply_two_views(state, params, v1, v2)
+            loss = local_ntxent_allgather(
+                z1, z2, temperature, axis, num_devices, interpret)
+            return loss, new_stats
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        grads = jax.lax.pmean(grads, axis)
+        new_stats = jax.lax.pmean(new_stats, axis)
+        state = state.apply_gradients(grads=grads)
+        state = state.replace(batch_stats=new_stats)
+        return state, {"loss": loss}
+
+    sharded = jax.shard_map(
+        per_device_step,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = "data"):
+    """Place a host batch with its leading dim sharded over the mesh."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def train_loop(
+    state: TrainState,
+    data_iter,
+    train_step: Callable,
+    num_steps: int,
+    log_every: int = 50,
+    flops_per_step: float | None = None,
+    hook: Callable | None = None,
+):
+    """Simple host loop: step, log loss / steps-per-sec / MFU."""
+    history = []
+    t0 = time.perf_counter()
+    last_t, last_step = t0, 0
+    for step in range(1, num_steps + 1):
+        v1, v2 = next(data_iter)
+        state, metrics = train_step(state, v1, v2)
+        if step % log_every == 0 or step == num_steps:
+            loss = float(metrics["loss"])
+            now = time.perf_counter()
+            sps = (step - last_step) / max(now - last_t, 1e-9)
+            last_t, last_step = now, step
+            entry = {"step": step, "loss": loss, "steps_per_sec": sps}
+            if flops_per_step:
+                entry["mfu"] = estimate_mfu(flops_per_step, sps)
+            history.append(entry)
+            logger.info("step %d: loss=%.4f, %.2f steps/s", step, loss, sps)
+            if hook is not None:
+                hook(state, entry)
+    return state, history
+
+
+def peak_flops_per_chip() -> float:
+    """Peak bf16 FLOP/s of the local accelerator (for MFU accounting)."""
+    kind = jax.local_devices()[0].device_kind.lower()
+    # Public peak numbers: v4 275T, v5e 197T, v5p 459T, v6e 918T bf16.
+    table = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12,
+             "v5p": 459e12, "v6e": 918e12, "v6 lite": 918e12}
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 100e12  # unknown accelerator: conservative placeholder
+
+
+def estimate_mfu(flops_per_step: float, steps_per_sec: float) -> float:
+    return flops_per_step * steps_per_sec / peak_flops_per_chip()

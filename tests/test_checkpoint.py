@@ -1,0 +1,38 @@
+"""Checkpoint/resume roundtrip (SURVEY.md §5.4: absent in the reference)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ntxent_tpu.models import ResNet, SimCLRModel
+from ntxent_tpu.training import (
+    CheckpointManager,
+    TrainerConfig,
+    create_train_state,
+)
+
+TinyEnc = functools.partial(ResNet, stage_sizes=(1,), small_images=True,
+                            dtype=jnp.float32)
+
+
+def test_checkpoint_roundtrip(tmp_path, rng):
+    model = SimCLRModel(encoder=TinyEnc, proj_hidden_dim=16, proj_dim=8)
+    cfg = TrainerConfig(batch_size=4, total_steps=10, warmup_steps=1)
+    state = create_train_state(model, rng, (1, 32, 32, 3), cfg)
+
+    mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=2)
+    assert mgr.latest_step() is None
+    assert mgr.save(0, state)
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 0
+
+    # Restore into a freshly-initialized template with different values.
+    other = create_train_state(model, jax.random.PRNGKey(99),
+                               (1, 32, 32, 3), cfg)
+    restored = mgr.restore(other)
+    for a, b in zip(jax.tree.leaves(restored.params),
+                    jax.tree.leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    mgr.close()

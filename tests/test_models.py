@@ -1,0 +1,103 @@
+"""Model families: shapes, dtypes, and SimCLR embedding contracts."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ntxent_tpu.models import (
+    CLIPModel,
+    ProjectionHead,
+    ResNet,
+    SimCLRModel,
+    TextTransformer,
+    ViT_Ti16,
+)
+
+TinyResNet = functools.partial(ResNet, stage_sizes=(1, 1), small_images=True,
+                               dtype=jnp.float32)
+TinyText = functools.partial(TextTransformer, vocab_size=64, max_len=16,
+                             hidden_dim=32, depth=1, num_heads=2,
+                             dtype=jnp.float32)
+TinyViT = functools.partial(ViT_Ti16, dtype=jnp.float32)
+
+
+def test_resnet_feature_shape(rng):
+    model = TinyResNet()
+    vars_ = model.init(rng, jnp.zeros((2, 32, 32, 3)), train=False)
+    h = model.apply(vars_, jnp.ones((4, 32, 32, 3)), train=False)
+    assert h.shape == (4, 64 * 2 * 4)  # width*2^(stages-1)*expansion
+    assert h.dtype == jnp.float32
+
+
+def test_resnet_params_are_fp32(rng):
+    model = ResNet(stage_sizes=(1,), small_images=True)  # bf16 activations
+    vars_ = model.init(rng, jnp.zeros((1, 32, 32, 3)), train=False)
+    for leaf in jax.tree.leaves(vars_["params"]):
+        assert leaf.dtype == jnp.float32
+
+
+def test_vit_cls_features(rng):
+    model = TinyViT()
+    vars_ = model.init(rng, jnp.zeros((2, 32, 32, 3)), train=False)
+    h = model.apply(vars_, jnp.ones((2, 32, 32, 3)), train=False)
+    assert h.shape == (2, 192)
+    assert bool(jnp.all(jnp.isfinite(h)))
+
+
+def test_simclr_model_outputs_normalized(rng):
+    model = SimCLRModel(encoder=TinyResNet, proj_hidden_dim=32, proj_dim=16)
+    vars_ = model.init(rng, jnp.zeros((2, 32, 32, 3)), train=False)
+    z, _ = model.apply(vars_, jax.random.uniform(rng, (8, 32, 32, 3)),
+                       train=True, mutable=["batch_stats"])
+    assert z.shape == (8, 16)
+    np.testing.assert_allclose(np.asarray(jnp.linalg.norm(z, axis=1)), 1.0,
+                               rtol=1e-5)
+
+
+def test_projection_head_shapes(rng):
+    head = ProjectionHead(hidden_dim=32, out_dim=8, dtype=jnp.float32)
+    vars_ = head.init(rng, jnp.zeros((2, 64)), train=False)
+    out = head.apply(vars_, jnp.ones((4, 64)), train=False)
+    assert out.shape == (4, 8)
+
+
+def test_clip_dual_encoder(rng):
+    model = CLIPModel(image_encoder=TinyViT, text_encoder=TinyText,
+                      embed_dim=16)
+    imgs = jnp.ones((2, 32, 32, 3))
+    toks = jnp.array([[1, 2, 3, 0, 0, 0, 0, 0]] * 2, jnp.int32)
+    vars_ = model.init(rng, imgs, toks, train=False)
+    zi, zt, scale = model.apply(vars_, imgs, toks, train=False)
+    assert zi.shape == (2, 16) and zt.shape == (2, 16)
+    np.testing.assert_allclose(np.asarray(jnp.linalg.norm(zi, axis=1)), 1.0,
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(scale), 1.0 / 0.07, rtol=1e-5)
+
+
+def test_clip_text_eot_pooling_ignores_padding(rng):
+    """Causal attention + EOT pooling: trailing pad length must not change
+    the pooled embedding (position 2 only attends to positions <= 2)."""
+    model = TinyText()
+    short = jnp.array([[5, 7, 9, 0, 0]], jnp.int32)
+    long = jnp.array([[5, 7, 9, 0, 0, 0, 0, 0]], jnp.int32)
+    vars_ = model.init(rng, jnp.zeros((1, 8), jnp.int32), train=False)
+    e_short = model.apply(vars_, short, train=False)
+    e_long = model.apply(vars_, long, train=False)
+    np.testing.assert_allclose(np.asarray(e_short), np.asarray(e_long),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("train", [True, False])
+def test_resnet_train_eval_modes(rng, train):
+    model = TinyResNet()
+    vars_ = model.init(rng, jnp.zeros((2, 32, 32, 3)), train=False)
+    x = jax.random.uniform(rng, (4, 32, 32, 3))
+    if train:
+        h, updates = model.apply(vars_, x, train=True, mutable=["batch_stats"])
+        assert "batch_stats" in updates
+    else:
+        h = model.apply(vars_, x, train=False)
+    assert bool(jnp.all(jnp.isfinite(h)))

@@ -1,0 +1,62 @@
+"""Cross-language golden check: native C++ core vs the jnp oracle.
+
+The reference never compared implementations against each other (SURVEY.md
+§4); here the C++ host implementation and the JAX/Pallas stack must agree on
+the same inputs — one correctness contract across languages."""
+
+import numpy as np
+import pytest
+
+from ntxent_tpu.ops import oracle
+
+native = pytest.importorskip("ntxent_tpu.native")
+
+if not native.native_available():
+    pytest.skip("no cmake/compiler available", allow_module_level=True)
+
+try:
+    native.load_library()
+except Exception as e:  # build failure environment-gates the module
+    pytest.skip(f"native build failed: {e}", allow_module_level=True)
+
+from conftest import make_embeddings  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+@pytest.mark.parametrize("two_n,dim", [(16, 8), (64, 32), (128, 64)])
+def test_native_forward_matches_oracle(rng, two_n, dim):
+    z = np.asarray(make_embeddings(rng, two_n, dim))
+    got = native.forward_cpu(z, 0.07)
+    want = float(oracle.ntxent_loss(jnp.asarray(z), 0.07))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_native_lse_matches_oracle(rng):
+    z = np.asarray(make_embeddings(rng, 32, 16))
+    _, lse = native.forward_cpu(z, 0.07, return_lse=True)
+    logits, _ = oracle._masked_logits(jnp.asarray(z), 0.07)
+    want = np.asarray(jax.nn.logsumexp(logits, axis=-1))
+    np.testing.assert_allclose(lse, want, rtol=1e-5)
+
+
+def test_native_backward_matches_oracle(rng):
+    z = np.asarray(make_embeddings(rng, 32, 16))
+    got = native.backward_cpu(z, 0.07)
+    want = np.asarray(oracle.ntxent_grad_oracle(jnp.asarray(z), 0.07))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_native_grad_output_scaling(rng):
+    z = np.asarray(make_embeddings(rng, 16, 8))
+    g1 = native.backward_cpu(z, 0.07, grad_output=1.0)
+    g2 = native.backward_cpu(z, 0.07, grad_output=2.0)
+    np.testing.assert_allclose(g2, 2.0 * g1, rtol=1e-5)
+
+
+def test_native_rejects_bad_inputs(rng):
+    z = np.asarray(make_embeddings(rng, 16, 8))
+    with pytest.raises(ValueError):
+        native.forward_cpu(z[:15], 0.07)  # odd rows
+    with pytest.raises(ValueError):
+        native.forward_cpu(z, -1.0)  # bad temperature

@@ -1,0 +1,190 @@
+"""Trainer: single-device and sharded train steps, LARS, augment, data."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ntxent_tpu.models import ResNet, SimCLRModel
+from ntxent_tpu.parallel import create_mesh
+from ntxent_tpu.training import (
+    ArrayDataset,
+    TrainerConfig,
+    augment_batch_pair,
+    cosine_warmup_schedule,
+    create_train_state,
+    make_sharded_train_step,
+    make_train_step,
+    shard_batch,
+    simclr_learning_rate,
+    synthetic_images,
+    train_loop,
+    two_view_iterator,
+)
+from ntxent_tpu.training.lars import exclusion_mask
+
+TinyEnc = functools.partial(ResNet, stage_sizes=(1, 1), small_images=True,
+                            dtype=jnp.float32)
+TinyEncSync = functools.partial(ResNet, stage_sizes=(1, 1), small_images=True,
+                                dtype=jnp.float32, axis_name="data")
+
+
+def tiny_model(axis_name=None):
+    from ntxent_tpu.models.projection import ProjectionHead
+
+    import flax.linen as nn
+
+    class M(nn.Module):
+        axis: str | None = None
+
+        def setup(self):
+            enc = TinyEncSync if self.axis else TinyEnc
+            self.backbone = enc()
+            self.projector = ProjectionHead(hidden_dim=32, out_dim=16,
+                                            dtype=jnp.float32,
+                                            axis_name=self.axis)
+
+        def __call__(self, x, train=True):
+            from ntxent_tpu.ops.oracle import cosine_normalize
+
+            return cosine_normalize(
+                self.projector(self.backbone(x, train=train), train=train))
+
+    return M(axis=axis_name)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return create_mesh(axis_names=("data",))
+
+
+def test_train_step_reduces_loss(rng):
+    model = tiny_model()
+    cfg = TrainerConfig(batch_size=16, total_steps=40, warmup_steps=1,
+                        base_lr=1.0)
+    state = create_train_state(model, rng, (2, 32, 32, 3), cfg)
+    step = make_train_step(temperature=0.2)
+    ds = ArrayDataset(synthetic_images(32, 32), batch_size=16)
+    it = two_view_iterator(ds, jax.random.PRNGKey(1), blur=False)
+    losses = []
+    for i in range(12):
+        v1, v2 = next(it)
+        state, metrics = step(state, v1, v2)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert min(losses[6:]) < losses[0]  # optimization makes progress
+
+
+def test_sharded_step_matches_single_device(rng, mesh):
+    """One distributed step == one single-device step (global BN + gathered
+    loss + psum'd grads reproduce full-batch math exactly in fp32)."""
+    cfg = TrainerConfig(batch_size=16, total_steps=10, warmup_steps=1,
+                        base_lr=0.5)
+    state_sh = create_train_state(tiny_model("data"), rng, (2, 32, 32, 3), cfg)
+    state_1d = create_train_state(tiny_model(), rng, (2, 32, 32, 3), cfg)
+
+    kv = jax.random.PRNGKey(5)
+    v1 = jax.random.uniform(kv, (16, 32, 32, 3))
+    v2 = jax.random.uniform(jax.random.fold_in(kv, 1), (16, 32, 32, 3))
+
+    step_sh = make_sharded_train_step(mesh, temperature=0.2)
+    step_1d = make_train_step(temperature=0.2)
+    new_sh, m_sh = step_sh(state_sh, *shard_batch((v1, v2), mesh))
+    new_1d, m_1d = step_1d(state_1d, v1, v2)
+
+    np.testing.assert_allclose(float(m_sh["loss"]), float(m_1d["loss"]),
+                               rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(new_sh.params),
+                    jax.tree.leaves(new_1d.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3,
+                                   atol=1e-5)
+
+
+def test_sharded_step_multiple_steps(rng, mesh):
+    cfg = TrainerConfig(batch_size=16, total_steps=10, warmup_steps=1)
+    state = create_train_state(tiny_model("data"), rng, (2, 32, 32, 3), cfg)
+    step = make_sharded_train_step(mesh, temperature=0.2)
+    ds = ArrayDataset(synthetic_images(32, 32), batch_size=16)
+    it = two_view_iterator(ds, jax.random.PRNGKey(1), blur=False)
+    for _ in range(3):
+        v1, v2 = next(it)
+        state, metrics = step(state, *shard_batch((v1, v2), mesh))
+        assert bool(jnp.isfinite(metrics["loss"]))
+
+
+def test_train_loop_history(rng):
+    model = tiny_model()
+    cfg = TrainerConfig(batch_size=8, total_steps=10, warmup_steps=1)
+    state = create_train_state(model, rng, (2, 32, 32, 3), cfg)
+    step = make_train_step(temperature=0.2)
+    ds = ArrayDataset(synthetic_images(16, 32), batch_size=8)
+    it = two_view_iterator(ds, jax.random.PRNGKey(1), blur=False)
+    state, history = train_loop(state, it, step, num_steps=4, log_every=2)
+    assert len(history) == 2
+    assert {"step", "loss", "steps_per_sec"} <= history[0].keys()
+
+
+# ---------------------------------------------------------------------------
+# LARS / schedule
+# ---------------------------------------------------------------------------
+
+
+def test_lars_exclusion_mask():
+    params = {
+        "stem_conv": {"kernel": np.zeros(1)},
+        "stem_bn": {"scale": np.zeros(1), "bias": np.zeros(1)},
+        "fc1": {"kernel": np.zeros(1), "bias": np.zeros(1)},
+    }
+    mask = exclusion_mask(params)
+    assert mask["stem_conv"]["kernel"] is True
+    assert mask["stem_bn"]["scale"] is False      # BN excluded
+    assert mask["stem_bn"]["bias"] is False
+    assert mask["fc1"]["kernel"] is True
+    assert mask["fc1"]["bias"] is False           # bias excluded
+
+
+def test_simclr_lr_scaling():
+    assert simclr_learning_rate(256) == pytest.approx(0.3)
+    assert simclr_learning_rate(4096) == pytest.approx(4.8)
+
+
+def test_cosine_warmup_schedule_shape():
+    sched = cosine_warmup_schedule(1.0, warmup_steps=10, total_steps=100)
+    assert float(sched(0)) == pytest.approx(0.0)
+    assert float(sched(10)) == pytest.approx(1.0, rel=1e-3)
+    assert float(sched(100)) < 0.01
+
+
+# ---------------------------------------------------------------------------
+# Augmentations / data
+# ---------------------------------------------------------------------------
+
+
+def test_augment_two_views_differ(rng):
+    imgs = jnp.asarray(synthetic_images(4, 32), jnp.float32) / 255.0
+    v1, v2 = augment_batch_pair(rng, imgs, blur=False)
+    assert v1.shape == imgs.shape and v2.shape == imgs.shape
+    assert float(jnp.max(jnp.abs(v1 - v2))) > 1e-3  # independent views
+    assert float(jnp.min(v1)) >= 0.0 and float(jnp.max(v1)) <= 1.0
+
+
+def test_augment_deterministic(rng):
+    imgs = jnp.asarray(synthetic_images(2, 32), jnp.float32) / 255.0
+    a1, a2 = augment_batch_pair(rng, imgs, blur=True)
+    b1, b2 = augment_batch_pair(rng, imgs, blur=True)
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(b1))
+    np.testing.assert_array_equal(np.asarray(a2), np.asarray(b2))
+
+
+def test_array_dataset_batching():
+    ds = ArrayDataset(synthetic_images(20, 8), batch_size=8)
+    it = iter(ds)
+    batches = [next(it) for _ in range(4)]
+    assert all(b.shape == (8, 8, 8, 3) for b in batches)
+
+
+def test_array_dataset_rejects_small():
+    with pytest.raises(ValueError):
+        ArrayDataset(synthetic_images(4, 8), batch_size=8)
