@@ -10,6 +10,7 @@ the full mapping to the reference.
 """
 
 from ntxent_tpu.api import backward, check_tensor_core_support, forward, ntxent
+from ntxent_tpu.ops.infonce_pallas import info_nce_fused
 from ntxent_tpu.ops.ntxent_pallas import (
     ntxent_loss_and_lse,
     ntxent_loss_fused,
@@ -38,5 +39,6 @@ __all__ = [
     "ntxent_partial_fused",
     "cosine_normalize",
     "info_nce_loss",
+    "info_nce_fused",
     "__version__",
 ]

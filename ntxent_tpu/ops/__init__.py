@@ -1,5 +1,6 @@
 from ntxent_tpu.ops import oracle
 from ntxent_tpu.ops.blocks import choose_blocks
+from ntxent_tpu.ops.infonce_pallas import info_nce_fused, info_nce_partial_fused
 from ntxent_tpu.ops.ntxent_pallas import (
     ntxent_loss_and_lse,
     ntxent_loss_fused,
@@ -12,4 +13,6 @@ __all__ = [
     "ntxent_loss_fused",
     "ntxent_loss_and_lse",
     "ntxent_partial_fused",
+    "info_nce_fused",
+    "info_nce_partial_fused",
 ]

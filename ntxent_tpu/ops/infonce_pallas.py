@@ -1,0 +1,156 @@
+"""Fused cross-modal InfoNCE (CLIP-style) on the shared Pallas kernel family.
+
+The BASELINE.json configs[4] workload (CLIP text-image InfoNCE, global batch
+32768) — the scale the reference's declared-but-absent NCCL path was named
+for (SURVEY.md §2.2). Same blockwise online-softmax design as the NT-Xent
+kernels (ops/ntxent_pallas.py): the (N, N) cross-modal similarity matrix
+``s = scale * za @ zb.T`` is tiled into VMEM, never materialized in HBM, and
+only the per-row/per-column logsumexp survives as the O(N) residual.
+
+Differences from NT-Xent, expressed through the kernels' ``diag_pos`` mode:
+positives sit on the a<->b diagonal (not at offset N) and the diagonal is NOT
+masked (za_i / zb_i are different modalities, so s_ii is a real pair, not a
+self-similarity). The loss is the symmetric cross-entropy
+``0.5 * (mean_i [lse_row_i - s_ii] + mean_j [lse_col_j - s_jj])``
+(= ops.oracle.info_nce_loss).
+
+The logit scale is a **traced, differentiable** input (CLIP's learnable
+``exp(logit_scale)``): it enters the kernels as a (1, 1) SMEM scalar and
+multiplies the fp32 MXU product — same arithmetic as the oracle, and
+d(loss)/d(scale) falls out of the row-gradient identity
+``dL/dscale = sum_i (G @ zb)_i . za_i`` with no extra kernel pass.
+
+Backward runs ONE fused kernel per input: for grad_za the row-softmax term
+(via row lse) and the column-softmax term (via column lse) are combined into
+a single ``G`` tile before one MXU matmul — half the passes of composing two
+one-direction VJPs.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .blocks import choose_blocks
+from .ntxent_pallas import (
+    _bwd_sym_call,
+    _default_interpret,
+    _fwd_call,
+    _gid_column,
+    _ntxent_partial,
+    _pad_rows,
+)
+
+__all__ = ["info_nce_fused", "info_nce_partial_fused", "resolve_scale"]
+
+
+def resolve_scale(temperature: float, scale) -> jax.Array:
+    """Logit scale as a traced fp32 scalar: ``scale`` if given, else 1/T."""
+    if scale is None:
+        scale = 1.0 / float(temperature)
+    return jnp.asarray(scale, jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _infonce(za, zb, scale, br, bc, interpret):
+    return _infonce_fwd(za, zb, scale, br, bc, interpret)[0]
+
+
+def _infonce_prepare(za, zb, br, bc):
+    n = za.shape[0]
+    pad = math.lcm(br, bc)  # each side serves as both rows and columns
+    zap = _pad_rows(za, pad)
+    zbp = _pad_rows(zb, pad)
+    gid = _gid_column(jnp.arange(zap.shape[0]), pad, sentinel=n)
+    return zap, zbp, gid, n
+
+
+def _infonce_fwd(za, zb, scale, br, bc, interpret):
+    zap, zbp, gid, n = _infonce_prepare(za, zb, br, bc)
+    common = dict(br=br, bc=bc, inv_t=1.0, cols_actual=n, n_half=0,
+                  interpret=interpret, diag_pos=True, scale=scale)
+    loss_a, lse_a = _fwd_call(zap, zbp, gid, **common)   # rows of s
+    loss_b, lse_b = _fwd_call(zbp, zap, gid, **common)   # rows of s.T = cols
+    loss = (loss_a + loss_b) / (2 * n)
+    return loss, (za, zb, scale, lse_a, lse_b)
+
+
+def _infonce_bwd(br, bc, interpret, res, g):
+    za, zb, scale, lse_a, lse_b = res
+    zap, zbp, gid, n = _infonce_prepare(za, zb, br, bc)
+    common = dict(br=br, bc=bc, inv_t=1.0, cols_actual=n, n_half=0,
+                  interpret=interpret, diag_pos=True, scale=scale)
+    # o_a[i] = sum_j G_ij zb_j with G = P_row + P_col - 2I (the total dL/ds
+    # before scale/normalization); o_b[j] = sum_i G_ij za_i.
+    o_a = _bwd_sym_call(zap, gid, lse_a, z_cols=zbp, lse_cols=lse_b,
+                        **common)[:n]
+    o_b = _bwd_sym_call(zbp, gid, lse_b, z_cols=zap, lse_cols=lse_a,
+                        **common)[:n]
+    coef = g / (2 * n)
+    grad_za = (o_a * (coef * scale)).astype(za.dtype)
+    grad_zb = (o_b * (coef * scale)).astype(zb.dtype)
+    # dL/dscale = coef * sum_ij G_ij (za_i . zb_j) = coef * sum_i o_a[i].za[i]
+    grad_scale = (coef * jnp.sum(o_a * za.astype(jnp.float32))).reshape(
+        jnp.shape(scale)).astype(scale.dtype)
+    return grad_za, grad_zb, grad_scale
+
+
+_infonce.defvjp(_infonce_fwd, _infonce_bwd)
+
+
+def info_nce_fused(
+    za: jax.Array,
+    zb: jax.Array,
+    temperature: float = 0.07,
+    *,
+    scale: jax.Array | float | None = None,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused symmetric InfoNCE over paired embeddings za, zb: (N, D) each.
+
+    Drop-in fused equivalent of ``ops.oracle.info_nce_loss`` — same
+    semantics, O(N) memory, exact gradients for za, zb AND the logit scale.
+    Pass ``scale`` (= 1/T, e.g. CLIP's learnable ``exp(logit_scale)``) as a
+    traced array to train it; otherwise ``temperature`` is used.
+    """
+    if za.shape != zb.shape:
+        raise ValueError(f"paired embeddings must match: {za.shape} vs {zb.shape}")
+    scale = resolve_scale(temperature, scale)
+    br, bc = choose_blocks(za.shape[0], za.shape[0], za.shape[1], za.dtype,
+                           block_rows, block_cols)
+    if interpret is None:
+        interpret = _default_interpret()
+    return _infonce(za, zb, scale, br, bc, interpret)
+
+
+def info_nce_partial_fused(
+    z_rows: jax.Array,
+    z_cols: jax.Array,
+    row_gid: jax.Array,
+    *,
+    scale: jax.Array | float = 1.0,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """One-direction partial InfoNCE **sum** over rows of the global matrix.
+
+    Returns ``sum_i [logsumexp_j s_ij - s_i,gid(i)]`` where
+    ``s = scale * z_rows @ z_cols.T`` and the positive of local row i is
+    global column ``row_gid[i]`` — the diagonal of the global matrix.
+    Differentiable w.r.t. both operands and ``scale``; powers the distributed
+    CLIP path (all-gather columns, local rows, psum — see
+    parallel/dist_loss.py) the way ``ntxent_partial_fused`` powers SimCLR.
+    """
+    br, bc = choose_blocks(z_rows.shape[0], z_cols.shape[0], z_rows.shape[1],
+                           z_rows.dtype, block_rows, block_cols)
+    if interpret is None:
+        interpret = _default_interpret()
+    return _ntxent_partial(z_rows, z_cols, row_gid.astype(jnp.int32),
+                           jnp.asarray(scale, jnp.float32), 1.0, br, bc,
+                           interpret, True)

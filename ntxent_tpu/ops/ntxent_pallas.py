@@ -64,19 +64,32 @@ def _tile_ids(i, j, br: int, bc: int):
     return rid, cid
 
 
-def _masked_sim_tile(zr, zc, row_gid, cid, inv_t, cols_actual):
-    """Scaled similarity tile with self-pair and padded columns masked."""
+def _masked_sim_tile(zr, zc, row_gid, cid, inv_t, cols_actual,
+                     diag_pos: bool = False):
+    """Scaled similarity tile with padded columns masked.
+
+    NT-Xent mode (``diag_pos=False``) additionally masks the self-similarity
+    diagonal; InfoNCE mode (``diag_pos=True``) keeps it — the diagonal IS the
+    positive there (cross-modal za/zb, so it is not a self-pair).
+    """
     s = jax.lax.dot_general(
         zr, zc,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * inv_t
-    mask = jnp.logical_or(cid == row_gid, cid >= cols_actual)
+    mask = cid >= cols_actual
+    if not diag_pos:
+        mask = jnp.logical_or(mask, cid == row_gid)
     return jnp.where(mask, _NEG_INF, s), s
 
 
-def _pos_gid(row_gid, n_half: int):
-    """Positive-pair column for each global row id: (gid + N) mod 2N."""
+def _pos_gid(row_gid, n_half: int, diag_pos: bool = False):
+    """Positive-pair column per global row id.
+
+    NT-Xent: the paired view at (gid + N) mod 2N; InfoNCE: the diagonal.
+    """
+    if diag_pos:
+        return row_gid
     return jnp.where(row_gid < n_half, row_gid + n_half, row_gid - n_half)
 
 
@@ -85,8 +98,9 @@ def _pos_gid(row_gid, n_half: int):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(zr_ref, zc_ref, gid_ref, loss_ref, lse_ref, m_ref, l_ref, p_ref,
-                *, br, bc, inv_t, cols_actual, n_half):
+def _fwd_kernel(zr_ref, zc_ref, gid_ref, scale_ref, loss_ref, lse_ref,
+                m_ref, l_ref, p_ref,
+                *, br, bc, inv_t, cols_actual, n_half, diag_pos=False):
     i = pl.program_id(0)
     j = pl.program_id(1)
     nj = pl.num_programs(1)
@@ -104,11 +118,12 @@ def _fwd_kernel(zr_ref, zc_ref, gid_ref, loss_ref, lse_ref, m_ref, l_ref, p_ref,
     row_gid = gid_ref[:]                      # (BR, 1) global row ids
     _, cid = _tile_ids(i, j, br, bc)
     s_masked, s_raw = _masked_sim_tile(
-        zr_ref[:], zc_ref[:], row_gid, cid, inv_t, cols_actual
+        zr_ref[:], zc_ref[:], row_gid, cid, inv_t * scale_ref[0, 0],
+        cols_actual, diag_pos
     )
 
     # Positive-pair logit (unmasked: the positive is never the diagonal).
-    pos_hit = cid == _pos_gid(row_gid, n_half)
+    pos_hit = cid == _pos_gid(row_gid, n_half, diag_pos)
     p_ref[:] += jnp.sum(jnp.where(pos_hit, s_raw, 0.0), axis=1, keepdims=True)
 
     # Online softmax update.
@@ -127,14 +142,21 @@ def _fwd_kernel(zr_ref, zc_ref, gid_ref, loss_ref, lse_ref, m_ref, l_ref, p_ref,
         loss_ref[0, 0] += jnp.sum(jnp.where(valid, lse - p_ref[:], 0.0))
 
 
+def _scale_arr(scale) -> jax.Array:
+    """Traced logit scale as the (1, 1) SMEM operand the kernels expect."""
+    if scale is None:
+        return jnp.ones((1, 1), jnp.float32)
+    return jnp.asarray(scale, jnp.float32).reshape(1, 1)
+
+
 def _fwd_call(z_rows, z_cols, row_gid, *, br, bc, inv_t, cols_actual, n_half,
-              interpret):
+              interpret, diag_pos=False, scale=None):
     rp, d = z_rows.shape
     cp = z_cols.shape[0]
     grid = (rp // br, cp // bc)
     kernel = functools.partial(
         _fwd_kernel, br=br, bc=bc, inv_t=inv_t,
-        cols_actual=cols_actual, n_half=n_half,
+        cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
     )
     loss_sum, lse = pl.pallas_call(
         kernel,
@@ -143,6 +165,7 @@ def _fwd_call(z_rows, z_cols, row_gid, *, br, bc, inv_t, cols_actual, n_half,
             pl.BlockSpec((br, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bc, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
@@ -163,7 +186,7 @@ def _fwd_call(z_rows, z_cols, row_gid, *, br, bc, inv_t, cols_actual, n_half,
             transcendentals=rp * cp,
         ),
         interpret=interpret,
-    )(z_rows, z_cols, row_gid)
+    )(z_rows, z_cols, row_gid, _scale_arr(scale))
     return loss_sum[0, 0], lse
 
 
@@ -172,8 +195,9 @@ def _fwd_call(z_rows, z_cols, row_gid, *, br, bc, inv_t, cols_actual, n_half,
 # ---------------------------------------------------------------------------
 
 
-def _bwd_sym_kernel(z_row_ref, z_col_ref, gid_ref, lse_r_ref, lse_c_ref,
-                    grad_ref, *, br, bc, inv_t, cols_actual, n_half):
+def _bwd_sym_kernel(z_row_ref, z_col_ref, gid_ref, scale_ref, lse_r_ref,
+                    lse_c_ref, grad_ref, *, br, bc, inv_t, cols_actual,
+                    n_half, diag_pos=False):
     """Symmetric-case backward: both row and column gradient terms per tile.
 
     ``lse_c_ref`` is the same logsumexp vector pre-transposed to (1, Rp) so
@@ -189,11 +213,12 @@ def _bwd_sym_kernel(z_row_ref, z_col_ref, gid_ref, lse_r_ref, lse_c_ref,
     row_gid = gid_ref[:]
     _, cid = _tile_ids(i, j, br, bc)
     s_masked, _ = _masked_sim_tile(
-        z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t, cols_actual
+        z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t * scale_ref[0, 0],
+        cols_actual, diag_pos
     )
     p_row = jnp.exp(s_masked - lse_r_ref[:])          # exp(s - lse[row])
     p_col = jnp.exp(s_masked - lse_c_ref[:])          # exp(s - lse[col]), (1, BC)
-    pos = (cid == _pos_gid(row_gid, n_half)).astype(jnp.float32)
+    pos = (cid == _pos_gid(row_gid, n_half, diag_pos)).astype(jnp.float32)
     valid_row = (row_gid < cols_actual).astype(jnp.float32)
     valid_col = (cid < cols_actual).astype(jnp.float32)
     g = (p_row - pos) * valid_row + (p_col - pos) * valid_col
@@ -204,8 +229,9 @@ def _bwd_sym_kernel(z_row_ref, z_col_ref, gid_ref, lse_r_ref, lse_c_ref,
     )
 
 
-def _bwd_rows_kernel(z_row_ref, z_col_ref, gid_ref, lse_r_ref, grad_ref,
-                     *, br, bc, inv_t, cols_actual, n_half):
+def _bwd_rows_kernel(z_row_ref, z_col_ref, gid_ref, scale_ref, lse_r_ref,
+                     grad_ref,
+                     *, br, bc, inv_t, cols_actual, n_half, diag_pos=False):
     """General case: d(loss_sum)/d(z_rows) = (P - E) @ z_cols."""
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -217,10 +243,11 @@ def _bwd_rows_kernel(z_row_ref, z_col_ref, gid_ref, lse_r_ref, grad_ref,
     row_gid = gid_ref[:]
     _, cid = _tile_ids(i, j, br, bc)
     s_masked, _ = _masked_sim_tile(
-        z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t, cols_actual
+        z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t * scale_ref[0, 0],
+        cols_actual, diag_pos
     )
     p = jnp.exp(s_masked - lse_r_ref[:])
-    pos = (cid == _pos_gid(row_gid, n_half)).astype(jnp.float32)
+    pos = (cid == _pos_gid(row_gid, n_half, diag_pos)).astype(jnp.float32)
     valid_row = (row_gid < cols_actual).astype(jnp.float32)
     g = (p - pos) * valid_row
     grad_ref[:] += jax.lax.dot_general(
@@ -230,8 +257,9 @@ def _bwd_rows_kernel(z_row_ref, z_col_ref, gid_ref, lse_r_ref, grad_ref,
     )
 
 
-def _bwd_cols_kernel(z_row_ref, z_col_ref, gid_ref, lse_r_ref, grad_ref,
-                     *, br, bc, inv_t, cols_actual, n_half):
+def _bwd_cols_kernel(z_row_ref, z_col_ref, gid_ref, scale_ref, lse_r_ref,
+                     grad_ref,
+                     *, br, bc, inv_t, cols_actual, n_half, diag_pos=False):
     """General case: d(loss_sum)/d(z_cols) = (P - E)^T @ z_rows.
 
     Grid is (col_block, row_block) with rows innermost so each output column
@@ -247,10 +275,11 @@ def _bwd_cols_kernel(z_row_ref, z_col_ref, gid_ref, lse_r_ref, grad_ref,
     row_gid = gid_ref[:]
     _, cid = _tile_ids(i, j, br, bc)
     s_masked, _ = _masked_sim_tile(
-        z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t, cols_actual
+        z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t * scale_ref[0, 0],
+        cols_actual, diag_pos
     )
     p = jnp.exp(s_masked - lse_r_ref[:])
-    pos = (cid == _pos_gid(row_gid, n_half)).astype(jnp.float32)
+    pos = (cid == _pos_gid(row_gid, n_half, diag_pos)).astype(jnp.float32)
     valid_row = (row_gid < cols_actual).astype(jnp.float32)
     g = (p - pos) * valid_row                         # (BR, BC)
     grad_ref[:] += jax.lax.dot_general(
@@ -261,14 +290,18 @@ def _bwd_cols_kernel(z_row_ref, z_col_ref, gid_ref, lse_r_ref, grad_ref,
 
 
 def _bwd_sym_call(z, row_gid, lse, *, br, bc, inv_t, cols_actual, n_half,
-                  interpret):
+                  interpret, diag_pos=False, z_cols=None, lse_cols=None,
+                  scale=None):
     rp, d = z.shape
-    grid = (rp // br, rp // bc)
     kernel = functools.partial(
         _bwd_sym_kernel, br=br, bc=bc, inv_t=inv_t,
-        cols_actual=cols_actual, n_half=n_half,
+        cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
     )
-    lse_t = lse.reshape(1, rp)  # column-side broadcast layout
+    zc = z if z_cols is None else z_cols
+    cp = zc.shape[0]
+    grid = (rp // br, cp // bc)
+    # column-side broadcast layout; defaults to the row-side lse (symmetric)
+    lse_t = (lse if lse_cols is None else lse_cols).reshape(1, cp)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -276,6 +309,7 @@ def _bwd_sym_call(z, row_gid, lse, *, br, bc, inv_t, cols_actual, n_half,
             pl.BlockSpec((br, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bc, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bc), lambda i, j: (0, j), memory_space=pltpu.VMEM),
         ],
@@ -283,21 +317,22 @@ def _bwd_sym_call(z, row_gid, lse, *, br, bc, inv_t, cols_actual, n_half,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((rp, d), jnp.float32),
         cost_estimate=pl.CostEstimate(
-            flops=4 * rp * rp * d,
-            bytes_accessed=2 * rp * d * 4,
-            transcendentals=2 * rp * rp,
+            flops=4 * rp * cp * d,
+            bytes_accessed=(rp + cp) * d * 4,
+            transcendentals=2 * rp * cp,
         ),
         interpret=interpret,
-    )(z, z, row_gid, lse, lse_t)
+    )(z, zc, row_gid, _scale_arr(scale), lse, lse_t)
 
 
 def _bwd_general_call(z_rows, z_cols, row_gid, lse, *, br, bc, inv_t,
-                      cols_actual, n_half, interpret):
+                      cols_actual, n_half, interpret, diag_pos=False,
+                      scale=None):
     rp, d = z_rows.shape
     cp = z_cols.shape[0]
     row_kernel = functools.partial(
         _bwd_rows_kernel, br=br, bc=bc, inv_t=inv_t,
-        cols_actual=cols_actual, n_half=n_half,
+        cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
     )
     grad_rows = pl.pallas_call(
         row_kernel,
@@ -306,17 +341,18 @@ def _bwd_general_call(z_rows, z_cols, row_gid, lse, *, br, bc, inv_t,
             pl.BlockSpec((br, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bc, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((br, d), lambda i, j: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((rp, d), jnp.float32),
         interpret=interpret,
-    )(z_rows, z_cols, row_gid, lse)
+    )(z_rows, z_cols, row_gid, _scale_arr(scale), lse)
 
     col_kernel = functools.partial(
         _bwd_cols_kernel, br=br, bc=bc, inv_t=inv_t,
-        cols_actual=cols_actual, n_half=n_half,
+        cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
     )
     grad_cols = pl.pallas_call(
         col_kernel,
@@ -325,13 +361,14 @@ def _bwd_general_call(z_rows, z_cols, row_gid, lse, *, br, bc, inv_t,
             pl.BlockSpec((br, d), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bc, d), lambda j, i: (j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((br, 1), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda j, i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((br, 1), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((bc, d), lambda j, i: (j, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((cp, d), jnp.float32),
         interpret=interpret,
-    )(z_rows, z_cols, row_gid, lse)
+    )(z_rows, z_cols, row_gid, _scale_arr(scale), lse)
     return grad_rows, grad_cols
 
 
@@ -427,10 +464,15 @@ def ntxent_loss_fused(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ntxent_partial(z_rows, z_cols, row_gid, temperature, br, bc, interpret):
-    return _ntxent_partial_fwd(z_rows, z_cols, row_gid, temperature, br, bc,
-                               interpret)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ntxent_partial(z_rows, z_cols, row_gid, lscale, temperature, br, bc,
+                    interpret, diag_pos=False):
+    """Partial loss sum with a traced logit scale (effective 1/T = lscale/T).
+
+    ``lscale`` is differentiable (CLIP's learnable ``exp(logit_scale)``);
+    the NT-Xent path passes a constant 1."""
+    return _ntxent_partial_fwd(z_rows, z_cols, row_gid, lscale, temperature,
+                               br, bc, interpret, diag_pos)[0]
 
 
 def _ntxent_partial_prepare(z_rows, z_cols, row_gid, br, bc):
@@ -441,28 +483,36 @@ def _ntxent_partial_prepare(z_rows, z_cols, row_gid, br, bc):
     return zr, zc, gid, two_n
 
 
-def _ntxent_partial_fwd(z_rows, z_cols, row_gid, temperature, br, bc, interpret):
+def _ntxent_partial_fwd(z_rows, z_cols, row_gid, lscale, temperature, br, bc,
+                        interpret, diag_pos=False):
     zr, zc, gid, two_n = _ntxent_partial_prepare(z_rows, z_cols, row_gid, br, bc)
     loss_sum, lse = _fwd_call(
         zr, zc, gid,
         br=br, bc=bc, inv_t=1.0 / temperature,
         cols_actual=two_n, n_half=two_n // 2, interpret=interpret,
+        diag_pos=diag_pos, scale=lscale,
     )
-    return loss_sum, (z_rows, z_cols, row_gid, lse)
+    return loss_sum, (z_rows, z_cols, row_gid, lscale, lse)
 
 
-def _ntxent_partial_bwd(temperature, br, bc, interpret, res, g):
-    z_rows, z_cols, row_gid, lse = res
+def _ntxent_partial_bwd(temperature, br, bc, interpret, diag_pos, res, g):
+    z_rows, z_cols, row_gid, lscale, lse = res
     zr, zc, gid, two_n = _ntxent_partial_prepare(z_rows, z_cols, row_gid, br, bc)
-    grad_rows, grad_cols = _bwd_general_call(
+    gr, gc = _bwd_general_call(
         zr, zc, gid, lse,
         br=br, bc=bc, inv_t=1.0 / temperature,
         cols_actual=two_n, n_half=two_n // 2, interpret=interpret,
+        diag_pos=diag_pos, scale=lscale,
     )
-    scale = g / temperature
-    grad_rows = (grad_rows[: z_rows.shape[0]] * scale).astype(z_rows.dtype)
-    grad_cols = (grad_cols[: z_cols.shape[0]] * scale).astype(z_cols.dtype)
-    return grad_rows, grad_cols, None
+    gr = gr[: z_rows.shape[0]]
+    coef = g / temperature
+    grad_rows = (gr * (coef * lscale)).astype(z_rows.dtype)
+    grad_cols = (gc[: z_cols.shape[0]] * (coef * lscale)).astype(z_cols.dtype)
+    # d loss_sum/d lscale = (1/T) sum_ij G_ij (zr_i . zc_j)
+    #                     = (1/T) sum_i (G @ zc)_i . zr_i  — gr IS G @ zc.
+    grad_lscale = (coef * jnp.sum(gr * z_rows.astype(jnp.float32))).reshape(
+        jnp.shape(lscale)).astype(lscale.dtype)
+    return grad_rows, grad_cols, None, grad_lscale
 
 
 _ntxent_partial.defvjp(_ntxent_partial_fwd, _ntxent_partial_bwd)
@@ -497,7 +547,8 @@ def ntxent_partial_fused(
     if interpret is None:
         interpret = _default_interpret()
     return _ntxent_partial(z_rows, z_cols, row_gid.astype(jnp.int32),
-                           float(temperature), br, bc, interpret)
+                           jnp.float32(1.0), float(temperature), br, bc,
+                           interpret)
 
 
 def ntxent_loss_and_lse(

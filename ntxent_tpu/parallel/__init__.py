@@ -1,4 +1,9 @@
-from ntxent_tpu.parallel.dist_loss import make_sharded_ntxent, ntxent_loss_distributed
+from ntxent_tpu.parallel.dist_loss import (
+    info_nce_loss_distributed,
+    make_sharded_infonce,
+    make_sharded_ntxent,
+    ntxent_loss_distributed,
+)
 from ntxent_tpu.parallel.mesh import (
     create_mesh,
     data_sharding,
@@ -7,7 +12,12 @@ from ntxent_tpu.parallel.mesh import (
     process_info,
     replicated_sharding,
 )
-from ntxent_tpu.parallel.ring import make_ring_ntxent, ntxent_loss_ring
+from ntxent_tpu.parallel.ring import (
+    info_nce_loss_ring,
+    make_ring_infonce,
+    make_ring_ntxent,
+    ntxent_loss_ring,
+)
 
 __all__ = [
     "create_mesh",
@@ -20,4 +30,8 @@ __all__ = [
     "ntxent_loss_distributed",
     "make_ring_ntxent",
     "ntxent_loss_ring",
+    "info_nce_loss_distributed",
+    "make_sharded_infonce",
+    "info_nce_loss_ring",
+    "make_ring_infonce",
 ]

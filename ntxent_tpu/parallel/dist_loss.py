@@ -25,11 +25,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops.infonce_pallas import info_nce_partial_fused
 from ..ops.ntxent_pallas import ntxent_partial_fused
 from .mesh import local_row_gids
 
 __all__ = ["ntxent_loss_distributed", "make_sharded_ntxent",
-           "local_ntxent_allgather"]
+           "local_ntxent_allgather", "info_nce_loss_distributed",
+           "make_sharded_infonce", "local_infonce_allgather"]
 
 
 def local_ntxent_allgather(z1_local, z2_local, temperature, axis, num_devices,
@@ -93,3 +95,69 @@ def ntxent_loss_distributed(
 ) -> jax.Array:
     """Global-batch canonical NT-Xent over a device mesh (one-shot form)."""
     return make_sharded_ntxent(mesh, temperature, axis, interpret)(z1, z2)
+
+
+def local_infonce_allgather(za_local, zb_local, scale, axis,
+                            interpret=None):
+    """Per-device global-batch InfoNCE body (call inside shard_map).
+
+    The CLIP analog of ``local_ntxent_allgather``: all-gather both modality
+    shards, then compute this device's local-rows x global-cols block of
+    each direction of the cross-modal matrix with the fused partial kernel.
+    Row direction: local za rows vs gathered zb; column direction: local zb
+    rows vs gathered za (the transpose's rows). ``scale`` (CLIP's learnable
+    ``exp(logit_scale)``) is traced and differentiable; its gradient — and
+    the reduce-scatter gradient of both all-gathers — falls out of AD.
+    """
+    n_local = za_local.shape[0]
+    za_g = jax.lax.all_gather(za_local, axis, tiled=True)    # (N, D)
+    zb_g = jax.lax.all_gather(zb_local, axis, tiled=True)
+    n = za_g.shape[0]
+    d = jax.lax.axis_index(axis)
+    gid = d * n_local + jnp.arange(n_local, dtype=jnp.int32)
+    loss_a = info_nce_partial_fused(za_local, zb_g, gid, scale=scale,
+                                    interpret=interpret)
+    loss_b = info_nce_partial_fused(zb_local, za_g, gid, scale=scale,
+                                    interpret=interpret)
+    return jax.lax.psum(loss_a + loss_b, axis) / (2 * n)
+
+
+def make_sharded_infonce(
+    mesh: Mesh,
+    axis: str = "data",
+    interpret: bool | None = None,
+):
+    """Build a jit-able global-batch InfoNCE over ``mesh``.
+
+    Returns ``loss_fn(za, zb, scale) -> scalar`` with za, zb (N, D) paired
+    modality embeddings sharded along ``axis`` and ``scale`` replicated
+    (differentiable — psum of its per-shard gradients is AD-derived).
+    """
+    def body(za_local, zb_local, scale):
+        return local_infonce_allgather(za_local, zb_local, scale, axis,
+                                       interpret)
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+
+def info_nce_loss_distributed(
+    za: jax.Array,
+    zb: jax.Array,
+    mesh: Mesh,
+    temperature: float = 0.07,
+    *,
+    scale: jax.Array | float | None = None,
+    axis: str = "data",
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Global-batch symmetric InfoNCE over a device mesh (one-shot form)."""
+    from ..ops.infonce_pallas import resolve_scale
+
+    return make_sharded_infonce(mesh, axis, interpret)(
+        za, zb, resolve_scale(temperature, scale))
