@@ -1,0 +1,91 @@
+// XLA FFI custom-call handlers for the native NT-Xent core.
+//
+// This is the framework's native XLA entry point (SURVEY.md §7.1): where the
+// reference exposed its CUDA host ops to Python through pybind11
+// (/root/reference/src/binding_new.cpp:4-21), this library exposes the C++
+// core (ntxent_cpu.cpp) to the XLA *runtime itself* as typed FFI custom
+// calls. The ops are registered from Python via jax.ffi.register_ffi_target
+// (ntxent_tpu/ffi.py) and invoked with jax.ffi.ffi_call — so the native code
+// participates in jit programs (fusion boundaries, buffer donation, async
+// dispatch) instead of living behind a host-side binding the compiler cannot
+// see. Handlers run on the CPU platform; the TPU hot path remains the Pallas
+// kernel (ops/ntxent_pallas.py), and tests assert the two agree.
+
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+extern "C" {
+int ntxent_forward_cpu(const float* z, int64_t two_n, int64_t dim,
+                       float temperature, float* loss_out, float* lse_out);
+int ntxent_backward_cpu(const float* z, const float* lse, int64_t two_n,
+                        int64_t dim, float temperature, float grad_output,
+                        float* grad_out);
+}
+
+namespace ntxent_tpu {
+
+// forward(z: f32[2N, D]; temperature) -> (loss: f32[], lse: f32[2N])
+// Returns the mean canonical NT-Xent loss plus the O(N) logsumexp residual
+// (the residual contract the reference intended but never honored, D9).
+static ffi::Error ForwardImpl(ffi::BufferR2<ffi::F32> z, float temperature,
+                              ffi::ResultBufferR0<ffi::F32> loss,
+                              ffi::ResultBufferR1<ffi::F32> lse) {
+  auto dims = z.dimensions();  // rank 2 guaranteed by the BufferR2 binding
+  const int64_t two_n = dims[0];
+  const int64_t dim = dims[1];
+  if (lse->dimensions()[0] != two_n) {
+    return ffi::Error::InvalidArgument("lse result must have 2N rows");
+  }
+  int rc = ntxent_forward_cpu(z.typed_data(), two_n, dim, temperature,
+                              loss->typed_data(), lse->typed_data());
+  if (rc != 0) {
+    return ffi::Error::InvalidArgument(
+        "ntxent_forward_cpu rejected its arguments (need even 2N > 0, "
+        "D > 0, temperature > 0)");
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(NtxentForwardFfi, ForwardImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::BufferR2<ffi::F32>>()
+                                  .Attr<float>("temperature")
+                                  .Ret<ffi::BufferR0<ffi::F32>>()
+                                  .Ret<ffi::BufferR1<ffi::F32>>());
+
+// backward(z: f32[2N, D], lse: f32[2N], g: f32[]; temperature)
+//   -> grad_z: f32[2N, D]
+// Exact dense cotangent of the mean loss scaled by the upstream scalar g —
+// the contract the reference's backward violated (SURVEY.md §2.3-D8).
+static ffi::Error BackwardImpl(ffi::BufferR2<ffi::F32> z,
+                               ffi::BufferR1<ffi::F32> lse,
+                               ffi::BufferR0<ffi::F32> g, float temperature,
+                               ffi::ResultBufferR2<ffi::F32> grad) {
+  auto dims = z.dimensions();  // rank 2 guaranteed by the BufferR2 binding
+  const int64_t two_n = dims[0];
+  const int64_t dim = dims[1];
+  if (lse.dimensions()[0] != two_n) {
+    return ffi::Error::InvalidArgument("lse must have 2N rows");
+  }
+  int rc = ntxent_backward_cpu(z.typed_data(), lse.typed_data(), two_n, dim,
+                               temperature, *g.typed_data(),
+                               grad->typed_data());
+  if (rc != 0) {
+    return ffi::Error::InvalidArgument(
+        "ntxent_backward_cpu rejected its arguments");
+  }
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(NtxentBackwardFfi, BackwardImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::BufferR2<ffi::F32>>()
+                                  .Arg<ffi::BufferR1<ffi::F32>>()
+                                  .Arg<ffi::BufferR0<ffi::F32>>()
+                                  .Attr<float>("temperature")
+                                  .Ret<ffi::BufferR2<ffi::F32>>());
+
+}  // namespace ntxent_tpu

@@ -1,0 +1,122 @@
+"""NT-Xent as an XLA FFI custom call backed by the native C++ core.
+
+The reference's native surface was a CUDA/C++ op handed to Python through
+pybind11 (/root/reference/src/binding_new.cpp:4-21) — the compiler never saw
+it. Here the native core (native/src/ntxent_cpu.cpp) is registered into the
+XLA runtime itself as typed FFI custom calls (native/src/ntxent_ffi.cpp), so
+the C++ implementation composes with ``jit``, ``grad`` and the rest of the
+program: XLA schedules it, owns its buffers, and differentiates through it
+via the ``jax.custom_vjp`` wired below (forward saves the O(N) logsumexp
+residual; backward is the exact dense native gradient — the contract the
+reference's backward violated, SURVEY.md §2.3-D8/D9).
+
+CPU-platform handlers; the TPU hot path remains ops/ntxent_pallas.py. Tests
+(tests/test_ffi.py) assert the FFI op, the Pallas kernel, and the jnp oracle
+agree on loss and gradients.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .native import build_native, find_ffi_lib
+
+__all__ = ["register", "ffi_available", "ntxent_loss_ffi"]
+
+_REGISTERED = False
+
+FORWARD_TARGET = "ntxent_forward_ffi"
+BACKWARD_TARGET = "ntxent_backward_ffi"
+
+
+def ffi_available() -> bool:
+    """True when the FFI library is (or can be) built and jax.ffi exists."""
+    try:
+        import jax.ffi  # noqa: F401
+    except ImportError:  # pragma: no cover
+        return False
+    from shutil import which
+
+    return find_ffi_lib() is not None or which("cmake") is not None
+
+
+def register(build_if_missing: bool = True) -> None:
+    """Build (if needed) and register the FFI handlers with XLA. Idempotent."""
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    lib_path = find_ffi_lib()
+    if lib_path is None:
+        if not build_if_missing:
+            raise FileNotFoundError(
+                "XLA FFI library not built; run ntxent_tpu.native.build_native()")
+        build_native(force=True)
+        lib_path = find_ffi_lib()
+        if lib_path is None:
+            raise RuntimeError(
+                "native build completed but produced no libntxent_xla_ffi — "
+                "jaxlib FFI headers missing at configure time?")
+    lib = ctypes.cdll.LoadLibrary(str(lib_path))
+    jax.ffi.register_ffi_target(
+        FORWARD_TARGET, jax.ffi.pycapsule(lib.NtxentForwardFfi),
+        platform="cpu")
+    jax.ffi.register_ffi_target(
+        BACKWARD_TARGET, jax.ffi.pycapsule(lib.NtxentBackwardFfi),
+        platform="cpu")
+    _REGISTERED = True
+
+
+def _forward_call(z: jax.Array, temperature: float):
+    two_n = z.shape[0]
+    call = jax.ffi.ffi_call(
+        FORWARD_TARGET,
+        (jax.ShapeDtypeStruct((), jnp.float32),
+         jax.ShapeDtypeStruct((two_n,), jnp.float32)),
+    )
+    return call(z.astype(jnp.float32), temperature=np.float32(temperature))
+
+
+def _backward_call(z, lse, g, temperature: float):
+    call = jax.ffi.ffi_call(
+        BACKWARD_TARGET,
+        jax.ShapeDtypeStruct(z.shape, jnp.float32),
+    )
+    return call(z.astype(jnp.float32), lse, jnp.asarray(g, jnp.float32),
+                temperature=np.float32(temperature))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _ntxent_ffi(z, temperature):
+    return _forward_call(z, temperature)[0]
+
+
+def _ntxent_ffi_fwd(z, temperature):
+    loss, lse = _forward_call(z, temperature)
+    return loss, (z, lse)
+
+
+def _ntxent_ffi_bwd(temperature, res, g):
+    z, lse = res
+    grad = _backward_call(z, lse, g, temperature)
+    return (grad.astype(z.dtype),)
+
+
+_ntxent_ffi.defvjp(_ntxent_ffi_fwd, _ntxent_ffi_bwd)
+
+
+def ntxent_loss_ffi(z: jax.Array, temperature: float = 0.07) -> jax.Array:
+    """Canonical NT-Xent mean loss via the native XLA FFI custom call.
+
+    Same semantics as ``ops.oracle.ntxent_loss`` / ``ntxent_loss_fused``;
+    runs the threaded C++ core inside the XLA CPU runtime. Differentiable
+    (exact dense gradient). ``temperature`` must be a static Python float.
+    """
+    if z.ndim != 2 or z.shape[0] % 2 != 0:
+        raise ValueError(f"z must be (2N, D) with even 2N, got {z.shape}")
+    register()
+    return _ntxent_ffi(z, float(temperature))

@@ -9,6 +9,7 @@ cmake+ninja on first use."""
 from __future__ import annotations
 
 import ctypes
+import logging
 import subprocess
 from pathlib import Path
 
@@ -19,6 +20,7 @@ __all__ = ["build_native", "load_library", "forward_cpu", "backward_cpu",
 
 _NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
 _BUILD_DIR = _NATIVE_DIR / "build"
+_FFI_FAIL_STAMP = _BUILD_DIR / ".ffi_build_failed"
 _LIB = None
 
 
@@ -41,14 +43,44 @@ def build_native(force: bool = False) -> Path:
 
     Rebuilds automatically when any native source is newer than the library.
     """
+    try:  # the XLA FFI target needs jaxlib's bundled headers
+        import jax.ffi
+
+        ffi_include: str | None = jax.ffi.include_dir()
+    except Exception:  # pragma: no cover - jax is a hard dep in practice
+        ffi_include = None
     lib = _find_lib()
-    if lib is not None and not force \
-            and lib.stat().st_mtime >= _sources_mtime():
+    src_mtime = _sources_mtime()
+    fresh = lib is not None and not force \
+        and lib.stat().st_mtime >= src_mtime
+    # A stamp recording an FFI build failure (e.g. incompatible jaxlib
+    # headers) counts as "fresh" so processes don't re-run the failing build
+    # forever; editing any native source invalidates it.
+    ffi_failed = _FFI_FAIL_STAMP.exists() \
+        and _FFI_FAIL_STAMP.stat().st_mtime >= src_mtime
+    ffi_lib = find_ffi_lib()
+    ffi_fresh = ffi_include is None or ffi_failed or (
+        ffi_lib is not None and not force
+        and ffi_lib.stat().st_mtime >= src_mtime)
+    if fresh and ffi_fresh:
         return lib
     _BUILD_DIR.mkdir(exist_ok=True)
     gen = ["-G", "Ninja"] if _have("ninja") else []
-    _run_logged(["cmake", *gen, ".."])
+    defs = [] if ffi_include is None \
+        else [f"-DXLA_FFI_INCLUDE_DIR={ffi_include}"]
+    _run_logged(["cmake", *gen, *defs, ".."])
     _run_logged(["cmake", "--build", ".", "-j"])
+    if ffi_include is not None:
+        # Separate best-effort invocation: an FFI header/API incompatibility
+        # must not take down the core ctypes library built above.
+        try:
+            _run_logged(["cmake", "--build", ".", "-j",
+                         "--target", "ntxent_xla_ffi"])
+            _FFI_FAIL_STAMP.unlink(missing_ok=True)
+        except RuntimeError as e:
+            logging.getLogger(__name__).warning(
+                "XLA FFI library build failed (core library unaffected): %s", e)
+            _FFI_FAIL_STAMP.write_text(str(e))
     lib = _find_lib()
     if lib is None:
         raise RuntimeError(f"native build produced no library in {_BUILD_DIR}")
@@ -63,6 +95,15 @@ def _have(tool: str) -> bool:
 
 def _find_lib() -> Path | None:
     for name in ("libntxent_cpu.so", "libntxent_cpu.dylib"):
+        p = _BUILD_DIR / name
+        if p.exists():
+            return p
+    return None
+
+
+def find_ffi_lib() -> Path | None:
+    """Path of the XLA FFI custom-call library, if built (see ffi.py)."""
+    for name in ("libntxent_xla_ffi.so", "libntxent_xla_ffi.dylib"):
         p = _BUILD_DIR / name
         if p.exists():
             return p

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.infonce_pallas import info_nce_partial_fused
+from ..ops.infonce_pallas import info_nce_partial_fused, resolve_scale
 from ..ops.ntxent_pallas import ntxent_partial_fused
 from .mesh import local_row_gids
 
@@ -157,7 +157,5 @@ def info_nce_loss_distributed(
     interpret: bool | None = None,
 ) -> jax.Array:
     """Global-batch symmetric InfoNCE over a device mesh (one-shot form)."""
-    from ..ops.infonce_pallas import resolve_scale
-
     return make_sharded_infonce(mesh, axis, interpret)(
         za, zb, resolve_scale(temperature, scale))

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops.infonce_pallas import resolve_scale
 from .mesh import local_row_gids
 
 __all__ = ["ntxent_loss_ring", "make_ring_ntxent",
@@ -125,21 +126,22 @@ def _infonce_ring_body(za_local, zb_local, scale, axis, num_devices):
     """
     n_local, _ = za_local.shape
     n = n_local * num_devices
-    za_s = za_local * scale
-    pos = jnp.sum(za_s * zb_local.astype(za_s.dtype), axis=-1,
-                  dtype=jnp.float32)                     # scale * za_i . zb_i
+    pos = jnp.sum(za_local * zb_local, axis=-1,
+                  dtype=jnp.float32) * scale             # scale * za_i . zb_i
 
     perm = [(i, (i + 1) % num_devices) for i in range(num_devices)]
 
     def fold(rows, blk, m, l):
-        s = jnp.dot(rows, blk.T, preferred_element_type=jnp.float32)
+        # scale applied to the fp32 dot product, so the circulating blocks
+        # stay in their original dtype (half the ICI bytes for bf16 inputs).
+        s = jnp.dot(rows, blk.T, preferred_element_type=jnp.float32) * scale
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(s - m_new[:, None]), axis=1)
         return m_new, l
 
     def step(carry, _):
         za_blk, zb_blk, m_a, l_a, m_b, l_b = carry
-        m_a, l_a = fold(za_s, zb_blk, m_a, l_a)      # row direction: s rows
+        m_a, l_a = fold(za_local, zb_blk, m_a, l_a)  # row direction: s rows
         m_b, l_b = fold(zb_local, za_blk, m_b, l_b)  # col direction: s.T rows
         za_blk = jax.lax.ppermute(za_blk, axis, perm)
         zb_blk = jax.lax.ppermute(zb_blk, axis, perm)
@@ -149,14 +151,13 @@ def _infonce_ring_body(za_local, zb_local, scale, axis, num_devices):
         return jax.lax.pcast(jnp.full((n_local,), v, jnp.float32),
                              (axis,), to="varying")
 
-    # The circulating za must carry the scale so the s.T fold sees scale*za;
-    # P-1 exchanges, final visiting block folded outside the scan.
-    init = (za_s.astype(jnp.float32), zb_local.astype(jnp.float32),
+    # P-1 exchanges; the final visiting block is folded outside the scan.
+    init = (za_local, zb_local,
             stat(_NEG_INF), stat(0.0), stat(_NEG_INF), stat(0.0))
     (za_blk, zb_blk, m_a, l_a, m_b, l_b), _ = jax.lax.scan(
         step, init, None, length=num_devices - 1
     )
-    m_a, l_a = fold(za_s, zb_blk, m_a, l_a)
+    m_a, l_a = fold(za_local, zb_blk, m_a, l_a)
     m_b, l_b = fold(zb_local, za_blk, m_b, l_b)
     lse_a = m_a + jnp.log(l_a)
     lse_b = m_b + jnp.log(l_b)
@@ -186,6 +187,4 @@ def info_nce_loss_ring(
     The CLIP-scale path (BASELINE.json configs[4], global batch 32768):
     memory is O(N/P) per chip and all communication is neighbor ICI hops.
     """
-    from ..ops.infonce_pallas import resolve_scale
-
     return make_ring_infonce(mesh, axis)(za, zb, resolve_scale(temperature, scale))

@@ -1,0 +1,74 @@
+"""XLA FFI custom-call path: native C++ core inside the XLA runtime.
+
+The cross-runtime agreement tests the reference never had (its pybind11 op
+was invisible to the compiler and its tests asserted only loss>0 / not-NaN,
+/root/reference/tests/test_forward.cpp:19-27): here the FFI op must match
+the jnp oracle and the Pallas kernel on loss AND gradients, under jit, and
+compose with jax.grad through a custom_vjp.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from conftest import make_embeddings
+from ntxent_tpu.ops.ntxent_pallas import ntxent_loss_fused
+from ntxent_tpu.ops.oracle import ntxent_loss
+
+ffi_mod = pytest.importorskip("ntxent_tpu.ffi")
+
+pytestmark = pytest.mark.skipif(
+    not ffi_mod.ffi_available(), reason="jax.ffi unavailable")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _register():
+    try:
+        ffi_mod.register()
+    except RuntimeError as e:
+        # build_native tolerates an FFI-target failure (incompatible jaxlib
+        # headers) as a degraded mode; mirror that here as a skip, not an error.
+        pytest.skip(f"XLA FFI library unavailable: {e}")
+
+
+@pytest.mark.parametrize("two_n,d", [(16, 32), (64, 128), (130, 96)])
+def test_ffi_matches_oracle(rng, two_n, d):
+    z = make_embeddings(rng, two_n, d)
+    got = ffi_mod.ntxent_loss_ffi(z, 0.07)
+    want = ntxent_loss(z, 0.07)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ffi_under_jit_matches_pallas(rng):
+    z = make_embeddings(rng, 64, 64)
+    f = jax.jit(lambda zz: ffi_mod.ntxent_loss_ffi(zz, 0.1))
+    got = f(z)
+    want = ntxent_loss_fused(z, 0.1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ffi_gradient_matches_oracle(rng):
+    z = make_embeddings(rng, 32, 48)
+    g_ffi = jax.grad(lambda zz: ffi_mod.ntxent_loss_ffi(zz, 0.07))(z)
+    g_orc = jax.grad(lambda zz: ntxent_loss(zz, 0.07))(z)
+    np.testing.assert_allclose(np.asarray(g_ffi), np.asarray(g_orc),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ffi_gradient_honors_cotangent(rng):
+    z = make_embeddings(rng, 16, 32)
+    _, vjp = jax.vjp(lambda zz: ffi_mod.ntxent_loss_ffi(zz, 0.07), z)
+    (g2,) = vjp(jnp.float32(2.0))
+    (g1,) = vjp(jnp.float32(1.0))
+    np.testing.assert_allclose(np.asarray(g2), 2.0 * np.asarray(g1),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ffi_rejects_odd_rows(rng):
+    z = make_embeddings(rng, 7, 8)
+    with pytest.raises(ValueError):
+        ffi_mod.ntxent_loss_ffi(z, 0.07)
