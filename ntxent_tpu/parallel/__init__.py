@@ -18,6 +18,13 @@ from ntxent_tpu.parallel.ring import (
     make_ring_ntxent,
     ntxent_loss_ring,
 )
+from ntxent_tpu.parallel.tp import (
+    make_tp_clip_train_step,
+    make_tp_simclr_train_step,
+    param_spec_tree,
+    shard_train_state,
+    tp_param_spec,
+)
 
 __all__ = [
     "create_mesh",
@@ -34,4 +41,9 @@ __all__ = [
     "make_sharded_infonce",
     "info_nce_loss_ring",
     "make_ring_infonce",
+    "tp_param_spec",
+    "param_spec_tree",
+    "shard_train_state",
+    "make_tp_simclr_train_step",
+    "make_tp_clip_train_step",
 ]

@@ -1,0 +1,211 @@
+"""Tensor parallelism: 2-D (data, model) mesh for the ViT/CLIP towers.
+
+SURVEY.md §2.2 (TP row): the reference has no tensor parallelism; the
+obligation for the ViT-B / CLIP configs (BASELINE.json configs[3-4]) is an
+optional ``model`` mesh axis realized "via pjit sharding annotations, not
+custom code". That is exactly what this module does — the idiomatic XLA/GSPMD
+recipe (pick a mesh, annotate shardings, let the compiler insert the
+collectives):
+
+* ``tp_param_spec`` maps each parameter path to a ``PartitionSpec``. The
+  Megatron-style layout for transformer blocks: attention Q/K/V project onto
+  head-sharded activations (heads split over ``model``), the attention output
+  projection contracts the sharded head axis (XLA inserts the psum); the MLP
+  up-projection is column-sharded, the down-projection row-sharded. Norms,
+  embeddings, and small projections stay replicated.
+* ``shard_train_state`` places a TrainState on the mesh: every leaf whose
+  trailing path matches a parameter rule (this covers the optimizer's
+  momentum/trace pytrees too, since optax states mirror the param tree)
+  gets its spec; everything else is replicated.
+* ``make_tp_simclr_train_step`` / ``make_tp_clip_train_step`` jit the
+  ordinary single-program train step over committed sharded inputs —
+  activations are constrained to stay batch-sharded over ``data``, weights
+  stay sharded over ``model``, and GSPMD derives every all-gather /
+  reduce-scatter / psum, including the loss's cross-batch similarity matmul.
+
+The explicit shard_map data-parallel path (trainer.py + parallel/dist_loss.py)
+remains the fused-Pallas-loss route; this module is the compiler-partitioned
+route for models big enough to need their weights split.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.oracle import info_nce_loss, ntxent_loss
+
+__all__ = [
+    "tp_param_spec",
+    "param_spec_tree",
+    "shard_train_state",
+    "make_tp_simclr_train_step",
+    "make_tp_clip_train_step",
+]
+
+
+def _path_names(path) -> list[str]:
+    out = []
+    for k in path:
+        if hasattr(k, "key"):
+            out.append(str(k.key))
+        elif hasattr(k, "name"):
+            out.append(str(k.name))
+        elif hasattr(k, "idx"):
+            out.append(str(k.idx))
+        else:  # pragma: no cover - future jax key types
+            out.append(str(k))
+    return out
+
+
+def tp_param_spec(path, leaf, *, model_axis: str = "model") -> P:
+    """Megatron-style PartitionSpec for one (path, leaf) of a transformer.
+
+    Matches on the *trailing* module names (flax linen auto-names), so the
+    same rule applies to ``params`` and to optimizer-state pytrees that
+    mirror the param tree. Leaves whose rank doesn't match the rule (or that
+    no rule covers) are replicated.
+    """
+    names = _path_names(path)
+    if not names:
+        return P()
+    leaf_name = names[-1]
+    in_attn = any("Attention" in n for n in names)
+    in_mlp = any("MlpBlock" in n for n in names)
+
+    if in_attn and len(names) >= 2:
+        proj = names[-2]
+        if proj in ("query", "key", "value"):
+            # kernel: (embed, heads, head_dim) — shard heads.
+            if leaf_name == "kernel" and leaf.ndim == 3:
+                return P(None, model_axis, None)
+            if leaf_name == "bias" and leaf.ndim == 2:
+                return P(model_axis, None)
+        elif proj == "out":
+            # kernel: (heads, head_dim, embed) — contract sharded heads;
+            # the bias is added after the psum, replicated.
+            if leaf_name == "kernel" and leaf.ndim == 3:
+                return P(model_axis, None, None)
+    if in_mlp:
+        dense = next((n for n in names if n.startswith("Dense_")), None)
+        if dense == "Dense_0":  # up-projection: column-sharded
+            if leaf_name == "kernel" and leaf.ndim == 2:
+                return P(None, model_axis)
+            if leaf_name == "bias" and leaf.ndim == 1:
+                return P(model_axis)
+        elif dense == "Dense_1":  # down-projection: row-sharded (psum after)
+            if leaf_name == "kernel" and leaf.ndim == 2:
+                return P(model_axis, None)
+    return P()
+
+
+def param_spec_tree(params, *, model_axis: str = "model"):
+    """PartitionSpec pytree for a param (or mirrored optimizer-state) tree."""
+    return jax.tree_util.tree_map_with_path(
+        functools.partial(tp_param_spec, model_axis=model_axis), params)
+
+
+def shard_train_state(state, mesh: Mesh, *, model_axis: str = "model"):
+    """Place a TrainState on the mesh with TP param/optimizer sharding.
+
+    Returns the state with every array leaf committed to a NamedSharding —
+    jit then infers program shardings from these placements (no in_shardings
+    needed).
+    """
+
+    def place(path, leaf):
+        if not hasattr(leaf, "ndim"):  # static fields (apply_fn, tx)
+            return leaf
+        spec = tp_param_spec(path, leaf, model_axis=model_axis)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, state)
+
+
+def _constrain_batch(x, mesh: Mesh, data_axis: str):
+    spec = P(data_axis, *([None] * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def make_tp_simclr_train_step(
+    mesh: Mesh,
+    temperature: float = 0.1,
+    *,
+    data_axis: str = "data",
+    has_batch_stats: bool = False,
+) -> Callable:
+    """Compiler-partitioned SimCLR train step on a (data, model) mesh.
+
+    The batch stays sharded over ``data``; weights matching ``tp_param_spec``
+    stay sharded over ``model``; the NT-Xent loss runs on the jnp oracle so
+    GSPMD shards the (2B, 2B) similarity matmul across the mesh (rows with
+    the batch sharding, columns via its own all-gather).
+
+    ``has_batch_stats=True`` is for encoders with BatchNorm (ResNet +
+    trainer.TrainState); the default fits the primary TP targets (ViT/CLIP,
+    no BatchNorm, plain flax TrainState).
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state, v1, v2):
+        v1c = _constrain_batch(v1, mesh, data_axis)
+        v2c = _constrain_batch(v2, mesh, data_axis)
+
+        def loss_fn(params):
+            both = jnp.concatenate([v1c, v2c], axis=0)
+            if has_batch_stats:
+                variables = {"params": params,
+                             "batch_stats": state.batch_stats}
+                z, updates = state.apply_fn(variables, both, train=True,
+                                            mutable=["batch_stats"])
+                new_stats = updates["batch_stats"]
+            else:
+                z = state.apply_fn({"params": params}, both, train=True)
+                new_stats = None
+            z = _constrain_batch(z, mesh, data_axis)
+            return ntxent_loss(z, temperature), new_stats
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        state2 = state.apply_gradients(grads=grads)
+        if new_stats is not None:
+            state2 = state2.replace(batch_stats=new_stats)
+        return state2, {"loss": loss}
+
+    return train_step
+
+
+def make_tp_clip_train_step(
+    mesh: Mesh,
+    *,
+    data_axis: str = "data",
+) -> Callable:
+    """Compiler-partitioned CLIP train step: dual towers, learnable scale.
+
+    ``state.apply_fn(variables, images, tokens)`` must return
+    ``(image_embeds, text_embeds, scale)`` (models/clip.py). The symmetric
+    InfoNCE runs at temperature ``1/scale`` so the logit scale's gradient
+    flows; GSPMD shards both towers over ``model`` and the (N, N) logit
+    matmul over the mesh.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state, images, tokens):
+        imc = _constrain_batch(images, mesh, data_axis)
+        tkc = _constrain_batch(tokens, mesh, data_axis)
+
+        def loss_fn(params):
+            zi, zt, scale = state.apply_fn({"params": params}, imc, tkc,
+                                           train=True)
+            zi = _constrain_batch(zi, mesh, data_axis)
+            zt = _constrain_batch(zt, mesh, data_axis)
+            return info_nce_loss(zi, zt, temperature=1.0 / scale)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), {"loss": loss}
+
+    return train_step
