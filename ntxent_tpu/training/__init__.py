@@ -16,6 +16,7 @@ from ntxent_tpu.training.trainer import (
     TrainState,
     create_train_state,
     estimate_mfu,
+    fit,
     make_sharded_train_step,
     make_train_step,
     shard_batch,
@@ -41,4 +42,5 @@ __all__ = [
     "make_train_step",
     "shard_batch",
     "train_loop",
+    "fit",
 ]

@@ -32,7 +32,7 @@ from .lars import cosine_warmup_schedule, create_lars, simclr_learning_rate
 logger = logging.getLogger(__name__)
 
 __all__ = ["TrainState", "create_train_state", "make_train_step",
-           "make_sharded_train_step", "train_loop", "TrainerConfig"]
+           "make_sharded_train_step", "train_loop", "fit", "TrainerConfig"]
 
 
 class TrainState(train_state.TrainState):
@@ -47,6 +47,12 @@ class TrainerConfig:
     weight_decay: float = 1e-6
     warmup_steps: int = 100
     total_steps: int = 1000
+    # Gradient accumulation: optimizer updates apply every `accum_steps`
+    # micro-batches (optax.MultiSteps). NOTE the contrastive semantics:
+    # negatives stay within each micro-batch — accumulation scales the
+    # optimizer's effective batch, not the loss's negative pool (use the
+    # distributed all-gather/ring losses to scale the pool itself).
+    accum_steps: int = 1
 
     @property
     def learning_rate(self) -> float:
@@ -67,6 +73,8 @@ def create_train_state(
         schedule = cosine_warmup_schedule(
             config.learning_rate, config.warmup_steps, config.total_steps)
         tx = create_lars(schedule, config.weight_decay, params=params)
+        if config.accum_steps > 1:
+            tx = optax.MultiSteps(tx, every_k_schedule=config.accum_steps)
     return TrainState.create(
         apply_fn=model.apply, params=params, tx=tx,
         batch_stats=variables.get("batch_stats", flax.core.freeze({})),
@@ -158,14 +166,22 @@ def train_loop(
     log_every: int = 50,
     flops_per_step: float | None = None,
     hook: Callable | None = None,
+    step_hook: Callable | None = None,
 ):
-    """Simple host loop: step, log loss / steps-per-sec / MFU."""
+    """Simple host loop: step, log loss / steps-per-sec / MFU.
+
+    ``hook(state, entry)`` fires at log points; ``step_hook(state)`` fires
+    after EVERY step (for periodic side effects keyed on the global
+    ``state.step``, e.g. interval-filtered checkpoint saves).
+    """
     history = []
     t0 = time.perf_counter()
     last_t, last_step = t0, 0
     for step in range(1, num_steps + 1):
         v1, v2 = next(data_iter)
         state, metrics = train_step(state, v1, v2)
+        if step_hook is not None:
+            step_hook(state)
         if step % log_every == 0 or step == num_steps:
             loss = float(metrics["loss"])
             now = time.perf_counter()
@@ -178,6 +194,76 @@ def train_loop(
             logger.info("step %d: loss=%.4f, %.2f steps/s", step, loss, sps)
             if hook is not None:
                 hook(state, entry)
+    return state, history
+
+
+def fit(
+    state: TrainState,
+    data_iter,
+    train_step: Callable,
+    num_steps: int,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 500,
+    log_every: int = 50,
+    flops_per_step: float | None = None,
+    fast_forward_data: bool = False,
+):
+    """Checkpoint-aware training: restore the latest checkpoint if one
+    exists, train to ``num_steps`` total, save every ``checkpoint_every``
+    steps (on the GLOBAL ``state.step``) and at the end.
+
+    The resume point is ``state.step`` (incremented by apply_gradients), so
+    a re-run after preemption continues where the last saved state stopped —
+    the capability the reference's multi-day target configs require
+    (SURVEY.md §5.4; the reference itself persisted nothing).
+
+    Counting caveats:
+
+    * All step counts here are TRAIN-STEP counts. With
+      ``TrainerConfig.accum_steps > 1`` each train step is one micro-batch
+      (flax increments ``state.step`` even when MultiSteps skips the
+      update), so optimizer updates number ``num_steps / accum_steps``.
+    * The optimizer/model state resumes exactly, but ``data_iter`` restarts
+      wherever the caller's iterator starts. Pass a resume-aware iterator,
+      or set ``fast_forward_data=True`` to consume ``state.step`` batches
+      first (exact for seeded pipelines; costs host+augment time
+      proportional to the skipped steps).
+    """
+    manager = None
+    if checkpoint_dir is not None:
+        from .checkpoint import CheckpointManager
+
+        manager = CheckpointManager(checkpoint_dir,
+                                    save_interval_steps=checkpoint_every)
+        if manager.latest_step() is not None:
+            state = manager.restore(state)
+            logger.info("resumed from checkpoint at step %d",
+                        int(state.step))
+
+    done = int(state.step)
+    remaining = num_steps - done
+    if remaining <= 0:
+        logger.info("nothing to do: checkpoint already at step %d", done)
+        return state, []
+    if fast_forward_data:
+        for _ in range(done):
+            next(data_iter)
+
+    def step_hook(s):
+        # Every step; orbax's FixedIntervalPolicy filters to global steps
+        # divisible by checkpoint_every (a resumed run keeps the cadence).
+        if manager is not None:
+            manager.save(int(s.step), s)
+
+    state, history = train_loop(
+        state, data_iter, train_step, remaining,
+        log_every=log_every,
+        flops_per_step=flops_per_step, step_hook=step_hook)
+    if manager is not None:
+        if manager.latest_step() != int(state.step):  # hook may have saved it
+            manager.save(int(state.step), state, force=True)
+        manager.wait_until_finished()
+        manager.close()
     return state, history
 
 
