@@ -35,10 +35,39 @@ from .utils.capability import check_tensor_core_support
 __all__ = ["forward", "backward", "check_tensor_core_support", "ntxent"]
 
 
-def _prep(z: jax.Array, use_mixed_precision: bool) -> jax.Array:
+def _is_torch(x) -> bool:
+    """True for torch.Tensor without importing torch unless it's loaded."""
+    import sys
+
+    torch = sys.modules.get("torch")
+    return torch is not None and isinstance(x, torch.Tensor)
+
+
+def _from_torch(x) -> jax.Array:
+    # Lazy import is safe: this branch only runs on torch-typed input, by
+    # which point torch itself is already loaded (see _is_torch).
+    from .torch_compat import to_jax
+
+    return to_jax(x)
+
+
+def _to_torch(x: jax.Array):
+    from .torch_compat import to_torch
+
+    return to_torch(x)
+
+
+def _prep(z, use_mixed_precision: bool):
+    """Accept jax/numpy/torch input (the reference's callers hold torch
+    tensors, binding_new.cpp:5-9); returns (jax array, was_torch flag)."""
+    was_torch = _is_torch(z)
+    if was_torch:
+        z = _from_torch(z)
+    else:
+        z = jnp.asarray(z)
     if use_mixed_precision:
-        return z.astype(jnp.bfloat16)
-    return z
+        z = z.astype(jnp.bfloat16)
+    return z, was_torch
 
 
 def forward(
@@ -52,8 +81,20 @@ def forward(
 ):
     """NT-Xent forward. Returns the scalar loss (matching binding_new.cpp:5-9),
     or (loss, softmax) with ``return_softmax=True`` (the intended contract).
+
+    Accepts jax, numpy, or torch input; torch in => torch out, so reference
+    callers holding ``torch.Tensor`` embeddings work unchanged.
     """
-    z = _prep(z, use_mixed_precision)
+    z, was_torch = _prep(z, use_mixed_precision)
+    out = _forward_jax(z, temperature, return_softmax, compat, fused)
+    if was_torch:
+        if isinstance(out, tuple):
+            return tuple(_to_torch(o) for o in out)
+        return _to_torch(out)
+    return out
+
+
+def _forward_jax(z, temperature, return_softmax, compat, fused):
     if compat == "reference":
         loss = oracle.ntxent_loss_compat(z, temperature)
         if return_softmax:
@@ -83,9 +124,12 @@ def backward(
     grad_logits) like the reference's {grad_z, grad_logits} pair
     (ntxent_kernel.cu:238). ``softmax_output`` is accepted for signature
     parity and ignored — gradients are recomputed exactly from ``z``.
+    Accepts jax, numpy, or torch input; torch in => torch out.
     """
-    z = _prep(z, use_mixed_precision)
+    z, was_torch = _prep(z, use_mixed_precision)
     del softmax_output  # recomputed exactly; kept for signature parity
+    if _is_torch(grad_output):
+        grad_output = _from_torch(grad_output)
     g = jnp.asarray(grad_output, jnp.float32)
     zf = z.astype(jnp.float32)
 
@@ -101,7 +145,10 @@ def backward(
     # softmax over it). G's diagonal is 0 (masked), so the mask constant
     # contributes nothing.
     grad_z = (grad_logits + grad_logits.T) @ zf / temperature
-    return grad_z.astype(z.dtype), grad_logits
+    grad_z = grad_z.astype(z.dtype)
+    if was_torch:
+        return _to_torch(grad_z), _to_torch(grad_logits)
+    return grad_z, grad_logits
 
 
 class _NtxentModule:

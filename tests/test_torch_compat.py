@@ -1,0 +1,111 @@
+"""Torch interop: the reference's torch UX working end-to-end.
+
+Covers (a) the compat API accepting/returning torch tensors, and (b) the
+autograd bridge — ``loss.backward()`` producing exact gradients, which the
+reference's own GradientCheck test attempted but could never do
+(/root/reference/tests/test_forward.cpp:29-38: its op was not an autograd
+node).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+torch = pytest.importorskip("torch")
+
+from conftest import make_embeddings  # noqa: E402
+from ntxent_tpu import api  # noqa: E402
+from ntxent_tpu.ops.oracle import ntxent_loss  # noqa: E402
+from ntxent_tpu.torch_compat import NTXentLoss, ntxent_loss_torch  # noqa: E402
+
+
+def _torch_embeddings(rows=32, dim=64, seed=0):
+    g = torch.Generator().manual_seed(seed)
+    z = torch.randn(rows, dim, generator=g)
+    return torch.nn.functional.normalize(z, dim=-1)
+
+
+def test_api_forward_torch_in_torch_out():
+    zt = _torch_embeddings()
+    loss = api.forward(zt, 0.07)
+    assert isinstance(loss, torch.Tensor)
+    want = ntxent_loss(jax.numpy.asarray(zt.numpy()), 0.07)
+    np.testing.assert_allclose(loss.numpy(), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_api_backward_torch_in_torch_out():
+    zt = _torch_embeddings()
+    grad_z, grad_logits = api.backward(zt, None, 1.0, 0.07)
+    assert isinstance(grad_z, torch.Tensor)
+    assert isinstance(grad_logits, torch.Tensor)
+    want = jax.grad(lambda z: ntxent_loss(z, 0.07))(
+        jax.numpy.asarray(zt.numpy()))
+    np.testing.assert_allclose(grad_z.numpy(), np.asarray(want),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_autograd_backward_matches_jax_grad():
+    zt = _torch_embeddings(16, 32).requires_grad_(True)
+    loss = ntxent_loss_torch(zt, 0.07)
+    loss.backward()
+    want = jax.grad(lambda z: ntxent_loss(z, 0.07))(
+        jax.numpy.asarray(zt.detach().numpy()))
+    np.testing.assert_allclose(zt.grad.numpy(), np.asarray(want),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_autograd_cotangent_scaling():
+    zt = _torch_embeddings(16, 32).requires_grad_(True)
+    (2.0 * ntxent_loss_torch(zt, 0.07)).backward()
+    g2 = zt.grad.clone()
+    zt.grad = None
+    ntxent_loss_torch(zt, 0.07).backward()
+    np.testing.assert_allclose(g2.numpy(), 2.0 * zt.grad.numpy(),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_nn_module_two_view_form_trains():
+    """One SGD step through a torch encoder using the bridged loss."""
+    torch.manual_seed(0)
+    enc = torch.nn.Sequential(torch.nn.Linear(8, 32), torch.nn.ReLU(),
+                              torch.nn.Linear(32, 16))
+    opt = torch.optim.SGD(enc.parameters(), lr=0.5)
+    crit = NTXentLoss(temperature=0.2)
+    x1 = torch.randn(8, 8)
+    x2 = x1 + 0.05 * torch.randn(8, 8)
+
+    def closure():
+        z1 = torch.nn.functional.normalize(enc(x1), dim=-1)
+        z2 = torch.nn.functional.normalize(enc(x2), dim=-1)
+        return crit(z1, z2)
+
+    losses = []
+    for _ in range(10):
+        opt.zero_grad()
+        loss = closure()
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], f"no training progress: {losses}"
+
+
+def test_torch_rejects_odd_rows():
+    with pytest.raises(ValueError):
+        ntxent_loss_torch(torch.randn(7, 8))
+
+
+def test_autograd_bf16_input_dtype_preserved():
+    zt = _torch_embeddings(16, 32).to(torch.bfloat16).requires_grad_(True)
+    loss = ntxent_loss_torch(zt, 0.2)
+    loss.backward()
+    assert zt.grad is not None and zt.grad.dtype == torch.bfloat16
+
+
+def test_no_grad_eval_runs():
+    zt = _torch_embeddings(16, 32)
+    with torch.no_grad():
+        loss = ntxent_loss_torch(zt, 0.07)
+    assert torch.isfinite(loss)
