@@ -30,11 +30,16 @@ __all__ = ["NTXentLoss", "ntxent_loss_torch", "to_jax", "to_torch"]
 
 
 def to_jax(t: torch.Tensor) -> jax.Array:
-    """torch -> jax; dlpack zero-copy when possible, else via numpy."""
+    """torch -> jax; dlpack zero-copy when possible, else via numpy
+    (routing bf16 — which torch cannot hand to numpy — through float32)."""
     try:
         return jnp.from_dlpack(t.detach().contiguous())
     except Exception:
-        return jnp.asarray(t.detach().cpu().numpy())
+        t = t.detach().cpu()
+        if t.dtype == torch.bfloat16:
+            return jnp.asarray(t.to(torch.float32).numpy()
+                               ).astype(jnp.bfloat16)
+        return jnp.asarray(t.numpy())
 
 
 def to_torch(x: jax.Array) -> torch.Tensor:
@@ -59,15 +64,21 @@ def _loss_fn(z: jax.Array, temperature: float) -> jax.Array:
 class _NTXentFn(torch.autograd.Function):
     @staticmethod
     def forward(ctx, z: torch.Tensor, temperature: float) -> torch.Tensor:
-        zj = to_jax(z.float())
+        # copy=True: ctx.zj must NOT alias z's storage — the gradient is
+        # computed lazily in backward, and a zero-copy alias would silently
+        # see in-place mutations of z that torch's version counter cannot
+        # track across the dlpack boundary.
+        zj = to_jax(z.detach().to(dtype=torch.float32, copy=True))
         ctx.zj = zj
         ctx.temperature = temperature
         ctx.in_dtype = z.dtype
-        return to_torch(_loss_fn(zj, temperature))
+        ctx.in_device = z.device
+        return to_torch(_loss_fn(zj, temperature)).to(z.device)
 
     @staticmethod
     def backward(ctx, grad_output: torch.Tensor):
         grad = to_torch(jax.grad(_loss_fn)(ctx.zj, ctx.temperature))
+        grad = grad.to(device=ctx.in_device)
         return (grad_output * grad).to(ctx.in_dtype), None
 
 

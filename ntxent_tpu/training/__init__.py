@@ -1,5 +1,9 @@
 from ntxent_tpu.training.augment import augment_batch_pair, augment_pair
-from ntxent_tpu.training.checkpoint import CheckpointManager
+from ntxent_tpu.training.evaluation import (
+    extract_features,
+    knn_accuracy,
+    linear_probe,
+)
 from ntxent_tpu.training.data import (
     ArrayDataset,
     PrefetchIterator,
@@ -27,6 +31,9 @@ __all__ = [
     "augment_batch_pair",
     "augment_pair",
     "CheckpointManager",
+    "extract_features",
+    "knn_accuracy",
+    "linear_probe",
     "ArrayDataset",
     "PrefetchIterator",
     "synthetic_images",
@@ -44,3 +51,15 @@ __all__ = [
     "train_loop",
     "fit",
 ]
+
+
+def __getattr__(name):
+    # CheckpointManager lazily: its orbax import initializes the JAX
+    # backends as a side effect, which (a) pins the platform before callers
+    # can choose one and (b) blocks on accelerator discovery — neither is
+    # acceptable for `import ntxent_tpu.training` itself.
+    if name == "CheckpointManager":
+        from ntxent_tpu.training.checkpoint import CheckpointManager
+
+        return CheckpointManager
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

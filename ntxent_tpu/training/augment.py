@@ -16,12 +16,15 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["augment_pair", "augment_batch_pair", "random_resized_crop",
            "color_jitter", "random_grayscale", "gaussian_blur",
            "random_flip"]
 
-_RGB_TO_Y = jnp.array([0.299, 0.587, 0.114])
+# numpy, not jnp: a module-level device array would initialize the JAX
+# backends (and block on accelerator discovery) at import time.
+_RGB_TO_Y = np.array([0.299, 0.587, 0.114], np.float32)
 
 
 def random_resized_crop(key, image, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):

@@ -1,0 +1,131 @@
+"""SSL evaluation protocol: frozen-feature linear probe and kNN accuracy.
+
+The standard SimCLR measurement loop (Chen et al. 2020 §B.6): freeze the
+pretrained encoder, extract features, train a linear classifier (or run a
+kNN vote) and report top-1. The reference had no evaluation of any kind
+(SURVEY.md §0.2 — no model, no trainer); this completes the training story
+its name promised. Everything jits: the probe is one `lax.scan` of adam
+steps over replicated feature batches — no host loop per epoch.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["extract_features", "linear_probe", "knn_accuracy"]
+
+
+def extract_features(
+    apply_features: Callable,
+    images: jax.Array,
+    batch_size: int = 256,
+) -> jax.Array:
+    """Frozen-encoder features in jitted batches.
+
+    ``apply_features(x) -> (B, F)`` is the encoder forward (e.g.
+    ``lambda x: model.apply(variables, x, train=False, method="features")``).
+    The tail partial batch is padded to keep one compiled shape and sliced
+    off afterwards.
+    """
+    n = images.shape[0]
+    fn = jax.jit(apply_features)
+    outs = []
+    for start in range(0, n, batch_size):
+        batch = images[start:start + batch_size]
+        pad = batch_size - batch.shape[0]
+        if pad:
+            batch = jnp.pad(batch, ((0, pad),) + ((0, 0),) * (batch.ndim - 1))
+        out = fn(batch)
+        outs.append(out[:batch_size - pad] if pad else out)
+    return jnp.concatenate(outs, axis=0)
+
+
+def linear_probe(
+    train_feats: jax.Array,
+    train_labels: jax.Array,
+    test_feats: jax.Array,
+    test_labels: jax.Array,
+    num_classes: int,
+    steps: int = 500,
+    learning_rate: float = 1e-2,
+    weight_decay: float = 1e-4,
+    key: jax.Array | None = None,
+) -> dict:
+    """Train a linear classifier on frozen features; return accuracies.
+
+    Full-batch adam inside one ``lax.scan`` — compiled once, no host loop.
+    Features are standardized (train statistics) for conditioning.
+    """
+    mu = train_feats.mean(axis=0, keepdims=True)
+    sd = train_feats.std(axis=0, keepdims=True) + 1e-6
+    xtr = (train_feats - mu) / sd
+    xte = (test_feats - mu) / sd
+
+    f = xtr.shape[-1]
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    w0 = jax.random.normal(key, (f, num_classes)) * 0.01
+    b0 = jnp.zeros((num_classes,))
+    tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+
+    def loss_fn(params):
+        logits = xtr @ params[0] + params[1]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, train_labels).mean()
+
+    @jax.jit
+    def run(params):
+        opt_state = tx.init(params)
+
+        def step(carry, _):
+            params, opt_state = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        (params, _), losses = jax.lax.scan(step, (params, opt_state), None,
+                                           length=steps)
+        return params, losses
+
+    params, losses = run((w0, b0))
+
+    def acc(x, y):
+        return float(jnp.mean(jnp.argmax(x @ params[0] + params[1], -1) == y))
+
+    return {
+        "train_accuracy": acc(xtr, train_labels),
+        "test_accuracy": acc(xte, test_labels),
+        "final_loss": float(losses[-1]),
+    }
+
+
+def knn_accuracy(
+    train_feats: jax.Array,
+    train_labels: jax.Array,
+    test_feats: jax.Array,
+    test_labels: jax.Array,
+    k: int = 20,
+    temperature: float = 0.07,
+) -> float:
+    """Weighted-kNN top-1 (the standard SSL monitor; cosine similarity,
+    exp(s/T)-weighted votes over the k nearest train features)."""
+    num_classes = int(train_labels.max()) + 1  # static for the jit below
+
+    def norm(x):
+        return x / jnp.maximum(
+            jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+
+    @jax.jit
+    def run():
+        sims = norm(test_feats) @ norm(train_feats).T      # (Nte, Ntr)
+        top_s, top_i = jax.lax.top_k(sims, k)
+        votes = jax.nn.one_hot(train_labels[top_i], num_classes)
+        w = jnp.exp(top_s / temperature)[..., None]
+        scores = jnp.sum(votes * w, axis=1)
+        return jnp.mean(jnp.argmax(scores, -1) == test_labels)
+
+    return float(run())

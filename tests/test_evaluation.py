@@ -1,0 +1,108 @@
+"""SSL evaluation protocol: linear probe and kNN on frozen features."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ntxent_tpu.training.evaluation import (
+    extract_features,
+    knn_accuracy,
+    linear_probe,
+)
+
+
+def separable_features(center_key, draw_key, n_per_class=64, classes=4,
+                       dim=16, spread=0.3):
+    """Gaussian blobs: linearly separable by construction.
+
+    ``center_key`` fixes the class centers; ``draw_key`` varies the samples —
+    so train and test sets share geometry but not points.
+    """
+    centers = jax.random.normal(center_key, (classes, dim)) * 2.0
+    draw_keys = jax.random.split(draw_key, classes)
+    feats, labels = [], []
+    for c in range(classes):
+        f = centers[c] + spread * jax.random.normal(draw_keys[c],
+                                                    (n_per_class, dim))
+        feats.append(f)
+        labels.append(jnp.full((n_per_class,), c, jnp.int32))
+    return jnp.concatenate(feats), jnp.concatenate(labels)
+
+
+@pytest.fixture()
+def blobs(rng):
+    kc, ktr, kte, kp = jax.random.split(rng, 4)
+    xtr, ytr = separable_features(kc, ktr)
+    xte, yte = separable_features(kc, kte)  # same centers, disjoint draws
+    assert not np.allclose(np.asarray(xtr), np.asarray(xte))
+    perm = jax.random.permutation(kp, xtr.shape[0])
+    return xtr[perm], ytr[perm], xte, yte
+
+
+def test_linear_probe_learns_separable(blobs):
+    xtr, ytr, xte, yte = blobs
+    res = linear_probe(xtr, ytr, xte, yte, num_classes=4, steps=300)
+    assert res["train_accuracy"] > 0.95
+    assert res["test_accuracy"] > 0.9
+    assert np.isfinite(res["final_loss"])
+
+
+def test_knn_accuracy_separable(blobs):
+    xtr, ytr, xte, yte = blobs
+    acc = knn_accuracy(xtr, ytr, xte, yte, k=10)
+    assert acc > 0.9
+
+
+def test_knn_chance_on_random_labels(rng):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    xtr = jax.random.normal(k1, (128, 16))
+    ytr = jax.random.randint(k2, (128,), 0, 4)
+    xte = jax.random.normal(k3, (64, 16))
+    yte = jax.random.randint(jax.random.fold_in(k3, 1), (64,), 0, 4)
+    acc = knn_accuracy(xtr, ytr, xte, yte, k=10)
+    assert acc < 0.6  # near chance (0.25), certainly far from separable
+
+def test_extract_features_batched_matches_direct(rng):
+    """Padding of the tail partial batch must not change the features."""
+    import flax.linen as nn
+
+    class Enc(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(8)(x.reshape(x.shape[0], -1))
+
+    model = Enc()
+    images = jax.random.uniform(rng, (70, 8, 8, 3))  # 70 % 32 != 0
+    variables = model.init(jax.random.PRNGKey(0), images[:1])
+    apply = lambda x: model.apply(variables, x)  # noqa: E731
+    feats = extract_features(apply, images, batch_size=32)
+    direct = apply(images)
+    assert feats.shape == (70, 8)
+    np.testing.assert_allclose(np.asarray(feats), np.asarray(direct),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_linear_probe_end_to_end_with_encoder(rng):
+    """Probe through a real (untrained) tiny encoder's features."""
+    import functools as ft
+
+    from ntxent_tpu.models import ResNet, SimCLRModel
+
+    enc = ft.partial(ResNet, stage_sizes=(1, 1), small_images=True,
+                     dtype=jnp.float32)
+    model = SimCLRModel(encoder=enc, proj_hidden_dim=32, proj_dim=16,
+                        dtype=jnp.float32)
+    variables = model.init(rng, jnp.zeros((1, 32, 32, 3)), train=False)
+    images = jax.random.uniform(rng, (48, 32, 32, 3))
+    labels = jnp.arange(48) % 3
+
+    feats = extract_features(
+        lambda x: model.apply(variables, x, train=False, method="features"),
+        images, batch_size=16)
+    assert feats.ndim == 2 and feats.shape[0] == 48
+    res = linear_probe(feats, labels, feats, labels, num_classes=3, steps=50)
+    assert np.isfinite(res["final_loss"])

@@ -14,7 +14,6 @@ import jax
 
 torch = pytest.importorskip("torch")
 
-from conftest import make_embeddings  # noqa: E402
 from ntxent_tpu import api  # noqa: E402
 from ntxent_tpu.ops.oracle import ntxent_loss  # noqa: E402
 from ntxent_tpu.torch_compat import NTXentLoss, ntxent_loss_torch  # noqa: E402
