@@ -116,9 +116,53 @@ def run_stability(results: dict):
     results["stability_pass"] = ok
 
 
+def run_distributed(quick: bool, results: dict):
+    """All-gather vs ring loss on the available device mesh.
+
+    On one device this measures kernel overheads only; on a real multi-chip
+    mesh it compares the gather-everything path against the O(N/P)-memory
+    ring (per-hop neighbor ICI traffic) at growing global batch.
+    """
+    import jax.numpy as jnp
+
+    from ntxent_tpu.parallel import (
+        create_mesh,
+        make_ring_ntxent,
+        make_sharded_ntxent,
+    )
+    from ntxent_tpu.training.trainer import shard_batch
+
+    n_dev = jax.device_count()
+    mesh = create_mesh(axis_names=("data",))
+    per_dev = [128, 512] if quick else [128, 512, 2048]
+    runs = 5 if quick else 20
+    print(f"\n=== distributed loss: all-gather vs ring on {n_dev} device(s) "
+          f"===")
+    print(f"{'N/dev':>8} {'global N':>9} {'gather ms':>10} {'ring ms':>9}")
+    for n in per_dev:
+        key = jax.random.PRNGKey(0)
+        z1 = jax.random.normal(key, (n * n_dev, 64))
+        z2 = jax.random.normal(jax.random.fold_in(key, 1), (n * n_dev, 64))
+        z1 = z1 / jnp.linalg.norm(z1, axis=1, keepdims=True)
+        z2 = z2 / jnp.linalg.norm(z2, axis=1, keepdims=True)
+        z1s, z2s = shard_batch((z1, z2), mesh)
+        gather = jax.jit(make_sharded_ntxent(mesh))
+        ring = jax.jit(make_ring_ntxent(mesh))
+        rg = time_fn(gather, z1s, z2s, warmup=2, runs=runs)
+        rr = time_fn(ring, z1s, z2s, warmup=2, runs=runs)
+        print(f"{n:>8} {2 * n * n_dev:>9} {rg.mean_ms:>10.3f} "
+              f"{rr.mean_ms:>9.3f}")
+        results.setdefault("distributed", []).append({
+            "per_device_n": n, "devices": n_dev,
+            "allgather": rg.as_dict(), "ring": rr.as_dict()})
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true", help="CI-sized grids")
+    parser.add_argument("--distributed", action="store_true",
+                        help="also benchmark all-gather vs ring losses over "
+                             "the device mesh")
     parser.add_argument("--out", default="benchmark_results")
     args = parser.parse_args()
 
@@ -134,6 +178,8 @@ def main():
     run_cpp_grid(args.quick, results, tracker)
     run_py_grid(args.quick, results, tracker)
     run_stability(results)
+    if args.distributed:
+        run_distributed(args.quick, results)
 
     out_dir = Path(args.out)
     out_dir.mkdir(exist_ok=True)

@@ -72,14 +72,16 @@ def linear_probe(
     b0 = jnp.zeros((num_classes,))
     tx = optax.adamw(learning_rate, weight_decay=weight_decay)
 
-    def loss_fn(params):
-        logits = xtr @ params[0] + params[1]
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, train_labels).mean()
-
+    # Features/labels enter as jit ARGUMENTS: closure constants would bake
+    # the train matrix into the executable and defeat the jit cache.
     @jax.jit
-    def run(params):
+    def run(params, x, y):
         opt_state = tx.init(params)
+
+        def loss_fn(params):
+            logits = x @ params[0] + params[1]
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
 
         def step(carry, _):
             params, opt_state = carry
@@ -91,7 +93,7 @@ def linear_probe(
                                            length=steps)
         return params, losses
 
-    params, losses = run((w0, b0))
+    params, losses = run((w0, b0), xtr, train_labels)
 
     def acc(x, y):
         return float(jnp.mean(jnp.argmax(x @ params[0] + params[1], -1) == y))
@@ -120,12 +122,12 @@ def knn_accuracy(
             jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
 
     @jax.jit
-    def run():
-        sims = norm(test_feats) @ norm(train_feats).T      # (Nte, Ntr)
+    def run(xtr, ytr, xte, yte):  # arrays as args: cacheable, not constants
+        sims = norm(xte) @ norm(xtr).T                     # (Nte, Ntr)
         top_s, top_i = jax.lax.top_k(sims, k)
-        votes = jax.nn.one_hot(train_labels[top_i], num_classes)
+        votes = jax.nn.one_hot(ytr[top_i], num_classes)
         w = jnp.exp(top_s / temperature)[..., None]
         scores = jnp.sum(votes * w, axis=1)
-        return jnp.mean(jnp.argmax(scores, -1) == test_labels)
+        return jnp.mean(jnp.argmax(scores, -1) == yte)
 
-    return float(run())
+    return float(run(train_feats, train_labels, test_feats, test_labels))

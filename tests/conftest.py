@@ -10,18 +10,23 @@ tests run unchanged on a real ICI mesh.
 
 import os
 
+# One suite, every backend (SURVEY.md §4): default is the 8-device virtual
+# CPU mesh; NTXENT_TEST_PLATFORM=tpu runs the same tests on real hardware
+# (single-chip kernels compile natively; mesh tests need >= 8 chips or skip).
+_PLATFORM = os.environ.get("NTXENT_TEST_PLATFORM", "cpu")
+
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
+if _PLATFORM == "cpu" and "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORMS"] = _PLATFORM
 
 import jax  # noqa: E402  (import after env setup)
 
 # A site plugin may have forced another platform at interpreter startup
-# (jax_platforms config wins over the env var) — force CPU back for tests.
-jax.config.update("jax_platforms", "cpu")
+# (jax_platforms config wins over the env var) — force it back for tests.
+jax.config.update("jax_platforms", _PLATFORM)
 
 import jax.numpy as jnp  # noqa: E402
 import pytest  # noqa: E402

@@ -28,6 +28,11 @@ from ntxent_tpu.parallel import (
 
 from conftest import make_embeddings
 
+# The mesh tests assume the conftest's 8-device virtual CPU mesh; on real
+# hardware (NTXENT_TEST_PLATFORM=tpu) skip unless the host has 8+ chips.
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs an 8-device mesh")
+
 
 @pytest.fixture(scope="module")
 def mesh():
@@ -44,7 +49,7 @@ def oracle_global_loss(z1, z2, t=0.07):
 
 
 def test_mesh_has_8_devices(mesh):
-    assert mesh.shape["data"] == 8
+    assert mesh.shape["data"] == jax.device_count()
 
 
 def test_distributed_loss_matches_oracle(rng, mesh):
@@ -114,14 +119,15 @@ def test_local_row_gids_cover_global_range(mesh):
 
     n_local = 4
     gids = jax.shard_map(
-        lambda: local_row_gids("data", n_local, 8).reshape(1, -1),
+        lambda: local_row_gids("data", n_local, jax.device_count()).reshape(1, -1),
         mesh=mesh, in_specs=(), out_specs=P("data"),
     )()
     flat = np.sort(np.asarray(gids).ravel())
-    np.testing.assert_array_equal(flat, np.arange(2 * n_local * 8))
+    np.testing.assert_array_equal(
+        flat, np.arange(2 * n_local * jax.device_count()))
 
 
 def test_process_info_single_host():
     info = process_info()
     assert info["process_count"] == 1
-    assert info["global_device_count"] == 8
+    assert info["global_device_count"] == jax.device_count()

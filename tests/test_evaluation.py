@@ -1,7 +1,5 @@
 """SSL evaluation protocol: linear probe and kNN on frozen features."""
 
-import functools
-
 import numpy as np
 import pytest
 
@@ -65,6 +63,7 @@ def test_knn_chance_on_random_labels(rng):
     yte = jax.random.randint(jax.random.fold_in(k3, 1), (64,), 0, 4)
     acc = knn_accuracy(xtr, ytr, xte, yte, k=10)
     assert acc < 0.6  # near chance (0.25), certainly far from separable
+
 
 def test_extract_features_batched_matches_direct(rng):
     """Padding of the tail partial batch must not change the features."""
