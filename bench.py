@@ -22,6 +22,7 @@ WARMUP, RUNS = 10, 100
 
 
 def main() -> None:
+    from ntxent_tpu.ops.autotune import autotune_blocks
     from ntxent_tpu.ops.ntxent_pallas import ntxent_loss_fused
     from ntxent_tpu.utils.profiling import time_fn
 
@@ -29,8 +30,13 @@ def main() -> None:
     z = jax.random.normal(key, (ROWS, DIM), jnp.float32)
     z = z / jnp.linalg.norm(z, axis=-1, keepdims=True)
 
+    # Measurement-based tile selection on the live chip (falls back to the
+    # static heuristic off-TPU); the timed run then uses the winning tile.
+    br, bc = autotune_blocks(ROWS, ROWS, DIM, warmup=2, runs=10)
+
     fwd_bwd = jax.jit(jax.value_and_grad(
-        lambda zz: ntxent_loss_fused(zz, TEMPERATURE)))
+        lambda zz: ntxent_loss_fused(zz, TEMPERATURE,
+                                     block_rows=br, block_cols=bc)))
     result = time_fn(fwd_bwd, z, warmup=WARMUP, runs=RUNS)
 
     print(json.dumps({

@@ -1,4 +1,5 @@
 from ntxent_tpu.ops import oracle
+from ntxent_tpu.ops.autotune import autotune_blocks
 from ntxent_tpu.ops.blocks import choose_blocks
 from ntxent_tpu.ops.infonce_pallas import info_nce_fused, info_nce_partial_fused
 from ntxent_tpu.ops.ntxent_pallas import (
@@ -10,6 +11,7 @@ from ntxent_tpu.ops.ntxent_pallas import (
 __all__ = [
     "oracle",
     "choose_blocks",
+    "autotune_blocks",
     "ntxent_loss_fused",
     "ntxent_loss_and_lse",
     "ntxent_partial_fused",

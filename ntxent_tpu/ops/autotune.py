@@ -1,0 +1,94 @@
+"""Measurement-based block autotuning for the fused loss kernels.
+
+The static heuristic (blocks.choose_blocks) picks safe VMEM-fitting tiles;
+this module refines it the way the hardware actually votes: time a small
+candidate grid of (block_rows, block_cols) on the live device and cache the
+winner per (rows, cols, dim, dtype, backend). The role the reference gave
+``get_optimal_block_size`` (/root/reference/include/ntxent_kernel.cuh:80-96)
+— a static occupancy formula — done by measurement, which is the only thing
+that survives hardware generations.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.profiling import time_fn
+from .blocks import VMEM_BUDGET_BYTES, _working_set_bytes, round_up
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["autotune_blocks", "clear_cache"]
+
+_CACHE: dict[tuple, tuple[int, int]] = {}
+
+_ROW_CANDIDATES = (64, 128, 256, 512)
+_COL_CANDIDATES = (128, 256, 512, 1024)
+
+
+def clear_cache() -> None:
+    _CACHE.clear()
+
+
+def _candidates(rows: int, cols: int, dim: int, itemsize: int):
+    for br in _ROW_CANDIDATES:
+        if br > round_up(rows, 8):
+            continue
+        for bc in _COL_CANDIDATES:
+            if bc > round_up(cols, 128):
+                continue
+            if _working_set_bytes(br, bc, dim, itemsize) > VMEM_BUDGET_BYTES:
+                continue
+            yield br, bc
+
+
+def autotune_blocks(
+    rows: int,
+    cols: int,
+    dim: int,
+    dtype=jnp.float32,
+    *,
+    include_backward: bool = True,
+    warmup: int = 2,
+    runs: int = 5,
+) -> tuple[int, int]:
+    """Time the candidate grid on the live device; return the fastest tile.
+
+    Results are cached per shape/dtype/backend for the process lifetime.
+    Falls back to the static heuristic when nothing can be measured (e.g.
+    interpret mode on CPU, where timing votes are meaningless anyway).
+    """
+    from .blocks import choose_blocks
+    from .ntxent_pallas import ntxent_loss_fused
+
+    key = (rows, cols, dim, jnp.dtype(dtype).str, jax.default_backend())
+    if key in _CACHE:
+        return _CACHE[key]
+    if jax.default_backend() not in ("tpu", "axon"):
+        return choose_blocks(rows, cols, dim, dtype)
+
+    z = jax.random.normal(jax.random.PRNGKey(0), (rows, dim), jnp.float32)
+    z = (z / jnp.linalg.norm(z, axis=-1, keepdims=True)).astype(dtype)
+
+    best, best_ms = None, float("inf")
+    for br, bc in _candidates(rows, cols, dim, jnp.dtype(dtype).itemsize):
+        def loss(zz, _br=br, _bc=bc):
+            return ntxent_loss_fused(zz, 0.07, block_rows=_br, block_cols=_bc)
+
+        fn = jax.jit(jax.value_and_grad(loss)) if include_backward \
+            else jax.jit(loss)
+        try:
+            r = time_fn(fn, z, warmup=warmup, runs=runs)
+        except Exception as e:  # candidate failed to compile/fit: skip it
+            logger.debug("autotune candidate (%d, %d) failed: %s", br, bc, e)
+            continue
+        logger.info("autotune (%d, %d): %.4f ms", br, bc, r.mean_ms)
+        if r.mean_ms < best_ms:
+            best, best_ms = (br, bc), r.mean_ms
+    if best is None:
+        best = choose_blocks(rows, cols, dim, dtype)
+    _CACHE[key] = best
+    return best

@@ -7,7 +7,12 @@ from ntxent_tpu.utils.capability import (
 )
 from ntxent_tpu.utils.logging_utils import setup_logging
 from ntxent_tpu.utils.memory import DeviceMemoryTracker, device_memory_mb
-from ntxent_tpu.utils.profiling import BenchmarkResults, time_fn, trace
+from ntxent_tpu.utils.profiling import (
+    BenchmarkResults,
+    measured_flops,
+    time_fn,
+    trace,
+)
 
 __all__ = [
     "check_tensor_core_support",
@@ -19,6 +24,7 @@ __all__ = [
     "DeviceMemoryTracker",
     "device_memory_mb",
     "BenchmarkResults",
+    "measured_flops",
     "time_fn",
     "trace",
 ]

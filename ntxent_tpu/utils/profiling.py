@@ -15,7 +15,7 @@ from dataclasses import dataclass, asdict
 
 import jax
 
-__all__ = ["BenchmarkResults", "time_fn", "trace"]
+__all__ = ["BenchmarkResults", "time_fn", "trace", "measured_flops"]
 
 
 @dataclass
@@ -51,6 +51,23 @@ def time_fn(fn, *args, warmup: int = 10, runs: int = 100) -> BenchmarkResults:
         min_ms=min(times_ms),
         max_ms=max(times_ms),
     )
+
+
+def measured_flops(fn, *args) -> float | None:
+    """FLOPs of one ``fn(*args)`` call from XLA's compiled cost analysis.
+
+    The honest input to MFU accounting (trainer.estimate_mfu): analytic
+    per-model FLOP formulas drift as architectures change; the compiler's
+    own count does not. Returns None when the backend provides no analysis.
+    """
+    try:
+        compiled = jax.jit(fn).lower(*args).compile()
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, list):  # some backends wrap it in a list
+            analysis = analysis[0]
+        return float(analysis["flops"])
+    except Exception:  # no analysis on this backend/version
+        return None
 
 
 @contextlib.contextmanager

@@ -1,0 +1,20 @@
+"""Autotuner: candidate filtering, cache, CPU fallback."""
+import jax
+import jax.numpy as jnp
+
+from ntxent_tpu.ops.autotune import _candidates, autotune_blocks, clear_cache, _CACHE
+from ntxent_tpu.ops.blocks import choose_blocks
+
+
+def test_cpu_falls_back_to_heuristic():
+    clear_cache()
+    got = autotune_blocks(4096, 4096, 128)
+    assert got == choose_blocks(4096, 4096, 128)
+
+
+def test_candidates_respect_vmem_and_shape():
+    cands = list(_candidates(512, 512, 128, 4))
+    assert cands, "no candidates for a plain shape"
+    assert all(br <= 512 and bc <= 512 for br, bc in cands)
+    small = list(_candidates(64, 128, 32, 4))
+    assert all(br <= 64 and bc <= 128 for br, bc in small)
