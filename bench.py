@@ -1,6 +1,6 @@
 """Headline benchmark: fused NT-Xent forward+backward at 4096x128.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 Baseline target (BASELINE.json north star): < 2 ms/step fwd+bwd at
 N x D = 4096 x 128; vs_baseline = target_ms / measured_ms (>1 beats it).
 
@@ -8,44 +8,197 @@ Protocol mirrors the reference harnesses: warmup then timed runs with a
 device sync per iteration (src/benchmark.cpp:25-39 used warmup 1 + 100 runs
 with cudaDeviceSynchronize; python/test.py:97-121 used warmup 10 + 100 runs)
 — here jax.block_until_ready plays the sync role.
+
+Robustness contract (this script runs unattended as the round's one
+driver-visible deliverable, so it must never hang and never emit
+unparseable output):
+
+* The parent process imports no JAX. All device work happens in a child
+  subprocess with a hard wall-clock timeout; a wedged TPU runtime is killed,
+  not waited on.
+* One retry on child failure — TPU backend init is observably flaky here
+  (round 1: "Unable to initialize backend 'axon'").
+* Interpret-mode timing is refused: off-accelerator the child times the
+  compiled XLA oracle instead of the Pallas kernel (interpret-mode Pallas at
+  4096x128 runs for minutes and measures nothing about the hardware), and
+  the emitted record says which path was timed.
+* Autotuning is wall-time-bounded (ops/autotune.py budget_s) and its winner
+  is persisted per device kind, so a tuned tile is reused across runs.
+* On total failure the parent still prints the JSON line, with value -1.0
+  and an "error" field — parseable by construction.
 """
 
-import json
+from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
 
 TARGET_MS = 2.0
 ROWS, DIM = 4096, 128
 TEMPERATURE = 0.07
 WARMUP, RUNS = 10, 100
+METRIC = f"ntxent_fused_fwd_bwd_ms_{ROWS}x{DIM}"
+UNIT = "ms"
+SENTINEL = "NTXENT_BENCH_RESULT:"
+CHILD_TIMEOUT_S = float(os.environ.get("NTXENT_BENCH_TIMEOUT_S", "420"))
+AUTOTUNE_BUDGET_S = float(os.environ.get("NTXENT_AUTOTUNE_BUDGET_S", "120"))
 
 
-def main() -> None:
-    from ntxent_tpu.ops.autotune import autotune_blocks
-    from ntxent_tpu.ops.ntxent_pallas import ntxent_loss_fused
-    from ntxent_tpu.utils.profiling import time_fn
+def _child() -> None:
+    """Measure in-process and print a SENTINEL-prefixed JSON payload."""
+    import jax
+
+    if os.environ.get("NTXENT_BENCH_FORCE_CPU") == "1":
+        # A site plugin may pin jax_platforms to an accelerator at
+        # interpreter startup, WINNING over the JAX_PLATFORMS env var — the
+        # config update is the only override that sticks (and it must land
+        # before any backend initializes).
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    device_kind = jax.local_devices()[0].device_kind
 
     key = jax.random.PRNGKey(0)
     z = jax.random.normal(key, (ROWS, DIM), jnp.float32)
     z = z / jnp.linalg.norm(z, axis=-1, keepdims=True)
 
-    # Measurement-based tile selection on the live chip (falls back to the
-    # static heuristic off-TPU); the timed run then uses the winning tile.
-    br, bc = autotune_blocks(ROWS, ROWS, DIM, warmup=2, runs=10)
+    if backend in ("tpu", "axon"):
+        from ntxent_tpu.ops.autotune import autotune_blocks
+        from ntxent_tpu.ops.ntxent_pallas import ntxent_loss_fused
 
-    fwd_bwd = jax.jit(jax.value_and_grad(
-        lambda zz: ntxent_loss_fused(zz, TEMPERATURE,
-                                     block_rows=br, block_cols=bc)))
-    result = time_fn(fwd_bwd, z, warmup=WARMUP, runs=RUNS)
+        br, bc = autotune_blocks(ROWS, ROWS, DIM,
+                                 budget_s=AUTOTUNE_BUDGET_S)
 
-    print(json.dumps({
-        "metric": f"ntxent_fused_fwd_bwd_ms_{ROWS}x{DIM}",
-        "value": round(result.mean_ms, 4),
-        "unit": "ms",
-        "vs_baseline": round(TARGET_MS / result.mean_ms, 3),
-    }))
+        def loss_fn(zz):
+            return ntxent_loss_fused(zz, TEMPERATURE,
+                                     block_rows=br, block_cols=bc)
+
+        extra = {"path": "pallas_fused", "block_rows": br, "block_cols": bc}
+    else:
+        # Off-accelerator the Pallas kernel would run in interpret mode —
+        # minutes per iteration, measuring nothing. Time the compiled XLA
+        # oracle instead and say so in the record.
+        from ntxent_tpu.ops.oracle import ntxent_loss
+
+        def loss_fn(zz):
+            return ntxent_loss(zz, TEMPERATURE)
+
+        extra = {"path": "xla_oracle_cpu_fallback"}
+
+    from ntxent_tpu.utils.profiling import time_fn
+
+    fwd_bwd = jax.jit(jax.value_and_grad(loss_fn))
+    # The CPU fallback is a liveness indicator, not a perf claim — don't
+    # spend 100 runs x ~1s/iter of host matmuls on it.
+    warmup, runs = (WARMUP, RUNS) if backend in ("tpu", "axon") else (3, 15)
+    result = time_fn(fwd_bwd, z, warmup=warmup, runs=runs)
+    payload = {
+        "backend": backend,
+        "device_kind": device_kind,
+        **result.as_dict(),
+        **extra,
+    }
+    print(SENTINEL + json.dumps(payload), flush=True)
+
+
+def _probe_backend(timeout_s: float = 150.0) -> str | None:
+    """Backend name the ambient config initializes to, probed in a
+    disposable subprocess (backend init can wedge indefinitely here —
+    observed both in round 1 and this session — so never init in a process
+    whose output we depend on)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout_s)
+        if proc.returncode == 0 and proc.stdout.strip():
+            return proc.stdout.strip().splitlines()[-1]
+    except (subprocess.TimeoutExpired, OSError):
+        pass
+    return None
+
+
+def _run_child(timeout_s: float,
+               force_cpu: bool = False) -> tuple[dict | None, str]:
+    """Run the measurement subprocess; return (payload, diagnostic_tail)."""
+    env = dict(os.environ)
+    if force_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["NTXENT_BENCH_FORCE_CPU"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+        return None, f"child timed out after {timeout_s:.0f}s (killed)"
+    except OSError as e:
+        return None, f"failed to spawn child: {e}"
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith(SENTINEL):
+            try:
+                return json.loads(line[len(SENTINEL):]), ""
+            except ValueError as e:
+                return None, f"unparseable child payload: {e}"
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
+    return None, f"child rc={proc.returncode}: " + " | ".join(tail)
+
+
+def main() -> None:
+    backend = _probe_backend()
+    diag = ""
+    payload = None
+    if backend in ("tpu", "axon"):
+        payload, diag = _run_child(CHILD_TIMEOUT_S)
+        if payload is None:
+            # One retry: backend init is flaky (round-1 failure mode). A
+            # fresh process re-attempts the TPU tunnel from scratch.
+            time.sleep(5.0)
+            payload, diag2 = _run_child(CHILD_TIMEOUT_S)
+            if payload is None:
+                diag = f"{diag}; retry: {diag2}"
+    else:
+        diag = f"accelerator probe found backend={backend!r}"
+    if payload is None:
+        # Last resort: forced-CPU child (cannot hang in accelerator init) so
+        # the emitted record still carries a measured liveness number.
+        payload, diag3 = _run_child(CHILD_TIMEOUT_S, force_cpu=True)
+        if payload is not None:
+            payload["error"] = f"accelerator path unavailable ({diag})"
+        else:
+            diag = f"{diag}; cpu fallback: {diag3}"
+
+    if payload is not None:
+        mean_ms = payload.pop("mean_ms")
+        record = {
+            "metric": METRIC,
+            "value": round(mean_ms, 4),
+            "unit": UNIT,
+            "vs_baseline": round(TARGET_MS / mean_ms, 3),
+            **{k: (round(v, 4) if isinstance(v, float) else v)
+               for k, v in payload.items()},
+        }
+    else:
+        record = {
+            "metric": METRIC,
+            "value": -1.0,
+            "unit": UNIT,
+            "vs_baseline": 0.0,
+            "error": diag,
+        }
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
-    main()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--child", action="store_true",
+                        help="internal: run the measurement in-process")
+    if parser.parse_args().child:
+        _child()
+    else:
+        main()

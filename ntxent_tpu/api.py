@@ -46,9 +46,12 @@ def _is_torch(x) -> bool:
 def _from_torch(x) -> jax.Array:
     # Lazy import is safe: this branch only runs on torch-typed input, by
     # which point torch itself is already loaded (see _is_torch).
+    # copy=True: callers of the reference-shaped API own their tensors and
+    # may mutate them in place after the call; zero-copy dlpack + async JAX
+    # dispatch would make that mutation visible to the pending computation.
     from .torch_compat import to_jax
 
-    return to_jax(x)
+    return to_jax(x, copy=True)
 
 
 def _to_torch(x: jax.Array):

@@ -29,9 +29,17 @@ from .ops.oracle import ntxent_loss
 __all__ = ["NTXentLoss", "ntxent_loss_torch", "to_jax", "to_torch"]
 
 
-def to_jax(t: torch.Tensor) -> jax.Array:
+def to_jax(t: torch.Tensor, copy: bool = False) -> jax.Array:
     """torch -> jax; dlpack zero-copy when possible, else via numpy
-    (routing bf16 — which torch cannot hand to numpy — through float32)."""
+    (routing bf16 — which torch cannot hand to numpy — through float32).
+
+    ``copy=True`` clones the tensor first: zero-copy dlpack aliases the
+    caller's storage, and JAX's async dispatch may read it after this call
+    returns — a later in-place mutation by the caller would then be observed.
+    API boundaries that don't control the caller should pass copy=True.
+    """
+    if copy:
+        t = t.detach().clone()
     try:
         return jnp.from_dlpack(t.detach().contiguous())
     except Exception:
@@ -44,12 +52,14 @@ def to_jax(t: torch.Tensor) -> jax.Array:
 
 def to_torch(x: jax.Array) -> torch.Tensor:
     """jax -> torch; dlpack when torch supports the device, else via numpy
-    (upcasting bf16, which numpy-for-torch cannot represent)."""
+    (round-tripping bf16, which numpy-for-torch cannot represent, through
+    float32 and casting back so the output dtype matches the input's)."""
     try:
         return torch.from_dlpack(x)
     except Exception:
         if x.dtype == jnp.bfloat16:
-            x = x.astype(jnp.float32)
+            return torch.from_numpy(
+                np.asarray(x.astype(jnp.float32))).to(torch.bfloat16)
         return torch.from_numpy(np.asarray(x))
 
 

@@ -10,6 +10,16 @@ from ntxent_tpu.training.data import (
     synthetic_images,
     two_view_iterator,
 )
+from ntxent_tpu.training.datasets import (
+    ArraySource,
+    Cifar10Source,
+    ImageFolderSource,
+    StreamingLoader,
+    TwoViewPipeline,
+    device_prefetch,
+    grain_loader,
+    streaming_two_view_iterator,
+)
 from ntxent_tpu.training.lars import (
     cosine_warmup_schedule,
     create_lars,
@@ -38,6 +48,14 @@ __all__ = [
     "PrefetchIterator",
     "synthetic_images",
     "two_view_iterator",
+    "ArraySource",
+    "Cifar10Source",
+    "ImageFolderSource",
+    "StreamingLoader",
+    "TwoViewPipeline",
+    "device_prefetch",
+    "grain_loader",
+    "streaming_two_view_iterator",
     "cosine_warmup_schedule",
     "create_lars",
     "simclr_learning_rate",

@@ -32,20 +32,49 @@ class CheckpointManager:
             ),
         )
 
-    def save(self, step: int, state: Any, force: bool = False) -> bool:
-        saved = self.manager.save(
-            step, args=ocp.args.StandardSave(state), force=force)
+    def save(self, step: int, state: Any, force: bool = False,
+             data_state: dict | None = None) -> bool:
+        """Save the TrainState, optionally with input-pipeline state.
+
+        ``data_state`` (a small JSON-able dict, e.g. StreamingLoader.state())
+        rides along as a composite item so resume can reposition the data
+        iterator exactly instead of replaying host batches.
+        """
+        if data_state is not None:
+            args: Any = ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                data_state=ocp.args.JsonSave(data_state))
+        else:
+            args = ocp.args.StandardSave(state)
+        saved = self.manager.save(step, args=args, force=force)
         if saved:
             logger.info("checkpoint saved at step %d -> %s", step,
                         self.directory)
         return saved
 
     def restore(self, state_template: Any, step: int | None = None) -> Any:
+        state, _ = self.restore_with_data_state(state_template, step)
+        return state
+
+    def restore_with_data_state(
+            self, state_template: Any,
+            step: int | None = None) -> tuple[Any, dict | None]:
+        """(state, data_state-or-None); handles both checkpoint layouts
+        (plain StandardSave and the composite written when data_state was
+        provided)."""
         step = step if step is not None else self.manager.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
-        return self.manager.restore(
-            step, args=ocp.args.StandardRestore(state_template))
+        try:
+            restored = self.manager.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(state_template),
+                    data_state=ocp.args.JsonRestore()))
+            return restored["state"], dict(restored["data_state"])
+        except Exception:
+            return self.manager.restore(
+                step, args=ocp.args.StandardRestore(state_template)), None
 
     def latest_step(self) -> int | None:
         return self.manager.latest_step()

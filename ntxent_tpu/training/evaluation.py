@@ -116,6 +116,9 @@ def knn_accuracy(
     """Weighted-kNN top-1 (the standard SSL monitor; cosine similarity,
     exp(s/T)-weighted votes over the k nearest train features)."""
     num_classes = int(train_labels.max()) + 1  # static for the jit below
+    # top_k over (Nte, Ntr) requires k <= Ntr; clamp rather than surface
+    # lax.top_k's opaque shape error when the train split is tiny.
+    k = min(k, int(train_feats.shape[0]))
 
     def norm(x):
         return x / jnp.maximum(

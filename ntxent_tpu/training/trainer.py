@@ -92,15 +92,28 @@ def _apply_two_views(state: TrainState, params, v1, v2, train: bool = True):
     return z[:n], z[n:], updates["batch_stats"]
 
 
-def make_train_step(temperature: float = 0.1) -> Callable:
-    """Single-device train step: fused Pallas loss, donated state."""
+def make_train_step(temperature: float = 0.1,
+                    use_fused: bool | None = None) -> Callable:
+    """Single-device train step: fused Pallas loss, donated state.
+
+    ``use_fused=None`` auto-selects: the Pallas kernel where it compiles
+    natively (TPU), the jnp oracle elsewhere (identical loss — the tests
+    prove it — but interpret-mode Pallas on CPU is ~100x slower and
+    measures nothing; same policy as api._loss_fn).
+    """
+    if use_fused is None:
+        use_fused = jax.default_backend() in ("tpu", "axon")
+    if use_fused:
+        loss_impl = ntxent_loss_fused
+    else:
+        from ..ops.oracle import ntxent_loss as loss_impl
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, v1, v2):
         def loss_fn(params):
             z1, z2, new_stats = _apply_two_views(state, params, v1, v2)
             z = jnp.concatenate([z1, z2], axis=0)
-            return ntxent_loss_fused(z, temperature), new_stats
+            return loss_impl(z, temperature), new_stats
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
@@ -158,17 +171,54 @@ def shard_batch(batch, mesh: Mesh, axis: str = "data"):
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
+def aot_compile_with_flops(train_step, *args):
+    """(flops-or-None, compiled-or-None): AOT-compile one train step and
+    read XLA's cost analysis off the executable.
+
+    For an SPMD (shard_map/pjit) step the compiled module is the per-device
+    program, so the FLOP count is per-chip — exactly what per-chip MFU
+    accounting wants. Callers should EXECUTE the returned compiled object
+    (it is a plain callable with the jit donation semantics baked in) —
+    lower().compile() does not populate the jit dispatch cache, so calling
+    the original wrapper afterwards would compile a second time.
+    """
+    try:
+        compiled = train_step.lower(*args).compile()
+    except Exception:  # not a jit wrapper / backend refused AOT
+        return None, None
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0]
+        flops: float | None = float(analysis["flops"])
+    except Exception:  # no analysis on this backend/version
+        flops = None
+    return flops, compiled
+
+
+def compiled_step_flops(train_step, *args) -> float | None:
+    """FLOPs of one compiled train step (cost-analysis only; prefer
+    aot_compile_with_flops when you will also run the step)."""
+    return aot_compile_with_flops(train_step, *args)[0]
+
+
 def train_loop(
     state: TrainState,
     data_iter,
     train_step: Callable,
     num_steps: int,
     log_every: int = 50,
-    flops_per_step: float | None = None,
+    flops_per_step: float | str | None = "auto",
     hook: Callable | None = None,
     step_hook: Callable | None = None,
 ):
     """Simple host loop: step, log loss / steps-per-sec / MFU.
+
+    MFU is automatic: with ``flops_per_step="auto"`` (default) the loop asks
+    XLA's compiled cost analysis for the step's per-chip FLOPs on the first
+    batch (BASELINE.json north star: >=50% MFU needs a measurement pathway,
+    not a hand-passed constant). Pass an explicit float to override, or None
+    to disable MFU accounting.
 
     ``hook(state, entry)`` fires at log points; ``step_hook(state)`` fires
     after EVERY step (for periodic side effects keyed on the global
@@ -179,6 +229,14 @@ def train_loop(
     last_t, last_step = t0, 0
     for step in range(1, num_steps + 1):
         v1, v2 = next(data_iter)
+        if step == 1 and flops_per_step == "auto":
+            flops_per_step, compiled = aot_compile_with_flops(
+                train_step, state, v1, v2)
+            if compiled is not None:
+                train_step = compiled  # reuse the executable we just built
+            if flops_per_step is not None:
+                logger.info("compiled step cost: %.3e FLOPs/chip",
+                            flops_per_step)
         state, metrics = train_step(state, v1, v2)
         if step_hook is not None:
             step_hook(state)
@@ -205,7 +263,7 @@ def fit(
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 500,
     log_every: int = 50,
-    flops_per_step: float | None = None,
+    flops_per_step: float | str | None = "auto",
     fast_forward_data: bool = False,
 ):
     """Checkpoint-aware training: restore the latest checkpoint if one
@@ -223,48 +281,65 @@ def fit(
       ``TrainerConfig.accum_steps > 1`` each train step is one micro-batch
       (flax increments ``state.step`` even when MultiSteps skips the
       update), so optimizer updates number ``num_steps / accum_steps``.
-    * The optimizer/model state resumes exactly, but ``data_iter`` restarts
-      wherever the caller's iterator starts. Pass a resume-aware iterator,
-      or set ``fast_forward_data=True`` to consume ``state.step`` batches
-      first (exact for seeded pipelines; costs host+augment time
-      proportional to the skipped steps).
+    * Data-iterator state: when ``data_iter`` exposes ``state()`` /
+      ``restore()`` (e.g. datasets.TwoViewPipeline), its state is saved
+      inside each checkpoint and restored on resume — exact mid-epoch
+      repositioning with zero host replay. Otherwise ``data_iter`` restarts
+      wherever the caller's iterator starts; set ``fast_forward_data=True``
+      to consume ``state.step`` batches first (exact for seeded pipelines;
+      costs host+augment time proportional to the skipped steps).
     """
     manager = None
-    if checkpoint_dir is not None:
-        from .checkpoint import CheckpointManager
+    stateful_data = hasattr(data_iter, "state") \
+        and hasattr(data_iter, "restore")
+    try:
+        if checkpoint_dir is not None:
+            from .checkpoint import CheckpointManager
 
-        manager = CheckpointManager(checkpoint_dir,
-                                    save_interval_steps=checkpoint_every)
-        if manager.latest_step() is not None:
-            state = manager.restore(state)
-            logger.info("resumed from checkpoint at step %d",
-                        int(state.step))
+            manager = CheckpointManager(checkpoint_dir,
+                                        save_interval_steps=checkpoint_every)
+            if manager.latest_step() is not None:
+                state, data_state = manager.restore_with_data_state(state)
+                logger.info("resumed from checkpoint at step %d",
+                            int(state.step))
+                if stateful_data and data_state is not None:
+                    data_iter.restore(data_state)
+                    logger.info("data iterator repositioned: %s", data_state)
+                    fast_forward_data = False  # already exact, skip replay
 
-    done = int(state.step)
-    remaining = num_steps - done
-    if remaining <= 0:
-        logger.info("nothing to do: checkpoint already at step %d", done)
-        return state, []
-    if fast_forward_data:
-        for _ in range(done):
-            next(data_iter)
+        done = int(state.step)
+        remaining = num_steps - done
+        if remaining <= 0:
+            logger.info("nothing to do: checkpoint already at step %d", done)
+            return state, []
+        if fast_forward_data:
+            for _ in range(done):
+                next(data_iter)
 
-    def step_hook(s):
-        # Every step; orbax's FixedIntervalPolicy filters to global steps
-        # divisible by checkpoint_every (a resumed run keeps the cadence).
+        def step_hook(s):
+            # Every step; orbax's FixedIntervalPolicy filters to global steps
+            # divisible by checkpoint_every (a resumed run keeps the cadence).
+            if manager is not None:
+                manager.save(int(s.step), s,
+                             data_state=data_iter.state()
+                             if stateful_data else None)
+
+        state, history = train_loop(
+            state, data_iter, train_step, remaining,
+            log_every=log_every,
+            flops_per_step=flops_per_step, step_hook=step_hook)
+        if manager is not None \
+                and manager.latest_step() != int(state.step):
+            manager.save(int(state.step), state, force=True,
+                         data_state=data_iter.state()
+                         if stateful_data else None)
+        return state, history
+    finally:
+        # Always drain + close the manager (its async save machinery holds
+        # background threads), including on the nothing-to-do early return.
         if manager is not None:
-            manager.save(int(s.step), s)
-
-    state, history = train_loop(
-        state, data_iter, train_step, remaining,
-        log_every=log_every,
-        flops_per_step=flops_per_step, step_hook=step_hook)
-    if manager is not None:
-        if manager.latest_step() != int(state.step):  # hook may have saved it
-            manager.save(int(state.step), state, force=True)
-        manager.wait_until_finished()
-        manager.close()
-    return state, history
+            manager.wait_until_finished()
+            manager.close()
 
 
 def peak_flops_per_chip() -> float:

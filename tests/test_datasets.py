@@ -1,0 +1,249 @@
+"""Streaming input pipeline: sources, loader, checkpointable resume.
+
+The reference ships no data code (SURVEY.md §0.2); these tests cover the
+framework's disk-backed loaders (VERDICT r1 missing #3) and the exact
+no-replay resume contract (VERDICT r1 weak #8 / next-round #10)."""
+
+import functools
+import pickle
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ntxent_tpu.training.datasets import (
+    ArraySource,
+    Cifar10Source,
+    ImageFolderSource,
+    StreamingLoader,
+    TwoViewPipeline,
+    device_prefetch,
+    grain_loader,
+)
+
+
+def _write_image_folder(root, classes=("cat", "dog"), per_class=6, size=24):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for c in classes:
+        d = root / c
+        d.mkdir(parents=True)
+        for i in range(per_class):
+            arr = rng.integers(0, 256, (size + 4, size, 3), np.uint8)
+            ext = "jpeg" if i % 2 else "png"
+            Image.fromarray(arr).save(d / f"img_{i}.{ext}")
+    return root
+
+
+def _write_cifar10(root, n_per_batch=10):
+    d = root / "cifar-10-batches-py"
+    d.mkdir(parents=True)
+    rng = np.random.default_rng(1)
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        blob = {
+            b"data": rng.integers(0, 256, (n_per_batch, 3072), np.uint8),
+            b"labels": rng.integers(0, 10, n_per_batch).tolist(),
+        }
+        with open(d / name, "wb") as f:
+            pickle.dump(blob, f)
+    return root
+
+
+class TestSources:
+    def test_image_folder_scan_and_decode(self, tmp_path):
+        src = ImageFolderSource(_write_image_folder(tmp_path / "train"),
+                                image_size=16)
+        assert len(src) == 12
+        assert src.class_names == ["cat", "dog"]
+        img = src[0]
+        assert img.shape == (16, 16, 3) and img.dtype == np.uint8
+        assert src.labels[:6].tolist() == [0] * 6
+
+    def test_cifar10_pickles(self, tmp_path):
+        src = Cifar10Source(_write_cifar10(tmp_path), train=True)
+        assert len(src) == 50
+        assert src[3].shape == (32, 32, 3) and src[3].dtype == np.uint8
+        test = Cifar10Source(tmp_path, train=False)
+        assert len(test) == 10
+
+    def test_cifar10_hwc_transpose(self, tmp_path):
+        # Row-major CHW flattening: first 1024 entries are the R plane.
+        src = Cifar10Source(_write_cifar10(tmp_path), train=False)
+        with open(tmp_path / "cifar-10-batches-py" / "test_batch", "rb") as f:
+            raw = pickle.load(f, encoding="bytes")[b"data"]
+        np.testing.assert_array_equal(src[0][..., 0],
+                                      raw[0][:1024].reshape(32, 32))
+
+    def test_array_source_memmap(self, tmp_path):
+        imgs = np.random.default_rng(2).integers(
+            0, 256, (20, 8, 8, 3), np.uint8)
+        np.save(tmp_path / "imgs.npy", imgs)
+        mm = np.load(tmp_path / "imgs.npy", mmap_mode="r")
+        src = ArraySource(mm)
+        np.testing.assert_array_equal(src[7], imgs[7])
+
+
+class TestStreamingLoader:
+    def _source(self, n=40, size=8):
+        return ArraySource(np.random.default_rng(3).integers(
+            0, 256, (n, size, size, 3), np.uint8))
+
+    def test_batches_and_epoch_coverage(self):
+        loader = StreamingLoader(self._source(), batch_size=8, seed=0,
+                                 num_threads=2)
+        it = iter(loader)
+        seen = [next(it) for _ in range(5)]  # exactly one epoch
+        assert all(b.shape == (8, 8, 8, 3) for b in seen)
+
+    def test_determinism_given_seed(self):
+        src = self._source()
+        a = iter(StreamingLoader(src, 8, seed=5, num_threads=2))
+        b = iter(StreamingLoader(src, 8, seed=5, num_threads=4))
+        for _ in range(7):
+            np.testing.assert_array_equal(next(a), next(b))
+
+    def test_state_restore_mid_epoch(self):
+        src = self._source()
+        full = iter(StreamingLoader(src, 8, seed=9, num_threads=2))
+        expected = [next(full) for _ in range(8)]  # spans epoch boundary
+
+        first = StreamingLoader(src, 8, seed=9, num_threads=2)
+        it = iter(first)
+        for _ in range(3):
+            next(it)
+        st = first.state()
+        assert st == {"epoch": 0, "offset": 3, "seed": 9}
+
+        resumed = StreamingLoader(src, 8, seed=123, num_threads=2)
+        resumed.restore(st)
+        rit = iter(resumed)
+        for k in range(3, 8):
+            np.testing.assert_array_equal(next(rit), expected[k])
+
+    def test_throughput_loader_outruns_step(self):
+        """The north-star property (SURVEY §7.4 risk #1): with read-ahead,
+        the consumer's wait per batch stays well under the step time."""
+        step_ms = 20.0
+        loader = StreamingLoader(self._source(n=160, size=16), batch_size=8,
+                                 num_threads=4, read_ahead=4)
+        it = iter(loader)
+        next(it)  # warm the pool
+        waits = []
+        for _ in range(12):
+            time.sleep(step_ms / 1e3)  # simulated device step
+            t0 = time.perf_counter()
+            next(it)
+            waits.append((time.perf_counter() - t0) * 1e3)
+        # Loader idle-wait must be small vs the step (VERDICT #4 done-when).
+        assert np.mean(waits) < step_ms / 2, f"loader lagging: {waits}"
+
+
+class TestPipelines:
+    def test_two_view_pipeline_shapes_and_range(self):
+        src = ArraySource(np.random.default_rng(4).integers(
+            0, 256, (32, 16, 16, 3), np.uint8))
+        pipe = TwoViewPipeline(StreamingLoader(src, 8, seed=0, num_threads=2),
+                               jax.random.PRNGKey(0), blur=False)
+        v1, v2 = next(pipe)
+        assert v1.shape == v2.shape == (8, 16, 16, 3)
+        assert jnp.issubdtype(v1.dtype, jnp.floating)
+        assert bool(jnp.all(jnp.isfinite(v1))) and bool(
+            jnp.all(jnp.isfinite(v2)))
+
+    def test_two_view_pipeline_resume_matches_uninterrupted(self):
+        src = ArraySource(np.random.default_rng(5).integers(
+            0, 256, (32, 8, 8, 3), np.uint8))
+
+        def make(seed_key=7):
+            return TwoViewPipeline(
+                StreamingLoader(src, 8, seed=1, num_threads=2),
+                jax.random.PRNGKey(seed_key), blur=False)
+
+        ref = make()
+        expected = [next(ref) for _ in range(6)]
+
+        first = make()
+        for _ in range(3):
+            next(first)
+        st = first.state()
+
+        resumed = make()
+        resumed.restore(st)
+        for k in range(3, 6):
+            v1, v2 = next(resumed)
+            np.testing.assert_array_equal(np.asarray(v1),
+                                          np.asarray(expected[k][0]))
+            np.testing.assert_array_equal(np.asarray(v2),
+                                          np.asarray(expected[k][1]))
+
+    def test_device_prefetch_order_preserved(self):
+        batches = [np.full((2, 2), i, np.float32) for i in range(7)]
+        out = list(device_prefetch(iter(batches), depth=3))
+        assert len(out) == 7
+        for i, x in enumerate(out):
+            assert float(np.asarray(x)[0, 0]) == i
+
+    def test_grain_loader_batches(self):
+        pytest.importorskip("grain")
+        src = ArraySource(np.random.default_rng(6).integers(
+            0, 256, (24, 8, 8, 3), np.uint8))
+        it = grain_loader(src, batch_size=8, seed=0, worker_count=0)
+        batch = next(it)
+        assert np.asarray(batch).shape == (8, 8, 8, 3)
+
+
+class TestFitResumeNoReplay:
+    @pytest.mark.slow
+    def test_kill_and_resume_reproduces_loss_curve(self, tmp_path):
+        """VERDICT #10 done-when: kill-and-resume reproduces the
+        uninterrupted loss curve exactly, with no fast_forward replay."""
+        from ntxent_tpu.models import ResNet, SimCLRModel
+        from ntxent_tpu.training import (
+            TrainerConfig,
+            create_train_state,
+            fit,
+            make_train_step,
+        )
+
+        src = ArraySource(np.random.default_rng(8).integers(
+            0, 256, (32, 16, 16, 3), np.uint8))
+        enc = functools.partial(ResNet, stage_sizes=(1,), small_images=True)
+
+        def fresh_state():
+            model = SimCLRModel(encoder=enc, proj_hidden_dim=16, proj_dim=8)
+            cfg = TrainerConfig(batch_size=8, total_steps=8, warmup_steps=1)
+            return create_train_state(model, jax.random.PRNGKey(0),
+                                      (1, 16, 16, 3), cfg)
+
+        def fresh_pipe():
+            return TwoViewPipeline(
+                StreamingLoader(src, 8, seed=2, num_threads=2),
+                jax.random.PRNGKey(11), blur=False)
+
+        step = make_train_step(temperature=0.1)
+
+        # Uninterrupted reference run: 8 steps straight through.
+        _, ref_hist = fit(fresh_state(), fresh_pipe(), step, num_steps=8,
+                          log_every=1, flops_per_step=None)
+        ref_losses = [h["loss"] for h in ref_hist]
+
+        # Interrupted run: 4 steps, checkpoint, then resume to 8.
+        ckpt = str(tmp_path / "ckpt")
+        fit(fresh_state(), fresh_pipe(), step, num_steps=4,
+            checkpoint_dir=ckpt, checkpoint_every=2, log_every=1,
+            flops_per_step=None)
+        resumed_pipe = fresh_pipe()  # restarts at 0; fit must reposition it
+        _, tail_hist = fit(fresh_state(), resumed_pipe, step, num_steps=8,
+                           checkpoint_dir=ckpt, checkpoint_every=2,
+                           log_every=1, flops_per_step=None)
+        tail_losses = [h["loss"] for h in tail_hist]
+
+        np.testing.assert_allclose(tail_losses, ref_losses[4:],
+                                   rtol=0, atol=1e-6)
+        # And the pipeline really was repositioned, not replayed from 0.
+        assert resumed_pipe.state()["offset"] == 8 % \
+            resumed_pipe.loader.batches_per_epoch() or \
+            resumed_pipe.state()["epoch"] > 0

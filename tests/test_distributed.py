@@ -85,6 +85,7 @@ def test_ring_loss_matches_oracle(rng, mesh):
     np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_grads_match_oracle(rng, mesh):
     """Backward through the ppermute ring (a reverse ring pass) is exact."""
     z1, z2 = global_views(rng)

@@ -16,6 +16,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import __graft_entry__ as graft  # noqa: E402
 
 
+@pytest.mark.slow
 def test_entry_traces():
     fn, example_args = graft.entry()
     lowered = jax.jit(fn).lower(*example_args)
@@ -23,6 +24,7 @@ def test_entry_traces():
 
 
 @pytest.mark.skipif(jax.device_count() < 8, reason="needs an 8-device mesh")
+@pytest.mark.slow
 def test_dryrun_multichip_runs():
     graft.dryrun_multichip(8)
 

@@ -183,6 +183,7 @@ def test_distributed_matches_oracle(rng, mesh):
     np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_distributed_grads_match_single_device(rng, mesh):
     """Gradients THROUGH the two all-gathers (AD-derived reduce-scatter)
     equal single-device autodiff — including the replicated logit scale."""
@@ -212,6 +213,7 @@ def test_ring_equals_allgather_path(rng, mesh):
     np.testing.assert_allclose(float(ring), float(gathered), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_grads_match_oracle(rng, mesh):
     """Backward through the ppermute ring (a reverse ring pass) is exact,
     including the logit-scale gradient."""

@@ -40,6 +40,7 @@ def test_fused_temperature_grid(rng, t):
     )
 
 
+@pytest.mark.slow
 def test_fused_ragged_shapes(rng):
     """Shapes that don't divide the block sizes exercise the padding path."""
     for two_n, dim in [(10, 8), (50, 40), (130, 100), (258, 72)]:

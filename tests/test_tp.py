@@ -96,6 +96,7 @@ def test_param_specs_shard_transformer_weights():
     assert all(s == P() for s in ln)
 
 
+@pytest.mark.slow
 def test_tp_simclr_step_matches_unsharded():
     model = tiny_vit()
     imgs = jax.random.uniform(jax.random.PRNGKey(1), (8, 8, 8, 3))
@@ -133,6 +134,7 @@ def test_tp_simclr_step_matches_unsharded():
                                    err_msg=str(pa))
 
 
+@pytest.mark.slow
 def test_tp_clip_step_matches_unsharded():
     model = tiny_clip()
     imgs = jax.random.uniform(jax.random.PRNGKey(2), (4, 8, 8, 3))

@@ -60,6 +60,7 @@ def mesh():
     return create_mesh(axis_names=("data",))
 
 
+@pytest.mark.slow
 def test_train_step_reduces_loss(rng):
     model = tiny_model()
     cfg = TrainerConfig(batch_size=16, total_steps=40, warmup_steps=1,
@@ -77,6 +78,25 @@ def test_train_step_reduces_loss(rng):
     assert min(losses[6:]) < losses[0]  # optimization makes progress
 
 
+def test_train_step_fused_matches_oracle_impl(rng):
+    """The step's auto-selected loss impl (oracle off-TPU) and the fused
+    Pallas path produce the same update — pins the use_fused knob."""
+    cfg = TrainerConfig(batch_size=8, total_steps=4, warmup_steps=1)
+    state_a = create_train_state(tiny_model(), rng, (2, 32, 32, 3), cfg)
+    state_b = create_train_state(tiny_model(), rng, (2, 32, 32, 3), cfg)
+    kv = jax.random.PRNGKey(3)
+    v1 = jax.random.uniform(kv, (8, 32, 32, 3))
+    v2 = jax.random.uniform(jax.random.fold_in(kv, 1), (8, 32, 32, 3))
+    sa, ma = make_train_step(0.2, use_fused=True)(state_a, v1, v2)
+    sb, mb = make_train_step(0.2, use_fused=False)(state_b, v1, v2)
+    np.testing.assert_allclose(float(ma["loss"]), float(mb["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(sa.params), jax.tree.leaves(sb.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-6)
+
+
+@pytest.mark.slow
 def test_sharded_step_matches_single_device(rng, mesh):
     """One distributed step == one single-device step (global BN + gathered
     loss + psum'd grads reproduce full-batch math exactly in fp32)."""
@@ -102,6 +122,7 @@ def test_sharded_step_matches_single_device(rng, mesh):
                                    atol=1e-5)
 
 
+@pytest.mark.slow
 def test_sharded_step_multiple_steps(rng, mesh):
     cfg = TrainerConfig(batch_size=16, total_steps=10, warmup_steps=1)
     state = create_train_state(tiny_model("data"), rng, (2, 32, 32, 3), cfg)
@@ -114,6 +135,7 @@ def test_sharded_step_multiple_steps(rng, mesh):
         assert bool(jnp.isfinite(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_train_loop_history(rng):
     model = tiny_model()
     cfg = TrainerConfig(batch_size=8, total_steps=10, warmup_steps=1)
@@ -190,6 +212,7 @@ def test_array_dataset_rejects_small():
         ArrayDataset(synthetic_images(4, 8), batch_size=8)
 
 
+@pytest.mark.slow
 def test_gradient_accumulation_updates_every_k(rng):
     """accum_steps=2: params move only after every 2nd micro-batch."""
     model = tiny_model()
@@ -222,6 +245,7 @@ def test_gradient_accumulation_updates_every_k(rng):
     assert not same(p2, snap(state)), "no update after 2k micro-steps"
 
 
+@pytest.mark.slow
 def test_fit_checkpoints_and_resumes(tmp_path, rng):
     from ntxent_tpu.training import fit
 
