@@ -138,7 +138,11 @@ def run_distributed(quick: bool, results: dict):
 
     On one device this measures kernel overheads only; on a real multi-chip
     mesh it compares the gather-everything path against the O(N/P)-memory
-    ring (per-hop neighbor ICI traffic) at growing global batch.
+    ring (per-hop neighbor ICI traffic) at growing global batch. Each row
+    also records XLA's compiled temp-memory for all three implementations
+    (gather / jnp ring / fused ring) — the footprint claim behind the ring
+    design. The fused ring is TIMED only on accelerator backends (on CPU it
+    runs interpret-mode and would measure the interpreter).
     """
     import jax.numpy as jnp
 
@@ -149,13 +153,15 @@ def run_distributed(quick: bool, results: dict):
     )
     from ntxent_tpu.training.trainer import shard_batch
 
+    on_accel = jax.default_backend() in ("tpu", "axon")
     n_dev = jax.device_count()
     mesh = create_mesh(axis_names=("data",))
     per_dev = [128, 512] if quick else [128, 512, 2048]
     runs = 5 if quick else 20
     print(f"\n=== distributed loss: all-gather vs ring on {n_dev} device(s) "
           f"===")
-    print(f"{'N/dev':>8} {'global N':>9} {'gather ms':>10} {'ring ms':>9}")
+    print(f"{'N/dev':>8} {'global N':>9} {'gather ms':>10} {'ring ms':>9} "
+          f"{'fused ms':>9} {'tmp MiB g/r/f':>16}")
     for n in per_dev:
         key = jax.random.PRNGKey(0)
         z1 = jax.random.normal(key, (n * n_dev, 64))
@@ -164,14 +170,29 @@ def run_distributed(quick: bool, results: dict):
         z2 = z2 / jnp.linalg.norm(z2, axis=1, keepdims=True)
         z1s, z2s = shard_batch((z1, z2), mesh)
         gather = jax.jit(make_sharded_ntxent(mesh))
-        ring = jax.jit(make_ring_ntxent(mesh))
+        ring = jax.jit(make_ring_ntxent(mesh, impl="jnp"))
+        fused = jax.jit(make_ring_ntxent(mesh, impl="fused"))
+
+        def temp_mib(fn):
+            try:
+                stats = fn.lower(z1s, z2s).compile().memory_analysis()
+                return round(stats.temp_size_in_bytes / 2**20, 1)
+            except Exception:
+                return None
+
+        mg, mr, mf = temp_mib(gather), temp_mib(ring), temp_mib(fused)
         rg = time_fn(gather, z1s, z2s, warmup=2, runs=runs)
         rr = time_fn(ring, z1s, z2s, warmup=2, runs=runs)
+        rf = time_fn(fused, z1s, z2s, warmup=2, runs=runs) if on_accel \
+            else None
+        rf_ms = f"{rf.mean_ms:>9.3f}" if rf else f"{'n/a':>9}"
         print(f"{n:>8} {2 * n * n_dev:>9} {rg.mean_ms:>10.3f} "
-              f"{rr.mean_ms:>9.3f}")
+              f"{rr.mean_ms:>9.3f} {rf_ms} {f'{mg}/{mr}/{mf}':>16}")
         results.setdefault("distributed", []).append({
             "per_device_n": n, "devices": n_dev,
-            "allgather": rg.as_dict(), "ring": rr.as_dict()})
+            "allgather": rg.as_dict(), "ring": rr.as_dict(),
+            "ring_fused": rf.as_dict() if rf else None,
+            "temp_mib": {"gather": mg, "ring_jnp": mr, "ring_fused": mf}})
 
 
 def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None):

@@ -47,6 +47,8 @@ __all__ = [
     "ntxent_loss_fused",
     "ntxent_partial_fused",
     "ntxent_loss_and_lse",
+    "block_lse",
+    "block_grads",
 ]
 
 _NEG_INF = -1e30
@@ -98,8 +100,8 @@ def _pos_gid(row_gid, n_half: int, diag_pos: bool = False):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(zr_ref, zc_ref, gid_ref, scale_ref, loss_ref, lse_ref,
-                m_ref, l_ref, p_ref,
+def _fwd_kernel(zr_ref, zc_ref, gid_ref, cgid_ref, scale_ref, loss_ref,
+                lse_ref, m_ref, l_ref, p_ref,
                 *, br, bc, inv_t, cols_actual, n_half, diag_pos=False):
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -116,7 +118,8 @@ def _fwd_kernel(zr_ref, zc_ref, gid_ref, scale_ref, loss_ref, lse_ref,
         p_ref[:] = jnp.zeros((br, 1), jnp.float32)
 
     row_gid = gid_ref[:]                      # (BR, 1) global row ids
-    _, cid = _tile_ids(i, j, br, bc)
+    cid = cgid_ref[:]                         # (1, BC) global col ids —
+    # an operand, not tile arithmetic: ring blocks carry arbitrary gids
     s_masked, s_raw = _masked_sim_tile(
         zr_ref[:], zc_ref[:], row_gid, cid, inv_t * scale_ref[0, 0],
         cols_actual, diag_pos
@@ -149,8 +152,16 @@ def _scale_arr(scale) -> jax.Array:
     return jnp.asarray(scale, jnp.float32).reshape(1, 1)
 
 
+def _col_gid_row(cp: int, col_gid=None) -> jax.Array:
+    """(1, CP) int32 global-column-id operand; defaults to [0..CP) (the
+    gathered/symmetric layouts, where column position IS the global id)."""
+    if col_gid is None:
+        return jnp.arange(cp, dtype=jnp.int32).reshape(1, cp)
+    return col_gid.astype(jnp.int32).reshape(1, cp)
+
+
 def _fwd_call(z_rows, z_cols, row_gid, *, br, bc, inv_t, cols_actual, n_half,
-              interpret, diag_pos=False, scale=None):
+              interpret, diag_pos=False, scale=None, col_gid=None):
     rp, d = z_rows.shape
     cp = z_cols.shape[0]
     grid = (rp // br, cp // bc)
@@ -165,6 +176,7 @@ def _fwd_call(z_rows, z_cols, row_gid, *, br, bc, inv_t, cols_actual, n_half,
             pl.BlockSpec((br, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bc, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bc), lambda i, j: (0, j), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
         ],
         out_specs=[
@@ -186,7 +198,7 @@ def _fwd_call(z_rows, z_cols, row_gid, *, br, bc, inv_t, cols_actual, n_half,
             transcendentals=rp * cp,
         ),
         interpret=interpret,
-    )(z_rows, z_cols, row_gid, _scale_arr(scale))
+    )(z_rows, z_cols, row_gid, _col_gid_row(cp, col_gid), _scale_arr(scale))
     return loss_sum[0, 0], lse
 
 
@@ -229,8 +241,8 @@ def _bwd_sym_kernel(z_row_ref, z_col_ref, gid_ref, scale_ref, lse_r_ref,
     )
 
 
-def _bwd_rows_kernel(z_row_ref, z_col_ref, gid_ref, scale_ref, lse_r_ref,
-                     grad_ref,
+def _bwd_rows_kernel(z_row_ref, z_col_ref, gid_ref, cgid_ref, scale_ref,
+                     lse_r_ref, grad_ref,
                      *, br, bc, inv_t, cols_actual, n_half, diag_pos=False):
     """General case: d(loss_sum)/d(z_rows) = (P - E) @ z_cols."""
     i = pl.program_id(0)
@@ -241,7 +253,7 @@ def _bwd_rows_kernel(z_row_ref, z_col_ref, gid_ref, scale_ref, lse_r_ref,
         grad_ref[:] = jnp.zeros(grad_ref.shape, grad_ref.dtype)
 
     row_gid = gid_ref[:]
-    _, cid = _tile_ids(i, j, br, bc)
+    cid = cgid_ref[:]
     s_masked, _ = _masked_sim_tile(
         z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t * scale_ref[0, 0],
         cols_actual, diag_pos
@@ -257,8 +269,8 @@ def _bwd_rows_kernel(z_row_ref, z_col_ref, gid_ref, scale_ref, lse_r_ref,
     )
 
 
-def _bwd_cols_kernel(z_row_ref, z_col_ref, gid_ref, scale_ref, lse_r_ref,
-                     grad_ref,
+def _bwd_cols_kernel(z_row_ref, z_col_ref, gid_ref, cgid_ref, scale_ref,
+                     lse_r_ref, grad_ref,
                      *, br, bc, inv_t, cols_actual, n_half, diag_pos=False):
     """General case: d(loss_sum)/d(z_cols) = (P - E)^T @ z_rows.
 
@@ -273,7 +285,7 @@ def _bwd_cols_kernel(z_row_ref, z_col_ref, gid_ref, scale_ref, lse_r_ref,
         grad_ref[:] = jnp.zeros(grad_ref.shape, grad_ref.dtype)
 
     row_gid = gid_ref[:]
-    _, cid = _tile_ids(i, j, br, bc)
+    cid = cgid_ref[:]
     s_masked, _ = _masked_sim_tile(
         z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t * scale_ref[0, 0],
         cols_actual, diag_pos
@@ -327,9 +339,10 @@ def _bwd_sym_call(z, row_gid, lse, *, br, bc, inv_t, cols_actual, n_half,
 
 def _bwd_general_call(z_rows, z_cols, row_gid, lse, *, br, bc, inv_t,
                       cols_actual, n_half, interpret, diag_pos=False,
-                      scale=None):
+                      scale=None, col_gid=None):
     rp, d = z_rows.shape
     cp = z_cols.shape[0]
+    cg = _col_gid_row(cp, col_gid)
     row_kernel = functools.partial(
         _bwd_rows_kernel, br=br, bc=bc, inv_t=inv_t,
         cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
@@ -341,6 +354,7 @@ def _bwd_general_call(z_rows, z_cols, row_gid, lse, *, br, bc, inv_t,
             pl.BlockSpec((br, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bc, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bc), lambda i, j: (0, j), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
         ],
@@ -348,7 +362,7 @@ def _bwd_general_call(z_rows, z_cols, row_gid, lse, *, br, bc, inv_t,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((rp, d), jnp.float32),
         interpret=interpret,
-    )(z_rows, z_cols, row_gid, _scale_arr(scale), lse)
+    )(z_rows, z_cols, row_gid, cg, _scale_arr(scale), lse)
 
     col_kernel = functools.partial(
         _bwd_cols_kernel, br=br, bc=bc, inv_t=inv_t,
@@ -361,6 +375,7 @@ def _bwd_general_call(z_rows, z_cols, row_gid, lse, *, br, bc, inv_t,
             pl.BlockSpec((br, d), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bc, d), lambda j, i: (j, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((br, 1), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bc), lambda j, i: (0, j), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda j, i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((br, 1), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
         ],
@@ -368,7 +383,7 @@ def _bwd_general_call(z_rows, z_cols, row_gid, lse, *, br, bc, inv_t,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((cp, d), jnp.float32),
         interpret=interpret,
-    )(z_rows, z_cols, row_gid, _scale_arr(scale), lse)
+    )(z_rows, z_cols, row_gid, cg, _scale_arr(scale), lse)
     return grad_rows, grad_cols
 
 
@@ -581,3 +596,99 @@ def ntxent_loss_and_lse(
         cols_actual=two_n, n_half=two_n // 2, interpret=interpret,
     )
     return loss_sum / two_n, lse[:two_n, 0]
+
+
+# ---------------------------------------------------------------------------
+# Mid-level block primitives for the ring (context-parallel) loss
+# ---------------------------------------------------------------------------
+
+
+def block_lse(
+    z_rows: jax.Array,
+    z_cols: jax.Array,
+    row_gid: jax.Array,
+    col_gid: jax.Array,
+    temperature: float,
+    total_cols: int,
+    *,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Per-row logsumexp over ONE column block of the global similarity
+    matrix, self-columns masked — the fused fold step of the ring NT-Xent
+    (parallel/ring.py), where the visiting block's columns carry arbitrary
+    global ids (``col_gid``).
+
+    Not wired for AD (the ring's custom VJP calls block_grads explicitly).
+    Positive-pair extraction is disabled by pointing ``n_half`` past every
+    real column id; the ring handles positives locally.
+    """
+    rows, d = z_rows.shape
+    cols = z_cols.shape[0]
+    br, bc = choose_blocks(rows, cols, d, z_rows.dtype,
+                           block_rows, block_cols)
+    if interpret is None:
+        interpret = _default_interpret()
+    zr = _pad_rows(z_rows, br)
+    zc = _pad_rows(z_cols, bc)
+    gid = _gid_column(row_gid, br, sentinel=total_cols)
+    cg = _pad_gid_row(col_gid, bc, sentinel=total_cols)
+    _, lse = _fwd_call(
+        zr, zc, gid,
+        br=br, bc=bc, inv_t=1.0 / float(temperature),
+        cols_actual=total_cols, n_half=total_cols, interpret=interpret,
+        col_gid=cg,
+    )
+    return lse[:rows, 0]
+
+
+def block_grads(
+    z_rows: jax.Array,
+    z_cols: jax.Array,
+    row_gid: jax.Array,
+    col_gid: jax.Array,
+    lse_rows: jax.Array,
+    temperature: float,
+    total_cols: int,
+    *,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Gradients of ``S = sum_r lse_r`` restricted to this column block:
+    ``(dS/dz_rows, dS/dz_cols) * temperature`` — i.e. the raw softmax-prob
+    matmuls ``P @ z_cols`` and ``P^T @ z_rows``; the caller multiplies by
+    ``cotangent / temperature`` once (matching _ntxent_partial_bwd).
+
+    The backward fold of the fused ring: per hop, dS/dz_rows accumulates
+    locally and dS/dz_cols circulates home with the visiting block.
+    """
+    rows, d = z_rows.shape
+    cols = z_cols.shape[0]
+    br, bc = choose_blocks(rows, cols, d, z_rows.dtype,
+                           block_rows, block_cols)
+    if interpret is None:
+        interpret = _default_interpret()
+    zr = _pad_rows(z_rows, br)
+    zc = _pad_rows(z_cols, bc)
+    gid = _gid_column(row_gid, br, sentinel=total_cols)
+    cg = _pad_gid_row(col_gid, bc, sentinel=total_cols)
+    # Padded rows carry sentinel gids (valid_row = 0 in-kernel); pad their
+    # lse with zeros so exp(s - lse) stays finite before masking.
+    lse_p = jnp.zeros((zr.shape[0], 1), jnp.float32
+                      ).at[:rows, 0].set(lse_rows)
+    gr, gc = _bwd_general_call(
+        zr, zc, gid, lse_p,
+        br=br, bc=bc, inv_t=1.0 / float(temperature),
+        cols_actual=total_cols, n_half=total_cols, interpret=interpret,
+        col_gid=cg,
+    )
+    return gr[:rows], gc[:cols]
+
+
+def _pad_gid_row(col_gid: jax.Array, multiple: int, sentinel: int):
+    """Pad a 1-D global-col-id vector to a block multiple with sentinel ids
+    (>= total_cols, so padded columns are masked in-kernel). Same padding
+    core as the row side — only the shape differs."""
+    return _gid_column(col_gid, multiple, sentinel)[:, 0]

@@ -90,8 +90,136 @@ def _ring_body(z1_local, z2_local, temperature, axis, num_devices):
     return jax.lax.psum(loss_sum, axis) / two_n
 
 
-def make_ring_ntxent(mesh: Mesh, temperature: float = 0.07, axis: str = "data"):
-    """Build a jit-able ring NT-Xent over ``mesh`` (see module docstring)."""
+def _make_ring_lse_sum(temperature: float, axis: str, num_devices: int,
+                       interpret: bool | None):
+    """custom-VJP scalar ``S = sum_i lse_i`` over this device's rows, where
+    lse is the global-row logsumexp accumulated around the ring with the
+    fused Pallas block kernels (ops.ntxent_pallas.block_lse/block_grads).
+
+    Forward: P-1 neighbor exchanges; each hop folds the visiting block's
+    per-row lse (one fused kernel call — the (R, C) tile never leaves VMEM)
+    into running (m, l) via logaddexp. Backward is a second ring pass: the
+    row-side gradient accumulates locally while the column-side gradient of
+    each visiting block circulates home WITH the block (P hops = one full
+    circle) — ring attention's backward, with the VJP matmuls on the MXU via
+    the fused backward kernels instead of AD through the forward scan.
+    """
+    from ..ops.ntxent_pallas import block_grads, block_lse
+
+    perm = [(i, (i + 1) % num_devices) for i in range(num_devices)]
+
+    @jax.custom_vjp
+    def ring_lse_sum(z_local, my_gid):
+        return _fwd(z_local, my_gid)[0]
+
+    def _lse(z_local, my_gid):
+        two_n = z_local.shape[0] * num_devices
+
+        def step(carry, _):
+            blk, bgid, m, l = carry
+            lse_k = block_lse(z_local, blk, my_gid, bgid, temperature,
+                              two_n, interpret=interpret)
+            m_new = jnp.maximum(m, lse_k)
+            l = l * jnp.exp(m - m_new) + jnp.exp(lse_k - m_new)
+            blk = jax.lax.ppermute(blk, axis, perm)
+            bgid = jax.lax.ppermute(bgid, axis, perm)
+            return (blk, bgid, m_new, l), None
+
+        rows = z_local.shape[0]
+        init = (z_local, my_gid,
+                jnp.full((rows,), _NEG_INF, jnp.float32),
+                jnp.zeros((rows,), jnp.float32))
+        (blk, bgid, m, l), _ = jax.lax.scan(
+            step, init, None, length=num_devices - 1)
+        lse_k = block_lse(z_local, blk, my_gid, bgid, temperature,
+                          two_n, interpret=interpret)
+        m_new = jnp.maximum(m, lse_k)
+        l = l * jnp.exp(m - m_new) + jnp.exp(lse_k - m_new)
+        return m_new + jnp.log(l)
+
+    def _fwd(z_local, my_gid):
+        lse = _lse(z_local, my_gid)
+        return jnp.sum(lse), (z_local, my_gid, lse)
+
+    def _bwd(res, ct):
+        z_local, my_gid, lse = res
+        two_n = z_local.shape[0] * num_devices
+
+        def step(carry, _):
+            blk, bgid, gblk, grows = carry
+            gr_k, gc_k = block_grads(z_local, blk, my_gid, bgid, lse,
+                                     temperature, two_n,
+                                     interpret=interpret)
+            grows = grows + gr_k
+            gblk = gblk + gc_k
+            # gblk rides WITH its block: after num_devices hops both are
+            # home, gblk holding every device's column-side contribution.
+            blk = jax.lax.ppermute(blk, axis, perm)
+            bgid = jax.lax.ppermute(bgid, axis, perm)
+            gblk = jax.lax.ppermute(gblk, axis, perm)
+            return (blk, bgid, gblk, grows), None
+
+        init = (z_local, my_gid,
+                jnp.zeros(z_local.shape, jnp.float32),
+                jnp.zeros(z_local.shape, jnp.float32))
+        (_, _, gblk, grows), _ = jax.lax.scan(
+            step, init, None, length=num_devices)
+        grad = (grows + gblk) * (ct / temperature)
+        return grad.astype(z_local.dtype), None
+
+    ring_lse_sum.defvjp(_fwd, _bwd)
+    return ring_lse_sum
+
+
+def _ring_body_fused(z1_local, z2_local, temperature, axis, num_devices,
+                     interpret):
+    """Fused-kernel ring NT-Xent body (see _make_ring_lse_sum)."""
+    n_local = z1_local.shape[0]
+    two_n = 2 * n_local * num_devices
+    inv_t = 1.0 / temperature
+
+    z_local = jnp.concatenate([z1_local, z2_local], axis=0)
+    my_gid = local_row_gids(axis, n_local, num_devices)
+
+    # Positives are device-local in the stacked-view layout; their (simple,
+    # dense) gradient flows through plain AD — only the quadratic lse part
+    # needs the custom ring VJP.
+    pos = jnp.sum(z1_local * z2_local, axis=-1, dtype=jnp.float32) * inv_t
+
+    lse_sum = _make_ring_lse_sum(temperature, axis, num_devices,
+                                 interpret)(z_local, my_gid)
+    loss_sum = lse_sum - 2.0 * jnp.sum(pos)
+    return jax.lax.psum(loss_sum, axis) / two_n
+
+
+def make_ring_ntxent(mesh: Mesh, temperature: float = 0.07,
+                     axis: str = "data", impl: str = "auto"):
+    """Build a jit-able ring NT-Xent over ``mesh`` (see module docstring).
+
+    ``impl``: "fused" folds each visiting block with the Pallas block
+    kernels (VMEM-tiled, MXU matmuls, custom ring VJP — the production TPU
+    path); "jnp" is the XLA-fused elementwise fold with AD-through-scan
+    gradients (the oracle the fused path is tested against; also the faster
+    choice under interpret mode); "auto" picks by backend.
+    """
+    if impl == "auto":
+        impl = "fused" if jax.default_backend() in ("tpu", "axon") else "jnp"
+    if impl not in ("fused", "jnp"):
+        raise ValueError(f"impl must be 'auto', 'fused' or 'jnp', got "
+                         f"{impl!r}")
+    if impl == "fused":
+        body = functools.partial(
+            _ring_body_fused,
+            temperature=float(temperature),
+            axis=axis,
+            num_devices=mesh.shape[axis],
+            interpret=None,
+        )
+        # check_vma=False: pallas_call's out_shape carries no varying-mesh-
+        # axes annotation, which check_vma=True rejects inside shard_map —
+        # same constraint (and comment) as dist_loss.py's pallas bodies.
+        return jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis)),
+                             out_specs=P(), check_vma=False)
     body = functools.partial(
         _ring_body,
         temperature=float(temperature),
@@ -108,9 +236,10 @@ def ntxent_loss_ring(
     mesh: Mesh,
     temperature: float = 0.07,
     axis: str = "data",
+    impl: str = "auto",
 ) -> jax.Array:
     """Global-batch NT-Xent without ever gathering the global batch."""
-    return make_ring_ntxent(mesh, temperature, axis)(z1, z2)
+    return make_ring_ntxent(mesh, temperature, axis, impl)(z1, z2)
 
 
 def _infonce_ring_body(za_local, zb_local, scale, axis, num_devices):
