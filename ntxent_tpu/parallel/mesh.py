@@ -39,6 +39,7 @@ def init_distributed(
     num_processes: int | None = None,
     process_id: int | None = None,
     local_device_ids: Sequence[int] | None = None,
+    initialization_timeout: int | None = None,
 ) -> None:
     """Multi-host rendezvous (the ``mpirun``/``MPI_Init`` role).
 
@@ -57,12 +58,18 @@ def init_distributed(
         logger.info("jax.distributed already initialized")
         return
     explicit = coordinator_address is not None
+    kwargs = {}
+    if initialization_timeout is not None:
+        # Bound the rendezvous wait (default is 300 s) — e.g. fail-fast
+        # health checks on a coordinator that never comes up.
+        kwargs["initialization_timeout"] = initialization_timeout
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
             local_device_ids=local_device_ids,
+            **kwargs,
         )
         logger.info("distributed init: process %d/%d, %d local devices",
                     jax.process_index(), jax.process_count(),

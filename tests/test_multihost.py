@@ -1,0 +1,139 @@
+"""Two-process multi-host rendezvous integration test.
+
+Exercises ``init_distributed``'s explicit-coordinator path
+(``ntxent_tpu/parallel/mesh.py``) for real: two OS processes on localhost
+rendezvous through ``jax.distributed.initialize``, build one global mesh,
+and run a cross-process ``psum`` — the MPI_Init + communicator role the
+reference only ever declared as link-only CMake options
+(/root/reference/CMakeLists.txt:13-14,41-47,115-121). Round-1 coverage only
+hit the single-process no-op fallback; this drives the coordinated path.
+
+Runs on CPU (2 processes x 2 virtual devices each); the same code path is
+what multi-host TPU pods take, with the coordinator auto-detected there.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.slow  # two cold JAX starts + rendezvous (~20-40 s)
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys
+
+    # Env must be set before jax import: 2 virtual CPU devices per process.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
+
+    from ntxent_tpu.parallel.mesh import (
+        create_mesh, init_distributed, process_info)
+
+    coordinator = sys.argv[1]
+    pid = int(sys.argv[2])
+    init_distributed(coordinator_address=coordinator, num_processes=2,
+                     process_id=pid)
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    info = process_info()
+    assert info["process_count"] == 2, info
+    assert info["global_device_count"] == 4, info
+    assert info["local_device_count"] == 2, info
+
+    # One global mesh over all 4 devices; a psum that crosses the process
+    # boundary proves the collective fabric, not just the rendezvous.
+    mesh = create_mesh(axis_names=("data",))
+
+    def body():
+        idx = jax.lax.axis_index("data").astype(jnp.float32)
+        return jax.lax.psum(idx + 1.0, "data")
+
+    summed = jax.jit(
+        jax.shard_map(body, mesh=mesh, in_specs=(), out_specs=P()))()
+    # Devices 0..3 contribute axis_index+1 → 1+2+3+4 = 10; devices 2,3
+    # live in the other process, so a wrong fabric cannot produce 10.
+    assert float(summed) == 10.0, float(summed)
+
+    print("MULTIHOST_OK:" + json.dumps(info))
+    jax.distributed.shutdown()
+""")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_rendezvous_and_psum(tmp_path):
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), coordinator, str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"process {pid} rc={p.returncode}:\n{out[-3000:]}")
+        assert "MULTIHOST_OK:" in out, f"process {pid} output:\n{out[-3000:]}"
+
+
+def test_explicit_coordinator_failure_propagates():
+    """A configured coordinator that cannot rendezvous must raise, not
+    silently fall back to single-process (mesh.py's `explicit` branch)."""
+    code = textwrap.dedent("""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        from ntxent_tpu.parallel.mesh import init_distributed
+        try:
+            init_distributed(coordinator_address="127.0.0.1:1",
+                             num_processes=2, process_id=1,
+                             initialization_timeout=5)
+        except Exception:
+            print("RAISED_AS_EXPECTED")
+        else:
+            print("SILENT_FALLBACK")
+    """)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=120, env=env)
+    # Two acceptable failure shapes: a Python exception our wrapper re-raised
+    # (RAISED_AS_EXPECTED), or JAX's coordination client LOG(FATAL)-aborting
+    # the process on the rendezvous deadline (observed on jax 0.9:
+    # "DEADLINE_EXCEEDED ... RegisterTask" with a nonzero exit). Either way
+    # the one unacceptable outcome is a silent single-process fallback.
+    out = proc.stdout + proc.stderr
+    assert "SILENT_FALLBACK" not in out, out
+    assert ("RAISED_AS_EXPECTED" in out
+            or ("DEADLINE_EXCEEDED" in out and proc.returncode != 0)), out
