@@ -20,8 +20,13 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import sys
 import time
 from pathlib import Path
+
+# Runnable from a bare checkout (`python benchmarks/run_benchmarks.py`):
+# python puts THIS file's directory on sys.path, not the repo root.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import jax
 import jax.numpy as jnp

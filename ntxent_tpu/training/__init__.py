@@ -25,6 +25,7 @@ from ntxent_tpu.training.lars import (
     create_lars,
     simclr_learning_rate,
 )
+from ntxent_tpu.training.preemption import PreemptionGuard
 from ntxent_tpu.training.trainer import (
     TrainerConfig,
     TrainState,
@@ -56,6 +57,7 @@ __all__ = [
     "device_prefetch",
     "grain_loader",
     "streaming_two_view_iterator",
+    "PreemptionGuard",
     "cosine_warmup_schedule",
     "create_lars",
     "simclr_learning_rate",

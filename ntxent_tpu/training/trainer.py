@@ -211,6 +211,7 @@ def train_loop(
     flops_per_step: float | str | None = "auto",
     hook: Callable | None = None,
     step_hook: Callable | None = None,
+    stop_fn: Callable[[], bool] | None = None,
 ):
     """Simple host loop: step, log loss / steps-per-sec / MFU.
 
@@ -223,10 +224,20 @@ def train_loop(
     ``hook(state, entry)`` fires at log points; ``step_hook(state)`` fires
     after EVERY step (for periodic side effects keyed on the global
     ``state.step``, e.g. interval-filtered checkpoint saves).
+
+    ``stop_fn()`` is polled after every step; returning True ends the loop
+    early at a step boundary (the preemption pathway —
+    training/preemption.PreemptionGuard turns SIGTERM into exactly this).
     """
     history = []
     t0 = time.perf_counter()
     last_t, last_step = t0, 0
+    if stop_fn is not None and stop_fn():
+        # Signal landed before the loop (e.g. during checkpoint restore):
+        # don't pull a batch or pay the step-1 AOT compile on the way out.
+        logger.warning("stop requested before training started")
+        return state, history
+    stopped = False
     for step in range(1, num_steps + 1):
         v1, v2 = next(data_iter)
         if step == 1 and flops_per_step == "auto":
@@ -240,7 +251,8 @@ def train_loop(
         state, metrics = train_step(state, v1, v2)
         if step_hook is not None:
             step_hook(state)
-        if step % log_every == 0 or step == num_steps:
+        stopped = stop_fn is not None and stop_fn()
+        if step % log_every == 0 or step == num_steps or stopped:
             loss = float(metrics["loss"])
             now = time.perf_counter()
             sps = (step - last_step) / max(now - last_t, 1e-9)
@@ -252,6 +264,10 @@ def train_loop(
             logger.info("step %d: loss=%.4f, %.2f steps/s", step, loss, sps)
             if hook is not None:
                 hook(state, entry)
+        if stopped:
+            logger.warning("stop requested: leaving train loop at step %d "
+                           "of %d", step, num_steps)
+            break
     return state, history
 
 
@@ -265,10 +281,17 @@ def fit(
     log_every: int = 50,
     flops_per_step: float | str | None = "auto",
     fast_forward_data: bool = False,
+    stop_fn: Callable[[], bool] | None = None,
 ):
     """Checkpoint-aware training: restore the latest checkpoint if one
     exists, train to ``num_steps`` total, save every ``checkpoint_every``
     steps (on the GLOBAL ``state.step``) and at the end.
+
+    ``stop_fn`` (see ``train_loop``) makes the run preemptible: when it
+    trips, the loop exits at the next step boundary and the final
+    force-save below persists exactly that step (model + data-iterator
+    state), so the next incarnation of the job resumes where the signal
+    landed. Pair with ``preemption.PreemptionGuard`` for SIGTERM handling.
 
     The resume point is ``state.step`` (incremented by apply_gradients), so
     a re-run after preemption continues where the last saved state stopped —
@@ -314,6 +337,11 @@ def fit(
             return state, []
         if fast_forward_data:
             for _ in range(done):
+                if stop_fn is not None and stop_fn():
+                    # Preempted during the replay: nothing new to save —
+                    # the checkpoint we restored is still the truth.
+                    logger.warning("stop requested during data fast-forward")
+                    return state, []
                 next(data_iter)
 
         def step_hook(s):
@@ -327,7 +355,8 @@ def fit(
         state, history = train_loop(
             state, data_iter, train_step, remaining,
             log_every=log_every,
-            flops_per_step=flops_per_step, step_hook=step_hook)
+            flops_per_step=flops_per_step, step_hook=step_hook,
+            stop_fn=stop_fn)
         if manager is not None \
                 and manager.latest_step() != int(state.step):
             manager.save(int(state.step), state, force=True,

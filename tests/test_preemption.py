@@ -1,0 +1,226 @@
+"""Fault injection: SIGTERM a live training process, resume exactly.
+
+Closes SURVEY.md §5.3 (failure detection / elastic recovery — absent in the
+reference, whose only failure handling was exception→exit(1) in harnesses,
+/root/reference/python/test.py:181-183,207-209). The scenario is the real
+one from Cloud TPU preemptible scheduling: the OS delivers SIGTERM with a
+grace window; the trainer must finish the in-flight step, persist model +
+data-iterator state, and exit 0 — and the relaunched job must reproduce the
+uninterrupted run's loss curve exactly.
+
+In-process tests cover the guard/stop plumbing cheaply; the slow test
+injects a genuine signal into a separate OS process.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import jax
+import pytest
+
+from ntxent_tpu.models import ResNet, SimCLRModel
+from ntxent_tpu.training import (
+    PreemptionGuard,
+    TrainerConfig,
+    create_train_state,
+    fit,
+    make_train_step,
+    train_loop,
+)
+
+TinyEnc = functools.partial(ResNet, stage_sizes=(1,), small_images=True)
+
+
+def _tiny_setup(rng, steps_hint=8):
+    model = SimCLRModel(encoder=TinyEnc, proj_hidden_dim=16, proj_dim=8)
+    cfg = TrainerConfig(batch_size=8, total_steps=steps_hint,
+                        warmup_steps=1)
+    state = create_train_state(model, rng, (1, 8, 8, 3), cfg)
+    step = make_train_step(cfg.temperature, use_fused=False)
+
+    def gen():
+        i = 0
+        key = jax.random.PRNGKey(7)
+        while True:
+            k1, k2 = jax.random.split(jax.random.fold_in(key, i))
+            yield (jax.random.uniform(k1, (8, 8, 8, 3)),
+                   jax.random.uniform(k2, (8, 8, 8, 3)))
+            i += 1
+
+    return state, step, gen()
+
+
+def test_stop_fn_halts_at_step_boundary(rng):
+    state, step, it = _tiny_setup(rng)
+    flag = {"stop": False}
+
+    def step_hook(s):  # the "signal" lands during step 3
+        if int(s.step) >= 3:
+            flag["stop"] = True
+
+    state, hist = train_loop(state, it, step, num_steps=10, log_every=100,
+                             flops_per_step=None, step_hook=step_hook,
+                             stop_fn=lambda: flag["stop"])
+    assert int(state.step) == 3  # stopped early, at a step boundary
+    assert hist and hist[-1]["step"] == 3  # final entry logged despite stop
+
+
+def test_stop_before_first_step_skips_the_loop(rng):
+    state, step, it = _tiny_setup(rng)
+    state, hist = train_loop(state, it, step, num_steps=10, log_every=100,
+                             flops_per_step=None, stop_fn=lambda: True)
+    assert int(state.step) == 0 and hist == []
+
+
+def test_fit_force_saves_the_stopped_step(tmp_path, rng):
+    from ntxent_tpu.training.checkpoint import CheckpointManager
+
+    state, step, it = _tiny_setup(rng)
+
+    with PreemptionGuard() as guard:
+        def requesting_iter():
+            # The "signal" lands while the host is assembling batch 4.
+            for i, batch in enumerate(it, start=1):
+                if i == 4:
+                    guard.request()
+                yield batch
+
+        state, _ = fit(state, requesting_iter(), step, num_steps=20,
+                       checkpoint_dir=str(tmp_path), checkpoint_every=100,
+                       log_every=100, flops_per_step=None,
+                       stop_fn=guard.requested)
+    assert guard.preempted
+    assert int(state.step) == 4
+    mgr = CheckpointManager(str(tmp_path))
+    try:
+        assert mgr.latest_step() == 4  # the stopped step was force-saved
+    finally:
+        mgr.close()
+
+
+def test_guard_chains_and_restores_previous_handler():
+    sentinel = []
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: sentinel.append(s))
+        with PreemptionGuard() as guard:
+            os.kill(os.getpid(), signal.SIGTERM)
+            # Python delivers the signal at the next bytecode boundary.
+            deadline = time.time() + 5
+            while not guard.preempted and time.time() < deadline:
+                time.sleep(0.01)
+            assert guard.preempted
+            assert sentinel == [signal.SIGTERM]  # chained to prior handler
+        assert sentinel and not guard._installed
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+_TRAIN_SCRIPT = textwrap.dedent("""
+    import functools, json, os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from ntxent_tpu.models import ResNet, SimCLRModel
+    from ntxent_tpu.training import (
+        ArraySource, PreemptionGuard, StreamingLoader, TrainerConfig,
+        TwoViewPipeline, create_train_state, fit, make_train_step)
+
+    ckpt_dir, num_steps, mode = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+    enc = functools.partial(ResNet, stage_sizes=(1,), small_images=True)
+    model = SimCLRModel(encoder=enc, proj_hidden_dim=16, proj_dim=8)
+    cfg = TrainerConfig(batch_size=8, total_steps=num_steps, warmup_steps=1)
+    state = create_train_state(model, jax.random.PRNGKey(0), (1, 8, 8, 3),
+                               cfg)
+    step = make_train_step(cfg.temperature, use_fused=False)
+
+    images = np.random.RandomState(0).rand(64, 8, 8, 3).astype("float32")
+    pipe = TwoViewPipeline(StreamingLoader(ArraySource(images), 8, seed=5,
+                                           num_threads=1),
+                           key=jax.random.PRNGKey(11), blur=False)
+
+    with PreemptionGuard() as guard:
+        def stop():
+            # Polled after every step: both the throttle (so the parent's
+            # SIGTERM lands mid-run, not after the run) and the stop flag.
+            if mode == "slow":
+                print("STEP_DONE", flush=True)
+                time.sleep(0.3)
+            return guard.requested()
+
+        state, hist = fit(state, pipe, step, num_steps=num_steps,
+                          checkpoint_dir=ckpt_dir, checkpoint_every=1000,
+                          log_every=1, flops_per_step=None, stop_fn=stop)
+    print("RUN_RESULT:" + json.dumps(
+        {"final_step": int(state.step),
+         "losses": [h["loss"] for h in hist],
+         "preempted": guard.preempted}), flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_sigterm_mid_run_checkpoints_and_resume_matches(tmp_path):
+    """Inject a real SIGTERM into a training process; the relaunched run
+    must finish and the combined loss curve must equal the uninterrupted
+    run's, step for step."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    script = tmp_path / "train.py"
+    script.write_text(_TRAIN_SCRIPT)
+
+    def run(ckpt, steps, mode, sig_after=None):
+        proc = subprocess.Popen(
+            [sys.executable, str(script), str(ckpt), str(steps), mode],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, bufsize=1)
+        if sig_after is not None:
+            # Wait until sig_after steps have demonstrably completed, then
+            # deliver the signal while the run is mid-flight.
+            seen = 0
+            for line in proc.stdout:
+                if line.startswith("STEP_DONE"):
+                    seen += 1
+                    if seen >= sig_after:
+                        proc.send_signal(signal.SIGTERM)
+                        break
+        out, _ = proc.communicate(timeout=600)
+        assert proc.returncode == 0, f"rc={proc.returncode}:\n{out[-3000:]}"
+        for line in reversed((out or "").splitlines()):
+            if line.startswith("RUN_RESULT:"):
+                return json.loads(line[len("RUN_RESULT:"):])
+        raise AssertionError(
+            f"no RUN_RESULT in output:\n{(out or '')[-3000:]}")
+
+    # Uninterrupted reference run: 8 steps.
+    ref = run(tmp_path / "ref", 8, "fast")
+    assert ref["final_step"] == 8 and not ref["preempted"]
+
+    # Interrupted run: SIGTERM lands after >= 3 completed steps.
+    ckpt = tmp_path / "ckpt"
+    first = run(ckpt, 8, "slow", sig_after=3)
+    assert first["preempted"]
+    stopped_at = first["final_step"]
+    assert 1 <= stopped_at < 8
+
+    # Relaunch: resumes from the force-saved step, finishes to 8.
+    second = run(ckpt, 8, "fast")
+    assert second["final_step"] == 8
+
+    combined = first["losses"] + second["losses"]
+    assert len(combined) == 8
+    assert combined == pytest.approx(ref["losses"], rel=1e-5), (
+        f"resumed curve diverged:\nref      = {ref['losses']}\n"
+        f"combined = {combined}")
