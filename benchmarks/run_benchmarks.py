@@ -200,82 +200,147 @@ def run_distributed(quick: bool, results: dict):
             "temp_mib": {"gather": mg, "ring_jnp": mr, "ring_fused": mf}})
 
 
-def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None):
-    """End-to-end SimCLR train-step benchmark with automatic MFU.
+def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
+                   batch: int | None):
+    """(name, state, step, step_args) for one flagship workload.
+
+    Sizes follow BASELINE.json's config ladder: RN50/224 (configs[2]),
+    ViT-B/16 SimCLR (configs[3]), CLIP ViT-B/16 + text tower (configs[4]).
+    Off-accelerator everything shrinks to a pathway check, not a perf claim.
+    """
+    import functools
+
+    from ntxent_tpu.models import (
+        CLIPModel,
+        ResNet,
+        ResNet50,
+        SimCLRModel,
+        TextTransformer,
+        ViT_B16,
+        VisionTransformer,
+    )
+    from ntxent_tpu.training.trainer import (
+        TrainState,
+        TrainerConfig,
+        create_train_state,
+        make_clip_train_step,
+        make_train_step,
+    )
+
+    small = quick or not on_accel
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+
+    if model_name == "clip_b16":
+        if small:
+            image_enc = functools.partial(
+                VisionTransformer, hidden_dim=32, depth=2, num_heads=2,
+                mlp_dim=64, patch_size=8)
+            text_enc = functools.partial(
+                TextTransformer, vocab_size=128, max_len=16, hidden_dim=32,
+                depth=2, num_heads=2)
+            b, size, tok_len, name = batch or 8, 32, 16, "clip_tiny"
+        else:
+            image_enc, text_enc = ViT_B16, TextTransformer
+            b, size, tok_len, name = batch or 256, 224, 77, "clip_b16"
+        model = CLIPModel(image_encoder=image_enc, text_encoder=text_enc,
+                          embed_dim=128 if small else 512)
+        images = jax.random.uniform(k1, (b, size, size, 3))
+        tokens = jax.random.randint(
+            k2, (b, tok_len), 1, 128 if small else 49408)
+        variables = model.init(jax.random.PRNGKey(0), images[:1], tokens[:1],
+                               train=False)
+        import optax
+
+        state = TrainState.create(apply_fn=model.apply,
+                                  params=variables["params"],
+                                  tx=optax.adamw(1e-4))
+        return name, b, size, state, make_clip_train_step(), (images, tokens)
+
+    if model_name == "vit_b16":
+        if small:
+            encoder = functools.partial(
+                VisionTransformer, hidden_dim=32, depth=2, num_heads=2,
+                mlp_dim=64, patch_size=8)
+            b, size, name = batch or 8, 32, "vit_tiny"
+        else:
+            encoder = ViT_B16
+            b, size, name = batch or 128, 224, "vit_b16"
+    else:  # resnet50
+        if small:
+            encoder = functools.partial(ResNet, stage_sizes=(1, 1),
+                                        small_images=True)
+            b, size, name = batch or 16, 32, "resnet_tiny"
+        else:
+            encoder = ResNet50
+            b, size, name = batch or 128, 224, "resnet50"
+    model = SimCLRModel(encoder=encoder, proj_hidden_dim=128, proj_dim=64)
+    cfg = TrainerConfig(batch_size=b, total_steps=10, warmup_steps=2)
+    state = create_train_state(model, jax.random.PRNGKey(0),
+                               (1, size, size, 3), cfg)
+    v1 = jax.random.uniform(k1, (b, size, size, 3))
+    v2 = jax.random.uniform(k2, (b, size, size, 3))
+    return name, b, size, state, make_train_step(cfg.temperature), (v1, v2)
+
+
+def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
+                      model_name: str = "resnet50",
+                      batch: int | None = None):
+    """End-to-end train-step benchmark with automatic MFU.
 
     The role the reference's benchmark played for its hot path
     (src/benchmark.cpp:68-88), applied to this framework's actual training
-    workload: model fwd + fused loss + bwd + LARS update, one chip. MFU uses
-    XLA's compiled per-chip FLOP count (trainer.compiled_step_flops) against
-    the device's peak (trainer.peak_flops_per_chip).
+    workloads: model fwd + fused loss + bwd + optimizer update, one chip.
+    MFU uses XLA's compiled per-chip FLOP count against the device's peak
+    (trainer.peak_flops_per_chip).
     """
-    from ntxent_tpu.models import ResNet, ResNet50, SimCLRModel
     from ntxent_tpu.training.trainer import (
-        TrainerConfig,
         aot_compile_with_flops,
-        create_train_state,
         estimate_mfu,
-        make_train_step,
         peak_flops_per_chip,
     )
 
     on_accel = jax.default_backend() in ("tpu", "axon")
-    if quick or not on_accel:
-        # CPU-sized stand-in: the pathway (cost analysis -> MFU) is what's
-        # exercised; the number is not a TPU claim.
-        import functools
-        encoder = functools.partial(ResNet, stage_sizes=(1, 1),
-                                    small_images=True)
-        batch, size = 16, 32
-        name = "resnet_tiny"
-    else:
-        encoder = ResNet50
-        batch, size = 64, 224
-        name = "resnet50"
-    model = SimCLRModel(encoder=encoder, proj_hidden_dim=128, proj_dim=64)
-    cfg = TrainerConfig(batch_size=batch, total_steps=10, warmup_steps=2)
-    state = create_train_state(model, jax.random.PRNGKey(0),
-                               (1, size, size, 3), cfg)
-    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
-    v1 = jax.random.uniform(k1, (batch, size, size, 3))
-    v2 = jax.random.uniform(k2, (batch, size, size, 3))
-    step = make_train_step(cfg.temperature)
+    name, batch, size, state, step, step_args = _trainer_setup(
+        model_name, quick, on_accel, batch)
 
-    flops, compiled = aot_compile_with_flops(step, state, v1, v2)
+    flops, compiled = aot_compile_with_flops(step, state, *step_args)
     if compiled is not None:
         step = compiled  # run the executable we already built
-    state, _ = step(state, v1, v2)  # first (warmup) step
+    state, _ = step(state, *step_args)  # first (warmup) step
 
     import time as _time
     runs = 5 if quick or not on_accel else 30
     times = []
     for _ in range(runs):
         t0 = _time.perf_counter()
-        state, metrics = step(state, v1, v2)
+        state, metrics = step(state, *step_args)
         jax.block_until_ready(metrics["loss"])
         times.append((_time.perf_counter() - t0) * 1e3)
     mean_ms = sum(times) / len(times)
-    sps = 1e3 / mean_ms
+    med_ms = sorted(times)[len(times) // 2]
+    # Steady-state throughput: the median discards the tunnel's dispatch
+    # outliers; MFU is a claim about the chip, so it uses the median.
+    sps = 1e3 / med_ms
     entry = {
         "model": name, "batch": batch, "image": size,
-        "mean_ms": mean_ms, "steps_per_sec": sps,
+        "mean_ms": mean_ms, "median_ms": med_ms, "steps_per_sec": sps,
         "flops_per_step": flops,
         "peak_flops_per_chip": peak_flops_per_chip(),
         "mfu": estimate_mfu(flops, sps) if flops else None,
     }
-    results["trainer"] = entry
+    results.setdefault("trainer", {})[name] = entry
     flops_str = f"{flops:.3e}" if flops else "n/a"
     mfu_str = f"{entry['mfu']:.1%}" if entry["mfu"] else "n/a"
     print(f"\n=== trainer step ({name}, batch {batch}, {size}x{size}) ===")
-    print(f"mean {mean_ms:.2f} ms/step, {sps:.2f} steps/s, "
-          f"flops/step={flops_str}, MFU={mfu_str}")
+    print(f"mean {mean_ms:.2f} / median {med_ms:.2f} ms/step, "
+          f"{sps:.2f} steps/s, flops/step={flops_str}, MFU={mfu_str}")
 
     if trace_dir:
         from ntxent_tpu.utils.profiling import trace
 
         with trace(trace_dir):
             for _ in range(3):
-                state, metrics = step(state, v1, v2)
+                state, metrics = step(state, *step_args)
             jax.block_until_ready(metrics["loss"])
         print(f"XProf trace -> {trace_dir}")
 
@@ -288,8 +353,14 @@ def main():
                         help="also benchmark all-gather vs ring losses over "
                              "the device mesh")
     parser.add_argument("--trainer", action="store_true",
-                        help="also benchmark the end-to-end SimCLR train "
-                             "step with automatic MFU")
+                        help="also benchmark the end-to-end train step "
+                             "with automatic MFU")
+    parser.add_argument("--model", default="resnet50",
+                        choices=["resnet50", "vit_b16", "clip_b16", "all"],
+                        help="trainer-bench workload (BASELINE.json config "
+                             "ladder); 'all' runs every flagship")
+    parser.add_argument("--batch", type=int, default=None,
+                        help="trainer-bench batch override")
     parser.add_argument("--trace", default=None, metavar="DIR",
                         help="capture an XProf trace of the trainer step "
                              "into DIR (implies --trainer)")
@@ -327,7 +398,11 @@ def main():
     if args.distributed:
         run_distributed(args.quick, results)
     if args.trainer or args.trace:
-        run_trainer_bench(args.quick, results, args.trace)
+        models = ["resnet50", "vit_b16", "clip_b16"] \
+            if args.model == "all" else [args.model]
+        for m in models:
+            run_trainer_bench(args.quick, results, args.trace,
+                              model_name=m, batch=args.batch)
 
     out_dir = Path(args.out)
     out_dir.mkdir(exist_ok=True)

@@ -53,10 +53,19 @@ class PreemptionGuard:
         self._event = threading.Event()
         self._previous: dict[int, object] = {}
         self._installed = False
+        self._announced = False
 
     # -- flag surface ----------------------------------------------------
     def requested(self) -> bool:
         """True once a shutdown signal has arrived (train_loop stop_fn)."""
+        if self._event.is_set() and not self._announced:
+            # Log from the polling (main) thread, never from the handler:
+            # logging's buffered streams are not reentrant, and a signal
+            # landing mid-write would crash the very path this class exists
+            # to protect.
+            self._announced = True
+            logger.warning("shutdown signal received: finishing current "
+                           "step, saving checkpoint, then exiting")
         return self._event.is_set()
 
     @property
@@ -69,14 +78,16 @@ class PreemptionGuard:
 
     # -- handler lifecycle ----------------------------------------------
     def _handler(self, signum, frame):
+        # Async-signal-safe: only flip the flag here. Logging happens on the
+        # main thread at the next requested() poll (reentrant-I/O hazard),
+        # and chaining skips Python's default SIGINT handler — invoking it
+        # would raise KeyboardInterrupt mid-step, the exact behavior a guard
+        # over SIGINT exists to prevent.
         first = not self._event.is_set()
         self._event.set()
-        if first:
-            logger.warning(
-                "signal %s received: finishing current step, saving "
-                "checkpoint, then exiting", signal.Signals(signum).name)
         prev = self._previous.get(signum)
-        if callable(prev) and first:
+        if (first and callable(prev)
+                and prev is not signal.default_int_handler):
             prev(signum, frame)
 
     def __enter__(self) -> "PreemptionGuard":

@@ -124,6 +124,41 @@ def make_train_step(temperature: float = 0.1,
     return train_step
 
 
+def make_clip_train_step(use_fused: bool | None = None) -> Callable:
+    """Single-device CLIP train step: dual towers, learnable logit scale.
+
+    ``state.apply_fn(variables, images, tokens)`` must return
+    ``(image_embeds, text_embeds, scale)`` (models/clip.py). Symmetric
+    InfoNCE runs at temperature ``1/scale`` so the scale's gradient flows.
+    The multi-chip equivalents are ``parallel.tp.make_tp_clip_train_step``
+    (GSPMD) and the ring/all-gather InfoNCE losses (parallel/).
+    """
+    if use_fused is None:
+        use_fused = jax.default_backend() in ("tpu", "axon")
+    if use_fused:
+        from ..ops.infonce_pallas import info_nce_fused as _nce
+
+        def loss_of(zi, zt, scale):
+            return _nce(zi, zt, scale=scale)
+    else:
+        from ..ops.oracle import info_nce_loss as _nce
+
+        def loss_of(zi, zt, scale):
+            return _nce(zi, zt, temperature=1.0 / scale)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state, images, tokens):
+        def loss_fn(params):
+            zi, zt, scale = state.apply_fn({"params": params}, images,
+                                           tokens, train=True)
+            return loss_of(zi, zt, scale)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), {"loss": loss}
+
+    return train_step
+
+
 def make_sharded_train_step(
     mesh: Mesh,
     temperature: float = 0.1,
