@@ -96,12 +96,42 @@ def _child() -> None:
     fwd_bwd = jax.jit(jax.value_and_grad(loss_fn))
     # The CPU fallback is a liveness indicator, not a perf claim — don't
     # spend 100 runs x ~1s/iter of host matmuls on it.
-    warmup, runs = (WARMUP, RUNS) if backend in ("tpu", "axon") else (3, 15)
+    on_accel = backend in ("tpu", "axon")
+    warmup, runs = (WARMUP, RUNS) if on_accel else (3, 15)
     result = time_fn(fwd_bwd, z, warmup=warmup, runs=runs)
+
+    # Steady-state cross-check: N data-DEPENDENT steps (each input is the
+    # previous step's gradient update, so executions can neither overlap
+    # nor be elided/cached), timed as one span ending in an actual
+    # device-to-host read of the final loss. This is robust where
+    # per-iteration block_until_ready is not: remote-relay backends have
+    # been observed marking buffers ready before execution completes,
+    # which makes per-iteration numbers physically impossible (sub-peak
+    # microseconds). The larger of the two protocols is the honest bound.
+    @jax.jit
+    def chained_step(zz):
+        loss, g = jax.value_and_grad(loss_fn)(zz)
+        z2 = zz - 0.01 * g
+        z2 = z2 / jnp.linalg.norm(z2, axis=-1, keepdims=True)
+        return z2, loss
+
+    zc, _ = chained_step(z)
+    zc.block_until_ready()
+    n_chain = 100 if on_accel else 5
+    t0 = time.perf_counter()
+    zc = z
+    for _ in range(n_chain):
+        zc, last_loss = chained_step(zc)
+    final = float(last_loss)  # D2H read: cannot return before the work is done
+    steady_ms = (time.perf_counter() - t0) * 1e3 / n_chain
+    if not (final == final):  # NaN guard on the thing we just timed
+        raise RuntimeError(f"chained loss went NaN: {final}")
+
     payload = {
         "backend": backend,
         "device_kind": device_kind,
         **result.as_dict(),
+        "steady_state_ms": steady_ms,
         **extra,
     }
     print(SENTINEL + json.dumps(payload), flush=True)
@@ -175,11 +205,17 @@ def main() -> None:
 
     if payload is not None:
         mean_ms = payload.pop("mean_ms")
+        # Headline value: the LARGER of the reference protocol (per-iter
+        # sync mean) and the chained+D2H steady state. They agree on honest
+        # backends (steady state is usually a hair lower); where a relay's
+        # readiness signal fires early, only the chained number is physical.
+        value_ms = max(mean_ms, payload.get("steady_state_ms", 0.0))
+        payload["protocol_mean_ms"] = mean_ms
         record = {
             "metric": METRIC,
-            "value": round(mean_ms, 4),
+            "value": round(value_ms, 4),
             "unit": UNIT,
-            "vs_baseline": round(TARGET_MS / mean_ms, 3),
+            "vs_baseline": round(TARGET_MS / value_ms, 3),
             **{k: (round(v, 4) if isinstance(v, float) else v)
                for k, v in payload.items()},
         }

@@ -202,7 +202,8 @@ def run_distributed(quick: bool, results: dict):
 
 def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
                    batch: int | None):
-    """(name, state, step, step_args) for one flagship workload.
+    """(name, batch, size, state, step, step_args) for one flagship
+    workload.
 
     Sizes follow BASELINE.json's config ladder: RN50/224 (configs[2]),
     ViT-B/16 SimCLR (configs[3]), CLIP ViT-B/16 + text tower (configs[4]).
@@ -310,20 +311,24 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
 
     import time as _time
     runs = 5 if quick or not on_accel else 30
-    times = []
+    # Chained steady-state protocol (same rationale as bench.py): the steps
+    # already chain through `state`, so timing the whole span and ending
+    # with an actual device-to-host read of the final loss cannot be fooled
+    # by a backend whose per-buffer readiness signal fires early (observed
+    # on the axon relay: per-iteration block_until_ready produced
+    # sub-physical step times and >100% MFU). MFU is a chip-utilization
+    # claim — it uses this number, never a per-iteration median.
+    jax.block_until_ready(state)  # drain the warmup step before t0
+    t0 = _time.perf_counter()
     for _ in range(runs):
-        t0 = _time.perf_counter()
         state, metrics = step(state, *step_args)
-        jax.block_until_ready(metrics["loss"])
-        times.append((_time.perf_counter() - t0) * 1e3)
-    mean_ms = sum(times) / len(times)
-    med_ms = sorted(times)[len(times) // 2]
-    # Steady-state throughput: the median discards the tunnel's dispatch
-    # outliers; MFU is a claim about the chip, so it uses the median.
-    sps = 1e3 / med_ms
+    final_loss = float(metrics["loss"])  # D2H: waits for the real work
+    chained_ms = (_time.perf_counter() - t0) * 1e3 / runs
+    assert final_loss == final_loss, "loss went NaN during trainer bench"
+    sps = 1e3 / chained_ms
     entry = {
         "model": name, "batch": batch, "image": size,
-        "mean_ms": mean_ms, "median_ms": med_ms, "steps_per_sec": sps,
+        "chained_ms": chained_ms, "steps_per_sec": sps,
         "flops_per_step": flops,
         "peak_flops_per_chip": peak_flops_per_chip(),
         "mfu": estimate_mfu(flops, sps) if flops else None,
@@ -332,7 +337,7 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
     flops_str = f"{flops:.3e}" if flops else "n/a"
     mfu_str = f"{entry['mfu']:.1%}" if entry["mfu"] else "n/a"
     print(f"\n=== trainer step ({name}, batch {batch}, {size}x{size}) ===")
-    print(f"mean {mean_ms:.2f} / median {med_ms:.2f} ms/step, "
+    print(f"chained {chained_ms:.2f} ms/step over {runs} steps, "
           f"{sps:.2f} steps/s, flops/step={flops_str}, MFU={mfu_str}")
 
     if trace_dir:

@@ -7,6 +7,7 @@ from ntxent_tpu.parallel.dist_loss import (
 from ntxent_tpu.parallel.mesh import (
     create_mesh,
     data_sharding,
+    global_batch,
     init_distributed,
     local_row_gids,
     process_info,
@@ -29,6 +30,7 @@ from ntxent_tpu.parallel.tp import (
 __all__ = [
     "create_mesh",
     "data_sharding",
+    "global_batch",
     "init_distributed",
     "local_row_gids",
     "process_info",

@@ -29,6 +29,7 @@ __all__ = [
     "create_mesh",
     "data_sharding",
     "replicated_sharding",
+    "global_batch",
     "local_row_gids",
     "process_info",
 ]
@@ -124,6 +125,24 @@ def data_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def global_batch(local_batch, mesh: Mesh, axis: str = "data"):
+    """Assemble per-process host batches into one global sharded array.
+
+    The multi-host counterpart of ``trainer.shard_batch`` (which only
+    handles fully-addressable meshes): every process passes the rows ITS
+    devices will own — e.g. each rank's slice of the global batch, the role
+    per-rank DataLoaders played in the reference's implied NCCL-SimCLR
+    pattern (SURVEY.md §2.2) — and the result is a global ``jax.Array``
+    sharded over ``axis`` that sharded train steps consume directly.
+    Works single-process too (where it reduces to a device_put).
+    """
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)),
+        local_batch)
 
 
 def local_row_gids(axis: str, n_local: int, num_devices: int):

@@ -64,7 +64,41 @@ _WORKER = textwrap.dedent("""
     # live in the other process, so a wrong fabric cannot produce 10.
     assert float(summed) == 10.0, float(summed)
 
-    print("MULTIHOST_OK:" + json.dumps(info))
+    # The full NCCL-SimCLR pattern across the process boundary: per-process
+    # data slices assembled into a global sharded batch, shard_map train
+    # step (all-gather embeddings -> fused partial loss -> psum'd grads),
+    # two real optimizer updates. Loss is replicated: both processes must
+    # see the identical trajectory.
+    import functools
+    import numpy as np
+    from ntxent_tpu.models import ResNet, SimCLRModel
+    from ntxent_tpu.parallel.mesh import global_batch
+    from ntxent_tpu.training.trainer import (
+        TrainerConfig, create_train_state, make_sharded_train_step)
+
+    enc = functools.partial(ResNet, stage_sizes=(1,), small_images=True)
+    model = SimCLRModel(encoder=enc, proj_hidden_dim=16, proj_dim=8)
+    cfg = TrainerConfig(batch_size=8, total_steps=2, warmup_steps=1)
+    state = create_train_state(model, jax.random.PRNGKey(0), (1, 8, 8, 3),
+                               cfg)
+    step = make_sharded_train_step(mesh, cfg.temperature)
+
+    losses = []
+    for i in range(2):
+        # Same deterministic global batch on every process; each process
+        # contributes only the rows its devices own (pid 0: rows 0-3,
+        # pid 1: rows 4-7 of the global batch of 8).
+        rng = np.random.RandomState(100 + i)
+        g1 = rng.rand(8, 8, 8, 3).astype(np.float32)
+        g2 = rng.rand(8, 8, 8, 3).astype(np.float32)
+        lo, hi = pid * 4, (pid + 1) * 4
+        v1, v2 = global_batch((g1[lo:hi], g2[lo:hi]), mesh)
+        assert v1.shape == (8, 8, 8, 3), v1.shape  # global, not local
+        state, metrics = step(state, v1, v2)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses)), losses
+
+    print("MULTIHOST_OK:" + json.dumps({**info, "losses": losses}))
     jax.distributed.shutdown()
 """)
 
@@ -101,10 +135,19 @@ def test_two_process_rendezvous_and_psum(tmp_path):
             if p.poll() is None:
                 p.kill()
 
+    import json
+
+    results = []
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, (
             f"process {pid} rc={p.returncode}:\n{out[-3000:]}")
         assert "MULTIHOST_OK:" in out, f"process {pid} output:\n{out[-3000:]}"
+        line = [ln for ln in out.splitlines()
+                if ln.startswith("MULTIHOST_OK:")][-1]
+        results.append(json.loads(line[len("MULTIHOST_OK:"):]))
+    # The replicated loss trajectory must be bit-identical on both
+    # processes — each ran the same global program over its own devices.
+    assert results[0]["losses"] == results[1]["losses"], results
 
 
 def test_explicit_coordinator_failure_propagates():
