@@ -1,0 +1,224 @@
+"""``ntxent-train``: command-line SimCLR pretraining driver.
+
+The runtime config/flag surface for the framework (SURVEY.md §5.6: the
+reference's only knobs were build-time CMake options,
+/root/reference/CMakeLists.txt:9-16, plus per-call kwargs — it shipped no
+way to actually launch the training its name promised). One command covers
+the BASELINE.json config ladder: synthetic smoke runs, CIFAR-10 single
+chip, ImageNet-layout folders on a data-parallel mesh, multi-host via
+explicit coordinator flags (the mpirun role).
+
+Everything here composes public API: datasets.TwoViewPipeline ->
+create_mesh/global_batch -> make_train_step/make_sharded_train_step ->
+fit under a PreemptionGuard (SIGTERM => checkpoint => clean exit => exact
+resume on relaunch).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import logging
+import sys
+
+logger = logging.getLogger("ntxent_tpu.cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ntxent-train",
+        description="TPU-native SimCLR pretraining (fused NT-Xent loss)")
+    d = p.add_argument_group("data")
+    d.add_argument("--dataset", default="synthetic",
+                   choices=["synthetic", "cifar10", "imagefolder"])
+    d.add_argument("--data-dir", default=None,
+                   help="CIFAR-10 pickle dir / ImageNet-layout root")
+    d.add_argument("--image-size", type=int, default=None,
+                   help="default: 32 (synthetic/cifar10) or 224")
+    d.add_argument("--synthetic-samples", type=int, default=512)
+
+    m = p.add_argument_group("model")
+    m.add_argument("--model", default="resnet50",
+                   choices=["resnet18", "resnet34", "resnet50", "resnet50x2",
+                            "resnet101", "resnet152", "vit_t16", "vit_s16",
+                            "vit_b16", "vit_l16", "tiny"])
+    m.add_argument("--proj-hidden-dim", type=int, default=2048)
+    m.add_argument("--proj-dim", type=int, default=128)
+
+    t = p.add_argument_group("training")
+    t.add_argument("--batch", type=int, default=256,
+                   help="GLOBAL batch (split across devices and processes)")
+    t.add_argument("--steps", type=int, default=1000)
+    t.add_argument("--temperature", type=float, default=0.1)
+    t.add_argument("--base-lr", type=float, default=0.3)
+    t.add_argument("--weight-decay", type=float, default=1e-6)
+    t.add_argument("--warmup-steps", type=int, default=100)
+    t.add_argument("--accum-steps", type=int, default=1)
+    t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--ckpt-dir", default=None)
+    t.add_argument("--ckpt-every", type=int, default=500)
+    t.add_argument("--log-every", type=int, default=50)
+
+    dist = p.add_argument_group("distributed (multi-host rendezvous; "
+                                "single-host multi-chip needs no flags)")
+    dist.add_argument("--coordinator", default=None,
+                      help="host:port of process 0 (mpirun role; "
+                           "auto-detected on Cloud TPU)")
+    dist.add_argument("--num-processes", type=int, default=None)
+    dist.add_argument("--process-id", type=int, default=None)
+
+    p.add_argument("--platform", default=None, metavar="cpu|tpu",
+                   help="force a JAX platform before backend init")
+    return p
+
+
+def _make_encoder(name: str, image_size: int):
+    from ntxent_tpu import models
+
+    if name == "tiny":
+        return functools.partial(models.ResNet, stage_sizes=(1,),
+                                 small_images=True)
+    table = {
+        "resnet18": models.ResNet18, "resnet34": models.ResNet34,
+        "resnet50": models.ResNet50, "resnet50x2": models.ResNet50x2,
+        "resnet101": models.ResNet101, "resnet152": models.ResNet152,
+        "vit_t16": models.ViT_Ti16, "vit_s16": models.ViT_S16,
+        "vit_b16": models.ViT_B16, "vit_l16": models.ViT_L16,
+    }
+    enc = table[name]
+    if name.startswith("resnet") and image_size <= 64:
+        enc = functools.partial(enc, small_images=True)
+    return enc
+
+
+def _make_pipeline(args, per_process_batch: int, sharding=None, mesh=None):
+    import numpy as np
+
+    import jax
+
+    from ntxent_tpu.training.datasets import (
+        ArraySource,
+        Cifar10Source,
+        GlobalTwoViewPipeline,
+        ImageFolderSource,
+        StreamingLoader,
+        TwoViewPipeline,
+    )
+
+    size = args.image_size
+    if args.dataset == "cifar10":
+        if args.data_dir is None:
+            raise SystemExit("--dataset cifar10 requires --data-dir")
+        source = Cifar10Source(args.data_dir)
+    elif args.dataset == "imagefolder":
+        if args.data_dir is None:
+            raise SystemExit("--dataset imagefolder requires --data-dir")
+        source = ImageFolderSource(args.data_dir, image_size=size)
+    else:
+        rng = np.random.RandomState(args.seed)
+        source = ArraySource(rng.rand(
+            args.synthetic_samples, size, size, 3).astype(np.float32))
+    # Multi-process: each process streams ITS slice of every global batch
+    # (seeded identically, offset by process_id — the per-rank DataLoader).
+    loader = StreamingLoader(source, per_process_batch, seed=args.seed,
+                             shard_index=jax.process_index(),
+                             shard_count=jax.process_count())
+    key = jax.random.PRNGKey(args.seed + 1)
+    if mesh is not None and jax.process_count() > 1:
+        # Global assembly before augmentation: only raw bytes cross the
+        # host boundary, views are born sharded (one replicated program —
+        # same key everywhere; per-row randomness is global-position-based).
+        return GlobalTwoViewPipeline(loader, key=key, mesh=mesh)
+    return TwoViewPipeline(loader, key=key, sharding=sharding)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    # Rendezvous BEFORE any backend touch (explicit flags or cloud
+    # auto-detect; a plain single-process run is a logged no-op).
+    from ntxent_tpu.parallel.mesh import (
+        create_mesh, init_distributed, process_info)
+
+    init_distributed(coordinator_address=args.coordinator,
+                     num_processes=args.num_processes,
+                     process_id=args.process_id)
+    info = process_info()
+    logger.info("topology: %s", info)
+
+    if args.image_size is None:
+        args.image_size = 224 if args.dataset == "imagefolder" else 32
+    if args.batch % info["global_device_count"]:
+        raise SystemExit(
+            f"--batch {args.batch} must divide across "
+            f"{info['global_device_count']} devices")
+    per_process_batch = args.batch // info["process_count"]
+
+    from ntxent_tpu.models import SimCLRModel
+    from ntxent_tpu.training import (
+        PreemptionGuard,
+        TrainerConfig,
+        create_train_state,
+        fit,
+        make_train_step,
+    )
+    from ntxent_tpu.training.trainer import make_sharded_train_step
+
+    encoder = _make_encoder(args.model, args.image_size)
+    model = SimCLRModel(encoder=encoder,
+                        proj_hidden_dim=args.proj_hidden_dim,
+                        proj_dim=args.proj_dim)
+    cfg = TrainerConfig(
+        batch_size=args.batch, temperature=args.temperature,
+        base_lr=args.base_lr, weight_decay=args.weight_decay,
+        warmup_steps=args.warmup_steps, total_steps=args.steps,
+        accum_steps=args.accum_steps)
+    state = create_train_state(
+        model, jax.random.PRNGKey(args.seed),
+        (1, args.image_size, args.image_size, 3), cfg)
+
+    n_dev = info["global_device_count"]
+    if n_dev > 1:
+        from ntxent_tpu.parallel.mesh import data_sharding
+
+        mesh = create_mesh(axis_names=("data",))
+        step = make_sharded_train_step(mesh, cfg.temperature)
+        # Batches arrive already sharded over the mesh: single-process via
+        # sharded device_put + sharded augmentation, multi-process via
+        # GlobalTwoViewPipeline's uint8 global assembly.
+        data = _make_pipeline(args, per_process_batch,
+                              sharding=data_sharding(mesh), mesh=mesh)
+        logger.info("data-parallel over %d devices (%d process(es))",
+                    n_dev, info["process_count"])
+    else:
+        step = make_train_step(cfg.temperature)
+        data = _make_pipeline(args, per_process_batch)
+        logger.info("single-device run")
+
+    with PreemptionGuard() as guard:
+        state, history = fit(
+            state, data, step, num_steps=args.steps,
+            checkpoint_dir=args.ckpt_dir, checkpoint_every=args.ckpt_every,
+            log_every=args.log_every, stop_fn=guard.requested)
+    if history:
+        last = history[-1]
+        logger.info("final: step %d loss %.4f (%.2f steps/s%s)",
+                    last["step"], last["loss"], last["steps_per_sec"],
+                    f", MFU {last['mfu']:.1%}" if "mfu" in last else "")
+    if guard.preempted:
+        logger.warning("run was preempted; checkpoint saved at step %d — "
+                       "relaunch with the same flags to resume",
+                       int(state.step))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,7 @@ from ntxent_tpu.training.data import (
 from ntxent_tpu.training.datasets import (
     ArraySource,
     Cifar10Source,
+    GlobalTwoViewPipeline,
     ImageFolderSource,
     StreamingLoader,
     TwoViewPipeline,
@@ -51,6 +52,7 @@ __all__ = [
     "two_view_iterator",
     "ArraySource",
     "Cifar10Source",
+    "GlobalTwoViewPipeline",
     "ImageFolderSource",
     "StreamingLoader",
     "TwoViewPipeline",

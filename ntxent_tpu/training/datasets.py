@@ -156,16 +156,32 @@ class StreamingLoader:
 
     def __init__(self, source, batch_size: int, seed: int = 0,
                  num_threads: int = 8, read_ahead: int = 4,
-                 drop_remainder: bool = True):
-        if len(source) < batch_size:
+                 drop_remainder: bool = True,
+                 shard_index: int = 0, shard_count: int = 1):
+        """``shard_index``/``shard_count``: multi-process data sharding —
+        every process computes the SAME seeded global order (a pure function
+        of seed and epoch) and yields only its ``batch_size``-row slice of
+        each global batch of ``batch_size * shard_count`` rows. Disjoint by
+        construction, no coordination needed (the per-rank DataLoader role
+        of the reference's implied MPI launch, SURVEY.md §2.2)."""
+        if not 0 <= shard_index < shard_count:
+            raise ValueError(f"shard {shard_index} not in [0, {shard_count})")
+        if shard_count > 1 and not drop_remainder:
+            raise ValueError("sharded loading requires drop_remainder=True "
+                             "(a ragged tail batch would leave shards with "
+                             "unequal row counts)")
+        if len(source) < batch_size * shard_count:
             raise ValueError(
-                f"source of {len(source)} < batch {batch_size}")
+                f"source of {len(source)} < global batch "
+                f"{batch_size * shard_count}")
         self.source = source
         self.batch_size = batch_size
         self.seed = seed
         self.num_threads = num_threads
         self.read_ahead = max(1, read_ahead)
         self.drop_remainder = drop_remainder
+        self.shard_index = shard_index
+        self.shard_count = shard_count
         self._epoch = 0
         self._offset = 0  # batches already yielded within the epoch
         self._lock = threading.Lock()
@@ -183,8 +199,9 @@ class StreamingLoader:
             self._offset = int(state["offset"])
 
     def batches_per_epoch(self) -> int:
-        n = len(self.source) // self.batch_size
-        if not self.drop_remainder and len(self.source) % self.batch_size:
+        rows = self.batch_size * self.shard_count
+        n = len(self.source) // rows
+        if not self.drop_remainder and len(self.source) % rows:
             n += 1
         return n
 
@@ -212,8 +229,9 @@ class StreamingLoader:
                 bi = start
                 while bi < nb or pending:
                     while bi < nb and len(pending) < self.read_ahead:
-                        idxs = order[bi * self.batch_size:
-                                     (bi + 1) * self.batch_size]
+                        rows = self.batch_size * self.shard_count
+                        lo = bi * rows + self.shard_index * self.batch_size
+                        idxs = order[lo:lo + self.batch_size]
                         pending.append([
                             pool.submit(self.source.__getitem__, int(i))
                             for i in idxs])
@@ -302,6 +320,65 @@ class TwoViewPipeline:
                 self.loader, self.key, blur=self.blur,
                 sharding=self.sharding)
         return next(self._gen)
+
+
+class GlobalTwoViewPipeline:
+    """Multi-process SSL input pipeline: per-process loader shard -> global
+    uint8 batch assembly -> two-view augmentation as ONE sharded program.
+
+    Only the raw (usually uint8) bytes cross the host boundary — the
+    augmented float32 views are born sharded on device and never come back
+    (cf. the module-header bandwidth note). The augmentation key derives
+    from (key, epoch, offset) only: a replicated global program requires
+    the SAME key on every process, and per-row randomness comes from each
+    row's position in the GLOBAL batch, so shards stay decorrelated.
+    Exposes the same checkpointable ``state()``/``restore()`` contract as
+    ``TwoViewPipeline`` (trainer.fit saves and restores it).
+
+    Works single-process too (where assembly reduces to a device_put), but
+    ``TwoViewPipeline`` with a ``sharding`` is the simpler spelling there.
+    """
+
+    def __init__(self, loader: StreamingLoader, key: jax.Array, mesh,
+                 axis: str = "data", blur: bool = True):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        self.loader = loader
+        self.key = key
+        self.blur = blur
+        self._sharding = NamedSharding(mesh, PartitionSpec(axis))
+        self._it = None
+
+    def state(self) -> dict:
+        return self.loader.state()
+
+    def restore(self, state: dict) -> None:
+        if self._it is not None:
+            raise RuntimeError("restore() must run before iteration starts")
+        self.loader.restore(state)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import jax.numpy as jnp
+
+        from .augment import augment_batch_pair
+
+        if self._it is None:
+            self._it = iter(self.loader)
+        st = self.loader.state()
+        sub = jax.random.fold_in(
+            jax.random.fold_in(self.key, st["epoch"]), st["offset"])
+        batch = next(self._it)  # this process's rows, host memory
+        x = jax.make_array_from_process_local_data(self._sharding, batch)
+
+        def views(k, xx):
+            if xx.dtype == jnp.uint8:
+                xx = xx.astype(jnp.float32) / 255.0
+            return augment_batch_pair(k, xx, blur=self.blur)
+
+        return jax.jit(views)(sub, x)
 
 
 def device_prefetch(iterator, depth: int = 2, sharding=None):

@@ -83,6 +83,22 @@ _WORKER = textwrap.dedent("""
                                cfg)
     step = make_sharded_train_step(mesh, cfg.temperature)
 
+    # Input pipeline across the boundary: each process streams its shard,
+    # uint8 global assembly, one replicated augmentation program.
+    from ntxent_tpu.training.datasets import (
+        ArraySource, GlobalTwoViewPipeline, StreamingLoader)
+
+    imgs = (np.random.RandomState(1).rand(32, 8, 8, 3) * 255).astype(
+        np.uint8)
+    pipe = GlobalTwoViewPipeline(
+        StreamingLoader(ArraySource(imgs), 4, seed=3, num_threads=1,
+                        shard_index=pid, shard_count=2),
+        key=jax.random.PRNGKey(9), mesh=mesh)
+    pv1, pv2 = next(pipe)
+    assert pv1.shape == (8, 8, 8, 3), pv1.shape  # global rows, f32 views
+    assert pv1.dtype == jnp.float32
+    assert float(jnp.max(pv1)) <= 1.0 + 1e-6
+
     losses = []
     for i in range(2):
         # Same deterministic global batch on every process; each process
