@@ -24,10 +24,14 @@ import sys
 logger = logging.getLogger("ntxent_tpu.cli")
 
 
-def build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(
-        prog="ntxent-train",
-        description="TPU-native SimCLR pretraining (fused NT-Xent loss)")
+MODEL_CHOICES = ["resnet18", "resnet34", "resnet50", "resnet50x2",
+                 "resnet101", "resnet152", "vit_t16", "vit_s16",
+                 "vit_b16", "vit_l16", "tiny"]
+
+
+def _add_common_args(p: argparse.ArgumentParser) -> None:
+    """Data/model/platform flags shared by ntxent-train and ntxent-eval
+    (one source of truth: a model added here is launchable AND evaluable)."""
     d = p.add_argument_group("data")
     d.add_argument("--dataset", default="synthetic",
                    choices=["synthetic", "cifar10", "imagefolder"])
@@ -35,15 +39,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="CIFAR-10 pickle dir / ImageNet-layout root")
     d.add_argument("--image-size", type=int, default=None,
                    help="default: 32 (synthetic/cifar10) or 224")
-    d.add_argument("--synthetic-samples", type=int, default=512)
 
     m = p.add_argument_group("model")
-    m.add_argument("--model", default="resnet50",
-                   choices=["resnet18", "resnet34", "resnet50", "resnet50x2",
-                            "resnet101", "resnet152", "vit_t16", "vit_s16",
-                            "vit_b16", "vit_l16", "tiny"])
+    m.add_argument("--model", default="resnet50", choices=MODEL_CHOICES)
     m.add_argument("--proj-hidden-dim", type=int, default=2048)
     m.add_argument("--proj-dim", type=int, default=128)
+
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default=None, metavar="cpu|tpu",
+                   help="force a JAX platform before backend init")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ntxent-train",
+        description="TPU-native SimCLR pretraining (fused NT-Xent loss)")
+    _add_common_args(p)
+    p.add_argument("--synthetic-samples", type=int, default=512)
 
     t = p.add_argument_group("training")
     t.add_argument("--batch", type=int, default=256,
@@ -54,7 +66,6 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--weight-decay", type=float, default=1e-6)
     t.add_argument("--warmup-steps", type=int, default=100)
     t.add_argument("--accum-steps", type=int, default=1)
-    t.add_argument("--seed", type=int, default=0)
     t.add_argument("--ckpt-dir", default=None)
     t.add_argument("--ckpt-every", type=int, default=500)
     t.add_argument("--log-every", type=int, default=50)
@@ -66,9 +77,6 @@ def build_parser() -> argparse.ArgumentParser:
                            "auto-detected on Cloud TPU)")
     dist.add_argument("--num-processes", type=int, default=None)
     dist.add_argument("--process-id", type=int, default=None)
-
-    p.add_argument("--platform", default=None, metavar="cpu|tpu",
-                   help="force a JAX platform before backend init")
     return p
 
 
@@ -217,6 +225,164 @@ def main(argv=None) -> int:
         logger.warning("run was preempted; checkpoint saved at step %d — "
                        "relaunch with the same flags to resume",
                        int(state.step))
+    return 0
+
+
+def build_eval_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ntxent-eval",
+        description="SSL evaluation of a pretrained checkpoint: linear "
+                    "probe and weighted-kNN on frozen encoder features")
+    _add_common_args(p)  # model/proj flags must match the training run
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--accum-steps", type=int, default=1,
+                   help="match the training run's value (it shapes the "
+                        "checkpoint's optimizer-state pytree)")
+    p.add_argument("--protocol", default="both",
+                   choices=["probe", "knn", "both"])
+    p.add_argument("--batch", type=int, default=256,
+                   help="feature-extraction batch")
+    p.add_argument("--probe-steps", type=int, default=500)
+    p.add_argument("--k", type=int, default=20)
+    p.add_argument("--max-train", type=int, default=10000,
+                   help="subsample caps keep eval wall time bounded")
+    p.add_argument("--max-test", type=int, default=2000)
+    return p
+
+
+def _labeled_arrays(args):
+    """(train_images, train_labels, test_images, test_labels) as float32
+    NHWC in [0, 1]."""
+    import numpy as np
+
+    def subsample(images, labels, cap, seed):
+        if cap and len(images) > cap:
+            idx = np.random.RandomState(seed).choice(
+                len(images), cap, replace=False)
+            return images[idx], labels[idx]
+        return images, labels
+
+    if args.dataset == "cifar10":
+        from ntxent_tpu.training.datasets import Cifar10Source
+
+        if args.data_dir is None:
+            raise SystemExit("--dataset cifar10 requires --data-dir")
+        tr = Cifar10Source(args.data_dir, train=True)
+        te = Cifar10Source(args.data_dir, train=False)
+        xtr, ytr = tr.images, tr.labels
+        xte, yte = te.images, te.labels
+    elif args.dataset == "imagefolder":
+        from ntxent_tpu.training.datasets import ImageFolderSource
+
+        if args.data_dir is None:
+            raise SystemExit("--dataset imagefolder requires --data-dir")
+        src = ImageFolderSource(args.data_dir, image_size=args.image_size)
+        labels = np.asarray(src.labels_list, np.int32)
+        # No held-out split in a bare folder: even/odd split by index.
+        # Cap the index lists BEFORE decoding — the caps exist so that an
+        # ImageNet-sized folder is never read whole into memory.
+        def pick(idxs, cap, seed):
+            if cap and len(idxs) > cap:
+                idxs = np.random.RandomState(seed).choice(
+                    idxs, cap, replace=False)
+            return np.sort(idxs)
+
+        tr_idx = pick(np.arange(0, len(src), 2), args.max_train, args.seed)
+        te_idx = pick(np.arange(1, len(src), 2), args.max_test,
+                      args.seed + 1)
+        xtr = np.stack([src[int(i)] for i in tr_idx])
+        xte = np.stack([src[int(i)] for i in te_idx])
+        ytr, yte = labels[tr_idx], labels[te_idx]
+    else:
+        rng = np.random.RandomState(args.seed)
+        n, s = 512, args.image_size
+        labels = rng.randint(0, 4, n).astype(np.int32)
+        # Class-dependent mean shift makes the synthetic task learnable.
+        imgs = (rng.rand(n, s, s, 3) * 0.5
+                + labels[:, None, None, None] * 0.125).astype(np.float32)
+        xtr, ytr = imgs[:384], labels[:384]
+        xte, yte = imgs[384:], labels[384:]
+    xtr, ytr = subsample(xtr, ytr, args.max_train, args.seed)
+    xte, yte = subsample(xte, yte, args.max_test, args.seed + 1)
+    to_f32 = lambda x: (x.astype(np.float32) / 255.0  # noqa: E731
+                        if x.dtype == np.uint8 else x.astype(np.float32))
+    return to_f32(xtr), ytr, to_f32(xte), yte
+
+
+def eval_main(argv=None) -> int:
+    args = build_eval_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    if args.image_size is None:
+        args.image_size = 224 if args.dataset == "imagefolder" else 32
+
+    import jax.numpy as jnp
+
+    from ntxent_tpu.models import SimCLRModel
+    from ntxent_tpu.training import (
+        TrainerConfig,
+        create_train_state,
+        extract_features,
+        knn_accuracy,
+        linear_probe,
+    )
+    from ntxent_tpu.training.checkpoint import CheckpointManager
+
+    encoder = _make_encoder(args.model, args.image_size)
+    model = SimCLRModel(encoder=encoder,
+                        proj_hidden_dim=args.proj_hidden_dim,
+                        proj_dim=args.proj_dim)
+    template = create_train_state(
+        model, jax.random.PRNGKey(0),
+        (1, args.image_size, args.image_size, 3),
+        TrainerConfig(accum_steps=args.accum_steps))
+    manager = CheckpointManager(args.ckpt_dir)
+    try:
+        if manager.latest_step() is None:
+            raise SystemExit(f"no checkpoint under {args.ckpt_dir}")
+        state = manager.restore(template)
+    finally:
+        manager.close()
+    logger.info("restored step %d from %s", int(state.step), args.ckpt_dir)
+
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+
+    def apply_features(x):
+        return model.apply(variables, x, train=False, method="features")
+
+    xtr, ytr, xte, yte = _labeled_arrays(args)
+    # One extraction pass over the concatenation: extract_features jits its
+    # argument internally, so two calls would compile the encoder twice.
+    import numpy as np
+
+    feats = extract_features(
+        apply_features, jnp.asarray(np.concatenate([xtr, xte])), args.batch)
+    ftr, fte = feats[:len(xtr)], feats[len(xtr):]
+    ytr, yte = jnp.asarray(ytr), jnp.asarray(yte)
+    num_classes = int(jnp.maximum(ytr.max(), yte.max())) + 1
+    logger.info("features: train %s test %s, %d classes",
+                ftr.shape, fte.shape, num_classes)
+
+    results = {"step": int(state.step)}
+    if args.protocol in ("knn", "both"):
+        results["knn_top1"] = float(
+            knn_accuracy(ftr, ytr, fte, yte, k=args.k))
+        logger.info("kNN (k=%d) top-1: %.4f", args.k, results["knn_top1"])
+    if args.protocol in ("probe", "both"):
+        probe = linear_probe(ftr, ytr, fte, yte, num_classes,
+                             steps=args.probe_steps,
+                             key=jax.random.PRNGKey(args.seed))
+        results["probe_top1"] = float(probe["test_accuracy"])
+        logger.info("linear probe top-1: %.4f", results["probe_top1"])
+    import json
+
+    print(json.dumps(results))
     return 0
 
 

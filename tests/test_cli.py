@@ -78,3 +78,42 @@ def test_cli_synthetic_run_checkpoints_and_resumes(tmp_path):
                             env=env)
     assert second.returncode == 0, second.stdout + second.stderr
     assert "nothing to do" in (second.stdout + second.stderr)
+
+
+@pytest.mark.slow
+def test_cli_train_then_eval(tmp_path):
+    """ntxent-eval restores the ntxent-train checkpoint and reports both
+    SSL protocols on the synthetic labeled task."""
+    import json
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # single device: fastest for a smoke run
+    repo = os.path.dirname(os.path.dirname(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    ckpt = tmp_path / "ckpt"
+    common = ["--model", "tiny", "--image-size", "8",
+              "--proj-hidden-dim", "16", "--proj-dim", "8",
+              "--platform", "cpu"]
+    train = subprocess.run(
+        [sys.executable, "-m", "ntxent_tpu.cli",
+         "--dataset", "synthetic", "--synthetic-samples", "64",
+         "--batch", "16", "--steps", "2", "--warmup-steps", "1",
+         "--ckpt-dir", str(ckpt), "--log-every", "1"] + common,
+        capture_output=True, text=True, timeout=600, env=env)
+    assert train.returncode == 0, train.stdout + train.stderr
+
+    code = (
+        "import sys; from ntxent_tpu.cli import eval_main;"
+        "sys.exit(eval_main(sys.argv[1:]))")
+    ev = subprocess.run(
+        [sys.executable, "-c", code,
+         "--ckpt-dir", str(ckpt), "--dataset", "synthetic",
+         "--probe-steps", "50", "--k", "5",
+         "--max-train", "256", "--max-test", "128"] + common,
+        capture_output=True, text=True, timeout=600, env=env)
+    assert ev.returncode == 0, ev.stdout + ev.stderr
+    result = json.loads(ev.stdout.strip().splitlines()[-1])
+    assert result["step"] == 2
+    assert 0.0 <= result["knn_top1"] <= 1.0
+    assert 0.0 <= result["probe_top1"] <= 1.0
