@@ -53,6 +53,10 @@ class TrainerConfig:
     # optimizer's effective batch, not the loss's negative pool (use the
     # distributed all-gather/ring losses to scale the pool itself).
     accum_steps: int = 1
+    # NOTE on rematerialization: remat is a property of the STEP, not the
+    # config — pass remat=True to make_train_step/make_sharded_train_step
+    # (the CLI's --remat does exactly that). Trades ~1 extra forward of
+    # FLOPs for not keeping encoder activations live across the loss.
 
     @property
     def learning_rate(self) -> float:
@@ -81,25 +85,40 @@ def create_train_state(
     )
 
 
-def _apply_two_views(state: TrainState, params, v1, v2, train: bool = True):
+def _apply_two_views(state: TrainState, params, v1, v2, train: bool = True,
+                     remat: bool = False):
     """Run both views through the model in ONE batched forward (2B on the
-    batch axis keeps the MXU fed and BN statistics shared across views)."""
+    batch axis keeps the MXU fed and BN statistics shared across views).
+
+    ``remat=True`` wraps the forward in ``jax.checkpoint``: encoder
+    activations are recomputed during the backward pass instead of held in
+    HBM across the loss (TrainerConfig.remat).
+    """
     both = jnp.concatenate([v1, v2], axis=0)
     variables = {"params": params, "batch_stats": state.batch_stats}
-    z, updates = state.apply_fn(
-        variables, both, train=train, mutable=["batch_stats"])
+
+    def fwd(variables, x):
+        return state.apply_fn(variables, x, train=train,
+                              mutable=["batch_stats"])
+
+    if remat:
+        fwd = jax.checkpoint(fwd)
+    z, updates = fwd(variables, both)
     n = v1.shape[0]
     return z[:n], z[n:], updates["batch_stats"]
 
 
 def make_train_step(temperature: float = 0.1,
-                    use_fused: bool | None = None) -> Callable:
+                    use_fused: bool | None = None,
+                    remat: bool = False) -> Callable:
     """Single-device train step: fused Pallas loss, donated state.
 
     ``use_fused=None`` auto-selects: the Pallas kernel where it compiles
     natively (TPU), the jnp oracle elsewhere (identical loss — the tests
     prove it — but interpret-mode Pallas on CPU is ~100x slower and
     measures nothing; same policy as api._loss_fn).
+    ``remat`` rematerializes the encoder forward in the backward pass
+    (TrainerConfig.remat).
     """
     if use_fused is None:
         use_fused = jax.default_backend() in ("tpu", "axon")
@@ -111,7 +130,8 @@ def make_train_step(temperature: float = 0.1,
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, v1, v2):
         def loss_fn(params):
-            z1, z2, new_stats = _apply_two_views(state, params, v1, v2)
+            z1, z2, new_stats = _apply_two_views(state, params, v1, v2,
+                                                 remat=remat)
             z = jnp.concatenate([z1, z2], axis=0)
             return loss_impl(z, temperature), new_stats
 
@@ -164,6 +184,7 @@ def make_sharded_train_step(
     temperature: float = 0.1,
     axis: str = "data",
     interpret: bool | None = None,
+    remat: bool = False,
 ) -> Callable:
     """Distributed train step over the mesh's data axis.
 
@@ -177,7 +198,8 @@ def make_sharded_train_step(
 
     def per_device_step(state: TrainState, v1, v2):
         def loss_fn(params):
-            z1, z2, new_stats = _apply_two_views(state, params, v1, v2)
+            z1, z2, new_stats = _apply_two_views(state, params, v1, v2,
+                                                 remat=remat)
             loss = local_ntxent_allgather(
                 z1, z2, temperature, axis, num_devices, interpret)
             return loss, new_stats
