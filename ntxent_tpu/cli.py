@@ -58,6 +58,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic-samples", type=int, default=512)
 
     t = p.add_argument_group("training")
+    t.add_argument("--objective", default="simclr",
+                   choices=["simclr", "clip"],
+                   help="simclr: two-view NT-Xent on --model. clip: "
+                        "symmetric InfoNCE over a dual encoder (--model is "
+                        "the image tower); --data-dir may point to an .npz "
+                        "with 'images' and 'tokens' arrays, else synthetic "
+                        "pairs")
+    t.add_argument("--vocab-size", type=int, default=49408,
+                   help="clip: text-tower vocabulary")
+    t.add_argument("--token-len", type=int, default=77,
+                   help="clip: tokenized caption length")
     t.add_argument("--batch", type=int, default=256,
                    help="GLOBAL batch (split across devices and processes)")
     t.add_argument("--steps", type=int, default=1000)
@@ -174,6 +185,9 @@ def main(argv=None) -> int:
             f"{info['global_device_count']} devices")
     per_process_batch = args.batch // info["process_count"]
 
+    if args.objective == "clip":
+        return _train_clip(args, info, per_process_batch)
+
     from ntxent_tpu.models import SimCLRModel
     from ntxent_tpu.training import (
         PreemptionGuard,
@@ -229,6 +243,138 @@ def main(argv=None) -> int:
     if guard.preempted:
         logger.warning("run was preempted; checkpoint saved at step %d — "
                        "relaunch with the same flags to resume",
+                       int(state.step))
+    return 0
+
+
+def _train_clip(args, info, per_process_batch: int) -> int:
+    """CLIP pretraining branch: dual encoder + symmetric InfoNCE.
+
+    The BASELINE.json configs[4] workload (text-image contrastive,
+    learnable logit scale). Image tower = --model (ViT variants; ResNets
+    are refused — make_clip_train_step carries no BatchNorm state);
+    multi-device runs use the compiler-partitioned TP step on a
+    (data, model) mesh with model_par=1, i.e. pure data parallelism that
+    can be widened to tensor parallelism by reshaping the mesh.
+    """
+    import functools
+
+    import jax
+    import numpy as np
+    import optax
+
+    from ntxent_tpu import models
+    from ntxent_tpu.models import CLIPModel, TextTransformer
+    from ntxent_tpu.parallel.mesh import create_mesh, global_batch
+    from ntxent_tpu.training import PreemptionGuard, fit
+    from ntxent_tpu.training.datasets import PairedArrayLoader
+    from ntxent_tpu.training.lars import cosine_warmup_schedule
+    from ntxent_tpu.training.trainer import TrainState, make_clip_train_step
+
+    if args.model.startswith("resnet"):
+        raise SystemExit("--objective clip takes a ViT image tower "
+                         "(--model vit_*|tiny); the CLIP step carries no "
+                         "BatchNorm state")
+    if args.dataset != "synthetic":
+        raise SystemExit("--objective clip takes paired data via "
+                         "--data-dir pairs.npz (images + tokens arrays); "
+                         "--dataset applies to the simclr objective only")
+    # NOTE --temperature is ignored here by design: CLIP's temperature is
+    # the model's learnable logit scale (models/clip.py).
+    if args.model == "tiny":
+        image_enc = functools.partial(
+            models.VisionTransformer, hidden_dim=32, depth=2, num_heads=2,
+            mlp_dim=64, patch_size=8)
+        text_enc = functools.partial(
+            TextTransformer, vocab_size=args.vocab_size,
+            max_len=args.token_len, hidden_dim=32, depth=2, num_heads=2)
+        embed_dim = 32
+    else:
+        image_enc = _make_encoder(args.model, args.image_size)
+        text_enc = functools.partial(TextTransformer,
+                                     vocab_size=args.vocab_size,
+                                     max_len=args.token_len)
+        embed_dim = 512
+    model = CLIPModel(image_encoder=image_enc, text_encoder=text_enc,
+                      embed_dim=embed_dim)
+
+    # Paired data: .npz with 'images' (N,H,W,C) + 'tokens' (N,L), else
+    # synthetic pairs sized like the real workload.
+    if args.data_dir:
+        with np.load(args.data_dir) as z:
+            images, tokens = z["images"], z["tokens"]
+    else:
+        rng = np.random.RandomState(args.seed)
+        n, s = args.synthetic_samples, args.image_size
+        images = rng.rand(n, s, s, 3).astype(np.float32)
+        tokens = rng.randint(1, args.vocab_size,
+                             (n, args.token_len)).astype(np.int32)
+    loader = PairedArrayLoader(images, tokens, per_process_batch,
+                               seed=args.seed,
+                               shard_index=info["process_index"],
+                               shard_count=info["process_count"])
+
+    variables = model.init(jax.random.PRNGKey(args.seed),
+                           np.zeros((1, args.image_size, args.image_size, 3),
+                                    np.float32),
+                           np.zeros((1, args.token_len), np.int32),
+                           train=False)
+    schedule = cosine_warmup_schedule(args.base_lr, args.warmup_steps,
+                                      args.steps)
+    tx = optax.adamw(schedule, weight_decay=args.weight_decay)
+    if args.accum_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=args.accum_steps)
+    state = TrainState.create(apply_fn=model.apply,
+                              params=variables["params"], tx=tx)
+
+    n_dev = info["global_device_count"]
+    if n_dev > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ntxent_tpu.parallel.tp import (
+            make_tp_clip_train_step, shard_train_state)
+
+        mesh = create_mesh(shape=(n_dev, 1), axis_names=("data", "model"))
+        state = shard_train_state(state, mesh)
+        step = make_tp_clip_train_step(mesh, remat=args.remat)
+        sharding = NamedSharding(mesh, P("data"))
+        multiprocess = info["process_count"] > 1
+
+        class ShardedPairs:
+            def state(self):
+                return loader.state()
+
+            def restore(self, s):
+                loader.restore(s)
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                imgs, toks = next(loader)
+                if multiprocess:
+                    return global_batch((imgs, toks), mesh)
+                return (jax.device_put(imgs, sharding),
+                        jax.device_put(toks, sharding))
+
+        data = ShardedPairs()
+        logger.info("CLIP data-parallel over %d devices", n_dev)
+    else:
+        step = make_clip_train_step(remat=args.remat)
+        data = loader
+        logger.info("CLIP single-device run")
+
+    with PreemptionGuard() as guard:
+        state, history = fit(
+            state, data, step, num_steps=args.steps,
+            checkpoint_dir=args.ckpt_dir, checkpoint_every=args.ckpt_every,
+            log_every=args.log_every, stop_fn=guard.requested)
+    if history:
+        last = history[-1]
+        logger.info("final: step %d loss %.4f (%.2f steps/s)",
+                    last["step"], last["loss"], last["steps_per_sec"])
+    if guard.preempted:
+        logger.warning("run was preempted; checkpoint saved at step %d",
                        int(state.step))
     return 0
 

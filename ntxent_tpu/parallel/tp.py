@@ -183,6 +183,7 @@ def make_tp_clip_train_step(
     mesh: Mesh,
     *,
     data_axis: str = "data",
+    remat: bool = False,
 ) -> Callable:
     """Compiler-partitioned CLIP train step: dual towers, learnable scale.
 
@@ -190,7 +191,8 @@ def make_tp_clip_train_step(
     ``(image_embeds, text_embeds, scale)`` (models/clip.py). The symmetric
     InfoNCE runs at temperature ``1/scale`` so the logit scale's gradient
     flows; GSPMD shards both towers over ``model`` and the (N, N) logit
-    matmul over the mesh.
+    matmul over the mesh. ``remat`` rematerializes the tower forwards in
+    the backward pass.
     """
 
     @functools.partial(jax.jit, donate_argnums=(0,))
@@ -198,9 +200,13 @@ def make_tp_clip_train_step(
         imc = _constrain_batch(images, mesh, data_axis)
         tkc = _constrain_batch(tokens, mesh, data_axis)
 
+        def fwd(params, imc, tkc):
+            return state.apply_fn({"params": params}, imc, tkc, train=True)
+
+        towers = jax.checkpoint(fwd) if remat else fwd
+
         def loss_fn(params):
-            zi, zt, scale = state.apply_fn({"params": params}, imc, tkc,
-                                           train=True)
+            zi, zt, scale = towers(params, imc, tkc)
             zi = _constrain_batch(zi, mesh, data_axis)
             zt = _constrain_batch(zt, mesh, data_axis)
             return info_nce_loss(zi, zt, temperature=1.0 / scale)

@@ -15,6 +15,7 @@ from ntxent_tpu.training.datasets import (
     Cifar10Source,
     GlobalTwoViewPipeline,
     ImageFolderSource,
+    PairedArrayLoader,
     StreamingLoader,
     TwoViewPipeline,
     device_prefetch,
@@ -54,6 +55,7 @@ __all__ = [
     "Cifar10Source",
     "GlobalTwoViewPipeline",
     "ImageFolderSource",
+    "PairedArrayLoader",
     "StreamingLoader",
     "TwoViewPipeline",
     "device_prefetch",

@@ -145,45 +145,77 @@ class ArraySource:
         return np.asarray(self.images[idx])
 
 
-class StreamingLoader:
-    """Seeded shuffling batch loader with threaded read-ahead.
+class _ShardedShuffle:
+    """Shared seeded-permutation + shard-slice arithmetic for the
+    checkpointable loaders — ONE source of truth for the resume and
+    multi-process-sharding math (StreamingLoader, PairedArrayLoader).
 
-    Iterating yields uint8/float (B, H, W, C) numpy batches forever (epoch
-    loop). ``state()`` / ``restore()`` give exact mid-epoch resumability:
-    the permutation is a pure function of (seed, epoch), so (epoch, offset)
-    pins the next batch precisely.
+    Every process computes the SAME seeded global order (a pure function
+    of seed and epoch) and yields only its ``batch_size``-row slice of
+    each global batch of ``batch_size * shard_count`` rows: disjoint by
+    construction, no coordination needed (the per-rank DataLoader role of
+    the reference's implied MPI launch, SURVEY.md §2.2).
     """
 
-    def __init__(self, source, batch_size: int, seed: int = 0,
-                 num_threads: int = 8, read_ahead: int = 4,
-                 drop_remainder: bool = True,
-                 shard_index: int = 0, shard_count: int = 1):
-        """``shard_index``/``shard_count``: multi-process data sharding —
-        every process computes the SAME seeded global order (a pure function
-        of seed and epoch) and yields only its ``batch_size``-row slice of
-        each global batch of ``batch_size * shard_count`` rows. Disjoint by
-        construction, no coordination needed (the per-rank DataLoader role
-        of the reference's implied MPI launch, SURVEY.md §2.2)."""
+    def _init_shuffle(self, n_rows: int, batch_size: int, seed: int,
+                      shard_index: int, shard_count: int,
+                      drop_remainder: bool = True) -> None:
         if not 0 <= shard_index < shard_count:
             raise ValueError(f"shard {shard_index} not in [0, {shard_count})")
         if shard_count > 1 and not drop_remainder:
             raise ValueError("sharded loading requires drop_remainder=True "
                              "(a ragged tail batch would leave shards with "
                              "unequal row counts)")
-        if len(source) < batch_size * shard_count:
+        if n_rows < batch_size * shard_count:
             raise ValueError(
-                f"source of {len(source)} < global batch "
+                f"source of {n_rows} < global batch "
                 f"{batch_size * shard_count}")
-        self.source = source
+        self._n_rows = n_rows
         self.batch_size = batch_size
         self.seed = seed
-        self.num_threads = num_threads
-        self.read_ahead = max(1, read_ahead)
-        self.drop_remainder = drop_remainder
         self.shard_index = shard_index
         self.shard_count = shard_count
+        self.drop_remainder = drop_remainder
         self._epoch = 0
         self._offset = 0  # batches already yielded within the epoch
+
+    def batches_per_epoch(self) -> int:
+        rows = self.batch_size * self.shard_count
+        n = self._n_rows // rows
+        if not self.drop_remainder and self._n_rows % rows:
+            n += 1
+        return n
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, epoch]))
+        return rng.permutation(self._n_rows)
+
+    def _batch_indices(self, order: np.ndarray, bi: int) -> np.ndarray:
+        rows = self.batch_size * self.shard_count
+        lo = bi * rows + self.shard_index * self.batch_size
+        return order[lo:lo + self.batch_size]
+
+
+class StreamingLoader(_ShardedShuffle):
+    """Seeded shuffling batch loader with threaded read-ahead.
+
+    Iterating yields uint8/float (B, H, W, C) numpy batches forever (epoch
+    loop). ``state()`` / ``restore()`` give exact mid-epoch resumability:
+    the permutation is a pure function of (seed, epoch), so (epoch, offset)
+    pins the next batch precisely. ``shard_index``/``shard_count``:
+    coordination-free multi-process sharding (see ``_ShardedShuffle``).
+    """
+
+    def __init__(self, source, batch_size: int, seed: int = 0,
+                 num_threads: int = 8, read_ahead: int = 4,
+                 drop_remainder: bool = True,
+                 shard_index: int = 0, shard_count: int = 1):
+        self._init_shuffle(len(source), batch_size, seed, shard_index,
+                           shard_count, drop_remainder)
+        self.source = source
+        self.num_threads = num_threads
+        self.read_ahead = max(1, read_ahead)
         self._lock = threading.Lock()
 
     # -- checkpointable-iterator protocol (trainer.fit looks for these) --
@@ -197,18 +229,6 @@ class StreamingLoader:
             self.seed = int(state["seed"])
             self._epoch = int(state["epoch"])
             self._offset = int(state["offset"])
-
-    def batches_per_epoch(self) -> int:
-        rows = self.batch_size * self.shard_count
-        n = len(self.source) // rows
-        if not self.drop_remainder and len(self.source) % rows:
-            n += 1
-        return n
-
-    def _epoch_order(self, epoch: int) -> np.ndarray:
-        rng = np.random.default_rng(
-            np.random.SeedSequence([self.seed, epoch]))
-        return rng.permutation(len(self.source))
 
     def __iter__(self) -> Iterator[np.ndarray]:
         # Not a `with` block: a generator abandoned mid-epoch is finalized
@@ -229,9 +249,7 @@ class StreamingLoader:
                 bi = start
                 while bi < nb or pending:
                     while bi < nb and len(pending) < self.read_ahead:
-                        rows = self.batch_size * self.shard_count
-                        lo = bi * rows + self.shard_index * self.batch_size
-                        idxs = order[lo:lo + self.batch_size]
+                        idxs = self._batch_indices(order, bi)
                         pending.append([
                             pool.submit(self.source.__getitem__, int(i))
                             for i in idxs])
@@ -320,6 +338,60 @@ class TwoViewPipeline:
                 self.loader, self.key, blur=self.blur,
                 sharding=self.sharding)
         return next(self._gen)
+
+
+class PairedArrayLoader(_ShardedShuffle):
+    """(images, tokens) paired-batch loader for CLIP-style training, with
+    the same checkpointable-iterator protocol as ``StreamingLoader``
+    (seeded per-epoch shuffle, ``state()``/``restore()`` exact resume,
+    coordination-free multi-process sharding — all via ``_ShardedShuffle``).
+
+    In-memory arrays only: the contrastive text-image workload
+    (BASELINE.json configs[4]) feeds from pre-tokenized pairs; for
+    disk-resident images compose ``ImageFolderSource`` + your tokenizer
+    into arrays first (or use grain).
+    """
+
+    def __init__(self, images, tokens, batch_size: int, seed: int = 0,
+                 shard_index: int = 0, shard_count: int = 1):
+        images = np.asarray(images)
+        tokens = np.asarray(tokens)
+        if len(images) != len(tokens):
+            raise ValueError(f"{len(images)} images vs {len(tokens)} tokens")
+        self._init_shuffle(len(images), batch_size, seed, shard_index,
+                           shard_count)
+        self.images, self.tokens = images, tokens
+        self._gen = None
+
+    def state(self) -> dict:
+        return {"epoch": self._epoch, "offset": self._offset,
+                "seed": self.seed}
+
+    def restore(self, state: dict) -> None:
+        if self._gen is not None:
+            raise RuntimeError("restore() must run before iteration starts")
+        self.seed = int(state["seed"])
+        self._epoch = int(state["epoch"])
+        self._offset = int(state["offset"])
+
+    def __next__(self):
+        if self._gen is None:
+            self._gen = self._generate()
+        return next(self._gen)
+
+    def __iter__(self):
+        return self
+
+    def _generate(self):
+        while True:
+            order = self._epoch_order(self._epoch)
+            nb = self.batches_per_epoch()
+            for bi in range(self._offset, nb):
+                idx = self._batch_indices(order, bi)
+                self._offset += 1
+                yield self.images[idx], self.tokens[idx]
+            self._epoch += 1
+            self._offset = 0
 
 
 class GlobalTwoViewPipeline:

@@ -144,12 +144,14 @@ def make_train_step(temperature: float = 0.1,
     return train_step
 
 
-def make_clip_train_step(use_fused: bool | None = None) -> Callable:
+def make_clip_train_step(use_fused: bool | None = None,
+                         remat: bool = False) -> Callable:
     """Single-device CLIP train step: dual towers, learnable logit scale.
 
     ``state.apply_fn(variables, images, tokens)`` must return
     ``(image_embeds, text_embeds, scale)`` (models/clip.py). Symmetric
     InfoNCE runs at temperature ``1/scale`` so the scale's gradient flows.
+    ``remat`` rematerializes the tower forwards in the backward pass.
     The multi-chip equivalents are ``parallel.tp.make_tp_clip_train_step``
     (GSPMD) and the ring/all-gather InfoNCE losses (parallel/).
     """
@@ -168,9 +170,14 @@ def make_clip_train_step(use_fused: bool | None = None) -> Callable:
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, images, tokens):
+        def fwd(params, images, tokens):
+            return state.apply_fn({"params": params}, images, tokens,
+                                  train=True)
+
+        towers = jax.checkpoint(fwd) if remat else fwd
+
         def loss_fn(params):
-            zi, zt, scale = state.apply_fn({"params": params}, images,
-                                           tokens, train=True)
+            zi, zt, scale = towers(params, images, tokens)
             return loss_of(zi, zt, scale)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
