@@ -190,10 +190,8 @@ def main(argv=None) -> int:
 
     from ntxent_tpu.models import SimCLRModel
     from ntxent_tpu.training import (
-        PreemptionGuard,
         TrainerConfig,
         create_train_state,
-        fit,
         make_train_step,
     )
     from ntxent_tpu.training.trainer import make_sharded_train_step
@@ -229,6 +227,15 @@ def main(argv=None) -> int:
         step = make_train_step(cfg.temperature, remat=args.remat)
         data = _make_pipeline(args, per_process_batch)
         logger.info("single-device run")
+
+    return _run_fit(data, state, step, args)
+
+
+def _run_fit(data, state, step, args) -> int:
+    """Shared training epilogue: preemption-guarded fit + final report
+    (one copy for both objectives, so the resume hint and MFU line cannot
+    drift)."""
+    from ntxent_tpu.training import PreemptionGuard, fit
 
     with PreemptionGuard() as guard:
         state, history = fit(
@@ -266,7 +273,6 @@ def _train_clip(args, info, per_process_batch: int) -> int:
     from ntxent_tpu import models
     from ntxent_tpu.models import CLIPModel, TextTransformer
     from ntxent_tpu.parallel.mesh import create_mesh, global_batch
-    from ntxent_tpu.training import PreemptionGuard, fit
     from ntxent_tpu.training.datasets import PairedArrayLoader
     from ntxent_tpu.training.lars import cosine_warmup_schedule
     from ntxent_tpu.training.trainer import TrainState, make_clip_train_step
@@ -303,6 +309,19 @@ def _train_clip(args, info, per_process_batch: int) -> int:
     if args.data_dir:
         with np.load(args.data_dir) as z:
             images, tokens = z["images"], z["tokens"]
+        # The arrays are the truth for the model's static shapes: derive
+        # them (a mismatching explicit flag fails loudly here instead of as
+        # a broadcast error inside jit).
+        if args.image_size not in (None, 32, images.shape[1]):
+            raise SystemExit(f"--image-size {args.image_size} != images in "
+                             f"{args.data_dir} ({images.shape[1]})")
+        args.image_size = int(images.shape[1])
+        args.token_len = int(tokens.shape[1])
+        if int(tokens.max()) >= args.vocab_size:
+            raise SystemExit(
+                f"tokens contain id {int(tokens.max())} >= --vocab-size "
+                f"{args.vocab_size} (XLA would clamp the embedding gather "
+                f"silently)")
     else:
         rng = np.random.RandomState(args.seed)
         n, s = args.synthetic_samples, args.image_size
@@ -328,6 +347,8 @@ def _train_clip(args, info, per_process_batch: int) -> int:
                               params=variables["params"], tx=tx)
 
     n_dev = info["global_device_count"]
+    mesh = sharding = None
+    multiprocess = info["process_count"] > 1
     if n_dev > 1:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -338,45 +359,37 @@ def _train_clip(args, info, per_process_batch: int) -> int:
         state = shard_train_state(state, mesh)
         step = make_tp_clip_train_step(mesh, remat=args.remat)
         sharding = NamedSharding(mesh, P("data"))
-        multiprocess = info["process_count"] > 1
-
-        class ShardedPairs:
-            def state(self):
-                return loader.state()
-
-            def restore(self, s):
-                loader.restore(s)
-
-            def __iter__(self):
-                return self
-
-            def __next__(self):
-                imgs, toks = next(loader)
-                if multiprocess:
-                    return global_batch((imgs, toks), mesh)
-                return (jax.device_put(imgs, sharding),
-                        jax.device_put(toks, sharding))
-
-        data = ShardedPairs()
         logger.info("CLIP data-parallel over %d devices", n_dev)
     else:
         step = make_clip_train_step(remat=args.remat)
-        data = loader
         logger.info("CLIP single-device run")
 
-    with PreemptionGuard() as guard:
-        state, history = fit(
-            state, data, step, num_steps=args.steps,
-            checkpoint_dir=args.ckpt_dir, checkpoint_every=args.ckpt_every,
-            log_every=args.log_every, stop_fn=guard.requested)
-    if history:
-        last = history[-1]
-        logger.info("final: step %d loss %.4f (%.2f steps/s)",
-                    last["step"], last["loss"], last["steps_per_sec"])
-    if guard.preempted:
-        logger.warning("run was preempted; checkpoint saved at step %d",
-                       int(state.step))
-    return 0
+    class ClipBatches:
+        """Loader passthrough (checkpointable state) + uint8 -> [0, 1]
+        normalization (the convention every other input path applies) +
+        optional sharded placement."""
+
+        def state(self):
+            return loader.state()
+
+        def restore(self, s):
+            loader.restore(s)
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            imgs, toks = next(loader)
+            if imgs.dtype == np.uint8:
+                imgs = imgs.astype(np.float32) / 255.0
+            if multiprocess:
+                return global_batch((imgs, toks), mesh)
+            if sharding is not None:
+                return (jax.device_put(imgs, sharding),
+                        jax.device_put(toks, sharding))
+            return imgs, toks
+
+    return _run_fit(ClipBatches(), state, step, args)
 
 
 def build_eval_parser() -> argparse.ArgumentParser:

@@ -196,3 +196,48 @@ def test_explicit_coordinator_failure_propagates():
     assert "SILENT_FALLBACK" not in out, out
     assert ("RAISED_AS_EXPECTED" in out
             or ("DEADLINE_EXCEEDED" in out and proc.returncode != 0)), out
+
+
+@pytest.mark.slow
+def test_cli_two_process_launch(tmp_path):
+    """ntxent-train's multi-host flags end to end: two OS processes
+    rendezvous via --coordinator, train the sharded step over one global
+    4-device mesh with per-process data shards, and checkpoint."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2").strip()
+    repo = os.path.dirname(os.path.dirname(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    ckpt = tmp_path / "ckpt"
+
+    def cmd(pid):
+        return [sys.executable, "-m", "ntxent_tpu.cli",
+                "--dataset", "synthetic", "--model", "tiny",
+                "--image-size", "8", "--synthetic-samples", "64",
+                "--batch", "16", "--steps", "2", "--warmup-steps", "1",
+                "--proj-hidden-dim", "16", "--proj-dim", "8",
+                "--ckpt-dir", str(ckpt), "--log-every", "1",
+                "--platform", "cpu",
+                "--coordinator", coordinator,
+                "--num-processes", "2", "--process-id", str(pid)]
+
+    procs = [subprocess.Popen(cmd(pid), stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True, env=env)
+             for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"process {pid} rc={p.returncode}:\n{out[-4000:]}")
+        assert "data-parallel over 4 devices (2 process(es))" in out, out[-2000:]
+        assert "final: step 2" in out, out[-2000:]
+    assert ckpt.exists() and any(ckpt.iterdir())
