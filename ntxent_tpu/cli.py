@@ -67,8 +67,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "pairs")
     t.add_argument("--vocab-size", type=int, default=49408,
                    help="clip: text-tower vocabulary")
-    t.add_argument("--token-len", type=int, default=77,
-                   help="clip: tokenized caption length")
+    t.add_argument("--token-len", type=int, default=None,
+                   help="clip: tokenized caption length (derived from "
+                        "--data-dir tokens when given; 77 for synthetic)")
     t.add_argument("--batch", type=int, default=256,
                    help="GLOBAL batch (split across devices and processes)")
     t.add_argument("--steps", type=int, default=1000)
@@ -177,8 +178,6 @@ def main(argv=None) -> int:
     info = process_info()
     logger.info("topology: %s", info)
 
-    if args.image_size is None:
-        args.image_size = 224 if args.dataset == "imagefolder" else 32
     if args.batch % info["global_device_count"]:
         raise SystemExit(
             f"--batch {args.batch} must divide across "
@@ -186,7 +185,11 @@ def main(argv=None) -> int:
     per_process_batch = args.batch // info["process_count"]
 
     if args.objective == "clip":
+        # image_size stays None here: the clip branch derives it from the
+        # paired data, and a conflicting EXPLICIT flag must fail loudly.
         return _train_clip(args, info, per_process_batch)
+    if args.image_size is None:
+        args.image_size = 224 if args.dataset == "imagefolder" else 32
 
     from ntxent_tpu.models import SimCLRModel
     from ntxent_tpu.training import (
@@ -287,6 +290,46 @@ def _train_clip(args, info, per_process_batch: int) -> int:
                          "--dataset applies to the simclr objective only")
     # NOTE --temperature is ignored here by design: CLIP's temperature is
     # the model's learnable logit scale (models/clip.py).
+
+    # Paired data FIRST — the arrays are the truth for every static shape
+    # the towers are built with (a conflicting explicit flag fails loudly
+    # here instead of as a broadcast error inside jit).
+    if args.data_dir:
+        with np.load(args.data_dir) as z:
+            images, tokens = z["images"], z["tokens"]
+        if images.ndim != 4 or images.shape[1] != images.shape[2] \
+                or images.shape[3] != 3:
+            raise SystemExit(f"images in {args.data_dir} must be square "
+                             f"NHWC with 3 channels, got {images.shape}")
+        if args.image_size is not None \
+                and args.image_size != images.shape[1]:
+            raise SystemExit(f"--image-size {args.image_size} != images in "
+                             f"{args.data_dir} ({images.shape[1]})")
+        if args.token_len is not None \
+                and args.token_len != tokens.shape[1]:
+            raise SystemExit(f"--token-len {args.token_len} != tokens in "
+                             f"{args.data_dir} ({tokens.shape[1]})")
+        args.image_size = int(images.shape[1])
+        args.token_len = int(tokens.shape[1])
+        tmin, tmax = int(tokens.min()), int(tokens.max())
+        if tmax >= args.vocab_size or tmin < 0:
+            raise SystemExit(
+                f"token ids span [{tmin}, {tmax}] outside [0, --vocab-size "
+                f"{args.vocab_size}) (XLA would clamp the embedding gather "
+                f"silently)")
+    else:
+        if args.image_size is None:
+            args.image_size = 32
+        if args.token_len is None:
+            args.token_len = 77
+        rng = np.random.RandomState(args.seed)
+        n, s = args.synthetic_samples, args.image_size
+        images = rng.rand(n, s, s, 3).astype(np.float32)
+        tokens = rng.randint(1, args.vocab_size,
+                             (n, args.token_len)).astype(np.int32)
+
+    # Towers are built AFTER the data derivation above so the text tower's
+    # max_len and the image tower's size match what will be fed.
     if args.model == "tiny":
         image_enc = functools.partial(
             models.VisionTransformer, hidden_dim=32, depth=2, num_heads=2,
@@ -303,31 +346,6 @@ def _train_clip(args, info, per_process_batch: int) -> int:
         embed_dim = 512
     model = CLIPModel(image_encoder=image_enc, text_encoder=text_enc,
                       embed_dim=embed_dim)
-
-    # Paired data: .npz with 'images' (N,H,W,C) + 'tokens' (N,L), else
-    # synthetic pairs sized like the real workload.
-    if args.data_dir:
-        with np.load(args.data_dir) as z:
-            images, tokens = z["images"], z["tokens"]
-        # The arrays are the truth for the model's static shapes: derive
-        # them (a mismatching explicit flag fails loudly here instead of as
-        # a broadcast error inside jit).
-        if args.image_size not in (None, 32, images.shape[1]):
-            raise SystemExit(f"--image-size {args.image_size} != images in "
-                             f"{args.data_dir} ({images.shape[1]})")
-        args.image_size = int(images.shape[1])
-        args.token_len = int(tokens.shape[1])
-        if int(tokens.max()) >= args.vocab_size:
-            raise SystemExit(
-                f"tokens contain id {int(tokens.max())} >= --vocab-size "
-                f"{args.vocab_size} (XLA would clamp the embedding gather "
-                f"silently)")
-    else:
-        rng = np.random.RandomState(args.seed)
-        n, s = args.synthetic_samples, args.image_size
-        images = rng.rand(n, s, s, 3).astype(np.float32)
-        tokens = rng.randint(1, args.vocab_size,
-                             (n, args.token_len)).astype(np.int32)
     loader = PairedArrayLoader(images, tokens, per_process_batch,
                                seed=args.seed,
                                shard_index=info["process_index"],
@@ -364,10 +382,16 @@ def _train_clip(args, info, per_process_batch: int) -> int:
         step = make_clip_train_step(remat=args.remat)
         logger.info("CLIP single-device run")
 
+    import jax.numpy as jnp
+
+    # uint8 -> [0, 1] happens ON DEVICE, after placement: only the raw
+    # bytes cross the host boundary (4x fewer than f32 — the same
+    # convention GlobalTwoViewPipeline documents for the SimCLR path).
+    _normalize = jax.jit(lambda x: x.astype(jnp.float32) / 255.0)
+
     class ClipBatches:
-        """Loader passthrough (checkpointable state) + uint8 -> [0, 1]
-        normalization (the convention every other input path applies) +
-        optional sharded placement."""
+        """Loader passthrough (checkpointable state) + sharded placement +
+        on-device uint8 normalization."""
 
         def state(self):
             return loader.state()
@@ -380,13 +404,13 @@ def _train_clip(args, info, per_process_batch: int) -> int:
 
         def __next__(self):
             imgs, toks = next(loader)
-            if imgs.dtype == np.uint8:
-                imgs = imgs.astype(np.float32) / 255.0
             if multiprocess:
-                return global_batch((imgs, toks), mesh)
-            if sharding is not None:
-                return (jax.device_put(imgs, sharding),
-                        jax.device_put(toks, sharding))
+                imgs, toks = global_batch((imgs, toks), mesh)
+            elif sharding is not None:
+                imgs = jax.device_put(imgs, sharding)
+                toks = jax.device_put(toks, sharding)
+            if imgs.dtype == jnp.uint8:
+                imgs = _normalize(imgs)
             return imgs, toks
 
     return _run_fit(ClipBatches(), state, step, args)

@@ -177,3 +177,70 @@ def test_cli_clip_objective_runs_and_resumes(tmp_path):
                             env=env)
     assert second.returncode == 0, second.stdout + second.stderr
     assert "nothing to do" in (second.stdout + second.stderr)
+
+
+def _clip_npz_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _write_pairs(path, image_size=16, n=32, token_len=8, vocab=64,
+                 dtype=np.uint8, bad_token=None):
+    rng = np.random.RandomState(0)
+    images = rng.rand(n, image_size, image_size, 3)
+    images = ((images * 255).astype(np.uint8) if dtype == np.uint8
+              else images.astype(np.float32))
+    tokens = rng.randint(1, vocab, (n, token_len)).astype(np.int32)
+    tokens[:, -1] = 0  # pad sentinel: id 0 must be accepted
+    if bad_token is not None:
+        tokens[0, 0] = bad_token
+    np.savez(path, images=images, tokens=tokens)
+    return path
+
+
+@pytest.mark.slow  # each case pays a subprocess JAX cold start
+class TestClipNpzValidation:
+    def _run(self, tmp_path, extra, **pairs_kw):
+        npz = _write_pairs(tmp_path / "pairs.npz", **pairs_kw)
+        cmd = [sys.executable, "-m", "ntxent_tpu.cli",
+               "--objective", "clip", "--model", "tiny",
+               "--data-dir", str(npz), "--vocab-size", "64",
+               "--batch", "8", "--steps", "1", "--warmup-steps", "1",
+               "--platform", "cpu"] + extra
+        return subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=300, env=_clip_npz_env())
+
+    def test_negative_token_id_rejected(self, tmp_path):
+        p = self._run(tmp_path, [], bad_token=-1)
+        assert p.returncode != 0
+        assert "token ids span" in p.stdout + p.stderr
+
+    def test_out_of_vocab_token_rejected(self, tmp_path):
+        p = self._run(tmp_path, [], bad_token=99)
+        assert p.returncode != 0
+        assert "token ids span" in p.stdout + p.stderr
+
+    def test_explicit_image_size_mismatch_rejected(self, tmp_path):
+        p = self._run(tmp_path, ["--image-size", "32"], image_size=16)
+        assert p.returncode != 0
+        assert "--image-size 32 != images" in p.stdout + p.stderr
+
+
+@pytest.mark.slow
+def test_cli_clip_uint8_npz_trains(tmp_path):
+    """Shapes derive from the npz (16px, 8 tokens) and uint8 images train
+    after on-device normalization."""
+    npz = _write_pairs(tmp_path / "pairs.npz", image_size=16, token_len=8)
+    cmd = [sys.executable, "-m", "ntxent_tpu.cli",
+           "--objective", "clip", "--model", "tiny",
+           "--data-dir", str(npz), "--vocab-size", "64",
+           "--batch", "8", "--steps", "2", "--warmup-steps", "1",
+           "--log-every", "1", "--platform", "cpu"]
+    p = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                       env=_clip_npz_env())
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "final: step 2" in p.stdout + p.stderr

@@ -1,0 +1,38 @@
+"""Timing/profiling utilities (SURVEY.md §5.1): the re-hosted equivalents
+of the reference's harness-side timing (benchmark.cpp:30-39) and
+-lineinfo/profiling build plumbing."""
+
+import jax
+import jax.numpy as jnp
+
+from ntxent_tpu.utils.profiling import measured_flops, time_fn, trace
+
+
+def test_time_fn_stats_are_consistent(rng):
+    f = jax.jit(lambda x: (x @ x.T).sum())
+    x = jax.random.normal(rng, (64, 64))
+    r = time_fn(f, x, warmup=2, runs=10)
+    assert 0 < r.min_ms <= r.mean_ms <= r.max_ms
+    assert r.std_ms >= 0
+    d = r.as_dict()
+    assert set(d) == {"mean_ms", "std_ms", "min_ms", "max_ms"}
+
+
+def test_measured_flops_matches_matmul_arithmetic(rng):
+    m, k, n = 128, 64, 32
+    a = jax.random.normal(rng, (m, k))
+    b = jax.random.normal(jax.random.fold_in(rng, 1), (k, n))
+    flops = measured_flops(lambda a, b: a @ b, a, b)
+    if flops is None:  # backend offers no cost analysis: nothing to pin
+        return
+    # XLA counts a multiply-add as 2 FLOPs: 2*m*k*n for the matmul.
+    assert abs(flops - 2 * m * k * n) / (2 * m * k * n) < 0.05, flops
+
+
+def test_trace_writes_profile_artifacts(tmp_path, rng):
+    f = jax.jit(lambda x: jnp.sin(x).sum())
+    with trace(str(tmp_path)) as log_dir:
+        jax.block_until_ready(f(jax.random.normal(rng, (256,))))
+    assert log_dir == str(tmp_path)
+    produced = list(tmp_path.rglob("*"))
+    assert produced, "trace() produced no profiler artifacts"
