@@ -65,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "the image tower); --data-dir may point to an .npz "
                         "with 'images' and 'tokens' arrays, else synthetic "
                         "pairs")
+    t.add_argument("--clip-parallel", default="dp", choices=["dp", "tp"],
+                   help="clip multi-device strategy: dp = shard_map data "
+                        "parallelism with the fused partial InfoNCE (the "
+                        "production TPU path); tp = compiler-partitioned "
+                        "(data, model) mesh for towers that need sharding")
     t.add_argument("--vocab-size", type=int, default=49408,
                    help="clip: text-tower vocabulary")
     t.add_argument("--token-len", type=int, default=None,
@@ -370,14 +375,25 @@ def _train_clip(args, info, per_process_batch: int) -> int:
     if n_dev > 1:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from ntxent_tpu.parallel.tp import (
-            make_tp_clip_train_step, shard_train_state)
+        if args.clip_parallel == "tp":
+            from ntxent_tpu.parallel.tp import (
+                make_tp_clip_train_step, shard_train_state)
 
-        mesh = create_mesh(shape=(n_dev, 1), axis_names=("data", "model"))
-        state = shard_train_state(state, mesh)
-        step = make_tp_clip_train_step(mesh, remat=args.remat)
+            mesh = create_mesh(shape=(n_dev, 1),
+                               axis_names=("data", "model"))
+            state = shard_train_state(state, mesh)
+            step = make_tp_clip_train_step(mesh, remat=args.remat)
+            logger.info("CLIP GSPMD (data, model) mesh over %d devices",
+                        n_dev)
+        else:
+            from ntxent_tpu.training.trainer import (
+                make_sharded_clip_train_step)
+
+            mesh = create_mesh(axis_names=("data",))
+            step = make_sharded_clip_train_step(mesh, remat=args.remat)
+            logger.info("CLIP shard_map data-parallel over %d devices "
+                        "(fused partial InfoNCE)", n_dev)
         sharding = NamedSharding(mesh, P("data"))
-        logger.info("CLIP data-parallel over %d devices", n_dev)
     else:
         step = make_clip_train_step(remat=args.remat)
         logger.info("CLIP single-device run")

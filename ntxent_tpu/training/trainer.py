@@ -26,13 +26,15 @@ from flax.training import train_state
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.ntxent_pallas import ntxent_loss_fused
-from ..parallel.dist_loss import local_ntxent_allgather
+from ..parallel.dist_loss import local_infonce_allgather, local_ntxent_allgather
 from .lars import cosine_warmup_schedule, create_lars, simclr_learning_rate
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["TrainState", "create_train_state", "make_train_step",
-           "make_sharded_train_step", "train_loop", "fit", "TrainerConfig"]
+           "make_clip_train_step", "make_sharded_train_step",
+           "make_sharded_clip_train_step", "train_loop", "fit",
+           "TrainerConfig"]
 
 
 class TrainState(train_state.TrainState):
@@ -144,6 +146,18 @@ def make_train_step(temperature: float = 0.1,
     return train_step
 
 
+def _clip_towers(state, remat: bool):
+    """Dual-tower forward closure shared by both CLIP steps (the analog of
+    ``_apply_two_views`` for the SimCLR pair): params -> (zi, zt, scale),
+    optionally rematerialized in the backward pass."""
+
+    def fwd(params, images, tokens):
+        return state.apply_fn({"params": params}, images, tokens,
+                              train=True)
+
+    return jax.checkpoint(fwd) if remat else fwd
+
+
 def make_clip_train_step(use_fused: bool | None = None,
                          remat: bool = False) -> Callable:
     """Single-device CLIP train step: dual towers, learnable logit scale.
@@ -170,11 +184,7 @@ def make_clip_train_step(use_fused: bool | None = None,
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, images, tokens):
-        def fwd(params, images, tokens):
-            return state.apply_fn({"params": params}, images, tokens,
-                                  train=True)
-
-        towers = jax.checkpoint(fwd) if remat else fwd
+        towers = _clip_towers(state, remat)
 
         def loss_fn(params):
             zi, zt, scale = towers(params, images, tokens)
@@ -218,6 +228,45 @@ def make_sharded_train_step(
         state = state.apply_gradients(grads=grads)
         state = state.replace(batch_stats=new_stats)
         return state, {"loss": loss}
+
+    sharded = jax.shard_map(
+        per_device_step,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_sharded_clip_train_step(
+    mesh: Mesh,
+    axis: str = "data",
+    interpret: bool | None = None,
+    remat: bool = False,
+) -> Callable:
+    """Distributed CLIP train step over the mesh's data axis (shard_map).
+
+    The dual-tower analog of ``make_sharded_train_step``: per-device tower
+    forwards on the local (images, tokens) shard, both modality embeddings
+    all-gathered into the FUSED partial InfoNCE
+    (parallel.dist_loss.local_infonce_allgather — per-device local-rows x
+    global-cols blocks, O(N) residuals), gradients pmean'd. This is the
+    production TPU path for data-parallel CLIP; use
+    ``parallel.tp.make_tp_clip_train_step`` when the towers themselves
+    need sharding (GSPMD tensor parallelism).
+    """
+
+    def per_device_step(state, images, tokens):
+        towers = _clip_towers(state, remat)
+
+        def loss_fn(params):
+            zi, zt, scale = towers(params, images, tokens)
+            return local_infonce_allgather(zi, zt, scale, axis, interpret)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        grads = jax.lax.pmean(grads, axis)
+        return state.apply_gradients(grads=grads), {"loss": loss}
 
     sharded = jax.shard_map(
         per_device_step,

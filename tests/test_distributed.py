@@ -178,3 +178,61 @@ def test_process_info_single_host():
     info = process_info()
     assert info["process_count"] == 1
     assert info["global_device_count"] == jax.device_count()
+
+
+@pytest.mark.slow
+def test_sharded_clip_step_matches_single_device(rng):
+    """make_sharded_clip_train_step (shard_map + fused partial InfoNCE +
+    pmean'd grads) must produce the same first-step loss and updated params
+    as make_clip_train_step on the identical global batch."""
+    import functools
+
+    import optax
+
+    from ntxent_tpu.models import CLIPModel, TextTransformer, VisionTransformer
+    from ntxent_tpu.parallel import create_mesh
+    from ntxent_tpu.training.trainer import (
+        TrainState,
+        make_clip_train_step,
+        make_sharded_clip_train_step,
+        shard_batch,
+    )
+
+    model = CLIPModel(
+        image_encoder=functools.partial(
+            VisionTransformer, hidden_dim=16, depth=1, num_heads=2,
+            mlp_dim=32, patch_size=8, dtype=jnp.float32),
+        text_encoder=functools.partial(
+            TextTransformer, vocab_size=32, max_len=8, hidden_dim=16,
+            depth=1, num_heads=2, dtype=jnp.float32),
+        embed_dim=8,
+    )
+    k1, k2 = jax.random.split(rng)
+    images = jax.random.uniform(k1, (8, 16, 16, 3))
+    tokens = jax.random.randint(k2, (8, 8), 1, 32)
+    variables = model.init(jax.random.PRNGKey(0), images[:1], tokens[:1],
+                           train=False)
+
+    def fresh_state():
+        # Fresh buffers each time: the train steps donate their state, so
+        # sharing `variables` across both runs would hand the second run
+        # deleted arrays.
+        params = jax.tree.map(jnp.array, variables["params"])
+        return TrainState.create(apply_fn=model.apply, params=params,
+                                 tx=optax.sgd(0.05))
+
+    single_step = make_clip_train_step(use_fused=False)
+    s_single, m_single = single_step(fresh_state(), images, tokens)
+
+    mesh = create_mesh(axis_names=("data",))
+    sharded_step = make_sharded_clip_train_step(mesh)
+    imgs_s, toks_s = shard_batch((images, tokens), mesh)
+    s_shard, m_shard = sharded_step(fresh_state(), imgs_s, toks_s)
+
+    assert float(m_shard["loss"]) == pytest.approx(
+        float(m_single["loss"]), rel=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                np.asarray(b),
+                                                rtol=2e-4, atol=1e-6),
+        s_single.params, s_shard.params)
