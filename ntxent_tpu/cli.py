@@ -69,7 +69,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="clip multi-device strategy: dp = shard_map data "
                         "parallelism with the fused partial InfoNCE (the "
                         "production TPU path); tp = compiler-partitioned "
-                        "(data, model) mesh for towers that need sharding")
+                        "(data, model) mesh for towers that need sharding "
+                        "(set --model-par > 1 or nothing is model-sharded)")
+    t.add_argument("--model-par", type=int, default=2,
+                   help="clip tp: model-axis size of the (data, model) "
+                        "mesh; device count must divide by it")
     t.add_argument("--vocab-size", type=int, default=49408,
                    help="clip: text-tower vocabulary")
     t.add_argument("--token-len", type=int, default=None,
@@ -379,12 +383,16 @@ def _train_clip(args, info, per_process_batch: int) -> int:
             from ntxent_tpu.parallel.tp import (
                 make_tp_clip_train_step, shard_train_state)
 
-            mesh = create_mesh(shape=(n_dev, 1),
+            if n_dev % args.model_par:
+                raise SystemExit(f"--model-par {args.model_par} must "
+                                 f"divide {n_dev} devices")
+            mesh = create_mesh(shape=(n_dev // args.model_par,
+                                      args.model_par),
                                axis_names=("data", "model"))
             state = shard_train_state(state, mesh)
             step = make_tp_clip_train_step(mesh, remat=args.remat)
-            logger.info("CLIP GSPMD (data, model) mesh over %d devices",
-                        n_dev)
+            logger.info("CLIP GSPMD (%d, %d) (data, model) mesh",
+                        n_dev // args.model_par, args.model_par)
         else:
             from ntxent_tpu.training.trainer import (
                 make_sharded_clip_train_step)
