@@ -266,24 +266,45 @@ def _run_fit(data, state, step, args) -> int:
     return 0
 
 
+def _build_clip_model(args):
+    """CLIPModel from resolved flags (one construction shared by train and
+    eval, so an evaluated checkpoint's pytree always matches). Requires
+    args.image_size / token_len / vocab_size already resolved."""
+    from ntxent_tpu import models
+    from ntxent_tpu.models import CLIPModel, TextTransformer
+
+    if args.model == "tiny":
+        image_enc = functools.partial(
+            models.VisionTransformer, hidden_dim=32, depth=2, num_heads=2,
+            mlp_dim=64, patch_size=8)
+        text_enc = functools.partial(
+            TextTransformer, vocab_size=args.vocab_size,
+            max_len=args.token_len, hidden_dim=32, depth=2, num_heads=2)
+        embed_dim = 32
+    else:
+        image_enc = _make_encoder(args.model, args.image_size)
+        text_enc = functools.partial(TextTransformer,
+                                     vocab_size=args.vocab_size,
+                                     max_len=args.token_len)
+        embed_dim = 512
+    return CLIPModel(image_encoder=image_enc, text_encoder=text_enc,
+                     embed_dim=embed_dim)
+
+
 def _train_clip(args, info, per_process_batch: int) -> int:
     """CLIP pretraining branch: dual encoder + symmetric InfoNCE.
 
     The BASELINE.json configs[4] workload (text-image contrastive,
     learnable logit scale). Image tower = --model (ViT variants; ResNets
     are refused — make_clip_train_step carries no BatchNorm state);
-    multi-device runs use the compiler-partitioned TP step on a
-    (data, model) mesh with model_par=1, i.e. pure data parallelism that
-    can be widened to tensor parallelism by reshaping the mesh.
+    multi-device runs default to the shard_map DP step (--clip-parallel,
+    fused partial InfoNCE) with a GSPMD (data, model) mesh available for
+    towers that need sharding.
     """
-    import functools
-
     import jax
     import numpy as np
     import optax
 
-    from ntxent_tpu import models
-    from ntxent_tpu.models import CLIPModel, TextTransformer
     from ntxent_tpu.parallel.mesh import create_mesh, global_batch
     from ntxent_tpu.training.datasets import PairedArrayLoader
     from ntxent_tpu.training.lars import cosine_warmup_schedule
@@ -339,22 +360,7 @@ def _train_clip(args, info, per_process_batch: int) -> int:
 
     # Towers are built AFTER the data derivation above so the text tower's
     # max_len and the image tower's size match what will be fed.
-    if args.model == "tiny":
-        image_enc = functools.partial(
-            models.VisionTransformer, hidden_dim=32, depth=2, num_heads=2,
-            mlp_dim=64, patch_size=8)
-        text_enc = functools.partial(
-            TextTransformer, vocab_size=args.vocab_size,
-            max_len=args.token_len, hidden_dim=32, depth=2, num_heads=2)
-        embed_dim = 32
-    else:
-        image_enc = _make_encoder(args.model, args.image_size)
-        text_enc = functools.partial(TextTransformer,
-                                     vocab_size=args.vocab_size,
-                                     max_len=args.token_len)
-        embed_dim = 512
-    model = CLIPModel(image_encoder=image_enc, text_encoder=text_enc,
-                      embed_dim=embed_dim)
+    model = _build_clip_model(args)
     loader = PairedArrayLoader(images, tokens, per_process_batch,
                                seed=args.seed,
                                shard_index=info["process_index"],
@@ -447,6 +453,15 @@ def build_eval_parser() -> argparse.ArgumentParser:
                     "probe and weighted-kNN on frozen encoder features")
     _add_common_args(p)  # model/proj flags must match the training run
     p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--objective", default="simclr",
+                   choices=["simclr", "clip"],
+                   help="what the checkpoint was trained with; clip "
+                        "evaluates the projected, L2-normalized image "
+                        "embeddings (encode_image — CLIP's shared space) "
+                        "and needs --vocab-size/--token-len to match the "
+                        "run")
+    p.add_argument("--vocab-size", type=int, default=49408)
+    p.add_argument("--token-len", type=int, default=77)
     p.add_argument("--accum-steps", type=int, default=1,
                    help="match the training run's value (it shapes the "
                         "checkpoint's optimizer-state pytree)")
@@ -546,14 +561,41 @@ def eval_main(argv=None) -> int:
     )
     from ntxent_tpu.training.checkpoint import CheckpointManager
 
-    encoder = _make_encoder(args.model, args.image_size)
-    model = SimCLRModel(encoder=encoder,
-                        proj_hidden_dim=args.proj_hidden_dim,
-                        proj_dim=args.proj_dim)
-    template = create_train_state(
-        model, jax.random.PRNGKey(0),
-        (1, args.image_size, args.image_size, 3),
-        TrainerConfig(accum_steps=args.accum_steps))
+    if args.objective == "clip":
+        # CLIP checkpoint: the template's pytree must match _train_clip's
+        # (CLIPModel params; AdamW opt state, MultiSteps-wrapped if the run
+        # accumulated). Features = projected image embeddings.
+        if args.model.startswith("resnet"):
+            raise SystemExit("--objective clip checkpoints have ViT image "
+                             "towers (--model vit_*|tiny); no resnet CLIP "
+                             "checkpoint can exist")
+        import numpy as np
+        import optax
+
+        from ntxent_tpu.training.trainer import TrainState
+
+        model = _build_clip_model(args)
+        variables0 = model.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, args.image_size, args.image_size, 3), np.float32),
+            np.zeros((1, args.token_len), np.int32), train=False)
+        # A SCHEDULE (callable), matching _train_clip's tx: adamw with a
+        # float LR has an EmptyState where the schedule keeps a count, and
+        # orbax restore is structure-strict.
+        tx = optax.adamw(lambda step: 0.0)
+        if args.accum_steps > 1:
+            tx = optax.MultiSteps(tx, every_k_schedule=args.accum_steps)
+        template = TrainState.create(apply_fn=model.apply,
+                                     params=variables0["params"], tx=tx)
+    else:
+        encoder = _make_encoder(args.model, args.image_size)
+        model = SimCLRModel(encoder=encoder,
+                            proj_hidden_dim=args.proj_hidden_dim,
+                            proj_dim=args.proj_dim)
+        template = create_train_state(
+            model, jax.random.PRNGKey(0),
+            (1, args.image_size, args.image_size, 3),
+            TrainerConfig(accum_steps=args.accum_steps))
     manager = CheckpointManager(args.ckpt_dir)
     try:
         if manager.latest_step() is None:
@@ -563,10 +605,21 @@ def eval_main(argv=None) -> int:
         manager.close()
     logger.info("restored step %d from %s", int(state.step), args.ckpt_dir)
 
-    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    if args.objective == "clip":
+        variables = {"params": state.params}
 
-    def apply_features(x):
-        return model.apply(variables, x, train=False, method="features")
+        def apply_features(x):
+            # Projected, L2-normalized image embeddings — CLIP's shared
+            # embedding space (the space its transfer results are quoted
+            # in), via the tower-only encode_image method.
+            return model.apply(variables, x, method="encode_image")
+    else:
+        variables = {"params": state.params,
+                     "batch_stats": state.batch_stats}
+
+        def apply_features(x):
+            return model.apply(variables, x, train=False,
+                               method="features")
 
     xtr, ytr, xte, yte = _labeled_arrays(args)
     # One extraction pass over the concatenation: extract_features jits its

@@ -244,3 +244,37 @@ def test_cli_clip_uint8_npz_trains(tmp_path):
                        env=_clip_npz_env())
     assert p.returncode == 0, p.stdout + p.stderr
     assert "final: step 2" in p.stdout + p.stderr
+
+
+@pytest.mark.slow
+def test_cli_clip_train_then_eval(tmp_path):
+    """ntxent-eval --objective clip restores a CLIP checkpoint and
+    evaluates the image tower's embeddings on the synthetic task."""
+    import json
+
+    env = _clip_npz_env()
+    ckpt = tmp_path / "ckpt"
+    common = ["--objective", "clip", "--model", "tiny",
+              "--image-size", "16", "--vocab-size", "64",
+              "--token-len", "8", "--platform", "cpu"]
+    train = subprocess.run(
+        [sys.executable, "-m", "ntxent_tpu.cli",
+         "--dataset", "synthetic", "--synthetic-samples", "64",
+         "--batch", "8", "--steps", "2", "--warmup-steps", "1",
+         "--ckpt-dir", str(ckpt), "--log-every", "1"] + common,
+        capture_output=True, text=True, timeout=600, env=env)
+    assert train.returncode == 0, train.stdout + train.stderr
+
+    code = ("import sys; from ntxent_tpu.cli import eval_main;"
+            "sys.exit(eval_main(sys.argv[1:]))")
+    ev = subprocess.run(
+        [sys.executable, "-c", code,
+         "--ckpt-dir", str(ckpt), "--dataset", "synthetic",
+         "--probe-steps", "30", "--k", "5",
+         "--max-train", "128", "--max-test", "64"] + common,
+        capture_output=True, text=True, timeout=600, env=env)
+    assert ev.returncode == 0, ev.stdout + ev.stderr
+    result = json.loads(ev.stdout.strip().splitlines()[-1])
+    assert result["step"] == 2
+    assert 0.0 <= result["knn_top1"] <= 1.0
+    assert 0.0 <= result["probe_top1"] <= 1.0
