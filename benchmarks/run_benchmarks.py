@@ -163,29 +163,36 @@ def run_distributed(quick: bool, results: dict):
     mesh = create_mesh(axis_names=("data",))
     per_dev = [128, 512] if quick else [128, 512, 2048]
     runs = 5 if quick else 20
+
+    def sharded_pair(seed: int, n: int, d: int = 64):
+        """Two normalized (n*n_dev, d) embedding shards on the mesh (one
+        protocol for the NT-Xent and InfoNCE sections below)."""
+        key = jax.random.PRNGKey(seed)
+        a = jax.random.normal(key, (n * n_dev, d))
+        b = jax.random.normal(jax.random.fold_in(key, 1), (n * n_dev, d))
+        a = a / jnp.linalg.norm(a, axis=1, keepdims=True)
+        b = b / jnp.linalg.norm(b, axis=1, keepdims=True)
+        return shard_batch((a, b), mesh)
+
+    def temp_mib(fn, *args):
+        try:
+            stats = fn.lower(*args).compile().memory_analysis()
+            return round(stats.temp_size_in_bytes / 2**20, 1)
+        except Exception:
+            return None
     print(f"\n=== distributed loss: all-gather vs ring on {n_dev} device(s) "
           f"===")
     print(f"{'N/dev':>8} {'global N':>9} {'gather ms':>10} {'ring ms':>9} "
           f"{'fused ms':>9} {'tmp MiB g/r/f':>16}")
     for n in per_dev:
-        key = jax.random.PRNGKey(0)
-        z1 = jax.random.normal(key, (n * n_dev, 64))
-        z2 = jax.random.normal(jax.random.fold_in(key, 1), (n * n_dev, 64))
-        z1 = z1 / jnp.linalg.norm(z1, axis=1, keepdims=True)
-        z2 = z2 / jnp.linalg.norm(z2, axis=1, keepdims=True)
-        z1s, z2s = shard_batch((z1, z2), mesh)
+        z1s, z2s = sharded_pair(0, n)
         gather = jax.jit(make_sharded_ntxent(mesh))
         ring = jax.jit(make_ring_ntxent(mesh, impl="jnp"))
         fused = jax.jit(make_ring_ntxent(mesh, impl="fused"))
 
-        def temp_mib(fn):
-            try:
-                stats = fn.lower(z1s, z2s).compile().memory_analysis()
-                return round(stats.temp_size_in_bytes / 2**20, 1)
-            except Exception:
-                return None
-
-        mg, mr, mf = temp_mib(gather), temp_mib(ring), temp_mib(fused)
+        mg = temp_mib(gather, z1s, z2s)
+        mr = temp_mib(ring, z1s, z2s)
+        mf = temp_mib(fused, z1s, z2s)
         rg = time_fn(gather, z1s, z2s, warmup=2, runs=runs)
         rr = time_fn(ring, z1s, z2s, warmup=2, runs=runs)
         rf = time_fn(fused, z1s, z2s, warmup=2, runs=runs) if on_accel \
@@ -198,6 +205,38 @@ def run_distributed(quick: bool, results: dict):
             "allgather": rg.as_dict(), "ring": rr.as_dict(),
             "ring_fused": rf.as_dict() if rf else None,
             "temp_mib": {"gather": mg, "ring_jnp": mr, "ring_fused": mf}})
+
+    # The CLIP InfoNCE pair (BASELINE configs[4]: text-image, global batch
+    # 32768): gather path = fused partial blocks over all-gathered
+    # modalities; ring path = per-hop neighbor circulation, O(N/P) memory.
+    from ntxent_tpu.parallel import make_ring_infonce, make_sharded_infonce
+
+    print(f"\n=== distributed InfoNCE (CLIP): all-gather vs ring on "
+          f"{n_dev} device(s) ===")
+    print(f"{'N/dev':>8} {'global N':>9} {'gather ms':>10} {'ring ms':>9} "
+          f"{'tmp MiB g/r':>12}")
+    scale = jnp.float32(1.0 / 0.07)
+    for n in per_dev:
+        zas, zbs = sharded_pair(1, n)
+        g_nce = jax.jit(make_sharded_infonce(mesh))
+        r_nce = jax.jit(make_ring_infonce(mesh))
+        mgn = temp_mib(g_nce, zas, zbs, scale)
+        mrn = temp_mib(r_nce, zas, zbs, scale)
+        # Fused partials run interpret-mode off-accelerator: time them only
+        # where they compile (same policy as the fused ring above).
+        if on_accel:
+            rgn = time_fn(g_nce, zas, zbs, scale, warmup=2, runs=runs)
+            gather_ms = f"{rgn.mean_ms:>10.3f}"
+            gather_rec = rgn.as_dict()
+        else:
+            gather_ms, gather_rec = f"{'n/a':>10}", None
+        rrn = time_fn(r_nce, zas, zbs, scale, warmup=2, runs=runs)
+        print(f"{n:>8} {n * n_dev:>9} {gather_ms} {rrn.mean_ms:>9.3f} "
+              f"{f'{mgn}/{mrn}':>12}")
+        results.setdefault("distributed_infonce", []).append({
+            "per_device_n": n, "devices": n_dev,
+            "allgather_fused": gather_rec, "ring": rrn.as_dict(),
+            "temp_mib": {"gather_fused": mgn, "ring": mrn}})
 
 
 def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
