@@ -34,6 +34,8 @@ from ntxent_tpu.training.trainer import (
     create_train_state,
     estimate_mfu,
     fit,
+    make_clip_train_step,
+    make_sharded_clip_train_step,
     make_sharded_train_step,
     make_train_step,
     shard_batch,
@@ -69,6 +71,8 @@ __all__ = [
     "TrainState",
     "create_train_state",
     "estimate_mfu",
+    "make_clip_train_step",
+    "make_sharded_clip_train_step",
     "make_sharded_train_step",
     "make_train_step",
     "shard_batch",
