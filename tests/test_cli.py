@@ -179,7 +179,7 @@ def test_cli_clip_objective_runs_and_resumes(tmp_path):
     assert "nothing to do" in (second.stdout + second.stderr)
 
 
-def _clip_npz_env():
+def _cpu_subprocess_env():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
@@ -212,7 +212,7 @@ class TestClipNpzValidation:
                "--batch", "8", "--steps", "1", "--warmup-steps", "1",
                "--platform", "cpu"] + extra
         return subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=300, env=_clip_npz_env())
+                              timeout=300, env=_cpu_subprocess_env())
 
     def test_negative_token_id_rejected(self, tmp_path):
         p = self._run(tmp_path, [], bad_token=-1)
@@ -241,7 +241,7 @@ def test_cli_clip_uint8_npz_trains(tmp_path):
            "--batch", "8", "--steps", "2", "--warmup-steps", "1",
            "--log-every", "1", "--platform", "cpu"]
     p = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
-                       env=_clip_npz_env())
+                       env=_cpu_subprocess_env())
     assert p.returncode == 0, p.stdout + p.stderr
     assert "final: step 2" in p.stdout + p.stderr
 
@@ -252,7 +252,7 @@ def test_cli_clip_train_then_eval(tmp_path):
     evaluates the image tower's embeddings on the synthetic task."""
     import json
 
-    env = _clip_npz_env()
+    env = _cpu_subprocess_env()
     ckpt = tmp_path / "ckpt"
     common = ["--objective", "clip", "--model", "tiny",
               "--image-size", "16", "--vocab-size", "64",
@@ -272,6 +272,49 @@ def test_cli_clip_train_then_eval(tmp_path):
          "--ckpt-dir", str(ckpt), "--dataset", "synthetic",
          "--probe-steps", "30", "--k", "5",
          "--max-train", "128", "--max-test", "64"] + common,
+        capture_output=True, text=True, timeout=600, env=env)
+    assert ev.returncode == 0, ev.stdout + ev.stderr
+    result = json.loads(ev.stdout.strip().splitlines()[-1])
+    assert result["step"] == 2
+    assert 0.0 <= result["knn_top1"] <= 1.0
+    assert 0.0 <= result["probe_top1"] <= 1.0
+
+
+@pytest.mark.slow
+def test_cli_imagefolder_train_then_eval(tmp_path):
+    """ImageNet-layout folder: train streams decoded images; eval decodes
+    only its capped index picks and reports both protocols."""
+    import json
+
+    from PIL import Image
+
+    root = tmp_path / "data"
+    rng = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(12):
+            arr = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(root / cls / f"{i}.png")
+
+    env = _cpu_subprocess_env()
+    ckpt = tmp_path / "ckpt"
+    common = ["--dataset", "imagefolder", "--data-dir", str(root),
+              "--model", "tiny", "--image-size", "8",
+              "--proj-hidden-dim", "16", "--proj-dim", "8",
+              "--platform", "cpu"]
+    train = subprocess.run(
+        [sys.executable, "-m", "ntxent_tpu.cli",
+         "--batch", "8", "--steps", "2", "--warmup-steps", "1",
+         "--ckpt-dir", str(ckpt), "--log-every", "1"] + common,
+        capture_output=True, text=True, timeout=600, env=env)
+    assert train.returncode == 0, train.stdout + train.stderr
+
+    code = ("import sys; from ntxent_tpu.cli import eval_main;"
+            "sys.exit(eval_main(sys.argv[1:]))")
+    ev = subprocess.run(
+        [sys.executable, "-c", code,
+         "--ckpt-dir", str(ckpt), "--probe-steps", "30", "--k", "3",
+         "--max-train", "8", "--max-test", "4"] + common,
         capture_output=True, text=True, timeout=600, env=env)
     assert ev.returncode == 0, ev.stdout + ev.stderr
     result = json.loads(ev.stdout.strip().splitlines()[-1])
