@@ -84,39 +84,15 @@ def test_cli_synthetic_run_checkpoints_and_resumes(tmp_path):
 def test_cli_train_then_eval(tmp_path):
     """ntxent-eval restores the ntxent-train checkpoint and reports both
     SSL protocols on the synthetic labeled task."""
-    import json
-
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)  # single device: fastest for a smoke run
-    repo = os.path.dirname(os.path.dirname(__file__))
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    ckpt = tmp_path / "ckpt"
-    common = ["--model", "tiny", "--image-size", "8",
-              "--proj-hidden-dim", "16", "--proj-dim", "8",
-              "--platform", "cpu"]
-    train = subprocess.run(
-        [sys.executable, "-m", "ntxent_tpu.cli",
-         "--dataset", "synthetic", "--synthetic-samples", "64",
-         "--batch", "16", "--steps", "2", "--warmup-steps", "1",
-         "--ckpt-dir", str(ckpt), "--log-every", "1"] + common,
-        capture_output=True, text=True, timeout=600, env=env)
-    assert train.returncode == 0, train.stdout + train.stderr
-
-    code = (
-        "import sys; from ntxent_tpu.cli import eval_main;"
-        "sys.exit(eval_main(sys.argv[1:]))")
-    ev = subprocess.run(
-        [sys.executable, "-c", code,
-         "--ckpt-dir", str(ckpt), "--dataset", "synthetic",
-         "--probe-steps", "50", "--k", "5",
-         "--max-train", "256", "--max-test", "128"] + common,
-        capture_output=True, text=True, timeout=600, env=env)
-    assert ev.returncode == 0, ev.stdout + ev.stderr
-    result = json.loads(ev.stdout.strip().splitlines()[-1])
-    assert result["step"] == 2
-    assert 0.0 <= result["knn_top1"] <= 1.0
-    assert 0.0 <= result["probe_top1"] <= 1.0
+    common = ["--dataset", "synthetic", "--model", "tiny",
+              "--image-size", "8", "--proj-hidden-dim", "16",
+              "--proj-dim", "8", "--platform", "cpu"]
+    _train_then_eval(
+        tmp_path / "ckpt", common,
+        train_extra=["--synthetic-samples", "64", "--batch", "16",
+                     "--steps", "2"],
+        eval_extra=["--probe-steps", "50", "--k", "5",
+                    "--max-train", "256", "--max-test", "128"])
 
 
 class TestPairedArrayLoader:
@@ -188,6 +164,36 @@ def _cpu_subprocess_env():
     return env
 
 
+def _train_then_eval(ckpt, common, train_extra, eval_extra, env=None,
+                     expect_step=2):
+    """Shared scaffold: ntxent-train to a checkpoint, ntxent-eval it, and
+    return the parsed eval JSON (one copy of the subprocess plumbing for
+    every dataset/objective variant)."""
+    import json
+
+    env = env or _cpu_subprocess_env()
+    train = subprocess.run(
+        [sys.executable, "-m", "ntxent_tpu.cli",
+         "--warmup-steps", "1", "--ckpt-dir", str(ckpt),
+         "--log-every", "1"] + train_extra + common,
+        capture_output=True, text=True, timeout=600, env=env)
+    assert train.returncode == 0, train.stdout + train.stderr
+
+    code = ("import sys; from ntxent_tpu.cli import eval_main;"
+            "sys.exit(eval_main(sys.argv[1:]))")
+    ev = subprocess.run(
+        [sys.executable, "-c", code, "--ckpt-dir", str(ckpt)]
+        + eval_extra + common,
+        capture_output=True, text=True, timeout=600, env=env)
+    assert ev.returncode == 0, ev.stdout + ev.stderr
+    result = json.loads(ev.stdout.strip().splitlines()[-1])
+    assert result["step"] == expect_step
+    assert 0.0 <= result["knn_top1"] <= 1.0
+    if "probe_top1" in result:
+        assert 0.0 <= result["probe_top1"] <= 1.0
+    return result
+
+
 def _write_pairs(path, image_size=16, n=32, token_len=8, vocab=64,
                  dtype=np.uint8, bad_token=None):
     rng = np.random.RandomState(0)
@@ -250,42 +256,22 @@ def test_cli_clip_uint8_npz_trains(tmp_path):
 def test_cli_clip_train_then_eval(tmp_path):
     """ntxent-eval --objective clip restores a CLIP checkpoint and
     evaluates the image tower's embeddings on the synthetic task."""
-    import json
-
-    env = _cpu_subprocess_env()
-    ckpt = tmp_path / "ckpt"
-    common = ["--objective", "clip", "--model", "tiny",
-              "--image-size", "16", "--vocab-size", "64",
-              "--token-len", "8", "--platform", "cpu"]
-    train = subprocess.run(
-        [sys.executable, "-m", "ntxent_tpu.cli",
-         "--dataset", "synthetic", "--synthetic-samples", "64",
-         "--batch", "8", "--steps", "2", "--warmup-steps", "1",
-         "--ckpt-dir", str(ckpt), "--log-every", "1"] + common,
-        capture_output=True, text=True, timeout=600, env=env)
-    assert train.returncode == 0, train.stdout + train.stderr
-
-    code = ("import sys; from ntxent_tpu.cli import eval_main;"
-            "sys.exit(eval_main(sys.argv[1:]))")
-    ev = subprocess.run(
-        [sys.executable, "-c", code,
-         "--ckpt-dir", str(ckpt), "--dataset", "synthetic",
-         "--probe-steps", "30", "--k", "5",
-         "--max-train", "128", "--max-test", "64"] + common,
-        capture_output=True, text=True, timeout=600, env=env)
-    assert ev.returncode == 0, ev.stdout + ev.stderr
-    result = json.loads(ev.stdout.strip().splitlines()[-1])
-    assert result["step"] == 2
-    assert 0.0 <= result["knn_top1"] <= 1.0
-    assert 0.0 <= result["probe_top1"] <= 1.0
+    common = ["--objective", "clip", "--dataset", "synthetic",
+              "--model", "tiny", "--image-size", "16",
+              "--vocab-size", "64", "--token-len", "8",
+              "--platform", "cpu"]
+    _train_then_eval(
+        tmp_path / "ckpt", common,
+        train_extra=["--synthetic-samples", "64", "--batch", "8",
+                     "--steps", "2"],
+        eval_extra=["--probe-steps", "30", "--k", "5",
+                    "--max-train", "128", "--max-test", "64"])
 
 
 @pytest.mark.slow
 def test_cli_imagefolder_train_then_eval(tmp_path):
     """ImageNet-layout folder: train streams decoded images; eval decodes
     only its capped index picks and reports both protocols."""
-    import json
-
     from PIL import Image
 
     root = tmp_path / "data"
@@ -296,28 +282,40 @@ def test_cli_imagefolder_train_then_eval(tmp_path):
             arr = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
             Image.fromarray(arr).save(root / cls / f"{i}.png")
 
-    env = _cpu_subprocess_env()
-    ckpt = tmp_path / "ckpt"
     common = ["--dataset", "imagefolder", "--data-dir", str(root),
               "--model", "tiny", "--image-size", "8",
               "--proj-hidden-dim", "16", "--proj-dim", "8",
               "--platform", "cpu"]
-    train = subprocess.run(
-        [sys.executable, "-m", "ntxent_tpu.cli",
-         "--batch", "8", "--steps", "2", "--warmup-steps", "1",
-         "--ckpt-dir", str(ckpt), "--log-every", "1"] + common,
-        capture_output=True, text=True, timeout=600, env=env)
-    assert train.returncode == 0, train.stdout + train.stderr
+    _train_then_eval(
+        tmp_path / "ckpt", common,
+        train_extra=["--batch", "8", "--steps", "2"],
+        eval_extra=["--probe-steps", "30", "--k", "3",
+                    "--max-train", "8", "--max-test", "4"])
 
-    code = ("import sys; from ntxent_tpu.cli import eval_main;"
-            "sys.exit(eval_main(sys.argv[1:]))")
-    ev = subprocess.run(
-        [sys.executable, "-c", code,
-         "--ckpt-dir", str(ckpt), "--probe-steps", "30", "--k", "3",
-         "--max-train", "8", "--max-test", "4"] + common,
-        capture_output=True, text=True, timeout=600, env=env)
-    assert ev.returncode == 0, ev.stdout + ev.stderr
-    result = json.loads(ev.stdout.strip().splitlines()[-1])
-    assert result["step"] == 2
-    assert 0.0 <= result["knn_top1"] <= 1.0
-    assert 0.0 <= result["probe_top1"] <= 1.0
+
+@pytest.mark.slow
+def test_cli_cifar10_train_then_eval(tmp_path):
+    """CIFAR-10 pickle layout end to end: train streams the batches_py
+    files, eval reports both protocols on the train/test split."""
+    import pickle
+
+    # Fabricated CIFAR-10 layout (same shape the real pickles have).
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir(parents=True)
+    rng = np.random.default_rng(1)
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        blob = {
+            b"data": rng.integers(0, 256, (16, 3072), np.uint8),
+            b"labels": rng.integers(0, 10, 16).tolist(),
+        }
+        with open(d / name, "wb") as f:
+            pickle.dump(blob, f)
+
+    common = ["--dataset", "cifar10", "--data-dir", str(tmp_path),
+              "--model", "tiny", "--proj-hidden-dim", "16",
+              "--proj-dim", "8", "--platform", "cpu"]
+    _train_then_eval(
+        tmp_path / "ckpt", common,
+        train_extra=["--batch", "8", "--steps", "2"],
+        eval_extra=["--probe-steps", "30", "--k", "3",
+                    "--max-train", "32", "--max-test", "8"])
