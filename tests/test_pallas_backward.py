@@ -90,3 +90,24 @@ def test_value_and_grad_jitted(rng):
     np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=1e-4,
                                atol=1e-6)
+
+
+@pytest.mark.slow
+def test_grad_random_shape_fuzz(rng):
+    """Seeded random-shape sweep of fwd+bwd vs the oracle: non-tile-aligned
+    (even) row counts and arbitrary dims exercise the padding/ragged paths
+    of the backward kernels, not just the curated shapes above."""
+    shape_rng = np.random.default_rng(2026)
+    for case in range(8):
+        two_n = 2 * int(shape_rng.integers(3, 160))
+        dim = int(shape_rng.integers(4, 200))
+        z = make_embeddings(jax.random.fold_in(rng, case), two_n, dim)
+        got_l, got_g = jax.value_and_grad(
+            lambda zz: ntxent_loss_fused(zz, 0.07))(z)
+        want_l, want_g = jax.value_and_grad(
+            lambda zz: oracle.ntxent_loss(zz, 0.07))(z)
+        np.testing.assert_allclose(float(got_l), float(want_l),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"loss @ {(two_n, dim)}")
+        np.testing.assert_allclose(got_g, want_g, rtol=1e-4, atol=1e-6,
+                                   err_msg=f"grad @ {(two_n, dim)}")
