@@ -100,30 +100,15 @@ def _child() -> None:
     warmup, runs = (WARMUP, RUNS) if on_accel else (3, 15)
     result = time_fn(fwd_bwd, z, warmup=warmup, runs=runs)
 
-    # Steady-state cross-check: N data-DEPENDENT steps (each input is the
-    # previous step's gradient update, so executions can neither overlap
-    # nor be elided/cached), timed as one span ending in an actual
-    # device-to-host read of the final loss. This is robust where
-    # per-iteration block_until_ready is not: remote-relay backends have
-    # been observed marking buffers ready before execution completes,
-    # which makes per-iteration numbers physically impossible (sub-peak
-    # microseconds). The larger of the two protocols is the honest bound.
-    @jax.jit
-    def chained_step(zz):
-        loss, g = jax.value_and_grad(loss_fn)(zz)
-        z2 = zz - 0.01 * g
-        z2 = z2 / jnp.linalg.norm(z2, axis=-1, keepdims=True)
-        return z2, loss
+    # Steady-state cross-check: N data-DEPENDENT steps run INSIDE one
+    # jitted lax.scan, one dispatch for the whole span, ended by a real
+    # device-to-host read — immune to relay timing distortion in both
+    # directions (early readiness signals AND per-step RPC round-trips;
+    # see utils/profiling.time_fn_chained).
+    from ntxent_tpu.utils.profiling import time_fn_chained
 
-    zc, _ = chained_step(z)
-    zc.block_until_ready()
     n_chain = 100 if on_accel else 5
-    t0 = time.perf_counter()
-    zc = z
-    for _ in range(n_chain):
-        zc, last_loss = chained_step(zc)
-    final = float(last_loss)  # D2H read: cannot return before the work is done
-    steady_ms = (time.perf_counter() - t0) * 1e3 / n_chain
+    steady_ms, final = time_fn_chained(loss_fn, z, length=n_chain, spans=3)
     if not (final == final):  # NaN guard on the thing we just timed
         raise RuntimeError(f"chained loss went NaN: {final}")
 

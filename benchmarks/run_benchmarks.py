@@ -343,30 +343,74 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
     name, batch, size, state, step, step_args = _trainer_setup(
         model_name, quick, on_accel, batch)
 
-    flops, compiled = aot_compile_with_flops(step, state, *step_args)
-    if compiled is not None:
-        step = compiled  # run the executable we already built
-    state, _ = step(state, *step_args)  # first (warmup) step
-
     import time as _time
     runs = 5 if quick or not on_accel else 30
     # Chained steady-state protocol (same rationale as bench.py): the steps
-    # already chain through `state`, so timing the whole span and ending
-    # with an actual device-to-host read of the final loss cannot be fooled
-    # by a backend whose per-buffer readiness signal fires early (observed
-    # on the axon relay: per-iteration block_until_ready produced
-    # sub-physical step times and >100% MFU). MFU is a chip-utilization
-    # claim — it uses this number, never a per-iteration median.
-    jax.block_until_ready(state)  # drain the warmup step before t0
-    t0 = _time.perf_counter()
-    for _ in range(runs):
-        state, metrics = step(state, *step_args)
-    final_loss = float(metrics["loss"])  # D2H: waits for the real work
-    chained_ms = (_time.perf_counter() - t0) * 1e3 / runs
+    # chain through `state` (data-dependent — no overlap, no elision),
+    # ended by an actual device-to-host read of the final loss. On
+    # accelerator backends the whole chain runs INSIDE one jitted lax.scan
+    # — one dispatch — because tunneled backends distort per-call timing in
+    # both directions (early readiness signals: >100% MFU observed;
+    # per-step relay round-trips: ~7.7 ms/step of pure RPC observed). MFU
+    # is a chip-utilization claim — it uses this number only. On local
+    # CPU the per-call chain is honest and avoids XLA:CPU's pathological
+    # scan-of-train-step compile time (~300 s even for the tiny model).
+    chain_exec = None
+    if on_accel:
+        from ntxent_tpu.utils.profiling import (
+            compile_chain,
+            flops_from_compiled,
+            time_chain,
+        )
+
+        step_fn = step
+
+        def chain_step(s):
+            s2, m = step_fn(s, *step_args)
+            return s2, m["loss"]
+
+        # ONE compile for the whole benchmark: flops come from the chain
+        # executable's own cost analysis (total / runs — the scan's
+        # per-iteration overhead beyond the step itself is negligible), so
+        # the step is never compiled a second time just for accounting.
+        try:
+            chain_exec = compile_chain(chain_step, state, runs)
+        except Exception as e:  # backend refused AOT of the scan: degrade
+            logger.warning("scan-chain AOT failed (%s); falling back to "
+                           "the per-call protocol — numbers may carry "
+                           "relay-timing distortion", e)
+
+    if chain_exec is not None:
+        total = flops_from_compiled(chain_exec)
+        flops = total / runs if total else None
+        chained_ms, state, final_loss = time_chain(
+            chain_exec, state, length=runs, spans=2)
+
+        def trace_callable(s):
+            s, last = chain_exec(s)
+            float(last)
+            return s
+    else:
+        flops, compiled = aot_compile_with_flops(step, state, *step_args)
+        if compiled is not None:
+            step = compiled  # run the executable we already built
+        state, _ = step(state, *step_args)  # warmup step
+        jax.block_until_ready(state)
+        t0 = _time.perf_counter()
+        for _ in range(runs):
+            state, metrics = step(state, *step_args)
+        final_loss = float(metrics["loss"])  # D2H: waits for the real work
+        chained_ms = (_time.perf_counter() - t0) * 1e3 / runs
+
+        def trace_callable(s):
+            s, m = step(s, *step_args)
+            jax.block_until_ready(m["loss"])
+            return s
     assert final_loss == final_loss, "loss went NaN during trainer bench"
     sps = 1e3 / chained_ms
     entry = {
         "model": name, "batch": batch, "image": size,
+        "protocol": "scan_chain" if chain_exec is not None else "per_call",
         "chained_ms": chained_ms, "steps_per_sec": sps,
         "flops_per_step": flops,
         "peak_flops_per_chip": peak_flops_per_chip(),
@@ -382,10 +426,14 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
     if trace_dir:
         from ntxent_tpu.utils.profiling import trace
 
+        # Runs only already-compiled executables (one chain span on
+        # accelerator, 3 single steps on CPU) — no compilation ever
+        # happens inside the captured trace.
         with trace(trace_dir):
-            for _ in range(3):
-                state, metrics = step(state, *step_args)
-            jax.block_until_ready(metrics["loss"])
+            state = trace_callable(state)
+            if not on_accel:
+                for _ in range(2):
+                    state = trace_callable(state)
         print(f"XProf trace -> {trace_dir}")
 
 
@@ -399,6 +447,9 @@ def main():
     parser.add_argument("--trainer", action="store_true",
                         help="also benchmark the end-to-end train step "
                              "with automatic MFU")
+    parser.add_argument("--trainer-only", action="store_true",
+                        help="skip the kernel grids and run only the "
+                             "trainer benchmark (implies --trainer)")
     parser.add_argument("--model", default="resnet50",
                         choices=["resnet50", "vit_b16", "clip_b16", "all"],
                         help="trainer-bench workload (BASELINE.json config "
@@ -436,12 +487,13 @@ def main():
     logger.info("timing impl=%s on backend=%s", _IMPL_NAME,
                 jax.default_backend())
 
-    run_cpp_grid(args.quick, results, tracker)
-    run_py_grid(args.quick, results, tracker)
-    run_stability(results)
+    if not args.trainer_only:
+        run_cpp_grid(args.quick, results, tracker)
+        run_py_grid(args.quick, results, tracker)
+        run_stability(results)
     if args.distributed:
         run_distributed(args.quick, results)
-    if args.trainer or args.trace:
+    if args.trainer or args.trace or args.trainer_only:
         models = ["resnet50", "vit_b16", "clip_b16"] \
             if args.model == "all" else [args.model]
         for m in models:

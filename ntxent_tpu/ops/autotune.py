@@ -30,7 +30,7 @@ from pathlib import Path
 import jax
 import jax.numpy as jnp
 
-from ..utils.profiling import time_fn
+from ..utils.profiling import time_fn_chained
 from .blocks import VMEM_BUDGET_BYTES, _working_set_bytes, round_up
 
 logger = logging.getLogger(__name__)
@@ -39,6 +39,10 @@ __all__ = ["autotune_blocks", "clear_cache", "cache_path"]
 
 _CACHE: dict[tuple, tuple[int, int]] = {}
 _DISK_CACHE: dict[str, list[int]] | None = None
+
+# Bumped whenever the timing protocol changes: v2 = scanned-chain votes
+# (v1 per-iteration votes are relay-distorted and must not be reused).
+_PROTOCOL_VERSION = 2
 
 _ROW_CANDIDATES = (64, 128, 256, 512)
 _COL_CANDIDATES = (128, 256, 512, 1024)
@@ -111,8 +115,8 @@ def autotune_blocks(
     dtype=jnp.float32,
     *,
     include_backward: bool = True,
-    warmup: int = 2,
-    runs: int = 5,
+    length: int = 100,
+    spans: int = 2,
     budget_s: float | None = 120.0,
 ) -> tuple[int, int]:
     """Time the candidate grid on the live device; return the fastest tile.
@@ -120,7 +124,13 @@ def autotune_blocks(
     Results are cached per shape/dtype/backend/device-kind, in-process and
     on disk. Falls back to the static heuristic when nothing can be measured
     (e.g. interpret mode on CPU, where timing votes are meaningless anyway).
-    ``budget_s`` bounds total sweep wall time (None = unbounded).
+
+    Each candidate is voted on with the scanned-chain protocol
+    (``time_fn_chained``): ``spans`` timed spans of ``length``
+    data-dependent steps each, so one candidate costs one compile plus
+    ``(spans + 1) * length`` executions. ``budget_s`` bounds total sweep
+    wall time (None = unbounded); it is checked between candidates, so the
+    sweep can overshoot by at most one candidate's cost.
     """
     from .blocks import choose_blocks
     from .ntxent_pallas import ntxent_loss_fused
@@ -128,8 +138,8 @@ def autotune_blocks(
     if jax.default_backend() not in ("tpu", "axon"):
         return choose_blocks(rows, cols, dim, dtype)
 
-    key = (rows, cols, dim, jnp.dtype(dtype).str, jax.default_backend(),
-           _device_kind())
+    key = (f"v{_PROTOCOL_VERSION}", rows, cols, dim, jnp.dtype(dtype).str,
+           jax.default_backend(), _device_kind())
     if key in _CACHE:
         return _CACHE[key]
     on_disk = _load_disk_cache().get(_disk_key(key))
@@ -143,28 +153,35 @@ def autotune_blocks(
 
     deadline = None if budget_s is None else time.monotonic() + budget_s
     best, best_ms = None, float("inf")
+    truncated = False
     for br, bc in _candidates(rows, cols, dim, jnp.dtype(dtype).itemsize):
         if deadline is not None and time.monotonic() > deadline:
             logger.warning("autotune budget (%.0fs) exhausted; best so far "
                            "wins", budget_s)
+            truncated = True
             break
 
         def loss(zz, _br=br, _bc=bc):
             return ntxent_loss_fused(zz, 0.07, block_rows=_br, block_cols=_bc)
 
-        fn = jax.jit(jax.value_and_grad(loss)) if include_backward \
-            else jax.jit(loss)
+        # Scanned-chain protocol (time_fn_chained docstring): per-iteration
+        # timing is relay-distorted on tunneled backends, and a mis-timed
+        # vote here silently pins a bad tile in the persistent cache.
         try:
-            r = time_fn(fn, z, warmup=warmup, runs=runs)
+            ms, _ = time_fn_chained(loss, z, length=length, spans=spans,
+                                    with_grad=include_backward)
         except Exception as e:  # candidate failed to compile/fit: skip it
             logger.debug("autotune candidate (%d, %d) failed: %s", br, bc, e)
             continue
-        logger.info("autotune (%d, %d): %.4f ms", br, bc, r.mean_ms)
-        if r.mean_ms < best_ms:
-            best, best_ms = (br, bc), r.mean_ms
+        logger.info("autotune (%d, %d): %.4f ms", br, bc, ms)
+        if ms < best_ms:
+            best, best_ms = (br, bc), ms
     if best is None:
         best = choose_blocks(rows, cols, dim, dtype)
-    else:
+    elif not truncated:
+        # A budget-truncated sweep's winner is only best-of-a-partial-grid;
+        # keep it for this process but don't pin it on disk for every
+        # future process on this device kind — the next full sweep decides.
         _store_disk_cache(key, best)
     _CACHE[key] = best
     return best

@@ -295,18 +295,13 @@ def aot_compile_with_flops(train_step, *args):
     lower().compile() does not populate the jit dispatch cache, so calling
     the original wrapper afterwards would compile a second time.
     """
+    from ..utils.profiling import flops_from_compiled
+
     try:
         compiled = train_step.lower(*args).compile()
     except Exception:  # not a jit wrapper / backend refused AOT
         return None, None
-    try:
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, list):
-            analysis = analysis[0]
-        flops: float | None = float(analysis["flops"])
-    except Exception:  # no analysis on this backend/version
-        flops = None
-    return flops, compiled
+    return flops_from_compiled(compiled), compiled
 
 
 def compiled_step_flops(train_step, *args) -> float | None:

@@ -15,7 +15,9 @@ from dataclasses import dataclass, asdict
 
 import jax
 
-__all__ = ["BenchmarkResults", "time_fn", "trace", "measured_flops"]
+__all__ = ["BenchmarkResults", "time_fn", "time_fn_chained",
+           "compile_chain", "time_chain", "trace", "measured_flops",
+           "flops_from_compiled"]
 
 
 @dataclass
@@ -53,6 +55,98 @@ def time_fn(fn, *args, warmup: int = 10, runs: int = 100) -> BenchmarkResults:
     )
 
 
+def compile_chain(step_fn, carry, length: int):
+    """AOT-compile a jitted ``lax.scan`` chain of ``length`` steps.
+
+    ``step_fn: carry -> (carry, scalar)``. The returned executable maps
+    ``carry -> (final_carry, last_scalar)``; its ``cost_analysis()`` gives
+    the whole chain's FLOPs (divide by ``length`` for per-step counts).
+    """
+    from jax import lax
+
+    @jax.jit
+    def chain(c0):
+        def body(c, _):
+            c2, s = step_fn(c)
+            return c2, s
+
+        cf, scalars = lax.scan(body, c0, None, length=length)
+        return cf, scalars[-1]
+
+    return chain.lower(carry).compile()
+
+
+def time_chain(chain_exec, carry, *, length: int,
+               spans: int = 3) -> tuple[float, object, float]:
+    """(best_per_step_ms, final_carry, final_scalar) of a compiled chain.
+
+    One warmup span, then best-of-``spans`` timed spans, each ending in an
+    actual device-to-host read of the chain's final scalar. Because the
+    steps inside the chain are data-dependent (each consumes the previous
+    carry) and the whole span is ONE dispatch, this protocol survives
+    remote-relay backends, which distort the naive ones in BOTH
+    directions: per-iteration ``block_until_ready`` can return before the
+    work physically ran (observed: sub-physical means, >100% MFU), while
+    a per-call Python chain pays one relay round-trip per step (observed:
+    ~7.7 ms/step of pure RPC at the 4096x128 headline shape). The final
+    scalar read guarantees the work happened.
+    """
+    carry, s = chain_exec(carry)  # warmup span
+    final = float(s)
+    best_ms = float("inf")
+    for _ in range(spans):
+        t0 = time.perf_counter()
+        carry, s = chain_exec(carry)
+        final = float(s)  # D2H: returns only after the work ran
+        best_ms = min(best_ms, (time.perf_counter() - t0) * 1e3 / length)
+    return best_ms, carry, final
+
+
+def time_fn_chained(loss_fn, z, *, length: int = 100, spans: int = 3,
+                    lr: float = 0.01,
+                    with_grad: bool = True) -> tuple[float, float]:
+    """Steady-state per-step ms of ``loss_fn`` via an on-device chain.
+
+    Builds a data-dependent SGD-like step from ``loss_fn`` (gradient
+    update + renormalize; or a loss-folded perturbation when
+    ``with_grad=False``) and measures it with ``compile_chain`` +
+    ``time_chain`` (see there for the protocol rationale). Returns
+    ``(best_per_step_ms, final_loss)``.
+    """
+    import jax.numpy as jnp
+
+    if with_grad:
+        def step(zz):
+            loss, g = jax.value_and_grad(loss_fn)(zz)
+            z2 = zz - lr * g
+            z2 = z2 / jnp.linalg.norm(z2, axis=-1, keepdims=True)
+            return z2.astype(zz.dtype), loss
+    else:
+        def step(zz):
+            loss = loss_fn(zz)
+            # forward-only data dependence: fold the loss back into the
+            # input so step k+1 cannot start (or be folded away) before
+            # step k finishes.
+            z2 = zz * (1.0 + 1e-6 * loss).astype(zz.dtype)
+            return z2, loss
+
+    chain_exec = compile_chain(step, z, length)
+    best_ms, _, final = time_chain(chain_exec, z, length=length, spans=spans)
+    return best_ms, final
+
+
+def flops_from_compiled(compiled) -> float | None:
+    """FLOP count off an already-compiled executable's cost analysis, or
+    None when the backend provides no analysis."""
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, list):  # some backends wrap it in a list
+            analysis = analysis[0]
+        return float(analysis["flops"])
+    except Exception:  # no analysis on this backend/version
+        return None
+
+
 def measured_flops(fn, *args) -> float | None:
     """FLOPs of one ``fn(*args)`` call from XLA's compiled cost analysis.
 
@@ -62,12 +156,9 @@ def measured_flops(fn, *args) -> float | None:
     """
     try:
         compiled = jax.jit(fn).lower(*args).compile()
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, list):  # some backends wrap it in a list
-            analysis = analysis[0]
-        return float(analysis["flops"])
-    except Exception:  # no analysis on this backend/version
+    except Exception:  # not jittable / backend refused AOT
         return None
+    return flops_from_compiled(compiled)
 
 
 @contextlib.contextmanager

@@ -5,7 +5,12 @@ of the reference's harness-side timing (benchmark.cpp:30-39) and
 import jax
 import jax.numpy as jnp
 
-from ntxent_tpu.utils.profiling import measured_flops, time_fn, trace
+from ntxent_tpu.utils.profiling import (
+    measured_flops,
+    time_fn,
+    time_fn_chained,
+    trace,
+)
 
 
 def test_time_fn_stats_are_consistent(rng):
@@ -16,6 +21,35 @@ def test_time_fn_stats_are_consistent(rng):
     assert r.std_ms >= 0
     d = r.as_dict()
     assert set(d) == {"mean_ms", "std_ms", "min_ms", "max_ms"}
+
+
+def test_time_fn_chained_measures_and_preserves_numerics(rng):
+    # The chained protocol must actually run the chain: the final loss it
+    # returns has to equal running the same data-dependent updates by hand.
+    def loss_fn(z):
+        return ((z @ z.T) ** 2).sum() / z.shape[0]
+
+    z = jax.random.normal(rng, (16, 8))
+    z = z / jnp.linalg.norm(z, axis=-1, keepdims=True)
+    ms, final = time_fn_chained(loss_fn, z, length=5, spans=2)
+    assert ms > 0
+
+    # The carry threads through every span (1 warmup + 2 timed), so the
+    # chain has advanced (1 + 2) * 5 steps by the end — each span sees a
+    # fresh input, which is what defeats result-caching relays.
+    zz = z
+    for _ in range(15):
+        loss, g = jax.value_and_grad(loss_fn)(zz)
+        zz = zz - 0.01 * g
+        zz = zz / jnp.linalg.norm(zz, axis=-1, keepdims=True)
+    assert abs(final - float(loss)) < 1e-4 * max(1.0, abs(float(loss)))
+
+
+def test_time_fn_chained_forward_only(rng):
+    z = jax.random.normal(rng, (8, 4))
+    ms, final = time_fn_chained(lambda z: (z * z).sum(), z,
+                                length=3, spans=1, with_grad=False)
+    assert ms > 0 and final == final
 
 
 def test_measured_flops_matches_matmul_arithmetic(rng):
