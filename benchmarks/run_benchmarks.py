@@ -416,7 +416,7 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
         "peak_flops_per_chip": peak_flops_per_chip(),
         "mfu": estimate_mfu(flops, sps) if flops else None,
     }
-    results.setdefault("trainer", {})[name] = entry
+    results.setdefault("trainer", {})[f"{name}@{batch}"] = entry
     flops_str = f"{flops:.3e}" if flops else "n/a"
     mfu_str = f"{entry['mfu']:.1%}" if entry["mfu"] else "n/a"
     print(f"\n=== trainer step ({name}, batch {batch}, {size}x{size}) ===")
@@ -454,8 +454,17 @@ def main():
                         choices=["resnet50", "vit_b16", "clip_b16", "all"],
                         help="trainer-bench workload (BASELINE.json config "
                              "ladder); 'all' runs every flagship")
-    parser.add_argument("--batch", type=int, default=None,
-                        help="trainer-bench batch override")
+    def _batch_list(text: str) -> list[int]:
+        try:
+            return [int(b) for b in text.split(",")]
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"expected an int or comma list of ints, got {text!r}")
+
+    parser.add_argument("--batch", type=_batch_list, default=None,
+                        help="trainer-bench batch override; a comma list "
+                             "(e.g. 64,128,256) sweeps batch sizes and "
+                             "records one entry per size")
     parser.add_argument("--trace", default=None, metavar="DIR",
                         help="capture an XProf trace of the trainer step "
                              "into DIR (implies --trainer)")
@@ -496,9 +505,11 @@ def main():
     if args.trainer or args.trace or args.trainer_only:
         models = ["resnet50", "vit_b16", "clip_b16"] \
             if args.model == "all" else [args.model]
+        batches = args.batch or [None]
         for m in models:
-            run_trainer_bench(args.quick, results, args.trace,
-                              model_name=m, batch=args.batch)
+            for b in batches:
+                run_trainer_bench(args.quick, results, args.trace,
+                                  model_name=m, batch=b)
 
     out_dir = Path(args.out)
     out_dir.mkdir(exist_ok=True)

@@ -203,8 +203,216 @@ def _fwd_call(z_rows, z_cols, row_gid, *, br, bc, inv_t, cols_actual, n_half,
 
 
 # ---------------------------------------------------------------------------
+# Triangular forward kernel (symmetric case): each tile computed ONCE
+# ---------------------------------------------------------------------------
+
+
+def _fwd_tri_kernel(zr_ref, zc_ref, loss_ref, lse_ref, m_all, l_all, p_all,
+                    *, b, inv_t, cols_actual, n_half, nb):
+    """Upper-triangle-only forward for the symmetric (z vs z) case.
+
+    The similarity matrix is symmetric, so tile (i, j) with j > i carries
+    the same numbers as tile (j, i) transposed. This kernel walks only
+    j >= i, folding each tile into row-block i's online-softmax stats
+    directly AND into row-block j's stats transposed — half the MXU work
+    of the rectangular kernel. The running (m, l, p) stats live in
+    full-length VMEM scratch because a row block keeps receiving
+    transposed contributions from earlier grid rows; TPU grid execution is
+    sequential (the accumulation pattern the rectangular kernel already
+    relies on), so block r's stats are complete exactly at tile
+    (r, nb-1), where its logsumexp is finalized.
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(jnp.logical_and(i == 0, j == 0))
+    def _():
+        loss_ref[0, 0] = jnp.float32(0.0)
+        m_all[:] = jnp.full(m_all.shape, _NEG_INF, jnp.float32)
+        l_all[:] = jnp.zeros(l_all.shape, jnp.float32)
+        p_all[:] = jnp.zeros(p_all.shape, jnp.float32)
+
+    @pl.when(j >= i)
+    def _():
+        rid, cid = _tile_ids(i, j, b, b)
+        s_masked, s_raw = _masked_sim_tile(
+            zr_ref[:], zc_ref[:], rid, cid, inv_t, cols_actual
+        )
+        pos_hit = cid == _pos_gid(rid, n_half)
+
+        # Direct fold into row-block i.
+        rs = pl.ds(i * b, b)
+        p_all[rs] += jnp.sum(jnp.where(pos_hit, s_raw, 0.0),
+                             axis=1, keepdims=True)
+        m_old = m_all[rs]
+        m_new = jnp.maximum(m_old, jnp.max(s_masked, axis=1, keepdims=True))
+        l_all[rs] = l_all[rs] * jnp.exp(m_old - m_new) + jnp.sum(
+            jnp.exp(s_masked - m_new), axis=1, keepdims=True
+        )
+        m_all[rs] = m_new
+
+        # Transposed fold into row-block j (strict upper tiles only: the
+        # diagonal tile's transpose is itself).
+        @pl.when(j > i)
+        def _():
+            st = s_masked.T
+            cs = pl.ds(j * b, b)
+            p_all[cs] += jnp.sum(jnp.where(pos_hit, s_raw, 0.0),
+                                 axis=0).reshape(b, 1)
+            m_old_c = m_all[cs]
+            m_new_c = jnp.maximum(
+                m_old_c, jnp.max(st, axis=1, keepdims=True))
+            l_all[cs] = l_all[cs] * jnp.exp(m_old_c - m_new_c) + jnp.sum(
+                jnp.exp(st - m_new_c), axis=1, keepdims=True
+            )
+            m_all[cs] = m_new_c
+
+    # Row-block i's stats are complete once the grid finishes its row.
+    @pl.when(j == nb - 1)
+    def _():
+        rs = pl.ds(i * b, b)
+        lse = m_all[rs] + jnp.log(l_all[rs])
+        lse_ref[:] = lse
+        rid = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0) + i * b
+        valid = rid < cols_actual
+        loss_ref[0, 0] += jnp.sum(jnp.where(valid, lse - p_all[rs], 0.0))
+
+
+def _fwd_tri_call(zp, *, b, inv_t, cols_actual, n_half, interpret):
+    rp, d = zp.shape
+    nb = rp // b
+    kernel = functools.partial(
+        _fwd_tri_kernel, b=b, inv_t=inv_t,
+        cols_actual=cols_actual, n_half=n_half, nb=nb,
+    )
+    loss_sum, lse = pl.pallas_call(
+        kernel,
+        grid=(nb, nb),
+        in_specs=[
+            pl.BlockSpec((b, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((b, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rp, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rp, 1), jnp.float32),
+            pltpu.VMEM((rp, 1), jnp.float32),
+            pltpu.VMEM((rp, 1), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=rp * rp * d,  # half the rectangular kernel's 2*rp*cp*d
+            bytes_accessed=(rp * d + (rp // b) * rp * d) * zp.dtype.itemsize,
+            transcendentals=rp * rp,
+        ),
+        interpret=interpret,
+    )(zp, zp)
+    return loss_sum[0, 0], lse
+
+
+# ---------------------------------------------------------------------------
 # Backward kernels
 # ---------------------------------------------------------------------------
+
+
+def _bwd_tri_kernel(zr_ref, zc_ref, lse_r_ref, lse_c_ref, grad_ref, acc,
+                    *, b, inv_t, cols_actual, n_half, nb):
+    """Upper-triangle-only symmetric backward.
+
+    Per strict-upper tile the similarity is recomputed ONCE and drives both
+    ``acc[i] += g @ z[j]`` and ``acc[j] += g^T @ z[i]`` (g is symmetric in
+    the p/p~ exchange, so the mirrored tile's gradient matrix is exactly
+    g^T). Versus the rectangular symmetric backward (one s + one dot per
+    full-grid tile) this is 1 s + 2 dots per half-grid tile: 25% less MXU
+    work. The full-length fp32 accumulator lives in VMEM scratch — callers
+    gate on rp*d*4 fitting the budget (ntxent_loss_fused's default path
+    falls back to the rectangular kernel otherwise).
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(jnp.logical_and(i == 0, j == 0))
+    def _():
+        acc[:] = jnp.zeros(acc.shape, acc.dtype)
+
+    @pl.when(j >= i)
+    def _():
+        rid, cid = _tile_ids(i, j, b, b)
+        s_masked, _ = _masked_sim_tile(
+            zr_ref[:], zc_ref[:], rid, cid, inv_t, cols_actual
+        )
+        p_row = jnp.exp(s_masked - lse_r_ref[:])      # exp(s - lse[row])
+        p_col = jnp.exp(s_masked - lse_c_ref[:])      # exp(s - lse[col])
+        pos = (cid == _pos_gid(rid, n_half)).astype(jnp.float32)
+        valid_row = (rid < cols_actual).astype(jnp.float32)
+        valid_col = (cid < cols_actual).astype(jnp.float32)
+        g = (p_row - pos) * valid_row + (p_col - pos) * valid_col
+
+        rs = pl.ds(i * b, b)
+        acc[rs] += jax.lax.dot_general(
+            g, zc_ref[:].astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+        @pl.when(j > i)
+        def _():
+            cs = pl.ds(j * b, b)
+            acc[cs] += jax.lax.dot_general(
+                g, zr_ref[:].astype(jnp.float32),
+                dimension_numbers=(((0,), (0,)), ((), ())),  # g^T @ z_i
+                preferred_element_type=jnp.float32,
+            )
+
+    # Block i's gradient is complete when its grid row ends (transposed
+    # contributions into it came from earlier grid rows).
+    @pl.when(j == nb - 1)
+    def _():
+        grad_ref[:] = acc[pl.ds(i * b, b)]
+
+
+def _bwd_tri_call(zp, lse, *, b, inv_t, cols_actual, n_half, interpret):
+    rp, d = zp.shape
+    nb = rp // b
+    kernel = functools.partial(
+        _bwd_tri_kernel, b=b, inv_t=inv_t,
+        cols_actual=cols_actual, n_half=n_half, nb=nb,
+    )
+    lse_t = lse.reshape(1, rp)
+    return pl.pallas_call(
+        kernel,
+        grid=(nb, nb),
+        in_specs=[
+            pl.BlockSpec((b, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((b, d), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rp, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((rp, d), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=3 * rp * rp * d,  # vs the rectangular sym kernel's 4
+            bytes_accessed=(2 * rp * d + rp) * 4,
+            transcendentals=rp * rp,
+        ),
+        interpret=interpret,
+    )(zp, zp, lse, lse_t)
+
+
+def _tri_bwd_fits(rp: int, d: int, b: int) -> bool:
+    """Does the triangular backward's working set (full-length fp32
+    accumulator + two z blocks + output block) fit the VMEM budget?"""
+    from .blocks import VMEM_BUDGET_BYTES
+
+    working = rp * d * 4 + 3 * b * d * 4 + b * b * 4
+    return working <= VMEM_BUDGET_BYTES
 
 
 def _bwd_sym_kernel(z_row_ref, z_col_ref, gid_ref, scale_ref, lse_r_ref,
@@ -413,13 +621,20 @@ def _gid_column(row_gid: jax.Array, multiple: int, sentinel: int) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
-def _ntxent_sym(z, temperature, br, bc, interpret):
-    return _ntxent_sym_fwd(z, temperature, br, bc, interpret)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _ntxent_sym(z, temperature, br, bc, interpret, triangular=False):
+    return _ntxent_sym_fwd(z, temperature, br, bc, interpret, triangular)[0]
 
 
-def _ntxent_sym_fwd(z, temperature, br, bc, interpret):
+def _ntxent_sym_fwd(z, temperature, br, bc, interpret, triangular=False):
     two_n, _ = z.shape
+    if triangular and br == bc:
+        zp = _pad_rows(z, br)
+        loss_sum, lse = _fwd_tri_call(
+            zp, b=br, inv_t=1.0 / temperature,
+            cols_actual=two_n, n_half=two_n // 2, interpret=interpret,
+        )
+        return loss_sum, (z, lse)
     pad = math.lcm(br, bc)  # one padded array serves as both rows and columns
     zp = _pad_rows(z, pad)
     gid = _gid_column(jnp.arange(zp.shape[0]), pad, sentinel=two_n)
@@ -431,9 +646,19 @@ def _ntxent_sym_fwd(z, temperature, br, bc, interpret):
     return loss_sum, (z, lse)
 
 
-def _ntxent_sym_bwd(temperature, br, bc, interpret, res, g):
+def _ntxent_sym_bwd(temperature, br, bc, interpret, triangular, res, g):
     z, lse = res
-    two_n, _ = z.shape
+    two_n, d = z.shape
+    if triangular and br == bc \
+            and _tri_bwd_fits(round_up(two_n, br), d, br):
+        zp = _pad_rows(z, br)
+        grad = _bwd_tri_call(
+            zp, lse,
+            b=br, inv_t=1.0 / temperature,
+            cols_actual=two_n, n_half=two_n // 2, interpret=interpret,
+        )
+        grad = grad[:two_n] * (g / temperature)
+        return (grad.astype(z.dtype),)
     pad = math.lcm(br, bc)
     zp = _pad_rows(z, pad)
     gid = _gid_column(jnp.arange(zp.shape[0]), pad, sentinel=two_n)
@@ -456,21 +681,31 @@ def ntxent_loss_fused(
     block_rows: int | None = None,
     block_cols: int | None = None,
     interpret: bool | None = None,
+    triangular: bool = False,
 ) -> jax.Array:
     """Fused canonical NT-Xent mean loss over stacked views z: (2N, D).
 
     Drop-in fused equivalent of ``ops.oracle.ntxent_loss`` — same semantics,
     O(N) memory, exact gradients via custom VJP. ``temperature`` must be a
     static Python float (it is baked into the kernel).
+
+    ``triangular=True`` switches the forward to the upper-triangle kernel
+    (each similarity tile computed once, folded into both row blocks —
+    half the forward MXU work; requires square blocks, which are forced
+    when the flag is set). Numerics differ from the rectangular kernel
+    only by online-softmax fold order.
     """
     two_n = z.shape[0]
     if two_n % 2 != 0:
         raise ValueError(f"NT-Xent needs an even number of rows, got {two_n}")
     br, bc = choose_blocks(two_n, two_n, z.shape[1], z.dtype,
                            block_rows, block_cols)
+    if triangular:
+        br = bc = min(br, bc)
     if interpret is None:
         interpret = _default_interpret()
-    loss_sum = _ntxent_sym(z, float(temperature), br, bc, interpret)
+    loss_sum = _ntxent_sym(z, float(temperature), br, bc, interpret,
+                           triangular)
     return loss_sum / two_n
 
 

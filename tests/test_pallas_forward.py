@@ -100,3 +100,34 @@ def test_fused_under_jit_and_vmap_composition(rng):
     jitted = jax.jit(lambda zz: ntxent_loss_fused(zz, 0.07))
     np.testing.assert_allclose(float(jitted(z)), float(oracle.ntxent_loss(z, 0.07)),
                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("two_n,dim,b", [
+    (64, 32, 16),    # block-aligned
+    (40, 16, 16),    # padded rows (transposed fold sees masked columns)
+    (96, 24, 32),    # multiple blocks, padded
+])
+def test_triangular_fused_matches_oracle(rng, two_n, dim, b):
+    """Upper-triangle forward (each tile computed once, folded into both
+    row blocks) == oracle, including fwd+bwd through the custom VJP."""
+    z = make_embeddings(rng, two_n, dim)
+    want_l, want_g = jax.value_and_grad(
+        lambda zz: oracle.ntxent_loss(zz, 0.07))(z)
+    got_l, got_g = jax.value_and_grad(
+        lambda zz: ntxent_loss_fused(zz, 0.07, block_rows=b, block_cols=b,
+                                     triangular=True))(z)
+    np.testing.assert_allclose(float(got_l), float(want_l),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_g), np.asarray(want_g),
+                               rtol=1e-4, atol=1e-6)
+    assert bool(jnp.all(jnp.isfinite(got_g)))
+
+
+def test_triangular_forces_square_blocks(rng):
+    """triangular=True must work even when asked for rectangular blocks
+    (it squares them) and agree with the rectangular kernel."""
+    z = make_embeddings(rng, 64, 32)
+    rect = float(ntxent_loss_fused(z, 0.07, block_rows=32, block_cols=16))
+    tri = float(ntxent_loss_fused(z, 0.07, block_rows=32, block_cols=16,
+                                  triangular=True))
+    np.testing.assert_allclose(tri, rect, rtol=1e-6)
