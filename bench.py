@@ -130,10 +130,12 @@ def _child() -> None:
         # CUDA op itself, D11): same shape, bf16 inputs, fp32 softmax
         # accumulation inside the kernel. Headline stays fp32 for
         # protocol comparability.
+        import math
+
         try:
             bf16_ms, bf16_final = time_fn_chained(
                 loss_fn, z.astype(jnp.bfloat16), length=n_chain, spans=3)
-            if bf16_final == bf16_final:  # record only finite measurements
+            if math.isfinite(bf16_final):  # record only finite measurements
                 payload["bf16_steady_state_ms"] = bf16_ms
         except Exception as e:
             payload["bf16_error"] = repr(e)
@@ -148,7 +150,7 @@ def _child() -> None:
         try:
             tri_ms, tri_final = time_fn_chained(tri_loss, z,
                                                 length=n_chain, spans=3)
-            if tri_final == tri_final:
+            if math.isfinite(tri_final):
                 payload["tri_steady_state_ms"] = tri_ms
         except Exception as e:
             payload["tri_error"] = repr(e)

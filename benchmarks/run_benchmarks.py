@@ -324,7 +324,8 @@ def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
 
 def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
                       model_name: str = "resnet50",
-                      batch: int | None = None):
+                      batch: int | None = None,
+                      tag_batch: bool = False):
     """End-to-end train-step benchmark with automatic MFU.
 
     The role the reference's benchmark played for its hot path
@@ -416,7 +417,10 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
         "peak_flops_per_chip": peak_flops_per_chip(),
         "mfu": estimate_mfu(flops, sps) if flops else None,
     }
-    results.setdefault("trainer", {})[f"{name}@{batch}"] = entry
+    # Sweeps need one entry per size; plain runs keep the pre-sweep key
+    # schema so existing results.json consumers stay comparable.
+    key = f"{name}@{batch}" if tag_batch else name
+    results.setdefault("trainer", {})[key] = entry
     flops_str = f"{flops:.3e}" if flops else "n/a"
     mfu_str = f"{entry['mfu']:.1%}" if entry["mfu"] else "n/a"
     print(f"\n=== trainer step ({name}, batch {batch}, {size}x{size}) ===")
@@ -509,7 +513,8 @@ def main():
         for m in models:
             for b in batches:
                 run_trainer_bench(args.quick, results, args.trace,
-                                  model_name=m, batch=b)
+                                  model_name=m, batch=b,
+                                  tag_batch=len(batches) > 1)
 
     out_dir = Path(args.out)
     out_dir.mkdir(exist_ok=True)

@@ -223,11 +223,15 @@ def main(argv=None) -> int:
 
     n_dev = info["global_device_count"]
     if n_dev > 1:
-        from ntxent_tpu.parallel.mesh import data_sharding
+        from ntxent_tpu.parallel.mesh import data_sharding, replicate_state
 
         mesh = create_mesh(axis_names=("data",))
         step = make_sharded_train_step(mesh, cfg.temperature,
                                        remat=args.remat)
+        # Commit params/opt-state replicated on the mesh BEFORE fit's
+        # checkpoint restore: a fresh template restores committed to one
+        # device and the sharded step then rejects the device mismatch.
+        state = replicate_state(state, mesh)
         # Batches arrive already sharded over the mesh: single-process via
         # sharded device_put + sharded augmentation, multi-process via
         # GlobalTwoViewPipeline's uint8 global assembly.
@@ -405,6 +409,10 @@ def _train_clip(args, info, per_process_batch: int) -> int:
 
             mesh = create_mesh(axis_names=("data",))
             step = make_sharded_clip_train_step(mesh, remat=args.remat)
+            # Same rationale as the SimCLR mesh path: restore must land
+            # replicated on the mesh, not committed to one device.
+            from ntxent_tpu.parallel.mesh import replicate_state
+            state = replicate_state(state, mesh)
             logger.info("CLIP shard_map data-parallel over %d devices "
                         "(fused partial InfoNCE)", n_dev)
         sharding = NamedSharding(mesh, P("data"))

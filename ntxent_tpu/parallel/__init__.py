@@ -11,6 +11,7 @@ from ntxent_tpu.parallel.mesh import (
     init_distributed,
     local_row_gids,
     process_info,
+    replicate_state,
     replicated_sharding,
 )
 from ntxent_tpu.parallel.ring import (
@@ -34,6 +35,7 @@ __all__ = [
     "init_distributed",
     "local_row_gids",
     "process_info",
+    "replicate_state",
     "replicated_sharding",
     "make_sharded_ntxent",
     "ntxent_loss_distributed",

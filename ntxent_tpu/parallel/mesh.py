@@ -127,6 +127,22 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def replicate_state(tree, mesh: Mesh):
+    """Commit every leaf of a pytree to the mesh, fully replicated.
+
+    Freshly-created arrays are UNcommitted (jit re-places them freely), so
+    data-parallel steps appear to work without this — but arrays that come
+    back from a checkpoint restore are committed to whatever sharding the
+    restore template carried (a fresh template ⇒ single-device), and the
+    next sharded step fails with "incompatible devices". Replicating the
+    template BEFORE restore makes orbax restore straight onto the mesh —
+    which is also what makes a checkpoint from an 8-device run resume on a
+    4-device mesh (elastic recovery: the global computation is
+    device-count-invariant for replicated params + synced BatchNorm).
+    """
+    return jax.device_put(tree, replicated_sharding(mesh))
+
+
 def global_batch(local_batch, mesh: Mesh, axis: str = "data"):
     """Assemble per-process host batches into one global sharded array.
 

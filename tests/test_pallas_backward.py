@@ -101,14 +101,14 @@ def test_grad_random_shape_fuzz(rng):
     for case in range(8):
         two_n = 2 * int(shape_rng.integers(3, 160))
         dim = int(shape_rng.integers(4, 200))
-        tri = case % 2 == 1  # alternate rectangular / triangular kernels
         z = make_embeddings(jax.random.fold_in(rng, case), two_n, dim)
-        got_l, got_g = jax.value_and_grad(
-            lambda zz: ntxent_loss_fused(zz, 0.07, triangular=tri))(z)
         want_l, want_g = jax.value_and_grad(
             lambda zz: oracle.ntxent_loss(zz, 0.07))(z)
-        np.testing.assert_allclose(float(got_l), float(want_l),
-                                   rtol=1e-5, atol=1e-6,
-                                   err_msg=f"loss @ {(two_n, dim, tri)}")
-        np.testing.assert_allclose(got_g, want_g, rtol=1e-4, atol=1e-6,
-                                   err_msg=f"grad @ {(two_n, dim, tri)}")
+        for tri in (False, True):  # both kernels on every drawn shape
+            got_l, got_g = jax.value_and_grad(
+                lambda zz: ntxent_loss_fused(zz, 0.07, triangular=tri))(z)
+            np.testing.assert_allclose(float(got_l), float(want_l),
+                                       rtol=1e-5, atol=1e-6,
+                                       err_msg=f"loss @ {(two_n, dim, tri)}")
+            np.testing.assert_allclose(got_g, want_g, rtol=1e-4, atol=1e-6,
+                                       err_msg=f"grad @ {(two_n, dim, tri)}")
