@@ -94,6 +94,22 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--ckpt-dir", default=None)
     t.add_argument("--ckpt-every", type=int, default=500)
     t.add_argument("--log-every", type=int, default=50)
+    def _positive_float(text: str) -> float:
+        try:
+            value = float(text)
+        except ValueError:
+            raise argparse.ArgumentTypeError(f"not a number: {text!r}")
+        if value <= 0:
+            raise argparse.ArgumentTypeError(
+                f"must be a positive number of seconds, got {text!r}")
+        return value
+
+    t.add_argument("--stall-timeout", type=_positive_float, default=None,
+                   metavar="SECONDS",
+                   help="failure detection: if no train step completes for "
+                        "this long, dump all thread stacks (where is it "
+                        "stuck) and log the stall; the run itself is left "
+                        "alive (pair with external supervision to restart)")
 
     dist = p.add_argument_group("distributed (multi-host rendezvous; "
                                 "single-host multi-chip needs no flags)")
@@ -251,13 +267,19 @@ def _run_fit(data, state, step, args) -> int:
     """Shared training epilogue: preemption-guarded fit + final report
     (one copy for both objectives, so the resume hint and MFU line cannot
     drift)."""
-    from ntxent_tpu.training import PreemptionGuard, fit
+    import contextlib
 
-    with PreemptionGuard() as guard:
+    from ntxent_tpu.training import PreemptionGuard, fit
+    from ntxent_tpu.utils import StallWatchdog
+
+    watchdog = (StallWatchdog(timeout_s=args.stall_timeout)
+                if getattr(args, "stall_timeout", None) else None)
+    with PreemptionGuard() as guard, (watchdog or contextlib.nullcontext()):
         state, history = fit(
             state, data, step, num_steps=args.steps,
             checkpoint_dir=args.ckpt_dir, checkpoint_every=args.ckpt_every,
-            log_every=args.log_every, stop_fn=guard.requested)
+            log_every=args.log_every, stop_fn=guard.requested,
+            watchdog=watchdog)
     if history:
         last = history[-1]
         logger.info("final: step %d loss %.4f (%.2f steps/s%s)",

@@ -320,6 +320,7 @@ def train_loop(
     hook: Callable | None = None,
     step_hook: Callable | None = None,
     stop_fn: Callable[[], bool] | None = None,
+    watchdog=None,
 ):
     """Simple host loop: step, log loss / steps-per-sec / MFU.
 
@@ -336,6 +337,11 @@ def train_loop(
     ``stop_fn()`` is polled after every step; returning True ends the loop
     early at a step boundary (the preemption pathway —
     training/preemption.PreemptionGuard turns SIGTERM into exactly this).
+
+    ``watchdog`` (a started ``utils.watchdog.StallWatchdog``) is beaten
+    once per step, so a hung collective/transfer past its timeout produces
+    thread-stack dumps and fires its ``on_stall`` policy (§5.3 failure
+    detection — a stalled run should diagnose itself, not go silent).
     """
     history = []
     t0 = time.perf_counter()
@@ -357,6 +363,8 @@ def train_loop(
                 logger.info("compiled step cost: %.3e FLOPs/chip",
                             flops_per_step)
         state, metrics = train_step(state, v1, v2)
+        if watchdog is not None:
+            watchdog.beat()
         if step_hook is not None:
             step_hook(state)
         stopped = stop_fn is not None and stop_fn()
@@ -390,6 +398,7 @@ def fit(
     flops_per_step: float | str | None = "auto",
     fast_forward_data: bool = False,
     stop_fn: Callable[[], bool] | None = None,
+    watchdog=None,
 ):
     """Checkpoint-aware training: restore the latest checkpoint if one
     exists, train to ``num_steps`` total, save every ``checkpoint_every``
@@ -464,7 +473,7 @@ def fit(
             state, data_iter, train_step, remaining,
             log_every=log_every,
             flops_per_step=flops_per_step, step_hook=step_hook,
-            stop_fn=stop_fn)
+            stop_fn=stop_fn, watchdog=watchdog)
         if manager is not None \
                 and manager.latest_step() != int(state.step):
             manager.save(int(state.step), state, force=True,

@@ -9,10 +9,15 @@ from ntxent_tpu.utils.logging_utils import setup_logging
 from ntxent_tpu.utils.memory import DeviceMemoryTracker, device_memory_mb
 from ntxent_tpu.utils.profiling import (
     BenchmarkResults,
+    compile_chain,
+    flops_from_compiled,
     measured_flops,
+    time_chain,
     time_fn,
+    time_fn_chained,
     trace,
 )
+from ntxent_tpu.utils.watchdog import StallWatchdog
 
 __all__ = [
     "check_tensor_core_support",
@@ -24,7 +29,12 @@ __all__ = [
     "DeviceMemoryTracker",
     "device_memory_mb",
     "BenchmarkResults",
+    "compile_chain",
+    "flops_from_compiled",
     "measured_flops",
+    "time_chain",
     "time_fn",
+    "time_fn_chained",
     "trace",
+    "StallWatchdog",
 ]

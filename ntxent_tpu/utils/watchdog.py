@@ -1,0 +1,123 @@
+"""Stall detection for long-running training loops.
+
+The reference has no failure detection at all (SURVEY.md §5.3 — its error
+handling is throw-on-CUDA-error and exit(1) in harnesses). On real
+multi-chip runs the common failure mode is not an exception but SILENCE: a
+wedged collective, a hung host-device transfer, or a stuck input pipeline
+leaves the process alive and the logs frozen. ``StallWatchdog`` turns that
+silence into a diagnosis and an action:
+
+* the training loop calls ``beat()`` every step (``train_loop`` does this
+  automatically when given a watchdog);
+* a daemon thread checks the time since the last beat; past ``timeout_s``
+  it dumps EVERY thread's Python stack via ``faulthandler`` (to stderr or
+  ``dump_path``) — the "where is it stuck" evidence — and invokes
+  ``on_stall`` once (e.g. a preemption-style force-checkpoint, a metrics
+  alarm, or ``os.kill(os.getpid(), SIGTERM)`` to trigger the
+  ``PreemptionGuard`` save-and-exit path).
+
+The watchdog never kills anything by itself: policy lives in ``on_stall``.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import logging
+import threading
+import time
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["StallWatchdog"]
+
+
+class StallWatchdog:
+    """Background thread that flags a loop which stopped making progress.
+
+    Usage::
+
+        with StallWatchdog(timeout_s=600, on_stall=save_and_die) as dog:
+            for batch in data:
+                state, metrics = train_step(state, *batch)
+                dog.beat()
+
+    or pass it to ``train_loop(..., watchdog=dog)`` which beats per step.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float = 600.0,
+        on_stall: Callable[[float], None] | None = None,
+        poll_s: float | None = None,
+        dump_path: str | None = None,
+    ):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be positive, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.poll_s = float(poll_s) if poll_s is not None \
+            else max(0.05, self.timeout_s / 10.0)
+        self.on_stall = on_stall
+        self.dump_path = dump_path
+        self.stalled = threading.Event()
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def beat(self) -> None:
+        """Record progress; also re-arms the watchdog after a stall."""
+        self._last_beat = time.monotonic()
+        self.stalled.clear()
+
+    def _dump_stacks(self) -> None:
+        try:
+            if self.dump_path is not None:
+                with open(self.dump_path, "a") as f:
+                    f.write(f"=== StallWatchdog dump @ {time.time():.0f} "
+                            f"(no beat for {self.silent_for():.1f}s) ===\n")
+                    f.flush()
+                    faulthandler.dump_traceback(file=f)
+            else:
+                faulthandler.dump_traceback()
+        except Exception:  # diagnosis must never take the process down
+            logger.exception("watchdog stack dump failed")
+
+    def silent_for(self) -> float:
+        return time.monotonic() - self._last_beat
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            quiet = self.silent_for()
+            if quiet >= self.timeout_s and not self.stalled.is_set():
+                self.stalled.set()
+                logger.error("training stalled: no progress for %.1fs "
+                             "(timeout %.1fs) — dumping thread stacks",
+                             quiet, self.timeout_s)
+                self._dump_stacks()
+                if self.on_stall is not None:
+                    try:
+                        self.on_stall(quiet)
+                    except Exception:
+                        logger.exception("watchdog on_stall callback failed")
+
+    def start(self) -> "StallWatchdog":
+        if self._thread is not None:
+            raise RuntimeError("watchdog already started")
+        self._stop.clear()  # stop() leaves it set; allow restart
+        self.beat()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ntxent-stall-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.poll_s * 4 + 1.0)
+            self._thread = None
+
+    def __enter__(self) -> "StallWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -67,7 +67,10 @@ def test_cli_synthetic_run_checkpoints_and_resumes(tmp_path):
            "--batch", "16", "--steps", "4", "--warmup-steps", "1",
            "--proj-hidden-dim", "16", "--proj-dim", "8",
            "--ckpt-dir", str(ckpt), "--ckpt-every", "100",
-           "--log-every", "1", "--platform", "cpu"]
+           "--log-every", "1", "--platform", "cpu",
+           # failure-detection plumbing rides along: a healthy run with a
+           # generous stall timeout must behave identically
+           "--stall-timeout", "300"]
     first = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
                            env=env)
     assert first.returncode == 0, first.stdout + first.stderr
