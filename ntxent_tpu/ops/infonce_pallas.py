@@ -20,10 +20,17 @@ multiplies the fp32 MXU product — same arithmetic as the oracle, and
 d(loss)/d(scale) falls out of the row-gradient identity
 ``dL/dscale = sum_i (G @ zb)_i . za_i`` with no extra kernel pass.
 
-Backward runs ONE fused kernel per input: for grad_za the row-softmax term
-(via row lse) and the column-softmax term (via column lse) are combined into
-a single ``G`` tile before one MXU matmul — half the passes of composing two
-one-direction VJPs.
+Both passes walk the similarity matrix ONCE for BOTH softmax directions:
+
+* forward (``_dual_fwd_kernel``): each s tile is produced once on the MXU
+  and folded directly into the row direction's online-softmax stats and
+  transposed into the column direction's — half the matmul work of running
+  the one-direction forward twice;
+* backward (``_dual_bwd_kernel``): one s recompute and one shared
+  ``G = P_row + P_col - 2I`` tile drive both gradients
+  (``G @ zb`` and ``G^T @ za``) — 3 matmuls per tile vs 4 for two
+  one-direction VJPs, falling back to the two-pass form when the
+  full-length accumulators exceed VMEM.
 """
 
 from __future__ import annotations
@@ -33,15 +40,18 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-from .blocks import choose_blocks
+from .blocks import VMEM_BUDGET_BYTES, choose_blocks
 from .ntxent_pallas import (
+    _NEG_INF,
     _bwd_sym_call,
     _default_interpret,
-    _fwd_call,
     _gid_column,
     _ntxent_partial,
     _pad_rows,
+    _tile_ids,
 )
 
 __all__ = ["info_nce_fused", "info_nce_partial_fused", "resolve_scale"]
@@ -52,6 +62,232 @@ def resolve_scale(temperature: float, scale) -> jax.Array:
     if scale is None:
         scale = 1.0 / float(temperature)
     return jnp.asarray(scale, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Dual-direction kernels: ONE walk of s per pass, both softmax directions
+# ---------------------------------------------------------------------------
+
+
+def _dual_fwd_kernel(za_ref, zb_ref, scale_ref, loss_ref, lse_a_ref,
+                     lse_b_ref, m_a, l_a, p_a, m_b, l_b, p_b,
+                     *, br, bc, rows_actual, cols_actual):
+    """Cross-modal forward: each s tile is produced ONCE on the MXU and
+    folded into BOTH direction's online-softmax stats — the row direction
+    (za rows over zb columns) directly, the column direction (zb rows over
+    za columns, i.e. s.T) transposed. Halves the forward matmul work of
+    running _fwd_kernel twice. Full-length stats live in VMEM scratch; a
+    row block's stats complete when its grid row ends, a column block's
+    when the grid's LAST row visits it.
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    ni = pl.num_programs(0)
+    nj = pl.num_programs(1)
+
+    @pl.when(jnp.logical_and(i == 0, j == 0))
+    def _():
+        loss_ref[0, 0] = jnp.float32(0.0)
+        m_a[:] = jnp.full(m_a.shape, _NEG_INF, jnp.float32)
+        l_a[:] = jnp.zeros(l_a.shape, jnp.float32)
+        p_a[:] = jnp.zeros(p_a.shape, jnp.float32)
+        m_b[:] = jnp.full(m_b.shape, _NEG_INF, jnp.float32)
+        l_b[:] = jnp.zeros(l_b.shape, jnp.float32)
+        p_b[:] = jnp.zeros(p_b.shape, jnp.float32)
+
+    rid, cid = _tile_ids(i, j, br, bc)
+    s = jax.lax.dot_general(
+        za_ref[:], zb_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale_ref[0, 0]
+    # Cross-modal: the diagonal IS the positive; only padding is masked,
+    # separately per direction (padded zb rows are fake columns of s,
+    # padded za rows are fake columns of s.T).
+    s_rowdir = jnp.where(cid >= cols_actual, _NEG_INF, s)
+    s_coldir = jnp.where(rid >= rows_actual, _NEG_INF, s)
+    pos_hit = cid == rid
+
+    rs = pl.ds(i * br, br)
+    p_a[rs] += jnp.sum(jnp.where(pos_hit, s, 0.0), axis=1, keepdims=True)
+    m_old = m_a[rs]
+    m_new = jnp.maximum(m_old, jnp.max(s_rowdir, axis=1, keepdims=True))
+    l_a[rs] = l_a[rs] * jnp.exp(m_old - m_new) + jnp.sum(
+        jnp.exp(s_rowdir - m_new), axis=1, keepdims=True)
+    m_a[rs] = m_new
+
+    cs = pl.ds(j * bc, bc)
+    st = s_coldir.T
+    p_b[cs] += jnp.sum(jnp.where(pos_hit, s, 0.0), axis=0).reshape(bc, 1)
+    m_old_b = m_b[cs]
+    m_new_b = jnp.maximum(m_old_b, jnp.max(st, axis=1, keepdims=True))
+    l_b[cs] = l_b[cs] * jnp.exp(m_old_b - m_new_b) + jnp.sum(
+        jnp.exp(st - m_new_b), axis=1, keepdims=True)
+    m_b[cs] = m_new_b
+
+    @pl.when(j == nj - 1)
+    def _():
+        lse = m_a[rs] + jnp.log(l_a[rs])
+        lse_a_ref[:] = lse
+        valid = (jax.lax.broadcasted_iota(jnp.int32, (br, 1), 0) + i * br
+                 ) < rows_actual
+        loss_ref[0, 0] += jnp.sum(jnp.where(valid, lse - p_a[rs], 0.0))
+
+    # The (j, 0) output window is revisited every grid row; only its LAST
+    # visit (final grid row) publishes complete column-side stats, and the
+    # loss fold runs once there too.
+    lse_b_ref[:] = m_b[cs] + jnp.log(l_b[cs])
+
+    @pl.when(i == ni - 1)
+    def _():
+        validc = (jax.lax.broadcasted_iota(jnp.int32, (bc, 1), 0) + j * bc
+                  ) < cols_actual
+        loss_ref[0, 0] += jnp.sum(
+            jnp.where(validc, lse_b_ref[:] - p_b[cs], 0.0))
+
+
+def _dual_fwd_call(zap, zbp, scale, *, br, bc, rows_actual, cols_actual,
+                   interpret):
+    rp, d = zap.shape
+    cp = zbp.shape[0]
+    kernel = functools.partial(
+        _dual_fwd_kernel, br=br, bc=bc,
+        rows_actual=rows_actual, cols_actual=cols_actual,
+    )
+    loss_sum, lse_a, lse_b = pl.pallas_call(
+        kernel,
+        grid=(rp // br, cp // bc),
+        in_specs=[
+            pl.BlockSpec((br, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, 1), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((cp, 1), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((rp, 1), jnp.float32)] * 3
+        + [pltpu.VMEM((cp, 1), jnp.float32)] * 3,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * rp * cp * d,
+            bytes_accessed=(rp * d + (rp // br) * cp * d) * zap.dtype.itemsize,
+            transcendentals=2 * rp * cp,
+        ),
+        interpret=interpret,
+    )(zap, zbp, jnp.asarray(scale, jnp.float32).reshape(1, 1))
+    return loss_sum[0, 0], lse_a, lse_b
+
+
+def _dual_bwd_kernel(za_ref, zb_ref, scale_ref, lse_a_ref, lse_bt_ref,
+                     grad_a_ref, grad_b_ref, acc_a, acc_b,
+                     *, br, bc, rows_actual, cols_actual):
+    """Cross-modal backward: ONE s recompute and ONE shared G per tile
+    drive both gradients — ``acc_a[i] += G @ zb_j`` and
+    ``acc_b[j] += G^T @ za_i`` (G is the total dL/ds, so its transpose is
+    exactly the other operand's gradient matrix). 3 matmuls per tile vs 4
+    for two independent one-direction backward passes.
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    ni = pl.num_programs(0)
+    nj = pl.num_programs(1)
+
+    @pl.when(jnp.logical_and(i == 0, j == 0))
+    def _():
+        acc_a[:] = jnp.zeros(acc_a.shape, acc_a.dtype)
+        acc_b[:] = jnp.zeros(acc_b.shape, acc_b.dtype)
+
+    rid, cid = _tile_ids(i, j, br, bc)
+    s = jax.lax.dot_general(
+        za_ref[:], zb_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale_ref[0, 0]
+    p_row = jnp.exp(jnp.where(cid >= cols_actual, _NEG_INF, s)
+                    - lse_a_ref[:])
+    p_col = jnp.exp(jnp.where(rid >= rows_actual, _NEG_INF, s)
+                    - lse_bt_ref[:])
+    pos = (cid == rid).astype(jnp.float32)
+    valid_row = (rid < rows_actual).astype(jnp.float32)
+    valid_col = (cid < cols_actual).astype(jnp.float32)
+    g = (p_row - pos) * valid_row + (p_col - pos) * valid_col
+
+    rs = pl.ds(i * br, br)
+    acc_a[rs] += jax.lax.dot_general(
+        g, zb_ref[:].astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    cs = pl.ds(j * bc, bc)
+    acc_b[cs] += jax.lax.dot_general(
+        g, za_ref[:].astype(jnp.float32),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == nj - 1)
+    def _():
+        grad_a_ref[:] = acc_a[rs]
+
+    @pl.when(i == ni - 1)
+    def _():
+        grad_b_ref[:] = acc_b[cs]
+
+
+def _dual_bwd_call(zap, zbp, scale, lse_a, lse_b, *, br, bc, rows_actual,
+                   cols_actual, interpret):
+    rp, d = zap.shape
+    cp = zbp.shape[0]
+    kernel = functools.partial(
+        _dual_bwd_kernel, br=br, bc=bc,
+        rows_actual=rows_actual, cols_actual=cols_actual,
+    )
+    grad_a, grad_b = pl.pallas_call(
+        kernel,
+        grid=(rp // br, cp // bc),
+        in_specs=[
+            pl.BlockSpec((br, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bc), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, d), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, d), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, d), jnp.float32),
+            jax.ShapeDtypeStruct((cp, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rp, d), jnp.float32),
+            pltpu.VMEM((cp, d), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=6 * rp * cp * d,  # 3 matmuls/tile at 2 flops per MAC
+            bytes_accessed=(2 * rp * d + 2 * cp * d) * 4,
+            transcendentals=2 * rp * cp,
+        ),
+        interpret=interpret,
+    )(zap, zbp, jnp.asarray(scale, jnp.float32).reshape(1, 1), lse_a,
+      lse_b.reshape(1, cp))
+    return grad_a, grad_b
+
+
+def _dual_bwd_fits(rp: int, cp: int, d: int, br: int, bc: int) -> bool:
+    """Do both full-length fp32 accumulators plus the tile working set fit
+    the VMEM budget?"""
+    working = (rp + cp) * d * 4 + (2 * br + 2 * bc) * d * 4 + br * bc * 4
+    return working <= VMEM_BUDGET_BYTES
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -69,26 +305,48 @@ def _infonce_prepare(za, zb, br, bc):
 
 
 def _infonce_fwd(za, zb, scale, br, bc, interpret):
-    zap, zbp, gid, n = _infonce_prepare(za, zb, br, bc)
-    common = dict(br=br, bc=bc, inv_t=1.0, cols_actual=n, n_half=0,
-                  interpret=interpret, diag_pos=True, scale=scale)
-    loss_a, lse_a = _fwd_call(zap, zbp, gid, **common)   # rows of s
-    loss_b, lse_b = _fwd_call(zbp, zap, gid, **common)   # rows of s.T = cols
-    loss = (loss_a + loss_b) / (2 * n)
-    return loss, (za, zb, scale, lse_a, lse_b)
+    n = za.shape[0]
+    zap = _pad_rows(za, br)
+    zbp = _pad_rows(zb, bc)
+    loss_sum, lse_a, lse_b = _dual_fwd_call(
+        zap, zbp, scale, br=br, bc=bc,
+        rows_actual=n, cols_actual=n, interpret=interpret)
+    loss = loss_sum / (2 * n)
+    # Residuals trimmed to n: each backward path re-pads for its own tiling
+    # (zero lse on padded entries is safe — their g contributions are
+    # masked by valid_row/valid_col either way).
+    return loss, (za, zb, scale, lse_a[:n, 0], lse_b[:n, 0])
 
 
 def _infonce_bwd(br, bc, interpret, res, g):
+    from .blocks import round_up
+
     za, zb, scale, lse_a, lse_b = res
-    zap, zbp, gid, n = _infonce_prepare(za, zb, br, bc)
-    common = dict(br=br, bc=bc, inv_t=1.0, cols_actual=n, n_half=0,
-                  interpret=interpret, diag_pos=True, scale=scale)
-    # o_a[i] = sum_j G_ij zb_j with G = P_row + P_col - 2I (the total dL/ds
-    # before scale/normalization); o_b[j] = sum_i G_ij za_i.
-    o_a = _bwd_sym_call(zap, gid, lse_a, z_cols=zbp, lse_cols=lse_b,
-                        **common)[:n]
-    o_b = _bwd_sym_call(zbp, gid, lse_b, z_cols=zap, lse_cols=lse_a,
-                        **common)[:n]
+    n, d = za.shape
+    rp, cp = round_up(n, br), round_up(n, bc)
+    lse_a = lse_a.reshape(n, 1)
+    lse_b = lse_b.reshape(n, 1)
+    if _dual_bwd_fits(rp, cp, d, br, bc):
+        # o_a[i] = sum_j G_ij zb_j with G = P_row + P_col - 2I (the total
+        # dL/ds before scale/normalization); o_b[j] = sum_i G_ij za_i.
+        # One s recompute + one shared G per tile drives both.
+        o_a, o_b = _dual_bwd_call(
+            _pad_rows(za, br), _pad_rows(zb, bc), scale,
+            _pad_rows(lse_a, br), _pad_rows(lse_b, bc), br=br, bc=bc,
+            rows_actual=n, cols_actual=n, interpret=interpret)
+        o_a, o_b = o_a[:n], o_b[:n]
+    else:
+        # Accumulators don't fit VMEM at this (N, D): two one-direction
+        # passes over the shared rectangular backward kernel instead.
+        zap2, zbp2, gid, _ = _infonce_prepare(za, zb, br, bc)
+        lse_ap = _pad_rows(lse_a, zap2.shape[0])
+        lse_bp = _pad_rows(lse_b, zap2.shape[0])
+        common = dict(br=br, bc=bc, inv_t=1.0, cols_actual=n, n_half=0,
+                      interpret=interpret, diag_pos=True, scale=scale)
+        o_a = _bwd_sym_call(zap2, gid, lse_ap, z_cols=zbp2, lse_cols=lse_bp,
+                            **common)[:n]
+        o_b = _bwd_sym_call(zbp2, gid, lse_bp, z_cols=zap2, lse_cols=lse_ap,
+                            **common)[:n]
     coef = g / (2 * n)
     grad_za = (o_a * (coef * scale)).astype(za.dtype)
     grad_zb = (o_b * (coef * scale)).astype(zb.dtype)
