@@ -54,7 +54,8 @@ from .ntxent_pallas import (
     _tile_ids,
 )
 
-__all__ = ["info_nce_fused", "info_nce_partial_fused", "resolve_scale"]
+__all__ = ["info_nce_fused", "info_nce_partial_fused",
+           "info_nce_dual_partial", "resolve_scale"]
 
 
 def resolve_scale(temperature: float, scale) -> jax.Array:
@@ -184,14 +185,19 @@ def _dual_fwd_call(zap, zbp, scale, *, br, bc, rows_actual, cols_actual,
     return loss_sum[0, 0], lse_a, lse_b
 
 
-def _dual_bwd_kernel(za_ref, zb_ref, scale_ref, lse_a_ref, lse_bt_ref,
-                     grad_a_ref, grad_b_ref, acc_a, acc_b,
+def _dual_bwd_kernel(za_ref, zb_ref, gid_ref, scale_ref, lse_a_ref,
+                     lse_bt_ref, grad_a_ref, grad_b_ref, acc_a, acc_b,
                      *, br, bc, rows_actual, cols_actual):
     """Cross-modal backward: ONE s recompute and ONE shared G per tile
     drive both gradients — ``acc_a[i] += G @ zb_j`` and
     ``acc_b[j] += G^T @ za_i`` (G is the total dL/ds, so its transpose is
     exactly the other operand's gradient matrix). 3 matmuls per tile vs 4
     for two independent one-direction backward passes.
+
+    Row identity comes from the ``gid_ref`` operand (global row ids,
+    sentinel >= rows_actual on padded rows): the symmetric case passes
+    [0..n), the distributed dual-partial case its shard's global ids —
+    positives sit at ``cid == gid``.
     """
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -203,7 +209,8 @@ def _dual_bwd_kernel(za_ref, zb_ref, scale_ref, lse_a_ref, lse_bt_ref,
         acc_a[:] = jnp.zeros(acc_a.shape, acc_a.dtype)
         acc_b[:] = jnp.zeros(acc_b.shape, acc_b.dtype)
 
-    rid, cid = _tile_ids(i, j, br, bc)
+    rid = gid_ref[:]                                  # (BR, 1) global ids
+    _, cid = _tile_ids(i, j, br, bc)
     s = jax.lax.dot_general(
         za_ref[:], zb_ref[:],
         dimension_numbers=(((1,), (1,)), ((), ())),
@@ -240,8 +247,8 @@ def _dual_bwd_kernel(za_ref, zb_ref, scale_ref, lse_a_ref, lse_bt_ref,
         grad_b_ref[:] = acc_b[cs]
 
 
-def _dual_bwd_call(zap, zbp, scale, lse_a, lse_b, *, br, bc, rows_actual,
-                   cols_actual, interpret):
+def _dual_bwd_call(zap, zbp, row_gid, scale, lse_a, lse_b, *, br, bc,
+                   rows_actual, cols_actual, interpret):
     rp, d = zap.shape
     cp = zbp.shape[0]
     kernel = functools.partial(
@@ -254,6 +261,7 @@ def _dual_bwd_call(zap, zbp, scale, lse_a, lse_b, *, br, bc, rows_actual,
         in_specs=[
             pl.BlockSpec((br, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((bc, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda i, j: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, bc), lambda i, j: (0, j), memory_space=pltpu.VMEM),
@@ -278,8 +286,8 @@ def _dual_bwd_call(zap, zbp, scale, lse_a, lse_b, *, br, bc, rows_actual,
             transcendentals=2 * rp * cp,
         ),
         interpret=interpret,
-    )(zap, zbp, jnp.asarray(scale, jnp.float32).reshape(1, 1), lse_a,
-      lse_b.reshape(1, cp))
+    )(zap, zbp, row_gid, jnp.asarray(scale, jnp.float32).reshape(1, 1),
+      lse_a, lse_b.reshape(1, cp))
     return grad_a, grad_b
 
 
@@ -331,7 +339,8 @@ def _infonce_bwd(br, bc, interpret, res, g):
         # dL/ds before scale/normalization); o_b[j] = sum_i G_ij za_i.
         # One s recompute + one shared G per tile drives both.
         o_a, o_b = _dual_bwd_call(
-            _pad_rows(za, br), _pad_rows(zb, bc), scale,
+            _pad_rows(za, br), _pad_rows(zb, bc),
+            _gid_column(jnp.arange(n), br, sentinel=n), scale,
             _pad_rows(lse_a, br), _pad_rows(lse_b, bc), br=br, bc=bc,
             rows_actual=n, cols_actual=n, interpret=interpret)
         o_a, o_b = o_a[:n], o_b[:n]
@@ -384,6 +393,131 @@ def info_nce_fused(
     if interpret is None:
         interpret = _default_interpret()
     return _infonce(za, zb, scale, br, bc, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Distributed dual-partial: one matmul pass per device, both directions
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _infonce_dual_local(za_local, zb_g, row_gid, scale, axis, br, bc,
+                        interpret):
+    """Per-device symmetric-InfoNCE partial SUM (call inside shard_map).
+
+    ONE tile walk of this device's local-rows x global-cols block of
+    ``s = scale * za @ zb.T`` yields the local row logsumexp AND this
+    device's partial column statistics; the global column logsumexp is a
+    cheap cross-device merge (pmax/psum over an (N,) vector) instead of a
+    second all-gather + matmul pass. The two-pass path
+    (``local_infonce_allgather``) gathers BOTH modalities and walks two
+    blocks; this walks one and gathers one.
+
+    Returns ``sum_local_i (lse_row_i - s_ii) + sum_local_i
+    (lse_col_gid(i) - s_ii)`` — psum across devices and divide by 2N for
+    the mean loss. Gradients are hand-derived (the combined
+    ``G = P_row + P_col - 2I`` identity): za_local's flows directly,
+    zb_g's partial flows back through the caller's all_gather as a
+    reduce-scatter, and the scale's partial is psum'd by shard_map AD.
+    """
+    return _infonce_dual_local_fwd(za_local, zb_g, row_gid, scale, axis,
+                                   br, bc, interpret)[0]
+
+
+def _infonce_dual_local_fwd(za_local, zb_g, row_gid, scale, axis, br, bc,
+                            interpret):
+    n_local = za_local.shape[0]
+    n = zb_g.shape[0]
+    zap = _pad_rows(za_local, br)
+    zbp = _pad_rows(zb_g, bc)
+    # Stats-only use of the dual forward kernel: positions are local, so
+    # its in-kernel positive/loss accumulation is ignored — positives are
+    # the global diagonal, recovered below from a rowwise dot.
+    _, lse_a_p, lse_b_p = _dual_fwd_call(
+        zap, zbp, scale, br=br, bc=bc,
+        rows_actual=n_local, cols_actual=n, interpret=interpret)
+    lse_a = lse_a_p[:n_local, 0]
+    lse_b_part = lse_b_p[:n, 0]
+    # Global column logsumexp: logsumexp-merge of the per-device partial
+    # stats — an (N,) collective, not a matmul.
+    m = jax.lax.pmax(lse_b_part, axis)
+    lse_b = m + jnp.log(jax.lax.psum(jnp.exp(lse_b_part - m), axis))
+    # Positive logits s_ii for the local pairs: zb row gid(i) gathered from
+    # the already-present zb_g.
+    pos = scale * jnp.sum(
+        za_local.astype(jnp.float32)
+        * jnp.take(zb_g, row_gid, axis=0).astype(jnp.float32), axis=1)
+    loss_part = jnp.sum(lse_a - pos) + jnp.sum(
+        jnp.take(lse_b, row_gid) - pos)
+    return loss_part, (za_local, zb_g, row_gid, scale, lse_a, lse_b)
+
+
+def _infonce_dual_local_bwd(axis, br, bc, interpret, res, g):
+    from .ntxent_pallas import _bwd_sym_call, _bwd_sym_cols_call
+
+    za_local, zb_g, row_gid, scale, lse_a, lse_b = res
+    n_local, d = za_local.shape
+    n = zb_g.shape[0]
+    zap = _pad_rows(za_local, br)
+    zbp = _pad_rows(zb_g, bc)
+    gid_col = _gid_column(row_gid, br, sentinel=n)
+    lse_ap = _pad_rows(lse_a.reshape(n_local, 1), br)
+    lse_bp = _pad_rows(lse_b.reshape(n, 1), bc)
+    # o_a = G @ zb over local rows; o_b_partial = G^T @ za over ALL columns
+    # (this device's row contribution — shard_map AD of the caller's
+    # all_gather psums it into the true zb gradient, i.e. reduce-scatter).
+    if _dual_bwd_fits(zap.shape[0], zbp.shape[0], d, br, bc):
+        # Shared-G kernel: one s recompute + two grad dots per tile.
+        o_a, o_b = _dual_bwd_call(
+            zap, zbp, gid_col, scale, lse_ap, lse_bp, br=br, bc=bc,
+            rows_actual=n, cols_actual=n, interpret=interpret)
+        o_a, o_b = o_a[:n_local], o_b[:n]
+    else:
+        # Accumulators exceed VMEM (large gathered N x D): two passes,
+        # each rebuilding G for its own output side.
+        common = dict(br=br, bc=bc, inv_t=1.0, cols_actual=n, n_half=0,
+                      interpret=interpret, diag_pos=True, scale=scale)
+        o_a = _bwd_sym_call(zap, gid_col, lse_ap, z_cols=zbp,
+                            lse_cols=lse_bp, **common)[:n_local]
+        o_b = _bwd_sym_cols_call(zap, zbp, gid_col, lse_ap, lse_bp,
+                                 **common)[:n]
+    grad_za = (o_a * (g * scale)).astype(za_local.dtype)
+    grad_zb = (o_b * (g * scale)).astype(zb_g.dtype)
+    grad_scale = (g * jnp.sum(o_a * za_local.astype(jnp.float32))).reshape(
+        jnp.shape(scale)).astype(scale.dtype)
+    return grad_za, grad_zb, None, grad_scale
+
+
+_infonce_dual_local.defvjp(_infonce_dual_local_fwd, _infonce_dual_local_bwd)
+
+
+def info_nce_dual_partial(
+    za_local: jax.Array,
+    zb_g: jax.Array,
+    row_gid: jax.Array,
+    axis: str,
+    *,
+    scale: jax.Array | float = 1.0,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Both-direction partial InfoNCE **sum** from ONE similarity walk.
+
+    For use inside shard_map: ``za_local`` (local rows), ``zb_g`` (the
+    all-gathered other modality), ``row_gid`` the local rows' global ids,
+    ``axis`` the mesh axis for the column-stat merge collectives. See
+    ``parallel.dist_loss.local_infonce_dual`` for the assembled loss.
+    """
+    br, bc = choose_blocks(za_local.shape[0], zb_g.shape[0],
+                           za_local.shape[1], za_local.dtype,
+                           block_rows, block_cols)
+    if interpret is None:
+        interpret = _default_interpret()
+    return _infonce_dual_local(za_local, zb_g,
+                               row_gid.astype(jnp.int32),
+                               jnp.asarray(scale, jnp.float32), axis, br, bc,
+                               interpret)
 
 
 def info_nce_partial_fused(

@@ -449,6 +449,79 @@ def _bwd_sym_kernel(z_row_ref, z_col_ref, gid_ref, scale_ref, lse_r_ref,
     )
 
 
+def _bwd_sym_cols_kernel(z_row_ref, z_col_ref, gid_ref, scale_ref,
+                         lse_r_ref, lse_c_ref, grad_ref,
+                         *, br, bc, inv_t, cols_actual, n_half,
+                         diag_pos=False):
+    """Column-side twin of ``_bwd_sym_kernel``: the same combined
+    ``G = (P_row - pos)·vr + (P_col - pos)·vc`` tile, but the output is
+    ``G^T @ z_rows`` accumulated per COLUMN block — the partial gradient
+    of the gathered column operand (what flows back through all_gather as
+    a reduce-scatter in the distributed dual-InfoNCE path). Grid is
+    (col_block, row_block), rows innermost.
+    """
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        grad_ref[:] = jnp.zeros(grad_ref.shape, grad_ref.dtype)
+
+    row_gid = gid_ref[:]
+    _, cid = _tile_ids(i, j, br, bc)
+    s_masked, _ = _masked_sim_tile(
+        z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t * scale_ref[0, 0],
+        cols_actual, diag_pos
+    )
+    p_row = jnp.exp(s_masked - lse_r_ref[:])
+    p_col = jnp.exp(s_masked - lse_c_ref[:])
+    pos = (cid == _pos_gid(row_gid, n_half, diag_pos)).astype(jnp.float32)
+    valid_row = (row_gid < cols_actual).astype(jnp.float32)
+    valid_col = (cid < cols_actual).astype(jnp.float32)
+    g = (p_row - pos) * valid_row + (p_col - pos) * valid_col
+    grad_ref[:] += jax.lax.dot_general(
+        g, z_row_ref[:].astype(jnp.float32),
+        dimension_numbers=(((0,), (0,)), ((), ())),   # (BC, D)
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _bwd_sym_cols_call(z_rows, z_cols, row_gid, lse_rows, lse_cols, *,
+                       br, bc, inv_t, cols_actual, n_half, interpret,
+                       diag_pos=False, scale=None):
+    """(Cp, D) partial gradient of the column operand under the combined-G
+    identity — pairs with ``_bwd_sym_call`` (which produces the row side).
+    ``lse_cols`` must already be the GLOBAL column logsumexp."""
+    rp, d = z_rows.shape
+    cp = z_cols.shape[0]
+    kernel = functools.partial(
+        _bwd_sym_cols_kernel, br=br, bc=bc, inv_t=inv_t,
+        cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(cp // bc, rp // br),
+        in_specs=[
+            pl.BlockSpec((br, d), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, d), lambda j, i: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda j, i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((br, 1), lambda j, i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bc), lambda j, i: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bc, d), lambda j, i: (j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((cp, d), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * rp * cp * d,
+            bytes_accessed=(rp + cp) * d * 4,
+            transcendentals=2 * rp * cp,
+        ),
+        interpret=interpret,
+    )(z_rows, z_cols, row_gid, _scale_arr(scale), lse_rows,
+      lse_cols.reshape(1, cp))
+
+
 def _bwd_rows_kernel(z_row_ref, z_col_ref, gid_ref, cgid_ref, scale_ref,
                      lse_r_ref, grad_ref,
                      *, br, bc, inv_t, cols_actual, n_half, diag_pos=False):

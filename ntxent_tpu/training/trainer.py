@@ -26,7 +26,10 @@ from flax.training import train_state
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.ntxent_pallas import ntxent_loss_fused
-from ..parallel.dist_loss import local_infonce_allgather, local_ntxent_allgather
+from ..parallel.dist_loss import (
+    local_ntxent_allgather,
+    resolve_local_infonce,
+)
 from .lars import cosine_warmup_schedule, create_lars, simclr_learning_rate
 
 logger = logging.getLogger(__name__)
@@ -244,25 +247,29 @@ def make_sharded_clip_train_step(
     axis: str = "data",
     interpret: bool | None = None,
     remat: bool = False,
+    loss_impl: str = "dual",
 ) -> Callable:
     """Distributed CLIP train step over the mesh's data axis (shard_map).
 
     The dual-tower analog of ``make_sharded_train_step``: per-device tower
-    forwards on the local (images, tokens) shard, both modality embeddings
-    all-gathered into the FUSED partial InfoNCE
-    (parallel.dist_loss.local_infonce_allgather — per-device local-rows x
-    global-cols blocks, O(N) residuals), gradients pmean'd. This is the
-    production TPU path for data-parallel CLIP; use
-    ``parallel.tp.make_tp_clip_train_step`` when the towers themselves
-    need sharding (GSPMD tensor parallelism).
+    forwards on the local (images, tokens) shard, then the fused partial
+    InfoNCE over the global batch (per-device local-rows x global-cols
+    blocks, O(N) residuals), gradients pmean'd. ``loss_impl="dual"``
+    (default) gathers one modality and walks the similarity block once
+    for both softmax directions (dist_loss.local_infonce_dual — half the
+    loss communication and matmuls); ``"twopass"`` keeps the
+    gather-both/walk-twice form. This is the production TPU path for
+    data-parallel CLIP; use ``parallel.tp.make_tp_clip_train_step`` when
+    the towers themselves need sharding (GSPMD tensor parallelism).
     """
+    local_loss = resolve_local_infonce(loss_impl)
 
     def per_device_step(state, images, tokens):
         towers = _clip_towers(state, remat)
 
         def loss_fn(params):
             zi, zt, scale = towers(params, images, tokens)
-            return local_infonce_allgather(zi, zt, scale, axis, interpret)
+            return local_loss(zi, zt, scale, axis, interpret)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         grads = jax.lax.pmean(grads, axis)
