@@ -294,10 +294,80 @@ def _infonce_ring_body(za_local, zb_local, scale, axis, num_devices):
     return jax.lax.psum(loss_sum, axis) / (2 * n)
 
 
-def make_ring_infonce(mesh: Mesh, axis: str = "data"):
-    """Build a jit-able ring InfoNCE over ``mesh``: (za, zb, scale) -> loss."""
+def _infonce_ring_dual_body(za_local, zb_local, scale, axis, num_devices):
+    """Dual ring InfoNCE: ONE matmul and ONE circulating block per hop.
+
+    Observation: in the two-block ring (``_infonce_ring_body``) every
+    global similarity tile is produced twice across the mesh — device d
+    computes ``S[rows_d, cols_o]`` when o's zb block visits, and device o
+    computes the SAME tile transposed (as ``S.T[rows_o, cols_d]``) when
+    d's za block visits. Here only the zb blocks circulate, each carrying
+    its running column-direction (m, l) statistics: per hop the single
+    tile ``za_local @ zb_blk.T`` is folded into the local row statistics
+    directly AND into the visiting block's stats transposed. Half the
+    matmuls and nearly half the ICI bytes per hop (one (n_local, D) block
+    plus two (n_local,) stat vectors instead of two blocks); one extra
+    stats-only hop at the end returns each block's completed column
+    logsumexp home.
+    """
+    n_local, _ = za_local.shape
+    n = n_local * num_devices
+    pos = jnp.sum(za_local * zb_local, axis=-1,
+                  dtype=jnp.float32) * scale
+
+    perm = [(i, (i + 1) % num_devices) for i in range(num_devices)]
+
+    def fold_both(zb_blk, m_a, l_a, m_blk, l_blk):
+        s = jnp.dot(za_local, zb_blk.T,
+                    preferred_element_type=jnp.float32) * scale
+        # Row direction: local za rows vs the visiting columns.
+        m_new = jnp.maximum(m_a, jnp.max(s, axis=1))
+        l_a = l_a * jnp.exp(m_a - m_new) + jnp.sum(
+            jnp.exp(s - m_new[:, None]), axis=1)
+        # Column direction: the SAME tile transposed is the visiting zb
+        # rows vs this device's za columns.
+        st = s.T
+        m_bn = jnp.maximum(m_blk, jnp.max(st, axis=1))
+        l_blk = l_blk * jnp.exp(m_blk - m_bn) + jnp.sum(
+            jnp.exp(st - m_bn[:, None]), axis=1)
+        return m_new, l_a, m_bn, l_blk
+
+    def step(carry, _):
+        zb_blk, m_a, l_a, m_blk, l_blk = carry
+        m_a, l_a, m_blk, l_blk = fold_both(zb_blk, m_a, l_a, m_blk, l_blk)
+        zb_blk, m_blk, l_blk = (
+            jax.lax.ppermute(t, axis, perm) for t in (zb_blk, m_blk, l_blk))
+        return (zb_blk, m_a, l_a, m_blk, l_blk), None
+
+    def stat(v):
+        return jax.lax.pcast(jnp.full((n_local,), v, jnp.float32),
+                             (axis,), to="varying")
+
+    init = (zb_local, stat(_NEG_INF), stat(0.0), stat(_NEG_INF), stat(0.0))
+    (zb_blk, m_a, l_a, m_blk, l_blk), _ = jax.lax.scan(
+        step, init, None, length=num_devices - 1
+    )
+    m_a, l_a, m_blk, l_blk = fold_both(zb_blk, m_a, l_a, m_blk, l_blk)
+    # The block is one hop short of home — send its finished stats there.
+    m_blk, l_blk = (jax.lax.ppermute(t, axis, perm) for t in (m_blk, l_blk))
+    lse_a = m_a + jnp.log(l_a)
+    lse_b = m_blk + jnp.log(l_blk)
+    loss_sum = jnp.sum(lse_a - pos) + jnp.sum(lse_b - pos)
+    return jax.lax.psum(loss_sum, axis) / (2 * n)
+
+
+def make_ring_infonce(mesh: Mesh, axis: str = "data", impl: str = "dual"):
+    """Build a jit-able ring InfoNCE over ``mesh``: (za, zb, scale) -> loss.
+
+    ``impl="dual"`` (default) circulates one block per hop and folds each
+    similarity tile into both softmax directions; ``impl="twoblock"``
+    circulates both modality blocks (kept for A/B comparison).
+    """
+    if impl not in ("dual", "twoblock"):
+        raise ValueError(f"unknown ring impl {impl!r}")
     body = functools.partial(
-        _infonce_ring_body, axis=axis, num_devices=mesh.shape[axis])
+        _infonce_ring_dual_body if impl == "dual" else _infonce_ring_body,
+        axis=axis, num_devices=mesh.shape[axis])
     return jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis), P()),
                          out_specs=P())
 
