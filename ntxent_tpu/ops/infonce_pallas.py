@@ -48,6 +48,8 @@ from .ntxent_pallas import (
     _NEG_INF,
     _bwd_sym_call,
     _default_interpret,
+    _exp0,
+    _log_l,
     _gid_column,
     _ntxent_partial,
     _pad_rows,
@@ -114,7 +116,7 @@ def _dual_fwd_kernel(za_ref, zb_ref, scale_ref, loss_ref, lse_a_ref,
     m_old = m_a[rs]
     m_new = jnp.maximum(m_old, jnp.max(s_rowdir, axis=1, keepdims=True))
     l_a[rs] = l_a[rs] * jnp.exp(m_old - m_new) + jnp.sum(
-        jnp.exp(s_rowdir - m_new), axis=1, keepdims=True)
+        _exp0(s_rowdir - m_new), axis=1, keepdims=True)
     m_a[rs] = m_new
 
     cs = pl.ds(j * bc, bc)
@@ -123,12 +125,12 @@ def _dual_fwd_kernel(za_ref, zb_ref, scale_ref, loss_ref, lse_a_ref,
     m_old_b = m_b[cs]
     m_new_b = jnp.maximum(m_old_b, jnp.max(st, axis=1, keepdims=True))
     l_b[cs] = l_b[cs] * jnp.exp(m_old_b - m_new_b) + jnp.sum(
-        jnp.exp(st - m_new_b), axis=1, keepdims=True)
+        _exp0(st - m_new_b), axis=1, keepdims=True)
     m_b[cs] = m_new_b
 
     @pl.when(j == nj - 1)
     def _():
-        lse = m_a[rs] + jnp.log(l_a[rs])
+        lse = m_a[rs] + _log_l(l_a[rs])
         lse_a_ref[:] = lse
         valid = (jax.lax.broadcasted_iota(jnp.int32, (br, 1), 0) + i * br
                  ) < rows_actual
@@ -137,7 +139,7 @@ def _dual_fwd_kernel(za_ref, zb_ref, scale_ref, loss_ref, lse_a_ref,
     # The (j, 0) output window is revisited every grid row; only its LAST
     # visit (final grid row) publishes complete column-side stats, and the
     # loss fold runs once there too.
-    lse_b_ref[:] = m_b[cs] + jnp.log(l_b[cs])
+    lse_b_ref[:] = m_b[cs] + _log_l(l_b[cs])
 
     @pl.when(i == ni - 1)
     def _():
@@ -216,10 +218,10 @@ def _dual_bwd_kernel(za_ref, zb_ref, gid_ref, scale_ref, lse_a_ref,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale_ref[0, 0]
-    p_row = jnp.exp(jnp.where(cid >= cols_actual, _NEG_INF, s)
-                    - lse_a_ref[:])
-    p_col = jnp.exp(jnp.where(rid >= rows_actual, _NEG_INF, s)
-                    - lse_bt_ref[:])
+    p_row = _exp0(jnp.where(cid >= cols_actual, _NEG_INF, s)
+                  - lse_a_ref[:])
+    p_col = _exp0(jnp.where(rid >= rows_actual, _NEG_INF, s)
+                  - lse_bt_ref[:])
     pos = (cid == rid).astype(jnp.float32)
     valid_row = (rid < rows_actual).astype(jnp.float32)
     valid_col = (cid < cols_actual).astype(jnp.float32)

@@ -54,6 +54,30 @@ __all__ = [
 _NEG_INF = -1e30
 
 
+def _exp0(x):
+    """``exp(min(x, 0))`` — the online-softmax/softmax-prob exponent.
+
+    Every exp in these kernels has a mathematically non-positive argument
+    (``s - rowmax(s)`` or ``s - lse``), so the clamp is exact. It exists
+    because a compiler may FUSE the similarity matmul into both the
+    max/lse consumer and the exp consumer, recomputing it with different
+    reassociation; at extreme logit magnitudes (|s| ≳ 1e9 in fp32) the
+    skew between the two evaluations can exceed 88 and a mathematically
+    impossible ``exp(>88) = inf`` appears (observed under XLA:CPU with the
+    interpret-mode kernels; flash-attention implementations carry the
+    same guard). Clamping caps the damage at exp(0) = 1.
+    """
+    return jnp.exp(jnp.minimum(x, 0.0))
+
+
+def _log_l(l):
+    """``log(l)`` with a tiny floor. Mathematically l >= 1 after any fold
+    (the row-max entry contributes exp(0)); it can only reach 0 through the
+    cross-evaluation skew described in _exp0, where a floor turns a
+    harmless relative error into a finite lse instead of -inf."""
+    return jnp.log(jnp.maximum(l, 1e-37))
+
+
 def _default_interpret() -> bool:
     platform = jax.devices()[0].platform
     return platform not in ("tpu", "axon")
@@ -133,13 +157,13 @@ def _fwd_kernel(zr_ref, zc_ref, gid_ref, cgid_ref, scale_ref, loss_ref,
     m_old = m_ref[:]
     m_new = jnp.maximum(m_old, jnp.max(s_masked, axis=1, keepdims=True))
     l_ref[:] = l_ref[:] * jnp.exp(m_old - m_new) + jnp.sum(
-        jnp.exp(s_masked - m_new), axis=1, keepdims=True
+        _exp0(s_masked - m_new), axis=1, keepdims=True
     )
     m_ref[:] = m_new
 
     @pl.when(j == nj - 1)
     def _():
-        lse = m_ref[:] + jnp.log(l_ref[:])
+        lse = m_ref[:] + _log_l(l_ref[:])
         lse_ref[:] = lse
         valid = row_gid < cols_actual
         loss_ref[0, 0] += jnp.sum(jnp.where(valid, lse - p_ref[:], 0.0))
@@ -168,7 +192,7 @@ def _fwd_call(z_rows, z_cols, row_gid, *, br, bc, inv_t, cols_actual, n_half,
     kernel = functools.partial(
         _fwd_kernel, br=br, bc=bc, inv_t=inv_t,
         cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
-    )
+        )
     loss_sum, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -247,7 +271,7 @@ def _fwd_tri_kernel(zr_ref, zc_ref, loss_ref, lse_ref, m_all, l_all, p_all,
         m_old = m_all[rs]
         m_new = jnp.maximum(m_old, jnp.max(s_masked, axis=1, keepdims=True))
         l_all[rs] = l_all[rs] * jnp.exp(m_old - m_new) + jnp.sum(
-            jnp.exp(s_masked - m_new), axis=1, keepdims=True
+            _exp0(s_masked - m_new), axis=1, keepdims=True
         )
         m_all[rs] = m_new
 
@@ -263,7 +287,7 @@ def _fwd_tri_kernel(zr_ref, zc_ref, loss_ref, lse_ref, m_all, l_all, p_all,
             m_new_c = jnp.maximum(
                 m_old_c, jnp.max(st, axis=1, keepdims=True))
             l_all[cs] = l_all[cs] * jnp.exp(m_old_c - m_new_c) + jnp.sum(
-                jnp.exp(st - m_new_c), axis=1, keepdims=True
+                _exp0(st - m_new_c), axis=1, keepdims=True
             )
             m_all[cs] = m_new_c
 
@@ -271,7 +295,7 @@ def _fwd_tri_kernel(zr_ref, zc_ref, loss_ref, lse_ref, m_all, l_all, p_all,
     @pl.when(j == nb - 1)
     def _():
         rs = pl.ds(i * b, b)
-        lse = m_all[rs] + jnp.log(l_all[rs])
+        lse = m_all[rs] + _log_l(l_all[rs])
         lse_ref[:] = lse
         rid = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0) + i * b
         valid = rid < cols_actual
@@ -346,8 +370,8 @@ def _bwd_tri_kernel(zr_ref, zc_ref, lse_r_ref, lse_c_ref, grad_ref, acc,
         s_masked, _ = _masked_sim_tile(
             zr_ref[:], zc_ref[:], rid, cid, inv_t, cols_actual
         )
-        p_row = jnp.exp(s_masked - lse_r_ref[:])      # exp(s - lse[row])
-        p_col = jnp.exp(s_masked - lse_c_ref[:])      # exp(s - lse[col])
+        p_row = _exp0(s_masked - lse_r_ref[:])      # exp(s - lse[row])
+        p_col = _exp0(s_masked - lse_c_ref[:])      # exp(s - lse[col])
         pos = (cid == _pos_gid(rid, n_half)).astype(jnp.float32)
         valid_row = (rid < cols_actual).astype(jnp.float32)
         valid_col = (cid < cols_actual).astype(jnp.float32)
@@ -436,8 +460,8 @@ def _bwd_sym_kernel(z_row_ref, z_col_ref, gid_ref, scale_ref, lse_r_ref,
         z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t * scale_ref[0, 0],
         cols_actual, diag_pos
     )
-    p_row = jnp.exp(s_masked - lse_r_ref[:])          # exp(s - lse[row])
-    p_col = jnp.exp(s_masked - lse_c_ref[:])          # exp(s - lse[col]), (1, BC)
+    p_row = _exp0(s_masked - lse_r_ref[:])          # exp(s - lse[row])
+    p_col = _exp0(s_masked - lse_c_ref[:])          # exp(s - lse[col]), (1, BC)
     pos = (cid == _pos_gid(row_gid, n_half, diag_pos)).astype(jnp.float32)
     valid_row = (row_gid < cols_actual).astype(jnp.float32)
     valid_col = (cid < cols_actual).astype(jnp.float32)
@@ -473,8 +497,8 @@ def _bwd_sym_cols_kernel(z_row_ref, z_col_ref, gid_ref, scale_ref,
         z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t * scale_ref[0, 0],
         cols_actual, diag_pos
     )
-    p_row = jnp.exp(s_masked - lse_r_ref[:])
-    p_col = jnp.exp(s_masked - lse_c_ref[:])
+    p_row = _exp0(s_masked - lse_r_ref[:])
+    p_col = _exp0(s_masked - lse_c_ref[:])
     pos = (cid == _pos_gid(row_gid, n_half, diag_pos)).astype(jnp.float32)
     valid_row = (row_gid < cols_actual).astype(jnp.float32)
     valid_col = (cid < cols_actual).astype(jnp.float32)
@@ -497,7 +521,7 @@ def _bwd_sym_cols_call(z_rows, z_cols, row_gid, lse_rows, lse_cols, *,
     kernel = functools.partial(
         _bwd_sym_cols_kernel, br=br, bc=bc, inv_t=inv_t,
         cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
-    )
+        )
     return pl.pallas_call(
         kernel,
         grid=(cp // bc, rp // br),
@@ -539,7 +563,7 @@ def _bwd_rows_kernel(z_row_ref, z_col_ref, gid_ref, cgid_ref, scale_ref,
         z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t * scale_ref[0, 0],
         cols_actual, diag_pos
     )
-    p = jnp.exp(s_masked - lse_r_ref[:])
+    p = _exp0(s_masked - lse_r_ref[:])
     pos = (cid == _pos_gid(row_gid, n_half, diag_pos)).astype(jnp.float32)
     valid_row = (row_gid < cols_actual).astype(jnp.float32)
     g = (p - pos) * valid_row
@@ -571,7 +595,7 @@ def _bwd_cols_kernel(z_row_ref, z_col_ref, gid_ref, cgid_ref, scale_ref,
         z_row_ref[:], z_col_ref[:], row_gid, cid, inv_t * scale_ref[0, 0],
         cols_actual, diag_pos
     )
-    p = jnp.exp(s_masked - lse_r_ref[:])
+    p = _exp0(s_masked - lse_r_ref[:])
     pos = (cid == _pos_gid(row_gid, n_half, diag_pos)).astype(jnp.float32)
     valid_row = (row_gid < cols_actual).astype(jnp.float32)
     g = (p - pos) * valid_row                         # (BR, BC)
@@ -589,7 +613,7 @@ def _bwd_sym_call(z, row_gid, lse, *, br, bc, inv_t, cols_actual, n_half,
     kernel = functools.partial(
         _bwd_sym_kernel, br=br, bc=bc, inv_t=inv_t,
         cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
-    )
+        )
     zc = z if z_cols is None else z_cols
     cp = zc.shape[0]
     grid = (rp // br, cp // bc)
@@ -627,7 +651,7 @@ def _bwd_general_call(z_rows, z_cols, row_gid, lse, *, br, bc, inv_t,
     row_kernel = functools.partial(
         _bwd_rows_kernel, br=br, bc=bc, inv_t=inv_t,
         cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
-    )
+        )
     grad_rows = pl.pallas_call(
         row_kernel,
         grid=(rp // br, cp // bc),
@@ -648,7 +672,7 @@ def _bwd_general_call(z_rows, z_cols, row_gid, lse, *, br, bc, inv_t,
     col_kernel = functools.partial(
         _bwd_cols_kernel, br=br, bc=bc, inv_t=inv_t,
         cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
-    )
+        )
     grad_cols = pl.pallas_call(
         col_kernel,
         grid=(cp // bc, rp // br),

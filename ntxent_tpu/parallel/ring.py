@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.infonce_pallas import resolve_scale
+from ..ops.ntxent_pallas import _exp0, _log_l
 from .mesh import local_row_gids
 
 __all__ = ["ntxent_loss_ring", "make_ring_ntxent",
@@ -58,7 +59,7 @@ def _ring_body(z1_local, z2_local, temperature, axis, num_devices):
         mask = my_gid[:, None] == block_gid[None, :]
         s = jnp.where(mask, _NEG_INF, s)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(s - m_new[:, None]), axis=1)
+        l = l * jnp.exp(m - m_new) + jnp.sum(_exp0(s - m_new[:, None]), axis=1)
         return m_new, l
 
     def step(carry, _):
@@ -85,7 +86,7 @@ def _ring_body(z1_local, z2_local, temperature, axis, num_devices):
         step, init, None, length=num_devices - 1
     )
     m, l = fold(block, block_gid, m, l)
-    lse = m + jnp.log(l)
+    lse = m + _log_l(l)
     loss_sum = jnp.sum(lse - pos)
     return jax.lax.psum(loss_sum, axis) / two_n
 
@@ -135,7 +136,7 @@ def _make_ring_lse_sum(temperature: float, axis: str, num_devices: int,
                           two_n, interpret=interpret)
         m_new = jnp.maximum(m, lse_k)
         l = l * jnp.exp(m - m_new) + jnp.exp(lse_k - m_new)
-        return m_new + jnp.log(l)
+        return m_new + _log_l(l)
 
     def _fwd(z_local, my_gid):
         lse = _lse(z_local, my_gid)
@@ -265,7 +266,7 @@ def _infonce_ring_body(za_local, zb_local, scale, axis, num_devices):
         # stay in their original dtype (half the ICI bytes for bf16 inputs).
         s = jnp.dot(rows, blk.T, preferred_element_type=jnp.float32) * scale
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(s - m_new[:, None]), axis=1)
+        l = l * jnp.exp(m - m_new) + jnp.sum(_exp0(s - m_new[:, None]), axis=1)
         return m_new, l
 
     def step(carry, _):
@@ -288,8 +289,8 @@ def _infonce_ring_body(za_local, zb_local, scale, axis, num_devices):
     )
     m_a, l_a = fold(za_local, zb_blk, m_a, l_a)
     m_b, l_b = fold(zb_local, za_blk, m_b, l_b)
-    lse_a = m_a + jnp.log(l_a)
-    lse_b = m_b + jnp.log(l_b)
+    lse_a = m_a + _log_l(l_a)
+    lse_b = m_b + _log_l(l_b)
     loss_sum = jnp.sum(lse_a - pos) + jnp.sum(lse_b - pos)
     return jax.lax.psum(loss_sum, axis) / (2 * n)
 
@@ -323,13 +324,13 @@ def _infonce_ring_dual_body(za_local, zb_local, scale, axis, num_devices):
         # Row direction: local za rows vs the visiting columns.
         m_new = jnp.maximum(m_a, jnp.max(s, axis=1))
         l_a = l_a * jnp.exp(m_a - m_new) + jnp.sum(
-            jnp.exp(s - m_new[:, None]), axis=1)
+            _exp0(s - m_new[:, None]), axis=1)
         # Column direction: the SAME tile transposed is the visiting zb
         # rows vs this device's za columns.
         st = s.T
         m_bn = jnp.maximum(m_blk, jnp.max(st, axis=1))
         l_blk = l_blk * jnp.exp(m_blk - m_bn) + jnp.sum(
-            jnp.exp(st - m_bn[:, None]), axis=1)
+            _exp0(st - m_bn[:, None]), axis=1)
         return m_new, l_a, m_bn, l_blk
 
     def step(carry, _):
@@ -350,8 +351,8 @@ def _infonce_ring_dual_body(za_local, zb_local, scale, axis, num_devices):
     m_a, l_a, m_blk, l_blk = fold_both(zb_blk, m_a, l_a, m_blk, l_blk)
     # The block is one hop short of home — send its finished stats there.
     m_blk, l_blk = (jax.lax.ppermute(t, axis, perm) for t in (m_blk, l_blk))
-    lse_a = m_a + jnp.log(l_a)
-    lse_b = m_blk + jnp.log(l_blk)
+    lse_a = m_a + _log_l(l_a)
+    lse_b = m_blk + _log_l(l_blk)
     loss_sum = jnp.sum(lse_a - pos) + jnp.sum(lse_b - pos)
     return jax.lax.psum(loss_sum, axis) / (2 * n)
 

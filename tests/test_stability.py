@@ -42,3 +42,35 @@ def test_extreme_logit_range(rng):
     z = make_embeddings(rng, 64, 32)
     loss = ntxent_loss_fused(z, 1e-4)  # logits up to ~1e4
     assert bool(jnp.isfinite(loss))
+
+
+@pytest.mark.parametrize("scale", SCALES)
+@pytest.mark.parametrize("t", TEMPS)
+def test_stability_grid_triangular(rng, scale, t):
+    """Same envelope for the upper-triangle kernels: the transposed
+    online-softmax folds and the shared-accumulator backward must stay
+    finite over the whole reference grid."""
+    z = make_embeddings(rng, 128, 256) * scale
+    loss, grad = jax.value_and_grad(
+        lambda zz: ntxent_loss_fused(zz, t, triangular=True))(z)
+    assert bool(jnp.isfinite(loss)), f"loss at scale={scale}, T={t}"
+    assert bool(jnp.all(jnp.isfinite(grad))), f"grad at scale={scale}, T={t}"
+
+
+@pytest.mark.parametrize("scale", SCALES)
+@pytest.mark.parametrize("t", TEMPS)
+def test_stability_grid_infonce_dual(rng, scale, t):
+    """Dual-direction InfoNCE kernels over the same envelope, gradients
+    for both modalities and the logit scale included."""
+    from ntxent_tpu.ops.infonce_pallas import info_nce_fused
+
+    k1, k2 = jax.random.split(rng)
+    za = make_embeddings(k1, 128, 256) * scale
+    zb = make_embeddings(k2, 128, 256) * scale
+    s0 = jnp.asarray(1.0 / t)
+    loss, grads = jax.value_and_grad(
+        lambda a, b, s: info_nce_fused(a, b, scale=s),
+        argnums=(0, 1, 2))(za, zb, s0)
+    assert bool(jnp.isfinite(loss)), f"loss at scale={scale}, T={t}"
+    for g in grads:
+        assert bool(jnp.all(jnp.isfinite(g))), f"grad at scale={scale}, T={t}"
