@@ -496,7 +496,16 @@ def build_eval_parser() -> argparse.ArgumentParser:
                    help="match the training run's value (it shapes the "
                         "checkpoint's optimizer-state pytree)")
     p.add_argument("--protocol", default="both",
-                   choices=["probe", "knn", "both"])
+                   choices=["probe", "knn", "both", "finetune"],
+                   help="frozen-feature probe / kNN, or end-to-end "
+                        "fine-tuning of the whole encoder (SimCLR-objective "
+                        "checkpoints only)")
+    p.add_argument("--finetune-steps", type=int, default=500)
+    p.add_argument("--finetune-lr", type=float, default=1e-3)
+    p.add_argument("--finetune-batch", type=int, default=64,
+                   help="fine-tune training minibatch (full backprop "
+                        "through the encoder — much heavier than the "
+                        "--batch feature-extraction inference batch)")
     p.add_argument("--batch", type=int, default=256,
                    help="feature-extraction batch")
     p.add_argument("--probe-steps", type=int, default=500)
@@ -652,6 +661,31 @@ def eval_main(argv=None) -> int:
                                method="features")
 
     xtr, ytr, xte, yte = _labeled_arrays(args)
+    num_classes = int(max(int(ytr.max()), int(yte.max()))) + 1
+
+    if args.protocol == "finetune":
+        if args.objective == "clip":
+            logger.error("--protocol finetune needs a SimCLR-objective "
+                         "checkpoint (an encoder with a features method)")
+            return 2
+        from ntxent_tpu.training import finetune
+
+        import json
+
+        res = finetune(model, variables, jnp.asarray(xtr), jnp.asarray(ytr),
+                       jnp.asarray(xte), jnp.asarray(yte),
+                       num_classes=num_classes,
+                       steps=args.finetune_steps,
+                       batch_size=args.finetune_batch,
+                       learning_rate=args.finetune_lr,
+                       key=jax.random.PRNGKey(args.seed))
+        results = {"step": int(state.step),
+                   "finetune_top1": float(res["test_accuracy"]),
+                   "finetune_train_top1": float(res["train_accuracy"])}
+        logger.info("finetune top-1: %.4f", results["finetune_top1"])
+        print(json.dumps(results))
+        return 0
+
     # One extraction pass over the concatenation: extract_features jits its
     # argument internally, so two calls would compile the encoder twice.
     import numpy as np
@@ -660,7 +694,6 @@ def eval_main(argv=None) -> int:
         apply_features, jnp.asarray(np.concatenate([xtr, xte])), args.batch)
     ftr, fte = feats[:len(xtr)], feats[len(xtr):]
     ytr, yte = jnp.asarray(ytr), jnp.asarray(yte)
-    num_classes = int(jnp.maximum(ytr.max(), yte.max())) + 1
     logger.info("features: train %s test %s, %d classes",
                 ftr.shape, fte.shape, num_classes)
 

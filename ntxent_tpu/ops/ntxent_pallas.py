@@ -192,7 +192,7 @@ def _fwd_call(z_rows, z_cols, row_gid, *, br, bc, inv_t, cols_actual, n_half,
     kernel = functools.partial(
         _fwd_kernel, br=br, bc=bc, inv_t=inv_t,
         cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
-        )
+    )
     loss_sum, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -521,7 +521,7 @@ def _bwd_sym_cols_call(z_rows, z_cols, row_gid, lse_rows, lse_cols, *,
     kernel = functools.partial(
         _bwd_sym_cols_kernel, br=br, bc=bc, inv_t=inv_t,
         cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
-        )
+    )
     return pl.pallas_call(
         kernel,
         grid=(cp // bc, rp // br),
@@ -613,7 +613,7 @@ def _bwd_sym_call(z, row_gid, lse, *, br, bc, inv_t, cols_actual, n_half,
     kernel = functools.partial(
         _bwd_sym_kernel, br=br, bc=bc, inv_t=inv_t,
         cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
-        )
+    )
     zc = z if z_cols is None else z_cols
     cp = zc.shape[0]
     grid = (rp // br, cp // bc)
@@ -651,7 +651,7 @@ def _bwd_general_call(z_rows, z_cols, row_gid, lse, *, br, bc, inv_t,
     row_kernel = functools.partial(
         _bwd_rows_kernel, br=br, bc=bc, inv_t=inv_t,
         cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
-        )
+    )
     grad_rows = pl.pallas_call(
         row_kernel,
         grid=(rp // br, cp // bc),
@@ -672,7 +672,7 @@ def _bwd_general_call(z_rows, z_cols, row_gid, lse, *, br, bc, inv_t,
     col_kernel = functools.partial(
         _bwd_cols_kernel, br=br, bc=bc, inv_t=inv_t,
         cols_actual=cols_actual, n_half=n_half, diag_pos=diag_pos,
-        )
+    )
     grad_cols = pl.pallas_call(
         col_kernel,
         grid=(cp // bc, rp // br),

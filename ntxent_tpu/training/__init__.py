@@ -1,6 +1,7 @@
 from ntxent_tpu.training.augment import augment_batch_pair, augment_pair
 from ntxent_tpu.training.evaluation import (
     extract_features,
+    finetune,
     knn_accuracy,
     linear_probe,
 )
@@ -47,6 +48,7 @@ __all__ = [
     "augment_pair",
     "CheckpointManager",
     "extract_features",
+    "finetune",
     "knn_accuracy",
     "linear_probe",
     "ArrayDataset",

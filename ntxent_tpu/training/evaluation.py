@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-__all__ = ["extract_features", "linear_probe", "knn_accuracy"]
+__all__ = ["extract_features", "linear_probe", "knn_accuracy", "finetune"]
 
 
 def extract_features(
@@ -101,6 +101,104 @@ def linear_probe(
     return {
         "train_accuracy": acc(xtr, train_labels),
         "test_accuracy": acc(xte, test_labels),
+        "final_loss": float(losses[-1]),
+    }
+
+
+def finetune(
+    model,
+    variables: dict,
+    train_images: jax.Array,
+    train_labels: jax.Array,
+    test_images: jax.Array,
+    test_labels: jax.Array,
+    num_classes: int,
+    steps: int = 200,
+    batch_size: int = 64,
+    learning_rate: float = 1e-3,
+    key: jax.Array | None = None,
+) -> dict:
+    """End-to-end fine-tuning evaluation (the SimCLR paper's third
+    protocol alongside the linear probe and kNN): attach a fresh linear
+    head to the PRETRAINED encoder and train every weight on the labeled
+    set, then report top-1.
+
+    The whole run is one jitted ``lax.scan`` of adamw minibatch steps
+    (indices pre-sampled host-side and passed as the scan xs); BatchNorm
+    statistics update through the scan carry and are used frozen at eval.
+    """
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    k_head, k_idx = jax.random.split(key)
+
+    def feats(params, batch_stats, x, train):
+        variables_ = {"params": params, "batch_stats": batch_stats}
+        if train:
+            f, updates = model.apply(variables_, x, train=True,
+                                     method="features",
+                                     mutable=["batch_stats"])
+            return f, updates["batch_stats"]
+        return model.apply(variables_, x, train=False,
+                           method="features"), batch_stats
+
+    feat_dim = feats(variables["params"], variables["batch_stats"],
+                     train_images[:1], False)[0].shape[-1]
+    head = (jax.random.normal(k_head, (feat_dim, num_classes)) * 0.01,
+            jnp.zeros((num_classes,)))
+    params0 = {"encoder": variables["params"], "head": head}
+    tx = optax.adamw(learning_rate, weight_decay=1e-4)
+
+    n = train_images.shape[0]
+    idx = jax.random.randint(k_idx, (steps, min(batch_size, n)), 0, n)
+
+    @jax.jit
+    def run(params, batch_stats, xtr, ytr, idx):
+        opt_state = tx.init(params)
+
+        def loss_fn(params, batch_stats, x, y):
+            f, new_stats = feats(params["encoder"], batch_stats, x, True)
+            logits = f @ params["head"][0] + params["head"][1]
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            return loss, new_stats
+
+        def step(carry, batch_idx):
+            params, batch_stats, opt_state = carry
+            x, y = xtr[batch_idx], ytr[batch_idx]
+            (loss, batch_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch_stats, x, y)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, batch_stats, opt_state), loss
+
+        (params, batch_stats, _), losses = jax.lax.scan(
+            step, (params, batch_stats, opt_state), idx)
+        return params, batch_stats, losses
+
+    params, batch_stats, losses = run(
+        params0, variables["batch_stats"], train_images, train_labels, idx)
+
+    @jax.jit
+    def predict(x):
+        f, _ = feats(params["encoder"], batch_stats, x, False)
+        return jnp.argmax(f @ params["head"][0] + params["head"][1], -1)
+
+    def acc(x, y):
+        # Batched like extract_features: one full-split forward would put
+        # the entire image set (and its activations) on device at once.
+        hits = total = 0
+        for start in range(0, x.shape[0], batch_size):
+            xb, yb = x[start:start + batch_size], y[start:start + batch_size]
+            pad = batch_size - xb.shape[0]
+            if pad:  # keep one compiled shape for the tail
+                xb = jnp.pad(xb, ((0, pad),) + ((0, 0),) * (xb.ndim - 1))
+            hits += int(jnp.sum(predict(xb)[:yb.shape[0]] == yb))
+            total += yb.shape[0]
+        return hits / max(total, 1)
+
+    return {
+        "train_accuracy": acc(train_images, train_labels),
+        "test_accuracy": acc(test_images, test_labels),
         "final_loss": float(losses[-1]),
     }
 

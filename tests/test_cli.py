@@ -87,6 +87,8 @@ def test_cli_synthetic_run_checkpoints_and_resumes(tmp_path):
 def test_cli_train_then_eval(tmp_path):
     """ntxent-eval restores the ntxent-train checkpoint and reports both
     SSL protocols on the synthetic labeled task."""
+    import json
+
     common = ["--dataset", "synthetic", "--model", "tiny",
               "--image-size", "8", "--proj-hidden-dim", "16",
               "--proj-dim", "8", "--platform", "cpu"]
@@ -96,6 +98,19 @@ def test_cli_train_then_eval(tmp_path):
                      "--steps", "2"],
         eval_extra=["--probe-steps", "50", "--k", "5",
                     "--max-train", "256", "--max-test", "128"])
+
+    # Third protocol on the same checkpoint: end-to-end fine-tuning.
+    code = ("import sys; from ntxent_tpu.cli import eval_main;"
+            "sys.exit(eval_main(sys.argv[1:]))")
+    ev = subprocess.run(
+        [sys.executable, "-c", code, "--ckpt-dir", str(tmp_path / "ckpt"),
+         "--protocol", "finetune", "--finetune-steps", "20",
+         "--batch", "16", "--max-train", "64", "--max-test", "32"] + common,
+        capture_output=True, text=True, timeout=600,
+        env=_cpu_subprocess_env())
+    assert ev.returncode == 0, ev.stdout + ev.stderr
+    result = json.loads(ev.stdout.strip().splitlines()[-1])
+    assert 0.0 <= result["finetune_top1"] <= 1.0
 
 
 class TestPairedArrayLoader:
