@@ -213,30 +213,38 @@ def run_distributed(quick: bool, results: dict):
 
     print(f"\n=== distributed InfoNCE (CLIP): all-gather vs ring on "
           f"{n_dev} device(s) ===")
-    print(f"{'N/dev':>8} {'global N':>9} {'gather ms':>10} {'ring ms':>9} "
-          f"{'tmp MiB g/r':>12}")
+    print(f"{'N/dev':>8} {'global N':>9} {'gather ms':>10} "
+          f"{'ring-dual ms':>12} {'ring-2blk ms':>12} {'tmp MiB g/d/2':>14}")
     scale = jnp.float32(1.0 / 0.07)
     for n in per_dev:
         zas, zbs = sharded_pair(1, n)
         g_nce = jax.jit(make_sharded_infonce(mesh))
-        r_nce = jax.jit(make_ring_infonce(mesh))
+        r_dual = jax.jit(make_ring_infonce(mesh, impl="dual"))
+        r_two = jax.jit(make_ring_infonce(mesh, impl="twoblock"))
         mgn = temp_mib(g_nce, zas, zbs, scale)
-        mrn = temp_mib(r_nce, zas, zbs, scale)
+        mrd = temp_mib(r_dual, zas, zbs, scale)
+        mr2 = temp_mib(r_two, zas, zbs, scale)
         # Fused partials run interpret-mode off-accelerator: time them only
-        # where they compile (same policy as the fused ring above).
+        # where they compile (same policy as the fused ring above). The
+        # ring bodies are plain jnp folds — timeable everywhere, and the
+        # dual/twoblock pair measures the one-walk-both-directions win
+        # directly (compute-bound on CPU).
         if on_accel:
             rgn = time_fn(g_nce, zas, zbs, scale, warmup=2, runs=runs)
             gather_ms = f"{rgn.mean_ms:>10.3f}"
             gather_rec = rgn.as_dict()
         else:
             gather_ms, gather_rec = f"{'n/a':>10}", None
-        rrn = time_fn(r_nce, zas, zbs, scale, warmup=2, runs=runs)
-        print(f"{n:>8} {n * n_dev:>9} {gather_ms} {rrn.mean_ms:>9.3f} "
-              f"{f'{mgn}/{mrn}':>12}")
+        rrd = time_fn(r_dual, zas, zbs, scale, warmup=2, runs=runs)
+        rr2 = time_fn(r_two, zas, zbs, scale, warmup=2, runs=runs)
+        print(f"{n:>8} {n * n_dev:>9} {gather_ms} {rrd.mean_ms:>12.3f} "
+              f"{rr2.mean_ms:>12.3f} {f'{mgn}/{mrd}/{mr2}':>14}")
         results.setdefault("distributed_infonce", []).append({
             "per_device_n": n, "devices": n_dev,
-            "allgather_fused": gather_rec, "ring": rrn.as_dict(),
-            "temp_mib": {"gather_fused": mgn, "ring": mrn}})
+            "allgather_fused": gather_rec, "ring_dual": rrd.as_dict(),
+            "ring_twoblock": rr2.as_dict(),
+            "temp_mib": {"gather_fused": mgn, "ring_dual": mrd,
+                         "ring_twoblock": mr2}})
 
 
 def _trainer_setup(model_name: str, quick: bool, on_accel: bool,

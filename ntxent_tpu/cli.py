@@ -580,6 +580,12 @@ def eval_main(argv=None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    if args.protocol == "finetune" and args.objective == "clip":
+        # Both flags are known now — fail before any checkpoint restore
+        # or dataset scan is paid for.
+        logger.error("--protocol finetune needs a SimCLR-objective "
+                     "checkpoint (an encoder with a features method)")
+        return 2
 
     import jax
 
@@ -664,10 +670,6 @@ def eval_main(argv=None) -> int:
     num_classes = int(max(int(ytr.max()), int(yte.max()))) + 1
 
     if args.protocol == "finetune":
-        if args.objective == "clip":
-            logger.error("--protocol finetune needs a SimCLR-objective "
-                         "checkpoint (an encoder with a features method)")
-            return 2
         from ntxent_tpu.training import finetune
 
         import json
