@@ -49,6 +49,8 @@ __all__ = [
     "ntxent_loss_and_lse",
     "block_lse",
     "block_grads",
+    "block_lse_dual",
+    "block_grads_dual",
 ]
 
 _NEG_INF = -1e30
@@ -1024,3 +1026,256 @@ def _pad_gid_row(col_gid: jax.Array, multiple: int, sentinel: int):
     (>= total_cols, so padded columns are masked in-kernel). Same padding
     core as the row side — only the shape differs."""
     return _gid_column(col_gid, multiple, sentinel)[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# Dual-direction block primitives for the pair-parallel (symmetric) loss
+# ---------------------------------------------------------------------------
+
+
+def _dual_stats_kernel(zr_ref, zc_ref, rgid_ref, cgid_ref, lse_r_ref,
+                       lse_c_ref, m_r, l_r, m_c, l_c,
+                       *, br, bc, inv_t, total):
+    """NT-Xent dual stats over ONE shard-pair tile of the symmetric global
+    matrix: each s tile is produced once and folded into the ROW side's
+    online softmax directly and the COLUMN side's transposed (the global
+    matrix is symmetric, so the tile's transpose is the mirror tile the
+    pair-parallel schedule never computes). Both sides carry explicit
+    global ids (sentinel >= total on padding); self-similarity
+    (cid == rid) and padding are masked per direction.
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(jnp.logical_and(i == 0, j == 0))
+    def _():
+        m_r[:] = jnp.full(m_r.shape, _NEG_INF, jnp.float32)
+        l_r[:] = jnp.zeros(l_r.shape, jnp.float32)
+        m_c[:] = jnp.full(m_c.shape, _NEG_INF, jnp.float32)
+        l_c[:] = jnp.zeros(l_c.shape, jnp.float32)
+
+    rid = rgid_ref[:]                       # (BR, 1) global row ids
+    cid = cgid_ref[:]                       # (1, BC) global col ids
+    s = jax.lax.dot_general(
+        zr_ref[:], zc_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * inv_t
+    self_hit = cid == rid
+    s_row = jnp.where(jnp.logical_or(cid >= total, self_hit), _NEG_INF, s)
+    s_col = jnp.where(jnp.logical_or(rid >= total, self_hit), _NEG_INF, s)
+
+    rs = pl.ds(i * br, br)
+    m_old = m_r[rs]
+    m_new = jnp.maximum(m_old, jnp.max(s_row, axis=1, keepdims=True))
+    l_r[rs] = l_r[rs] * jnp.exp(m_old - m_new) + jnp.sum(
+        _exp0(s_row - m_new), axis=1, keepdims=True)
+    m_r[rs] = m_new
+
+    cs = pl.ds(j * bc, bc)
+    st = s_col.T
+    m_old_c = m_c[cs]
+    m_new_c = jnp.maximum(m_old_c, jnp.max(st, axis=1, keepdims=True))
+    l_c[cs] = l_c[cs] * jnp.exp(m_old_c - m_new_c) + jnp.sum(
+        _exp0(st - m_new_c), axis=1, keepdims=True)
+    m_c[cs] = m_new_c
+
+    @pl.when(j == nj - 1)
+    def _():
+        lse_r_ref[:] = m_r[rs] + _log_l(l_r[rs])
+
+    # The (j, 0) window is revisited every grid row; its final visit (last
+    # grid row) publishes complete column-side stats.
+    lse_c_ref[:] = m_c[cs] + _log_l(l_c[cs])
+
+
+def block_lse_dual(
+    z_rows: jax.Array,
+    z_cols: jax.Array,
+    row_gid: jax.Array,
+    col_gid: jax.Array,
+    temperature: float,
+    total: int,
+    *,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(lse_rows, lse_cols) of ONE shard-pair tile from a single walk.
+
+    lse_rows[a] = logsumexp over this tile's columns for row a;
+    lse_cols[b] = logsumexp over this tile's ROWS for column b (the
+    symmetric mirror tile's row direction). Fold results across a
+    device's assigned tiles with logaddexp; weight a tile by adding
+    log(w) to both outputs. Not AD-wired — the pair-parallel loss's
+    custom VJP calls block_grads_dual explicitly.
+    """
+    rows, d = z_rows.shape
+    cols = z_cols.shape[0]
+    br, bc = choose_blocks(rows, cols, d, z_rows.dtype,
+                           block_rows, block_cols)
+    if interpret is None:
+        interpret = _default_interpret()
+    zr = _pad_rows(z_rows, br)
+    zc = _pad_rows(z_cols, bc)
+    gid_r = _gid_column(row_gid, br, sentinel=total)
+    gid_c = _pad_gid_row(col_gid, bc, total).reshape(1, -1)
+    rp, cp = zr.shape[0], zc.shape[0]
+    kernel = functools.partial(
+        _dual_stats_kernel, br=br, bc=bc,
+        inv_t=1.0 / float(temperature), total=total,
+    )
+    lse_r, lse_c = pl.pallas_call(
+        kernel,
+        grid=(rp // br, cp // bc),
+        in_specs=[
+            pl.BlockSpec((br, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bc), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, 1), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((cp, 1), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((rp, 1), jnp.float32)] * 2
+        + [pltpu.VMEM((cp, 1), jnp.float32)] * 2,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * rp * cp * d,
+            bytes_accessed=(rp + cp) * d * 4,
+            transcendentals=2 * rp * cp,
+        ),
+        interpret=interpret,
+    )(zr, zc, gid_r, gid_c)
+    return lse_r[:rows, 0], lse_c[:cols, 0]
+
+
+def _dual_grads_kernel(zr_ref, zc_ref, rgid_ref, cgid_ref, lse_r_ref,
+                       lse_c_ref, gr_ref, gc_ref, acc_c,
+                       *, br, bc, inv_t, total):
+    """Shared-G gradients of the pair-parallel lse sum over one tile:
+    ``G = exp(s - lse_row) + exp(s - lse_col)`` (self/padding masked, no
+    positive term — positives are handled locally by the caller), with
+    ``gr += G @ z_cols`` per row block and ``gc += G^T @ z_rows``
+    accumulated in full-length scratch (shard-sized, so it always fits).
+    One s recompute + two dots per tile — the mirror tile is never walked.
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    ni = pl.num_programs(0)
+
+    @pl.when(jnp.logical_and(i == 0, j == 0))
+    def _():
+        acc_c[:] = jnp.zeros(acc_c.shape, acc_c.dtype)
+
+    @pl.when(j == 0)
+    def _():
+        gr_ref[:] = jnp.zeros(gr_ref.shape, gr_ref.dtype)
+
+    rid = rgid_ref[:]
+    cid = cgid_ref[:]
+    s = jax.lax.dot_general(
+        zr_ref[:], zc_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * inv_t
+    self_hit = cid == rid
+    s_row = jnp.where(jnp.logical_or(cid >= total, self_hit), _NEG_INF, s)
+    s_col = jnp.where(jnp.logical_or(rid >= total, self_hit), _NEG_INF, s)
+    valid_row = (rid < total).astype(jnp.float32)
+    valid_col = (cid < total).astype(jnp.float32)
+    g = _exp0(s_row - lse_r_ref[:]) * valid_row \
+        + _exp0(s_col - lse_c_ref[:]) * valid_col
+
+    gr_ref[:] += jax.lax.dot_general(
+        g, zc_ref[:].astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    cs = pl.ds(j * bc, bc)
+    acc_c[cs] += jax.lax.dot_general(
+        g, zr_ref[:].astype(jnp.float32),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == ni - 1)
+    def _():
+        gc_ref[:] = acc_c[cs]
+
+
+def block_grads_dual(
+    z_rows: jax.Array,
+    z_cols: jax.Array,
+    row_gid: jax.Array,
+    col_gid: jax.Array,
+    lse_rows: jax.Array,
+    lse_cols: jax.Array,
+    temperature: float,
+    total: int,
+    *,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Both sides' gradient contributions of one pair tile, times T.
+
+    With ``S = sum_rows (lse - pos)`` over the GLOBAL matrix and the
+    tile's rows/cols carrying global lse values, returns
+    ``(dS/dz_rows, dS/dz_cols) * temperature`` restricted to this tile's
+    softmax terms (no positive term); the caller multiplies by
+    ``cotangent / temperature`` once and adds the local positive
+    gradient. Self/padding masking matches block_lse_dual.
+    """
+    rows, d = z_rows.shape
+    cols = z_cols.shape[0]
+    br, bc = choose_blocks(rows, cols, d, z_rows.dtype,
+                           block_rows, block_cols)
+    if interpret is None:
+        interpret = _default_interpret()
+    zr = _pad_rows(z_rows, br)
+    zc = _pad_rows(z_cols, bc)
+    gid_r = _gid_column(row_gid, br, sentinel=total)
+    gid_c = _pad_gid_row(col_gid, bc, total).reshape(1, -1)
+    lse_rp = _pad_rows(lse_rows.reshape(rows, 1), br)
+    lse_cp = _pad_rows(lse_cols.reshape(cols, 1), bc).reshape(1, -1)
+    rp, cp = zr.shape[0], zc.shape[0]
+    kernel = functools.partial(
+        _dual_grads_kernel, br=br, bc=bc,
+        inv_t=1.0 / float(temperature), total=total,
+    )
+    gr, gc = pl.pallas_call(
+        kernel,
+        grid=(rp // br, cp // bc),
+        in_specs=[
+            pl.BlockSpec((br, d), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, d), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bc), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((br, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bc), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, d), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bc, d), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, d), jnp.float32),
+            jax.ShapeDtypeStruct((cp, d), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((cp, d), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=6 * rp * cp * d,
+            bytes_accessed=(2 * rp + 2 * cp) * d * 4,
+            transcendentals=2 * rp * cp,
+        ),
+        interpret=interpret,
+    )(zr, zc, gid_r, gid_c, lse_rp, lse_cp)
+    return gr[:rows], gc[:cols]

@@ -14,6 +14,10 @@ from ntxent_tpu.parallel.mesh import (
     replicate_state,
     replicated_sharding,
 )
+from ntxent_tpu.parallel.pair import (
+    make_pair_ntxent,
+    ntxent_loss_pair,
+)
 from ntxent_tpu.parallel.ring import (
     info_nce_loss_ring,
     make_ring_infonce,
@@ -35,6 +39,8 @@ __all__ = [
     "init_distributed",
     "local_row_gids",
     "process_info",
+    "make_pair_ntxent",
+    "ntxent_loss_pair",
     "replicate_state",
     "replicated_sharding",
     "make_sharded_ntxent",

@@ -63,13 +63,25 @@ def make_sharded_ntxent(
     temperature: float = 0.07,
     axis: str = "data",
     interpret: bool | None = None,
+    impl: str = "strip",
 ):
     """Build a jit-able global-batch NT-Xent over ``mesh``.
 
     Returns ``loss_fn(z1, z2) -> scalar`` where z1, z2 are the two augmented
     views, (N, D) each, sharded (or shardable) along ``axis``. The scalar is
     replicated; gradients through it are correct per-shard gradients.
+
+    ``impl="strip"`` (default): every device walks its local-rows x
+    global-cols strip. ``impl="pair"``: balanced symmetric shard-pair
+    schedule — each global tile walked once across the mesh, ~2.2x fewer
+    loss matmuls at P=8 (see parallel/pair.py for the trade-offs).
     """
+    if impl == "pair":
+        from .pair import make_pair_ntxent
+
+        return make_pair_ntxent(mesh, temperature, axis, interpret)
+    if impl != "strip":
+        raise ValueError(f"unknown NT-Xent impl {impl!r}")
     num_devices = mesh.shape[axis]
 
     body = functools.partial(

@@ -1,0 +1,204 @@
+"""Pair-parallel NT-Xent: balanced symmetric tile assignment across devices.
+
+The global (2N, 2N) similarity matrix is symmetric, so the classic
+data-parallel decomposition — every device computes its full local-rows x
+global-cols strip (`dist_loss.local_ntxent_allgather`) — computes every
+off-diagonal shard-pair tile TWICE across the mesh (device d produces
+S[rows_d, cols_e]; device e produces the same tile transposed). Here each
+unordered shard pair {d, e} is walked once, on a balanced round-robin
+schedule: device d takes column shards (d + k) mod P for k = 0..⌈(P-1)/2⌉,
+and for even P the k = P/2 pair (claimed by both endpoints) is weighted ½
+on each. Per tile, the dual block kernels
+(`ops.ntxent_pallas.block_lse_dual` / `block_grads_dual`) fold the single
+MXU walk into BOTH sides' statistics/gradients.
+
+Matmul-unit accounting per shard-pair tile position (P = 8):
+
+| | strip (gather path) | pair-parallel |
+|---|---|---|
+| forward | 1.0 x P | 1.0 x (P/2 + 1/2) |
+| backward | 4.0 x P (rows+cols kernels) | 3.0 x (P/2 + 1/2) |
+| fwd+bwd total | 5 P = 40 | 2.25 P = 18 |
+
+i.e. ~2.2x fewer loss matmuls at P = 8. Cross-device assembly: the column
+statistics merge with an (2N,)-vector logsumexp psum (forward) and the
+gradient contributions with one (2N, D) psum (backward — the same volume
+as the strip path's AD-derived reduce-scatter). Positives stay local
+(each row's paired view lives on the same shard) and differentiate by AD.
+
+This is an opt-in alternative to the strip path (`impl="pair"` on
+`make_sharded_ntxent`); the strip remains the default until the crossover
+is profiled on real hardware (the pair schedule trades matmuls for two
+extra small collectives and loses when the loss is dispatch-bound).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.ntxent_pallas import block_grads_dual, block_lse_dual
+from .mesh import local_row_gids
+
+__all__ = ["make_pair_ntxent", "ntxent_loss_pair"]
+
+_NEG_INF = -1e30
+
+
+def _shard_gids(e, n_local: int, num_devices: int):
+    """Canonical stacked-view global ids of shard ``e``'s rows: view-1 rows
+    [e·n, (e+1)·n) and view-2 rows [N + e·n, N + (e+1)·n)."""
+    n_total = n_local * num_devices
+    base = e * n_local + jnp.arange(n_local, dtype=jnp.int32)
+    return jnp.concatenate([base, base + n_total])
+
+
+def _tile_schedule(num_devices: int):
+    """(k, weight) pairs for this mesh size: offsets each device walks.
+
+    k = 0 is the self tile (its transpose is itself — folded once);
+    1..⌈(P-1)/2⌉ are full-weight pairs; for even P the antipodal k = P/2
+    pair is claimed by both endpoints at weight ½ each.
+    """
+    ks = [(0, 1.0)]
+    half = (num_devices - 1) // 2
+    ks += [(k, 1.0) for k in range(1, half + 1)]
+    if num_devices % 2 == 0 and num_devices > 1:
+        ks.append((num_devices // 2, 0.5))
+    return ks
+
+
+def _make_pair_lse_sum(temperature: float, axis: str, num_devices: int,
+                      interpret: bool | None):
+    """custom-VJP scalar ``S = Σ_local rows lse_i`` over the global matrix,
+    computed with the balanced pair schedule (see module docstring)."""
+
+    @jax.custom_vjp
+    def pair_lse_sum(z_local, my_gid):
+        return _fwd(z_local, my_gid)[0]
+
+    def _tiles(z_g, d, two_n_local):
+        for k, w in _tile_schedule(num_devices):
+            e = jax.lax.rem(d + k, num_devices)
+            ze = jax.lax.dynamic_slice_in_dim(z_g, e * two_n_local,
+                                              two_n_local)
+            gid_e = _shard_gids(e, two_n_local // 2, num_devices)
+            yield k, w, ze, gid_e
+
+    def _lse_all(z_local, my_gid):
+        two_n_local = z_local.shape[0]
+        two_n = two_n_local * num_devices
+        d = jax.lax.axis_index(axis)
+        z_g = jax.lax.all_gather(z_local, axis, tiled=True)
+        lse_part = jnp.full((two_n,), _NEG_INF, jnp.float32)
+        for k, w, ze, gid_e in _tiles(z_g, d, two_n_local):
+            lr, lc = block_lse_dual(z_local, ze, my_gid, gid_e,
+                                    temperature, two_n,
+                                    interpret=interpret)
+            if w != 1.0:  # weight in lse space: l·w ⇔ lse + log w
+                logw = jnp.float32(math.log(w))
+                lr, lc = lr + logw, lc + logw
+            lse_part = lse_part.at[my_gid].set(
+                jnp.logaddexp(lse_part[my_gid], lr))
+            if k != 0:
+                # k = 0's transpose is the same tile — folding lc too
+                # would double-count the self pair.
+                lse_part = lse_part.at[gid_e].set(
+                    jnp.logaddexp(lse_part[gid_e], lc))
+        m = jax.lax.pmax(lse_part, axis)
+        lse_all = m + jnp.log(
+            jax.lax.psum(jnp.exp(lse_part - m), axis))
+        return z_g, lse_all
+
+    def _fwd(z_local, my_gid):
+        z_g, lse_all = _lse_all(z_local, my_gid)
+        return jnp.sum(jnp.take(lse_all, my_gid)), (
+            z_local, my_gid, z_g, lse_all)
+
+    def _bwd(res, ct):
+        z_local, my_gid, z_g, lse_all = res
+        two_n_local, dim = z_local.shape
+        two_n = two_n_local * num_devices
+        d = jax.lax.axis_index(axis)
+        buf = jnp.zeros((two_n, dim), jnp.float32)
+        for k, w, ze, gid_e in _tiles(z_g, d, two_n_local):
+            gr, gc = block_grads_dual(
+                z_local, ze, my_gid, gid_e,
+                jnp.take(lse_all, my_gid), jnp.take(lse_all, gid_e),
+                temperature, two_n, interpret=interpret)
+            if k == 0:
+                # The self tile's G already contains both directions
+                # (lse_r == lse_c there); gc would double it.
+                buf = buf.at[my_gid].add(gr)
+            else:
+                buf = buf.at[my_gid].add(w * gr)
+                buf = buf.at[gid_e].add(w * gc)
+        grad_full = jax.lax.psum(buf, axis)
+        grad = jnp.take(grad_full, my_gid, axis=0) * (ct / temperature)
+        return grad.astype(z_local.dtype), None
+
+    pair_lse_sum.defvjp(_fwd, _bwd)
+    return pair_lse_sum
+
+
+def _pair_body(z1_local, z2_local, temperature, axis, num_devices,
+               interpret):
+    n_local = z1_local.shape[0]
+    two_n = 2 * n_local * num_devices
+    inv_t = 1.0 / temperature
+
+    z_local = jnp.concatenate([z1_local, z2_local], axis=0)
+    my_gid = local_row_gids(axis, n_local, num_devices)
+    # Positives are device-local pairs; their gradient comes from AD of
+    # this expression (the -E term of d loss/d s).
+    pos = jnp.sum(z1_local * z2_local, axis=-1, dtype=jnp.float32) * inv_t
+    pos = jnp.concatenate([pos, pos])
+
+    lse_sum = _make_pair_lse_sum(temperature, axis, num_devices,
+                                 interpret)(z_local, my_gid)
+    loss_sum = lse_sum - jnp.sum(pos)
+    return jax.lax.psum(loss_sum, axis) / two_n
+
+
+def make_pair_ntxent(
+    mesh: Mesh,
+    temperature: float = 0.07,
+    axis: str = "data",
+    interpret: bool | None = None,
+):
+    """Build a jit-able pair-parallel global-batch NT-Xent over ``mesh``.
+
+    Same contract as ``dist_loss.make_sharded_ntxent`` — (z1, z2) sharded
+    along ``axis`` → replicated scalar mean loss with exact gradients —
+    at roughly half the loss matmuls (see module docstring).
+    """
+    body = functools.partial(
+        _pair_body,
+        temperature=float(temperature),
+        axis=axis,
+        num_devices=mesh.shape[axis],
+        interpret=interpret,
+    )
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+
+def ntxent_loss_pair(
+    z1: jax.Array,
+    z2: jax.Array,
+    mesh: Mesh,
+    temperature: float = 0.07,
+    axis: str = "data",
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Global-batch canonical NT-Xent, pair-parallel (one-shot form)."""
+    return make_pair_ntxent(mesh, temperature, axis, interpret)(z1, z2)
