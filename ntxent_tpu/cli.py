@@ -87,6 +87,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--weight-decay", type=float, default=1e-6)
     t.add_argument("--warmup-steps", type=int, default=100)
     t.add_argument("--accum-steps", type=int, default=1)
+    t.add_argument("--dp-loss", default="strip", choices=["strip", "pair"],
+                   help="data-parallel NT-Xent decomposition: 'strip' "
+                        "(local rows x global cols per device) or 'pair' "
+                        "(balanced shard-pair schedule — each global "
+                        "similarity tile computed once across the mesh)")
     t.add_argument("--remat", action="store_true",
                    help="rematerialize the encoder forward in the backward "
                         "pass (fits bigger batches in HBM at ~1 extra "
@@ -212,6 +217,10 @@ def main(argv=None) -> int:
     if args.objective == "clip":
         # image_size stays None here: the clip branch derives it from the
         # paired data, and a conflicting EXPLICIT flag must fail loudly.
+        if args.dp_loss != "strip":
+            logger.warning("--dp-loss %s ignored: the CLIP objective uses "
+                           "the InfoNCE loss family (see --clip-parallel)",
+                           args.dp_loss)
         return _train_clip(args, info, per_process_batch)
     if args.image_size is None:
         args.image_size = 224 if args.dataset == "imagefolder" else 32
@@ -243,7 +252,8 @@ def main(argv=None) -> int:
 
         mesh = create_mesh(axis_names=("data",))
         step = make_sharded_train_step(mesh, cfg.temperature,
-                                       remat=args.remat)
+                                       remat=args.remat,
+                                       loss_impl=args.dp_loss)
         # Commit params/opt-state replicated on the mesh BEFORE fit's
         # checkpoint restore: a fresh template restores committed to one
         # device and the sharded step then rejects the device mismatch.
@@ -256,6 +266,9 @@ def main(argv=None) -> int:
         logger.info("data-parallel over %d devices (%d process(es))",
                     n_dev, info["process_count"])
     else:
+        if args.dp_loss != "strip":
+            logger.warning("--dp-loss %s ignored: single-device run has "
+                           "no shard-pair schedule", args.dp_loss)
         step = make_train_step(cfg.temperature, remat=args.remat)
         data = _make_pipeline(args, per_process_batch)
         logger.info("single-device run")

@@ -34,7 +34,8 @@ from ..ops.ntxent_pallas import ntxent_partial_fused
 from .mesh import local_row_gids
 
 __all__ = ["ntxent_loss_distributed", "make_sharded_ntxent",
-           "local_ntxent_allgather", "info_nce_loss_distributed",
+           "local_ntxent_allgather", "resolve_local_ntxent",
+           "info_nce_loss_distributed",
            "make_sharded_infonce", "local_infonce_allgather",
            "local_infonce_dual", "resolve_local_infonce"]
 
@@ -58,6 +59,20 @@ def local_ntxent_allgather(z1_local, z2_local, temperature, axis, num_devices,
     return jax.lax.psum(loss_sum, axis) / z_global.shape[0]
 
 
+def resolve_local_ntxent(impl: str):
+    """The per-device NT-Xent body for an impl name — the ONE dispatch
+    point shared by make_sharded_ntxent and the sharded train-step
+    factory. Bodies share the signature
+    ``(z1_local, z2_local, temperature, axis, num_devices, interpret)``."""
+    if impl == "pair":
+        from .pair import pair_body
+
+        return pair_body
+    if impl == "strip":
+        return local_ntxent_allgather
+    raise ValueError(f"unknown NT-Xent impl {impl!r}")
+
+
 def make_sharded_ntxent(
     mesh: Mesh,
     temperature: float = 0.07,
@@ -76,16 +91,10 @@ def make_sharded_ntxent(
     schedule — each global tile walked once across the mesh, ~2.2x fewer
     loss matmuls at P=8 (see parallel/pair.py for the trade-offs).
     """
-    if impl == "pair":
-        from .pair import make_pair_ntxent
-
-        return make_pair_ntxent(mesh, temperature, axis, interpret)
-    if impl != "strip":
-        raise ValueError(f"unknown NT-Xent impl {impl!r}")
     num_devices = mesh.shape[axis]
 
     body = functools.partial(
-        local_ntxent_allgather,
+        resolve_local_ntxent(impl),
         temperature=float(temperature),
         axis=axis,
         num_devices=num_devices,

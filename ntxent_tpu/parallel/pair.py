@@ -44,7 +44,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops.ntxent_pallas import block_grads_dual, block_lse_dual
 from .mesh import local_row_gids
 
-__all__ = ["make_pair_ntxent", "ntxent_loss_pair"]
+__all__ = ["make_pair_ntxent", "ntxent_loss_pair", "pair_body"]
 
 _NEG_INF = -1e30
 
@@ -162,6 +162,11 @@ def _pair_body(z1_local, z2_local, temperature, axis, num_devices,
                                  interpret)(z_local, my_gid)
     loss_sum = lse_sum - jnp.sum(pos)
     return jax.lax.psum(loss_sum, axis) / two_n
+
+
+# Public alias: the per-device body shared with the train-step factory
+# (same signature as dist_loss.local_ntxent_allgather).
+pair_body = _pair_body
 
 
 def make_pair_ntxent(

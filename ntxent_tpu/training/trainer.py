@@ -29,6 +29,7 @@ from ..ops.ntxent_pallas import ntxent_loss_fused
 from ..parallel.dist_loss import (
     local_ntxent_allgather,
     resolve_local_infonce,
+    resolve_local_ntxent,
 )
 from .lars import cosine_warmup_schedule, create_lars, simclr_learning_rate
 
@@ -205,6 +206,7 @@ def make_sharded_train_step(
     axis: str = "data",
     interpret: bool | None = None,
     remat: bool = False,
+    loss_impl: str = "strip",
 ) -> Callable:
     """Distributed train step over the mesh's data axis.
 
@@ -213,16 +215,22 @@ def make_sharded_train_step(
     model's ``axis_name``), ``lax.all_gather`` of embeddings into the fused
     partial loss, ``psum`` of gradients — i.e. the complete NCCL-SimCLR
     collective pattern compiled onto ICI by XLA.
+
+    ``loss_impl="pair"`` swaps the loss for the balanced shard-pair
+    schedule (parallel/pair.py: each global similarity tile walked once
+    across the mesh — ~2.2x fewer loss matmuls at P=8).
     """
     num_devices = mesh.shape[axis]
+    loss_body = resolve_local_ntxent(loss_impl)
+
+    def local_loss(z1, z2):
+        return loss_body(z1, z2, temperature, axis, num_devices, interpret)
 
     def per_device_step(state: TrainState, v1, v2):
         def loss_fn(params):
             z1, z2, new_stats = _apply_two_views(state, params, v1, v2,
                                                  remat=remat)
-            loss = local_ntxent_allgather(
-                z1, z2, temperature, axis, num_devices, interpret)
-            return loss, new_stats
+            return local_loss(z1, z2), new_stats
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)

@@ -311,3 +311,46 @@ class TestPairParallel:
         np.testing.assert_allclose(float(a), float(b), rtol=1e-5)
         with pytest.raises(ValueError, match="unknown"):
             make_sharded_ntxent(mesh, impl="nope")
+
+
+@pytest.mark.slow
+def test_sharded_train_step_pair_equals_strip(rng):
+    """make_sharded_train_step(loss_impl='pair') produces the same loss
+    and updated params as the strip decomposition on one step."""
+    import functools
+
+    from ntxent_tpu.models import ResNet, SimCLRModel
+    from ntxent_tpu.training import (
+        TrainerConfig,
+        create_train_state,
+        make_sharded_train_step,
+    )
+
+    model = SimCLRModel(
+        encoder=functools.partial(ResNet, stage_sizes=(1,),
+                                  small_images=True, dtype=jnp.float32,
+                                  axis_name="data"),
+        proj_hidden_dim=16, proj_dim=8, axis_name="data")
+    cfg = TrainerConfig(batch_size=16, total_steps=4, warmup_steps=1)
+    mesh = create_mesh(axis_names=("data",))
+    k1, k2 = jax.random.split(rng)
+    v1 = jax.random.uniform(k1, (16, 16, 16, 3))
+    v2 = jax.random.uniform(k2, (16, 16, 16, 3))
+
+    def run(impl):
+        state = create_train_state(model, jax.random.PRNGKey(0),
+                                   (1, 16, 16, 3), cfg)
+        step = make_sharded_train_step(mesh, 0.1, loss_impl=impl)
+        state, m = step(state, *shard_batch((v1, v2), mesh))
+        return state, float(m["loss"])
+
+    s_strip, l_strip = run("strip")
+    s_pair, l_pair = run("pair")
+    assert l_pair == pytest.approx(l_strip, rel=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        s_pair.params, s_strip.params)
+
+    with pytest.raises(ValueError, match="unknown NT-Xent impl"):
+        make_sharded_train_step(mesh, loss_impl="nope")
