@@ -354,3 +354,23 @@ def test_sharded_train_step_pair_equals_strip(rng):
 
     with pytest.raises(ValueError, match="unknown NT-Xent impl"):
         make_sharded_train_step(mesh, loss_impl="nope")
+
+
+def test_pair_schedule_covers_every_pair_with_unit_weight():
+    """For any mesh size, every unordered shard pair must be walked with
+    total weight exactly 1 across the mesh (the half-weighted antipodal
+    tile at even P summing from both endpoints)."""
+    from collections import defaultdict
+
+    from ntxent_tpu.parallel.pair import _tile_schedule
+
+    for p in (1, 2, 3, 4, 5, 7, 8, 12, 16):
+        weight = defaultdict(float)
+        for d in range(p):
+            for k, w in _tile_schedule(p):
+                e = (d + k) % p
+                weight[frozenset((d, e))] += w
+        for a in range(p):
+            for b in range(a, p):
+                assert weight[frozenset((a, b))] == pytest.approx(1.0), (
+                    p, a, b, weight[frozenset((a, b))])
