@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Poll the TPU tunnel on a short cadence; the moment a probe answers, run
+# the full capture list (on_chip_capture.sh). The tunnel is intermittently
+# alive in windows (BASELINE.md "Timing-semantics history"), and a wedged
+# backend hangs ANY jax init forever — so each probe is a disposable
+# subprocess under `timeout`, never this shell.
+#
+# Usage: chip_watch.sh [max_hours]   (default 11)
+set -u
+REPO=/root/repo
+OUT="$REPO/benchmark_results/tpu"
+WLOG="$OUT/watch.log"
+PROBE_TIMEOUT=120
+PERIOD=240          # seconds between probe starts
+MAX_HOURS="${1:-11}"
+export PYTHONPATH="$REPO:/root/.axon_site"
+mkdir -p "$OUT"
+
+deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
+echo "[$(date -u +%H:%M:%S)] chip watch up (period ${PERIOD}s, max ${MAX_HOURS}h)" >>"$WLOG"
+
+while [ "$(date +%s)" -lt "$deadline" ]; do
+    backend=$(timeout "$PROBE_TIMEOUT" python -c \
+        "import jax; print(jax.default_backend())" 2>/dev/null | tail -1)
+    if [ "$backend" = "tpu" ] || [ "$backend" = "axon" ]; then
+        echo "[$(date -u +%H:%M:%S)] CHIP ALIVE (backend=$backend) — capturing" >>"$WLOG"
+        bash "$REPO/scripts/on_chip_capture.sh"
+        echo "[$(date -u +%H:%M:%S)] capture list finished; watch exiting" >>"$WLOG"
+        exit 0
+    fi
+    echo "[$(date -u +%H:%M:%S)] probe: backend=${backend:-none/timeout}" >>"$WLOG"
+    sleep "$PERIOD"
+done
+echo "[$(date -u +%H:%M:%S)] watch window expired without a live chip" >>"$WLOG"
+exit 1

@@ -1,0 +1,97 @@
+#!/usr/bin/env bash
+# Run the full pending on-chip capture list (BASELINE.md "Pending on-chip
+# captures") in priority order, committing each artifact the moment it
+# lands. Designed to run unattended from chip_watch.sh the instant the TPU
+# tunnel answers: the tunnel dies without warning (see BASELINE.md
+# "Timing-semantics history"), so every step has its own hard timeout and
+# every successful artifact is committed immediately — a mid-list wedge
+# loses only the remaining steps, never captured data.
+#
+# Priority order mirrors VERDICT r2 "Next round" #1/#2/#5:
+#   1. bench.py headline (fp32 + bf16 + triangular companions)
+#   2. RN50 MFU ladder (batch 64,128,256)
+#   3. ViT-B/16 and CLIP-B/16 train steps
+#   4. RN50 remat variant at the largest batch
+#   5. TPU-gated pytest tier
+#   6. XProf trace of the RN50 step
+set -u
+REPO=/root/repo
+OUT="$REPO/benchmark_results/tpu"
+LOG="$OUT/capture.log"
+export PYTHONPATH="$REPO:/root/.axon_site"
+mkdir -p "$OUT"
+cd "$REPO"
+
+say() { echo "[$(date -u +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+commit_art() {  # commit_art <message> <paths...>
+    local msg="$1"; shift
+    git add "$@" >>"$LOG" 2>&1
+    if ! git diff --cached --quiet; then
+        git commit -q -m "$msg" >>"$LOG" 2>&1 && say "committed: $msg"
+    fi
+}
+
+run_step() {  # run_step <timeout_s> <name> <stdout_file|-> <cmd...>
+    local t="$1" name="$2" dest="$3"; shift 3
+    say "START $name (timeout ${t}s): $*"
+    local rc
+    if [ "$dest" = "-" ]; then
+        timeout "$t" "$@" >>"$LOG" 2>&1; rc=$?
+    else
+        timeout "$t" "$@" >"$dest" 2>>"$LOG"; rc=$?
+    fi
+    say "DONE  $name rc=$rc"
+    return $rc
+}
+
+say "=== on-chip capture session starting ==="
+
+# 1. Headline bench: bench.py prints exactly one JSON line on stdout.
+run_step 900 headline "$OUT/bench_headline.json" python bench.py || true
+# Snapshot the autotune cache the run refreshed (v2 protocol winner).
+cp -f "$REPO"/.ntxent_autotune*.json "$OUT/" 2>/dev/null || true
+commit_art "on-chip capture: bench.py headline (fp32/bf16/triangular)" \
+    "$OUT/" || true
+
+# 2. RN50 MFU ladder.
+run_step 2400 mfu_ladder - python benchmarks/run_benchmarks.py \
+    --trainer-only --model resnet50 --batch 64,128,256 \
+    --out "$OUT/mfu_rn50_ladder.json" || true
+commit_art "on-chip capture: RN50 MFU ladder batch 64/128/256" "$OUT/" || true
+
+# 3. ViT and CLIP flagship steps.
+run_step 1500 vit - python benchmarks/run_benchmarks.py \
+    --trainer-only --model vit_b16 --batch 64,128 \
+    --out "$OUT/mfu_vit_b16.json" || true
+commit_art "on-chip capture: ViT-B/16 train step" "$OUT/" || true
+
+run_step 1500 clip - python benchmarks/run_benchmarks.py \
+    --trainer-only --model clip_b16 --batch 64,128 \
+    --out "$OUT/mfu_clip_b16.json" || true
+commit_art "on-chip capture: CLIP-B/16 train step (dual InfoNCE kernels)" \
+    "$OUT/" || true
+
+# 4. Remat variant at the largest batch (HBM-bound hypothesis check).
+#    --remat only exists once benchmarks grow the flag; harmless rc!=0 if not.
+run_step 1500 remat - python benchmarks/run_benchmarks.py \
+    --trainer-only --model resnet50 --batch 256 --remat \
+    --out "$OUT/mfu_rn50_remat.json" || true
+commit_art "on-chip capture: RN50 batch-256 remat variant" "$OUT/" || true
+
+# 5. TPU-gated test tier (tpu marks skip off-chip; assert on-device here).
+run_step 1200 tpu_tests "$OUT/pytest_tpu_tier.txt" \
+    python -m pytest tests/ -m tpu -q --no-header || true
+commit_art "on-chip capture: TPU-gated pytest tier" "$OUT/" || true
+
+# 6. XProf trace last (largest artifact, least load-bearing).
+run_step 1200 xprof - python benchmarks/run_benchmarks.py \
+    --trainer-only --model resnet50 --batch 128 \
+    --trace "$OUT/xprof" --out "$OUT/mfu_rn50_traced.json" || true
+# Traces are big: commit the summary JSON + a size-capped listing only.
+ls -laR "$OUT/xprof" > "$OUT/xprof_manifest.txt" 2>/dev/null || true
+commit_art "on-chip capture: XProf-traced RN50 step" \
+    "$OUT/mfu_rn50_traced.json" "$OUT/xprof_manifest.txt" \
+    "$OUT/capture.log" || true
+
+say "=== capture session complete ==="
