@@ -107,10 +107,12 @@ def _child() -> None:
     # see utils/profiling.time_fn_chained).
     from ntxent_tpu.utils.profiling import time_fn_chained
 
+    import math
+
     n_chain = 100 if on_accel else 5
     steady_ms, final = time_fn_chained(loss_fn, z, length=n_chain, spans=3)
-    if not (final == final):  # NaN guard on the thing we just timed
-        raise RuntimeError(f"chained loss went NaN: {final}")
+    if not math.isfinite(final):  # NaN/inf guard on the thing we just timed
+        raise RuntimeError(f"chained loss went non-finite: {final}")
 
     payload = {
         "backend": backend,
@@ -130,8 +132,6 @@ def _child() -> None:
         # CUDA op itself, D11): same shape, bf16 inputs, fp32 softmax
         # accumulation inside the kernel. Headline stays fp32 for
         # protocol comparability.
-        import math
-
         try:
             bf16_ms, bf16_final = time_fn_chained(
                 loss_fn, z.astype(jnp.bfloat16), length=n_chain, spans=3)

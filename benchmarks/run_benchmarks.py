@@ -248,7 +248,7 @@ def run_distributed(quick: bool, results: dict):
 
 
 def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
-                   batch: int | None):
+                   batch: int | None, remat: bool = False):
     """(name, batch, size, state, step, step_args) for one flagship
     workload.
 
@@ -302,7 +302,8 @@ def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
         state = TrainState.create(apply_fn=model.apply,
                                   params=variables["params"],
                                   tx=optax.adamw(1e-4))
-        return name, b, size, state, make_clip_train_step(), (images, tokens)
+        return (name, b, size, state, make_clip_train_step(remat=remat),
+                (images, tokens))
 
     if model_name == "vit_b16":
         if small:
@@ -327,13 +328,15 @@ def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
                                (1, size, size, 3), cfg)
     v1 = jax.random.uniform(k1, (b, size, size, 3))
     v2 = jax.random.uniform(k2, (b, size, size, 3))
-    return name, b, size, state, make_train_step(cfg.temperature), (v1, v2)
+    return (name, b, size, state,
+            make_train_step(cfg.temperature, remat=remat), (v1, v2))
 
 
 def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
                       model_name: str = "resnet50",
                       batch: int | None = None,
-                      tag_batch: bool = False):
+                      tag_batch: bool = False,
+                      remat: bool = False):
     """End-to-end train-step benchmark with automatic MFU.
 
     The role the reference's benchmark played for its hot path
@@ -350,7 +353,7 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
 
     on_accel = jax.default_backend() in ("tpu", "axon")
     name, batch, size, state, step, step_args = _trainer_setup(
-        model_name, quick, on_accel, batch)
+        model_name, quick, on_accel, batch, remat=remat)
 
     import time as _time
     runs = 5 if quick or not on_accel else 30
@@ -418,7 +421,7 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
     assert final_loss == final_loss, "loss went NaN during trainer bench"
     sps = 1e3 / chained_ms
     entry = {
-        "model": name, "batch": batch, "image": size,
+        "model": name, "batch": batch, "image": size, "remat": remat,
         "protocol": "scan_chain" if chain_exec is not None else "per_call",
         "chained_ms": chained_ms, "steps_per_sec": sps,
         "flops_per_step": flops,
@@ -477,6 +480,10 @@ def main():
                         help="trainer-bench batch override; a comma list "
                              "(e.g. 64,128,256) sweeps batch sizes and "
                              "records one entry per size")
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialize the encoder forward in the "
+                             "backward pass (jax.checkpoint) — the "
+                             "HBM-vs-FLOPs lever for the MFU ladder")
     parser.add_argument("--trace", default=None, metavar="DIR",
                         help="capture an XProf trace of the trainer step "
                              "into DIR (implies --trainer)")
@@ -522,7 +529,8 @@ def main():
             for b in batches:
                 run_trainer_bench(args.quick, results, args.trace,
                                   model_name=m, batch=b,
-                                  tag_batch=len(batches) > 1)
+                                  tag_batch=len(batches) > 1,
+                                  remat=args.remat)
 
     out_dir = Path(args.out)
     out_dir.mkdir(exist_ok=True)

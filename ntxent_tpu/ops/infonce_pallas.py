@@ -74,7 +74,8 @@ def resolve_scale(temperature: float, scale) -> jax.Array:
 
 def _dual_fwd_kernel(za_ref, zb_ref, scale_ref, loss_ref, lse_a_ref,
                      lse_b_ref, m_a, l_a, p_a, m_b, l_b, p_b,
-                     *, br, bc, rows_actual, cols_actual):
+                     *, br, bc, rows_actual, cols_actual,
+                     stats_only=False):
     """Cross-modal forward: each s tile is produced ONCE on the MXU and
     folded into BOTH direction's online-softmax stats — the row direction
     (za rows over zb columns) directly, the column direction (zb rows over
@@ -82,6 +83,13 @@ def _dual_fwd_kernel(za_ref, zb_ref, scale_ref, loss_ref, lse_a_ref,
     running _fwd_kernel twice. Full-length stats live in VMEM scratch; a
     row block's stats complete when its grid row ends, a column block's
     when the grid's LAST row visits it.
+
+    ``stats_only=True`` (static) strips the positive-logit accumulation
+    and the SMEM loss folds: the distributed dual-partial path
+    (_infonce_dual_local_fwd) wants ONLY the two lse vectors — its
+    positives live on the global diagonal, so the local-iota positives
+    this kernel would fold are meaningless there, and the two (br, bc)
+    masked reductions per tile are pure wasted VPU work on that hot path.
     """
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -93,10 +101,11 @@ def _dual_fwd_kernel(za_ref, zb_ref, scale_ref, loss_ref, lse_a_ref,
         loss_ref[0, 0] = jnp.float32(0.0)
         m_a[:] = jnp.full(m_a.shape, _NEG_INF, jnp.float32)
         l_a[:] = jnp.zeros(l_a.shape, jnp.float32)
-        p_a[:] = jnp.zeros(p_a.shape, jnp.float32)
         m_b[:] = jnp.full(m_b.shape, _NEG_INF, jnp.float32)
         l_b[:] = jnp.zeros(l_b.shape, jnp.float32)
-        p_b[:] = jnp.zeros(p_b.shape, jnp.float32)
+        if not stats_only:
+            p_a[:] = jnp.zeros(p_a.shape, jnp.float32)
+            p_b[:] = jnp.zeros(p_b.shape, jnp.float32)
 
     rid, cid = _tile_ids(i, j, br, bc)
     s = jax.lax.dot_general(
@@ -109,10 +118,11 @@ def _dual_fwd_kernel(za_ref, zb_ref, scale_ref, loss_ref, lse_a_ref,
     # padded za rows are fake columns of s.T).
     s_rowdir = jnp.where(cid >= cols_actual, _NEG_INF, s)
     s_coldir = jnp.where(rid >= rows_actual, _NEG_INF, s)
-    pos_hit = cid == rid
 
     rs = pl.ds(i * br, br)
-    p_a[rs] += jnp.sum(jnp.where(pos_hit, s, 0.0), axis=1, keepdims=True)
+    if not stats_only:
+        pos_hit = cid == rid
+        p_a[rs] += jnp.sum(jnp.where(pos_hit, s, 0.0), axis=1, keepdims=True)
     m_old = m_a[rs]
     m_new = jnp.maximum(m_old, jnp.max(s_rowdir, axis=1, keepdims=True))
     l_a[rs] = l_a[rs] * jnp.exp(m_old - m_new) + jnp.sum(
@@ -121,7 +131,8 @@ def _dual_fwd_kernel(za_ref, zb_ref, scale_ref, loss_ref, lse_a_ref,
 
     cs = pl.ds(j * bc, bc)
     st = s_coldir.T
-    p_b[cs] += jnp.sum(jnp.where(pos_hit, s, 0.0), axis=0).reshape(bc, 1)
+    if not stats_only:
+        p_b[cs] += jnp.sum(jnp.where(pos_hit, s, 0.0), axis=0).reshape(bc, 1)
     m_old_b = m_b[cs]
     m_new_b = jnp.maximum(m_old_b, jnp.max(st, axis=1, keepdims=True))
     l_b[cs] = l_b[cs] * jnp.exp(m_old_b - m_new_b) + jnp.sum(
@@ -132,30 +143,33 @@ def _dual_fwd_kernel(za_ref, zb_ref, scale_ref, loss_ref, lse_a_ref,
     def _():
         lse = m_a[rs] + _log_l(l_a[rs])
         lse_a_ref[:] = lse
-        valid = (jax.lax.broadcasted_iota(jnp.int32, (br, 1), 0) + i * br
-                 ) < rows_actual
-        loss_ref[0, 0] += jnp.sum(jnp.where(valid, lse - p_a[rs], 0.0))
+        if not stats_only:
+            valid = (jax.lax.broadcasted_iota(jnp.int32, (br, 1), 0) + i * br
+                     ) < rows_actual
+            loss_ref[0, 0] += jnp.sum(jnp.where(valid, lse - p_a[rs], 0.0))
 
     # The (j, 0) output window is revisited every grid row; only its LAST
     # visit (final grid row) publishes complete column-side stats, and the
     # loss fold runs once there too.
     lse_b_ref[:] = m_b[cs] + _log_l(l_b[cs])
 
-    @pl.when(i == ni - 1)
-    def _():
-        validc = (jax.lax.broadcasted_iota(jnp.int32, (bc, 1), 0) + j * bc
-                  ) < cols_actual
-        loss_ref[0, 0] += jnp.sum(
-            jnp.where(validc, lse_b_ref[:] - p_b[cs], 0.0))
+    if not stats_only:
+        @pl.when(i == ni - 1)
+        def _():
+            validc = (jax.lax.broadcasted_iota(jnp.int32, (bc, 1), 0)
+                      + j * bc) < cols_actual
+            loss_ref[0, 0] += jnp.sum(
+                jnp.where(validc, lse_b_ref[:] - p_b[cs], 0.0))
 
 
 def _dual_fwd_call(zap, zbp, scale, *, br, bc, rows_actual, cols_actual,
-                   interpret):
+                   interpret, stats_only=False):
     rp, d = zap.shape
     cp = zbp.shape[0]
     kernel = functools.partial(
         _dual_fwd_kernel, br=br, bc=bc,
         rows_actual=rows_actual, cols_actual=cols_actual,
+        stats_only=stats_only,
     )
     loss_sum, lse_a, lse_b = pl.pallas_call(
         kernel,
@@ -432,12 +446,14 @@ def _infonce_dual_local_fwd(za_local, zb_g, row_gid, scale, axis, br, bc,
     n = zb_g.shape[0]
     zap = _pad_rows(za_local, br)
     zbp = _pad_rows(zb_g, bc)
-    # Stats-only use of the dual forward kernel: positions are local, so
-    # its in-kernel positive/loss accumulation is ignored — positives are
-    # the global diagonal, recovered below from a rowwise dot.
+    # Stats-only dual forward: the kernel's in-kernel positives are
+    # local-iota (meaningless here — positives are the global diagonal,
+    # recovered below from a rowwise dot), so the flag strips their
+    # accumulation and the loss folds from this hot path entirely.
     _, lse_a_p, lse_b_p = _dual_fwd_call(
         zap, zbp, scale, br=br, bc=bc,
-        rows_actual=n_local, cols_actual=n, interpret=interpret)
+        rows_actual=n_local, cols_actual=n, interpret=interpret,
+        stats_only=True)
     lse_a = lse_a_p[:n_local, 0]
     lse_b_part = lse_b_p[:n, 0]
     # Global column logsumexp: logsumexp-merge of the per-device partial

@@ -75,7 +75,19 @@ def _tile_schedule(num_devices: int):
 def _make_pair_lse_sum(temperature: float, axis: str, num_devices: int,
                       interpret: bool | None):
     """custom-VJP scalar ``S = Σ_local rows lse_i`` over the global matrix,
-    computed with the balanced pair schedule (see module docstring)."""
+    computed with the balanced pair schedule (see module docstring).
+
+    INVARIANT (uniform cotangent): the backward scales the psum'd GLOBAL
+    gradient buffer by this device's own cotangent ``ct`` — valid only
+    when ``ct`` is identical on every shard. That holds for the sole
+    caller (``_pair_body``: the loss is psum'd then divided by a global
+    constant, so AD hands every device the same scalar), and it is what
+    makes the pair schedule work — tiles for rows owned by OTHER devices
+    are computed here and psum'd home, and a per-device ``ct`` would have
+    to travel with each tile's rows (an extra all_gather of P scalars) to
+    stay correct. If you reuse this VJP under a non-uniform cotangent,
+    psum/gather the per-row owners' cotangents and scale ``buf`` rows
+    before the psum instead."""
 
     @jax.custom_vjp
     def pair_lse_sum(z_local, my_gid):

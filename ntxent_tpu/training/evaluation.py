@@ -146,7 +146,21 @@ def finetune(
     head = (jax.random.normal(k_head, (feat_dim, num_classes)) * 0.01,
             jnp.zeros((num_classes,)))
     params0 = {"encoder": variables["params"], "head": head}
-    tx = optax.adamw(learning_rate, weight_decay=1e-4)
+
+    def _decay_mask(params):
+        # Standard SimCLR fine-tune protocol: weight decay applies to the
+        # matmul kernels only — BatchNorm/LayerNorm scale+bias and every
+        # bias vector are exempt (they are named 'scale'/'bias' in flax;
+        # the fresh head is a (W, b) tuple whose index 0 is the matrix).
+        def keep(path, _leaf):
+            last = path[-1]
+            if isinstance(last, jax.tree_util.SequenceKey):
+                return last.idx == 0
+            return getattr(last, "key", "") == "kernel"
+
+        return jax.tree_util.tree_map_with_path(keep, params)
+
+    tx = optax.adamw(learning_rate, weight_decay=1e-4, mask=_decay_mask)
 
     n = train_images.shape[0]
     idx = jax.random.randint(k_idx, (steps, min(batch_size, n)), 0, n)

@@ -24,7 +24,9 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
         "import jax; print(jax.default_backend())" 2>/dev/null | tail -1)
     if [ "$backend" = "tpu" ] || [ "$backend" = "axon" ]; then
         echo "[$(date -u +%H:%M:%S)] CHIP ALIVE (backend=$backend) — capturing" >>"$WLOG"
-        bash "$REPO/scripts/on_chip_capture.sh"
+        # The registered platform name ('tpu' on real hosts, 'axon' through
+        # the tunnel plugin) flows into the capture's pytest tier.
+        NTXENT_CHIP_BACKEND="$backend" bash "$REPO/scripts/on_chip_capture.sh"
         echo "[$(date -u +%H:%M:%S)] capture list finished; watch exiting" >>"$WLOG"
         exit 0
     fi

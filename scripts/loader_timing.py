@@ -1,0 +1,175 @@
+"""Loader-vs-step timing: does the input pipeline keep up with the chip?
+
+SURVEY §7.4 risk #4 / judge r2 "Next round" #8: everything the trainer
+benchmark measures uses a synthetic on-device batch, so nothing proved the
+disk -> host -> device -> augment pipeline can feed the step without
+capping MFU. This harness measures exactly that, end to end, with REAL
+disk reads: it materializes a CIFAR-10-format dataset on disk (synthetic
+pixels, canonical pickle-batch layout — Cifar10Source reads it exactly the
+way it reads the real download), then times the same train step two ways:
+
+  * ``piped``  — each step consumes the next two-view batch from the real
+    ``StreamingLoader -> TwoViewPipeline`` (threaded read-ahead, on-device
+    augmentation), plus the host time spent blocked in ``next()``;
+  * ``staged`` — the identical step re-runs one pre-staged device batch
+    (the trainer-bench condition: zero input cost).
+
+Both loops end with a device-to-host read of the final loss, so the work
+physically ran. The verdict number is ``pipeline_overhead = piped/staged``:
+~1.0 means the loader hides under the step (input pipeline will not cap
+MFU); the gap, when there is one, is bounded by ``host_fetch_ms`` (time
+actually blocked on the host).
+
+Writes one JSON artifact and prints it. Usage:
+    python scripts/loader_timing.py [--steps 200] [--batch 256]
+        [--model resnet50] [--out benchmark_results/<backend>/loader.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def make_cifar10_on_disk(root: Path, n_per_batch: int = 10000,
+                         batches: int = 5, seed: int = 0) -> Path:
+    """Write synthetic data in the canonical cifar-10-batches-py layout."""
+    d = root / "cifar-10-batches-py"
+    d.mkdir(parents=True, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    for i in range(1, batches + 1):
+        payload = {
+            b"data": rng.randint(0, 256, (n_per_batch, 3072), dtype=np.uint8),
+            b"labels": rng.randint(0, 10, n_per_batch).tolist(),
+        }
+        with open(d / f"data_batch_{i}", "wb") as f:
+            pickle.dump(payload, f)
+    return root
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--model", default="resnet50",
+                   choices=["tiny", "resnet18", "resnet50"])
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--platform", default=None)
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import functools
+
+    import jax.numpy as jnp
+
+    from ntxent_tpu import models
+    from ntxent_tpu.models import SimCLRModel
+    from ntxent_tpu.training import (
+        TrainerConfig,
+        create_train_state,
+        make_train_step,
+    )
+    from ntxent_tpu.training.datasets import (
+        Cifar10Source,
+        StreamingLoader,
+        TwoViewPipeline,
+    )
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+    steps = args.steps if on_accel else min(args.steps, 8)
+    batch = args.batch if on_accel else min(args.batch, 32)
+
+    if args.model == "tiny" or not on_accel:
+        encoder = functools.partial(models.ResNet, stage_sizes=(1,),
+                                    small_images=True)
+        model_name = "tiny"
+    else:
+        enc = {"resnet18": models.ResNet18,
+               "resnet50": models.ResNet50}[args.model]
+        encoder = functools.partial(enc, small_images=args.image_size <= 64)
+        model_name = args.model
+
+    model = SimCLRModel(encoder=encoder, proj_hidden_dim=128, proj_dim=64)
+    cfg = TrainerConfig(batch_size=batch, total_steps=steps + 16,
+                        warmup_steps=2)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0),
+        (1, args.image_size, args.image_size, 3), cfg)
+    step = make_train_step(cfg.temperature)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        make_cifar10_on_disk(Path(tmp))
+        source = Cifar10Source(tmp)
+        loader = StreamingLoader(source, batch, seed=0)
+        pipeline = TwoViewPipeline(loader, key=jax.random.PRNGKey(1))
+        it = iter(pipeline)
+
+        # Warmup: compiles the step and the augmentation program, fills the
+        # loader's read-ahead. Both timed loops then run the same
+        # executables.
+        v1, v2 = next(it)
+        state, m = step(state, v1, v2)
+        jax.block_until_ready(m["loss"])
+
+        # --- piped: real disk -> augment -> step, fetch time accounted.
+        host_fetch_s = 0.0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            f0 = time.perf_counter()
+            v1, v2 = next(it)
+            host_fetch_s += time.perf_counter() - f0
+            state, m = step(state, v1, v2)
+        piped_loss = float(m["loss"])  # D2H: the work physically ran
+        piped_s = time.perf_counter() - t0
+
+        # --- staged: same step, one resident batch (trainer-bench regime).
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, v1, v2)
+        staged_loss = float(m["loss"])
+        staged_s = time.perf_counter() - t0
+
+    record = {
+        "metric": "loader_vs_step",
+        "backend": backend,
+        "device_kind": jax.local_devices()[0].device_kind,
+        "model": model_name,
+        "batch": batch,
+        "image": args.image_size,
+        "steps": steps,
+        "piped_ms_per_step": round(piped_s * 1e3 / steps, 4),
+        "staged_ms_per_step": round(staged_s * 1e3 / steps, 4),
+        "host_fetch_ms_per_step": round(host_fetch_s * 1e3 / steps, 4),
+        "pipeline_overhead": round(piped_s / staged_s, 4),
+        "piped_final_loss": piped_loss,
+        "staged_final_loss": staged_loss,
+    }
+    line = json.dumps(record)
+    print(line)
+    out = args.out or str(
+        REPO / "benchmark_results"
+        / ("tpu" if on_accel else "cpu") / "loader_timing.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -57,41 +57,51 @@ commit_art "on-chip capture: bench.py headline (fp32/bf16/triangular)" \
 # 2. RN50 MFU ladder.
 run_step 2400 mfu_ladder - python benchmarks/run_benchmarks.py \
     --trainer-only --model resnet50 --batch 64,128,256 \
-    --out "$OUT/mfu_rn50_ladder.json" || true
+    --out "$OUT/mfu_rn50_ladder" || true
 commit_art "on-chip capture: RN50 MFU ladder batch 64/128/256" "$OUT/" || true
 
 # 3. ViT and CLIP flagship steps.
 run_step 1500 vit - python benchmarks/run_benchmarks.py \
     --trainer-only --model vit_b16 --batch 64,128 \
-    --out "$OUT/mfu_vit_b16.json" || true
+    --out "$OUT/mfu_vit_b16" || true
 commit_art "on-chip capture: ViT-B/16 train step" "$OUT/" || true
 
 run_step 1500 clip - python benchmarks/run_benchmarks.py \
     --trainer-only --model clip_b16 --batch 64,128 \
-    --out "$OUT/mfu_clip_b16.json" || true
+    --out "$OUT/mfu_clip_b16" || true
 commit_art "on-chip capture: CLIP-B/16 train step (dual InfoNCE kernels)" \
     "$OUT/" || true
 
 # 4. Remat variant at the largest batch (HBM-bound hypothesis check).
-#    --remat only exists once benchmarks grow the flag; harmless rc!=0 if not.
 run_step 1500 remat - python benchmarks/run_benchmarks.py \
     --trainer-only --model resnet50 --batch 256 --remat \
-    --out "$OUT/mfu_rn50_remat.json" || true
+    --out "$OUT/mfu_rn50_remat" || true
 commit_art "on-chip capture: RN50 batch-256 remat variant" "$OUT/" || true
 
 # 5. TPU-gated test tier (tpu marks skip off-chip; assert on-device here).
+#    The platform name must be the one that actually registered ('axon'
+#    through the tunnel plugin, 'tpu' on a real host) — conftest.py feeds
+#    it to jax.config, and a name with no registered backend fails init.
 run_step 1200 tpu_tests "$OUT/pytest_tpu_tier.txt" \
+    env NTXENT_TEST_PLATFORM="${NTXENT_CHIP_BACKEND:-tpu}" \
     python -m pytest tests/ -m tpu -q --no-header || true
 commit_art "on-chip capture: TPU-gated pytest tier" "$OUT/" || true
 
-# 6. XProf trace last (largest artifact, least load-bearing).
+# 6. Loader-vs-step timing: real disk reads feeding the step (SURVEY §7.4
+#    risk #4 — proves the input pipeline won't cap MFU).
+run_step 1500 loader - python scripts/loader_timing.py \
+    --steps 200 --batch 256 --model resnet50 || true
+commit_art "on-chip capture: loader-vs-step timing (real disk pipeline)" \
+    "$OUT/" || true
+
+# 7. XProf trace last (largest artifact, least load-bearing).
 run_step 1200 xprof - python benchmarks/run_benchmarks.py \
     --trainer-only --model resnet50 --batch 128 \
-    --trace "$OUT/xprof" --out "$OUT/mfu_rn50_traced.json" || true
+    --trace "$OUT/xprof" --out "$OUT/mfu_rn50_traced" || true
 # Traces are big: commit the summary JSON + a size-capped listing only.
 ls -laR "$OUT/xprof" > "$OUT/xprof_manifest.txt" 2>/dev/null || true
 commit_art "on-chip capture: XProf-traced RN50 step" \
-    "$OUT/mfu_rn50_traced.json" "$OUT/xprof_manifest.txt" \
+    "$OUT/mfu_rn50_traced" "$OUT/xprof_manifest.txt" \
     "$OUT/capture.log" || true
 
 say "=== capture session complete ==="
