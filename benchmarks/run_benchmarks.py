@@ -418,7 +418,10 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
             s, m = step(s, *step_args)
             jax.block_until_ready(m["loss"])
             return s
-    assert final_loss == final_loss, "loss went NaN during trainer bench"
+    import math as _math
+    if not _math.isfinite(final_loss):  # NaN OR inf invalidates the timing
+        raise RuntimeError(
+            f"loss went non-finite ({final_loss}) during trainer bench")
     sps = 1e3 / chained_ms
     entry = {
         "model": name, "batch": batch, "image": size, "remat": remat,

@@ -15,10 +15,18 @@ way it reads the real download), then times the same train step two ways:
     (the trainer-bench condition: zero input cost).
 
 Both loops end with a device-to-host read of the final loss, so the work
-physically ran. The verdict number is ``pipeline_overhead = piped/staged``:
-~1.0 means the loader hides under the step (input pipeline will not cap
-MFU); the gap, when there is one, is bounded by ``host_fetch_ms`` (time
-actually blocked on the host).
+physically ran.
+
+Protocol caveat (tunneled backends): the two loops above are per-call
+Python chains, which this repo's own timing-semantics notes show carry
+relay RPC overhead per step — identical in BOTH loops, so their ratio
+(``pipeline_overhead``) is biased TOWARD 1.0 on the tunnel. The verdict
+therefore also records ``staged_chain_ms_per_step`` — the same step timed
+with the scanned-chain protocol (the only tunnel-immune one; one jitted
+``lax.scan`` dispatch, D2H-terminated) — and the load-bearing criterion is
+``host_fetch_ms_per_step < staged_chain_ms_per_step``: the loader keeps
+the chip fed iff the host blocks for less than one true device step, with
+the threaded read-ahead hiding the rest.
 
 Writes one JSON artifact and prints it. Usage:
     python scripts/loader_timing.py [--steps 200] [--batch 256]
@@ -64,7 +72,6 @@ def main() -> None:
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--model", default="resnet50",
                    choices=["tiny", "resnet18", "resnet50"])
-    p.add_argument("--image-size", type=int, default=32)
     p.add_argument("--platform", default=None)
     p.add_argument("--out", default=None)
     args = p.parse_args()
@@ -96,6 +103,10 @@ def main() -> None:
     steps = args.steps if on_accel else min(args.steps, 8)
     batch = args.batch if on_accel else min(args.batch, 32)
 
+    # CIFAR batches are 32x32 — the ADVERSARIAL case for the loader (the
+    # shortest step per byte of input of any BASELINE config; at 224 the
+    # step is ~50x longer and hiding the loader is easy).
+    image_size = 32
     if args.model == "tiny" or not on_accel:
         encoder = functools.partial(models.ResNet, stage_sizes=(1,),
                                     small_images=True)
@@ -103,7 +114,7 @@ def main() -> None:
     else:
         enc = {"resnet18": models.ResNet18,
                "resnet50": models.ResNet50}[args.model]
-        encoder = functools.partial(enc, small_images=args.image_size <= 64)
+        encoder = functools.partial(enc, small_images=True)
         model_name = args.model
 
     model = SimCLRModel(encoder=encoder, proj_hidden_dim=128, proj_dim=64)
@@ -111,7 +122,7 @@ def main() -> None:
                         warmup_steps=2)
     state = create_train_state(
         model, jax.random.PRNGKey(0),
-        (1, args.image_size, args.image_size, 3), cfg)
+        (1, image_size, image_size, 3), cfg)
     step = make_train_step(cfg.temperature)
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -146,18 +157,41 @@ def main() -> None:
         staged_loss = float(m["loss"])
         staged_s = time.perf_counter() - t0
 
+        # --- staged, scanned-chain: the tunnel-immune true device step
+        # time (see module docstring); the criterion's denominator.
+        staged_chain_ms = None
+        if on_accel:
+            from ntxent_tpu.utils.profiling import compile_chain, time_chain
+
+            def chain_step(s, _v1=v1, _v2=v2):
+                s2, mm = step(s, _v1, _v2)
+                return s2, mm["loss"]
+
+            try:
+                chain_exec = compile_chain(chain_step, state, 50)
+                staged_chain_ms, state, _ = time_chain(
+                    chain_exec, state, length=50, spans=2)
+            except Exception as e:
+                print(f"scan-chain staged timing failed: {e!r}",
+                      file=sys.stderr)
+
     record = {
         "metric": "loader_vs_step",
         "backend": backend,
         "device_kind": jax.local_devices()[0].device_kind,
         "model": model_name,
         "batch": batch,
-        "image": args.image_size,
+        "image": image_size,
         "steps": steps,
         "piped_ms_per_step": round(piped_s * 1e3 / steps, 4),
         "staged_ms_per_step": round(staged_s * 1e3 / steps, 4),
+        "staged_chain_ms_per_step": (
+            round(staged_chain_ms, 4) if staged_chain_ms else None),
         "host_fetch_ms_per_step": round(host_fetch_s * 1e3 / steps, 4),
         "pipeline_overhead": round(piped_s / staged_s, 4),
+        "loader_keeps_up": (
+            host_fetch_s * 1e3 / steps < staged_chain_ms
+            if staged_chain_ms else None),
         "piped_final_loss": piped_loss,
         "staged_final_loss": staged_loss,
     }

@@ -49,8 +49,10 @@ say "=== on-chip capture session starting ==="
 
 # 1. Headline bench: bench.py prints exactly one JSON line on stdout.
 run_step 900 headline "$OUT/bench_headline.json" python bench.py || true
-# Snapshot the autotune cache the run refreshed (v2 protocol winner).
-cp -f "$REPO"/.ntxent_autotune*.json "$OUT/" 2>/dev/null || true
+# Snapshot the autotune cache the run refreshed (v2 protocol winner);
+# ops/autotune.py cache_path() = $NTXENT_TPU_CACHE or ~/.cache/ntxent_tpu.
+cp -f "${NTXENT_TPU_CACHE:-$HOME/.cache/ntxent_tpu}/autotune.json" \
+    "$OUT/autotune_cache.json" 2>/dev/null || true
 commit_art "on-chip capture: bench.py headline (fp32/bf16/triangular)" \
     "$OUT/" || true
 

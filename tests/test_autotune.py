@@ -1,8 +1,19 @@
-"""Autotuner: candidate filtering, cache, CPU fallback."""
+"""Autotuner: candidate filtering, cache, CPU fallback, and the live
+measured sweep's decision logic (winner selection, disk persistence,
+budget truncation) exercised off-chip with stubbed backend + timer —
+the timing ACCURACY of the sweep is asserted on real hardware by
+tests/test_tpu_only.py::test_autotune_live_sweep_caches_winner."""
 import jax
 import jax.numpy as jnp
+import pytest
 
-from ntxent_tpu.ops.autotune import _candidates, autotune_blocks, clear_cache, _CACHE
+from ntxent_tpu.ops import autotune
+from ntxent_tpu.ops.autotune import (
+    _CACHE,
+    _candidates,
+    autotune_blocks,
+    clear_cache,
+)
 from ntxent_tpu.ops.blocks import choose_blocks
 
 
@@ -18,3 +29,69 @@ def test_candidates_respect_vmem_and_shape():
     assert all(br <= 512 and bc <= 512 for br, bc in cands)
     small = list(_candidates(64, 128, 32, 4))
     assert all(br <= 64 and bc <= 128 for br, bc in small)
+
+
+@pytest.fixture()
+def sweep_env(monkeypatch, tmp_path):
+    """Run the measured-sweep code path on CPU: backend probe says 'tpu',
+    the chain timer is a deterministic stub, the disk cache is isolated."""
+    clear_cache()
+    monkeypatch.setenv("NTXENT_TPU_CACHE", str(tmp_path))
+    monkeypatch.setattr(autotune.jax, "default_backend", lambda: "tpu")
+    yield tmp_path
+    clear_cache()
+
+
+def test_sweep_picks_fastest_candidate_and_persists(sweep_env, monkeypatch):
+    calls = []
+
+    def fake_timer(fn, z, length, spans, with_grad):
+        # Identify the candidate from the closure defaults (loss binds
+        # _br/_bc as keyword defaults) and hand (256, 128) the best time.
+        br, bc = fn.__defaults__
+        calls.append((br, bc))
+        return (0.5 if (br, bc) == (256, 128) else 1.0 + br / 1e4), 0.0
+
+    monkeypatch.setattr(autotune, "time_fn_chained", fake_timer)
+    best = autotune_blocks(512, 512, 64, length=5, spans=1, budget_s=None)
+    assert best == (256, 128)
+    assert len(calls) == len(list(_candidates(512, 512, 64, 4)))
+    # Full (untruncated) sweep persists per device kind: a fresh process
+    # (cleared in-memory cache, dropped disk mirror) must hit the FILE,
+    # not re-measure.
+    _CACHE.clear()
+    monkeypatch.setattr(autotune, "_DISK_CACHE", None)
+    calls.clear()
+    again = autotune_blocks(512, 512, 64, length=5, spans=1, budget_s=None)
+    assert again == (256, 128)
+    assert calls == [], "disk-cached winner was re-measured"
+
+
+def test_sweep_budget_truncation_not_persisted(sweep_env, monkeypatch):
+    def slow_timer(fn, z, length, spans, with_grad):
+        import time as _t
+        _t.sleep(0.05)
+        br, bc = fn.__defaults__
+        return 1.0 + br / 1e4, 0.0
+
+    monkeypatch.setattr(autotune, "time_fn_chained", slow_timer)
+    # Budget only allows ~the first candidate: winner is best-of-partial.
+    best = autotune_blocks(512, 512, 64, length=5, spans=1, budget_s=0.01)
+    assert best in list(_candidates(512, 512, 64, 4))
+    # A truncated sweep must NOT pin its partial winner on disk...
+    _CACHE.clear()
+    timed = []
+    monkeypatch.setattr(
+        autotune, "time_fn_chained",
+        lambda fn, z, **kw: (timed.append(fn.__defaults__) or (1.0, 0.0)))
+    autotune_blocks(512, 512, 64, length=5, spans=1, budget_s=None)
+    assert timed, "truncated winner was treated as authoritative"
+
+
+def test_sweep_all_candidates_fail_falls_back(sweep_env, monkeypatch):
+    def broken_timer(fn, z, **kw):
+        raise RuntimeError("compile failed")
+
+    monkeypatch.setattr(autotune, "time_fn_chained", broken_timer)
+    best = autotune_blocks(512, 512, 64, length=5, spans=1, budget_s=None)
+    assert best == choose_blocks(512, 512, 64)

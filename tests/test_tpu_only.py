@@ -125,22 +125,38 @@ def test_autotune_live_sweep_caches_winner():
     clear_cache()  # in-process only; the disk cache under $NTXENT_TPU_CACHE
     # would satisfy the lookup without measuring, so point it elsewhere.
     import os
-    old = os.environ.get("NTXENT_TPU_CACHE")
     import tempfile
+    old = os.environ.get("NTXENT_TPU_CACHE")
+    # Spy on the chain timer: autotune_blocks falls back to the
+    # choose_blocks heuristic when every candidate fails, and that
+    # fallback is ALSO cached — so without this, the test would go green
+    # with zero successful measurements (the exact gap it exists to close).
+    real_timer = autotune.time_fn_chained
+    measurements = []
+
+    def spy(fn, z, **kw):
+        out = real_timer(fn, z, **kw)
+        measurements.append((fn.__defaults__, out[0]))
+        return out
+
+    autotune.time_fn_chained = spy
     with tempfile.TemporaryDirectory() as tmp:
         os.environ["NTXENT_TPU_CACHE"] = tmp
         try:
             br, bc = autotune_blocks(512, 512, 64, length=10, spans=1,
                                      budget_s=60.0)
-            # A legal candidate: positive, aligned, within the 512 grid.
-            assert br > 0 and bc > 0
-            assert br <= 512 and bc <= 512
-            # Second call must be an in-process cache hit (identical
-            # result, no sweep): the cache key must exist now.
-            assert any(k for k in autotune._CACHE), "sweep did not cache"
+            assert measurements, "live sweep measured no candidate"
+            assert all(np.isfinite(ms) and ms > 0
+                       for _, ms in measurements)
+            # The winner is a measured candidate, not the fallback.
+            assert (br, bc) in [blocks for blocks, _ in measurements]
+            # Second call must be a cache hit: no new measurements.
+            n = len(measurements)
             assert autotune_blocks(512, 512, 64, length=10, spans=1,
                                    budget_s=60.0) == (br, bc)
+            assert len(measurements) == n, "cached winner was re-measured"
         finally:
+            autotune.time_fn_chained = real_timer
             clear_cache()
             if old is None:
                 os.environ.pop("NTXENT_TPU_CACHE", None)
