@@ -381,10 +381,14 @@ def info_nce_loss_ring(
     *,
     scale: jax.Array | float | None = None,
     axis: str = "data",
+    impl: str = "dual",
 ) -> jax.Array:
     """Global-batch InfoNCE without ever gathering the global batch.
 
     The CLIP-scale path (BASELINE.json configs[4], global batch 32768):
     memory is O(N/P) per chip and all communication is neighbor ICI hops.
+    ``impl`` selects the ring body (``"dual"``/``"twoblock"`` — see
+    ``make_ring_infonce``).
     """
-    return make_ring_infonce(mesh, axis)(za, zb, resolve_scale(temperature, scale))
+    return make_ring_infonce(mesh, axis, impl=impl)(
+        za, zb, resolve_scale(temperature, scale))

@@ -28,6 +28,20 @@ import jax  # noqa: E402  (import after env setup)
 # (jax_platforms config wins over the env var) — force it back for tests.
 jax.config.update("jax_platforms", _PLATFORM)
 
+# Persistent XLA compilation cache: the fast tier is COMPILE-dominated
+# (interpret-mode shard_map programs take 10-60 s each to build), and the
+# cache is keyed by HLO hash, so edited code recompiles while untouched
+# programs hit disk — repeat runs of the tier drop from ~9 min toward the
+# execute-only floor. Point NTXENT_JAX_CACHE elsewhere (or at '') to move
+# or disable it.
+_JAX_CACHE = os.environ.get(
+    "NTXENT_JAX_CACHE",
+    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache"))
+if _JAX_CACHE:
+    jax.config.update("jax_compilation_cache_dir", _JAX_CACHE)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import jax.numpy as jnp  # noqa: E402
 import pytest  # noqa: E402
 

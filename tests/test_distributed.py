@@ -255,62 +255,71 @@ class TestPairParallel:
         np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
 
     def test_matches_oracle_odd_mesh(self, rng):
-        # 5-device submesh: odd P has no split tile — different schedule.
+        # 3-device submesh: odd P has no split tile — different schedule.
+        # (P=3 exercises the same no-antipodal branch as any odd P at a
+        # fraction of the interpret-mode shard_map compile cost; the
+        # schedule-coverage invariant across ALL mesh sizes is pinned by
+        # test_pair_schedule_covers_every_pair_with_unit_weight below.)
         from ntxent_tpu.parallel import create_mesh, ntxent_loss_pair
 
-        mesh5 = create_mesh(devices=jax.devices()[:5],
+        mesh3 = create_mesh(devices=jax.devices()[:3],
                             axis_names=("data",))
-        z1 = make_embeddings(rng, 20, 8)
-        z2 = make_embeddings(jax.random.fold_in(rng, 1), 20, 8)
-        z1s, z2s = shard_batch((z1, z2), mesh5)
-        got = ntxent_loss_pair(z1s, z2s, mesh5, 0.2)
+        z1 = make_embeddings(rng, 12, 8)
+        z2 = make_embeddings(jax.random.fold_in(rng, 1), 12, 8)
+        z1s, z2s = shard_batch((z1, z2), mesh3)
+        got = ntxent_loss_pair(z1s, z2s, mesh3, 0.2)
         want = oracle.ntxent_loss(jnp.concatenate([z1, z2]), 0.2)
         np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
 
         # Backward through the odd-P schedule (no antipodal split tile).
         from ntxent_tpu.parallel import make_pair_ntxent
 
-        fn = make_pair_ntxent(mesh5, 0.2)
+        fn = make_pair_ntxent(mesh3, 0.2)
         g1, g2 = jax.grad(lambda a, b: fn(a, b), argnums=(0, 1))(z1s, z2s)
         go = jax.grad(lambda z: oracle.ntxent_loss(z, 0.2))(
             jnp.concatenate([z1, z2]))
-        for got_g, want_g in zip((g1, g2), (go[:20], go[20:])):
+        for got_g, want_g in zip((g1, g2), (go[:12], go[12:])):
             np.testing.assert_allclose(np.asarray(got_g),
                                        np.asarray(want_g),
                                        rtol=1e-4, atol=1e-6)
 
-    def test_grads_match_strip_path(self, rng, mesh):
-        """pair == strip == oracle gradients through the custom VJP
-        (G-tile psum assembly) plus the AD-handled positive term."""
-        from ntxent_tpu.parallel import make_pair_ntxent, make_sharded_ntxent
+    def test_grads_match_oracle_even_mesh(self, rng):
+        """pair == oracle gradients through the custom VJP (G-tile psum
+        assembly) plus the AD-handled positive term, on an even mesh
+        (antipodal split tile in the backward schedule). 4-device submesh:
+        same even-P branch as P=8, half the compile; pair==strip follows
+        transitively from the strip path's own oracle equality
+        (test_distributed_grads_match_oracle)."""
+        from ntxent_tpu.parallel import create_mesh, make_pair_ntxent
 
-        z1 = make_embeddings(rng, 32, 16)
-        z2 = make_embeddings(jax.random.fold_in(rng, 2), 32, 16)
-        z1s, z2s = shard_batch((z1, z2), mesh)
-        pair = make_pair_ntxent(mesh, 0.1)
-        strip = make_sharded_ntxent(mesh, 0.1)
+        mesh4 = create_mesh(devices=jax.devices()[:4],
+                            axis_names=("data",))
+        z1 = make_embeddings(rng, 16, 16)
+        z2 = make_embeddings(jax.random.fold_in(rng, 2), 16, 16)
+        z1s, z2s = shard_batch((z1, z2), mesh4)
+        pair = make_pair_ntxent(mesh4, 0.1)
         gp = jax.grad(lambda a, b: pair(a, b), argnums=(0, 1))(z1s, z2s)
-        gs = jax.grad(lambda a, b: strip(a, b), argnums=(0, 1))(z1s, z2s)
         go = jax.grad(lambda z: oracle.ntxent_loss(z, 0.1))(
             jnp.concatenate([z1, z2]))
-        for got, want in zip(gp, (go[:32], go[32:])):
+        for got, want in zip(gp, (go[:16], go[16:])):
             np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                        rtol=1e-4, atol=1e-6)
-        for got, want in zip(gp, gs):
-            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                                       rtol=1e-5, atol=1e-7)
 
-    def test_impl_knob_and_unknown_rejected(self, rng, mesh):
-        from ntxent_tpu.parallel import make_sharded_ntxent
+    def test_impl_knob_and_unknown_rejected(self, rng):
+        from ntxent_tpu.parallel import create_mesh, make_sharded_ntxent
 
-        z1 = make_embeddings(rng, 16, 8)
-        z2 = make_embeddings(jax.random.fold_in(rng, 3), 16, 8)
-        z1s, z2s = shard_batch((z1, z2), mesh)
-        a = make_sharded_ntxent(mesh, 0.1)(z1s, z2s)
-        b = make_sharded_ntxent(mesh, 0.1, impl="pair")(z1s, z2s)
+        # 2-device submesh: the knob test only proves ROUTING (each impl
+        # computes the same loss); the full-mesh equalities live above.
+        mesh2 = create_mesh(devices=jax.devices()[:2],
+                            axis_names=("data",))
+        z1 = make_embeddings(rng, 8, 8)
+        z2 = make_embeddings(jax.random.fold_in(rng, 3), 8, 8)
+        z1s, z2s = shard_batch((z1, z2), mesh2)
+        a = make_sharded_ntxent(mesh2, 0.1)(z1s, z2s)
+        b = make_sharded_ntxent(mesh2, 0.1, impl="pair")(z1s, z2s)
         np.testing.assert_allclose(float(a), float(b), rtol=1e-5)
         with pytest.raises(ValueError, match="unknown"):
-            make_sharded_ntxent(mesh, impl="nope")
+            make_sharded_ntxent(mesh2, impl="nope")
 
 
 @pytest.mark.slow

@@ -16,8 +16,9 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import __graft_entry__ as graft  # noqa: E402
 
 
-@pytest.mark.slow
 def test_entry_traces():
+    # Fast-tier guard on the driver entry: tracing + lowering catches
+    # signature/shape rot in seconds without an XLA compile.
     fn, example_args = graft.entry()
     lowered = jax.jit(fn).lower(*example_args)
     assert lowered is not None
@@ -29,7 +30,11 @@ def test_dryrun_multichip_runs():
     graft.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_measured_flops_on_entry():
+    # Needs a real XLA compile of the RN50 entry (~20 s on the CI host) —
+    # slow tier; the flops-accounting logic itself is pinned fast by
+    # test_profiling.py::test_measured_flops_matches_matmul_arithmetic.
     from ntxent_tpu.utils import measured_flops
 
     fn, example_args = graft.entry()
