@@ -18,6 +18,12 @@ from ntxent_tpu.parallel.pair import (
     make_pair_ntxent,
     ntxent_loss_pair,
 )
+from ntxent_tpu.parallel.ring_attention import (
+    attention_oracle,
+    blockwise_attention,
+    make_ring_attention,
+    make_ulysses_attention,
+)
 from ntxent_tpu.parallel.ring import (
     info_nce_loss_ring,
     make_ring_infonce,
@@ -51,6 +57,10 @@ __all__ = [
     "make_sharded_infonce",
     "info_nce_loss_ring",
     "make_ring_infonce",
+    "attention_oracle",
+    "blockwise_attention",
+    "make_ring_attention",
+    "make_ulysses_attention",
     "tp_param_spec",
     "param_spec_tree",
     "shard_train_state",

@@ -1,0 +1,324 @@
+"""Sequence/context parallelism for long sequences: ring attention and
+Ulysses-style all-to-all head parallelism.
+
+The framework's contrastive losses already have their ring form
+(parallel/ring.py — the quadratic object there is the similarity matrix).
+This module gives the TOWERS the same treatment for sequences too long for
+one chip's attention: the quadratic object is the (L, L) attention matrix,
+and "long context" means L²  doesn't fit — or L itself doesn't — per chip.
+
+Two standard decompositions, both over a 1-D mesh axis that shards the
+sequence dimension:
+
+* **Ring attention** (`make_ring_attention`): Q stays home; (K, V) blocks
+  circulate around the ICI ring via ``lax.ppermute`` while each device
+  folds every visiting block into flash-style online-softmax statistics
+  (running max m, running sum l, running output O). After P hops every
+  query row has seen all L keys: per-chip attention memory is
+  O(L/P x L/P) per fold, activations O(L/P), and all communication rides
+  neighbor ICI links. The backward is a custom VJP running a SECOND ring
+  pass in which each (K, V) block circulates together with its (dK, dV)
+  accumulators and arrives home carrying every device's contribution —
+  the hand-written reverse-ring the pattern needs, derived once here
+  (same structure as ring.py's fused-ring loss VJP).
+* **Ulysses / all-to-all** (`make_ulysses_attention`): one
+  ``lax.all_to_all`` re-shards from sequence-split to head-split (every
+  device gets the FULL sequence for H/P heads), attention runs locally
+  and exactly, and a second all-to-all re-shards back. Communication is
+  two all-to-alls of the activations; attention math is untouched —
+  gradients flow through the collectives by AD. Requires H % P == 0.
+
+When to use which (the scaling-book recipe): Ulysses when heads divide
+cleanly and the all-to-all fits ICI (cheapest — exact attention, two
+collectives); the ring when L/P is the binding constraint or heads are
+few — its communication overlaps with per-hop compute and nothing ever
+holds the full (L, d) K/V on one chip.
+
+Shapes follow the towers' convention: q, k, v are (B, L, H, D) with L
+sharded over the mesh axis; outputs match. All softmax statistics are
+fp32 regardless of input dtype (bf16-safe), with the same `_exp0`/`_log_l`
+compiler-skew hardening the loss kernels use.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.ntxent_pallas import _exp0, _log_l
+
+__all__ = [
+    "attention_oracle",
+    "blockwise_attention",
+    "make_ring_attention",
+    "make_ulysses_attention",
+]
+
+_NEG_INF = -1e30
+
+
+def _resolve_scale(scale, head_dim) -> float:
+    return float(scale) if scale is not None else 1.0 / math.sqrt(head_dim)
+
+
+def attention_oracle(q, k, v, *, causal: bool = False, scale=None,
+                     q_offset: int = 0, k_offset: int = 0):
+    """Reference full-softmax attention (jnp, fp32 softmax) — the oracle
+    the parallel forms are tested against. q, k, v: (B, L, H, D)."""
+    sc = _resolve_scale(scale, q.shape[-1])
+    s = jnp.einsum("blhd,bmhd->bhlm", q, k,
+                   preferred_element_type=jnp.float32) * sc
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        s = jnp.where(kpos[None, None, None, :] > qpos[None, None, :, None],
+                      _NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhlm,bmhd->blhd", p.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def _fold(q_bhld, kb, vb, qpos, kpos, m, l, o, sc, causal):
+    """Fold one (K, V) block into the online-softmax statistics.
+
+    q_bhld: (B, H, Lq, D); kb, vb: (B, Lk, H, D); m, l: (B, H, Lq);
+    o: (B, H, Lq, D) fp32 accumulators; qpos/kpos: global row positions.
+    """
+    s = jnp.einsum("bhld,bmhd->bhlm", q_bhld, kb,
+                   preferred_element_type=jnp.float32) * sc
+    if causal:
+        s = jnp.where(kpos[None, None, None, :] > qpos[None, None, :, None],
+                      _NEG_INF, s)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # A fold whose every entry is causal-masked leaves m_new at -inf and
+    # s - m_new == 0 — the raw exp would count masked entries as weight 1.
+    # (Happens on real rings: an early hop can be entirely in a query
+    # row's future.) Zero them explicitly.
+    p = jnp.where(s <= _NEG_INF * 0.5, 0.0, _exp0(s - m_new[..., None]))
+    alpha = _exp0(m - m_new)
+    l = l * alpha + jnp.sum(p, axis=-1)
+    o = o * alpha[..., None] + jnp.einsum(
+        "bhlm,bmhd->bhld", p, vb.astype(jnp.float32))
+    return m_new, l, o
+
+
+def blockwise_attention(q, k, v, *, block_kv: int | None = None,
+                        causal: bool = False, scale=None):
+    """Single-device flash-style attention: a ``lax.scan`` over K/V blocks
+    with online-softmax folds — never materializes the (L, L) matrix.
+    Exact (same math as ``attention_oracle``, fold order aside). The
+    per-hop building block of the ring form, usable standalone for long
+    single-chip sequences. L must divide by ``block_kv`` (default: one
+    block — plain attention memory, kept simple for callers that only
+    want the interface)."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    block = block_kv or lk
+    if lk % block:
+        raise ValueError(f"sequence {lk} not divisible by block {block}")
+    sc = _resolve_scale(scale, d)
+    q_ = q.astype(jnp.float32).transpose(0, 2, 1, 3)  # (B, H, Lq, D)
+    pos = jnp.arange(lq)
+    m = jnp.full((b, h, lq), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, lq), jnp.float32)
+    o = jnp.zeros((b, h, lq, d), jnp.float32)
+
+    kb = k.reshape(b, lk // block, block, h, d).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(b, lk // block, block, h, d).transpose(1, 0, 2, 3, 4)
+
+    def step(carry, blk):
+        m, l, o = carry
+        kj, vj, j = blk
+        kpos = j * block + jnp.arange(block)
+        m, l, o = _fold(q_, kj, vj, pos, kpos, m, l, o, sc, causal)
+        return (m, l, o), None
+
+    (m, l, o), _ = jax.lax.scan(
+        step, (m, l, o), (kb, vb, jnp.arange(lk // block)))
+    out = o / l[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_attention(q, k, v, axis, num_devices, causal, sc):
+    """Per-device ring attention body (call inside shard_map).
+
+    q, k, v: (B, L/P, H, D) local sequence shards. Returns the local
+    (B, L/P, H, D) output block after all P hops.
+    """
+    return _ring_fwd(q, k, v, axis, num_devices, causal, sc)[0]
+
+
+def _hop_perm(axis, num_devices):
+    return [(i, (i + 1) % num_devices) for i in range(num_devices)]
+
+
+def _positions(axis, l_loc):
+    d = jax.lax.axis_index(axis)
+    return d * l_loc + jnp.arange(l_loc)
+
+
+def _ring_fwd(q, k, v, axis, num_devices, causal, sc):
+    b, l_loc, h, d = q.shape
+    perm = _hop_perm(axis, num_devices)
+    qpos = _positions(axis, l_loc)
+    q_ = q.astype(jnp.float32).transpose(0, 2, 1, 3)  # (B, H, Lq, D)
+
+    def varying(x):
+        return jax.lax.pcast(x, (axis,), to="varying")
+
+    init = (
+        k, v, qpos,
+        varying(jnp.full((b, h, l_loc), _NEG_INF, jnp.float32)),
+        varying(jnp.zeros((b, h, l_loc), jnp.float32)),
+        varying(jnp.zeros((b, h, l_loc, d), jnp.float32)),
+    )
+
+    def step(carry, _):
+        kb, vb, kpos, m, l, o = carry
+        m, l, o = _fold(q_, kb, vb, qpos, kpos, m, l, o, sc, causal)
+        kb = jax.lax.ppermute(kb, axis, perm)
+        vb = jax.lax.ppermute(vb, axis, perm)
+        kpos = jax.lax.ppermute(kpos, axis, perm)
+        return (kb, vb, kpos, m, l, o), None
+
+    (_, _, _, m, l, o), _ = jax.lax.scan(step, init, None,
+                                         length=num_devices)
+    lse = m + _log_l(l)                      # (B, H, Lq)
+    out = (o / l[..., None]).transpose(0, 2, 1, 3).astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis, num_devices, causal, sc, res, g):
+    """Second ring pass: each (K, V) block circulates WITH its (dK, dV)
+    accumulators and arrives home carrying every device's contribution."""
+    q, k, v, out, lse = res
+    b, l_loc, h, d = q.shape
+    perm = _hop_perm(axis, num_devices)
+    qpos = _positions(axis, l_loc)
+
+    q_ = q.astype(jnp.float32).transpose(0, 2, 1, 3)     # (B, H, Lq, D)
+    do = g.astype(jnp.float32).transpose(0, 2, 1, 3)     # (B, H, Lq, D)
+    # D_i = sum_d do_i * o_i — the softmax-backward row correction.
+    drow = jnp.sum(do * out.astype(jnp.float32).transpose(0, 2, 1, 3),
+                   axis=-1)                               # (B, H, Lq)
+
+    def varying(x):
+        return jax.lax.pcast(x, (axis,), to="varying")
+
+    init = (
+        k, v, qpos,
+        varying(jnp.zeros((b, l_loc, h, d), jnp.float32)),  # dk acc
+        varying(jnp.zeros((b, l_loc, h, d), jnp.float32)),  # dv acc
+        varying(jnp.zeros((b, h, l_loc, d), jnp.float32)),  # dq acc (home)
+    )
+
+    def step(carry, _):
+        kb, vb, kpos, dkb, dvb, dq = carry
+        s = jnp.einsum("bhld,bmhd->bhlm", q_, kb,
+                       preferred_element_type=jnp.float32) * sc
+        if causal:
+            s = jnp.where(
+                kpos[None, None, None, :] > qpos[None, None, :, None],
+                _NEG_INF, s)
+        p = _exp0(s - lse[..., None])                     # true softmax rows
+        dvb = dvb + jnp.einsum("bhlm,bhld->bmhd", p, do)
+        dp = jnp.einsum("bhld,bmhd->bhlm", do, vb.astype(jnp.float32))
+        ds = p * (dp - drow[..., None]) * sc
+        dq = dq + jnp.einsum("bhlm,bmhd->bhld", ds, kb.astype(jnp.float32))
+        dkb = dkb + jnp.einsum("bhlm,bhld->bmhd", ds, q_)
+        kb = jax.lax.ppermute(kb, axis, perm)
+        vb = jax.lax.ppermute(vb, axis, perm)
+        kpos = jax.lax.ppermute(kpos, axis, perm)
+        dkb = jax.lax.ppermute(dkb, axis, perm)
+        dvb = jax.lax.ppermute(dvb, axis, perm)
+        return (kb, vb, kpos, dkb, dvb, dq), None
+
+    (_, _, _, dk, dv, dq), _ = jax.lax.scan(step, init, None,
+                                            length=num_devices)
+    dq = dq.transpose(0, 2, 1, 3)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_attention.defvjp(_ring_fwd, _ring_bwd)
+
+
+def make_ring_attention(mesh: Mesh, axis: str = "data", *,
+                        causal: bool = False, scale=None):
+    """Build a jit-able sequence-parallel ring attention over ``mesh``.
+
+    Returns ``fn(q, k, v) -> out`` with all four (B, L, H, D) and L
+    sharded over ``axis`` (L % P == 0). ``causal`` masks with GLOBAL
+    positions, so the sharded form equals the oracle on the full
+    sequence. Exact gradients for q, k, v via the second-ring-pass VJP.
+    """
+    num_devices = mesh.shape[axis]
+
+    def body(q, k, v):
+        sc = _resolve_scale(scale, q.shape[-1])
+        return _ring_attention(q, k, v, axis, num_devices, causal, sc)
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+        check_vma=False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all head parallelism)
+# ---------------------------------------------------------------------------
+
+
+def make_ulysses_attention(mesh: Mesh, axis: str = "data", *,
+                           causal: bool = False, scale=None,
+                           block_kv: int | None = None):
+    """Build a jit-able all-to-all sequence-parallel attention.
+
+    Input/output (B, L, H, D) with L sharded over ``axis``; internally one
+    ``all_to_all`` re-shards to (B, L, H/P, D) per device (full sequence,
+    a slice of heads), attention runs locally — blockwise when
+    ``block_kv`` is set — and a second all-to-all restores the sequence
+    sharding. H % P == 0 required. Gradients through the collectives are
+    AD-derived (the transpose of an all-to-all is the reverse
+    all-to-all).
+    """
+    num_devices = mesh.shape[axis]
+
+    def body(q, k, v):
+        h = q.shape[2]
+        if h % num_devices:
+            raise ValueError(
+                f"Ulysses needs heads ({h}) divisible by mesh axis "
+                f"({num_devices}); use make_ring_attention instead")
+
+        def to_heads(x):   # (B, L/P, H, D) -> (B, L, H/P, D)
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+        if block_kv:
+            oh = blockwise_attention(qh, kh, vh, block_kv=block_kv,
+                                     causal=causal, scale=scale)
+        else:
+            oh = attention_oracle(qh, kh, vh, causal=causal, scale=scale)
+        # (B, L, H/P, D) -> (B, L/P, H, D)
+        return jax.lax.all_to_all(oh, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+        check_vma=False,
+    )

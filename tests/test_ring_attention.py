@@ -1,0 +1,153 @@
+"""Sequence/context-parallel attention vs the full-softmax oracle.
+
+The towers' long-sequence story (parallel/ring_attention.py): ring
+attention (circulating KV + second-ring-pass VJP) and Ulysses all-to-all
+head parallelism must be the SAME FUNCTION as single-device attention —
+loss and every gradient — on the 8-device virtual mesh, causal and not.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ntxent_tpu.parallel import (
+    attention_oracle,
+    blockwise_attention,
+    create_mesh,
+    make_ring_attention,
+    make_ulysses_attention,
+)
+
+# Only the mesh-using tests need 8 devices; blockwise_attention is a
+# single-device path and must stay tested on small-chip sessions too.
+needs_mesh = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs an 8-device mesh")
+
+B, L, H, D = 2, 32, 8, 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return create_mesh(axis_names=("data",))
+
+
+@pytest.fixture()
+def qkv(rng):
+    ks = jax.random.split(rng, 3)
+    return tuple(jax.random.normal(k, (B, L, H, D)) * 0.5 for k in ks)
+
+
+def loss_of(fn):
+    """Scalar probe whose gradient exercises dq, dk, dv with a non-uniform
+    cotangent (squared output weights every element differently)."""
+    return lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+
+def assert_same_fn(fn, ref, qkv, rtol=1e-5, atol=1e-6):
+    out, ref_out = fn(*qkv), ref(*qkv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=rtol, atol=atol)
+    g = jax.grad(loss_of(fn), argnums=(0, 1, 2))(*qkv)
+    gr = jax.grad(loss_of(ref), argnums=(0, 1, 2))(*qkv)
+    for got, want in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_matches_oracle(rng, qkv, causal):
+    import functools
+
+    fn = functools.partial(blockwise_attention, block_kv=8, causal=causal)
+    ref = functools.partial(attention_oracle, causal=causal)
+    assert_same_fn(fn, ref, qkv)
+
+
+def test_blockwise_rejects_nondividing_block(qkv):
+    with pytest.raises(ValueError, match="not divisible"):
+        blockwise_attention(*qkv, block_kv=5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@needs_mesh
+def test_ring_matches_oracle(rng, qkv, mesh, causal):
+    """The circulating-KV ring (forward) and the second-ring-pass VJP
+    (backward) equal full attention — including causal masking with
+    GLOBAL positions, where early hops can be entirely masked for some
+    query rows (the fold must not count masked entries)."""
+    import functools
+
+    fn = make_ring_attention(mesh, causal=causal)
+    ref = functools.partial(attention_oracle, causal=causal)
+    assert_same_fn(fn, ref, qkv)
+
+
+@needs_mesh
+def test_ring_bf16_finite_and_close(rng, qkv, mesh):
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+    out = make_ring_attention(mesh)(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = attention_oracle(*qkv)
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref), rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@needs_mesh
+def test_ulysses_matches_oracle(rng, qkv, mesh, causal):
+    import functools
+
+    fn = make_ulysses_attention(mesh, causal=causal)
+    ref = functools.partial(attention_oracle, causal=causal)
+    assert_same_fn(fn, ref, qkv)
+
+
+@needs_mesh
+def test_ulysses_blockwise_local_path(rng, qkv, mesh):
+    fn = make_ulysses_attention(mesh, block_kv=8)
+    ref = attention_oracle
+    assert_same_fn(fn, ref, qkv)
+
+
+@needs_mesh
+def test_ulysses_rejects_indivisible_heads(rng, mesh):
+    ks = jax.random.split(rng, 3)
+    q, k, v = (jax.random.normal(kk, (B, L, 6, D)) for kk in ks)
+    with pytest.raises(ValueError, match="divisible"):
+        make_ulysses_attention(mesh)(q, k, v)
+
+
+@pytest.mark.slow
+@needs_mesh
+def test_ring_memory_never_gathers_kv(mesh):
+    """The ring's compiled temp memory must stay below the gather-style
+    form's: nothing ever holds the full (L, d) K/V — the reason the ring
+    exists (long-context claim, SURVEY §5.7)."""
+    big_l, h, d = 2048 * 8, 4, 64
+    q = jnp.ones((1, big_l, h, d), jnp.bfloat16)
+
+    def temp_bytes(fn):
+        stats = jax.jit(fn).lower(q, q, q).compile().memory_analysis()
+        if stats is None:
+            pytest.skip("backend exposes no memory analysis")
+        return stats.temp_size_in_bytes
+
+    ring = temp_bytes(make_ring_attention(mesh))
+
+    def gathered(qq, kk, vv):
+        # The all-gather form: full K/V on every device.
+        from jax.sharding import PartitionSpec as P
+
+        def body(qq, kk, vv):
+            kg = jax.lax.all_gather(kk, "data", axis=1, tiled=True)
+            vg = jax.lax.all_gather(vv, "data", axis=1, tiled=True)
+            return attention_oracle(qq, kg, vg)
+
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, "data"),) * 3, out_specs=P(None, "data"),
+            check_vma=False)(qq, kk, vv)
+
+    gath = temp_bytes(gathered)
+    assert ring < gath, (ring, gath)
