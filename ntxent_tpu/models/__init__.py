@@ -1,4 +1,9 @@
 from ntxent_tpu.models.clip import CLIPModel, TextTransformer
+from ntxent_tpu.models.long_context import (
+    LongContextBlock,
+    LongContextTransformer,
+    SeqParallelSelfAttention,
+)
 from ntxent_tpu.models.projection import ProjectionHead, SimCLRModel
 from ntxent_tpu.models.resnet import (
     ResNet,
@@ -20,6 +25,9 @@ from ntxent_tpu.models.vit import (
 __all__ = [
     "CLIPModel",
     "TextTransformer",
+    "LongContextBlock",
+    "LongContextTransformer",
+    "SeqParallelSelfAttention",
     "ProjectionHead",
     "SimCLRModel",
     "ResNet",

@@ -1,0 +1,122 @@
+"""Long-context transformer tower: attention as a pluggable function.
+
+The stock towers (models/vit.py) use flax's fused attention — right for
+L ≤ a few hundred (ViT-B/16 at 224px has L = 197, where the (L, L)
+matrix is trivia). For sequences where L or L² is the constraint, this
+module factors the attention CALL out of the architecture so the same
+parameters run under any of the framework's attention decompositions
+(parallel/ring_attention.py):
+
+* single chip, moderate L     -> ``attention_oracle`` (exact, simple)
+* single chip, long L         -> ``blockwise_attention`` (flash-style
+                                  lax.scan folds, no (L, L) materialized)
+* mesh, sequence-sharded      -> ``make_ring_attention(mesh)`` or
+                                  ``make_ulysses_attention(mesh)``
+
+All four are the same mathematical function (tests pin model outputs AND
+parameter gradients for every plan), so a checkpoint trained under one
+runs under the others — the parallelism decision is a RUNTIME choice,
+not an architecture fork.
+shard_map attention composes inside jit: annotate the inputs sequence-
+sharded and GSPMD partitions the pointwise/Dense ops around the explicit
+ring/all-to-all collectives.
+
+Follows the towers' conventions (vit.py): bf16 activations / fp32
+params, fp32 LayerNorm, pre-norm blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel.ring_attention import attention_oracle
+from .vit import MlpBlock
+
+AttentionFn = Callable[..., jnp.ndarray]  # (q, k, v) -> out, all (B,L,H,D)
+
+
+class SeqParallelSelfAttention(nn.Module):
+    """QKV projection + pluggable attention call + output projection.
+
+    ``attention_fn`` consumes/produces (B, L, H, D); every projection here
+    is pointwise over L, so under a sequence-sharded input GSPMD keeps
+    them local and only ``attention_fn``'s own collectives move data.
+    """
+
+    num_heads: int
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_fn: AttentionFn = attention_oracle
+
+    @nn.compact
+    def __call__(self, x):
+        b, l, hidden = x.shape
+        if hidden % self.num_heads:
+            raise ValueError(
+                f"hidden {hidden} not divisible by heads {self.num_heads}")
+        head_dim = hidden // self.num_heads
+
+        def proj(name):
+            return nn.DenseGeneral(
+                (self.num_heads, head_dim), axis=-1, dtype=self.dtype,
+                param_dtype=jnp.float32, name=name)(x)
+
+        out = self.attention_fn(proj("query"), proj("key"), proj("value"))
+        return nn.DenseGeneral(
+            hidden, axis=(-2, -1), dtype=self.dtype,
+            param_dtype=jnp.float32, name="out")(out)
+
+
+class LongContextBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_fn: AttentionFn = attention_oracle
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        y = SeqParallelSelfAttention(
+            num_heads=self.num_heads, dtype=self.dtype,
+            attention_fn=self.attention_fn)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32)(x)
+        return x + MlpBlock(self.mlp_dim, self.dtype)(y)
+
+
+class LongContextTransformer(nn.Module):
+    """Token-sequence tower for sequences beyond single-chip attention.
+
+    Maps (B, L) int tokens -> (B, L, hidden) contextual features (mean-
+    pool or slice downstream as the objective needs). Same parameter tree
+    regardless of ``attention_fn`` — swap the decomposition at load time.
+    """
+
+    vocab_size: int
+    hidden_dim: int = 512
+    depth: int = 8
+    num_heads: int = 8
+    mlp_dim: int = 2048
+    max_len: int = 32768
+    dtype: jnp.dtype = jnp.bfloat16
+    attention_fn: AttentionFn = attention_oracle
+
+    @nn.compact
+    def __call__(self, tokens):
+        b, l = tokens.shape
+        if l > self.max_len:
+            raise ValueError(
+                f"sequence length {l} exceeds max_len {self.max_len} "
+                f"(raise max_len — it sizes the position table)")
+        x = nn.Embed(self.vocab_size, self.hidden_dim,
+                     param_dtype=jnp.float32, dtype=self.dtype)(tokens)
+        pos = self.param("pos_embedding", nn.initializers.normal(0.02),
+                         (1, self.max_len, self.hidden_dim), jnp.float32)
+        x = x + pos[:, :l].astype(self.dtype)
+        for _ in range(self.depth):
+            x = LongContextBlock(
+                num_heads=self.num_heads, mlp_dim=self.mlp_dim,
+                dtype=self.dtype, attention_fn=self.attention_fn)(x)
+        return nn.LayerNorm(dtype=jnp.float32)(x)
