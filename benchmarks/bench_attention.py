@@ -1,0 +1,102 @@
+"""Flash-attention A/B: fused Pallas kernel vs XLA's own fusion.
+
+The long-context hot-path decision (ops/attention_pallas.py): at what
+sequence length does the blockwise kernel beat letting XLA fuse
+softmax(QK^T)V? Times both with the scanned-chain protocol (the only
+trustworthy one on tunneled backends — see utils/profiling) at bf16,
+causal and not, over an L ladder, and writes one JSON artifact.
+
+On CPU hosts this refuses to time the kernel (interpret mode measures
+the interpreter) and records the XLA oracle only, marked as such.
+
+Usage: python benchmarks/bench_attention.py [--out FILE] [--ladder 1024,4096,8192]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--ladder", default="1024,4096,8192")
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--platform", default=None)
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax.numpy as jnp
+
+    from ntxent_tpu.ops import flash_attention
+    from ntxent_tpu.parallel import attention_oracle
+    from ntxent_tpu.utils.profiling import time_fn_chained
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+    ladder = [int(x) for x in args.ladder.split(",")]
+    if not on_accel:
+        ladder = [min(ladder)]
+
+    rows = []
+    for l in ladder:
+        ks = jax.random.split(jax.random.PRNGKey(l), 3)
+        q, k, v = (jax.random.normal(kk, (1, l, args.heads, args.head_dim),
+                                     jnp.bfloat16) * 0.5 for kk in ks)
+
+        for causal in (False, True):
+            entry = {"seq_len": l, "causal": causal, "backend": backend}
+
+            # Scalar probe: the chained protocol folds the loss back into
+            # q each step, so step k+1 is data-dependent on step k.
+            def oracle_loss(qq, _c=causal):
+                return jnp.sum(
+                    attention_oracle(qq, k, v, causal=_c).astype(jnp.float32))
+
+            n = 20 if on_accel else 3
+            ms, _ = time_fn_chained(oracle_loss, q, length=n, spans=2,
+                                    with_grad=False)
+            entry["xla_oracle_ms"] = round(ms, 4)
+            if on_accel:  # interpret-mode timing measures nothing
+
+                def flash_loss(qq, _c=causal):
+                    return jnp.sum(
+                        flash_attention(qq, k, v, causal=_c)
+                        .astype(jnp.float32))
+
+                ms, _ = time_fn_chained(flash_loss, q, length=n, spans=2,
+                                        with_grad=False)
+                entry["pallas_flash_ms"] = round(ms, 4)
+                entry["speedup"] = round(
+                    entry["xla_oracle_ms"] / ms, 3) if ms else None
+            rows.append(entry)
+            print(json.dumps(entry))
+
+    out = args.out or str(
+        REPO / "benchmark_results" / ("tpu" if on_accel else "cpu")
+        / "attention_ab.json")
+    out_dir = os.path.dirname(out)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    with open(out, "w") as f:
+        json.dump({"timestamp": time.strftime("%Y%m%d_%H%M%S"),
+                   "device_kind": jax.local_devices()[0].device_kind,
+                   "rows": rows}, f, indent=1)
+    print(f"-> {out}")
+
+
+if __name__ == "__main__":
+    main()

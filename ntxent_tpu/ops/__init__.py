@@ -1,6 +1,7 @@
 from ntxent_tpu.ops import oracle
 from ntxent_tpu.ops.autotune import autotune_blocks
 from ntxent_tpu.ops.blocks import choose_blocks
+from ntxent_tpu.ops.attention_pallas import flash_attention
 from ntxent_tpu.ops.infonce_pallas import info_nce_fused, info_nce_partial_fused
 from ntxent_tpu.ops.ntxent_pallas import (
     ntxent_loss_and_lse,
@@ -17,4 +18,5 @@ __all__ = [
     "ntxent_partial_fused",
     "info_nce_fused",
     "info_nce_partial_fused",
+    "flash_attention",
 ]

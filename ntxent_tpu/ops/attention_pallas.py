@@ -1,0 +1,395 @@
+"""Fused flash-attention Pallas kernels for the long-context hot path.
+
+The sequence-parallel family (parallel/ring_attention.py) decomposes
+attention ACROSS chips; this module is the single-chip hot path UNDER
+those decompositions: softmax(Q K^T / sqrt(d)) V computed blockwise on
+the MXU with online-softmax statistics in VMEM — the (L, L) matrix never
+touches HBM. Same design as the loss kernels (ops/ntxent_pallas.py):
+
+* forward: one tile walk; running (m, l, acc) in VMEM scratch; each
+  (q-block, kv-block) tile is one MXU matmul + a VPU fold; the row
+  logsumexp is published as a residual for the backward;
+* backward: flash recompute — a dQ kernel (walks kv blocks for each home
+  q block) and a dK/dV kernel (walks q blocks for each home kv block),
+  each rebuilding its s tile from the saved lse instead of reading a
+  stored probability matrix (O(L) residuals, O(block²) live memory);
+* numerics: fp32 statistics regardless of input dtype, the same
+  ``_exp0``/``_log_l`` compiler-skew hardening the loss kernels use, and
+  explicit zeroing of fully-masked folds (causal ring hops).
+
+Layout: the public entry takes the towers' (B, L, H, D) and flattens to
+(B*H, L, D) — batch*heads becomes the outer grid axis, so every tile is
+a clean (block, D) MXU operand. Causal masking takes global position
+OFFSETS so sequence-sharded callers (ring hops) mask correctly.
+
+Off-TPU the kernels run in Pallas interpret mode (exact, slow) — the
+tests pin them against `attention_oracle` there; on TPU they compile
+natively (tests/test_tpu_only.py asserts the backend).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .blocks import VMEM_BUDGET_BYTES, round_up
+from .ntxent_pallas import _default_interpret, _exp0, _log_l, _pad_rows
+
+__all__ = ["flash_attention", "resolve_attention_scale"]
+
+_NEG_INF = -1e30
+
+
+def resolve_attention_scale(scale, head_dim) -> float:
+    """The ONE copy of the default-scale rule (None -> 1/sqrt(head_dim));
+    shared by every attention form (parallel/ring_attention.py included)
+    so a convention change cannot silently diverge between them."""
+    return float(scale) if scale is not None else 1.0 / math.sqrt(head_dim)
+
+
+def _tile_live(i, j, bq, bk, q_off, k_off):
+    """False iff the (i, j) tile is ENTIRELY above the causal diagonal
+    (its smallest key position exceeds its largest query position) — the
+    MXU work for such a tile is all-masked and skippable."""
+    return (k_off + j * bk) <= (q_off + (i + 1) * bq - 1)
+
+
+def _causal_mask(s, i, j, bq, bk, q_off, k_off):
+    qpos = q_off + i * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    kpos = k_off + j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(kpos > qpos, _NEG_INF, s)
+
+
+def _pad_mask(s, j, bk, cols_actual):
+    kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(kpos >= cols_actual, _NEG_INF, s)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
+                bq, bk, sc, causal, q_off, k_off, cols_actual):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        m_s[:] = jnp.full(m_s.shape, _NEG_INF, jnp.float32)
+        l_s[:] = jnp.zeros(l_s.shape, jnp.float32)
+        acc_s[:] = jnp.zeros(acc_s.shape, jnp.float32)
+
+    def compute():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sc
+        s = _pad_mask(s, j, bk, cols_actual)
+        if causal:
+            s = _causal_mask(s, i, j, bq, bk, q_off, k_off)
+
+        m_old = m_s[:]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=1, keepdims=True))
+        # A fully-masked fold leaves m_new at -inf and s - m_new == 0; the
+        # raw exp would weight masked entries 1 (same edge the jnp fold
+        # guards — still reachable via q padding even with tile skipping).
+        p = jnp.where(s <= _NEG_INF * 0.5, 0.0, _exp0(s - m_new))
+        alpha = _exp0(m_old - m_new)
+        l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot(
+            p.astype(v_ref.dtype), v_ref[0],
+            preferred_element_type=jnp.float32)
+        m_s[:] = m_new
+
+    if causal:
+        # Tiles entirely above the diagonal are all-masked: skip their
+        # MXU matmuls outright (~2x at long L) instead of masking them.
+        pl.when(_tile_live(i, j, bq, bk, q_off, k_off))(compute)
+    else:
+        compute()
+
+    @pl.when(j == nj - 1)
+    def _():
+        # Rows that saw nothing (q padding) divide by l=0 -> guard to 1.
+        l_safe = jnp.where(l_s[:] == 0.0, 1.0, l_s[:])
+        o_ref[0] = (acc_s[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_s[:] + _log_l(l_s[:]))[:, 0]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_s, *, bq, bk, sc, causal, q_off, k_off, cols_actual):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_s[:] = jnp.zeros(dq_s.shape, jnp.float32)
+
+    def compute():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sc
+        s = _pad_mask(s, j, bk, cols_actual)
+        if causal:
+            s = _causal_mask(s, i, j, bq, bk, q_off, k_off)
+        p = jnp.where(s <= _NEG_INF * 0.5, 0.0,
+                      _exp0(s - lse_ref[0][:, None]))
+        dp = jax.lax.dot_general(
+            do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * sc
+        dq_s[:] += jax.lax.dot(ds.astype(k_ref.dtype), k_ref[0],
+                               preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_tile_live(i, j, bq, bk, q_off, k_off))(compute)
+    else:
+        compute()
+
+    @pl.when(j == nj - 1)
+    def _():
+        dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_s, dv_s, *, bq, bk, sc, causal,
+                q_off, k_off, cols_actual):
+    j = pl.program_id(1)   # home kv block
+    i = pl.program_id(2)   # visiting q block
+    ni = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_s[:] = jnp.zeros(dk_s.shape, jnp.float32)
+        dv_s[:] = jnp.zeros(dv_s.shape, jnp.float32)
+
+    def compute():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sc
+        s = _pad_mask(s, j, bk, cols_actual)
+        if causal:
+            s = _causal_mask(s, i, j, bq, bk, q_off, k_off)
+        p = jnp.where(s <= _NEG_INF * 0.5, 0.0,
+                      _exp0(s - lse_ref[0][:, None]))
+        do32 = do_ref[0].astype(jnp.float32)
+        # dV_j += P^T dO_i ; dS = P*(dO V_j^T - delta) ; dK_j += dS^T Q_i
+        dv_s[:] += jax.lax.dot_general(
+            p, do32, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do32, v_ref[0].astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * sc
+        dk_s[:] += jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_tile_live(i, j, bq, bk, q_off, k_off))(compute)
+    else:
+        compute()
+
+    @pl.when(i == ni - 1)
+    def _():
+        dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _flat(x):
+    b, l, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+
+
+def _unflat(x, b, h):
+    bh, l, d = x.shape
+    return x.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+
+
+def _blocks(l, lk, d, block_q, block_kv, itemsize=4):
+    bq = block_q or min(256, round_up(l, 8))
+    bk = block_kv or min(256, round_up(lk, 128))
+    bq = round_up(min(bq, round_up(l, 8)), 8)
+    bk = round_up(min(bk, round_up(lk, 128)), 128)
+    # Shrink un-pinned dimensions until the tile working set fits VMEM:
+    # q/k/v/do blocks + the fp32 s/p tile + fp32 accumulators.
+    def working_set(bq_, bk_):
+        return ((bq_ + 2 * bk_) * d * itemsize       # q + k + v blocks
+                + bq_ * bk_ * 4 * 2                  # s and p, fp32
+                + (bq_ + bk_) * d * 4 + bq_ * 8)     # accs + m/l
+    while working_set(bq, bk) > VMEM_BUDGET_BYTES:
+        if block_kv is None and bk > 128:
+            bk //= 2
+        elif block_q is None and bq > 8:
+            bq //= 2
+        else:
+            break  # caller pinned both: their responsibility
+    return bq, bk
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, sc, causal, q_off, k_off, bq, bk, interpret):
+    return _flash_fwd(q, k, v, sc, causal, q_off, k_off, bq, bk,
+                      interpret)[0]
+
+
+def _specs(bq, bk, d):
+    qspec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM)
+    rowvec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i),
+                          memory_space=pltpu.VMEM)
+    return qspec, kspec, rowvec
+
+
+def _flash_fwd(q, k, v, sc, causal, q_off, k_off, bq, bk, interpret):
+    b, lq_a, h, d = q.shape
+    lk_a = k.shape[1]
+    qf = _pad_rows(_flat(q).transpose(1, 0, 2), bq).transpose(1, 0, 2)
+    kf = _pad_rows(_flat(k).transpose(1, 0, 2), bk).transpose(1, 0, 2)
+    vf = _pad_rows(_flat(v).transpose(1, 0, 2), bk).transpose(1, 0, 2)
+    bh, lq, _ = qf.shape
+    lk = kf.shape[1]
+    qspec, kspec, rowvec = _specs(bq, bk, d)
+
+    kernel = functools.partial(
+        _fwd_kernel, bq=bq, bk=bk, sc=sc, causal=causal,
+        q_off=q_off, k_off=k_off, cols_actual=lk_a)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, lq // bq, lk // bk),
+        in_specs=[qspec, kspec, kspec],
+        out_specs=[qspec, rowvec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * lq * lk * d,
+            bytes_accessed=(bh * lq * d * 2
+                            + (lq // bq) * bh * lk * d * 2)
+            * q.dtype.itemsize,
+            transcendentals=bh * lq * lk,
+        ),
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = _unflat(o[:, :lq_a], b, h)
+    return out, (q, k, v, out, lse[:, :lq_a])
+
+
+def _flash_bwd(sc, causal, q_off, k_off, bq, bk, interpret, res, g):
+    q, k, v, out, lse = res
+    b, lq_a, h, d = q.shape
+    lk_a = k.shape[1]
+    qf = _pad_rows(_flat(q).transpose(1, 0, 2), bq).transpose(1, 0, 2)
+    kf = _pad_rows(_flat(k).transpose(1, 0, 2), bk).transpose(1, 0, 2)
+    vf = _pad_rows(_flat(v).transpose(1, 0, 2), bk).transpose(1, 0, 2)
+    dof = _pad_rows(_flat(g).transpose(1, 0, 2), bq).transpose(1, 0, 2)
+    bh, lq, _ = qf.shape
+    lk = kf.shape[1]
+    # delta_i = sum_d do_i o_i (the softmax-backward row correction) and
+    # the padded lse: cheap jnp preprocessing, O(L) memory.
+    delta = jnp.sum(_flat(g).astype(jnp.float32)
+                    * _flat(out).astype(jnp.float32), axis=-1)
+    deltaf = _pad_rows(delta.transpose(1, 0), bq).transpose(1, 0)
+    # Padded q rows: lse pads to 0, delta to 0, do to 0 -> p rows harmless.
+    lsef = _pad_rows(lse.transpose(1, 0), bq).transpose(1, 0)
+
+    qspec, kspec, rowvec = _specs(bq, bk, d)
+    common = dict(bq=bq, bk=bk, sc=sc, causal=causal, q_off=q_off,
+                  k_off=k_off, cols_actual=lk_a)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **common),
+        grid=(bh, lq // bq, lk // bk),
+        in_specs=[qspec, kspec, kspec, qspec, rowvec, rowvec],
+        out_specs=[qspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, lq, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)[0]
+
+    # dK/dV: home block is the kv block -> swap the inner grid axes so the
+    # q blocks visit; index maps follow (b, j, i) grid coordinates.
+    qspec_v = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    kspec_h = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                           memory_space=pltpu.VMEM)
+    rowvec_v = pl.BlockSpec((1, bq), lambda b, j, i: (b, i),
+                            memory_space=pltpu.VMEM)
+
+    def dkv_kernel(*refs):
+        return _dkv_kernel(*refs, **common)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, lk // bk, lq // bq),
+        in_specs=[qspec_v, kspec_h, kspec_h, qspec_v, rowvec_v, rowvec_v],
+        out_specs=[kspec_h, kspec_h],
+        out_shape=[jax.ShapeDtypeStruct((bh, lk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, lk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    return (_unflat(dq[:, :lq_a], b, h),
+            _unflat(dk[:, :lk_a], b, h),
+            _unflat(dv[:, :lk_a], b, h))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    q_offset: int = 0,
+    k_offset: int = 0,
+    block_q: int | None = None,
+    block_kv: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused blockwise attention: softmax(q k^T * scale) v on the MXU.
+
+    q, k, v: (B, L, H, D) (k/v may have a different L than q). Exact
+    forward and gradients (flash recompute backward); the (L, L) matrix
+    never exists in HBM. ``q_offset``/``k_offset`` give the blocks'
+    global positions for causal masking under sequence sharding. Drop-in
+    for ``parallel.ring_attention.attention_oracle`` and usable as a
+    ``LongContextTransformer.attention_fn``.
+    """
+    if (q.ndim != 4 or k.shape != v.shape or q.shape[::2] != k.shape[::2]
+            or q.shape[3] != k.shape[3]):
+        raise ValueError(
+            f"expected (B, L, H, D) q/k/v with shared B/H/D, got "
+            f"{q.shape} {k.shape} {v.shape}")
+    sc = resolve_attention_scale(scale, q.shape[-1])
+    bq, bk = _blocks(q.shape[1], k.shape[1], q.shape[-1], block_q, block_kv,
+                     jnp.dtype(q.dtype).itemsize)
+    if interpret is None:
+        interpret = _default_interpret()
+    return _flash(q, k, v, sc, causal, int(q_offset), int(k_offset),
+                  bq, bk, interpret)

@@ -43,12 +43,12 @@ compiler-skew hardening the loss kernels use.
 from __future__ import annotations
 
 import functools
-import math
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops.attention_pallas import resolve_attention_scale as _resolve_scale
 from ..ops.ntxent_pallas import _exp0, _log_l
 
 __all__ = [
@@ -59,10 +59,6 @@ __all__ = [
 ]
 
 _NEG_INF = -1e30
-
-
-def _resolve_scale(scale, head_dim) -> float:
-    return float(scale) if scale is not None else 1.0 / math.sqrt(head_dim)
 
 
 def attention_oracle(q, k, v, *, causal: bool = False, scale=None,

@@ -89,6 +89,12 @@ run_step 1200 tpu_tests "$OUT/pytest_tpu_tier.txt" \
     python -m pytest tests/ -m tpu -q --no-header || true
 commit_art "on-chip capture: TPU-gated pytest tier" "$OUT/" || true
 
+# 5b. Flash-attention A/B: fused Pallas kernel vs XLA's own fusion over
+#     the long-context L ladder (the attention_pallas.py design decision).
+run_step 1500 attention_ab - python benchmarks/bench_attention.py \
+    --out "$OUT/attention_ab.json" || true
+commit_art "on-chip capture: flash-attention vs XLA A/B ladder" "$OUT/" || true
+
 # 6. Loader-vs-step timing: real disk reads feeding the step (SURVEY §7.4
 #    risk #4 — proves the input pipeline won't cap MFU).
 run_step 1500 loader - python scripts/loader_timing.py \

@@ -1,0 +1,119 @@
+"""Fused flash-attention kernels vs the full-softmax oracle.
+
+ops/attention_pallas.py runs here in interpret mode (exact, the debug
+oracle); tests pin forward AND all three gradients against
+attention_oracle, including causal masking, q/k position offsets, row
+padding (L not a block multiple), cross-attention lengths, and bf16.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ntxent_tpu.ops import flash_attention
+from ntxent_tpu.parallel import attention_oracle
+
+
+def qkv(rng, lq=24, lk=24, h=2, d=8, b=2):
+    kq, kk, kv = jax.random.split(rng, 3)
+    return (jax.random.normal(kq, (b, lq, h, d)) * 0.5,
+            jax.random.normal(kk, (b, lk, h, d)) * 0.5,
+            jax.random.normal(kv, (b, lk, h, d)) * 0.5)
+
+
+def assert_matches(fn, ref, args, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(np.asarray(fn(*args)),
+                               np.asarray(ref(*args)), rtol=rtol, atol=atol)
+    gf = jax.grad(lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2),
+                  argnums=(0, 1, 2))(*args)
+    gr = jax.grad(lambda *a: jnp.sum(ref(*a).astype(jnp.float32) ** 2),
+                  argnums=(0, 1, 2))(*args)
+    for got, want in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_oracle(rng, causal):
+    fn = functools.partial(flash_attention, causal=causal,
+                           block_q=8, block_kv=128)
+    ref = functools.partial(attention_oracle, causal=causal)
+    assert_matches(fn, ref, qkv(rng))
+
+
+def test_padded_rows_and_default_blocks(rng):
+    # L = 20 with the default block policy: q pads to the sublane multiple,
+    # kv to the lane multiple — padded keys masked, padded queries sliced.
+    assert_matches(flash_attention, attention_oracle, qkv(rng, lq=20, lk=20))
+
+
+def test_cross_attention_lengths(rng):
+    # Decoder-style: 16 queries over 40 keys (block-padded on both sides).
+    assert_matches(flash_attention, attention_oracle,
+                   qkv(rng, lq=16, lk=40))
+
+
+def test_position_offsets_match_sliced_oracle(rng):
+    """q_offset/k_offset reproduce a sequence-sharded causal slice: rows
+    [8:16) of a length-24 causal attention, computed standalone —
+    forward AND gradients (the backward kernels apply the offsets in
+    their own _causal_mask calls, which only this test exercises)."""
+    q, k, v = qkv(rng, lq=24, lk=24)
+    full = attention_oracle(q, k, v, causal=True)
+    part_fn = functools.partial(flash_attention, causal=True,
+                                q_offset=8, k_offset=0,
+                                block_q=8, block_kv=128)
+    part = part_fn(q[:, 8:16], k, v)
+    np.testing.assert_allclose(np.asarray(part), np.asarray(full[:, 8:16]),
+                               rtol=1e-5, atol=1e-6)
+
+    gp = jax.grad(lambda qs, kk, vv: jnp.sum(part_fn(qs, kk, vv) ** 2),
+                  argnums=(0, 1, 2))(q[:, 8:16], k, v)
+    go = jax.grad(
+        lambda qq, kk, vv: jnp.sum(
+            attention_oracle(qq, kk, vv, causal=True)[:, 8:16] ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(go[0][:, 8:16]),
+                               rtol=1e-4, atol=1e-5)
+    for got, want in zip(gp[1:], go[1:]):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_finite_and_close(rng):
+    q, k, v = qkv(rng)
+    out = flash_attention(q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                          v.astype(jnp.bfloat16))
+    assert out.dtype == jnp.bfloat16
+    assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(attention_oracle(q, k, v)),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_rejects_bad_shapes(rng):
+    q, k, v = qkv(rng)
+    with pytest.raises(ValueError, match="expected"):
+        flash_attention(q[:, :, :1], k, v)  # mismatched heads
+
+
+def test_as_long_context_plan(rng):
+    """flash_attention slots into LongContextTransformer.attention_fn and
+    reproduces the oracle plan's outputs on one parameter tree."""
+    from ntxent_tpu.models import LongContextTransformer
+
+    def build(fn):
+        return LongContextTransformer(
+            vocab_size=32, hidden_dim=16, depth=1, num_heads=2,
+            mlp_dim=32, max_len=24, dtype=jnp.float32, attention_fn=fn)
+
+    tokens = jax.random.randint(rng, (2, 24), 0, 32)
+    params = build(attention_oracle).init(jax.random.PRNGKey(0), tokens)
+    want = build(attention_oracle).apply(params, tokens)
+    got = build(functools.partial(flash_attention, block_q=8)).apply(
+        params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)

@@ -115,6 +115,19 @@ def test_infonce_dual_matches_oracle_on_device(rng):
                                rtol=1e-4, atol=1e-6)
 
 
+def test_flash_attention_matches_oracle_on_device(rng):
+    from ntxent_tpu.ops import flash_attention
+    from ntxent_tpu.parallel import attention_oracle
+
+    ks = jax.random.split(rng, 3)
+    q, k, v = (jax.random.normal(kk, (2, 256, 4, 64)) * 0.5 for kk in ks)
+    out = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal=True))(
+        q, k, v)
+    ref = attention_oracle(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_autotune_live_sweep_caches_winner():
     """The measured sweep (ops/autotune.py) on its real backend: it has run
     exactly once un-asserted before this test existed, yet gates bench.py's
