@@ -70,11 +70,13 @@ def _pad_mask(s, j, bk, cols_actual):
     return jnp.where(kpos >= cols_actual, _NEG_INF, s)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
-                bq, bk, sc, causal, q_off, k_off, cols_actual):
+def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s,
+                acc_s, *, bq, bk, sc, causal, cols_actual):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
+    q_off = offs_ref[0, 0]
+    k_off = offs_ref[0, 1]
 
     @pl.when(j == 0)
     def _():
@@ -120,11 +122,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         lse_ref[0] = (m_s[:] + _log_l(l_s[:]))[:, 0]
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_s, *, bq, bk, sc, causal, q_off, k_off, cols_actual):
+def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_s, *, bq, bk, sc, causal, cols_actual):
     i = pl.program_id(1)
     j = pl.program_id(2)
     nj = pl.num_programs(2)
+    q_off = offs_ref[0, 0]
+    k_off = offs_ref[0, 1]
 
     @pl.when(j == 0)
     def _():
@@ -159,12 +163,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_s, dv_s, *, bq, bk, sc, causal,
-                q_off, k_off, cols_actual):
+                cols_actual):
     j = pl.program_id(1)   # home kv block
     i = pl.program_id(2)   # visiting q block
     ni = pl.num_programs(2)
+    q_off = offs_ref[0, 0]
+    k_off = offs_ref[0, 1]
 
     @pl.when(i == 0)
     def _():
@@ -208,6 +214,174 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
 
 
+def _fold_kernel(offs_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,
+                 m_out, l_out, acc_out, *, bq, bk, sc, causal, cols_actual):
+    """One flash fold with CARRIED statistics: (m, l, acc) arrive as
+    inputs (a previous fold's — or ring hop's — running state), are
+    updated with this call's K/V, and leave as outputs. The ring
+    attention hot path: each ppermute hop is one of these calls, so the
+    across-hop softmax state never re-normalizes and the final
+    ``out = acc / l`` is exact regardless of hop order."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    q_off = offs_ref[0, 0]
+    k_off = offs_ref[0, 1]
+
+    @pl.when(j == 0)
+    def _():
+        m_out[:] = m_in[:]
+        l_out[:] = l_in[:]
+        acc_out[:] = acc_in[:]
+
+    def compute():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sc
+        s = _pad_mask(s, j, bk, cols_actual)
+        if causal:
+            s = _causal_mask(s, i, j, bq, bk, q_off, k_off)
+        m_old = m_out[0][:, None]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(s <= _NEG_INF * 0.5, 0.0, _exp0(s - m_new))
+        alpha = _exp0(m_old - m_new)
+        l_out[0] = (l_out[0][:, None] * alpha
+                    + jnp.sum(p, axis=1, keepdims=True))[:, 0]
+        acc_out[0] = acc_out[0] * alpha + jax.lax.dot(
+            p.astype(v_ref.dtype), v_ref[0],
+            preferred_element_type=jnp.float32)
+        m_out[0] = m_new[:, 0]
+
+    if causal:
+        pl.when(_tile_live(i, j, bq, bk, q_off, k_off))(compute)
+    else:
+        compute()
+
+
+def flash_fold(qf, kf, vf, m, l, acc, *, q_offset, k_offset,
+               scale, causal=False, block_q=None, block_kv=None,
+               cols_actual=None, interpret=None):
+    """Fold one K/V segment into running flash statistics (flattened
+    (BH, L, D) layout; caller pads L to block multiples).
+
+    The building block of the fused ring attention
+    (parallel/ring_attention.py, impl="flash"): state (m, l: (BH, Lq)
+    fp32; acc: (BH, Lq, D) fp32) threads through successive calls —
+    offsets are TRACED, so a device-dependent ring hop can mask
+    causally against global positions. Row padding to block multiples is
+    handled here: padded keys are masked, padded query rows' stats are
+    sliced away before returning.
+    """
+    bh, lq_a, d = qf.shape
+    lk_a = kf.shape[1]
+    bq, bk = _blocks(lq_a, lk_a, d, block_q, block_kv,
+                     jnp.dtype(qf.dtype).itemsize)
+    if interpret is None:
+        interpret = _default_interpret()
+    qf = _pad_axis1(qf, bq)
+    kf, vf = _pad_axis1(kf, bk), _pad_axis1(vf, bk)
+    m, l, acc = _pad_axis1(m, bq), _pad_axis1(l, bq), _pad_axis1(acc, bq)
+    lq, lk = qf.shape[1], kf.shape[1]
+    offspec, qspec, kspec, rowvec = _specs(bq, bk, d)
+    kernel = functools.partial(
+        _fold_kernel, bq=bq, bk=bk, sc=scale, causal=causal,
+        cols_actual=lk_a if cols_actual is None else cols_actual)
+    m, l, acc = pl.pallas_call(
+        kernel,
+        grid=(bh, lq // bq, lk // bk),
+        in_specs=[offspec, qspec, kspec, kspec, rowvec, rowvec, qspec],
+        out_specs=[rowvec, rowvec, qspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(_offs_arr(q_offset, k_offset), qf, kf, vf, m, l, acc)
+    return m[:, :lq_a], l[:, :lq_a], acc[:, :lq_a]
+
+
+def flash_dq_hop(qf, kf, vf, dof, lsef, deltaf, *, q_offset, k_offset,
+                 scale, causal=False, block_q=None, block_kv=None,
+                 cols_actual=None, interpret=None):
+    """This K/V segment's contribution to dQ (flattened layout, fp32) —
+    the per-hop unit of the fused ring backward; caller sums over hops."""
+    bh, lq_a, d = qf.shape
+    lk_a = kf.shape[1]
+    bq, bk = _blocks(lq_a, lk_a, d, block_q, block_kv,
+                     jnp.dtype(qf.dtype).itemsize)
+    if interpret is None:
+        interpret = _default_interpret()
+    qf, dof = _pad_axis1(qf, bq), _pad_axis1(dof, bq)
+    kf, vf = _pad_axis1(kf, bk), _pad_axis1(vf, bk)
+    lsef, deltaf = _pad_axis1(lsef, bq), _pad_axis1(deltaf, bq)
+    lq, lk = qf.shape[1], kf.shape[1]
+    offspec, qspec, kspec, rowvec = _specs(bq, bk, d)
+    common = dict(bq=bq, bk=bk, sc=scale, causal=causal,
+                  cols_actual=lk_a if cols_actual is None else cols_actual)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **common),
+        grid=(bh, lq // bq, lk // bk),
+        in_specs=[offspec, qspec, kspec, kspec, qspec, rowvec, rowvec],
+        out_specs=[pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((bh, lq, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(_offs_arr(q_offset, k_offset), qf, kf, vf, dof, lsef, deltaf)[0]
+    return dq[:, :lq_a]
+
+
+def flash_dkv_hop(qf, kf, vf, dof, lsef, deltaf, *, q_offset, k_offset,
+                  scale, causal=False, block_q=None, block_kv=None,
+                  cols_actual=None, interpret=None):
+    """The local rows' contribution to this visiting K/V segment's
+    (dK, dV) (flattened layout, fp32) — circulated home by the ring."""
+    bh, lq_a, d = qf.shape
+    lk_a = kf.shape[1]
+    bq, bk = _blocks(lq_a, lk_a, d, block_q, block_kv,
+                     jnp.dtype(qf.dtype).itemsize)
+    if interpret is None:
+        interpret = _default_interpret()
+    qf, dof = _pad_axis1(qf, bq), _pad_axis1(dof, bq)
+    kf, vf = _pad_axis1(kf, bk), _pad_axis1(vf, bk)
+    lsef, deltaf = _pad_axis1(lsef, bq), _pad_axis1(deltaf, bq)
+    lq, lk = qf.shape[1], kf.shape[1]
+    common = dict(bq=bq, bk=bk, sc=scale, causal=causal,
+                  cols_actual=lk_a if cols_actual is None else cols_actual)
+    offspec_v = pl.BlockSpec((1, 2), lambda b, j, i: (0, 0),
+                             memory_space=pltpu.SMEM)
+    qspec_v = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    kspec_h = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                           memory_space=pltpu.VMEM)
+    rowvec_v = pl.BlockSpec((1, bq), lambda b, j, i: (b, i),
+                            memory_space=pltpu.VMEM)
+
+    def dkv_kernel(*refs):
+        return _dkv_kernel(*refs, **common)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, lk // bk, lq // bq),
+        in_specs=[offspec_v, qspec_v, kspec_h, kspec_h, qspec_v, rowvec_v,
+                  rowvec_v],
+        out_specs=[kspec_h, kspec_h],
+        out_shape=[jax.ShapeDtypeStruct((bh, lk, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, lk, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(_offs_arr(q_offset, k_offset), qf, kf, vf, dof, lsef, deltaf)
+    return dk[:, :lk_a], dv[:, :lk_a]
+
+
+def _pad_axis1(x, mult):
+    perm = (1, 0) if x.ndim == 2 else (1, 0, 2)
+    return _pad_rows(x.transpose(*perm), mult).transpose(*perm)
+
+
 def _flat(x):
     b, l, h, d = x.shape
     return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
@@ -246,32 +420,40 @@ def _flash(q, k, v, sc, causal, q_off, k_off, bq, bk, interpret):
 
 
 def _specs(bq, bk, d):
+    offspec = pl.BlockSpec((1, 2), lambda b, i, j: (0, 0),
+                           memory_space=pltpu.SMEM)
     qspec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM)
     rowvec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i),
                           memory_space=pltpu.VMEM)
-    return qspec, kspec, rowvec
+    return offspec, qspec, kspec, rowvec
+
+
+def _offs_arr(q_off, k_off):
+    return jnp.stack(
+        [jnp.asarray(q_off, jnp.int32),
+         jnp.asarray(k_off, jnp.int32)]).reshape(1, 2)
 
 
 def _flash_fwd(q, k, v, sc, causal, q_off, k_off, bq, bk, interpret):
     b, lq_a, h, d = q.shape
     lk_a = k.shape[1]
-    qf = _pad_rows(_flat(q).transpose(1, 0, 2), bq).transpose(1, 0, 2)
-    kf = _pad_rows(_flat(k).transpose(1, 0, 2), bk).transpose(1, 0, 2)
-    vf = _pad_rows(_flat(v).transpose(1, 0, 2), bk).transpose(1, 0, 2)
+    qf = _pad_axis1(_flat(q), bq)
+    kf = _pad_axis1(_flat(k), bk)
+    vf = _pad_axis1(_flat(v), bk)
     bh, lq, _ = qf.shape
     lk = kf.shape[1]
-    qspec, kspec, rowvec = _specs(bq, bk, d)
+    offspec, qspec, kspec, rowvec = _specs(bq, bk, d)
 
     kernel = functools.partial(
         _fwd_kernel, bq=bq, bk=bk, sc=sc, causal=causal,
-        q_off=q_off, k_off=k_off, cols_actual=lk_a)
+        cols_actual=lk_a)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, lq // bq, lk // bk),
-        in_specs=[qspec, kspec, kspec],
+        in_specs=[offspec, qspec, kspec, kspec],
         out_specs=[qspec, rowvec],
         out_shape=[
             jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
@@ -290,70 +472,29 @@ def _flash_fwd(q, k, v, sc, causal, q_off, k_off, bq, bk, interpret):
             transcendentals=bh * lq * lk,
         ),
         interpret=interpret,
-    )(qf, kf, vf)
+    )(_offs_arr(q_off, k_off), qf, kf, vf)
     out = _unflat(o[:, :lq_a], b, h)
     return out, (q, k, v, out, lse[:, :lq_a])
 
 
 def _flash_bwd(sc, causal, q_off, k_off, bq, bk, interpret, res, g):
+    # ONE backward implementation: the hop wrappers (flash_dq_hop /
+    # flash_dkv_hop) own the padding and pallas_call wiring; the
+    # single-chip backward is simply the one-hop case.
     q, k, v, out, lse = res
-    b, lq_a, h, d = q.shape
-    lk_a = k.shape[1]
-    qf = _pad_rows(_flat(q).transpose(1, 0, 2), bq).transpose(1, 0, 2)
-    kf = _pad_rows(_flat(k).transpose(1, 0, 2), bk).transpose(1, 0, 2)
-    vf = _pad_rows(_flat(v).transpose(1, 0, 2), bk).transpose(1, 0, 2)
-    dof = _pad_rows(_flat(g).transpose(1, 0, 2), bq).transpose(1, 0, 2)
-    bh, lq, _ = qf.shape
-    lk = kf.shape[1]
-    # delta_i = sum_d do_i o_i (the softmax-backward row correction) and
-    # the padded lse: cheap jnp preprocessing, O(L) memory.
-    delta = jnp.sum(_flat(g).astype(jnp.float32)
-                    * _flat(out).astype(jnp.float32), axis=-1)
-    deltaf = _pad_rows(delta.transpose(1, 0), bq).transpose(1, 0)
-    # Padded q rows: lse pads to 0, delta to 0, do to 0 -> p rows harmless.
-    lsef = _pad_rows(lse.transpose(1, 0), bq).transpose(1, 0)
-
-    qspec, kspec, rowvec = _specs(bq, bk, d)
-    common = dict(bq=bq, bk=bk, sc=sc, causal=causal, q_off=q_off,
-                  k_off=k_off, cols_actual=lk_a)
-
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, **common),
-        grid=(bh, lq // bq, lk // bk),
-        in_specs=[qspec, kspec, kspec, qspec, rowvec, rowvec],
-        out_specs=[qspec],
-        out_shape=[jax.ShapeDtypeStruct((bh, lq, d), q.dtype)],
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        interpret=interpret,
-    )(qf, kf, vf, dof, lsef, deltaf)[0]
-
-    # dK/dV: home block is the kv block -> swap the inner grid axes so the
-    # q blocks visit; index maps follow (b, j, i) grid coordinates.
-    qspec_v = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
-                           memory_space=pltpu.VMEM)
-    kspec_h = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
-                           memory_space=pltpu.VMEM)
-    rowvec_v = pl.BlockSpec((1, bq), lambda b, j, i: (b, i),
-                            memory_space=pltpu.VMEM)
-
-    def dkv_kernel(*refs):
-        return _dkv_kernel(*refs, **common)
-
-    dk, dv = pl.pallas_call(
-        dkv_kernel,
-        grid=(bh, lk // bk, lq // bq),
-        in_specs=[qspec_v, kspec_h, kspec_h, qspec_v, rowvec_v, rowvec_v],
-        out_specs=[kspec_h, kspec_h],
-        out_shape=[jax.ShapeDtypeStruct((bh, lk, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, lk, d), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d), jnp.float32)],
-        interpret=interpret,
-    )(qf, kf, vf, dof, lsef, deltaf)
-
-    return (_unflat(dq[:, :lq_a], b, h),
-            _unflat(dk[:, :lk_a], b, h),
-            _unflat(dv[:, :lk_a], b, h))
+    b, _, h, _ = q.shape
+    qf, kf, vf, dof, outf = (_flat(x) for x in (q, k, v, g, out))
+    # delta_i = sum_d do_i o_i (the softmax-backward row correction):
+    # cheap jnp preprocessing, O(L) memory.
+    delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
+                    axis=-1)
+    kwargs = dict(q_offset=q_off, k_offset=k_off, scale=sc, causal=causal,
+                  block_q=bq, block_kv=bk, interpret=interpret)
+    dq = flash_dq_hop(qf, kf, vf, dof, lse, delta, **kwargs)
+    dk, dv = flash_dkv_hop(qf, kf, vf, dof, lse, delta, **kwargs)
+    return (_unflat(dq, b, h).astype(q.dtype),
+            _unflat(dk, b, h).astype(k.dtype),
+            _unflat(dv, b, h).astype(v.dtype))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention_pallas import resolve_attention_scale as _resolve_scale
+from ..ops.attention_pallas import _flat, _unflat
 from ..ops.ntxent_pallas import _exp0, _log_l
 
 __all__ = [
@@ -59,6 +60,12 @@ __all__ = [
 ]
 
 _NEG_INF = -1e30
+
+
+def _varying(x, axis):
+    """Mark a device-invariant init as ring-varying (scan carries must
+    agree in varying-ness with the values ppermute makes device-local)."""
+    return jax.lax.pcast(x, (axis,), to="varying")
 
 
 def attention_oracle(q, k, v, *, causal: bool = False, scale=None,
@@ -169,14 +176,11 @@ def _ring_fwd(q, k, v, axis, num_devices, causal, sc):
     qpos = _positions(axis, l_loc)
     q_ = q.astype(jnp.float32).transpose(0, 2, 1, 3)  # (B, H, Lq, D)
 
-    def varying(x):
-        return jax.lax.pcast(x, (axis,), to="varying")
-
     init = (
         k, v, qpos,
-        varying(jnp.full((b, h, l_loc), _NEG_INF, jnp.float32)),
-        varying(jnp.zeros((b, h, l_loc), jnp.float32)),
-        varying(jnp.zeros((b, h, l_loc, d), jnp.float32)),
+        _varying(jnp.full((b, h, l_loc), _NEG_INF, jnp.float32), axis),
+        _varying(jnp.zeros((b, h, l_loc), jnp.float32), axis),
+        _varying(jnp.zeros((b, h, l_loc, d), jnp.float32), axis),
     )
 
     def step(carry, _):
@@ -208,14 +212,11 @@ def _ring_bwd(axis, num_devices, causal, sc, res, g):
     drow = jnp.sum(do * out.astype(jnp.float32).transpose(0, 2, 1, 3),
                    axis=-1)                               # (B, H, Lq)
 
-    def varying(x):
-        return jax.lax.pcast(x, (axis,), to="varying")
-
     init = (
         k, v, qpos,
-        varying(jnp.zeros((b, l_loc, h, d), jnp.float32)),  # dk acc
-        varying(jnp.zeros((b, l_loc, h, d), jnp.float32)),  # dv acc
-        varying(jnp.zeros((b, h, l_loc, d), jnp.float32)),  # dq acc (home)
+        _varying(jnp.zeros((b, l_loc, h, d), jnp.float32), axis),  # dk
+        _varying(jnp.zeros((b, l_loc, h, d), jnp.float32), axis),  # dv
+        _varying(jnp.zeros((b, h, l_loc, d), jnp.float32), axis),  # dq home
     )
 
     def step(carry, _):
@@ -248,19 +249,125 @@ def _ring_bwd(axis, num_devices, causal, sc, res, g):
 _ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
+# --- Fused (Pallas) ring: flash folds per hop, kernel-grade hot path ---
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_attention_flash(q, k, v, axis, num_devices, causal, sc):
+    """Ring attention whose per-hop fold runs the fused flash kernel
+    (ops/attention_pallas.py:flash_fold) — carried (m, l, acc) statistics
+    thread through the hops, so the across-hop softmax is exact and the
+    (L_loc, L_loc) tile work happens on the MXU with VMEM statistics.
+    The backward is the same second ring pass as the jnp form, but each
+    hop's contribution comes from the flash dQ / dK-dV kernels."""
+    return _ring_flash_fwd(q, k, v, axis, num_devices, causal, sc)[0]
+
+
+def _ring_flash_fwd(q, k, v, axis, num_devices, causal, sc):
+    from ..ops.attention_pallas import flash_fold
+
+    b, l_loc, h, d = q.shape
+    bh = b * h
+    perm = _hop_perm(axis, num_devices)
+    q_off = jax.lax.axis_index(axis) * l_loc
+    qf = _flat(q)
+
+    init = (
+        _flat(k), _flat(v),
+        (jax.lax.axis_index(axis) * l_loc).reshape(1),
+        _varying(jnp.full((bh, l_loc), _NEG_INF, jnp.float32), axis),
+        _varying(jnp.zeros((bh, l_loc), jnp.float32), axis),
+        _varying(jnp.zeros((bh, l_loc, d), jnp.float32), axis),
+    )
+
+    def step(carry, _):
+        kf, vf, k_off, m, l, acc = carry
+        m, l, acc = flash_fold(qf, kf, vf, m, l, acc,
+                               q_offset=q_off, k_offset=k_off[0],
+                               scale=sc, causal=causal)
+        kf = jax.lax.ppermute(kf, axis, perm)
+        vf = jax.lax.ppermute(vf, axis, perm)
+        k_off = jax.lax.ppermute(k_off, axis, perm)
+        return (kf, vf, k_off, m, l, acc), None
+
+    (_, _, _, m, l, acc), _ = jax.lax.scan(step, init, None,
+                                           length=num_devices)
+    lse = m + _log_l(l)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = _unflat((acc / l_safe[..., None]).astype(q.dtype), b, h)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis, num_devices, causal, sc, res, g):
+    from ..ops.attention_pallas import flash_dkv_hop, flash_dq_hop
+
+    q, k, v, out, lse = res
+    b, l_loc, h, d = q.shape
+    bh = b * h
+    perm = _hop_perm(axis, num_devices)
+    q_off = jax.lax.axis_index(axis) * l_loc
+    qf, dof, outf = _flat(q), _flat(g), _flat(out)
+    delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
+                    axis=-1)
+
+    init = (
+        _flat(k), _flat(v),
+        (jax.lax.axis_index(axis) * l_loc).reshape(1),
+        _varying(jnp.zeros((bh, l_loc, d), jnp.float32), axis),  # dk
+        _varying(jnp.zeros((bh, l_loc, d), jnp.float32), axis),  # dv
+        _varying(jnp.zeros((bh, l_loc, d), jnp.float32), axis),  # dq home
+    )
+
+    def step(carry, _):
+        kf, vf, k_off, dkf, dvf, dqf = carry
+        kwargs = dict(q_offset=q_off, k_offset=k_off[0], scale=sc,
+                      causal=causal)
+        dqf = dqf + flash_dq_hop(qf, kf, vf, dof, lse, delta, **kwargs)
+        dkc, dvc = flash_dkv_hop(qf, kf, vf, dof, lse, delta, **kwargs)
+        dkf, dvf = dkf + dkc, dvf + dvc
+        kf = jax.lax.ppermute(kf, axis, perm)
+        vf = jax.lax.ppermute(vf, axis, perm)
+        k_off = jax.lax.ppermute(k_off, axis, perm)
+        dkf = jax.lax.ppermute(dkf, axis, perm)
+        dvf = jax.lax.ppermute(dvf, axis, perm)
+        return (kf, vf, k_off, dkf, dvf, dqf), None
+
+    (_, _, _, dkf, dvf, dqf), _ = jax.lax.scan(step, init, None,
+                                               length=num_devices)
+    return (_unflat(dqf, b, h).astype(q.dtype),
+            _unflat(dkf, b, h).astype(k.dtype),
+            _unflat(dvf, b, h).astype(v.dtype))
+
+
+_ring_attention_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def make_ring_attention(mesh: Mesh, axis: str = "data", *,
-                        causal: bool = False, scale=None):
+                        causal: bool = False, scale=None,
+                        impl: str = "jnp"):
     """Build a jit-able sequence-parallel ring attention over ``mesh``.
 
     Returns ``fn(q, k, v) -> out`` with all four (B, L, H, D) and L
     sharded over ``axis`` (L % P == 0). ``causal`` masks with GLOBAL
     positions, so the sharded form equals the oracle on the full
     sequence. Exact gradients for q, k, v via the second-ring-pass VJP.
+
+    ``impl="jnp"`` folds hops with XLA ops (runs everywhere);
+    ``impl="flash"`` runs the fused Pallas flash kernels per hop
+    (carried-statistics folds forward, flash dQ/dK-dV kernels in the
+    backward ring) — the TPU hot path; interpret-mode (exact, slow)
+    off-TPU. The two are the same function; on-chip A/B decides the
+    production default.
     """
+    if impl not in ("jnp", "flash"):
+        raise ValueError(f"unknown ring attention impl {impl!r}")
     num_devices = mesh.shape[axis]
 
     def body(q, k, v):
         sc = _resolve_scale(scale, q.shape[-1])
+        if impl == "flash":
+            return _ring_attention_flash(q, k, v, axis, num_devices,
+                                         causal, sc)
         return _ring_attention(q, k, v, axis, num_devices, causal, sc)
 
     return jax.shard_map(

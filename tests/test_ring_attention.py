@@ -119,6 +119,27 @@ def test_ulysses_rejects_indivisible_heads(rng, mesh):
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("causal", [False, True])
+@needs_mesh
+def test_ring_flash_impl_matches_oracle(rng, qkv, mesh, causal):
+    """impl='flash' — the fused Pallas ring (carried-statistics folds
+    forward, flash dQ/dK-dV kernels in the backward ring) — is the same
+    function as the oracle, every gradient included; both kernel
+    branches (causal tile-skip and the unconditional fold) covered.
+    Slow tier: interpret-mode Pallas inside an 8-hop scan."""
+    import functools
+
+    fn = make_ring_attention(mesh, causal=causal, impl="flash")
+    ref = functools.partial(attention_oracle, causal=causal)
+    assert_same_fn(fn, ref, qkv)
+
+
+def test_ring_rejects_unknown_impl(mesh):
+    with pytest.raises(ValueError, match="unknown"):
+        make_ring_attention(mesh, impl="nope")
+
+
+@pytest.mark.slow
 @needs_mesh
 def test_ring_memory_never_gathers_kv(mesh):
     """The ring's compiled temp memory must stay below the gather-style
