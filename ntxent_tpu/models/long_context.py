@@ -27,15 +27,30 @@ params, fp32 LayerNorm, pre-norm blocks.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ..parallel.ring_attention import attention_oracle
 from .vit import MlpBlock
 
 AttentionFn = Callable[..., jnp.ndarray]  # (q, k, v) -> out, all (B,L,H,D)
+
+
+def default_attention() -> AttentionFn:
+    """Backend auto-selection (same policy as the trainer's use_fused):
+    the fused flash kernel where it compiles natively (TPU), the exact
+    jnp oracle elsewhere (identical function; interpret-mode Pallas off
+    TPU is ~100x slower and measures nothing)."""
+    from ..utils.capability import is_tpu_backend
+
+    if is_tpu_backend():
+        from ..ops.attention_pallas import flash_attention
+
+        return flash_attention
+    return attention_oracle
 
 
 class SeqParallelSelfAttention(nn.Module):
@@ -48,7 +63,7 @@ class SeqParallelSelfAttention(nn.Module):
 
     num_heads: int
     dtype: jnp.dtype = jnp.bfloat16
-    attention_fn: AttentionFn = attention_oracle
+    attention_fn: Optional[AttentionFn] = None  # None -> default_attention()
 
     @nn.compact
     def __call__(self, x):
@@ -63,7 +78,8 @@ class SeqParallelSelfAttention(nn.Module):
                 (self.num_heads, head_dim), axis=-1, dtype=self.dtype,
                 param_dtype=jnp.float32, name=name)(x)
 
-        out = self.attention_fn(proj("query"), proj("key"), proj("value"))
+        attention_fn = self.attention_fn or default_attention()
+        out = attention_fn(proj("query"), proj("key"), proj("value"))
         return nn.DenseGeneral(
             hidden, axis=(-2, -1), dtype=self.dtype,
             param_dtype=jnp.float32, name="out")(out)
@@ -73,7 +89,7 @@ class LongContextBlock(nn.Module):
     num_heads: int
     mlp_dim: int
     dtype: jnp.dtype = jnp.bfloat16
-    attention_fn: AttentionFn = attention_oracle
+    attention_fn: Optional[AttentionFn] = None
 
     @nn.compact
     def __call__(self, x):
@@ -101,7 +117,7 @@ class LongContextTransformer(nn.Module):
     mlp_dim: int = 2048
     max_len: int = 32768
     dtype: jnp.dtype = jnp.bfloat16
-    attention_fn: AttentionFn = attention_oracle
+    attention_fn: Optional[AttentionFn] = None  # None -> default_attention()
 
     @nn.compact
     def __call__(self, tokens):

@@ -135,7 +135,8 @@ def autotune_blocks(
     from .blocks import choose_blocks
     from .ntxent_pallas import ntxent_loss_fused
 
-    if jax.default_backend() not in ("tpu", "axon"):
+    from ..utils.capability import is_tpu_backend
+    if not is_tpu_backend():
         return choose_blocks(rows, cols, dim, dtype)
 
     key = (f"v{_PROTOCOL_VERSION}", rows, cols, dim, jnp.dtype(dtype).str,

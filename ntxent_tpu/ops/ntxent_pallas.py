@@ -81,8 +81,9 @@ def _log_l(l):
 
 
 def _default_interpret() -> bool:
-    platform = jax.devices()[0].platform
-    return platform not in ("tpu", "axon")
+    from ..utils.capability import is_tpu_backend
+
+    return not is_tpu_backend(jax.devices()[0].platform)
 
 
 def _tile_ids(i, j, br: int, bc: int):

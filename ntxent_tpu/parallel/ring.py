@@ -204,7 +204,8 @@ def make_ring_ntxent(mesh: Mesh, temperature: float = 0.07,
     choice under interpret mode); "auto" picks by backend.
     """
     if impl == "auto":
-        impl = "fused" if jax.default_backend() in ("tpu", "axon") else "jnp"
+        from ..utils.capability import is_tpu_backend
+        impl = "fused" if is_tpu_backend() else "jnp"
     if impl not in ("fused", "jnp"):
         raise ValueError(f"impl must be 'auto', 'fused' or 'jnp', got "
                          f"{impl!r}")

@@ -66,7 +66,9 @@ def to_torch(x: jax.Array) -> torch.Tensor:
 def _loss_fn(z: jax.Array, temperature: float) -> jax.Array:
     # Fused Pallas kernel where it compiles natively; jnp oracle elsewhere
     # (interpret-mode Pallas on CPU would be needlessly slow).
-    if jax.default_backend() in ("tpu", "axon"):
+    from .utils.capability import is_tpu_backend
+
+    if is_tpu_backend():
         return ntxent_loss_fused(z, temperature)
     return ntxent_loss(z, temperature)
 

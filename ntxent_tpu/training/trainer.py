@@ -127,7 +127,9 @@ def make_train_step(temperature: float = 0.1,
     (TrainerConfig.remat).
     """
     if use_fused is None:
-        use_fused = jax.default_backend() in ("tpu", "axon")
+        from ..utils.capability import is_tpu_backend
+
+        use_fused = is_tpu_backend()
     if use_fused:
         loss_impl = ntxent_loss_fused
     else:
@@ -174,7 +176,9 @@ def make_clip_train_step(use_fused: bool | None = None,
     (GSPMD) and the ring/all-gather InfoNCE losses (parallel/).
     """
     if use_fused is None:
-        use_fused = jax.default_backend() in ("tpu", "axon")
+        from ..utils.capability import is_tpu_backend
+
+        use_fused = is_tpu_backend()
     if use_fused:
         from ..ops.infonce_pallas import info_nce_fused as _nce
 

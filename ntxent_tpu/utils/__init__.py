@@ -1,5 +1,6 @@
 from ntxent_tpu.utils.capability import (
     check_tensor_core_support,
+    is_tpu_backend,
     device_kind,
     has_mxu,
     supports_bf16_matmul,

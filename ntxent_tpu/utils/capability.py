@@ -15,6 +15,7 @@ import jax
 
 __all__ = [
     "check_tensor_core_support",
+    "is_tpu_backend",
     "device_kind",
     "has_mxu",
     "supports_bf16_matmul",
@@ -45,6 +46,14 @@ def has_mxu(backend: str | None = None) -> bool:
         except (TypeError, ValueError):
             return False
     return False
+
+
+def is_tpu_backend(backend: str | None = None) -> bool:
+    """THE fused-path predicate: does the (given or default) backend
+    compile Pallas kernels natively? 'tpu' on real hosts, 'axon' through
+    the tunnel plugin — one copy of this tuple, so adding/renaming a
+    backend cannot silently leave a caller on the ~100x interpret path."""
+    return (backend or jax.default_backend()) in ("tpu", "axon")
 
 
 def check_tensor_core_support() -> bool:

@@ -60,6 +60,24 @@ def test_blockwise_plan_matches_oracle(tokens, params):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_default_plan_auto_selects(tokens, params):
+    """attention_fn=None resolves by backend at CALL time: the fused
+    flash kernel where Pallas compiles natively, the jnp oracle
+    elsewhere — and either way the default model equals the explicit
+    oracle plan on the same parameter tree."""
+    from ntxent_tpu.models.long_context import default_attention
+    from ntxent_tpu.ops import flash_attention
+    from ntxent_tpu.parallel import attention_oracle
+    from ntxent_tpu.utils.capability import is_tpu_backend
+
+    expected = flash_attention if is_tpu_backend() else attention_oracle
+    assert default_attention() is expected
+    want = build(attention_oracle).apply(params, tokens)
+    got = build(None).apply(params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
 @needs_mesh
 @pytest.mark.parametrize("plan", ["ring", "ulysses"])
 def test_mesh_plans_match_oracle(tokens, params, plan):
