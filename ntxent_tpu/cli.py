@@ -44,6 +44,13 @@ def _add_common_args(p: argparse.ArgumentParser) -> None:
     m.add_argument("--model", default="resnet50", choices=MODEL_CHOICES)
     m.add_argument("--proj-hidden-dim", type=int, default=2048)
     m.add_argument("--proj-dim", type=int, default=128)
+    m.add_argument("--moe-experts", type=int, default=0,
+                   help="ViT models only: switch-MoE MLP with this many "
+                        "experts in every other block (parallel/moe.py); "
+                        "0 = dense MLPs")
+    m.add_argument("--moe-aux-weight", type=float, default=0.01,
+                   help="weight of the MoE load-balance aux loss when "
+                        "--moe-experts > 0 (Switch Transformer default)")
 
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None, metavar="cpu|tpu",
@@ -126,9 +133,11 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _make_encoder(name: str, image_size: int):
+def _make_encoder(name: str, image_size: int, moe_experts: int = 0):
     from ntxent_tpu import models
 
+    if moe_experts > 0 and not name.startswith("vit"):
+        raise SystemExit("--moe-experts requires a ViT model")
     if name == "tiny":
         return functools.partial(models.ResNet, stage_sizes=(1,),
                                  small_images=True)
@@ -142,6 +151,8 @@ def _make_encoder(name: str, image_size: int):
     enc = table[name]
     if name.startswith("resnet") and image_size <= 64:
         enc = functools.partial(enc, small_images=True)
+    if moe_experts > 0:
+        enc = functools.partial(enc, moe_experts=moe_experts)
     return enc
 
 
@@ -221,6 +232,9 @@ def main(argv=None) -> int:
             logger.warning("--dp-loss %s ignored: the CLIP objective uses "
                            "the InfoNCE loss family (see --clip-parallel)",
                            args.dp_loss)
+        if args.moe_experts > 0:
+            logger.warning("--moe-experts ignored: MoE towers are wired for "
+                           "the simclr objective only")
         return _train_clip(args, info, per_process_batch)
     if args.image_size is None:
         args.image_size = 224 if args.dataset == "imagefolder" else 32
@@ -233,10 +247,12 @@ def main(argv=None) -> int:
     )
     from ntxent_tpu.training.trainer import make_sharded_train_step
 
-    encoder = _make_encoder(args.model, args.image_size)
+    encoder = _make_encoder(args.model, args.image_size,
+                            moe_experts=args.moe_experts)
     model = SimCLRModel(encoder=encoder,
                         proj_hidden_dim=args.proj_hidden_dim,
                         proj_dim=args.proj_dim)
+    moe_aux = args.moe_aux_weight if args.moe_experts > 0 else 0.0
     cfg = TrainerConfig(
         batch_size=args.batch, temperature=args.temperature,
         base_lr=args.base_lr, weight_decay=args.weight_decay,
@@ -253,7 +269,8 @@ def main(argv=None) -> int:
         mesh = create_mesh(axis_names=("data",))
         step = make_sharded_train_step(mesh, cfg.temperature,
                                        remat=args.remat,
-                                       loss_impl=args.dp_loss)
+                                       loss_impl=args.dp_loss,
+                                       moe_aux_weight=moe_aux)
         # Commit params/opt-state replicated on the mesh BEFORE fit's
         # checkpoint restore: a fresh template restores committed to one
         # device and the sharded step then rejects the device mismatch.
@@ -269,7 +286,8 @@ def main(argv=None) -> int:
         if args.dp_loss != "strip":
             logger.warning("--dp-loss %s ignored: single-device run has "
                            "no shard-pair schedule", args.dp_loss)
-        step = make_train_step(cfg.temperature, remat=args.remat)
+        step = make_train_step(cfg.temperature, remat=args.remat,
+                               moe_aux_weight=moe_aux)
         data = _make_pipeline(args, per_process_batch)
         logger.info("single-device run")
 
@@ -646,7 +664,8 @@ def eval_main(argv=None) -> int:
         template = TrainState.create(apply_fn=model.apply,
                                      params=variables0["params"], tx=tx)
     else:
-        encoder = _make_encoder(args.model, args.image_size)
+        encoder = _make_encoder(args.model, args.image_size,
+                                moe_experts=args.moe_experts)
         model = SimCLRModel(encoder=encoder,
                             proj_hidden_dim=args.proj_hidden_dim,
                             proj_dim=args.proj_dim)

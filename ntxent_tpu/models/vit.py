@@ -32,6 +32,7 @@ class EncoderBlock(nn.Module):
     num_heads: int
     mlp_dim: int
     dtype: jnp.dtype
+    moe_experts: int = 0  # >0 swaps the dense MLP for a switch-MoE MLP
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -42,6 +43,11 @@ class EncoderBlock(nn.Module):
         )(y, y, mask=mask)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32)(x)
+        if self.moe_experts > 0:
+            from ntxent_tpu.parallel.moe import MoEMlp
+
+            return x + MoEMlp(num_experts=self.moe_experts,
+                              mlp_dim=self.mlp_dim, dtype=self.dtype)(y)
         return x + MlpBlock(self.mlp_dim, self.dtype)(y)
 
 
@@ -54,6 +60,9 @@ class VisionTransformer(nn.Module):
     num_heads: int = 12
     mlp_dim: int = 3072
     dtype: jnp.dtype = jnp.bfloat16
+    # Every-other-block switch-MoE (Switch Transformer layout) when > 0;
+    # aux losses surface under intermediates/…/moe_aux_loss.
+    moe_experts: int = 0
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -76,8 +85,9 @@ class VisionTransformer(nn.Module):
         x = x + pos.astype(self.dtype)
 
         for i in range(self.depth):
+            moe = self.moe_experts if i % 2 == 1 else 0
             x = EncoderBlock(self.num_heads, self.mlp_dim, self.dtype,
-                             name=f"block_{i}")(x)
+                             moe_experts=moe, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x)
         return x[:, 0].astype(jnp.float32)  # CLS token
 

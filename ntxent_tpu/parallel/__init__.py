@@ -18,6 +18,17 @@ from ntxent_tpu.parallel.pair import (
     make_pair_ntxent,
     ntxent_loss_pair,
 )
+from ntxent_tpu.parallel.moe import (
+    MoEMlp,
+    init_moe_params,
+    make_expert_parallel_moe,
+    switch_moe,
+)
+from ntxent_tpu.parallel.pp import (
+    make_gpipe,
+    pipeline_stage_params,
+    stack_stage_params,
+)
 from ntxent_tpu.parallel.ring_attention import (
     attention_oracle,
     blockwise_attention,
@@ -47,6 +58,13 @@ __all__ = [
     "process_info",
     "make_pair_ntxent",
     "ntxent_loss_pair",
+    "make_gpipe",
+    "pipeline_stage_params",
+    "stack_stage_params",
+    "MoEMlp",
+    "init_moe_params",
+    "make_expert_parallel_moe",
+    "switch_moe",
     "replicate_state",
     "replicated_sharding",
     "make_sharded_ntxent",

@@ -1,0 +1,229 @@
+"""Expert parallelism: Switch-style MoE MLP with all-to-all dispatch.
+
+SURVEY.md §2.2 marked EP/Ulysses N/A for the reference — this module goes
+beyond parity and fills the ``ep`` slot of the dp/tp/pp/sp/ep matrix. The
+design follows the standard TPU MoE recipe (Switch Transformer / GShard):
+everything is a fixed-shape einsum so XLA can tile it onto the MXU, and the
+only communication is a pair of ``lax.all_to_all`` exchanges over the
+``expert`` mesh axis.
+
+* **Routing** is top-1 ("switch") with a static capacity
+  ``C = ceil(T / E * capacity_factor)``. A token's slot inside its expert
+  is its rank among same-expert tokens (cumsum of the one-hot assignment);
+  tokens past capacity are *dropped* — their combine weight is zero, so
+  they pass through the residual stream untouched. Static shapes mean no
+  data-dependent control flow inside jit.
+* **Dispatch/combine** are the mesh-tensorflow einsum formulation: a
+  ``(T, E, C)`` one-hot dispatch mask gathers token rows into an
+  ``(E, C, d)`` expert batch; the transpose einsum with gate-weighted
+  entries scatters expert outputs back. Both lower to MXU matmuls.
+* **Expert parallelism**: under ``shard_map`` with ``axis="expert"``, each
+  device routes its local tokens against all ``E`` experts, then one
+  tiled ``all_to_all`` re-shards the ``(E, C, d)`` expert batch from
+  token-sharded to expert-sharded — each device receives every device's
+  rows for its own ``E/P`` experts — the local expert FFNs run, and the
+  inverse ``all_to_all`` brings the rows home for the local combine. This
+  is exactly the dispatch pattern the Ulysses path uses for heads
+  (ring_attention.py), applied to experts.
+* **Load-balancing aux loss** (Switch eq. 4): ``E * sum_e f_e * p_e`` with
+  ``f_e`` the fraction of tokens routed to expert ``e`` and ``p_e`` the
+  mean router probability — differentiable through ``p_e`` only.
+
+``MoEMlp`` is the flax module (drop-in for the towers' dense ``MlpBlock``);
+``switch_moe`` is the pure functional core shared by the local and
+expert-parallel paths, so the EP test can assert shard == single-device.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["MoEParams", "init_moe_params", "switch_moe",
+           "make_expert_parallel_moe", "MoEMlp"]
+
+
+@dataclass(frozen=True)
+class MoEParams:
+    """Weights of one switch-MoE layer (E experts, width d, hidden f)."""
+
+    router: jax.Array  # (d, E)
+    w_up: jax.Array    # (E, d, f)
+    b_up: jax.Array    # (E, f)
+    w_down: jax.Array  # (E, f, d)
+    b_down: jax.Array  # (E, d)
+
+
+jax.tree_util.register_dataclass(
+    MoEParams, data_fields=["router", "w_up", "b_up", "w_down", "b_down"],
+    meta_fields=[])
+
+
+def init_moe_params(key, num_experts: int, d: int, mlp_dim: int,
+                    dtype=jnp.float32) -> MoEParams:
+    kr, ku, kd = jax.random.split(key, 3)
+    lecun = nn.initializers.lecun_normal()
+    return MoEParams(
+        router=lecun(kr, (d, num_experts), dtype),
+        w_up=lecun(ku, (num_experts, d, mlp_dim), dtype),
+        b_up=jnp.zeros((num_experts, mlp_dim), dtype),
+        w_down=lecun(kd, (num_experts, mlp_dim, d), dtype),
+        b_down=jnp.zeros((num_experts, d), dtype),
+    )
+
+
+def _route(x2d: jax.Array, router: jax.Array, capacity: int):
+    """Top-1 routing → (dispatch (T,E,C) bool, combine (T,E,C), aux loss).
+
+    Router math in fp32 regardless of activation dtype (softmax stability,
+    same policy as the towers' norms).
+    """
+    t, _ = x2d.shape
+    e = router.shape[1]
+    logits = x2d.astype(jnp.float32) @ router.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                    # (T, E)
+    expert = jnp.argmax(probs, axis=-1)                        # (T,)
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)      # (T, E)
+    gate = jnp.sum(probs * onehot, axis=-1)                    # (T,)
+    # Rank of each token within its expert (0-based), in token order.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot         # (T, E)
+    slot = jnp.sum(pos, axis=-1).astype(jnp.int32)             # (T,)
+    kept = slot < capacity
+    dispatch = (onehot * kept[:, None].astype(jnp.float32))[..., None] \
+        * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)[:, None, :]
+    combine = dispatch * gate[:, None, None]
+    # Per-expert token fraction and mean router prob (aux-loss inputs;
+    # the caller pmean's them over the mesh so sharded aux == global aux).
+    frac = jnp.mean(onehot, axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    return dispatch, combine, frac, mean_p
+
+
+def switch_moe(params: MoEParams, x: jax.Array, *,
+               capacity_factor: float = 1.25,
+               axis: str | None = None):
+    """Apply one switch-MoE layer; returns ``(y, aux_loss)``.
+
+    ``x`` is ``(..., d)``; leading axes are flattened into a token axis for
+    routing. With ``axis`` set, the call must be inside ``shard_map``:
+    experts are sharded over that mesh axis (``E % axis_size == 0``) and
+    the expert batch crosses the mesh via two tiled all-to-alls; capacity
+    is computed from the *local* token count, so the routing decisions are
+    identical to the unsharded layer whenever nothing overflows.
+    """
+    d = x.shape[-1]
+    lead = x.shape[:-1]
+    x2d = x.reshape(-1, d)
+    t = x2d.shape[0]
+    e = params.router.shape[1]
+    capacity = max(1, math.ceil(t / e * capacity_factor))
+    dispatch, combine, frac, mean_p = _route(x2d, params.router, capacity)
+    if axis is not None:
+        # Equal shard sizes → pmean of per-shard token means IS the global
+        # mean, so the load-balance loss below matches the unsharded layer.
+        frac = jax.lax.pmean(frac, axis)
+        mean_p = jax.lax.pmean(mean_p, axis)
+    # Switch load-balance loss (eq. 4): differentiable through probs only.
+    aux = e * jnp.sum(frac * mean_p)
+
+    xin = jnp.einsum("tec,td->ecd", dispatch,
+                     x2d.astype(jnp.float32)).astype(x.dtype)  # (E, C, d)
+
+    w_up, b_up, w_down, b_down = (params.w_up, params.b_up,
+                                  params.w_down, params.b_down)
+    if axis is not None:
+        p = jax.lax.axis_size(axis)
+        if e % p:
+            raise ValueError(f"{e} experts not divisible over {p} devices")
+        # Token-sharded (E, C, d) → expert-sharded (E/P, P*C, d): each
+        # device keeps only its experts' rows, from every device.
+        xin = jax.lax.all_to_all(xin, axis, split_axis=0, concat_axis=1,
+                                 tiled=True)
+        i = jax.lax.axis_index(axis)
+        sl = e // p
+        w_up = jax.lax.dynamic_slice_in_dim(w_up, i * sl, sl, 0)
+        b_up = jax.lax.dynamic_slice_in_dim(b_up, i * sl, sl, 0)
+        w_down = jax.lax.dynamic_slice_in_dim(w_down, i * sl, sl, 0)
+        b_down = jax.lax.dynamic_slice_in_dim(b_down, i * sl, sl, 0)
+
+    h = jnp.einsum("ecd,edf->ecf", xin, w_up.astype(x.dtype)) \
+        + b_up[:, None, :].astype(x.dtype)
+    h = nn.gelu(h)
+    yout = jnp.einsum("ecf,efd->ecd", h, w_down.astype(x.dtype)) \
+        + b_down[:, None, :].astype(x.dtype)
+
+    if axis is not None:
+        # Inverse exchange: expert-sharded rows come home token-sharded.
+        yout = jax.lax.all_to_all(yout, axis, split_axis=1, concat_axis=0,
+                                  tiled=True)
+
+    y = jnp.einsum("tec,ecd->td", combine,
+                   yout.astype(jnp.float32)).astype(x.dtype)
+    return y.reshape(*lead, d), aux
+
+
+def make_expert_parallel_moe(mesh: Mesh, *, axis: str = "expert",
+                             capacity_factor: float = 1.25,
+                             token_axis: str | None = None):
+    """Build ``fn(params, x) -> (y, aux)`` sharded over ``mesh[axis]``.
+
+    Tokens are sharded over ``token_axis`` (defaults to ``axis`` itself —
+    the usual dp=ep layout where each device routes its own batch shard);
+    expert weights enter replicated and each device slices its own
+    experts. ``aux`` is psum-averaged so every device returns the global
+    load-balance loss.
+    """
+    tok = token_axis or axis
+
+    def body(params, x):
+        # switch_moe already pmean's the aux-loss statistics over the mesh,
+        # so aux comes back identical (and global) on every device.
+        return switch_moe(params, x, capacity_factor=capacity_factor,
+                          axis=axis)
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(P(), P(tok)),
+        out_specs=(P(tok), P()), check_vma=False)
+
+
+class MoEMlp(nn.Module):
+    """Flax switch-MoE MLP: drop-in for the towers' dense ``MlpBlock``.
+
+    Sows the load-balance aux loss under ``intermediates/moe_aux_loss`` so
+    trainers can collect it via ``mutable=["intermediates"]`` and add
+    ``aux_weight * sum(aux)`` to the objective.
+    """
+
+    num_experts: int
+    mlp_dim: int
+    dtype: Any = jnp.float32
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        lecun = nn.initializers.lecun_normal()
+        params = MoEParams(
+            router=self.param("router", lecun, (d, self.num_experts),
+                              jnp.float32),
+            w_up=self.param("w_up", lecun,
+                            (self.num_experts, d, self.mlp_dim),
+                            jnp.float32),
+            b_up=self.param("b_up", nn.initializers.zeros,
+                            (self.num_experts, self.mlp_dim), jnp.float32),
+            w_down=self.param("w_down", lecun,
+                              (self.num_experts, self.mlp_dim, d),
+                              jnp.float32),
+            b_down=self.param("b_down", nn.initializers.zeros,
+                              (self.num_experts, d), jnp.float32),
+        )
+        y, aux = switch_moe(params, x.astype(self.dtype),
+                            capacity_factor=self.capacity_factor)
+        self.sow("intermediates", "moe_aux_loss", aux)
+        return y

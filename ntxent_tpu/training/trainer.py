@@ -92,31 +92,48 @@ def create_train_state(
 
 
 def _apply_two_views(state: TrainState, params, v1, v2, train: bool = True,
-                     remat: bool = False):
+                     remat: bool = False, collect_moe_aux: bool = False):
     """Run both views through the model in ONE batched forward (2B on the
     batch axis keeps the MXU fed and BN statistics shared across views).
 
     ``remat=True`` wraps the forward in ``jax.checkpoint``: encoder
     activations are recomputed during the backward pass instead of held in
     HBM across the loss (TrainerConfig.remat).
+
+    ``collect_moe_aux=True`` also collects the ``intermediates`` sown by
+    MoE towers (parallel/moe.py) and returns the summed load-balance aux
+    loss as a fourth element (0.0 otherwise).
     """
     both = jnp.concatenate([v1, v2], axis=0)
     variables = {"params": params, "batch_stats": state.batch_stats}
+    mutable = ["batch_stats", "intermediates"] if collect_moe_aux \
+        else ["batch_stats"]
 
     def fwd(variables, x):
-        return state.apply_fn(variables, x, train=train,
-                              mutable=["batch_stats"])
+        return state.apply_fn(variables, x, train=train, mutable=mutable)
 
     if remat:
         fwd = jax.checkpoint(fwd)
     z, updates = fwd(variables, both)
     n = v1.shape[0]
-    return z[:n], z[n:], updates["batch_stats"]
+    aux = 0.0
+    if collect_moe_aux:
+        # Select ONLY the moe_aux_loss entries: other modules may sow
+        # unrelated intermediates (debug activations, attention maps) that
+        # must never leak into the objective.
+        flat, _ = jax.tree_util.tree_flatten_with_path(
+            updates.get("intermediates", {}))
+        leaves = [v for path, v in flat
+                  if any(getattr(k, "key", None) == "moe_aux_loss"
+                         for k in path)]
+        aux = sum(jnp.sum(a) for a in leaves) if leaves else jnp.float32(0)
+    return z[:n], z[n:], updates["batch_stats"], aux
 
 
 def make_train_step(temperature: float = 0.1,
                     use_fused: bool | None = None,
-                    remat: bool = False) -> Callable:
+                    remat: bool = False,
+                    moe_aux_weight: float = 0.0) -> Callable:
     """Single-device train step: fused Pallas loss, donated state.
 
     ``use_fused=None`` auto-selects: the Pallas kernel where it compiles
@@ -125,6 +142,9 @@ def make_train_step(temperature: float = 0.1,
     measures nothing; same policy as api._loss_fn).
     ``remat`` rematerializes the encoder forward in the backward pass
     (TrainerConfig.remat).
+    ``moe_aux_weight > 0`` adds that multiple of the MoE towers'
+    load-balance aux loss (Switch uses 1e-2) to the objective and reports
+    it under ``metrics["moe_aux"]``.
     """
     if use_fused is None:
         from ..utils.capability import is_tpu_backend
@@ -134,20 +154,25 @@ def make_train_step(temperature: float = 0.1,
         loss_impl = ntxent_loss_fused
     else:
         from ..ops.oracle import ntxent_loss as loss_impl
+    collect = moe_aux_weight > 0.0
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, v1, v2):
         def loss_fn(params):
-            z1, z2, new_stats = _apply_two_views(state, params, v1, v2,
-                                                 remat=remat)
+            z1, z2, new_stats, aux = _apply_two_views(
+                state, params, v1, v2, remat=remat, collect_moe_aux=collect)
             z = jnp.concatenate([z1, z2], axis=0)
-            return loss_impl(z, temperature), new_stats
+            loss = loss_impl(z, temperature) + moe_aux_weight * aux
+            return loss, (new_stats, aux)
 
-        (loss, new_stats), grads = jax.value_and_grad(
+        (loss, (new_stats, aux)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
         state = state.apply_gradients(grads=grads)
         state = state.replace(batch_stats=new_stats)
-        return state, {"loss": loss}
+        metrics = {"loss": loss}
+        if collect:
+            metrics["moe_aux"] = aux
+        return state, metrics
 
     return train_step
 
@@ -211,6 +236,7 @@ def make_sharded_train_step(
     interpret: bool | None = None,
     remat: bool = False,
     loss_impl: str = "strip",
+    moe_aux_weight: float = 0.0,
 ) -> Callable:
     """Distributed train step over the mesh's data axis.
 
@@ -223,26 +249,35 @@ def make_sharded_train_step(
     ``loss_impl="pair"`` swaps the loss for the balanced shard-pair
     schedule (parallel/pair.py: each global similarity tile walked once
     across the mesh — ~2.2x fewer loss matmuls at P=8).
+
+    ``moe_aux_weight > 0`` adds the MoE load-balance aux loss, pmean'd
+    over the mesh (each device routes its own batch shard, so the mean of
+    per-shard aux losses is the dp=ep estimator of balance).
     """
     num_devices = mesh.shape[axis]
     loss_body = resolve_local_ntxent(loss_impl)
+    collect = moe_aux_weight > 0.0
 
     def local_loss(z1, z2):
         return loss_body(z1, z2, temperature, axis, num_devices, interpret)
 
     def per_device_step(state: TrainState, v1, v2):
         def loss_fn(params):
-            z1, z2, new_stats = _apply_two_views(state, params, v1, v2,
-                                                 remat=remat)
-            return local_loss(z1, z2), new_stats
+            z1, z2, new_stats, aux = _apply_two_views(
+                state, params, v1, v2, remat=remat, collect_moe_aux=collect)
+            loss = local_loss(z1, z2) + moe_aux_weight * aux
+            return loss, (new_stats, aux)
 
-        (loss, new_stats), grads = jax.value_and_grad(
+        (loss, (new_stats, aux)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
         grads = jax.lax.pmean(grads, axis)
         new_stats = jax.lax.pmean(new_stats, axis)
         state = state.apply_gradients(grads=grads)
         state = state.replace(batch_stats=new_stats)
-        return state, {"loss": loss}
+        metrics = {"loss": loss}
+        if collect:
+            metrics["moe_aux"] = jax.lax.pmean(aux, axis)
+        return state, metrics
 
     sharded = jax.shard_map(
         per_device_step,

@@ -1,0 +1,160 @@
+"""Switch-MoE routing, dispatch, and expert parallelism vs dense oracles.
+
+Beyond-reference subsystem (SURVEY.md §2.2 marks EP N/A for the reference).
+The key equalities: a 1-expert MoE is exactly the dense MLP; the
+expert-parallel shard_map path (all-to-all over the ``expert`` axis) equals
+the unsharded layer token-for-token when capacity doesn't overflow, and its
+gradients match; over-capacity tokens pass through with zero MLP output.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ntxent_tpu.parallel import create_mesh
+from ntxent_tpu.parallel.moe import (
+    MoEMlp,
+    init_moe_params,
+    make_expert_parallel_moe,
+    switch_moe,
+)
+
+from conftest import make_embeddings  # noqa: F401  (fixture module)
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs an 8-device mesh")
+
+D, F = 16, 32
+
+
+def _dense(params, x):
+    h = nn.gelu(x @ params.w_up[0] + params.b_up[0])
+    return h @ params.w_down[0] + params.b_down[0]
+
+
+def test_single_expert_equals_dense(rng):
+    params = init_moe_params(rng, num_experts=1, d=D, mlp_dim=F)
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (4, 6, D))
+    y, aux = switch_moe(params, x, capacity_factor=2.0)
+    want = _dense(params, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # One expert: f = p = 1, aux = E * f * p = 1.
+    np.testing.assert_allclose(float(aux), 1.0, atol=1e-6)
+
+
+def test_balanced_router_aux_is_one(rng):
+    params = init_moe_params(rng, num_experts=4, d=D, mlp_dim=F)
+    # Zero router → uniform probs; argmax ties break to expert 0, so f is
+    # degenerate but p stays uniform: aux = E * (1 * 1/E) = 1.
+    params = jax.tree.map(jnp.zeros_like, params)
+    x = jax.random.normal(rng, (32, D))
+    _, aux = switch_moe(params, x, capacity_factor=8.0)
+    np.testing.assert_allclose(float(aux), 1.0, atol=1e-6)
+
+
+def test_capacity_drop_passes_through_zero(rng):
+    """C=1 forces drops; dropped tokens get exactly zero output."""
+    params = init_moe_params(rng, num_experts=2, d=D, mlp_dim=F)
+    x = jax.random.normal(jax.random.fold_in(rng, 2), (16, D))
+    # capacity = ceil(16/2 * 0.125) = 1 → at most 2 kept tokens.
+    y, _ = switch_moe(params, x, capacity_factor=0.125)
+    norms = np.linalg.norm(np.asarray(y), axis=-1)
+    assert np.isfinite(np.asarray(y)).all()
+    assert (norms == 0.0).sum() >= 16 - 2
+
+
+def test_expert_parallel_matches_local(rng):
+    """8-way EP (all-to-all dispatch) == unsharded layer, values and grads."""
+    mesh = create_mesh(axis_names=("expert",))
+    e = 8
+    params = init_moe_params(rng, num_experts=e, d=D, mlp_dim=F)
+    x = jax.random.normal(jax.random.fold_in(rng, 3), (128, D))
+    # Ample capacity both locally (16 tokens/device) and globally.
+    cf = 8.0
+    want, aux_want = switch_moe(params, x, capacity_factor=cf)
+    ep = make_expert_parallel_moe(mesh, capacity_factor=cf)
+    got, aux_got = jax.jit(ep)(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(aux_got), float(aux_want), atol=1e-5)
+
+    def loss_local(p):
+        y, aux = switch_moe(p, x, capacity_factor=cf)
+        return jnp.sum(y ** 2) + aux
+
+    def loss_ep(p):
+        y, aux = ep(p, x)
+        return jnp.sum(y ** 2) + aux
+
+    gw = jax.grad(loss_local)(params)
+    gg = jax.jit(jax.grad(loss_ep))(params)
+    for a, b in zip(jax.tree.leaves(gg), jax.tree.leaves(gw)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_expert_count_must_divide_mesh(rng):
+    mesh = create_mesh(axis_names=("expert",))
+    params = init_moe_params(rng, num_experts=4, d=D, mlp_dim=F)
+    x = jax.random.normal(rng, (64, D))
+    ep = make_expert_parallel_moe(mesh)
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(ep)(params, x)
+
+
+def test_moe_mlp_module_sows_aux(rng):
+    m = MoEMlp(num_experts=4, mlp_dim=F)
+    x = jax.random.normal(rng, (2, 6, D))
+    variables = m.init(rng, x)
+    y, state = m.apply(variables, x, mutable=["intermediates"])
+    assert y.shape == x.shape
+    (aux,) = state["intermediates"]["moe_aux_loss"]
+    assert np.isfinite(float(aux))
+    g = jax.grad(lambda v: jnp.sum(
+        m.apply(v, x, mutable=["intermediates"])[0] ** 2))(variables)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree.leaves(g))
+
+
+def test_moe_vit_tower(rng):
+    """MoE-ViT: every-other-block switch MLP, aux losses surfaced."""
+    from ntxent_tpu.models import VisionTransformer
+
+    m = VisionTransformer(patch_size=8, hidden_dim=16, depth=2, num_heads=2,
+                          mlp_dim=32, dtype=jnp.float32, moe_experts=4)
+    x = jax.random.uniform(rng, (2, 16, 16, 3))
+    variables = m.init(rng, x, train=False)
+    y, state = m.apply(variables, x, train=True, mutable=["intermediates"])
+    assert y.shape == (2, 16)
+    aux = jax.tree.leaves(state["intermediates"])
+    assert len(aux) == 1  # depth 2 → one MoE block (block_1)
+    assert np.isfinite(float(aux[0]))
+
+
+def test_moe_vit_train_step(rng):
+    """One SimCLR step on an MoE-ViT tower: aux loss joins the objective."""
+    from ntxent_tpu.models import SimCLRModel, VisionTransformer
+    from ntxent_tpu.training import TrainerConfig, create_train_state
+    from ntxent_tpu.training.trainer import make_train_step
+
+    import functools
+
+    encoder = functools.partial(
+        VisionTransformer, patch_size=8, hidden_dim=16, depth=2,
+        num_heads=2, mlp_dim=32, dtype=jnp.float32, moe_experts=2)
+    model = SimCLRModel(encoder=encoder, proj_hidden_dim=16, proj_dim=8)
+    cfg = TrainerConfig(batch_size=4, total_steps=2, warmup_steps=1)
+    state = create_train_state(model, rng, (1, 16, 16, 3), cfg)
+    v1 = jax.random.uniform(jax.random.fold_in(rng, 1), (4, 16, 16, 3))
+    v2 = jax.random.uniform(jax.random.fold_in(rng, 2), (4, 16, 16, 3))
+    step = make_train_step(use_fused=False, moe_aux_weight=0.01)
+    state, metrics = step(state, v1, v2)
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(metrics["moe_aux"]))
+    # Weight 0 keeps the legacy metrics surface (no collection cost).
+    step0 = make_train_step(use_fused=False)
+    _, metrics0 = step0(state, v1, v2)
+    assert "moe_aux" not in metrics0

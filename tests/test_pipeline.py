@@ -1,0 +1,156 @@
+"""GPipe pipeline parallelism vs the sequential oracle on the CPU mesh.
+
+Beyond-reference subsystem (SURVEY.md §2.2 marks PP N/A for the reference):
+the pipelined forward must equal applying the stages in sequence, and the
+AD-derived backward pipeline must equal the sequential gradients — weights
+and activations alike. Shapes are tiny; the schedule logic, ppermute hops,
+and psum replication are what is under test.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ntxent_tpu.parallel import create_mesh
+from ntxent_tpu.parallel.pp import (
+    make_gpipe,
+    pipeline_stage_params,
+    stack_stage_params,
+)
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs an 8-device mesh")
+
+S, M, B, D = 4, 4, 8, 16
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return create_mesh(devices=jax.devices()[:S], axis_names=("stage",))
+
+
+def _dense_stage(p, x):
+    return jax.nn.relu(x @ p["w"] + p["b"])
+
+
+def _make_stages(key, n=S, d=D):
+    ps = []
+    for i in range(n):
+        k = jax.random.fold_in(key, i)
+        ps.append({
+            "w": jax.random.normal(k, (d, d)) / np.sqrt(d),
+            "b": jnp.zeros((d,)),
+        })
+    return ps
+
+
+def _sequential(params_list, x):
+    for p in params_list:
+        x = _dense_stage(p, x)
+    return x
+
+
+def test_forward_matches_sequential(mesh, rng):
+    params_list = _make_stages(rng)
+    x = jax.random.normal(jax.random.fold_in(rng, 99), (B, D))
+    want = _sequential(params_list, x)
+    pipe = make_gpipe(_dense_stage, mesh, num_microbatches=M)
+    got = jax.jit(pipe)(stack_stage_params(params_list), x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_grads_match_sequential(mesh, rng, remat):
+    params_list = _make_stages(rng)
+    stacked = stack_stage_params(params_list)
+    x = jax.random.normal(jax.random.fold_in(rng, 7), (B, D))
+
+    def loss_seq(ps, x):
+        return jnp.sum(_sequential(ps, x) ** 2)
+
+    pipe = make_gpipe(_dense_stage, mesh, num_microbatches=M, remat=remat)
+
+    def loss_pipe(stacked, x):
+        return jnp.sum(pipe(stacked, x) ** 2)
+
+    want_p, want_x = jax.grad(loss_seq, argnums=(0, 1))(params_list, x)
+    got_p, got_x = jax.jit(jax.grad(loss_pipe, argnums=(0, 1)))(stacked, x)
+    np.testing.assert_allclose(np.asarray(got_x), np.asarray(want_x),
+                               rtol=1e-4, atol=1e-5)
+    want_stacked = stack_stage_params(want_p)
+    for a, b in zip(jax.tree.leaves(got_p), jax.tree.leaves(want_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_microbatch_count_one_and_uneven_batch(mesh, rng):
+    params_list = _make_stages(rng)
+    x = jax.random.normal(rng, (B, D))
+    pipe1 = make_gpipe(_dense_stage, mesh, num_microbatches=1)
+    got = jax.jit(pipe1)(stack_stage_params(params_list), x)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(_sequential(params_list, x)),
+                               rtol=1e-5, atol=1e-5)
+    bad = make_gpipe(_dense_stage, mesh, num_microbatches=3)
+    with pytest.raises(ValueError, match="microbatch"):
+        jax.jit(bad)(stack_stage_params(params_list), x)
+
+
+def test_dp_pp_composed(rng):
+    """2-D (data, stage) mesh: batch stays data-sharded through the pipe."""
+    mesh2 = create_mesh(shape=(2, S), axis_names=("data", "stage"))
+    params_list = _make_stages(rng)
+    x = jax.random.normal(jax.random.fold_in(rng, 3), (B, D))
+    pipe = make_gpipe(_dense_stage, mesh2, num_microbatches=2,
+                      data_axis="data")
+    got = jax.jit(pipe)(stack_stage_params(params_list), x)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(_sequential(params_list, x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_transformer_blocks_pipelined(mesh, rng):
+    """Real EncoderBlock stages (2 blocks/stage via scan) == sequential."""
+    from ntxent_tpu.models.vit import EncoderBlock
+
+    blk = EncoderBlock(num_heads=2, mlp_dim=32, dtype=jnp.float32)
+    x = jax.random.normal(rng, (4, 6, D))
+    blocks = []
+    for i in range(2 * S):
+        blocks.append(blk.init(jax.random.fold_in(rng, i), x)["params"])
+
+    want = x
+    for p in blocks:
+        want = blk.apply({"params": p}, want)
+
+    # Stage-major stacking: (S, blocks_per_stage, ...) leaves.
+    stages = [jax.tree.map(lambda *a: jnp.stack(a, 0),
+                           *blocks[2 * s:2 * s + 2]) for s in range(S)]
+
+    def stage_fn(stage_params, acts):
+        def one(a, p):
+            return blk.apply({"params": p}, a), None
+        out, _ = jax.lax.scan(one, acts, stage_params)
+        return out
+
+    pipe = make_gpipe(stage_fn, mesh, num_microbatches=2)
+    got = jax.jit(pipe)(stack_stage_params(stages), x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_stage_params_split():
+    p = {f"block_{i}": {"w": jnp.full((3,), float(i))} for i in range(6)}
+    p["final_ln"] = {"scale": jnp.ones((3,))}
+    stacked, rest = pipeline_stage_params(p, num_stages=3)
+    assert stacked["w"].shape == (3, 2, 3)
+    np.testing.assert_allclose(np.asarray(stacked["w"][1, 0]), 2.0)
+    np.testing.assert_allclose(np.asarray(stacked["w"][2, 1]), 5.0)
+    assert list(rest) == ["final_ln"]
+    with pytest.raises(ValueError, match="split"):
+        pipeline_stage_params(p, num_stages=4)
+    with pytest.raises(ValueError, match="block"):
+        pipeline_stage_params({"x": 1}, num_stages=1)
