@@ -1,0 +1,111 @@
+"""Gather-engine A/B: Python StreamingLoader vs the native C++ loader.
+
+Host-side measurement (no accelerator involved): both engines stream
+seeded-shuffled batches out of the same memory-mapped ``.npy`` row store,
+so the numbers isolate exactly what the native engine replaces — the
+GIL-bound per-row copies of the Python thread pool vs C++ workers doing
+``memcpy`` against the mmap. Two row shapes bracket the design space:
+small rows (CIFAR-class, gather is permutation-bound) and large rows
+(ImageNet-class, gather is bandwidth-bound).
+
+The policy layer (_ShardedShuffle) is shared by both engines, so equal
+batch streams are a precondition the loader tests already pin; this
+harness only times them.
+
+Usage:
+    python benchmarks/bench_loader.py [--epochs 3]
+        [--out benchmark_results/cpu/loader_engines.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def make_store(root: Path, name: str, shape) -> Path:
+    path = root / name
+    mm = np.lib.format.open_memmap(path, mode="w+", dtype=np.uint8,
+                                   shape=shape)
+    rng = np.random.RandomState(0)
+    step = max(1, shape[0] // 64)
+    for lo in range(0, shape[0], step):  # chunked: bounded host memory
+        hi = min(shape[0], lo + step)
+        mm[lo:hi] = rng.randint(0, 255, (hi - lo, *shape[1:]), np.uint8)
+    mm.flush()
+    del mm
+    return path
+
+
+def time_epochs(loader, epochs: int) -> tuple[float, float]:
+    """(seconds, bytes) consumed over `epochs` full epochs."""
+    nb = loader.batches_per_epoch()
+    it = iter(loader)
+    total = 0
+    t0 = time.perf_counter()
+    for _ in range(epochs * nb):
+        total += next(it).nbytes
+    return time.perf_counter() - t0, float(total)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from ntxent_tpu.training.datasets import ArraySource, StreamingLoader
+    from ntxent_tpu.training.native_loader import NativeStreamingLoader
+
+    cases = [
+        ("small_rows_32x32", (50_000, 32, 32, 3)),
+        ("large_rows_224x224", (2_000, 224, 224, 3)),
+    ]
+    results = []
+    with tempfile.TemporaryDirectory() as td:
+        for name, shape in cases:
+            path = make_store(Path(td), f"{name}.npy", shape)
+            mm = np.load(path, mmap_mode="r")
+            batch = min(args.batch, shape[0] // 4)
+            engines = {
+                "python": StreamingLoader(
+                    ArraySource(mm), batch, seed=1,
+                    num_threads=args.threads),
+                "native": NativeStreamingLoader(
+                    mm, batch, seed=1, num_threads=args.threads),
+            }
+            row = {"case": name, "rows": shape[0],
+                   "row_bytes": int(np.prod(shape[1:])), "batch": batch,
+                   "threads": args.threads, "epochs": args.epochs}
+            for label, ld in engines.items():
+                time_epochs(ld, 1)  # warm the page cache + pools
+                s, nbytes = time_epochs(ld, args.epochs)
+                row[f"{label}_gbps"] = round(nbytes / s / 1e9, 3)
+                row[f"{label}_batches_per_s"] = round(
+                    args.epochs * ld.batches_per_epoch() / s, 1)
+            row["native_speedup"] = round(
+                row["native_gbps"] / row["python_gbps"], 2)
+            results.append(row)
+            print(json.dumps(row))
+
+    if args.out:
+        out = Path(args.out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(
+            {"benchmark": "loader_engines", "results": results}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,11 +34,20 @@ def _add_common_args(p: argparse.ArgumentParser) -> None:
     (one source of truth: a model added here is launchable AND evaluable)."""
     d = p.add_argument_group("data")
     d.add_argument("--dataset", default="synthetic",
-                   choices=["synthetic", "cifar10", "imagefolder"])
+                   choices=["synthetic", "cifar10", "imagefolder", "npy"],
+                   help="npy: memmap'd .npy (N, H, W, C) row store "
+                        "(--data-dir points at the file; training only)")
     d.add_argument("--data-dir", default=None,
-                   help="CIFAR-10 pickle dir / ImageNet-layout root")
+                   help="CIFAR-10 pickle dir / ImageNet-layout root / "
+                        ".npy row store")
     d.add_argument("--image-size", type=int, default=None,
-                   help="default: 32 (synthetic/cifar10) or 224")
+                   help="default: 32 (synthetic/cifar10), 224 "
+                        "(imagefolder), or the npy store's row shape")
+    d.add_argument("--loader", default="python",
+                   choices=["python", "native"],
+                   help="batch-gather engine: python = threaded "
+                        "StreamingLoader; native = C++ worker pool over "
+                        "the mmap'd store (npy dataset only)")
 
     m = p.add_argument_group("model")
     m.add_argument("--model", default="resnet50", choices=MODEL_CHOICES)
@@ -133,6 +142,16 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _npy_store_shape(args) -> tuple:
+    """Validate --dataset npy flags and return the store's array shape
+    (single source of truth for both the pipeline and image-size logic)."""
+    import numpy as np
+
+    if args.data_dir is None:
+        raise SystemExit("--dataset npy requires --data-dir")
+    return np.load(args.data_dir, mmap_mode="r").shape
+
+
 def _make_encoder(name: str, image_size: int, moe_experts: int = 0):
     from ntxent_tpu import models
 
@@ -179,15 +198,31 @@ def _make_pipeline(args, per_process_batch: int, sharding=None, mesh=None):
         if args.data_dir is None:
             raise SystemExit("--dataset imagefolder requires --data-dir")
         source = ImageFolderSource(args.data_dir, image_size=size)
+    elif args.dataset == "npy":
+        _npy_store_shape(args)  # validates --data-dir + readability
+        source = ArraySource(np.load(args.data_dir, mmap_mode="r"))
     else:
         rng = np.random.RandomState(args.seed)
         source = ArraySource(rng.rand(
             args.synthetic_samples, size, size, 3).astype(np.float32))
     # Multi-process: each process streams ITS slice of every global batch
     # (seeded identically, offset by process_id — the per-rank DataLoader).
-    loader = StreamingLoader(source, per_process_batch, seed=args.seed,
-                             shard_index=jax.process_index(),
-                             shard_count=jax.process_count())
+    if args.loader == "native":
+        from ntxent_tpu.training.native_loader import NativeStreamingLoader
+
+        try:
+            loader = NativeStreamingLoader(
+                source, per_process_batch, seed=args.seed,
+                shard_index=jax.process_index(),
+                shard_count=jax.process_count())
+        except (TypeError, ValueError, OSError, RuntimeError) as e:
+            # not-a-memmap source AND native-build failures (no compiler,
+            # cmake error) both land here: one clean exit, no traceback.
+            raise SystemExit(f"--loader native: {e}")
+    else:
+        loader = StreamingLoader(source, per_process_batch, seed=args.seed,
+                                 shard_index=jax.process_index(),
+                                 shard_count=jax.process_count())
     key = jax.random.PRNGKey(args.seed + 1)
     if mesh is not None and jax.process_count() > 1:
         # Global assembly before augmentation: only raw bytes cross the
@@ -236,7 +271,17 @@ def main(argv=None) -> int:
             logger.warning("--moe-experts ignored: MoE towers are wired for "
                            "the simclr objective only")
         return _train_clip(args, info, per_process_batch)
-    if args.image_size is None:
+    if args.dataset == "npy":
+        # No resize path exists for the raw row store: the model MUST be
+        # built at the store's native resolution.
+        store_size = int(_npy_store_shape(args)[1])
+        if args.image_size is not None and args.image_size != store_size:
+            raise SystemExit(
+                f"--image-size {args.image_size} disagrees with the npy "
+                f"store's row shape ({store_size}); omit the flag or "
+                f"re-export the store")
+        args.image_size = store_size
+    elif args.image_size is None:
         args.image_size = 224 if args.dataset == "imagefolder" else 32
 
     from ntxent_tpu.models import SimCLRModel
@@ -590,6 +635,9 @@ def _labeled_arrays(args):
         xtr = np.stack([src[int(i)] for i in tr_idx])
         xte = np.stack([src[int(i)] for i in te_idx])
         ytr, yte = labels[tr_idx], labels[te_idx]
+    elif args.dataset == "npy":
+        raise SystemExit("--dataset npy has no labels; evaluation needs "
+                         "cifar10 or imagefolder")
     else:
         rng = np.random.RandomState(args.seed)
         n, s = 512, args.image_size

@@ -1,0 +1,194 @@
+"""Native-engine streaming loader: C++ worker pool, Python policy.
+
+The reference's input pipeline leaned on torch's DataLoader, whose real
+work happens in its native (C++) workers. This module is that component
+for this framework: ``native/src/loader.cpp`` gathers scattered rows from
+a memory-mapped store into dense batch buffers on a thread pool, keeping
+``read_ahead`` batches ready ahead of the consumer — released from the
+GIL entirely, unlike ``StreamingLoader``'s Python thread pool.
+
+Policy stays in Python on purpose: ``NativeStreamingLoader`` derives from
+the same ``_ShardedShuffle`` as ``StreamingLoader``, so the seeded epoch
+permutation, coordination-free shard slicing, and exact mid-epoch resume
+arithmetic have ONE source of truth — the engines are interchangeable and
+the tests assert batch-for-batch equality between them.
+
+Requires a *memory-mapped row store* (``np.memmap`` / ``np.load(...,
+mmap_mode='r')`` / a raw file) — the zero-decode path ``ArraySource``
+serves. Sources that decode per item (ImageFolderSource) keep using
+``StreamingLoader``; decoding belongs where the decoder lives.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from collections import deque
+from typing import Iterator
+
+import numpy as np
+
+from .datasets import ArraySource, _ShardedShuffle
+
+__all__ = ["NativeStreamingLoader", "native_loader_available"]
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.ntx_loader_open.restype = ctypes.c_void_p
+    lib.ntx_loader_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_int32]
+    lib.ntx_loader_submit.restype = ctypes.c_int
+    lib.ntx_loader_submit.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8)]
+    lib.ntx_loader_next.restype = ctypes.c_int64
+    lib.ntx_loader_next.argtypes = [ctypes.c_void_p]
+    lib.ntx_loader_outstanding.restype = ctypes.c_int64
+    lib.ntx_loader_outstanding.argtypes = [ctypes.c_void_p]
+    lib.ntx_loader_close.restype = None
+    lib.ntx_loader_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _library() -> ctypes.CDLL:
+    from ntxent_tpu.native import load_library
+
+    return _bind(load_library())
+
+
+def native_loader_available() -> bool:
+    """True when the native library is (or can be) built on this host."""
+    from ntxent_tpu.native import native_available
+
+    return native_available()
+
+
+def _as_memmap(source) -> tuple[np.memmap, int]:
+    """Validate the source and return (memmap, file offset of row 0).
+
+    The engine addresses rows as ``file_offset + i * row_bytes``, so the
+    offset is derived from the view's actual data pointer relative to the
+    root mmap — a contiguous slice (``mm[5000:]``) gathers the RIGHT rows
+    rather than silently reading from the file start; strided or
+    otherwise non-contiguous views are rejected (their rows are not
+    ``row_bytes`` apart in the file).
+    """
+    import mmap as mmaplib
+
+    if isinstance(source, ArraySource):
+        source = source.images
+    if not isinstance(source, np.memmap):
+        raise TypeError(
+            "NativeStreamingLoader needs a np.memmap-backed source "
+            f"(np.load(..., mmap_mode='r')), got {type(source).__name__}; "
+            "use StreamingLoader for in-memory or per-item-decode sources")
+    if source.filename is None:  # pragma: no cover - anonymous maps only
+        raise TypeError("memmap has no backing file")
+    if not source.flags["C_CONTIGUOUS"]:
+        raise TypeError("NativeStreamingLoader needs a C-contiguous memmap "
+                        "view (strided slices change the on-disk row "
+                        "stride); index rows via the loader's shuffle "
+                        "instead")
+    root = getattr(source, "_mmap", None)
+    if root is None:  # pragma: no cover - non-standard memmap subclass
+        raise TypeError("memmap view carries no root mmap")
+    # numpy maps the file from the page-aligned floor of the header
+    # offset; the view's pointer distance from that base is its true
+    # position in the file.
+    base_addr = np.frombuffer(root, dtype=np.uint8).ctypes.data
+    page_base = source.offset - source.offset % mmaplib.ALLOCATIONGRANULARITY
+    file_off = page_base + (source.ctypes.data - base_addr)
+    if file_off < 0:  # pragma: no cover - defensive
+        raise ValueError("memmap data pointer precedes its root mapping")
+    return source, int(file_off)
+
+
+class NativeStreamingLoader(_ShardedShuffle):
+    """Drop-in ``StreamingLoader`` over the native batch-gather engine.
+
+    Same constructor surface, same checkpointable-iterator protocol
+    (``state()``/``restore()``), same seeded order — only the gather
+    engine differs: row copies run on C++ threads against the mmap'd
+    file, with ``read_ahead`` whole batches in flight.
+    """
+
+    def __init__(self, source, batch_size: int, seed: int = 0,
+                 num_threads: int = 8, read_ahead: int = 4,
+                 drop_remainder: bool = True,
+                 shard_index: int = 0, shard_count: int = 1):
+        mm, file_off = _as_memmap(source)
+        self._init_shuffle(len(mm), batch_size, seed, shard_index,
+                           shard_count, drop_remainder)
+        import threading
+
+        self._mm = mm
+        self._file_offset = file_off
+        self._row_shape = mm.shape[1:]
+        self._dtype = mm.dtype
+        self._row_bytes = int(mm.dtype.itemsize * np.prod(mm.shape[1:],
+                                                          dtype=np.int64))
+        self.num_threads = num_threads
+        self.read_ahead = max(1, read_ahead)
+        self._lock = threading.Lock()
+        self._lib = _library()  # build (or load) eagerly: fail at init
+
+    def state(self) -> dict:
+        with self._lock:
+            return {"epoch": self._epoch, "offset": self._offset,
+                    "seed": self.seed}
+
+    def restore(self, state: dict) -> None:
+        with self._lock:
+            self.seed = int(state["seed"])
+            self._epoch = int(state["epoch"])
+            self._offset = int(state["offset"])
+
+    def _submit(self, handle, order: np.ndarray, bi: int) -> np.ndarray:
+        """Queue batch ``bi``; workers gather straight into the returned
+        buffer (zero staging copies) — it must stay referenced and
+        untouched until the matching next() drains it."""
+        idxs = np.ascontiguousarray(self._batch_indices(order, bi),
+                                    dtype=np.int64)
+        out = np.empty((len(idxs), *self._row_shape), self._dtype)
+        rc = self._lib.ntx_loader_submit(
+            handle, idxs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(idxs), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        if rc != 0:
+            raise RuntimeError("native loader rejected batch submission")
+        return out
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        handle = self._lib.ntx_loader_open(
+            str(self._mm.filename).encode(), self._file_offset,
+            int(self._n_rows), self._row_bytes, self.batch_size,
+            int(self.num_threads), int(self.read_ahead))
+        if not handle:
+            raise RuntimeError(
+                f"native loader failed to open {self._mm.filename}")
+        try:
+            while True:
+                with self._lock:
+                    epoch, start = self._epoch, self._offset
+                order = self._epoch_order(epoch)
+                nb = self.batches_per_epoch()
+                bi = start
+                inflight: deque[np.ndarray] = deque()
+                while bi < nb and len(inflight) < self.read_ahead:
+                    inflight.append(self._submit(handle, order, bi))
+                    bi += 1
+                while inflight:
+                    rows = self._lib.ntx_loader_next(handle)
+                    if rows < 0:
+                        raise RuntimeError("native loader next() failed")
+                    out = inflight.popleft()
+                    if bi < nb:
+                        inflight.append(self._submit(handle, order, bi))
+                        bi += 1
+                    with self._lock:
+                        self._offset += 1
+                    yield out[:rows]
+                with self._lock:
+                    self._epoch += 1
+                    self._offset = 0
+        finally:
+            self._lib.ntx_loader_close(handle)
