@@ -75,9 +75,28 @@ struct Loader {
   std::deque<Chunk> work;      // gather chunks awaiting a worker
   std::deque<int> order;       // submission order, consumed by next()
   std::mutex mu;
-  std::condition_variable cv_work, cv_ready, cv_space;
+  std::condition_variable cv_work, cv_ready, cv_space, cv_drain;
   std::vector<std::thread> workers;
+  int active_calls = 0;  // blocked/running submit()/next() calls
   bool stop = false;
+};
+
+// Counts a caller inside submit()/next() so close() can wait for them to
+// drain before freeing the Loader — without this, a consumer thread
+// blocked in a wait() would wake up inside freed memory.
+struct CallGuard {
+  Loader* ld;
+  explicit CallGuard(Loader* l) : ld(l) {
+    std::lock_guard<std::mutex> lk(ld->mu);
+    ++ld->active_calls;
+  }
+  ~CallGuard() {
+    {
+      std::lock_guard<std::mutex> lk(ld->mu);
+      --ld->active_calls;
+    }
+    ld->cv_drain.notify_all();
+  }
 };
 
 void worker_main(Loader* ld) {
@@ -154,6 +173,7 @@ int ntx_loader_submit(void* h, const int64_t* indices, int64_t count,
     return -1;
   for (int64_t i = 0; i < count; ++i)
     if (indices[i] < 0 || indices[i] >= ld->n_rows) return -1;
+  CallGuard guard(ld);
   int sid;
   {
     std::unique_lock<std::mutex> lk(ld->mu);
@@ -181,6 +201,7 @@ int ntx_loader_submit(void* h, const int64_t* indices, int64_t count,
 int64_t ntx_loader_next(void* h) {
   auto* ld = static_cast<Loader*>(h);
   if (!ld) return -1;
+  CallGuard guard(ld);
   int64_t rows;
   {
     std::unique_lock<std::mutex> lk(ld->mu);
@@ -213,6 +234,12 @@ void ntx_loader_close(void* h) {
   ld->cv_work.notify_all();
   ld->cv_ready.notify_all();
   ld->cv_space.notify_all();
+  {
+    // Wait for any caller still blocked in submit()/next() to observe
+    // `stop` and leave before the Loader is freed under it.
+    std::unique_lock<std::mutex> lk(ld->mu);
+    ld->cv_drain.wait(lk, [&] { return ld->active_calls == 0; });
+  }
   for (auto& t : ld->workers) t.join();
   ::munmap(const_cast<uint8_t*>(ld->map), ld->map_len);
   ::close(ld->fd);

@@ -199,7 +199,8 @@ def _make_pipeline(args, per_process_batch: int, sharding=None, mesh=None):
             raise SystemExit("--dataset imagefolder requires --data-dir")
         source = ImageFolderSource(args.data_dir, image_size=size)
     elif args.dataset == "npy":
-        _npy_store_shape(args)  # validates --data-dir + readability
+        # --data-dir presence/readability already validated by main()'s
+        # _npy_store_shape call (which also pinned image_size).
         source = ArraySource(np.load(args.data_dir, mmap_mode="r"))
     else:
         rng = np.random.RandomState(args.seed)
@@ -270,6 +271,9 @@ def main(argv=None) -> int:
         if args.moe_experts > 0:
             logger.warning("--moe-experts ignored: MoE towers are wired for "
                            "the simclr objective only")
+        if args.loader != "python":
+            logger.warning("--loader %s ignored: the CLIP objective uses "
+                           "PairedArrayLoader", args.loader)
         return _train_clip(args, info, per_process_batch)
     if args.dataset == "npy":
         # No resize path exists for the raw row store: the model MUST be

@@ -2,6 +2,7 @@ from ntxent_tpu.models.clip import CLIPModel, TextTransformer
 from ntxent_tpu.models.long_context import (
     LongContextBlock,
     LongContextTransformer,
+    make_pipelined_apply,
     SeqParallelSelfAttention,
 )
 from ntxent_tpu.models.projection import ProjectionHead, SimCLRModel
@@ -27,6 +28,7 @@ __all__ = [
     "TextTransformer",
     "LongContextBlock",
     "LongContextTransformer",
+    "make_pipelined_apply",
     "SeqParallelSelfAttention",
     "ProjectionHead",
     "SimCLRModel",

@@ -119,20 +119,91 @@ class LongContextTransformer(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     attention_fn: Optional[AttentionFn] = None  # None -> default_attention()
 
-    @nn.compact
-    def __call__(self, tokens):
-        b, l = tokens.shape
+    def setup(self):
+        # Explicit names reproduce the original nn.compact auto-names, so
+        # the parameter tree (and every existing checkpoint/test) is
+        # byte-identical to the pre-setup() module.
+        self.token_embed = nn.Embed(self.vocab_size, self.hidden_dim,
+                                    param_dtype=jnp.float32,
+                                    dtype=self.dtype, name="Embed_0")
+        self.pos_embedding = self.param(
+            "pos_embedding", nn.initializers.normal(0.02),
+            (1, self.max_len, self.hidden_dim), jnp.float32)
+        self.blocks = [
+            LongContextBlock(
+                num_heads=self.num_heads, mlp_dim=self.mlp_dim,
+                dtype=self.dtype, attention_fn=self.attention_fn,
+                name=f"LongContextBlock_{i}")
+            for i in range(self.depth)]
+        self.out_ln = nn.LayerNorm(dtype=jnp.float32, name="LayerNorm_0")
+
+    def embed(self, tokens):
+        """(B, L) tokens -> (B, L, hidden) embedded + positioned acts
+        (the pre-pipeline stage of the pipelined forward)."""
+        _, l = tokens.shape
         if l > self.max_len:
             raise ValueError(
                 f"sequence length {l} exceeds max_len {self.max_len} "
                 f"(raise max_len — it sizes the position table)")
-        x = nn.Embed(self.vocab_size, self.hidden_dim,
-                     param_dtype=jnp.float32, dtype=self.dtype)(tokens)
-        pos = self.param("pos_embedding", nn.initializers.normal(0.02),
-                         (1, self.max_len, self.hidden_dim), jnp.float32)
-        x = x + pos[:, :l].astype(self.dtype)
-        for _ in range(self.depth):
-            x = LongContextBlock(
-                num_heads=self.num_heads, mlp_dim=self.mlp_dim,
-                dtype=self.dtype, attention_fn=self.attention_fn)(x)
-        return nn.LayerNorm(dtype=jnp.float32)(x)
+        x = self.token_embed(tokens)
+        return x + self.pos_embedding[:, :l].astype(self.dtype)
+
+    def head(self, x):
+        """Final norm (the post-pipeline stage of the pipelined forward)."""
+        return self.out_ln(x)
+
+    def __call__(self, tokens):
+        x = self.embed(tokens)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.head(x)
+
+
+def make_pipelined_apply(model: LongContextTransformer, mesh, *,
+                         num_microbatches: int, axis: str = "stage",
+                         data_axis: str | None = None,
+                         remat: bool = False):
+    """Pipeline-parallel forward for the long-context tower.
+
+    Returns ``fn(variables, tokens) -> (B, L, hidden)`` equal to
+    ``model.apply`` but with the block stack executed as a GPipe pipeline
+    over ``mesh[axis]`` (parallel/pp.py): each device holds
+    ``depth / num_stages`` blocks' weights, activations hand off over
+    ppermute, embedding and final norm run replicated outside the
+    pipeline. Same parameter tree as the plain forward — pipelining is a
+    RUNTIME choice, exactly like the attention decomposition above.
+
+    ``model.attention_fn`` must be a plain function (oracle / blockwise /
+    flash) — a shard_map-based plan (ring/Ulysses) cannot nest inside the
+    pipeline's own shard_map body.
+    """
+    from ..parallel.pp import make_gpipe, pipeline_stage_params
+
+    num_stages = mesh.shape[axis]
+    if model.depth % num_stages:
+        raise ValueError(f"depth {model.depth} does not split over "
+                         f"{num_stages} stages")
+    blk = LongContextBlock(num_heads=model.num_heads,
+                           mlp_dim=model.mlp_dim, dtype=model.dtype,
+                           attention_fn=model.attention_fn)
+
+    def stage_fn(stage_params, acts):
+        def one(a, p):
+            return blk.apply({"params": p}, a), None
+        out, _ = jax.lax.scan(one, acts, stage_params)
+        return out
+
+    pipe = make_gpipe(stage_fn, mesh, num_microbatches=num_microbatches,
+                      axis=axis, data_axis=data_axis, remat=remat)
+
+    def apply(variables, tokens):
+        stacked, rest = pipeline_stage_params(
+            variables["params"], num_stages,
+            block_prefix="LongContextBlock_")
+        x = model.apply({"params": rest}, tokens,
+                        method=LongContextTransformer.embed)
+        x = pipe(stacked, x)
+        return model.apply({"params": rest}, x,
+                           method=LongContextTransformer.head)
+
+    return apply

@@ -178,6 +178,21 @@ class _ShardedShuffle:
         self.drop_remainder = drop_remainder
         self._epoch = 0
         self._offset = 0  # batches already yielded within the epoch
+        self._lock = threading.Lock()
+
+    # -- checkpointable-iterator protocol (trainer.fit looks for these);
+    # ONE implementation for every engine (Python, native, paired) so the
+    # exact-resume contract cannot drift between them --
+    def state(self) -> dict:
+        with self._lock:
+            return {"epoch": self._epoch, "offset": self._offset,
+                    "seed": self.seed}
+
+    def restore(self, state: dict) -> None:
+        with self._lock:
+            self.seed = int(state["seed"])
+            self._epoch = int(state["epoch"])
+            self._offset = int(state["offset"])
 
     def batches_per_epoch(self) -> int:
         rows = self.batch_size * self.shard_count
@@ -216,19 +231,6 @@ class StreamingLoader(_ShardedShuffle):
         self.source = source
         self.num_threads = num_threads
         self.read_ahead = max(1, read_ahead)
-        self._lock = threading.Lock()
-
-    # -- checkpointable-iterator protocol (trainer.fit looks for these) --
-    def state(self) -> dict:
-        with self._lock:
-            return {"epoch": self._epoch, "offset": self._offset,
-                    "seed": self.seed}
-
-    def restore(self, state: dict) -> None:
-        with self._lock:
-            self.seed = int(state["seed"])
-            self._epoch = int(state["epoch"])
-            self._offset = int(state["offset"])
 
     def __iter__(self) -> Iterator[np.ndarray]:
         # Not a `with` block: a generator abandoned mid-epoch is finalized
@@ -363,16 +365,10 @@ class PairedArrayLoader(_ShardedShuffle):
         self.images, self.tokens = images, tokens
         self._gen = None
 
-    def state(self) -> dict:
-        return {"epoch": self._epoch, "offset": self._offset,
-                "seed": self.seed}
-
     def restore(self, state: dict) -> None:
         if self._gen is not None:
             raise RuntimeError("restore() must run before iteration starts")
-        self.seed = int(state["seed"])
-        self._epoch = int(state["epoch"])
-        self._offset = int(state["offset"])
+        super().restore(state)
 
     def __next__(self):
         if self._gen is None:

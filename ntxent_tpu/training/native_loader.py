@@ -119,8 +119,6 @@ class NativeStreamingLoader(_ShardedShuffle):
         mm, file_off = _as_memmap(source)
         self._init_shuffle(len(mm), batch_size, seed, shard_index,
                            shard_count, drop_remainder)
-        import threading
-
         self._mm = mm
         self._file_offset = file_off
         self._row_shape = mm.shape[1:]
@@ -129,19 +127,7 @@ class NativeStreamingLoader(_ShardedShuffle):
                                                           dtype=np.int64))
         self.num_threads = num_threads
         self.read_ahead = max(1, read_ahead)
-        self._lock = threading.Lock()
         self._lib = _library()  # build (or load) eagerly: fail at init
-
-    def state(self) -> dict:
-        with self._lock:
-            return {"epoch": self._epoch, "offset": self._offset,
-                    "seed": self.seed}
-
-    def restore(self, state: dict) -> None:
-        with self._lock:
-            self.seed = int(state["seed"])
-            self._epoch = int(state["epoch"])
-            self._offset = int(state["offset"])
 
     def _submit(self, handle, order: np.ndarray, bi: int) -> np.ndarray:
         """Queue batch ``bi``; workers gather straight into the returned
