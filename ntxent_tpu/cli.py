@@ -54,9 +54,9 @@ def _add_common_args(p: argparse.ArgumentParser) -> None:
     m.add_argument("--proj-hidden-dim", type=int, default=2048)
     m.add_argument("--proj-dim", type=int, default=128)
     m.add_argument("--moe-experts", type=int, default=0,
-                   help="ViT models only: switch-MoE MLP with this many "
-                        "experts in every other block (parallel/moe.py); "
-                        "0 = dense MLPs")
+                   help="ViT towers only (simclr encoder / clip image "
+                        "tower): switch-MoE MLP with this many experts in "
+                        "every other block (parallel/moe.py); 0 = dense")
     m.add_argument("--moe-aux-weight", type=float, default=0.01,
                    help="weight of the MoE load-balance aux loss when "
                         "--moe-experts > 0 (Switch Transformer default)")
@@ -268,9 +268,6 @@ def main(argv=None) -> int:
             logger.warning("--dp-loss %s ignored: the CLIP objective uses "
                            "the InfoNCE loss family (see --clip-parallel)",
                            args.dp_loss)
-        if args.moe_experts > 0:
-            logger.warning("--moe-experts ignored: MoE towers are wired for "
-                           "the simclr objective only")
         if args.loader != "python":
             logger.warning("--loader %s ignored: the CLIP objective uses "
                            "PairedArrayLoader", args.loader)
@@ -379,16 +376,18 @@ def _build_clip_model(args):
     from ntxent_tpu import models
     from ntxent_tpu.models import CLIPModel, TextTransformer
 
+    moe = getattr(args, "moe_experts", 0)
     if args.model == "tiny":
         image_enc = functools.partial(
             models.VisionTransformer, hidden_dim=32, depth=2, num_heads=2,
-            mlp_dim=64, patch_size=8)
+            mlp_dim=64, patch_size=8, moe_experts=moe)
         text_enc = functools.partial(
             TextTransformer, vocab_size=args.vocab_size,
             max_len=args.token_len, hidden_dim=32, depth=2, num_heads=2)
         embed_dim = 32
     else:
-        image_enc = _make_encoder(args.model, args.image_size)
+        image_enc = _make_encoder(args.model, args.image_size,
+                                  moe_experts=moe)
         text_enc = functools.partial(TextTransformer,
                                      vocab_size=args.vocab_size,
                                      max_len=args.token_len)
@@ -467,6 +466,7 @@ def _train_clip(args, info, per_process_batch: int) -> int:
     # Towers are built AFTER the data derivation above so the text tower's
     # max_len and the image tower's size match what will be fed.
     model = _build_clip_model(args)
+    moe_aux = args.moe_aux_weight if args.moe_experts > 0 else 0.0
     loader = PairedArrayLoader(images, tokens, per_process_batch,
                                seed=args.seed,
                                shard_index=info["process_index"],
@@ -502,7 +502,8 @@ def _train_clip(args, info, per_process_batch: int) -> int:
                                       args.model_par),
                                axis_names=("data", "model"))
             state = shard_train_state(state, mesh)
-            step = make_tp_clip_train_step(mesh, remat=args.remat)
+            step = make_tp_clip_train_step(mesh, remat=args.remat,
+                                           moe_aux_weight=moe_aux)
             logger.info("CLIP GSPMD (%d, %d) (data, model) mesh",
                         n_dev // args.model_par, args.model_par)
         else:
@@ -510,7 +511,8 @@ def _train_clip(args, info, per_process_batch: int) -> int:
                 make_sharded_clip_train_step)
 
             mesh = create_mesh(axis_names=("data",))
-            step = make_sharded_clip_train_step(mesh, remat=args.remat)
+            step = make_sharded_clip_train_step(mesh, remat=args.remat,
+                                                moe_aux_weight=moe_aux)
             # Same rationale as the SimCLR mesh path: restore must land
             # replicated on the mesh, not committed to one device.
             from ntxent_tpu.parallel.mesh import replicate_state
@@ -519,7 +521,8 @@ def _train_clip(args, info, per_process_batch: int) -> int:
                         "(fused partial InfoNCE)", n_dev)
         sharding = NamedSharding(mesh, P("data"))
     else:
-        step = make_clip_train_step(remat=args.remat)
+        step = make_clip_train_step(remat=args.remat,
+                                    moe_aux_weight=moe_aux)
         logger.info("CLIP single-device run")
 
     import jax.numpy as jnp

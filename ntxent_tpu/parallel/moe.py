@@ -46,7 +46,24 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = ["MoEParams", "init_moe_params", "switch_moe",
-           "make_expert_parallel_moe", "MoEMlp"]
+           "make_expert_parallel_moe", "MoEMlp", "moe_aux_from"]
+
+
+def moe_aux_from(updates) -> jax.Array:
+    """Summed MoE load-balance loss out of a mutated-variables dict.
+
+    Lives next to the module that sows ``moe_aux_loss`` (``MoEMlp``) and
+    selects ONLY those entries: other modules may sow unrelated
+    intermediates (debug activations, attention maps) that must never
+    leak into a training objective. Consumed by the trainers
+    (training/trainer.py, parallel/tp.py).
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        updates.get("intermediates", {}))
+    leaves = [v for path, v in flat
+              if any(getattr(k, "key", None) == "moe_aux_loss"
+                     for k in path)]
+    return sum(jnp.sum(a) for a in leaves) if leaves else jnp.float32(0)
 
 
 @dataclass(frozen=True)

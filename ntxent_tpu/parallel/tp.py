@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.oracle import info_nce_loss, ntxent_loss
+from .moe import moe_aux_from
 
 __all__ = [
     "tp_param_spec",
@@ -184,6 +185,7 @@ def make_tp_clip_train_step(
     *,
     data_axis: str = "data",
     remat: bool = False,
+    moe_aux_weight: float = 0.0,
 ) -> Callable:
     """Compiler-partitioned CLIP train step: dual towers, learnable scale.
 
@@ -192,8 +194,10 @@ def make_tp_clip_train_step(
     InfoNCE runs at temperature ``1/scale`` so the logit scale's gradient
     flows; GSPMD shards both towers over ``model`` and the (N, N) logit
     matmul over the mesh. ``remat`` rematerializes the tower forwards in
-    the backward pass.
+    the backward pass. ``moe_aux_weight > 0`` adds the MoE towers'
+    load-balance aux loss (a single global program — no pmean needed).
     """
+    collect = moe_aux_weight > 0.0
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, images, tokens):
@@ -201,17 +205,29 @@ def make_tp_clip_train_step(
         tkc = _constrain_batch(tokens, mesh, data_axis)
 
         def fwd(params, imc, tkc):
-            return state.apply_fn({"params": params}, imc, tkc, train=True)
+            if not collect:
+                out = state.apply_fn({"params": params}, imc, tkc,
+                                     train=True)
+                return (*out, 0.0)
+            out, updates = state.apply_fn(
+                {"params": params}, imc, tkc, train=True,
+                mutable=["intermediates"])
+            return (*out, moe_aux_from(updates))
 
         towers = jax.checkpoint(fwd) if remat else fwd
 
         def loss_fn(params):
-            zi, zt, scale = towers(params, imc, tkc)
+            zi, zt, scale, aux = towers(params, imc, tkc)
             zi = _constrain_batch(zi, mesh, data_axis)
             zt = _constrain_batch(zt, mesh, data_axis)
-            return info_nce_loss(zi, zt, temperature=1.0 / scale)
+            return info_nce_loss(zi, zt, temperature=1.0 / scale) \
+                + moe_aux_weight * aux, aux
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        return state.apply_gradients(grads=grads), {"loss": loss}
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        metrics = {"loss": loss}
+        if collect:
+            metrics["moe_aux"] = aux
+        return state.apply_gradients(grads=grads), metrics
 
     return train_step

@@ -31,6 +31,7 @@ from ..parallel.dist_loss import (
     resolve_local_infonce,
     resolve_local_ntxent,
 )
+from ..parallel.moe import moe_aux_from
 from .lars import cosine_warmup_schedule, create_lars, simclr_learning_rate
 
 logger = logging.getLogger(__name__)
@@ -116,17 +117,7 @@ def _apply_two_views(state: TrainState, params, v1, v2, train: bool = True,
         fwd = jax.checkpoint(fwd)
     z, updates = fwd(variables, both)
     n = v1.shape[0]
-    aux = 0.0
-    if collect_moe_aux:
-        # Select ONLY the moe_aux_loss entries: other modules may sow
-        # unrelated intermediates (debug activations, attention maps) that
-        # must never leak into the objective.
-        flat, _ = jax.tree_util.tree_flatten_with_path(
-            updates.get("intermediates", {}))
-        leaves = [v for path, v in flat
-                  if any(getattr(k, "key", None) == "moe_aux_loss"
-                         for k in path)]
-        aux = sum(jnp.sum(a) for a in leaves) if leaves else jnp.float32(0)
+    aux = moe_aux_from(updates) if collect_moe_aux else 0.0
     return z[:n], z[n:], updates["batch_stats"], aux
 
 
@@ -177,26 +168,36 @@ def make_train_step(temperature: float = 0.1,
     return train_step
 
 
-def _clip_towers(state, remat: bool):
+def _clip_towers(state, remat: bool, collect_moe_aux: bool = False):
     """Dual-tower forward closure shared by both CLIP steps (the analog of
-    ``_apply_two_views`` for the SimCLR pair): params -> (zi, zt, scale),
-    optionally rematerialized in the backward pass."""
+    ``_apply_two_views`` for the SimCLR pair): params ->
+    (zi, zt, scale, moe_aux), optionally rematerialized in the backward
+    pass (``moe_aux`` is 0.0 unless ``collect_moe_aux``)."""
 
     def fwd(params, images, tokens):
-        return state.apply_fn({"params": params}, images, tokens,
-                              train=True)
+        if not collect_moe_aux:
+            zi, zt, scale = state.apply_fn(
+                {"params": params}, images, tokens, train=True)
+            return zi, zt, scale, 0.0
+        (zi, zt, scale), updates = state.apply_fn(
+            {"params": params}, images, tokens, train=True,
+            mutable=["intermediates"])
+        return zi, zt, scale, moe_aux_from(updates)
 
     return jax.checkpoint(fwd) if remat else fwd
 
 
 def make_clip_train_step(use_fused: bool | None = None,
-                         remat: bool = False) -> Callable:
+                         remat: bool = False,
+                         moe_aux_weight: float = 0.0) -> Callable:
     """Single-device CLIP train step: dual towers, learnable logit scale.
 
     ``state.apply_fn(variables, images, tokens)`` must return
     ``(image_embeds, text_embeds, scale)`` (models/clip.py). Symmetric
     InfoNCE runs at temperature ``1/scale`` so the scale's gradient flows.
     ``remat`` rematerializes the tower forwards in the backward pass.
+    ``moe_aux_weight > 0`` adds the MoE towers' load-balance aux loss
+    (reported under ``metrics["moe_aux"]``).
     The multi-chip equivalents are ``parallel.tp.make_tp_clip_train_step``
     (GSPMD) and the ring/all-gather InfoNCE losses (parallel/).
     """
@@ -214,17 +215,22 @@ def make_clip_train_step(use_fused: bool | None = None,
 
         def loss_of(zi, zt, scale):
             return _nce(zi, zt, temperature=1.0 / scale)
+    collect = moe_aux_weight > 0.0
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, images, tokens):
-        towers = _clip_towers(state, remat)
+        towers = _clip_towers(state, remat, collect_moe_aux=collect)
 
         def loss_fn(params):
-            zi, zt, scale = towers(params, images, tokens)
-            return loss_of(zi, zt, scale)
+            zi, zt, scale, aux = towers(params, images, tokens)
+            return loss_of(zi, zt, scale) + moe_aux_weight * aux, aux
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        return state.apply_gradients(grads=grads), {"loss": loss}
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        metrics = {"loss": loss}
+        if collect:
+            metrics["moe_aux"] = aux
+        return state.apply_gradients(grads=grads), metrics
 
     return train_step
 
@@ -274,7 +280,11 @@ def make_sharded_train_step(
         new_stats = jax.lax.pmean(new_stats, axis)
         state = state.apply_gradients(grads=grads)
         state = state.replace(batch_stats=new_stats)
-        metrics = {"loss": loss}
+        # The aux term varies per shard (each device routes its own
+        # batch); pmean the REPORTED loss so it equals the optimized
+        # objective (whose gradient is the pmean above) on every device —
+        # the P() out_spec would otherwise publish one arbitrary shard's.
+        metrics = {"loss": jax.lax.pmean(loss, axis) if collect else loss}
         if collect:
             metrics["moe_aux"] = jax.lax.pmean(aux, axis)
         return state, metrics
@@ -295,6 +305,7 @@ def make_sharded_clip_train_step(
     interpret: bool | None = None,
     remat: bool = False,
     loss_impl: str = "dual",
+    moe_aux_weight: float = 0.0,
 ) -> Callable:
     """Distributed CLIP train step over the mesh's data axis (shard_map).
 
@@ -308,19 +319,29 @@ def make_sharded_clip_train_step(
     gather-both/walk-twice form. This is the production TPU path for
     data-parallel CLIP; use ``parallel.tp.make_tp_clip_train_step`` when
     the towers themselves need sharding (GSPMD tensor parallelism).
+    ``moe_aux_weight``: as in ``make_sharded_train_step`` (aux pmean'd —
+    the dp=ep estimator over per-shard routing).
     """
     local_loss = resolve_local_infonce(loss_impl)
+    collect = moe_aux_weight > 0.0
 
     def per_device_step(state, images, tokens):
-        towers = _clip_towers(state, remat)
+        towers = _clip_towers(state, remat, collect_moe_aux=collect)
 
         def loss_fn(params):
-            zi, zt, scale = towers(params, images, tokens)
-            return local_loss(zi, zt, scale, axis, interpret)
+            zi, zt, scale, aux = towers(params, images, tokens)
+            return local_loss(zi, zt, scale, axis, interpret) \
+                + moe_aux_weight * aux, aux
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
         grads = jax.lax.pmean(grads, axis)
-        return state.apply_gradients(grads=grads), {"loss": loss}
+        # Same rationale as make_sharded_train_step: the per-shard aux
+        # makes loss shard-varying; report the pmean (== the objective).
+        metrics = {"loss": jax.lax.pmean(loss, axis) if collect else loss}
+        if collect:
+            metrics["moe_aux"] = jax.lax.pmean(aux, axis)
+        return state.apply_gradients(grads=grads), metrics
 
     sharded = jax.shard_map(
         per_device_step,

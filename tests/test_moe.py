@@ -158,3 +158,61 @@ def test_moe_vit_train_step(rng):
     step0 = make_train_step(use_fused=False)
     _, metrics0 = step0(state, v1, v2)
     assert "moe_aux" not in metrics0
+
+
+def _tiny_moe_clip(rng):
+    import functools
+
+    from ntxent_tpu.models import CLIPModel, TextTransformer, VisionTransformer
+
+    model = CLIPModel(
+        image_encoder=functools.partial(
+            VisionTransformer, patch_size=8, hidden_dim=16, depth=2,
+            num_heads=2, mlp_dim=32, dtype=jnp.float32, moe_experts=2),
+        text_encoder=functools.partial(
+            TextTransformer, vocab_size=32, max_len=8, hidden_dim=16,
+            depth=1, num_heads=2, dtype=jnp.float32),
+        embed_dim=8)
+    images = jax.random.uniform(jax.random.fold_in(rng, 1), (4, 16, 16, 3))
+    tokens = jax.random.randint(jax.random.fold_in(rng, 2), (4, 8), 1, 32)
+    variables = model.init(rng, images[:1], tokens[:1], train=False)
+    return model, variables, images, tokens
+
+
+def test_moe_clip_train_step(rng):
+    """CLIP with an MoE image tower: aux joins the InfoNCE objective."""
+    import optax
+
+    from ntxent_tpu.training.trainer import TrainState, make_clip_train_step
+
+    model, variables, images, tokens = _tiny_moe_clip(rng)
+    state = TrainState.create(apply_fn=model.apply,
+                              params=variables["params"],
+                              tx=optax.adamw(1e-3))
+    step = make_clip_train_step(use_fused=False, moe_aux_weight=0.01)
+    state, metrics = step(state, images, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(metrics["moe_aux"]))
+
+
+def test_moe_clip_tp_step(rng):
+    """GSPMD tensor-parallel CLIP step with an MoE image tower."""
+    import optax
+    from flax.training import train_state as ts
+
+    from ntxent_tpu.parallel import create_mesh
+    from ntxent_tpu.parallel.tp import (
+        make_tp_clip_train_step,
+        shard_train_state,
+    )
+
+    model, variables, images, tokens = _tiny_moe_clip(rng)
+    mesh = create_mesh(shape=(4, 2), axis_names=("data", "model"))
+    state = ts.TrainState.create(apply_fn=model.apply,
+                                 params=variables["params"],
+                                 tx=optax.adamw(1e-3))
+    state = shard_train_state(state, mesh)
+    step = make_tp_clip_train_step(mesh, moe_aux_weight=0.01)
+    state, metrics = step(state, images, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(metrics["moe_aux"]))
