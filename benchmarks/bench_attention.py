@@ -30,6 +30,10 @@ def main() -> None:
     p.add_argument("--ladder", default="1024,4096,8192")
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--autotune", action="store_true",
+                   help="also time the kernel at the measured-sweep tile "
+                        "(ops.autotune.autotune_attention_blocks) next to "
+                        "the static-heuristic tile")
     p.add_argument("--platform", default=None)
     p.add_argument("--out", default=None)
     args = p.parse_args()
@@ -82,6 +86,33 @@ def main() -> None:
                 entry["pallas_flash_ms"] = round(ms, 4)
                 entry["speedup"] = round(
                     entry["xla_oracle_ms"] / ms, 3) if ms else None
+                if args.autotune:
+                    from ntxent_tpu.ops import autotune_attention_blocks
+                    from ntxent_tpu.ops.attention_pallas import _blocks
+
+                    bq, bk = autotune_attention_blocks(
+                        l, l, args.head_dim, jnp.bfloat16, causal=causal,
+                        batch_heads=args.heads, include_backward=False)
+                    entry["tuned_blocks"] = [bq, bk]
+                    if (bq, bk) == _blocks(l, l, args.head_dim,
+                                           None, None, 2):
+                        # Winner == the heuristic tile already timed:
+                        # don't burn the scarce chip window re-measuring
+                        # the identical kernel config.
+                        entry["pallas_tuned_ms"] = entry["pallas_flash_ms"]
+                        entry["tuned_speedup"] = entry["speedup"]
+                    else:
+                        def tuned_loss(qq, _c=causal, _bq=bq, _bk=bk):
+                            return jnp.sum(
+                                flash_attention(qq, k, v, causal=_c,
+                                                block_q=_bq, block_kv=_bk)
+                                .astype(jnp.float32))
+
+                        ms, _ = time_fn_chained(tuned_loss, q, length=n,
+                                                spans=2, with_grad=False)
+                        entry["pallas_tuned_ms"] = round(ms, 4)
+                        entry["tuned_speedup"] = round(
+                            entry["xla_oracle_ms"] / ms, 3) if ms else None
             rows.append(entry)
             print(json.dumps(entry))
 

@@ -1,5 +1,5 @@
 from ntxent_tpu.ops import oracle
-from ntxent_tpu.ops.autotune import autotune_blocks
+from ntxent_tpu.ops.autotune import autotune_attention_blocks, autotune_blocks
 from ntxent_tpu.ops.blocks import choose_blocks
 from ntxent_tpu.ops.attention_pallas import flash_attention
 from ntxent_tpu.ops.infonce_pallas import info_nce_fused, info_nce_partial_fused
@@ -12,6 +12,7 @@ from ntxent_tpu.ops.ntxent_pallas import (
 __all__ = [
     "oracle",
     "choose_blocks",
+    "autotune_attention_blocks",
     "autotune_blocks",
     "ntxent_loss_fused",
     "ntxent_loss_and_lse",

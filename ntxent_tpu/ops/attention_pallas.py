@@ -392,18 +392,24 @@ def _unflat(x, b, h):
     return x.reshape(b, h, l, d).transpose(0, 2, 1, 3)
 
 
+def attention_working_set_bytes(bq: int, bk: int, d: int,
+                                itemsize: int = 4) -> int:
+    """VMEM bytes one (q-block, kv-block) attention tile keeps live:
+    q/k/v/do blocks + the fp32 s/p tile + fp32 accumulators. Shared by
+    the static chooser below and the measured sweep (ops/autotune.py)."""
+    return ((bq + 2 * bk) * d * itemsize         # q + k + v blocks
+            + bq * bk * 4 * 2                    # s and p, fp32
+            + (bq + bk) * d * 4 + bq * 8)        # accs + m/l
+
+
 def _blocks(l, lk, d, block_q, block_kv, itemsize=4):
     bq = block_q or min(256, round_up(l, 8))
     bk = block_kv or min(256, round_up(lk, 128))
     bq = round_up(min(bq, round_up(l, 8)), 8)
     bk = round_up(min(bk, round_up(lk, 128)), 128)
-    # Shrink un-pinned dimensions until the tile working set fits VMEM:
-    # q/k/v/do blocks + the fp32 s/p tile + fp32 accumulators.
-    def working_set(bq_, bk_):
-        return ((bq_ + 2 * bk_) * d * itemsize       # q + k + v blocks
-                + bq_ * bk_ * 4 * 2                  # s and p, fp32
-                + (bq_ + bk_) * d * 4 + bq_ * 8)     # accs + m/l
-    while working_set(bq, bk) > VMEM_BUDGET_BYTES:
+    # Shrink un-pinned dimensions until the tile working set fits VMEM.
+    while attention_working_set_bytes(bq, bk, d, itemsize) \
+            > VMEM_BUDGET_BYTES:
         if block_kv is None and bk > 128:
             bk //= 2
         elif block_q is None and bq > 8:

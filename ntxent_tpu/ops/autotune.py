@@ -1,4 +1,5 @@
-"""Measurement-based block autotuning for the fused loss kernels.
+"""Measurement-based block autotuning for the fused Pallas kernels
+(NT-Xent/InfoNCE loss tiles and flash-attention tiles).
 
 The static heuristic (blocks.choose_blocks) picks safe VMEM-fitting tiles;
 this module refines it the way the hardware actually votes: time a small
@@ -35,7 +36,8 @@ from .blocks import VMEM_BUDGET_BYTES, _working_set_bytes, round_up
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["autotune_blocks", "clear_cache", "cache_path"]
+__all__ = ["autotune_blocks", "autotune_attention_blocks", "clear_cache",
+           "cache_path"]
 
 _CACHE: dict[tuple, tuple[int, int]] = {}
 _DISK_CACHE: dict[str, list[int]] | None = None
@@ -96,14 +98,18 @@ def _store_disk_cache(key: tuple, best: tuple[int, int]) -> None:
         logger.debug("autotune cache not persisted: %s", e)
 
 
-def _candidates(rows: int, cols: int, dim: int, itemsize: int):
+def _candidates(rows: int, cols: int, dim: int, itemsize: int,
+                ws_fn=_working_set_bytes):
+    """(row, col) tile grid filtered by shape caps and the kernel's VMEM
+    working set (``ws_fn``: loss tiles by default, attention tiles via
+    ``attention_working_set_bytes`` — ONE generator for both sweeps)."""
     for br in _ROW_CANDIDATES:
         if br > round_up(rows, 8):
             continue
         for bc in _COL_CANDIDATES:
             if bc > round_up(cols, 128):
                 continue
-            if _working_set_bytes(br, bc, dim, itemsize) > VMEM_BUDGET_BYTES:
+            if ws_fn(br, bc, dim, itemsize) > VMEM_BUDGET_BYTES:
                 continue
             yield br, bc
 
@@ -152,37 +158,135 @@ def autotune_blocks(
     z = jax.random.normal(jax.random.PRNGKey(0), (rows, dim), jnp.float32)
     z = (z / jnp.linalg.norm(z, axis=-1, keepdims=True)).astype(dtype)
 
+    def make_loss(cand):
+        # The candidate rides as keyword defaults (introspectable via
+        # fn.__defaults__ — the sweep tests identify candidates that way).
+        def loss(zz, _br=cand[0], _bc=cand[1]):
+            return ntxent_loss_fused(zz, 0.07, block_rows=_br,
+                                     block_cols=_bc)
+
+        return loss
+
+    best = _measured_sweep(
+        key, _candidates(rows, cols, dim, jnp.dtype(dtype).itemsize),
+        make_loss, z, length=length, spans=spans,
+        with_grad=include_backward, budget_s=budget_s)
+    if best is None:
+        best = choose_blocks(rows, cols, dim, dtype)
+        _CACHE[key] = best
+    return best
+
+
+def _measured_sweep(key, candidates, make_loss, example, *, length, spans,
+                    with_grad, budget_s):
+    """Vote a candidate grid with the scanned-chain protocol; cache the
+    winner (in-process always; on disk only for a full, un-truncated
+    sweep). Returns None when no candidate could be measured — the caller
+    supplies (and caches) its static fallback.
+
+    Per-iteration timing is relay-distorted on tunneled backends
+    (time_fn_chained docstring), and a mis-timed vote here would silently
+    pin a bad tile in the persistent cache — hence chained votes only.
+    """
     deadline = None if budget_s is None else time.monotonic() + budget_s
     best, best_ms = None, float("inf")
     truncated = False
-    for br, bc in _candidates(rows, cols, dim, jnp.dtype(dtype).itemsize):
+    for cand in candidates:
         if deadline is not None and time.monotonic() > deadline:
             logger.warning("autotune budget (%.0fs) exhausted; best so far "
                            "wins", budget_s)
             truncated = True
             break
-
-        def loss(zz, _br=br, _bc=bc):
-            return ntxent_loss_fused(zz, 0.07, block_rows=_br, block_cols=_bc)
-
-        # Scanned-chain protocol (time_fn_chained docstring): per-iteration
-        # timing is relay-distorted on tunneled backends, and a mis-timed
-        # vote here silently pins a bad tile in the persistent cache.
         try:
-            ms, _ = time_fn_chained(loss, z, length=length, spans=spans,
-                                    with_grad=include_backward)
+            ms, _ = time_fn_chained(make_loss(cand), example, length=length,
+                                    spans=spans, with_grad=with_grad)
         except Exception as e:  # candidate failed to compile/fit: skip it
-            logger.debug("autotune candidate (%d, %d) failed: %s", br, bc, e)
+            logger.debug("autotune candidate %s failed: %s", cand, e)
             continue
-        logger.info("autotune (%d, %d): %.4f ms", br, bc, ms)
+        logger.info("autotune %s: %.4f ms", cand, ms)
         if ms < best_ms:
-            best, best_ms = (br, bc), ms
+            best, best_ms = tuple(cand), ms
+    if best is not None:
+        if not truncated:
+            # A truncated sweep's winner is only best-of-a-partial-grid;
+            # keep it for this process but don't pin it on disk for every
+            # future process on this device kind — the next full sweep
+            # decides.
+            _store_disk_cache(key, best)
+        _CACHE[key] = best
+    return best
+
+
+def _attention_candidates(l_q: int, l_kv: int, d: int, itemsize: int):
+    from .attention_pallas import attention_working_set_bytes
+
+    return _candidates(l_q, l_kv, d, itemsize,
+                       ws_fn=attention_working_set_bytes)
+
+
+def autotune_attention_blocks(
+    l_q: int,
+    l_kv: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+    *,
+    causal: bool = False,
+    batch_heads: int = 8,
+    include_backward: bool = True,
+    length: int = 50,
+    spans: int = 2,
+    budget_s: float | None = 120.0,
+) -> tuple[int, int]:
+    """Measured (block_q, block_kv) for the fused flash-attention kernels.
+
+    Same contract as ``autotune_blocks``, applied to
+    ``ops.attention_pallas.flash_attention``: scanned-chain votes on the
+    live device, winner cached per shape/causality/dtype/device-kind,
+    static VMEM heuristic as the off-device fallback. ``batch_heads``
+    sizes the representative B*H grid dimension the vote runs under.
+    """
+    from ..utils.capability import is_tpu_backend
+    from .attention_pallas import _blocks, flash_attention
+
+    itemsize = jnp.dtype(dtype).itemsize
+    fallback = _blocks(l_q, l_kv, head_dim, None, None, itemsize)
+    if not is_tpu_backend():
+        return fallback
+
+    # include_backward and batch_heads are part of the key: a forward-only
+    # vote (bench_attention.py) must never be served to a training-path
+    # caller whose backward kernels may prefer a different tile.
+    key = (f"v{_PROTOCOL_VERSION}", "attn", l_q, l_kv, head_dim,
+           bool(causal), bool(include_backward), batch_heads,
+           jnp.dtype(dtype).str, jax.default_backend(), _device_kind())
+    if key in _CACHE:
+        return _CACHE[key]
+    on_disk = _load_disk_cache().get(_disk_key(key))
+    if on_disk is not None:
+        best = (int(on_disk[0]), int(on_disk[1]))
+        _CACHE[key] = best
+        return best
+
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    shape = (1, l_q, batch_heads, head_dim)
+    q = (jax.random.normal(kq, shape) * 0.5).astype(dtype)
+    k = (jax.random.normal(kk, (1, l_kv, batch_heads, head_dim))
+         * 0.5).astype(dtype)
+    v = (jax.random.normal(kv, k.shape) * 0.5).astype(dtype)
+
+    def make_loss(cand):
+        def loss(qq, _bq=cand[0], _bk=cand[1]):
+            return jnp.sum(flash_attention(
+                qq, k, v, causal=causal, block_q=_bq, block_kv=_bk
+            ).astype(jnp.float32))
+
+        return loss
+
+    best = _measured_sweep(
+        key, _attention_candidates(l_q, l_kv, head_dim, itemsize),
+        make_loss, q, length=length, spans=spans,
+        with_grad=include_backward, budget_s=budget_s)
     if best is None:
-        best = choose_blocks(rows, cols, dim, dtype)
-    elif not truncated:
-        # A budget-truncated sweep's winner is only best-of-a-partial-grid;
-        # keep it for this process but don't pin it on disk for every
-        # future process on this device kind — the next full sweep decides.
-        _store_disk_cache(key, best)
-    _CACHE[key] = best
+        best = fallback
+        _CACHE[key] = best
     return best

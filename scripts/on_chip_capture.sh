@@ -91,8 +91,10 @@ commit_art "on-chip capture: TPU-gated pytest tier" "$OUT/" || true
 
 # 5b. Flash-attention A/B: fused Pallas kernel vs XLA's own fusion over
 #     the long-context L ladder (the attention_pallas.py design decision).
-run_step 1500 attention_ab - python benchmarks/bench_attention.py \
-    --out "$OUT/attention_ab.json" || true
+#     --autotune adds the measured-sweep tile next to the heuristic one
+#     (winners persist in the autotune cache snapshotted at step 1).
+run_step 2400 attention_ab - python benchmarks/bench_attention.py \
+    --autotune --out "$OUT/attention_ab.json" || true
 commit_art "on-chip capture: flash-attention vs XLA A/B ladder" "$OUT/" || true
 
 # 6. Loader-vs-step timing: real disk reads feeding the step (SURVEY §7.4

@@ -95,3 +95,51 @@ def test_sweep_all_candidates_fail_falls_back(sweep_env, monkeypatch):
     monkeypatch.setattr(autotune, "time_fn_chained", broken_timer)
     best = autotune_blocks(512, 512, 64, length=5, spans=1, budget_s=None)
     assert best == choose_blocks(512, 512, 64)
+
+
+def test_attention_cpu_falls_back_to_heuristic():
+    from ntxent_tpu.ops.attention_pallas import _blocks
+    from ntxent_tpu.ops.autotune import autotune_attention_blocks
+
+    clear_cache()
+    got = autotune_attention_blocks(4096, 4096, 64, jnp.bfloat16)
+    assert got == _blocks(4096, 4096, 64, None, None, 2)
+
+
+def test_attention_candidates_respect_vmem_and_shape():
+    from ntxent_tpu.ops.attention_pallas import attention_working_set_bytes
+    from ntxent_tpu.ops.autotune import _attention_candidates
+    from ntxent_tpu.ops.blocks import VMEM_BUDGET_BYTES
+
+    cands = list(_attention_candidates(4096, 4096, 64, 2))
+    assert cands, "no candidates for a plain long-context shape"
+    assert all(attention_working_set_bytes(bq, bk, 64, 2)
+               <= VMEM_BUDGET_BYTES for bq, bk in cands)
+    small = list(_attention_candidates(64, 128, 64, 2))
+    assert all(bq <= 64 and bk <= 128 for bq, bk in small)
+
+
+def test_attention_sweep_picks_fastest_and_persists(sweep_env, monkeypatch):
+    from ntxent_tpu.ops.autotune import autotune_attention_blocks
+
+    calls = []
+
+    def fake_timer(fn, q, length, spans, with_grad):
+        bq, bk = fn.__defaults__
+        calls.append((bq, bk))
+        return (0.25 if (bq, bk) == (128, 256) else 1.0 + bq / 1e4), 0.0
+
+    monkeypatch.setattr(autotune, "time_fn_chained", fake_timer)
+    best = autotune_attention_blocks(1024, 1024, 64, jnp.bfloat16,
+                                     length=5, spans=1, budget_s=None)
+    assert best == (128, 256)
+    assert calls, "sweep never measured"
+    # Cached on disk under a DIFFERENT key family than the loss tiles:
+    # a fresh process must hit the file, not re-measure.
+    _CACHE.clear()
+    monkeypatch.setattr(autotune, "_DISK_CACHE", None)
+    calls.clear()
+    again = autotune_attention_blocks(1024, 1024, 64, jnp.bfloat16,
+                                      length=5, spans=1, budget_s=None)
+    assert again == (128, 256)
+    assert calls == []

@@ -175,3 +175,50 @@ def test_autotune_live_sweep_caches_winner():
                 os.environ.pop("NTXENT_TPU_CACHE", None)
             else:
                 os.environ["NTXENT_TPU_CACHE"] = old
+
+
+def test_attention_autotune_live_sweep_caches_winner():
+    """The flash-attention measured sweep on its real backend (the loss-
+    tile twin above; gates bench_attention.py --autotune)."""
+    import os
+    import tempfile
+
+    from ntxent_tpu.ops import autotune
+    from ntxent_tpu.ops.autotune import (
+        autotune_attention_blocks,
+        clear_cache,
+    )
+
+    clear_cache()
+    old = os.environ.get("NTXENT_TPU_CACHE")
+    real_timer = autotune.time_fn_chained
+    measurements = []
+
+    def spy(fn, q, **kw):
+        out = real_timer(fn, q, **kw)
+        measurements.append((fn.__defaults__, out[0]))
+        return out
+
+    autotune.time_fn_chained = spy
+    with tempfile.TemporaryDirectory() as tmp:
+        os.environ["NTXENT_TPU_CACHE"] = tmp
+        try:
+            bq, bk = autotune_attention_blocks(
+                1024, 1024, 64, length=5, spans=1, budget_s=60.0,
+                include_backward=False)
+            assert measurements, "live sweep measured no candidate"
+            assert all(np.isfinite(ms) and ms > 0
+                       for _, ms in measurements)
+            assert (bq, bk) in [blocks for blocks, _ in measurements]
+            n = len(measurements)
+            assert autotune_attention_blocks(
+                1024, 1024, 64, length=5, spans=1, budget_s=60.0,
+                include_backward=False) == (bq, bk)
+            assert len(measurements) == n, "cached winner was re-measured"
+        finally:
+            autotune.time_fn_chained = real_timer
+            clear_cache()
+            if old is None:
+                os.environ.pop("NTXENT_TPU_CACHE", None)
+            else:
+                os.environ["NTXENT_TPU_CACHE"] = old
