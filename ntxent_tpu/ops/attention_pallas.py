@@ -393,13 +393,20 @@ def _unflat(x, b, h):
 
 
 def attention_working_set_bytes(bq: int, bk: int, d: int,
-                                itemsize: int = 4) -> int:
+                                itemsize: int = 4,
+                                backward: bool = False) -> int:
     """VMEM bytes one (q-block, kv-block) attention tile keeps live:
-    q/k/v/do blocks + the fp32 s/p tile + fp32 accumulators. Shared by
-    the static chooser below and the measured sweep (ops/autotune.py)."""
-    return ((bq + 2 * bk) * d * itemsize         # q + k + v blocks
-            + bq * bk * 4 * 2                    # s and p, fp32
-            + (bq + bk) * d * 4 + bq * 8)        # accs + m/l
+    q/k/v blocks + the fp32 s/p tile + fp32 accumulators. Shared by the
+    static chooser below and the measured sweep (ops/autotune.py).
+    ``backward=True`` adds the recompute kernels' extra residents (the
+    do block and the dk/dv accumulator pair) so a backward-inclusive
+    sweep never admits tiles only the forward fits."""
+    ws = ((bq + 2 * bk) * d * itemsize           # q + k + v blocks
+          + bq * bk * 4 * 2                      # s and p, fp32
+          + (bq + bk) * d * 4 + bq * 8)          # accs + m/l
+    if backward:
+        ws += bq * d * itemsize + (bq + bk) * d * 4  # do + dq/dk/dv accs
+    return ws
 
 
 def _blocks(l, lk, d, block_q, block_kv, itemsize=4):

@@ -217,11 +217,16 @@ def _measured_sweep(key, candidates, make_loss, example, *, length, spans,
     return best
 
 
-def _attention_candidates(l_q: int, l_kv: int, d: int, itemsize: int):
+def _attention_candidates(l_q: int, l_kv: int, d: int, itemsize: int,
+                          include_backward: bool = False):
+    import functools as _ft
+
     from .attention_pallas import attention_working_set_bytes
 
-    return _candidates(l_q, l_kv, d, itemsize,
-                       ws_fn=attention_working_set_bytes)
+    return _candidates(
+        l_q, l_kv, d, itemsize,
+        ws_fn=_ft.partial(attention_working_set_bytes,
+                          backward=include_backward))
 
 
 def autotune_attention_blocks(
@@ -276,14 +281,22 @@ def autotune_attention_blocks(
 
     def make_loss(cand):
         def loss(qq, _bq=cand[0], _bk=cand[1]):
+            # The chain timer differentiates w.r.t. qq ONLY; tying k and
+            # v to qq keeps the dK/dV recompute kernel live in the vote —
+            # with independent k/v its cotangents feed nothing and XLA
+            # DCEs the very kernel a backward-inclusive vote must time.
+            tie = 1e-3 * jnp.mean(qq) if include_backward else 0.0
+            kk = k + tie  # scalar tie: shape-safe for l_q != l_kv
+            vv = v + tie
             return jnp.sum(flash_attention(
-                qq, k, v, causal=causal, block_q=_bq, block_kv=_bk
+                qq, kk, vv, causal=causal, block_q=_bq, block_kv=_bk
             ).astype(jnp.float32))
 
         return loss
 
     best = _measured_sweep(
-        key, _attention_candidates(l_q, l_kv, head_dim, itemsize),
+        key, _attention_candidates(l_q, l_kv, head_dim, itemsize,
+                                   include_backward=include_backward),
         make_loss, q, length=length, spans=spans,
         with_grad=include_backward, budget_s=budget_s)
     if best is None:

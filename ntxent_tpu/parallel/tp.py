@@ -101,6 +101,23 @@ def tp_param_spec(path, leaf, *, model_axis: str = "model") -> P:
         elif dense == "Dense_1":  # down-projection: row-sharded (psum after)
             if leaf_name == "kernel" and leaf.ndim == 2:
                 return P(model_axis, None)
+    if any("MoEMlp" in n for n in names):
+        # MoE weights under TP: Megatron WITHIN each expert — the hidden
+        # (f) axis shards over `model` (up-projection column-parallel,
+        # down-projection row-parallel; XLA inserts the psum after the f
+        # contraction). The expert axis deliberately stays unsharded:
+        # expert-dim sharding makes the partitioner emit the
+        # scatter/all-to-all path, which XLA:CPU's threaded runtime
+        # executes with a nondeterministic abort (~40% of runs on the
+        # 8-device host mesh) — psum-only programs are stable everywhere.
+        # True expert-dim EP is the explicit shard_map path
+        # (parallel/moe.py:make_expert_parallel_moe). Router replicated.
+        if leaf_name == "w_up" and leaf.ndim == 3:    # (E, d, f)
+            return P(None, None, model_axis)
+        if leaf_name == "b_up" and leaf.ndim == 2:    # (E, f)
+            return P(None, model_axis)
+        if leaf_name == "w_down" and leaf.ndim == 3:  # (E, f, d)
+            return P(None, model_axis, None)
     return P()
 
 

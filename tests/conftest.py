@@ -34,9 +34,36 @@ jax.config.update("jax_platforms", _PLATFORM)
 # programs hit disk — repeat runs of the tier drop from ~9 min toward the
 # execute-only floor. Point NTXENT_JAX_CACHE elsewhere (or at '') to move
 # or disable it.
+#
+# The cache dir is suffixed with a hash of the host's CPU feature flags:
+# XLA:CPU persists AOT machine code, and this workspace migrates across a
+# heterogeneous host fleet — an executable compiled for another machine's
+# features loads with a cpu_aot_loader feature-mismatch warning and XLA
+# itself says it "could lead to execution errors such as SIGILL".
+# Per-host-type subdirs remove that class entirely; each machine type
+# warms its own cache. (Self-written entries also warn, about XLA's own
+# "+prefer-no-scatter" pseudo-features — that one is benign.)
+
+
+def _host_cpu_tag() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    import hashlib
+
+                    return hashlib.sha1(line.encode()).hexdigest()[:10]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine() or "unknown"
+
+
 _JAX_CACHE = os.environ.get(
     "NTXENT_JAX_CACHE",
-    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache"))
+    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache",
+                 _host_cpu_tag()))
 if _JAX_CACHE:
     jax.config.update("jax_compilation_cache_dir", _JAX_CACHE)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -212,6 +212,18 @@ def test_moe_clip_tp_step(rng):
                                  params=variables["params"],
                                  tx=optax.adamw(1e-3))
     state = shard_train_state(state, mesh)
+    # MoE weights shard Megatron-style WITHIN each expert (hidden axis
+    # over model; expert axis unsharded — see tp_param_spec's rationale);
+    # the router stays replicated (every token scores every expert).
+    def spec_of(suffix):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                state.params)[0]:
+            if jax.tree_util.keystr(path).endswith(suffix):
+                return leaf.sharding.spec
+        raise AssertionError(f"no param path ends with {suffix}")
+    assert spec_of("['w_up']") == (None, None, "model")
+    assert spec_of("['w_down']") == (None, "model", None)
+    assert spec_of("['router']") == ()
     step = make_tp_clip_train_step(mesh, moe_aux_weight=0.01)
     state, metrics = step(state, images, tokens)
     assert np.isfinite(float(metrics["loss"]))
