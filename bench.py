@@ -4,10 +4,15 @@ Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...}.
 Baseline target (BASELINE.json north star): < 2 ms/step fwd+bwd at
 N x D = 4096 x 128; vs_baseline = target_ms / measured_ms (>1 beats it).
 
-Protocol mirrors the reference harnesses: warmup then timed runs with a
-device sync per iteration (src/benchmark.cpp:25-39 used warmup 1 + 100 runs
-with cudaDeviceSynchronize; python/test.py:97-121 used warmup 10 + 100 runs)
-— here jax.block_until_ready plays the sync role.
+Two protocols run every time and both land in the record:
+* reference mirror (protocol_mean_ms): warmup then timed runs with a device
+  sync per iteration (src/benchmark.cpp:25-39 used warmup 1 + 100 runs with
+  cudaDeviceSynchronize; python/test.py:97-121 used warmup 10 + 100 runs) —
+  here jax.block_until_ready plays the sync role;
+* chained steady state (the headline "value"): 100 data-dependent steps in
+  ONE jitted lax.scan dispatch ended by a real device-to-host read — the
+  per-step time the hardware actually sustains, immune to relay/tunnel
+  distortion in both directions (see main() for why the headline uses it).
 
 Robustness contract (this script runs unattended as the round's one
 driver-visible deliverable, so it must never hang and never emit
@@ -226,12 +231,31 @@ def main() -> None:
 
     if payload is not None:
         mean_ms = payload.pop("mean_ms")
-        # Headline value: the LARGER of the reference protocol (per-iter
-        # sync mean) and the chained+D2H steady state. They agree on honest
-        # backends (steady state is usually a hair lower); where a relay's
-        # readiness signal fires early, only the chained number is physical.
-        value_ms = max(mean_ms, payload.get("steady_state_ms", 0.0))
+        # Headline value: the chained+D2H steady state — N data-DEPENDENT
+        # steps inside ONE dispatch, ended by a real device-to-host read.
+        # That protocol is immune to relay distortion in BOTH directions:
+        # an early readiness signal cannot shrink it (the final value must
+        # actually arrive on the host) and a per-step RPC round trip cannot
+        # inflate it (there is only one dispatch for the whole span). The
+        # reference per-iter-sync mean stays in the record as
+        # protocol_mean_ms; on local hardware the two agree (sync costs
+        # microseconds), but through the remote-relay tunnel the per-iter
+        # protocol has measured BOTH ~65 ms/iter of pure network RTT
+        # (commit 0f61fd0's bench_headline.json: mean 69.27 ms over a
+        # 0.81 ms steady state) and sub-physical means from early
+        # readiness signals (11 minutes later, same chip: mean 0.134 ms,
+        # min 0.028 ms — under the device time) — neither is the device,
+        # so no max()/min() policy over the two can be right; only the
+        # chained number is physical in every regime.
+        steady_ms = payload.get("steady_state_ms", 0.0)
+        value_ms = steady_ms if steady_ms > 0.0 else mean_ms
         payload["protocol_mean_ms"] = mean_ms
+        # The dispersion stats belong to the per-iter protocol, not to
+        # "value" — prefix them so they cannot be read as the headline's
+        # spread (through the tunnel they describe relay behavior).
+        for stat in ("std_ms", "min_ms", "max_ms"):
+            if stat in payload:
+                payload[f"protocol_{stat}"] = payload.pop(stat)
         record = {
             "metric": METRIC,
             "value": round(value_ms, 4),

@@ -370,8 +370,8 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
     chain_exec = None
     if on_accel:
         from ntxent_tpu.utils.profiling import (
+            chain_flops_per_step,
             compile_chain,
-            flops_from_compiled,
             time_chain,
         )
 
@@ -381,10 +381,11 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
             s2, m = step_fn(s, *step_args)
             return s2, m["loss"]
 
-        # ONE compile for the whole benchmark: flops come from the chain
-        # executable's own cost analysis (total / runs — the scan's
-        # per-iteration overhead beyond the step itself is negligible), so
-        # the step is never compiled a second time just for accounting.
+        # ONE backend compile for the whole benchmark: flops come from the
+        # chain executable's cost analysis via chain_flops_per_step, which
+        # probes whether this backend counts the scan body once or x trip
+        # count (TPU: once), so the step is never backend-compiled a
+        # second time just for accounting.
         try:
             chain_exec = compile_chain(chain_step, state, runs)
         except Exception as e:  # backend refused AOT of the scan: degrade
@@ -393,8 +394,7 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
                            "relay-timing distortion", e)
 
     if chain_exec is not None:
-        total = flops_from_compiled(chain_exec)
-        flops = total / runs if total else None
+        flops = chain_flops_per_step(chain_exec, runs)
         chained_ms, state, final_loss = time_chain(
             chain_exec, state, length=runs, spans=2)
 

@@ -10,6 +10,7 @@ from ntxent_tpu.utils.logging_utils import setup_logging
 from ntxent_tpu.utils.memory import DeviceMemoryTracker, device_memory_mb
 from ntxent_tpu.utils.profiling import (
     BenchmarkResults,
+    chain_flops_per_step,
     compile_chain,
     flops_from_compiled,
     measured_flops,
@@ -30,6 +31,7 @@ __all__ = [
     "DeviceMemoryTracker",
     "device_memory_mb",
     "BenchmarkResults",
+    "chain_flops_per_step",
     "compile_chain",
     "flops_from_compiled",
     "measured_flops",

@@ -6,6 +6,8 @@ import jax
 import jax.numpy as jnp
 
 from ntxent_tpu.utils.profiling import (
+    chain_flops_per_step,
+    compile_chain,
     measured_flops,
     time_fn,
     time_fn_chained,
@@ -61,6 +63,58 @@ def test_measured_flops_matches_matmul_arithmetic(rng):
         return
     # XLA counts a multiply-add as 2 FLOPs: 2*m*k*n for the matmul.
     assert abs(flops - 2 * m * k * n) / (2 * m * k * n) < 0.05, flops
+
+
+def test_chain_flops_per_step_matches_single_step(rng):
+    # Backends disagree on whether a scan BODY's FLOPs are reported once
+    # or multiplied by the trip count (XLA:CPU and TPU: once). Whatever
+    # this backend does, chain_flops_per_step must land on the per-STEP
+    # count — misclassification here is a silent chain-length-x MFU skew
+    # (the 30x understatement fixed in round 3).
+    n, length = 64, 6
+
+    def step(c):
+        c2 = jnp.tanh(c @ c)
+        return c2, jnp.sum(c2)
+
+    exec_ = compile_chain(step, jnp.eye(n, dtype=jnp.float32), length)
+    per_step = chain_flops_per_step(exec_, length)
+    if per_step is None:  # backend offers no cost analysis: nothing to pin
+        return
+    single = 2 * n * n * n  # the matmul dominates the step
+    assert 0.5 * single < per_step < 3 * single, per_step
+
+
+def test_chain_flops_probe_failure_not_memoized(monkeypatch):
+    # A transiently failed probe must fall back conservatively for THAT
+    # call only — memoizing the failure would pin the understated reading
+    # for the whole process (review finding, round 3).
+    from ntxent_tpu.utils import profiling
+
+    monkeypatch.setattr(profiling, "_SCAN_FLOP_SEMANTICS", {})
+    real_compile = profiling.compile_chain
+    calls = {"n": 0}
+
+    def flaky_compile(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("tunnel hiccup")
+        return real_compile(*a, **k)
+
+    monkeypatch.setattr(profiling, "compile_chain", flaky_compile)
+    assert profiling._scan_body_flop_semantics() == "scaled"
+    assert profiling._SCAN_FLOP_SEMANTICS == {}  # failure NOT cached
+    verdict = profiling._scan_body_flop_semantics()  # re-probes
+    if profiling.flops_from_compiled(
+            real_compile(lambda c: (c, c[0, 0]),
+                         jnp.zeros((2, 2), jnp.float32), 2)) is None:
+        # Backend offers no cost analysis at all: every probe degrades,
+        # nothing is memoized — also correct.
+        assert verdict == "scaled"
+        assert profiling._SCAN_FLOP_SEMANTICS == {}
+    else:
+        assert profiling._SCAN_FLOP_SEMANTICS.get(jax.default_backend()) \
+            == verdict
 
 
 def test_trace_writes_profile_artifacts(tmp_path, rng):
