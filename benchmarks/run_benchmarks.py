@@ -377,8 +377,11 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
 
         step_fn = step
 
-        def chain_step(s):
-            s2, m = step_fn(s, *step_args)
+        # The batch rides as chain ARGUMENTS (see compile_chain): closing
+        # over it embeds it as an HLO constant, and at RN50 batch 256 that
+        # ~308 MB payload 413s the tunnel's remote-compile endpoint.
+        def chain_step(s, *args):
+            s2, m = step_fn(s, *args)
             return s2, m["loss"]
 
         # ONE backend compile for the whole benchmark: flops come from the
@@ -387,7 +390,7 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
         # count (TPU: once), so the step is never backend-compiled a
         # second time just for accounting.
         try:
-            chain_exec = compile_chain(chain_step, state, runs)
+            chain_exec = compile_chain(chain_step, state, runs, *step_args)
         except Exception as e:  # backend refused AOT of the scan: degrade
             logger.warning("scan-chain AOT failed (%s); falling back to "
                            "the per-call protocol — numbers may carry "
@@ -396,10 +399,10 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
     if chain_exec is not None:
         flops = chain_flops_per_step(chain_exec, runs)
         chained_ms, state, final_loss = time_chain(
-            chain_exec, state, length=runs, spans=2)
+            chain_exec, state, *step_args, length=runs, spans=2)
 
         def trace_callable(s):
-            s, last = chain_exec(s)
+            s, last = chain_exec(s, *step_args)
             float(last)
             return s
     else:

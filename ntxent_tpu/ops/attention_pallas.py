@@ -119,7 +119,7 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s,
         # Rows that saw nothing (q padding) divide by l=0 -> guard to 1.
         l_safe = jnp.where(l_s[:] == 0.0, 1.0, l_s[:])
         o_ref[0] = (acc_s[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_s[:] + _log_l(l_s[:]))[:, 0]
+        lse_ref[0] = m_s[:] + _log_l(l_s[:])
 
 
 def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -144,12 +144,12 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             s = _causal_mask(s, i, j, bq, bk, q_off, k_off)
         p = jnp.where(s <= _NEG_INF * 0.5, 0.0,
-                      _exp0(s - lse_ref[0][:, None]))
+                      _exp0(s - lse_ref[0]))
         dp = jax.lax.dot_general(
             do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * sc
+        ds = p * (dp - delta_ref[0]) * sc
         dq_s[:] += jax.lax.dot(ds.astype(k_ref.dtype), k_ref[0],
                                preferred_element_type=jnp.float32)
 
@@ -187,7 +187,7 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             s = _causal_mask(s, i, j, bq, bk, q_off, k_off)
         p = jnp.where(s <= _NEG_INF * 0.5, 0.0,
-                      _exp0(s - lse_ref[0][:, None]))
+                      _exp0(s - lse_ref[0]))
         do32 = do_ref[0].astype(jnp.float32)
         # dV_j += P^T dO_i ; dS = P*(dO V_j^T - delta) ; dK_j += dS^T Q_i
         dv_s[:] += jax.lax.dot_general(
@@ -197,7 +197,7 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do32, v_ref[0].astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * sc
+        ds = p * (dp - delta_ref[0]) * sc
         dk_s[:] += jax.lax.dot_general(
             ds, q_ref[0].astype(jnp.float32),
             dimension_numbers=(((0,), (0,)), ((), ())),
@@ -242,16 +242,15 @@ def _fold_kernel(offs_ref, q_ref, k_ref, v_ref, m_in, l_in, acc_in,
         s = _pad_mask(s, j, bk, cols_actual)
         if causal:
             s = _causal_mask(s, i, j, bq, bk, q_off, k_off)
-        m_old = m_out[0][:, None]
+        m_old = m_out[0]
         m_new = jnp.maximum(m_old, jnp.max(s, axis=1, keepdims=True))
         p = jnp.where(s <= _NEG_INF * 0.5, 0.0, _exp0(s - m_new))
         alpha = _exp0(m_old - m_new)
-        l_out[0] = (l_out[0][:, None] * alpha
-                    + jnp.sum(p, axis=1, keepdims=True))[:, 0]
+        l_out[0] = l_out[0] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_out[0] = acc_out[0] * alpha + jax.lax.dot(
             p.astype(v_ref.dtype), v_ref[0],
             preferred_element_type=jnp.float32)
-        m_out[0] = m_new[:, 0]
+        m_out[0] = m_new
 
     if causal:
         pl.when(_tile_live(i, j, bq, bk, q_off, k_off))(compute)
@@ -281,7 +280,9 @@ def flash_fold(qf, kf, vf, m, l, acc, *, q_offset, k_offset,
         interpret = _default_interpret()
     qf = _pad_axis1(qf, bq)
     kf, vf = _pad_axis1(kf, bk), _pad_axis1(vf, bk)
-    m, l, acc = _pad_axis1(m, bq), _pad_axis1(l, bq), _pad_axis1(acc, bq)
+    m = _pad_axis1(m, bq)[..., None]
+    l = _pad_axis1(l, bq)[..., None]
+    acc = _pad_axis1(acc, bq)
     lq, lk = qf.shape[1], kf.shape[1]
     offspec, qspec, kspec, rowvec = _specs(bq, bk, d)
     kernel = functools.partial(
@@ -293,13 +294,13 @@ def flash_fold(qf, kf, vf, m, l, acc, *, q_offset, k_offset,
         in_specs=[offspec, qspec, kspec, kspec, rowvec, rowvec, qspec],
         out_specs=[rowvec, rowvec, qspec],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
-            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
             jax.ShapeDtypeStruct((bh, lq, d), jnp.float32),
         ],
         interpret=interpret,
     )(_offs_arr(q_offset, k_offset), qf, kf, vf, m, l, acc)
-    return m[:, :lq_a], l[:, :lq_a], acc[:, :lq_a]
+    return m[:, :lq_a, 0], l[:, :lq_a, 0], acc[:, :lq_a]
 
 
 def flash_dq_hop(qf, kf, vf, dof, lsef, deltaf, *, q_offset, k_offset,
@@ -315,7 +316,8 @@ def flash_dq_hop(qf, kf, vf, dof, lsef, deltaf, *, q_offset, k_offset,
         interpret = _default_interpret()
     qf, dof = _pad_axis1(qf, bq), _pad_axis1(dof, bq)
     kf, vf = _pad_axis1(kf, bk), _pad_axis1(vf, bk)
-    lsef, deltaf = _pad_axis1(lsef, bq), _pad_axis1(deltaf, bq)
+    lsef = _pad_axis1(lsef, bq)[..., None]
+    deltaf = _pad_axis1(deltaf, bq)[..., None]
     lq, lk = qf.shape[1], kf.shape[1]
     offspec, qspec, kspec, rowvec = _specs(bq, bk, d)
     common = dict(bq=bq, bk=bk, sc=scale, causal=causal,
@@ -346,7 +348,8 @@ def flash_dkv_hop(qf, kf, vf, dof, lsef, deltaf, *, q_offset, k_offset,
         interpret = _default_interpret()
     qf, dof = _pad_axis1(qf, bq), _pad_axis1(dof, bq)
     kf, vf = _pad_axis1(kf, bk), _pad_axis1(vf, bk)
-    lsef, deltaf = _pad_axis1(lsef, bq), _pad_axis1(deltaf, bq)
+    lsef = _pad_axis1(lsef, bq)[..., None]
+    deltaf = _pad_axis1(deltaf, bq)[..., None]
     lq, lk = qf.shape[1], kf.shape[1]
     common = dict(bq=bq, bk=bk, sc=scale, causal=causal,
                   cols_actual=lk_a if cols_actual is None else cols_actual)
@@ -356,7 +359,7 @@ def flash_dkv_hop(qf, kf, vf, dof, lsef, deltaf, *, q_offset, k_offset,
                            memory_space=pltpu.VMEM)
     kspec_h = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
                            memory_space=pltpu.VMEM)
-    rowvec_v = pl.BlockSpec((1, bq), lambda b, j, i: (b, i),
+    rowvec_v = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0),
                             memory_space=pltpu.VMEM)
 
     def dkv_kernel(*refs):
@@ -439,7 +442,12 @@ def _specs(bq, bk, d):
                          memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM)
-    rowvec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i),
+    # Row statistics (lse/delta/m/l) ride as (bh, L, 1) column vectors:
+    # a (1, bq) block over a (bh, L) array is not a legal TPU tile
+    # (second-to-last block dim must be 8-divisible or span the array),
+    # but (1, bq, 1) over (bh, L, 1) is — the same layout the loss
+    # kernels use for their (rows, 1) statistics.
+    rowvec = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
                           memory_space=pltpu.VMEM)
     return offspec, qspec, kspec, rowvec
 
@@ -470,7 +478,7 @@ def _flash_fwd(q, k, v, sc, causal, q_off, k_off, bq, bk, interpret):
         out_specs=[qspec, rowvec],
         out_shape=[
             jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -487,7 +495,7 @@ def _flash_fwd(q, k, v, sc, causal, q_off, k_off, bq, bk, interpret):
         interpret=interpret,
     )(_offs_arr(q_off, k_off), qf, kf, vf)
     out = _unflat(o[:, :lq_a], b, h)
-    return out, (q, k, v, out, lse[:, :lq_a])
+    return out, (q, k, v, out, lse[:, :lq_a, 0])
 
 
 def _flash_bwd(sc, causal, q_off, k_off, bq, bk, interpret, res, g):

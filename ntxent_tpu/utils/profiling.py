@@ -55,29 +55,37 @@ def time_fn(fn, *args, warmup: int = 10, runs: int = 100) -> BenchmarkResults:
     )
 
 
-def compile_chain(step_fn, carry, length: int):
+def compile_chain(step_fn, carry, length: int, *consts):
     """AOT-compile a jitted ``lax.scan`` chain of ``length`` steps.
 
-    ``step_fn: carry -> (carry, scalar)``. The returned executable maps
-    ``carry -> (final_carry, last_scalar)``; for per-step FLOP counts off
-    its cost analysis use ``chain_flops_per_step`` (backends disagree on
-    whether a scan body is counted once or x trip count).
+    ``step_fn: (carry, *consts) -> (carry, scalar)``. The returned
+    executable maps ``(carry, *consts) -> (final_carry, last_scalar)``;
+    for per-step FLOP counts off its cost analysis use
+    ``chain_flops_per_step`` (backends disagree on whether a scan body is
+    counted once or x trip count).
+
+    ``consts`` (e.g. a fixed benchmark batch) MUST ride as arguments, not
+    closures: a closed-over device array becomes an HLO literal, and at
+    trainer-batch sizes the serialized module then carries hundreds of MB
+    of constant payload — big enough to blow a remote-compile relay's
+    request limit (observed: HTTP 413 at RN50 batch 256, ~308 MB of
+    embedded views).
     """
     from jax import lax
 
     @jax.jit
-    def chain(c0):
+    def chain(c0, *cs):
         def body(c, _):
-            c2, s = step_fn(c)
+            c2, s = step_fn(c, *cs)
             return c2, s
 
         cf, scalars = lax.scan(body, c0, None, length=length)
         return cf, scalars[-1]
 
-    return chain.lower(carry).compile()
+    return chain.lower(carry, *consts).compile()
 
 
-def time_chain(chain_exec, carry, *, length: int,
+def time_chain(chain_exec, carry, *consts, length: int,
                spans: int = 3) -> tuple[float, object, float]:
     """(best_per_step_ms, final_carry, final_scalar) of a compiled chain.
 
@@ -92,12 +100,12 @@ def time_chain(chain_exec, carry, *, length: int,
     ~7.7 ms/step of pure RPC at the 4096x128 headline shape). The final
     scalar read guarantees the work happened.
     """
-    carry, s = chain_exec(carry)  # warmup span
+    carry, s = chain_exec(carry, *consts)  # warmup span
     final = float(s)
     best_ms = float("inf")
     for _ in range(spans):
         t0 = time.perf_counter()
-        carry, s = chain_exec(carry)
+        carry, s = chain_exec(carry, *consts)
         final = float(s)  # D2H: returns only after the work ran
         best_ms = min(best_ms, (time.perf_counter() - t0) * 1e3 / length)
     return best_ms, carry, final

@@ -163,14 +163,17 @@ def main() -> None:
         if on_accel:
             from ntxent_tpu.utils.profiling import compile_chain, time_chain
 
-            def chain_step(s, _v1=v1, _v2=v2):
+            def chain_step(s, _v1, _v2):
                 s2, mm = step(s, _v1, _v2)
                 return s2, mm["loss"]
 
             try:
-                chain_exec = compile_chain(chain_step, state, 50)
+                # batch as chain ARGUMENTS, not closures — closed-over
+                # arrays embed as HLO constants and can 413 the tunnel's
+                # remote-compile endpoint (see profiling.compile_chain).
+                chain_exec = compile_chain(chain_step, state, 50, v1, v2)
                 staged_chain_ms, state, _ = time_chain(
-                    chain_exec, state, length=50, spans=2)
+                    chain_exec, state, v1, v2, length=50, spans=2)
             except Exception as e:
                 print(f"scan-chain staged timing failed: {e!r}",
                       file=sys.stderr)

@@ -20,13 +20,32 @@ if _PLATFORM == "cpu" and "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ["JAX_PLATFORMS"] = _PLATFORM
+
+# NTXENT_TEST_PLATFORM=tpu means "run on the accelerator, whatever JAX calls
+# it here": a real host registers the platform as 'tpu', the tunneled chip
+# registers as 'axon'. Forcing JAX_PLATFORMS=tpu would fail init on the
+# tunnel, so in tpu mode we leave platform selection to JAX (accelerators
+# outrank cpu) and fail fast below if none answered.
+if _PLATFORM != "tpu":
+    os.environ["JAX_PLATFORMS"] = _PLATFORM
+else:
+    # A stale JAX_PLATFORMS (e.g. exported by a prior cpu-tier run) would
+    # silently pin the backend and turn a healthy chip into a confusing
+    # "no accelerator" failure below.
+    os.environ.pop("JAX_PLATFORMS", None)
 
 import jax  # noqa: E402  (import after env setup)
 
-# A site plugin may have forced another platform at interpreter startup
-# (jax_platforms config wins over the env var) — force it back for tests.
-jax.config.update("jax_platforms", _PLATFORM)
+if _PLATFORM != "tpu":
+    # A site plugin may have forced another platform at interpreter startup
+    # (jax_platforms config wins over the env var) — force it back for tests.
+    jax.config.update("jax_platforms", _PLATFORM)
+else:
+    _backend = jax.default_backend()
+    if _backend not in ("tpu", "axon"):
+        raise RuntimeError(
+            "NTXENT_TEST_PLATFORM=tpu but no accelerator backend initialized "
+            f"(got {_backend!r}) — is the chip/tunnel alive?")
 
 # Persistent XLA compilation cache: the fast tier is COMPILE-dominated
 # (interpret-mode shard_map programs take 10-60 s each to build), and the
