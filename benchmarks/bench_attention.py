@@ -71,8 +71,9 @@ def main() -> None:
                     attention_oracle(qq, k, v, causal=_c).astype(jnp.float32))
 
             n = 20 if on_accel else 3
+            span = 400.0 if on_accel else None  # amortize tunnel RPC
             ms, _ = time_fn_chained(oracle_loss, q, length=n, spans=2,
-                                    with_grad=False)
+                                    with_grad=False, min_span_ms=span)
             entry["xla_oracle_ms"] = round(ms, 4)
             if on_accel:  # interpret-mode timing measures nothing
 
@@ -82,7 +83,7 @@ def main() -> None:
                         .astype(jnp.float32))
 
                 ms, _ = time_fn_chained(flash_loss, q, length=n, spans=2,
-                                        with_grad=False)
+                                        with_grad=False, min_span_ms=span)
                 entry["pallas_flash_ms"] = round(ms, 4)
                 entry["speedup"] = round(
                     entry["xla_oracle_ms"] / ms, 3) if ms else None
@@ -109,13 +110,22 @@ def main() -> None:
                                 .astype(jnp.float32))
 
                         ms, _ = time_fn_chained(tuned_loss, q, length=n,
-                                                spans=2, with_grad=False)
+                                                spans=2, with_grad=False,
+                                                min_span_ms=span)
                         entry["pallas_tuned_ms"] = round(ms, 4)
                         entry["tuned_speedup"] = round(
                             entry["xla_oracle_ms"] / ms, 3) if ms else None
             rows.append(entry)
             print(json.dumps(entry))
+            _write(args, on_accel, rows, jax)  # after EVERY row: the
+            # tunnel dies without warning; an end-only write lost 5
+            # completed rows to a wedged final rung once already.
 
+    out = _write(args, on_accel, rows, jax)
+    print(f"-> {out}")
+
+
+def _write(args, on_accel, rows, jax):
     out = args.out or str(
         REPO / "benchmark_results" / ("tpu" if on_accel else "cpu")
         / "attention_ab.json")
@@ -126,7 +136,7 @@ def main() -> None:
         json.dump({"timestamp": time.strftime("%Y%m%d_%H%M%S"),
                    "device_kind": jax.local_devices()[0].device_kind,
                    "rows": rows}, f, indent=1)
-    print(f"-> {out}")
+    return out
 
 
 if __name__ == "__main__":

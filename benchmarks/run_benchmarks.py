@@ -248,7 +248,8 @@ def run_distributed(quick: bool, results: dict):
 
 
 def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
-                   batch: int | None, remat: bool = False):
+                   batch: int | None, remat: bool = False,
+                   stem: str = "conv"):
     """(name, batch, size, state, step, step_args) for one flagship
     workload.
 
@@ -316,12 +317,18 @@ def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
             b, size, name = batch or 128, 224, "vit_b16"
     else:  # resnet50
         if small:
+            if stem != "conv":
+                logger.warning("--stem %s is ignored in the quick/"
+                               "off-accelerator tier (tiny small-images "
+                               "model has no ImageNet stem)", stem)
             encoder = functools.partial(ResNet, stage_sizes=(1, 1),
                                         small_images=True)
             b, size, name = batch or 16, 32, "resnet_tiny"
         else:
-            encoder = ResNet50
+            encoder = functools.partial(ResNet50, stem=stem)
             b, size, name = batch or 128, 224, "resnet50"
+            if stem != "conv":
+                name = f"resnet50[{stem}]"
     model = SimCLRModel(encoder=encoder, proj_hidden_dim=128, proj_dim=64)
     cfg = TrainerConfig(batch_size=b, total_steps=10, warmup_steps=2)
     state = create_train_state(model, jax.random.PRNGKey(0),
@@ -336,7 +343,8 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
                       model_name: str = "resnet50",
                       batch: int | None = None,
                       tag_batch: bool = False,
-                      remat: bool = False):
+                      remat: bool = False,
+                      stem: str = "conv"):
     """End-to-end train-step benchmark with automatic MFU.
 
     The role the reference's benchmark played for its hot path
@@ -353,7 +361,7 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
 
     on_accel = jax.default_backend() in ("tpu", "axon")
     name, batch, size, state, step, step_args = _trainer_setup(
-        model_name, quick, on_accel, batch, remat=remat)
+        model_name, quick, on_accel, batch, remat=remat, stem=stem)
 
     import time as _time
     runs = 5 if quick or not on_accel else 30
@@ -486,6 +494,12 @@ def main():
                         help="trainer-bench batch override; a comma list "
                              "(e.g. 64,128,256) sweeps batch sizes and "
                              "records one entry per size")
+    parser.add_argument("--stem", choices=["conv", "space_to_depth"],
+                        default="conv",
+                        help="ResNet stem variant: space_to_depth runs the "
+                             "7x7/s2 stem as an MXU-dense 4x4/s1 conv on "
+                             "space-to-depth input (weight-compatible; "
+                             "models/resnet.py:SpaceToDepthStem)")
     parser.add_argument("--remat", action="store_true",
                         help="rematerialize the encoder forward in the "
                              "backward pass (jax.checkpoint) — the "
@@ -536,7 +550,7 @@ def main():
                 run_trainer_bench(args.quick, results, args.trace,
                                   model_name=m, batch=b,
                                   tag_batch=len(batches) > 1,
-                                  remat=args.remat)
+                                  remat=args.remat, stem=args.stem)
 
     out_dir = Path(args.out)
     out_dir.mkdir(exist_ok=True)

@@ -51,6 +51,11 @@ def _add_common_args(p: argparse.ArgumentParser) -> None:
 
     m = p.add_argument_group("model")
     m.add_argument("--model", default="resnet50", choices=MODEL_CHOICES)
+    m.add_argument("--stem", default="conv",
+                   choices=["conv", "space_to_depth"],
+                   help="ResNet ImageNet stem: space_to_depth runs the "
+                        "7x7/s2 conv as an MXU-dense 4x4/s1 conv on "
+                        "space-to-depth input (weight-compatible)")
     m.add_argument("--proj-hidden-dim", type=int, default=2048)
     m.add_argument("--proj-dim", type=int, default=128)
     m.add_argument("--moe-experts", type=int, default=0,
@@ -152,11 +157,16 @@ def _npy_store_shape(args) -> tuple:
     return np.load(args.data_dir, mmap_mode="r").shape
 
 
-def _make_encoder(name: str, image_size: int, moe_experts: int = 0):
+def _make_encoder(name: str, image_size: int, moe_experts: int = 0,
+                  stem: str = "conv"):
     from ntxent_tpu import models
 
     if moe_experts > 0 and not name.startswith("vit"):
         raise SystemExit("--moe-experts requires a ViT model")
+    if stem != "conv" and not name.startswith("resnet"):
+        raise SystemExit(f"--stem {stem} applies to ResNet encoders only "
+                         f"(got --model {name}); it would be silently "
+                         "ignored")
     if name == "tiny":
         return functools.partial(models.ResNet, stage_sizes=(1,),
                                  small_images=True)
@@ -169,7 +179,16 @@ def _make_encoder(name: str, image_size: int, moe_experts: int = 0):
     }
     enc = table[name]
     if name.startswith("resnet") and image_size <= 64:
+        if stem != "conv":
+            raise SystemExit(
+                f"--stem {stem} applies to the ImageNet stem only; "
+                f"--image-size {image_size} selects the small-images "
+                "(3x3/s1) stem, which would silently ignore it")
         enc = functools.partial(enc, small_images=True)
+    elif name.startswith("resnet") and stem != "conv":
+        # MXU-dense ImageNet stem (weight-compatible with the plain one;
+        # models/resnet.py:SpaceToDepthStem).
+        enc = functools.partial(enc, stem=stem)
     if moe_experts > 0:
         enc = functools.partial(enc, moe_experts=moe_experts)
     return enc
@@ -294,7 +313,8 @@ def main(argv=None) -> int:
     from ntxent_tpu.training.trainer import make_sharded_train_step
 
     encoder = _make_encoder(args.model, args.image_size,
-                            moe_experts=args.moe_experts)
+                            moe_experts=args.moe_experts,
+                            stem=args.stem)
     model = SimCLRModel(encoder=encoder,
                         proj_hidden_dim=args.proj_hidden_dim,
                         proj_dim=args.proj_dim)
@@ -720,7 +740,8 @@ def eval_main(argv=None) -> int:
                                      params=variables0["params"], tx=tx)
     else:
         encoder = _make_encoder(args.model, args.image_size,
-                                moe_experts=args.moe_experts)
+                                moe_experts=args.moe_experts,
+                                stem=args.stem)
         model = SimCLRModel(encoder=encoder,
                             proj_hidden_dim=args.proj_hidden_dim,
                             proj_dim=args.proj_dim)

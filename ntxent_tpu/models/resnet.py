@@ -19,12 +19,63 @@ from collections.abc import Callable, Sequence
 from functools import partial
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 __all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
            "ResNet152", "ResNet50x2"]
 
 ModuleDef = Callable
+
+
+def _space_to_depth(x, block: int = 2):
+    """(B, H, W, C) -> (B, H/b, W/b, b*b*C), channel-major in (a, b, c)."""
+    B, H, W, C = x.shape
+    x = x.reshape(B, H // block, block, W // block, block, C)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        B, H // block, W // block, block * block * C)
+
+
+class SpaceToDepthStem(nn.Module):
+    """The ImageNet 7x7/stride-2 stem conv, executed MXU-friendly.
+
+    A 7x7/s2 conv on a 3-channel image uses 3 of the MXU's 128 input
+    lanes per tap — the single most padding-wasteful op in ResNet. The
+    MLPerf-TPU transform: space-to-depth the image by 2 (H/2, W/2, 12)
+    and run the EXACT same linear map as a 4x4/stride-1 conv whose
+    kernel is the 7x7 kernel zero-padded to 8x8 and phase-grouped.
+
+    Weight-compatible by construction: the parameter stays the standard
+    (7, 7, C, width) kernel (checkpoints interchange with the plain
+    stem); the pad + phase-group runs per apply and costs O(64*49*C)
+    elementwise work. Equivalence is pinned by
+    tests/test_models.py::test_s2d_stem_equivalence.
+    """
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        B, H, W, C = x.shape
+        if H % 2 or W % 2:
+            raise ValueError(f"space-to-depth stem needs even H/W, got "
+                             f"{(H, W)}")
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (7, 7, C, self.features),
+            jnp.float32)
+        # W'[ki, kj, (a, b, c), o] = W[2ki + a, 2kj + b, c, o] (zero at
+        # the padded 8th row/col): same taps, phase-major channel order
+        # matching _space_to_depth's (a, b, c) layout.
+        w = jnp.pad(kernel, ((0, 1), (0, 1), (0, 0), (0, 0)))
+        w = w.reshape(4, 2, 4, 2, C, self.features)
+        w = w.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * C, self.features)
+        xs = _space_to_depth(x.astype(self.dtype), 2)
+        # SAME at k=4/s1 pads (1, 2) — exactly the s2d image of the
+        # original SAME (2, 3) padding at k=7/s2.
+        return jax.lax.conv_general_dilated(
+            xs, w.astype(self.dtype), window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 class BottleneckBlock(nn.Module):
@@ -89,6 +140,7 @@ class ResNet(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     axis_name: str | None = None
     small_images: bool = False
+    stem: str = "conv"  # "conv" | "space_to_depth" (ImageNet stem only)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -101,10 +153,16 @@ class ResNet(nn.Module):
                        axis_name=self.axis_name if train else None)
         act = nn.relu
 
+        if self.stem not in ("conv", "space_to_depth"):
+            raise ValueError(f"unknown stem {self.stem!r}: expected 'conv' "
+                             "or 'space_to_depth'")
         x = x.astype(self.dtype)
         width = 64 * self.width_multiplier
         if self.small_images:
             x = conv(width, (3, 3), name="stem_conv")(x)
+        elif self.stem == "space_to_depth":
+            x = SpaceToDepthStem(width, dtype=self.dtype,
+                                 name="stem_conv")(x)
         else:
             x = conv(width, (7, 7), strides=(2, 2), name="stem_conv")(x)
         x = norm(name="stem_bn")(x)

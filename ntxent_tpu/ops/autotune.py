@@ -43,8 +43,12 @@ _CACHE: dict[tuple, tuple[int, int]] = {}
 _DISK_CACHE: dict[str, list[int]] | None = None
 
 # Bumped whenever the timing protocol changes: v2 = scanned-chain votes
-# (v1 per-iteration votes are relay-distorted and must not be reused).
-_PROTOCOL_VERSION = 2
+# (v1 per-iteration votes are relay-distorted and must not be reused);
+# v3 = span-amortized votes (v2 chains were too short at fast shapes —
+# ~64 ms of fixed tunnel dispatch on a 50x1.7 ms span made sub-ms votes
+# noise; measured consequence: a pinned 1024-causal attention tile 2.4x
+# slower than the heuristic, benchmark_results/tpu/attention_ab.json).
+_PROTOCOL_VERSION = 3
 
 _ROW_CANDIDATES = (64, 128, 256, 512)
 _COL_CANDIDATES = (128, 256, 512, 1024)
@@ -198,8 +202,12 @@ def _measured_sweep(key, candidates, make_loss, example, *, length, spans,
             truncated = True
             break
         try:
+            # min_span_ms: a short-chain vote on a tunneled backend is
+            # noise (fixed ~64 ms dispatch overhead vs sub-ms steps) and
+            # would pin a random tile in the persistent cache.
             ms, _ = time_fn_chained(make_loss(cand), example, length=length,
-                                    spans=spans, with_grad=with_grad)
+                                    spans=spans, with_grad=with_grad,
+                                    min_span_ms=400.0)
         except Exception as e:  # candidate failed to compile/fit: skip it
             logger.debug("autotune candidate %s failed: %s", cand, e)
             continue

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Run the full pending on-chip capture list (BASELINE.md "Pending on-chip
+# Run the pending on-chip capture list (BASELINE.md "Pending on-chip
 # captures") in priority order, committing each artifact the moment it
 # lands. Designed to run unattended from chip_watch.sh the instant the TPU
 # tunnel answers: the tunnel dies without warning (see BASELINE.md
@@ -7,13 +7,9 @@
 # every successful artifact is committed immediately — a mid-list wedge
 # loses only the remaining steps, never captured data.
 #
-# Priority order mirrors VERDICT r2 "Next round" #1/#2/#5:
-#   1. bench.py headline (fp32 + bf16 + triangular companions)
-#   2. RN50 MFU ladder (batch 64,128,256)
-#   3. ViT-B/16 and CLIP-B/16 train steps
-#   4. RN50 remat variant at the largest batch
-#   5. TPU-gated pytest tier
-#   6. XProf trace of the RN50 step
+# 2026-07-31 refresh (capture round 3b): the first window landed the
+# headline + MFU ladders + 5/6 attention A/B rows; this list is what
+# remains, plus re-votes under the v3 span-amortized autotune protocol.
 set -u
 REPO=/root/repo
 OUT="$REPO/benchmark_results/tpu"
@@ -37,78 +33,83 @@ run_step() {  # run_step <timeout_s> <name> <stdout_file|-> <cmd...>
     say "START $name (timeout ${t}s): $*"
     local rc
     if [ "$dest" = "-" ]; then
-        timeout "$t" "$@" >>"$LOG" 2>&1; rc=$?
+        timeout -k 30 "$t" "$@" >>"$LOG" 2>&1; rc=$?
     else
-        timeout "$t" "$@" >"$dest" 2>>"$LOG"; rc=$?
+        # Stage stdout and install only on success: '>' would truncate a
+        # previously captured evidence artifact the moment a (possibly
+        # doomed) rerun starts, and the unconditional commit would then
+        # clobber the committed number with an empty file.
+        timeout -k 30 "$t" "$@" >"$dest.tmp" 2>>"$LOG"; rc=$?
+        # KEEP_ON_FAIL=1 (e.g. a pytest report: failures are the point)
+        # installs any non-empty output regardless of rc.
+        if [ -s "$dest.tmp" ] && { [ $rc -eq 0 ] \
+                || [ "${KEEP_ON_FAIL:-0}" = 1 ]; }; then
+            mv -f "$dest.tmp" "$dest"
+        else
+            say "KEEP  $name: rc=$rc or empty output — prior $dest preserved"
+            rm -f "$dest.tmp"
+        fi
     fi
     say "DONE  $name rc=$rc"
     return $rc
 }
 
-say "=== on-chip capture session starting ==="
+say "=== on-chip capture session (r3b list) starting ==="
 
-# 1. Headline bench: bench.py prints exactly one JSON line on stdout.
-run_step 900 headline "$OUT/bench_headline.json" python bench.py || true
-# Snapshot the autotune cache the run refreshed (v2 protocol winner);
-# ops/autotune.py cache_path() = $NTXENT_TPU_CACHE or ~/.cache/ntxent_tpu.
+# 1. Headline bench: refreshes the autotune vote under the v3 protocol
+#    (v2 votes were short-chain noise at fast shapes and are invalidated).
+run_step 1200 headline "$OUT/bench_headline.json" python bench.py || true
 cp -f "${NTXENT_TPU_CACHE:-$HOME/.cache/ntxent_tpu}/autotune.json" \
     "$OUT/autotune_cache.json" 2>/dev/null || true
-commit_art "on-chip capture: bench.py headline (fp32/bf16/triangular)" \
+commit_art "on-chip capture: bench.py headline (v3 autotune protocol)" \
     "$OUT/" || true
 
-# 2. RN50 MFU ladder.
-run_step 2400 mfu_ladder - python benchmarks/run_benchmarks.py \
-    --trainer-only --model resnet50 --batch 64,128,256 \
-    --out "$OUT/mfu_rn50_ladder" || true
-commit_art "on-chip capture: RN50 MFU ladder batch 64/128/256" "$OUT/" || true
+# 2. TPU-gated test tier (conftest auto-resolves the platform name now).
+KEEP_ON_FAIL=1 run_step 1800 tpu_tests "$OUT/pytest_tpu_tier.txt" \
+    env NTXENT_TEST_PLATFORM=tpu \
+    python -m pytest tests/ -m tpu -q --no-header || true
+commit_art "on-chip capture: TPU-gated pytest tier" "$OUT/" || true
 
-# 3. ViT and CLIP flagship steps.
-run_step 1500 vit - python benchmarks/run_benchmarks.py \
-    --trainer-only --model vit_b16 --batch 64,128 \
-    --out "$OUT/mfu_vit_b16" || true
-commit_art "on-chip capture: ViT-B/16 train step" "$OUT/" || true
-
-run_step 1500 clip - python benchmarks/run_benchmarks.py \
-    --trainer-only --model clip_b16 --batch 64,128 \
-    --out "$OUT/mfu_clip_b16" || true
-commit_art "on-chip capture: CLIP-B/16 train step (dual InfoNCE kernels)" \
+# 3. RN50 batch-256 rung, fixed chain protocol (batch as arguments — the
+#    constant-embedding 413 is gone).
+run_step 1800 rn50_b256 - python benchmarks/run_benchmarks.py \
+    --trainer-only --model resnet50 --batch 256 \
+    --out "$OUT/mfu_rn50_b256" || true
+commit_art "on-chip capture: RN50 batch-256 (fixed chain protocol)" \
     "$OUT/" || true
 
-# 4. Remat variant at the largest batch (HBM-bound hypothesis check).
-run_step 1500 remat - python benchmarks/run_benchmarks.py \
+# 4. Remat variant at the same batch (HBM-bound hypothesis check).
+run_step 1800 rn50_b256_remat - python benchmarks/run_benchmarks.py \
     --trainer-only --model resnet50 --batch 256 --remat \
     --out "$OUT/mfu_rn50_remat" || true
 commit_art "on-chip capture: RN50 batch-256 remat variant" "$OUT/" || true
 
-# 5. TPU-gated test tier (tpu marks skip off-chip; assert on-device here).
-#    The platform name must be the one that actually registered ('axon'
-#    through the tunnel plugin, 'tpu' on a real host) — conftest.py feeds
-#    it to jax.config, and a name with no registered backend fails init.
-run_step 1200 tpu_tests "$OUT/pytest_tpu_tier.txt" \
-    env NTXENT_TEST_PLATFORM="${NTXENT_CHIP_BACKEND:-tpu}" \
-    python -m pytest tests/ -m tpu -q --no-header || true
-commit_art "on-chip capture: TPU-gated pytest tier" "$OUT/" || true
+# 5. Space-to-depth stem A/B at batch 128 (the MXU-density lever for the
+#    RN50 MFU plateau; weight-compatible, models/resnet.py).
+run_step 1500 rn50_s2d - python benchmarks/run_benchmarks.py \
+    --trainer-only --model resnet50 --batch 128 --stem space_to_depth \
+    --out "$OUT/mfu_rn50_s2d" || true
+commit_art "on-chip capture: RN50 space-to-depth stem A/B" "$OUT/" || true
 
-# 5b. Flash-attention A/B: fused Pallas kernel vs XLA's own fusion over
-#     the long-context L ladder (the attention_pallas.py design decision).
-#     --autotune adds the measured-sweep tile next to the heuristic one
-#     (winners persist in the autotune cache snapshotted at step 1).
-run_step 2400 attention_ab - python benchmarks/bench_attention.py \
+# 6. Flash-attention A/B rerun: incremental writes now, span-amortized
+#    timing at small L, and the 8192-causal rung that died with the
+#    tunnel last window.
+run_step 3000 attention_ab - python benchmarks/bench_attention.py \
     --autotune --out "$OUT/attention_ab.json" || true
-commit_art "on-chip capture: flash-attention vs XLA A/B ladder" "$OUT/" || true
+commit_art "on-chip capture: flash-attention vs XLA A/B ladder" "$OUT/" \
+    || true
 
-# 6. Loader-vs-step timing: real disk reads feeding the step (SURVEY §7.4
+# 7. Loader-vs-step timing: real disk reads feeding the step (SURVEY §7.4
 #    risk #4 — proves the input pipeline won't cap MFU).
 run_step 1500 loader - python scripts/loader_timing.py \
     --steps 200 --batch 256 --model resnet50 || true
 commit_art "on-chip capture: loader-vs-step timing (real disk pipeline)" \
     "$OUT/" || true
 
-# 7. XProf trace last (largest artifact, least load-bearing).
-run_step 1200 xprof - python benchmarks/run_benchmarks.py \
+# 8. XProf trace last (largest artifact, least load-bearing).
+run_step 1500 xprof - python benchmarks/run_benchmarks.py \
     --trainer-only --model resnet50 --batch 128 \
     --trace "$OUT/xprof" --out "$OUT/mfu_rn50_traced" || true
-# Traces are big: commit the summary JSON + a size-capped listing only.
 ls -laR "$OUT/xprof" > "$OUT/xprof_manifest.txt" 2>/dev/null || true
 commit_art "on-chip capture: XProf-traced RN50 step" \
     "$OUT/mfu_rn50_traced" "$OUT/xprof_manifest.txt" \

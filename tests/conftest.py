@@ -41,6 +41,13 @@ if _PLATFORM != "tpu":
     # (jax_platforms config wins over the env var) — force it back for tests.
     jax.config.update("jax_platforms", _PLATFORM)
 else:
+    # A site plugin may have pinned jax_platforms at interpreter startup
+    # (config wins over env); restore auto-selection so the accelerator
+    # can win, tolerating jax versions that reject a None/'' update.
+    try:
+        jax.config.update("jax_platforms", None)
+    except Exception:
+        pass
     _backend = jax.default_backend()
     if _backend not in ("tpu", "axon"):
         raise RuntimeError(

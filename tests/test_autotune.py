@@ -45,7 +45,7 @@ def sweep_env(monkeypatch, tmp_path):
 def test_sweep_picks_fastest_candidate_and_persists(sweep_env, monkeypatch):
     calls = []
 
-    def fake_timer(fn, z, length, spans, with_grad):
+    def fake_timer(fn, z, length, spans, with_grad, **kw):
         # Identify the candidate from the closure defaults (loss binds
         # _br/_bc as keyword defaults) and hand (256, 128) the best time.
         br, bc = fn.__defaults__
@@ -124,7 +124,7 @@ def test_attention_sweep_picks_fastest_and_persists(sweep_env, monkeypatch):
 
     calls = []
 
-    def fake_timer(fn, q, length, spans, with_grad):
+    def fake_timer(fn, q, length, spans, with_grad, **kw):
         bq, bk = fn.__defaults__
         calls.append((bq, bk))
         return (0.25 if (bq, bk) == (128, 256) else 1.0 + bq / 1e4), 0.0

@@ -101,3 +101,32 @@ def test_resnet_train_eval_modes(rng, train):
     else:
         h = model.apply(vars_, x, train=False)
     assert bool(jnp.all(jnp.isfinite(h)))
+
+
+def test_s2d_stem_equivalence(rng):
+    """space_to_depth stem computes EXACTLY the plain 7x7/s2 stem's map.
+
+    Same parameter tree (7,7,C,width kernel under stem_conv), same
+    function: init the plain-stem model, apply both stems with those
+    weights on the same input, compare features. fp32 end to end so the
+    only tolerance needed is reduction-order noise.
+    """
+    plain = ResNet(stage_sizes=(1,), stem="conv", dtype=jnp.float32)
+    s2d = ResNet(stage_sizes=(1,), stem="space_to_depth", dtype=jnp.float32)
+    x = jax.random.normal(rng, (2, 32, 32, 3), jnp.float32)
+    vars_ = plain.init(jax.random.PRNGKey(0), x, train=False)
+    # identical param trees => the plain init applies to the s2d model
+    assert (jax.tree.map(jnp.shape, vars_["params"]["stem_conv"])
+            == jax.tree.map(jnp.shape,
+                            s2d.init(jax.random.PRNGKey(0), x,
+                                     train=False)["params"]["stem_conv"]))
+    h_plain = plain.apply(vars_, x, train=False)
+    h_s2d = s2d.apply(vars_, x, train=False)
+    np.testing.assert_allclose(np.asarray(h_plain), np.asarray(h_s2d),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_s2d_stem_odd_size_rejected(rng):
+    s2d = ResNet(stage_sizes=(1,), stem="space_to_depth", dtype=jnp.float32)
+    with pytest.raises(ValueError, match="even"):
+        s2d.init(rng, jnp.zeros((1, 31, 31, 3)), train=False)
