@@ -108,6 +108,12 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--weight-decay", type=float, default=1e-6)
     t.add_argument("--warmup-steps", type=int, default=100)
     t.add_argument("--accum-steps", type=int, default=1)
+    t.add_argument("--fsdp", action="store_true",
+                   help="fully-sharded data parallelism (ZeRO-3 via "
+                        "GSPMD): shard params + optimizer moments over "
+                        "the data axis instead of replicating them — "
+                        "HBM capacity for ICI bandwidth "
+                        "(parallel/fsdp.py)")
     t.add_argument("--dp-loss", default="strip", choices=["strip", "pair"],
                    help="data-parallel NT-Xent decomposition: 'strip' "
                         "(local rows x global cols per device) or 'pair' "
@@ -280,6 +286,10 @@ def main(argv=None) -> int:
             f"{info['global_device_count']} devices")
     per_process_batch = args.batch // info["process_count"]
 
+    if args.objective == "clip" and args.fsdp:
+        raise SystemExit("--fsdp is the SimCLR data-parallel memory path; "
+                         "for CLIP use --clip-parallel tp to shard the "
+                         "towers (it would otherwise be silently ignored)")
     if args.objective == "clip":
         # image_size stays None here: the clip branch derives it from the
         # paired data, and a conflicting EXPLICIT flag must fail loudly.
@@ -329,7 +339,32 @@ def main(argv=None) -> int:
         (1, args.image_size, args.image_size, 3), cfg)
 
     n_dev = info["global_device_count"]
-    if n_dev > 1:
+    if n_dev > 1 and args.fsdp:
+        from ntxent_tpu.parallel import (
+            make_fsdp_train_step,
+            shard_train_state_fsdp,
+        )
+        from ntxent_tpu.parallel.mesh import data_sharding
+
+        if args.moe_experts > 0:
+            raise SystemExit("--fsdp does not compose with --moe-experts "
+                             "yet (MoE aux losses ride the shard_map DP "
+                             "path)")
+        if args.dp_loss != "strip":
+            logger.warning("--dp-loss %s ignored under --fsdp (the FSDP "
+                           "step uses the GSPMD-sharded oracle loss)",
+                           args.dp_loss)
+        mesh = create_mesh(axis_names=("data",))
+        has_bs = bool(jax.tree_util.tree_leaves(state.batch_stats))
+        step = make_fsdp_train_step(mesh, cfg.temperature,
+                                    remat=args.remat,
+                                    has_batch_stats=has_bs)
+        state = shard_train_state_fsdp(state, mesh)
+        data = _make_pipeline(args, per_process_batch,
+                              sharding=data_sharding(mesh), mesh=mesh)
+        logger.info("FSDP (ZeRO-3) over %d devices (%d process(es))",
+                    n_dev, info["process_count"])
+    elif n_dev > 1:
         from ntxent_tpu.parallel.mesh import data_sharding, replicate_state
 
         mesh = create_mesh(axis_names=("data",))
@@ -349,6 +384,9 @@ def main(argv=None) -> int:
         logger.info("data-parallel over %d devices (%d process(es))",
                     n_dev, info["process_count"])
     else:
+        if args.fsdp:
+            logger.warning("--fsdp ignored: single-device run has nothing "
+                           "to shard over")
         if args.dp_loss != "strip":
             logger.warning("--dp-loss %s ignored: single-device run has "
                            "no shard-pair schedule", args.dp_loss)

@@ -41,6 +41,12 @@ from ntxent_tpu.parallel.ring import (
     make_ring_ntxent,
     ntxent_loss_ring,
 )
+from ntxent_tpu.parallel.fsdp import (
+    fsdp_param_spec,
+    make_fsdp_train_step,
+    param_bytes_per_device,
+    shard_train_state_fsdp,
+)
 from ntxent_tpu.parallel.tp import (
     make_tp_clip_train_step,
     make_tp_simclr_train_step,
@@ -84,4 +90,8 @@ __all__ = [
     "shard_train_state",
     "make_tp_simclr_train_step",
     "make_tp_clip_train_step",
+    "fsdp_param_spec",
+    "make_fsdp_train_step",
+    "param_bytes_per_device",
+    "shard_train_state_fsdp",
 ]

@@ -1,0 +1,194 @@
+"""Fully-sharded data parallelism (ZeRO-3 style) via GSPMD.
+
+Beyond the reference (SURVEY.md §2.2 lists only declared DP): the memory
+side of data parallelism. The shard_map DP path (trainer.py) and the TP
+path (tp.py) both keep a FULL parameter + optimizer-state replica per
+device; for encoders at ResNet-152/ViT-L scale on small-HBM chips the
+replica, its Adam/LARS moments, and the gradients are the footprint that
+caps batch size. FSDP shards all three over the ``data`` axis and pays
+for it with weight all-gathers at use time.
+
+TPU-idiomatic recipe (same shape as tp.py — annotate, don't hand-roll):
+
+* ``fsdp_param_spec`` maps each array leaf to a ``PartitionSpec`` that
+  shards its LARGEST ``data``-divisible dimension; small leaves (norm
+  scales, biases — below ``min_shard_elems``) stay replicated, where
+  sharding would buy nothing and cost a collective each.
+* Optimizer state needs no separate rules: optax states mirror the param
+  tree, so placing every array leaf of the TrainState through the same
+  shape-driven rule shards Adam moments / LARS traces exactly like their
+  parameters (ZeRO's optimizer-state partitioning for free).
+* ``make_fsdp_train_step`` jits the ordinary global-batch train step over
+  the committed placements. GSPMD inserts the all-gather of each weight
+  shard at use and — because the gradient of all-gather is
+  reduce-scatter — emits reduce-scattered gradients that land directly
+  on the optimizer's shards. No hand-written collectives anywhere; this
+  is the ICI-bandwidth-for-HBM-capacity trade compiled from annotations.
+
+Composes with the fused-kernel DP loss story the same way tp.py does:
+the loss here is the jnp oracle (GSPMD shards the similarity matmul);
+the explicit shard_map + fused Pallas partials path stays the
+latency-optimal route when params fit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.oracle import ntxent_loss
+from .mesh import data_sharding
+
+__all__ = [
+    "fsdp_param_spec",
+    "fsdp_spec_tree",
+    "shard_train_state_fsdp",
+    "make_fsdp_train_step",
+    "param_bytes_per_device",
+]
+
+# Leaves smaller than this many elements are replicated: a (64,) BN scale
+# sharded 8 ways saves 56 floats and costs an all-gather per use.
+MIN_SHARD_ELEMS = 2 ** 14
+
+
+def fsdp_param_spec(leaf, *, axis: str = "data", axis_size: int,
+                    min_shard_elems: int = MIN_SHARD_ELEMS) -> P:
+    """PartitionSpec sharding the largest ``axis_size``-divisible dim.
+
+    Ties break toward the TRAILING dimension (weights are (in, out) /
+    (H, W, Cin, Cout): the output-feature axis is both the usually-larger
+    and the contraction-friendly choice). Replicates when the leaf is
+    small or nothing divides.
+    """
+    if not hasattr(leaf, "ndim") or leaf.ndim == 0 \
+            or leaf.size < min_shard_elems:
+        return P()
+    best = None  # (dim_size, index) — max size, later index wins ties
+    for i, d in enumerate(leaf.shape):
+        if d % axis_size == 0 and (best is None or d >= best[0]):
+            best = (d, i)
+    if best is None:
+        return P()
+    spec = [None] * leaf.ndim
+    spec[best[1]] = axis
+    return P(*spec)
+
+
+def fsdp_spec_tree(tree, *, axis: str = "data", axis_size: int):
+    """Spec pytree for params or any mirrored optimizer-state tree."""
+    return jax.tree_util.tree_map(
+        functools.partial(fsdp_param_spec, axis=axis, axis_size=axis_size),
+        tree)
+
+
+def shard_train_state_fsdp(state, mesh: Mesh, *, axis: str = "data"):
+    """Place a TrainState on the mesh with FSDP sharding on every array
+    leaf (params, Adam/LARS moments, and batch_stats alike — the rule is
+    shape-driven, so the mirrored optimizer trees shard with their
+    parameters). jit infers program shardings from these placements.
+
+    Aliasing caveat: ``jax.device_put`` onto the mesh reuses the source
+    buffer on its home device rather than copying. The returned state is
+    therefore NOT independent of ``state`` — donating the original to a
+    jitted step afterwards deletes shards out from under the placed copy
+    ("Array has been deleted"). Treat the original as consumed, as with
+    tp.shard_train_state."""
+    axis_size = mesh.shape[axis]
+
+    def place(leaf):
+        if not hasattr(leaf, "ndim"):  # static fields (apply_fn, tx, step)
+            return leaf
+        spec = fsdp_param_spec(leaf, axis=axis, axis_size=axis_size)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, state)
+
+
+def param_bytes_per_device(state) -> int:
+    """Actually-addressable bytes of the first device's param shards —
+    the memory claim FSDP exists for (== total/P + replicated smalls)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        if hasattr(leaf, "addressable_shards"):
+            s = leaf.addressable_shards[0]
+            total += s.data.size * s.data.dtype.itemsize
+    return total
+
+
+def _constrain_batch(x, mesh: Mesh, axis: str):
+    return jax.lax.with_sharding_constraint(x, data_sharding(mesh, axis))
+
+
+def _constrain_state(state, mesh: Mesh, axis: str):
+    """Pin every array leaf of the OUTPUT state to its FSDP spec.
+
+    Without this, GSPMD freely picks output shardings (e.g. splitting a
+    replicated (64,) BN bias over ``data``), and feeding the returned
+    state back into the compiled step then fails with a passed-vs-required
+    sharding mismatch on the second call.
+    """
+    axis_size = mesh.shape[axis]
+
+    def pin(leaf):
+        if not hasattr(leaf, "ndim"):
+            return leaf
+        spec = fsdp_param_spec(leaf, axis=axis, axis_size=axis_size)
+        return jax.lax.with_sharding_constraint(
+            leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(pin, state)
+
+
+def make_fsdp_train_step(
+    mesh: Mesh,
+    temperature: float = 0.1,
+    *,
+    axis: str = "data",
+    has_batch_stats: bool = True,
+    remat: bool = False,
+) -> Callable:
+    """Fully-sharded SimCLR train step: batch sharded over ``axis``,
+    weights/optimizer sharded per ``fsdp_param_spec``; GSPMD derives the
+    gather-on-use / reduce-scatter schedule. ``has_batch_stats`` default
+    True (the flagship FSDP target is the ResNet family, which carries
+    BatchNorm; the global-batch program gives cross-replica statistics by
+    construction). ``remat=True`` rematerializes the encoder forward —
+    the usual FSDP companion, since both trade compute/comm for HBM.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state, v1, v2):
+        v1c = _constrain_batch(v1, mesh, axis)
+        v2c = _constrain_batch(v2, mesh, axis)
+
+        def encode(params, both):
+            if has_batch_stats:
+                variables = {"params": params,
+                             "batch_stats": state.batch_stats}
+                return state.apply_fn(variables, both, train=True,
+                                      mutable=["batch_stats"])
+            return state.apply_fn({"params": params}, both, train=True), None
+
+        if remat:
+            encode = jax.checkpoint(encode, static_argnums=())
+
+        def loss_fn(params):
+            both = jnp.concatenate([v1c, v2c], axis=0)
+            z, updates = encode(params, both)
+            new_stats = updates["batch_stats"] if has_batch_stats else None
+            z = _constrain_batch(z, mesh, axis)
+            return ntxent_loss(z, temperature), new_stats
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        state2 = state.apply_gradients(grads=grads)
+        if new_stats is not None:
+            state2 = state2.replace(batch_stats=new_stats)
+        return _constrain_state(state2, mesh, axis), {"loss": loss}
+
+    return train_step

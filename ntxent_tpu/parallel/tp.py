@@ -133,6 +133,12 @@ def shard_train_state(state, mesh: Mesh, *, model_axis: str = "model"):
     Returns the state with every array leaf committed to a NamedSharding —
     jit then infers program shardings from these placements (no in_shardings
     needed).
+
+    Aliasing caveat: ``jax.device_put`` onto the mesh reuses the source
+    buffer on its home device rather than copying, so the returned state
+    is NOT independent of ``state`` — donating the original to a jitted
+    step afterwards deletes shards out from under the placed copy. Treat
+    the original as consumed (see fsdp.shard_train_state_fsdp).
     """
 
     def place(path, leaf):

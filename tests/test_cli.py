@@ -83,6 +83,45 @@ def test_cli_synthetic_run_checkpoints_and_resumes(tmp_path):
     assert "nothing to do" in (second.stdout + second.stderr)
 
 
+def test_cli_fsdp_run(tmp_path):
+    """--fsdp launch: params/optimizer sharded over the 8-device mesh,
+    training proceeds, checkpoints against the SHARDED template, and a
+    relaunch restores it; --objective clip rejects the flag."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    repo = os.path.dirname(os.path.dirname(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    ckpt = tmp_path / "ckpt"
+    cmd = [sys.executable, "-m", "ntxent_tpu.cli",
+           "--dataset", "synthetic", "--model", "tiny",
+           "--image-size", "8", "--synthetic-samples", "64",
+           "--batch", "16", "--steps", "2", "--warmup-steps", "1",
+           "--proj-hidden-dim", "16", "--proj-dim", "8",
+           "--ckpt-dir", str(ckpt), "--ckpt-every", "100",
+           "--log-every", "1", "--platform", "cpu", "--fsdp"]
+    run = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                         env=env)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "FSDP (ZeRO-3) over 8 devices" in (run.stdout + run.stderr)
+    assert "final: step 2" in (run.stdout + run.stderr)
+    assert ckpt.exists() and any(ckpt.iterdir())
+
+    # Relaunch: Orbax must restore the GSPMD-sharded checkpoint into the
+    # sharded template (the FSDP analog of the DP replicate-then-restore
+    # ordering) and conclude there is nothing left to do.
+    second = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                            env=env)
+    assert second.returncode == 0, second.stdout + second.stderr
+    assert "nothing to do" in (second.stdout + second.stderr)
+
+    bad = subprocess.run(cmd + ["--objective", "clip"], capture_output=True,
+                         text=True, timeout=120, env=env)
+    assert bad.returncode != 0
+    assert "--fsdp is the SimCLR" in (bad.stdout + bad.stderr)
+
+
 @pytest.mark.slow
 def test_cli_train_then_eval(tmp_path):
     """ntxent-eval restores the ntxent-train checkpoint and reports both

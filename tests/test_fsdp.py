@@ -1,0 +1,109 @@
+"""FSDP (ZeRO-3 via GSPMD): sharded == unsharded, and the memory claim.
+
+Runs on the 8-device virtual CPU mesh (conftest). The contract: sharding
+params + optimizer state over ``data`` changes WHERE arrays live, never
+what the step computes — loss and updated params must match the
+single-device step bit-for-near-bit — and each device must hold ~1/P of
+the parameter bytes (that is the point of FSDP).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ntxent_tpu.models import ResNet, SimCLRModel
+from ntxent_tpu.parallel import (
+    create_mesh,
+    fsdp_param_spec,
+    make_fsdp_train_step,
+    param_bytes_per_device,
+    shard_train_state_fsdp,
+)
+from ntxent_tpu.training import TrainerConfig, create_train_state
+from ntxent_tpu.training.trainer import make_train_step
+
+
+def _tiny_state(batch):
+    model = SimCLRModel(
+        encoder=functools.partial(ResNet, stage_sizes=(1, 1),
+                                  small_images=True, dtype=jnp.float32),
+        proj_hidden_dim=64, proj_dim=32)
+    cfg = TrainerConfig(batch_size=batch, total_steps=4, warmup_steps=1)
+    return create_train_state(model, jax.random.PRNGKey(0), (1, 16, 16, 3),
+                              cfg), cfg
+
+
+def test_fsdp_spec_rules():
+    size = 8
+    # Large matrix: largest divisible dim sharded, trailing wins ties.
+    assert fsdp_param_spec(jnp.zeros((256, 256)), axis_size=size) \
+        == P(None, "data")
+    # Conv kernel: Cout (largest divisible) sharded.
+    assert fsdp_param_spec(jnp.zeros((3, 3, 64, 256)), axis_size=size) \
+        == P(None, None, None, "data")
+    # Small leaves replicate.
+    assert fsdp_param_spec(jnp.zeros((64,)), axis_size=size) == P()
+    # Nothing divisible replicates.
+    assert fsdp_param_spec(jnp.zeros((129, 129)), axis_size=size,
+                           min_shard_elems=1) == P()
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_fsdp_step_matches_unsharded(remat):
+    batch = 16
+    mesh = create_mesh(axis_names=("data",))
+    state, cfg = _tiny_state(batch)
+    # A SECOND, independent state for the FSDP run: device_put onto the
+    # mesh ALIASES the source buffer on its home device, and both step
+    # factories donate their input — running the reference step on the
+    # same state would delete the placed copy's shards out from under it
+    # (see shard_train_state_fsdp docstring). Init is deterministic, so
+    # the two states are equal.
+    state2, _ = _tiny_state(batch)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+    v1 = jax.random.uniform(k1, (batch, 16, 16, 3))
+    v2 = jax.random.uniform(k2, (batch, 16, 16, 3))
+
+    fstate = shard_train_state_fsdp(state2, mesh)
+    ref_step = make_train_step(cfg.temperature)
+    ref_state, ref_m = ref_step(state, v1, v2)
+
+    fsdp_step = make_fsdp_train_step(mesh, cfg.temperature, remat=remat)
+    fstate2, m = fsdp_step(fstate, v1, v2)
+
+    # GSPMD reduces in a different order (reduce-scatter trees vs local
+    # sums) and the tiny model's BatchNorm rsqrt amplifies it — observed
+    # ~2e-4 relative on the loss; anything structural would be >>1e-2.
+    np.testing.assert_allclose(float(m["loss"]), float(ref_m["loss"]),
+                               rtol=1e-3)
+    ref_leaves = jax.tree_util.tree_leaves(ref_state.params)
+    got_leaves = jax.tree_util.tree_leaves(fstate2.params)
+    for r, g in zip(ref_leaves, got_leaves):
+        np.testing.assert_allclose(np.asarray(jax.device_get(g)),
+                                   np.asarray(r), rtol=5e-3, atol=5e-4)
+
+
+def test_fsdp_shards_param_and_optimizer_bytes():
+    mesh = create_mesh(axis_names=("data",))
+    n_dev = mesh.shape["data"]
+    state, _ = _tiny_state(8)
+    total = sum(leaf.size * leaf.dtype.itemsize
+                for leaf in jax.tree_util.tree_leaves(state.params))
+    fstate = shard_train_state_fsdp(state, mesh)
+    per_dev = param_bytes_per_device(fstate)
+    # Each device holds far less than the replica; the tiny model carries
+    # proportionally many small replicated leaves, so assert < 60%.
+    assert per_dev < 0.6 * total, (per_dev, total)
+    # The big leaves really are split 1/P: check the largest param leaf
+    # and its mirrored optimizer moment.
+    big = max(jax.tree_util.tree_leaves(fstate.params), key=lambda x: x.size)
+    assert big.addressable_shards[0].data.size == big.size // n_dev
+    opt_leaves = [x for x in jax.tree_util.tree_leaves(fstate.opt_state)
+                  if hasattr(x, "size") and x.size == big.size]
+    assert opt_leaves, "no mirrored optimizer moment found for the big leaf"
+    assert opt_leaves[0].addressable_shards[0].data.size \
+        == big.size // n_dev
