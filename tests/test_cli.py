@@ -83,6 +83,7 @@ def test_cli_synthetic_run_checkpoints_and_resumes(tmp_path):
     assert "nothing to do" in (second.stdout + second.stderr)
 
 
+@pytest.mark.slow
 def test_cli_fsdp_run(tmp_path):
     """--fsdp launch: params/optimizer sharded over the 8-device mesh,
     training proceeds, checkpoints against the SHARDED template, and a

@@ -52,7 +52,11 @@ def test_fsdp_spec_rules():
                            min_shard_elems=1) == P()
 
 
-@pytest.mark.parametrize("remat", [False, True])
+@pytest.mark.parametrize(
+    "remat",
+    [False,
+     # remat recompiles the whole encoder backward; slow tier only.
+     pytest.param(True, marks=pytest.mark.slow)])
 def test_fsdp_step_matches_unsharded(remat):
     batch = 16
     mesh = create_mesh(axis_names=("data",))
