@@ -466,6 +466,102 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
         print(f"XProf trace -> {trace_dir}")
 
 
+def run_trainer_ablation(quick: bool, results: dict,
+                         model_name: str = "resnet50",
+                         batch: int | None = None,
+                         stem: str = "conv",
+                         remat: bool = False):
+    """Component attribution of the train step, no profiler needed.
+
+    Times three chained programs on the same state/batch and reads the
+    differences: (a) encoder fwd + loss, (b) + backward w.r.t. params,
+    (c) the full train step (+ optimizer). Each chain is data-dependent
+    per step (the scalar folds back into its inputs) so XLA can neither
+    hoist the loop-invariant forward out of the scan nor overlap steps —
+    the same protocol rationale as run_trainer_bench. The role XProf's
+    op attribution plays, measured with nothing but the step itself —
+    and immune to the tunnel's timing distortions, which XProf captures
+    through this relay are not guaranteed to be.
+    """
+    from ntxent_tpu.training.trainer import _apply_two_views
+    from ntxent_tpu.utils.capability import is_tpu_backend
+    from ntxent_tpu.utils.profiling import compile_chain, time_chain
+
+    if not model_name.startswith(("resnet", "vit")):
+        raise SystemExit("--ablate decomposes the SimCLR (two-view) step "
+                         f"only; got --model {model_name}")
+    on_accel = jax.default_backend() in ("tpu", "axon")
+    name, batch, size, state, step, step_args = _trainer_setup(
+        model_name, quick, on_accel, batch, stem=stem, remat=remat)
+    runs = 5 if quick or not on_accel else 30
+    temperature = 0.1
+    # The SAME forward and loss the train step runs (fused kernel on
+    # accelerators) — attribution by subtraction is only valid when every
+    # chain shares the stages it claims to share.
+    loss_impl = ntxent_loss_fused if is_tpu_backend() else ntxent_loss_oracle
+
+    def encode_loss(params, v1, v2):
+        z1, z2, _, _ = _apply_two_views(state, params, v1, v2, remat=remat)
+        return loss_impl(jnp.concatenate([z1, z2], axis=0), temperature)
+
+    def fwd_step(carry, v1, v2):
+        params, tick = carry
+        # fold the loss into a per-step input scale: keeps every
+        # iteration's forward live (no LICM) without touching params
+        loss = encode_loss(params, v1 * (1 + 1e-9 * tick), v2)
+        return (params, loss), loss
+
+    def bwd_step(carry, v1, v2):
+        params, _ = carry
+        loss, g = jax.value_and_grad(encode_loss)(params, v1, v2)
+        # negligible but non-elidable param update keeps the backward on
+        # the chain's dependence path
+        params2 = jax.tree_util.tree_map(lambda p, gg: p - 1e-12 * gg,
+                                         params, g)
+        return (params2, loss), loss
+
+    v1, v2 = step_args
+
+    def full_step(s, a, b):
+        s2, m = step(s, a, b)
+        return s2, m["loss"]
+
+    rows = {}
+    for nm, fn, carry in (
+            ("fwd_loss", fwd_step, (state.params, jnp.float32(0))),
+            ("fwd_bwd", bwd_step, (state.params, jnp.float32(0))),
+            ("full_step", full_step, state)):
+        if on_accel:
+            exec_ = compile_chain(fn, carry, runs, v1, v2)
+            ms, _, final = time_chain(exec_, carry, v1, v2, length=runs,
+                                      spans=2)
+        else:
+            # Pathway check only: XLA:CPU's scan-of-train-step compile is
+            # pathological (run_trainer_bench note), so loop per call.
+            import time as _t
+
+            jfn = jax.jit(fn)
+            carry, final = jfn(carry, v1, v2)
+            jax.block_until_ready(final)
+            t0 = _t.perf_counter()
+            for _ in range(runs):
+                carry, final = jfn(carry, v1, v2)
+            final = float(final)
+            ms = (_t.perf_counter() - t0) * 1e3 / runs
+        import math as _math
+        if not _math.isfinite(final):
+            raise RuntimeError(f"non-finite loss during {nm} ablation")
+        rows[nm] = round(ms, 3)
+    rows["bwd_cost"] = round(rows["fwd_bwd"] - rows["fwd_loss"], 3)
+    rows["optimizer_cost"] = round(rows["full_step"] - rows["fwd_bwd"], 3)
+    entry = {"model": name, "batch": batch, "image": size, "remat": remat,
+             **rows}
+    results.setdefault("trainer_ablation", {})[f"{name}@{batch}"] = entry
+    print(f"\n=== trainer ablation ({name}, batch {batch}) ===")
+    for k, v in rows.items():
+        print(f"{k:>16}: {v:.3f} ms/step")
+
+
 def main():
     global _IMPL, _IMPL_NAME
     parser = argparse.ArgumentParser()
@@ -494,6 +590,9 @@ def main():
                         help="trainer-bench batch override; a comma list "
                              "(e.g. 64,128,256) sweeps batch sizes and "
                              "records one entry per size")
+    parser.add_argument("--ablate", action="store_true",
+                        help="component attribution: time fwd / fwd+bwd / "
+                             "full-step chains and report the differences")
     parser.add_argument("--stem", choices=["conv", "space_to_depth"],
                         default="conv",
                         help="ResNet stem variant: space_to_depth runs the "
@@ -547,10 +646,15 @@ def main():
         batches = args.batch or [None]
         for m in models:
             for b in batches:
-                run_trainer_bench(args.quick, results, args.trace,
-                                  model_name=m, batch=b,
-                                  tag_batch=len(batches) > 1,
-                                  remat=args.remat, stem=args.stem)
+                if args.ablate:
+                    run_trainer_ablation(args.quick, results, model_name=m,
+                                         batch=b, stem=args.stem,
+                                         remat=args.remat)
+                else:
+                    run_trainer_bench(args.quick, results, args.trace,
+                                      model_name=m, batch=b,
+                                      tag_batch=len(batches) > 1,
+                                      remat=args.remat, stem=args.stem)
 
     out_dir = Path(args.out)
     out_dir.mkdir(exist_ok=True)

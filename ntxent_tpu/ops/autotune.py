@@ -103,10 +103,19 @@ def _store_disk_cache(key: tuple, best: tuple[int, int]) -> None:
 
 
 def _candidates(rows: int, cols: int, dim: int, itemsize: int,
-                ws_fn=_working_set_bytes):
+                ws_fn=_working_set_bytes, near=None):
     """(row, col) tile grid filtered by shape caps and the kernel's VMEM
     working set (``ws_fn``: loss tiles by default, attention tiles via
-    ``attention_working_set_bytes`` — ONE generator for both sweeps)."""
+    ``attention_working_set_bytes`` — ONE generator for both sweeps).
+
+    ``near``: a (row, col) anchor — usually the static heuristic's pick —
+    that orders the grid by log-distance from it. Sweeps run under a wall
+    budget and truncate; a fixed row-major order made a truncated sweep's
+    "best so far" whatever corner happened to be enumerated first, while
+    anchor-ordering means truncation degrades toward the heuristic
+    instead of toward an arbitrary tile.
+    """
+    cands = []
     for br in _ROW_CANDIDATES:
         if br > round_up(rows, 8):
             continue
@@ -115,7 +124,16 @@ def _candidates(rows: int, cols: int, dim: int, itemsize: int,
                 continue
             if ws_fn(br, bc, dim, itemsize) > VMEM_BUDGET_BYTES:
                 continue
-            yield br, bc
+            cands.append((br, bc))
+    if near is not None:
+        import math
+
+        def dist(c):
+            return (abs(math.log2(c[0] / near[0]))
+                    + abs(math.log2(c[1] / near[1])))
+
+        cands.sort(key=dist)
+    yield from cands
 
 
 def autotune_blocks(
@@ -172,7 +190,8 @@ def autotune_blocks(
         return loss
 
     best = _measured_sweep(
-        key, _candidates(rows, cols, dim, jnp.dtype(dtype).itemsize),
+        key, _candidates(rows, cols, dim, jnp.dtype(dtype).itemsize,
+                         near=choose_blocks(rows, cols, dim, dtype)),
         make_loss, z, length=length, spans=spans,
         with_grad=include_backward, budget_s=budget_s)
     if best is None:
@@ -226,7 +245,7 @@ def _measured_sweep(key, candidates, make_loss, example, *, length, spans,
 
 
 def _attention_candidates(l_q: int, l_kv: int, d: int, itemsize: int,
-                          include_backward: bool = False):
+                          include_backward: bool = False, near=None):
     import functools as _ft
 
     from .attention_pallas import attention_working_set_bytes
@@ -234,7 +253,8 @@ def _attention_candidates(l_q: int, l_kv: int, d: int, itemsize: int,
     return _candidates(
         l_q, l_kv, d, itemsize,
         ws_fn=_ft.partial(attention_working_set_bytes,
-                          backward=include_backward))
+                          backward=include_backward),
+        near=near)
 
 
 def autotune_attention_blocks(
@@ -304,7 +324,8 @@ def autotune_attention_blocks(
 
     best = _measured_sweep(
         key, _attention_candidates(l_q, l_kv, head_dim, itemsize,
-                                   include_backward=include_backward),
+                                   include_backward=include_backward,
+                                   near=fallback),
         make_loss, q, length=length, spans=spans,
         with_grad=include_backward, budget_s=budget_s)
     if best is None:

@@ -91,6 +91,14 @@ run_step 1500 rn50_s2d - python benchmarks/run_benchmarks.py \
     --out "$OUT/mfu_rn50_s2d" || true
 commit_art "on-chip capture: RN50 space-to-depth stem A/B" "$OUT/" || true
 
+# 5b. Step-component ablation (fwd / fwd+bwd / full chains): where the
+#     RN50 milliseconds actually go — profiler-free attribution that the
+#     relay cannot distort, complementing (and hedging) the XProf step.
+run_step 1800 rn50_ablate - python benchmarks/run_benchmarks.py \
+    --trainer-only --model resnet50 --batch 128 --ablate \
+    --out "$OUT/mfu_rn50_ablation" || true
+commit_art "on-chip capture: RN50 step-component ablation" "$OUT/" || true
+
 # 6. Flash-attention A/B rerun: incremental writes now, span-amortized
 #    timing at small L, and the 8192-causal rung that died with the
 #    tunnel last window.
