@@ -1,9 +1,11 @@
 #!/usr/bin/env bash
-# Poll the TPU tunnel on a short cadence; the moment a probe answers, run
-# the full capture list (on_chip_capture.sh). The tunnel is intermittently
-# alive in windows (BASELINE.md "Timing-semantics history"), and a wedged
-# backend hangs ANY jax init forever — so each probe is a disposable
-# subprocess under `timeout`, never this shell.
+# Poll the TPU tunnel on a short cadence; on every alive window, run the
+# capture list (on_chip_capture.sh — idempotent via per-step done
+# markers), and keep watching until every step has captured or the
+# window budget expires. The tunnel is intermittently alive in windows
+# (BASELINE.md "Timing-semantics history"), and a wedged backend hangs
+# ANY jax init forever — so each probe is a disposable subprocess under
+# `timeout`, never this shell.
 #
 # Usage: chip_watch.sh [max_hours]   (default 11)
 set -u
@@ -20,18 +22,24 @@ deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
 echo "[$(date -u +%H:%M:%S)] chip watch up (period ${PERIOD}s, max ${MAX_HOURS}h)" >>"$WLOG"
 
 while [ "$(date +%s)" -lt "$deadline" ]; do
+    # Sentinel written by on_chip_capture.sh when its own step list is
+    # fully captured — the list has exactly one owner, so a step added
+    # there cannot be missed by a stale copy here.
+    if [ -e "$OUT/.all_captured" ]; then
+        echo "[$(date -u +%H:%M:%S)] all captures done; watch exiting" >>"$WLOG"
+        exit 0
+    fi
     backend=$(timeout "$PROBE_TIMEOUT" python -c \
         "import jax; print(jax.default_backend())" 2>/dev/null | tail -1)
     if [ "$backend" = "tpu" ] || [ "$backend" = "axon" ]; then
         echo "[$(date -u +%H:%M:%S)] CHIP ALIVE (backend=$backend) — capturing" >>"$WLOG"
-        # The registered platform name ('tpu' on real hosts, 'axon' through
-        # the tunnel plugin) flows into the capture's pytest tier.
         NTXENT_CHIP_BACKEND="$backend" bash "$REPO/scripts/on_chip_capture.sh"
-        echo "[$(date -u +%H:%M:%S)] capture list finished; watch exiting" >>"$WLOG"
-        exit 0
+        echo "[$(date -u +%H:%M:%S)] capture pass finished; re-watching" >>"$WLOG"
+        # fall through to the sleep: a fast-failing step with a live chip
+        # must not spin capture passes back-to-back
     fi
     echo "[$(date -u +%H:%M:%S)] probe: backend=${backend:-none/timeout}" >>"$WLOG"
     sleep "$PERIOD"
 done
-echo "[$(date -u +%H:%M:%S)] watch window expired without a live chip" >>"$WLOG"
+echo "[$(date -u +%H:%M:%S)] watch window expired" >>"$WLOG"
 exit 1
