@@ -114,7 +114,32 @@ _WORKER = textwrap.dedent("""
         losses.append(float(metrics["loss"]))
     assert all(np.isfinite(losses)), losses
 
-    print("MULTIHOST_OK:" + json.dumps({**info, "losses": losses}))
+    # FSDP (ZeRO-3) across the process boundary: params + optimizer
+    # moments sharded over the SAME global mesh (device_put of the
+    # host-replicated init onto a cross-process NamedSharding), one step,
+    # replicated loss — both ranks must agree.
+    from ntxent_tpu.parallel import (
+        make_fsdp_train_step, param_bytes_per_device,
+        shard_train_state_fsdp)
+
+    fs_state = create_train_state(model, jax.random.PRNGKey(0),
+                                  (1, 8, 8, 3), cfg)
+    total_bytes = sum(x.size * x.dtype.itemsize
+                      for x in jax.tree_util.tree_leaves(fs_state.params))
+    fs_state = shard_train_state_fsdp(fs_state, mesh)
+    assert param_bytes_per_device(fs_state) < total_bytes
+    fs_step = make_fsdp_train_step(mesh, cfg.temperature)
+    rng = np.random.RandomState(7)
+    f1 = rng.rand(8, 8, 8, 3).astype(np.float32)
+    f2 = rng.rand(8, 8, 8, 3).astype(np.float32)
+    lo, hi = pid * 4, (pid + 1) * 4
+    fv1, fv2 = global_batch((f1[lo:hi], f2[lo:hi]), mesh)
+    fs_state, fs_m = fs_step(fs_state, fv1, fv2)
+    fsdp_loss = float(fs_m["loss"])
+    assert np.isfinite(fsdp_loss), fsdp_loss
+
+    print("MULTIHOST_OK:" + json.dumps(
+        {**info, "losses": losses, "fsdp_loss": fsdp_loss}))
     jax.distributed.shutdown()
 """)
 
@@ -164,6 +189,8 @@ def test_two_process_rendezvous_and_psum(tmp_path):
     # The replicated loss trajectory must be bit-identical on both
     # processes — each ran the same global program over its own devices.
     assert results[0]["losses"] == results[1]["losses"], results
+    # FSDP across the boundary: same replicated trajectory requirement.
+    assert results[0]["fsdp_loss"] == results[1]["fsdp_loss"], results
 
 
 def test_explicit_coordinator_failure_propagates():
