@@ -43,7 +43,13 @@ def default_attention() -> AttentionFn:
     """Backend auto-selection (same policy as the trainer's use_fused):
     the fused flash kernel where it compiles natively (TPU), the exact
     jnp oracle elsewhere (identical function; interpret-mode Pallas off
-    TPU is ~100x slower and measures nothing)."""
+    TPU is ~100x slower and measures nothing).
+
+    Measured basis for the unconditional-on-TPU choice (v5e A/B,
+    benchmark_results/tpu/attention_ab.json): flash ties XLA's own
+    fusion at L=1024 (0.96-1.13x), wins 1.5x at 4096 causal, and wins
+    23-31x at 8192 where XLA spills the materialized score matrix — no
+    length regime favors the oracle enough to warrant a crossover."""
     from ..utils.capability import is_tpu_backend
 
     if is_tpu_backend():
