@@ -222,3 +222,20 @@ def test_attention_autotune_live_sweep_caches_winner():
                 os.environ.pop("NTXENT_TPU_CACHE", None)
             else:
                 os.environ["NTXENT_TPU_CACHE"] = old
+
+
+def test_s2d_stem_matches_conv_on_device(rng):
+    """The space-to-depth stem equivalence through REAL conv lowering:
+    interpret-free CPU proved the math; this pins the TPU compilation of
+    both stems (conv_general_dilated layouts differ on MXU) to the same
+    features on the same weights."""
+    from ntxent_tpu.models import ResNet
+
+    plain = ResNet(stage_sizes=(1,), stem="conv", dtype=jnp.float32)
+    s2d = ResNet(stage_sizes=(1,), stem="space_to_depth", dtype=jnp.float32)
+    x = jax.random.normal(rng, (2, 64, 64, 3), jnp.float32)
+    vars_ = plain.init(jax.random.PRNGKey(0), x, train=False)
+    h1 = jax.jit(lambda v, xx: plain.apply(v, xx, train=False))(vars_, x)
+    h2 = jax.jit(lambda v, xx: s2d.apply(v, xx, train=False))(vars_, x)
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2),
+                               rtol=2e-4, atol=2e-4)
