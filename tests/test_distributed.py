@@ -254,6 +254,7 @@ class TestPairParallel:
         want = oracle.ntxent_loss(jnp.concatenate([z1, z2]), 0.1)
         np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
 
+    @pytest.mark.slow
     def test_matches_oracle_odd_mesh(self, rng):
         # 3-device submesh: odd P has no split tile — different schedule.
         # (P=3 exercises the same no-antipodal branch as any odd P at a
