@@ -89,6 +89,20 @@ say "=== on-chip capture session (r3b list) starting ==="
 # 1. Headline bench: refreshes the autotune vote under the v3 protocol
 #    (v2 votes were short-chain noise at fast shapes and are invalidated).
 run_step 1200 headline "$OUT/bench_headline.json" python bench.py || true
+# A tunnel death between chip_watch's probe and this step makes bench.py
+# exit 0 with a CPU-fallback record — never let that overwrite a committed
+# TPU capture (restore it and re-arm the step for the next window).
+if command -v python3 >/dev/null && [ -s "$OUT/bench_headline.json" ]; then
+    new_backend=$(python3 -c "import json,sys;print(json.load(open(sys.argv[1])).get('backend',''))" "$OUT/bench_headline.json" 2>/dev/null)
+    if [ "$new_backend" != "tpu" ] && [ "$new_backend" != "axon" ]; then
+        if git show "HEAD:benchmark_results/tpu/bench_headline.json" 2>/dev/null \
+                | grep -q '"backend": "\(tpu\|axon\)"'; then
+            say "headline: refusing to keep a $new_backend fallback over the committed TPU capture"
+            git checkout -- "$OUT/bench_headline.json" 2>>"$LOG"
+            rm -f "$OUT/.done_headline"
+        fi
+    fi
+fi
 cp -f "${NTXENT_TPU_CACHE:-$HOME/.cache/ntxent_tpu}/autotune.json" \
     "$OUT/autotune_cache.json" 2>/dev/null || true
 commit_art "on-chip capture: bench.py headline (v3 autotune protocol)" \
