@@ -413,10 +413,24 @@ def attention_working_set_bytes(bq: int, bk: int, d: int,
 
 
 def _blocks(l, lk, d, block_q, block_kv, itemsize=4):
+    if (block_q is not None and block_q <= 0) or \
+            (block_kv is not None and block_kv <= 0):
+        raise ValueError(f"block_q/block_kv must be positive, got "
+                         f"{(block_q, block_kv)}")
     bq = block_q or min(256, round_up(l, 8))
     bk = block_kv or min(256, round_up(lk, 128))
     bq = round_up(min(bq, round_up(l, 8)), 8)
     bk = round_up(min(bk, round_up(lk, 128)), 128)
+    if (block_q is not None and bq != block_q) or \
+            (block_kv is not None and bk != block_kv):
+        # An explicit pin (e.g. an autotune winner recorded for another
+        # shape) that is not a legal tile here gets aligned/clamped —
+        # say so, or the caller believes their measured tile is running.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "attention tile pin (%s, %s) adjusted to legal (%s, %s) "
+            "for shape l=%s lk=%s", block_q, block_kv, bq, bk, l, lk)
     # Shrink un-pinned dimensions until the tile working set fits VMEM.
     while attention_working_set_bytes(bq, bk, d, itemsize) \
             > VMEM_BUDGET_BYTES:

@@ -252,18 +252,21 @@ _ring_attention.defvjp(_ring_fwd, _ring_bwd)
 # --- Fused (Pallas) ring: flash folds per hop, kernel-grade hot path ---
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_attention_flash(q, k, v, axis, num_devices, causal, sc):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_attention_flash(q, k, v, axis, num_devices, causal, sc,
+                          bq=None, bk=None):
     """Ring attention whose per-hop fold runs the fused flash kernel
     (ops/attention_pallas.py:flash_fold) — carried (m, l, acc) statistics
     thread through the hops, so the across-hop softmax is exact and the
     (L_loc, L_loc) tile work happens on the MXU with VMEM statistics.
     The backward is the same second ring pass as the jnp form, but each
     hop's contribution comes from the flash dQ / dK-dV kernels."""
-    return _ring_flash_fwd(q, k, v, axis, num_devices, causal, sc)[0]
+    return _ring_flash_fwd(q, k, v, axis, num_devices, causal, sc,
+                           bq, bk)[0]
 
 
-def _ring_flash_fwd(q, k, v, axis, num_devices, causal, sc):
+def _ring_flash_fwd(q, k, v, axis, num_devices, causal, sc,
+                    bq=None, bk=None):
     from ..ops.attention_pallas import flash_fold
 
     b, l_loc, h, d = q.shape
@@ -284,7 +287,8 @@ def _ring_flash_fwd(q, k, v, axis, num_devices, causal, sc):
         kf, vf, k_off, m, l, acc = carry
         m, l, acc = flash_fold(qf, kf, vf, m, l, acc,
                                q_offset=q_off, k_offset=k_off[0],
-                               scale=sc, causal=causal)
+                               scale=sc, causal=causal,
+                               block_q=bq, block_kv=bk)
         kf = jax.lax.ppermute(kf, axis, perm)
         vf = jax.lax.ppermute(vf, axis, perm)
         k_off = jax.lax.ppermute(k_off, axis, perm)
@@ -298,7 +302,7 @@ def _ring_flash_fwd(q, k, v, axis, num_devices, causal, sc):
     return out, (q, k, v, out, lse)
 
 
-def _ring_flash_bwd(axis, num_devices, causal, sc, res, g):
+def _ring_flash_bwd(axis, num_devices, causal, sc, bq, bk, res, g):
     from ..ops.attention_pallas import flash_dkv_hop, flash_dq_hop
 
     q, k, v, out, lse = res
@@ -321,7 +325,7 @@ def _ring_flash_bwd(axis, num_devices, causal, sc, res, g):
     def step(carry, _):
         kf, vf, k_off, dkf, dvf, dqf = carry
         kwargs = dict(q_offset=q_off, k_offset=k_off[0], scale=sc,
-                      causal=causal)
+                      causal=causal, block_q=bq, block_kv=bk)
         dqf = dqf + flash_dq_hop(qf, kf, vf, dof, lse, delta, **kwargs)
         dkc, dvc = flash_dkv_hop(qf, kf, vf, dof, lse, delta, **kwargs)
         dkf, dvf = dkf + dkc, dvf + dvc
@@ -344,7 +348,9 @@ _ring_attention_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 def make_ring_attention(mesh: Mesh, axis: str = "data", *,
                         causal: bool = False, scale=None,
-                        impl: str = "jnp"):
+                        impl: str = "jnp",
+                        block_q: int | None = None,
+                        block_kv: int | None = None):
     """Build a jit-able sequence-parallel ring attention over ``mesh``.
 
     Returns ``fn(q, k, v) -> out`` with all four (B, L, H, D) and L
@@ -358,16 +364,26 @@ def make_ring_attention(mesh: Mesh, axis: str = "data", *,
     backward ring) — the TPU hot path; interpret-mode (exact, slow)
     off-TPU. The two are the same function; on-chip A/B decides the
     production default.
+
+    ``block_q``/``block_kv`` (flash only) pin the per-hop kernel tiles —
+    feed them from ``ops.autotune.autotune_attention_blocks(l_local,
+    l_local, head_dim, causal=causal)`` to run each hop at the
+    measured-winner tile instead of the static heuristic (the tuned
+    tile was worth up to 1.3x on the single-chip A/B ladder).
     """
     if impl not in ("jnp", "flash"):
         raise ValueError(f"unknown ring attention impl {impl!r}")
+    if impl != "flash" and (block_q is not None or block_kv is not None):
+        raise ValueError("block_q/block_kv tune the flash kernels; the "
+                         "jnp fold has no tiles — they would be silently "
+                         "ignored")
     num_devices = mesh.shape[axis]
 
     def body(q, k, v):
         sc = _resolve_scale(scale, q.shape[-1])
         if impl == "flash":
             return _ring_attention_flash(q, k, v, axis, num_devices,
-                                         causal, sc)
+                                         causal, sc, block_q, block_kv)
         return _ring_attention(q, k, v, axis, num_devices, causal, sc)
 
     return jax.shard_map(
