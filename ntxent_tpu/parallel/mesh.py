@@ -153,14 +153,18 @@ def create_hybrid_mesh(
     if total != jax.device_count():
         raise ValueError(f"hybrid mesh wants {total} devices, have "
                          f"{jax.device_count()}")
-    try:
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            tuple(ici_shape), tuple(dcn_shape))
-    except Exception as e:  # no slice attribute (CPU / single slice)
-        logger.info("hybrid device ordering unavailable (%s); using the "
-                    "flat mesh with the combined shape", e)
+    # Degrade to flat ordering ONLY where slice topology does not exist
+    # (CPU meshes, single slice) — on real multi-slice hardware a
+    # create_hybrid_device_mesh failure is a misconfiguration (e.g.
+    # per-slice product != slice size) and must surface, not silently
+    # produce the DCN-spanning layout this helper exists to prevent.
+    if getattr(jax.devices()[0], "slice_index", None) is None:
+        logger.info("no slice topology on this backend; using the flat "
+                    "mesh with the combined shape")
         combined = tuple(i * d for i, d in zip(ici_shape, dcn_shape))
-        dev_array = mesh_utils.create_device_mesh(combined)
+        return create_mesh(combined, axis_names)
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        tuple(ici_shape), tuple(dcn_shape))
     return Mesh(dev_array, tuple(axis_names))
 
 

@@ -394,8 +394,9 @@ def test_hybrid_mesh_runs_sharded_step(rng):
     from jax.sharding import Mesh
 
     from ntxent_tpu.parallel import create_hybrid_mesh, make_sharded_ntxent
-    from ntxent_tpu.training.trainer import shard_batch
 
+    if jax.device_count() != 8:
+        pytest.skip("hybrid-mesh shapes below assume exactly 8 devices")
     mesh = create_hybrid_mesh((2, 2), (2, 1), axis_names=("data", "model"))
     assert mesh.shape == {"data": 4, "model": 2}
 
