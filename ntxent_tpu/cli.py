@@ -145,6 +145,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     dist = p.add_argument_group("distributed (multi-host rendezvous; "
                                 "single-host multi-chip needs no flags)")
+    dist.add_argument("--dcn-slices", type=int, default=1,
+                      help="multi-slice pods: split the data axis across "
+                           "this many slices (DCN) with the per-slice "
+                           "chips innermost on ICI "
+                           "(parallel.create_hybrid_mesh); 1 = single "
+                           "slice / flat mesh")
     dist.add_argument("--coordinator", default=None,
                       help="host:port of process 0 (mpirun role; "
                            "auto-detected on Cloud TPU)")
@@ -198,6 +204,23 @@ def _make_encoder(name: str, image_size: int, moe_experts: int = 0,
     if moe_experts > 0:
         enc = functools.partial(enc, moe_experts=moe_experts)
     return enc
+
+
+def _data_mesh(args):
+    """The 1-D data mesh for DP/FSDP runs: flat, or hybrid DCN x ICI when
+    --dcn-slices > 1 (slice-aware device order on multi-slice pods)."""
+    from ntxent_tpu.parallel import create_hybrid_mesh, create_mesh
+
+    n = getattr(args, "dcn_slices", 1)
+    if n and n > 1:
+        import jax as _jax
+
+        if _jax.device_count() % n:
+            raise SystemExit(f"--dcn-slices {n} must divide the "
+                             f"{_jax.device_count()} devices")
+        return create_hybrid_mesh((_jax.device_count() // n,), (n,),
+                                  axis_names=("data",))
+    return create_mesh(axis_names=("data",))
 
 
 def _make_pipeline(args, per_process_batch: int, sharding=None, mesh=None):
@@ -354,7 +377,7 @@ def main(argv=None) -> int:
             logger.warning("--dp-loss %s ignored under --fsdp (the FSDP "
                            "step uses the GSPMD-sharded oracle loss)",
                            args.dp_loss)
-        mesh = create_mesh(axis_names=("data",))
+        mesh = _data_mesh(args)
         has_bs = bool(jax.tree_util.tree_leaves(state.batch_stats))
         step = make_fsdp_train_step(mesh, cfg.temperature,
                                     remat=args.remat,
@@ -367,7 +390,7 @@ def main(argv=None) -> int:
     elif n_dev > 1:
         from ntxent_tpu.parallel.mesh import data_sharding, replicate_state
 
-        mesh = create_mesh(axis_names=("data",))
+        mesh = _data_mesh(args)
         step = make_sharded_train_step(mesh, cfg.temperature,
                                        remat=args.remat,
                                        loss_impl=args.dp_loss,
@@ -568,7 +591,7 @@ def _train_clip(args, info, per_process_batch: int) -> int:
             from ntxent_tpu.training.trainer import (
                 make_sharded_clip_train_step)
 
-            mesh = create_mesh(axis_names=("data",))
+            mesh = _data_mesh(args)
             step = make_sharded_clip_train_step(mesh, remat=args.remat,
                                                 moe_aux_weight=moe_aux)
             # Same rationale as the SimCLR mesh path: restore must land
