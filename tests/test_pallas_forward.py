@@ -131,3 +131,36 @@ def test_triangular_forces_square_blocks(rng):
     tri = float(ntxent_loss_fused(z, 0.07, block_rows=32, block_cols=16,
                                   triangular=True))
     np.testing.assert_allclose(tri, rect, rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_fused_random_shape_fuzz(rng):
+    """Seeded fuzz over (rows, dim, scale, T, triangular): 12 draws of
+    awkward shapes (primes, non-multiples of every tile granule) must
+    match the oracle on loss AND gradient. The fixed grids above anchor
+    the reference protocol; this sweeps the input space between them —
+    the property-style coverage the reference's qualitative-only suite
+    never had (SURVEY §4)."""
+    import random
+
+    prng = random.Random(1234)
+    for draw in range(12):
+        two_n = 2 * prng.choice([3, 7, 13, 29, 53, 101, 173])
+        dim = prng.choice([5, 17, 33, 64, 129])
+        scale = prng.choice([1e-4, 1.0, 1e3])
+        t = prng.choice([0.03, 0.07, 0.5])
+        tri = prng.random() < 0.5
+        z = make_embeddings(jax.random.fold_in(rng, draw), two_n, dim,
+                            scale=scale)
+        want, gw = jax.value_and_grad(
+            lambda zz: oracle.ntxent_loss(zz, t))(z)
+        got, gg = jax.value_and_grad(
+            lambda zz: ntxent_loss_fused(zz, t, triangular=tri))(z)
+        np.testing.assert_allclose(
+            float(got), float(want), rtol=2e-5, atol=1e-6,
+            err_msg=f"draw {draw}: {two_n}x{dim} scale={scale} T={t} "
+                    f"tri={tri}")
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gw), rtol=2e-4, atol=1e-6,
+            err_msg=f"grad draw {draw}: {two_n}x{dim} scale={scale} "
+                    f"T={t} tri={tri}")
