@@ -347,3 +347,30 @@ def test_distributed_dual_vmem_fallback_matches(rng, mesh, monkeypatch):
     for a, b in zip(in_budget, fallback):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.slow
+def test_infonce_random_shape_fuzz(rng):
+    """Seeded fuzz over (n, dim, T): 8 draws of awkward pair counts must
+    match the oracle on loss and both tower gradients (the dual-direction
+    walk's padding/masking logic between the fixed grids)."""
+    import random
+
+    prng = random.Random(99)
+    for draw in range(8):
+        n = prng.choice([3, 11, 37, 61, 97, 131])
+        dim = prng.choice([7, 24, 65, 128])
+        t = prng.choice([0.03, 0.07, 0.5])
+        za, zb = paired(jax.random.fold_in(rng, draw), n, dim)
+        want, (gwa, gwb) = jax.value_and_grad(
+            lambda a, b: oracle.info_nce_loss(a, b, t),
+            argnums=(0, 1))(za, zb)
+        got, (gga, ggb) = jax.value_and_grad(
+            lambda a, b: info_nce_fused(a, b, t), argnums=(0, 1))(za, zb)
+        np.testing.assert_allclose(
+            float(got), float(want), rtol=2e-5, atol=1e-6,
+            err_msg=f"draw {draw}: n={n} dim={dim} T={t}")
+        for gg, gw in ((gga, gwa), (ggb, gwb)):
+            np.testing.assert_allclose(
+                np.asarray(gg), np.asarray(gw), rtol=2e-4, atol=5e-4,
+                err_msg=f"grad draw {draw}: n={n} dim={dim} T={t}")
