@@ -415,3 +415,26 @@ def test_hybrid_mesh_runs_sharded_step(rng):
         create_hybrid_mesh((2,), (2, 1))
     with pytest.raises(ValueError, match="devices"):
         create_hybrid_mesh((4, 4), (2, 1))
+
+
+@pytest.mark.slow
+def test_ring_random_shape_fuzz(rng, mesh):
+    """Seeded fuzz over ragged per-device row counts x temperature for the
+    ring NT-Xent (jnp fold): global batches whose shards force padding and
+    sentinel ids must still match the single-device oracle exactly."""
+    import random
+
+    prng = random.Random(5)
+    n_dev = mesh.shape["data"]
+    for draw in range(4):
+        per_dev = prng.choice([3, 5, 9, 11])
+        t = prng.choice([0.05, 0.1, 0.5])
+        n = per_dev * n_dev
+        k = jax.random.fold_in(rng, draw)
+        z1 = make_embeddings(k, n, 24)
+        z2 = make_embeddings(jax.random.fold_in(k, 1), n, 24)
+        got = float(ntxent_loss_ring(*shard_batch((z1, z2), mesh), mesh, t))
+        want = float(oracle.ntxent_loss(jnp.concatenate([z1, z2]), t))
+        np.testing.assert_allclose(
+            got, want, rtol=1e-5,
+            err_msg=f"draw {draw}: per_dev={per_dev} T={t}")
