@@ -249,7 +249,7 @@ def run_distributed(quick: bool, results: dict):
 
 def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
                    batch: int | None, remat: bool = False,
-                   stem: str = "conv"):
+                   stem: str = "conv", bn_fast_variance: bool = False):
     """(name, batch, size, state, step, step_args) for one flagship
     workload.
 
@@ -321,14 +321,22 @@ def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
                 logger.warning("--stem %s is ignored in the quick/"
                                "off-accelerator tier (tiny small-images "
                                "model has no ImageNet stem)", stem)
+            if bn_fast_variance:
+                logger.warning("--bn-fast-variance is ignored in the "
+                               "quick/off-accelerator tier (pathway "
+                               "check, not an A/B)")
             encoder = functools.partial(ResNet, stage_sizes=(1, 1),
                                         small_images=True)
             b, size, name = batch or 16, 32, "resnet_tiny"
         else:
-            encoder = functools.partial(ResNet50, stem=stem)
+            encoder = functools.partial(ResNet50, stem=stem,
+                                        bn_fast_variance=bn_fast_variance)
             b, size, name = batch or 128, 224, "resnet50"
-            if stem != "conv":
-                name = f"resnet50[{stem}]"
+            tags = [t for t in (stem if stem != "conv" else None,
+                                "fastvar" if bn_fast_variance else None)
+                    if t]
+            if tags:
+                name = f"resnet50[{','.join(tags)}]"
     model = SimCLRModel(encoder=encoder, proj_hidden_dim=128, proj_dim=64)
     cfg = TrainerConfig(batch_size=b, total_steps=10, warmup_steps=2)
     state = create_train_state(model, jax.random.PRNGKey(0),
@@ -344,7 +352,7 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
                       batch: int | None = None,
                       tag_batch: bool = False,
                       remat: bool = False,
-                      stem: str = "conv"):
+                      stem: str = "conv", bn_fast_variance: bool = False):
     """End-to-end train-step benchmark with automatic MFU.
 
     The role the reference's benchmark played for its hot path
@@ -361,7 +369,8 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
 
     on_accel = jax.default_backend() in ("tpu", "axon")
     name, batch, size, state, step, step_args = _trainer_setup(
-        model_name, quick, on_accel, batch, remat=remat, stem=stem)
+        model_name, quick, on_accel, batch, remat=remat, stem=stem,
+        bn_fast_variance=bn_fast_variance)
 
     import time as _time
     runs = 5 if quick or not on_accel else 30
@@ -470,7 +479,8 @@ def run_trainer_ablation(quick: bool, results: dict,
                          model_name: str = "resnet50",
                          batch: int | None = None,
                          stem: str = "conv",
-                         remat: bool = False):
+                         remat: bool = False,
+                         bn_fast_variance: bool = False):
     """Component attribution of the train step, no profiler needed.
 
     Times three chained programs on the same state/batch and reads the
@@ -492,7 +502,8 @@ def run_trainer_ablation(quick: bool, results: dict,
                          f"only; got --model {model_name}")
     on_accel = jax.default_backend() in ("tpu", "axon")
     name, batch, size, state, step, step_args = _trainer_setup(
-        model_name, quick, on_accel, batch, stem=stem, remat=remat)
+        model_name, quick, on_accel, batch, stem=stem, remat=remat,
+        bn_fast_variance=bn_fast_variance)
     runs = 5 if quick or not on_accel else 30
     temperature = 0.1
     # The SAME forward and loss the train step runs (fused kernel on
@@ -590,6 +601,10 @@ def main():
                         help="trainer-bench batch override; a comma list "
                              "(e.g. 64,128,256) sweeps batch sizes and "
                              "records one entry per size")
+    parser.add_argument("--bn-fast-variance", action="store_true",
+                        help="ResNet BatchNorm one-pass variance "
+                             "(halves BN reduction bandwidth; A/B lever "
+                             "for the RN50 MFU plateau)")
     parser.add_argument("--ablate", action="store_true",
                         help="component attribution: time fwd / fwd+bwd / "
                              "full-step chains and report the differences")
@@ -649,12 +664,15 @@ def main():
                 if args.ablate:
                     run_trainer_ablation(args.quick, results, model_name=m,
                                          batch=b, stem=args.stem,
-                                         remat=args.remat)
+                                         remat=args.remat,
+                                         bn_fast_variance=args
+                                         .bn_fast_variance)
                 else:
                     run_trainer_bench(args.quick, results, args.trace,
                                       model_name=m, batch=b,
                                       tag_batch=len(batches) > 1,
-                                      remat=args.remat, stem=args.stem)
+                                      remat=args.remat, stem=args.stem,
+                                      bn_fast_variance=args.bn_fast_variance)
 
     out_dir = Path(args.out)
     out_dir.mkdir(exist_ok=True)

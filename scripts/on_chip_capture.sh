@@ -72,7 +72,8 @@ run_step() {  # run_step <timeout_s> <name> <stdout_file|-> <cmd...>
 all_done() {
     local n
     for n in headline tpu_tests rn50_b256 rn50_b256_remat rn50_s2d \
-             rn50_ablate attention_ab loader train_e2e xprof; do
+             rn50_fastvar rn50_ablate attention_ab loader train_e2e \
+             xprof; do
         [ -e "$OUT/.done_$n" ] || return 1
     done
     return 0
@@ -134,6 +135,13 @@ run_step 1500 rn50_s2d - python benchmarks/run_benchmarks.py \
     --trainer-only --model resnet50 --batch 128 --stem space_to_depth \
     --out "$OUT/mfu_rn50_s2d" || true
 commit_art "on-chip capture: RN50 space-to-depth stem A/B" "$OUT/" || true
+
+# 5a2. BatchNorm one-pass-variance A/B at batch 128 (the bandwidth
+#      lever: 53 norms x two reduction passes -> one).
+run_step 1500 rn50_fastvar - python benchmarks/run_benchmarks.py \
+    --trainer-only --model resnet50 --batch 128 --bn-fast-variance \
+    --out "$OUT/mfu_rn50_fastvar" || true
+commit_art "on-chip capture: RN50 BN fast-variance A/B" "$OUT/" || true
 
 # 5b. Step-component ablation (fwd / fwd+bwd / full chains): where the
 #     RN50 milliseconds actually go — profiler-free attribution that the
