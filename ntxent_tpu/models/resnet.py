@@ -162,6 +162,13 @@ class ResNet(nn.Module):
         if self.stem not in ("conv", "space_to_depth"):
             raise ValueError(f"unknown stem {self.stem!r}: expected 'conv' "
                              "or 'space_to_depth'")
+        if self.small_images and self.stem != "conv":
+            # The CIFAR stem replaces the ImageNet stem entirely, so a
+            # non-default stem choice would be silently ignored here —
+            # fail loudly instead (same check the CLI makes; ADVICE r3 #3).
+            raise ValueError(f"stem={self.stem!r} requires the ImageNet "
+                             "stem; small_images=True uses the 3x3 CIFAR "
+                             "stem and would silently ignore it")
         x = x.astype(self.dtype)
         width = 64 * self.width_multiplier
         if self.small_images:

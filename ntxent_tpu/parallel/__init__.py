@@ -44,6 +44,7 @@ from ntxent_tpu.parallel.ring import (
 )
 from ntxent_tpu.parallel.fsdp import (
     fsdp_param_spec,
+    make_fsdp_clip_train_step,
     make_fsdp_train_step,
     param_bytes_per_device,
     shard_train_state_fsdp,
@@ -93,6 +94,7 @@ __all__ = [
     "make_tp_simclr_train_step",
     "make_tp_clip_train_step",
     "fsdp_param_spec",
+    "make_fsdp_clip_train_step",
     "make_fsdp_train_step",
     "param_bytes_per_device",
     "shard_train_state_fsdp",

@@ -40,6 +40,19 @@ __all__ = ["ntxent_loss_distributed", "make_sharded_ntxent",
            "local_infonce_dual", "resolve_local_infonce"]
 
 
+def _resolve_loss_axes(mesh: Mesh, axis):
+    """(axes tuple, collective axis arg, device count) for a loss that may
+    span one mesh axis (the plain DP case) or several (hybrid meshes —
+    the FSDP step's batch axes). A single axis keeps the string form for
+    collectives (identical semantics, simpler HLO names); multiple axes
+    pass as the tuple ``lax`` collectives accept directly."""
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    return axes, axes[0] if len(axes) == 1 else axes, n
+
+
 def local_ntxent_allgather(z1_local, z2_local, temperature, axis, num_devices,
                            interpret=None):
     """Per-device global-batch NT-Xent body (call inside shard_map/psum
@@ -90,13 +103,18 @@ def make_sharded_ntxent(
     global-cols strip. ``impl="pair"``: balanced symmetric shard-pair
     schedule — each global tile walked once across the mesh, ~2.2x fewer
     loss matmuls at P=8 (see parallel/pair.py for the trade-offs).
+
+    ``axis`` may be a tuple of mesh axes (e.g. ``('dcn', 'data')`` on a
+    hybrid mesh): the batch then shards over their product and the
+    bodies' collectives run over the combined axes — how the FSDP step
+    embeds this loss inside a GSPMD program (fsdp.py).
     """
-    num_devices = mesh.shape[axis]
+    axes, body_axis, num_devices = _resolve_loss_axes(mesh, axis)
 
     body = functools.partial(
         resolve_local_ntxent(impl),
         temperature=float(temperature),
-        axis=axis,
+        axis=body_axis,
         num_devices=num_devices,
         interpret=interpret,
     )
@@ -105,7 +123,7 @@ def make_sharded_ntxent(
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(axis), P(axis)),
+        in_specs=(P(axes), P(axes)),
         out_specs=P(),
         check_vma=False,
     )
@@ -197,16 +215,19 @@ def make_sharded_infonce(
     ``impl="dual"`` (default) gathers one modality and walks the
     similarity block once for both directions; ``impl="twopass"`` is the
     gather-both/walk-twice form (kept for A/B comparison).
+
+    ``axis`` may be a tuple of mesh axes, as in ``make_sharded_ntxent``.
     """
     local = resolve_local_infonce(impl)
+    axes, body_axis, _ = _resolve_loss_axes(mesh, axis)
 
     def body(za_local, zb_local, scale):
-        return local(za_local, zb_local, scale, axis, interpret)
+        return local(za_local, zb_local, scale, body_axis, interpret)
 
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P()),
+        in_specs=(P(axes), P(axes), P()),
         out_specs=P(),
         check_vma=False,
     )

@@ -25,10 +25,19 @@ TPU-idiomatic recipe (same shape as tp.py — annotate, don't hand-roll):
   on the optimizer's shards. No hand-written collectives anywhere; this
   is the ICI-bandwidth-for-HBM-capacity trade compiled from annotations.
 
-Composes with the fused-kernel DP loss story the same way tp.py does:
-the loss here is the jnp oracle (GSPMD shards the similarity matmul);
-the explicit shard_map + fused Pallas partials path stays the
-latency-optimal route when params fit.
+Composes with the fused-kernel DP loss: the default train step embeds
+the shard_map fused-partial NT-Xent (``dist_loss.resolve_local_ntxent``
+— the same strip/pair bodies the explicit DP trainer uses) inside the
+GSPMD-sharded program, so ZeRO-3 parameter sharding and the Pallas
+fused loss run together in one jitted step (``loss_impl="oracle"``
+keeps the all-jnp GSPMD-sharded similarity matmul for A/B).
+
+Hybrid ZeRO on multi-slice pods: pass a 2-axis ``('dcn', 'data')``
+hybrid mesh with ``batch_axes=('dcn', 'data')`` and the default
+``axis='data'`` — the batch (and the loss all-gather's bulky, once-per-
+step traffic) spans slices over DCN while the per-layer weight
+all-gathers GSPMD inserts at use stay on intra-slice ICI, because the
+parameter shards never cross slices (ADVICE r3 #1).
 """
 
 from __future__ import annotations
@@ -41,13 +50,13 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.oracle import ntxent_loss
-from .mesh import data_sharding
 
 __all__ = [
     "fsdp_param_spec",
     "fsdp_spec_tree",
     "shard_train_state_fsdp",
     "make_fsdp_train_step",
+    "make_fsdp_clip_train_step",
     "param_bytes_per_device",
 ]
 
@@ -120,10 +129,6 @@ def param_bytes_per_device(state) -> int:
     return total
 
 
-def _constrain_batch(x, mesh: Mesh, axis: str):
-    return jax.lax.with_sharding_constraint(x, data_sharding(mesh, axis))
-
-
 def _constrain_state(state, mesh: Mesh, axis: str):
     """Pin every array leaf of the OUTPUT state to its FSDP spec.
 
@@ -144,27 +149,95 @@ def _constrain_state(state, mesh: Mesh, axis: str):
     return jax.tree_util.tree_map(pin, state)
 
 
+def _resolve_batch_axes(mesh: Mesh, axis: str, batch_axes):
+    """(batch_axes tuple, shard_map collective axis arg, device count).
+
+    ``batch_axes`` defaults to every mesh axis; the parameter axis must be
+    among them (its gradient reduce-scatter rides the batch program). A
+    single axis keeps the string form for collectives (identical
+    semantics, simpler HLO names); multiple axes pass as the tuple the
+    collectives accept directly.
+    """
+    if batch_axes is None:
+        batch_axes = tuple(mesh.axis_names)
+    if isinstance(batch_axes, str):
+        batch_axes = (batch_axes,)
+    batch_axes = tuple(batch_axes)
+    if axis not in batch_axes:
+        raise ValueError(f"param axis {axis!r} must be one of the batch "
+                         f"axes {batch_axes} (its gradient reduce-scatter "
+                         "rides the batch program)")
+    loss_axis = batch_axes[0] if len(batch_axes) == 1 else batch_axes
+    n = 1
+    for a in batch_axes:
+        n *= mesh.shape[a]
+    return batch_axes, loss_axis, n
+
+
+def _row_constrainer(mesh: Mesh, batch_axes: tuple):
+    """Closure pinning an array's leading dim over ``batch_axes``."""
+    sharding = NamedSharding(mesh, P(batch_axes))
+
+    def constrain(x):
+        return jax.lax.with_sharding_constraint(x, sharding)
+
+    return constrain
+
+
 def make_fsdp_train_step(
     mesh: Mesh,
     temperature: float = 0.1,
     *,
     axis: str = "data",
+    batch_axes: str | tuple | None = None,
     has_batch_stats: bool = True,
     remat: bool = False,
+    loss_impl: str = "strip",
+    interpret: bool | None = None,
 ) -> Callable:
-    """Fully-sharded SimCLR train step: batch sharded over ``axis``,
-    weights/optimizer sharded per ``fsdp_param_spec``; GSPMD derives the
-    gather-on-use / reduce-scatter schedule. ``has_batch_stats`` default
-    True (the flagship FSDP target is the ResNet family, which carries
-    BatchNorm; the global-batch program gives cross-replica statistics by
-    construction). ``remat=True`` rematerializes the encoder forward —
-    the usual FSDP companion, since both trade compute/comm for HBM.
+    """Fully-sharded SimCLR train step: batch sharded over ``batch_axes``
+    (default: every mesh axis), weights/optimizer sharded over ``axis``
+    per ``fsdp_param_spec``; GSPMD derives the gather-on-use /
+    reduce-scatter schedule for the weights while the loss runs as the
+    shard_map fused-partial NT-Xent over the batch axes.
+
+    ``loss_impl``: ``"strip"`` (default) / ``"pair"`` — the fused Pallas
+    per-device bodies shared with the explicit DP trainer
+    (``dist_loss.resolve_local_ntxent``); ``"oracle"`` — the all-jnp
+    global loss whose similarity matmul GSPMD shards (the pre-round-4
+    behavior, kept for A/B).
+
+    On a 1-axis mesh ``batch_axes == (axis,)`` and this is flat ZeRO-3.
+    On a hybrid ``('dcn', 'data')`` mesh the defaults give hybrid ZeRO:
+    batch over all devices, parameter shards confined to the intra-slice
+    ``data`` (ICI) axis and replicated across slices, so per-layer weight
+    all-gathers never touch DCN.
+
+    ``has_batch_stats`` default True (the flagship FSDP target is the
+    ResNet family, which carries BatchNorm; the global-batch program
+    gives cross-replica statistics by construction). ``remat=True``
+    rematerializes the encoder forward — the usual FSDP companion, since
+    both trade compute/comm for HBM.
     """
+    batch_axes, loss_axis, _ = _resolve_batch_axes(mesh, axis, batch_axes)
+
+    if loss_impl == "oracle":
+        sharded_loss = None
+    else:
+        # The ONE dispatch point for fused NT-Xent bodies — same factory
+        # the explicit shard_map DP trainer uses, tuple-axis form.
+        from .dist_loss import make_sharded_ntxent
+
+        sharded_loss = make_sharded_ntxent(
+            mesh, temperature, axis=loss_axis, interpret=interpret,
+            impl=loss_impl)
+
+    constrain_rows = _row_constrainer(mesh, batch_axes)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, v1, v2):
-        v1c = _constrain_batch(v1, mesh, axis)
-        v2c = _constrain_batch(v2, mesh, axis)
+        v1c = constrain_rows(v1)
+        v2c = constrain_rows(v2)
 
         def encode(params, both):
             if has_batch_stats:
@@ -181,8 +254,17 @@ def make_fsdp_train_step(
             both = jnp.concatenate([v1c, v2c], axis=0)
             z, updates = encode(params, both)
             new_stats = updates["batch_stats"] if has_batch_stats else None
-            z = _constrain_batch(z, mesh, axis)
-            return ntxent_loss(z, temperature), new_stats
+            if sharded_loss is None:
+                z = constrain_rows(z)
+                return ntxent_loss(z, temperature), new_stats
+            n = v1c.shape[0]
+            # Split the stacked (2N, D) embeddings back into views: the
+            # fused bodies take (z1, z2) row-sharded over the batch axes
+            # and rebuild the [view1; view2] global layout internally
+            # (mesh.local_row_gids).
+            z1 = constrain_rows(z[:n])
+            z2 = constrain_rows(z[n:])
+            return sharded_loss(z1, z2), new_stats
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
@@ -190,5 +272,97 @@ def make_fsdp_train_step(
         if new_stats is not None:
             state2 = state2.replace(batch_stats=new_stats)
         return _constrain_state(state2, mesh, axis), {"loss": loss}
+
+    return train_step
+
+
+def make_fsdp_clip_train_step(
+    mesh: Mesh,
+    *,
+    axis: str = "data",
+    batch_axes: str | tuple | None = None,
+    remat: bool = False,
+    loss_impl: str = "dual",
+    moe_aux_weight: float = 0.0,
+    interpret: bool | None = None,
+) -> Callable:
+    """Fully-sharded CLIP train step: the dual-tower analog of
+    ``make_fsdp_train_step`` (round 4 — the CLI previously refused
+    ``--fsdp`` for the CLIP objective outright).
+
+    ViT-L/H-scale dual towers with AdamW moments are exactly where ZeRO-3
+    pays: params + both optimizer moments shard over ``axis`` per
+    ``fsdp_param_spec`` while the (images, tokens) batch shards over
+    ``batch_axes`` (default: every mesh axis — hybrid ZeRO on a
+    ``('dcn', 'data')`` mesh, like the SimCLR step).
+
+    ``loss_impl``: ``"dual"`` (default) / ``"twopass"`` — the fused
+    partial InfoNCE bodies shared with the shard_map DP trainer
+    (``dist_loss.resolve_local_infonce``), run as a shard_map inside the
+    GSPMD program; ``"oracle"`` — the all-jnp global InfoNCE whose
+    similarity matmul GSPMD shards.
+
+    ``state.apply_fn(variables, images, tokens)`` must return
+    ``(image_embeds, text_embeds, scale)`` (models/clip.py); the
+    learnable logit scale's gradient flows through either loss path.
+    ``moe_aux_weight > 0`` adds the MoE towers' load-balance aux loss —
+    computed once over the global batch by the GSPMD program (no
+    per-shard pmean estimator needed, unlike the shard_map DP step).
+    """
+    batch_axes, loss_axis, _ = _resolve_batch_axes(mesh, axis, batch_axes)
+    collect = moe_aux_weight > 0.0
+
+    if loss_impl == "oracle":
+        sharded_loss = None
+    else:
+        # The ONE dispatch point for fused InfoNCE bodies — same factory
+        # the shard_map DP CLIP trainer uses, tuple-axis form.
+        from .dist_loss import make_sharded_infonce
+
+        sharded_loss = make_sharded_infonce(
+            mesh, axis=loss_axis, interpret=interpret, impl=loss_impl)
+
+    constrain_rows = _row_constrainer(mesh, batch_axes)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state, images, tokens):
+        imc = constrain_rows(images)
+        tkc = constrain_rows(tokens)
+
+        def fwd(params, im, tk):
+            if not collect:
+                zi, zt, scale = state.apply_fn(
+                    {"params": params}, im, tk, train=True)
+                return zi, zt, scale, 0.0
+            from .moe import moe_aux_from
+
+            (zi, zt, scale), updates = state.apply_fn(
+                {"params": params}, im, tk, train=True,
+                mutable=["intermediates"])
+            return zi, zt, scale, moe_aux_from(updates)
+
+        if remat:
+            fwd = jax.checkpoint(fwd)
+
+        def loss_fn(params):
+            zi, zt, scale, aux = fwd(params, imc, tkc)
+            if sharded_loss is None:
+                from ..ops.oracle import info_nce_loss
+
+                zi_c = constrain_rows(zi)
+                zt_c = constrain_rows(zt)
+                loss = info_nce_loss(zi_c, zt_c, temperature=1.0 / scale)
+            else:
+                loss = sharded_loss(constrain_rows(zi), constrain_rows(zt),
+                                    scale)
+            return loss + moe_aux_weight * aux, aux
+
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        state2 = state.apply_gradients(grads=grads)
+        metrics = {"loss": loss}
+        if collect:
+            metrics["moe_aux"] = aux
+        return _constrain_state(state2, mesh, axis), metrics
 
     return train_step

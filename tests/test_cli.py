@@ -84,10 +84,13 @@ def test_cli_synthetic_run_checkpoints_and_resumes(tmp_path):
 
 
 @pytest.mark.slow
-def test_cli_fsdp_run(tmp_path):
+@pytest.mark.parametrize("dcn_slices", [1, 2])
+def test_cli_fsdp_run(tmp_path, dcn_slices):
     """--fsdp launch: params/optimizer sharded over the 8-device mesh,
     training proceeds, checkpoints against the SHARDED template, and a
-    relaunch restores it; --objective clip rejects the flag."""
+    relaunch restores it; --objective clip rejects the flag. With
+    --dcn-slices 2 the same launch builds the hybrid-ZeRO ('dcn', 'data')
+    mesh (params on the ICI axis only — ADVICE r3 #1)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
@@ -101,11 +104,16 @@ def test_cli_fsdp_run(tmp_path):
            "--batch", "16", "--steps", "2", "--warmup-steps", "1",
            "--proj-hidden-dim", "16", "--proj-dim", "8",
            "--ckpt-dir", str(ckpt), "--ckpt-every", "100",
-           "--log-every", "1", "--platform", "cpu", "--fsdp"]
+           "--log-every", "1", "--platform", "cpu", "--fsdp",
+           "--dcn-slices", str(dcn_slices)]
     run = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
                          env=env)
     assert run.returncode == 0, run.stdout + run.stderr
-    assert "FSDP (ZeRO-3) over 8 devices" in (run.stdout + run.stderr)
+    assert "FSDP (ZeRO-3, strip loss) over 8 devices" \
+        in (run.stdout + run.stderr)
+    if dcn_slices > 1:
+        assert "hybrid ZeRO: params sharded over ICI axis 'data' (size 4)" \
+            in (run.stdout + run.stderr)
     assert "final: step 2" in (run.stdout + run.stderr)
     assert ckpt.exists() and any(ckpt.iterdir())
 
@@ -117,10 +125,15 @@ def test_cli_fsdp_run(tmp_path):
     assert second.returncode == 0, second.stdout + second.stderr
     assert "nothing to do" in (second.stdout + second.stderr)
 
-    bad = subprocess.run(cmd + ["--objective", "clip"], capture_output=True,
-                         text=True, timeout=120, env=env)
+    # --fsdp composes with the CLIP objective since round 4 (dp only):
+    # the tensor-parallel combination is the one that must still refuse.
+    bad = subprocess.run(cmd + ["--objective", "clip",
+                                "--clip-parallel", "tp"],
+                         capture_output=True, text=True, timeout=120,
+                         env=env)
     assert bad.returncode != 0
-    assert "--fsdp is the SimCLR" in (bad.stdout + bad.stderr)
+    assert "--fsdp and --clip-parallel tp do not compose" \
+        in (bad.stdout + bad.stderr)
 
 
 @pytest.mark.slow
@@ -377,3 +390,34 @@ def test_cli_cifar10_train_then_eval(tmp_path):
         train_extra=["--batch", "8", "--steps", "2"],
         eval_extra=["--probe-steps", "30", "--k", "3",
                     "--max-train", "32", "--max-test", "8"])
+
+
+@pytest.mark.slow
+def test_cli_clip_fsdp_run(tmp_path):
+    """--objective clip --fsdp (round 4): ZeRO-3 dual towers with the
+    fused partial InfoNCE inside the GSPMD step, checkpointed against
+    the sharded template and restored on relaunch."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    repo = os.path.dirname(os.path.dirname(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    ckpt = tmp_path / "ckpt"
+    cmd = [sys.executable, "-m", "ntxent_tpu.cli",
+           "--objective", "clip", "--model", "tiny",
+           "--dataset", "synthetic", "--synthetic-samples", "64",
+           "--image-size", "16", "--vocab-size", "64", "--token-len", "8",
+           "--batch", "16", "--steps", "2", "--warmup-steps", "1",
+           "--ckpt-dir", str(ckpt), "--ckpt-every", "100",
+           "--log-every", "1", "--platform", "cpu", "--fsdp"]
+    run = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                         env=env)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "CLIP FSDP (ZeRO-3, dual loss) over 8 devices" \
+        in (run.stdout + run.stderr)
+    assert ckpt.exists() and any(ckpt.iterdir())
+    second = subprocess.run(cmd, capture_output=True, text=True,
+                            timeout=600, env=env)
+    assert second.returncode == 0, second.stdout + second.stderr
+    assert "nothing to do" in (second.stdout + second.stderr)

@@ -53,11 +53,15 @@ def test_fsdp_spec_rules():
 
 
 @pytest.mark.parametrize(
-    "remat",
-    [False,
+    "remat,loss_impl",
+    [(False, "strip"),
+     # The GSPMD-sharded jnp-oracle loss (the pre-round-4 default) and
+     # the balanced shard-pair fused body, same contract.
+     (False, "oracle"),
+     pytest.param(False, "pair", marks=pytest.mark.slow),
      # remat recompiles the whole encoder backward; slow tier only.
-     pytest.param(True, marks=pytest.mark.slow)])
-def test_fsdp_step_matches_unsharded(remat):
+     pytest.param(True, "strip", marks=pytest.mark.slow)])
+def test_fsdp_step_matches_unsharded(remat, loss_impl):
     batch = 16
     mesh = create_mesh(axis_names=("data",))
     state, cfg = _tiny_state(batch)
@@ -76,7 +80,8 @@ def test_fsdp_step_matches_unsharded(remat):
     ref_step = make_train_step(cfg.temperature)
     ref_state, ref_m = ref_step(state, v1, v2)
 
-    fsdp_step = make_fsdp_train_step(mesh, cfg.temperature, remat=remat)
+    fsdp_step = make_fsdp_train_step(mesh, cfg.temperature, remat=remat,
+                                     loss_impl=loss_impl)
     fstate2, m = fsdp_step(fstate, v1, v2)
 
     # GSPMD reduces in a different order (reduce-scatter trees vs local
@@ -111,3 +116,127 @@ def test_fsdp_shards_param_and_optimizer_bytes():
     assert opt_leaves, "no mirrored optimizer moment found for the big leaf"
     assert opt_leaves[0].addressable_shards[0].data.size \
         == big.size // n_dev
+
+
+def test_hybrid_zero_params_stay_on_ici_axis():
+    """Hybrid ZeRO on a ('dcn', 'data') mesh (ADVICE r3 #1): the batch —
+    and the loss's once-per-step bulky collectives — span every device,
+    but parameter shards are confined to the inner ICI axis and
+    replicated across slices, so the per-layer weight all-gathers GSPMD
+    inserts at use never cross DCN. Same numbers as the unsharded step.
+    """
+    batch = 16
+    hmesh = create_mesh((2, 4), axis_names=("dcn", "data"))
+    state, cfg = _tiny_state(batch)
+    state2, _ = _tiny_state(batch)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+    v1 = jax.random.uniform(k1, (batch, 16, 16, 3))
+    v2 = jax.random.uniform(k2, (batch, 16, 16, 3))
+
+    ref_state, ref_m = make_train_step(cfg.temperature)(state, v1, v2)
+    fstate = shard_train_state_fsdp(state2, hmesh, axis="data")
+    # batch_axes defaults to every mesh axis -> ('dcn', 'data').
+    step = make_fsdp_train_step(hmesh, cfg.temperature, axis="data")
+    fstate2, m = step(fstate, v1, v2)
+
+    np.testing.assert_allclose(float(m["loss"]), float(ref_m["loss"]),
+                               rtol=1e-3)
+    for r, g in zip(jax.tree_util.tree_leaves(ref_state.params),
+                    jax.tree_util.tree_leaves(fstate2.params)):
+        np.testing.assert_allclose(np.asarray(jax.device_get(g)),
+                                   np.asarray(r), rtol=5e-3, atol=5e-4)
+    # The memory claim, hybrid form: big leaves split 1/|ici|, NOT 1/8 —
+    # the dcn dimension replicates.
+    big = max(jax.tree_util.tree_leaves(fstate2.params),
+              key=lambda x: x.size)
+    assert big.addressable_shards[0].data.size == big.size // 4
+
+
+def test_fsdp_param_axis_must_ride_batch_axes():
+    hmesh = create_mesh((2, 4), axis_names=("dcn", "data"))
+    with pytest.raises(ValueError, match="must be one of the batch axes"):
+        make_fsdp_train_step(hmesh, 0.1, axis="dcn", batch_axes=("data",))
+
+
+def _tiny_clip_state():
+    import optax
+
+    from ntxent_tpu.models import (
+        CLIPModel,
+        TextTransformer,
+        VisionTransformer,
+    )
+    from ntxent_tpu.training.trainer import TrainState
+
+    model = CLIPModel(
+        image_encoder=functools.partial(
+            VisionTransformer, hidden_dim=16, depth=1, num_heads=2,
+            mlp_dim=32, patch_size=8, dtype=jnp.float32),
+        text_encoder=functools.partial(
+            TextTransformer, vocab_size=32, max_len=8, hidden_dim=16,
+            depth=1, num_heads=2, dtype=jnp.float32),
+        embed_dim=8)
+    images = jax.random.uniform(jax.random.PRNGKey(11), (16, 16, 16, 3))
+    tokens = jax.random.randint(jax.random.PRNGKey(12), (16, 8), 1, 32)
+    variables = model.init(jax.random.PRNGKey(0), images[:1], tokens[:1],
+                           train=False)
+    # SGD, not AdamW: Adam's first-step update is +/-lr whatever the
+    # gradient magnitude, so near-zero-gradient leaves amplify harmless
+    # reduction-order noise into sign flips — SGD keeps param deltas
+    # proportional to the gradients this test actually compares.
+    state = TrainState.create(apply_fn=model.apply,
+                              params=variables["params"],
+                              tx=optax.sgd(1e-2))
+    return state, images, tokens
+
+
+@pytest.mark.parametrize(
+    "loss_impl",
+    ["dual",
+     pytest.param("twopass", marks=pytest.mark.slow),
+     pytest.param("oracle", marks=pytest.mark.slow)])
+def test_fsdp_clip_step_matches_unsharded(loss_impl):
+    """ZeRO-3 for the dual-tower CLIP objective (round 4): the FSDP step
+    with the fused partial InfoNCE inside the GSPMD program computes the
+    same loss and the same updated params as the single-device step."""
+    from ntxent_tpu.training.trainer import make_clip_train_step
+
+    state, images, tokens = _tiny_clip_state()
+    state2, _, _ = _tiny_clip_state()
+    ref_state, ref_m = make_clip_train_step(use_fused=False)(
+        state, images, tokens)
+
+    mesh = create_mesh(axis_names=("data",))
+    fstate = shard_train_state_fsdp(state2, mesh)
+    from ntxent_tpu.parallel import make_fsdp_clip_train_step
+
+    step = make_fsdp_clip_train_step(mesh, loss_impl=loss_impl)
+    fstate2, m = step(fstate, images, tokens)
+
+    np.testing.assert_allclose(float(m["loss"]), float(ref_m["loss"]),
+                               rtol=1e-3)
+    for r, g in zip(jax.tree_util.tree_leaves(ref_state.params),
+                    jax.tree_util.tree_leaves(fstate2.params)):
+        np.testing.assert_allclose(np.asarray(jax.device_get(g)),
+                                   np.asarray(r), rtol=5e-3, atol=5e-4)
+
+
+@pytest.mark.slow
+def test_fsdp_clip_hybrid_mesh():
+    """CLIP hybrid ZeRO on a ('dcn', 'data') mesh: same loss as the
+    single-device step (the tiny towers' leaves all sit below
+    MIN_SHARD_ELEMS, so the byte-sharding claim is covered by the
+    SimCLR hybrid test, not re-asserted here)."""
+    from ntxent_tpu.parallel import make_fsdp_clip_train_step
+    from ntxent_tpu.training.trainer import make_clip_train_step
+
+    state, images, tokens = _tiny_clip_state()
+    state2, _, _ = _tiny_clip_state()
+    _, ref_m = make_clip_train_step(use_fused=False)(state, images, tokens)
+
+    hmesh = create_mesh((2, 4), axis_names=("dcn", "data"))
+    fstate = shard_train_state_fsdp(state2, hmesh, axis="data")
+    step = make_fsdp_clip_train_step(hmesh, axis="data")
+    _, m = step(fstate, images, tokens)
+    np.testing.assert_allclose(float(m["loss"]), float(ref_m["loss"]),
+                               rtol=1e-3)
