@@ -249,7 +249,8 @@ def run_distributed(quick: bool, results: dict):
 
 def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
                    batch: int | None, remat: bool = False,
-                   stem: str = "conv", bn_fast_variance: bool = False):
+                   stem: str = "conv", bn_fast_variance: bool = False,
+                   vit_attention: str = "xla"):
     """(name, batch, size, state, step, step_args) for one flagship
     workload.
 
@@ -280,17 +281,23 @@ def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
     k1, k2 = jax.random.split(jax.random.PRNGKey(1))
 
     if model_name == "clip_b16":
+        # --vit-attention applies to the IMAGE tower only: the text tower
+        # is causally masked, which the flash path refuses by design.
         if small:
             image_enc = functools.partial(
                 VisionTransformer, hidden_dim=32, depth=2, num_heads=2,
-                mlp_dim=64, patch_size=8)
+                mlp_dim=64, patch_size=8, attention_impl=vit_attention)
             text_enc = functools.partial(
                 TextTransformer, vocab_size=128, max_len=16, hidden_dim=32,
                 depth=2, num_heads=2)
             b, size, tok_len, name = batch or 8, 32, 16, "clip_tiny"
         else:
-            image_enc, text_enc = ViT_B16, TextTransformer
+            image_enc = functools.partial(ViT_B16,
+                                          attention_impl=vit_attention)
+            text_enc = TextTransformer
             b, size, tok_len, name = batch or 256, 224, 77, "clip_b16"
+        if vit_attention != "xla":
+            name = f"{name}[{vit_attention}]"
         model = CLIPModel(image_encoder=image_enc, text_encoder=text_enc,
                           embed_dim=128 if small else 512)
         images = jax.random.uniform(k1, (b, size, size, 3))
@@ -310,11 +317,15 @@ def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
         if small:
             encoder = functools.partial(
                 VisionTransformer, hidden_dim=32, depth=2, num_heads=2,
-                mlp_dim=64, patch_size=8)
+                mlp_dim=64, patch_size=8,
+                attention_impl=vit_attention)
             b, size, name = batch or 8, 32, "vit_tiny"
         else:
-            encoder = ViT_B16
+            encoder = functools.partial(ViT_B16,
+                                        attention_impl=vit_attention)
             b, size, name = batch or 128, 224, "vit_b16"
+        if vit_attention != "xla":
+            name = f"{name}[{vit_attention}]"
     else:  # resnet50
         if small:
             if stem != "conv":
@@ -347,12 +358,42 @@ def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
             make_train_step(cfg.temperature, remat=remat), (v1, v2))
 
 
+def _vit_flash_flops_correction(model_name: str, name: str, batch: int,
+                                size: int) -> float:
+    """Analytic fwd+bwd FLOPs of the attention matmuls when the ViT tower
+    runs the Pallas flash kernel.
+
+    XLA's cost analysis reports ~0 FLOPs for pallas_call custom calls, so
+    the compiled-executable count the MFU rides on omits QK^T / PV (and
+    their backward) exactly when ``--vit-attention flash`` moves them
+    into the kernel — without this, the flash A/B's MFU is biased low by
+    the attention share of the step while the chip does identical math.
+    Counted at the XLA-variant equivalent (forward + standard backward =
+    3x forward), independent of the kernel's internal recompute policy —
+    the same useful-work convention cost analysis applies to the rest of
+    the step.
+    """
+    dims = {"vit_tiny": (32, 2, 8), "vit_b16": (768, 12, 16),
+            "clip_tiny": (32, 2, 8), "clip_b16": (768, 12, 16)}
+    base = name.split("[")[0]
+    if base not in dims:
+        return 0.0
+    hidden, depth, patch = dims[base]
+    # SimCLR pushes both views through the tower; CLIP's image tower sees
+    # the batch once (the text tower stays on the XLA path).
+    rows = batch if model_name == "clip_b16" else 2 * batch
+    l = (size // patch) ** 2 + 1
+    fwd = 4.0 * rows * l * l * hidden  # QK^T + PV, 2*rows*L^2*hidden each
+    return 3.0 * depth * fwd
+
+
 def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
                       model_name: str = "resnet50",
                       batch: int | None = None,
                       tag_batch: bool = False,
                       remat: bool = False,
-                      stem: str = "conv", bn_fast_variance: bool = False):
+                      stem: str = "conv", bn_fast_variance: bool = False,
+                      vit_attention: str = "xla"):
     """End-to-end train-step benchmark with automatic MFU.
 
     The role the reference's benchmark played for its hot path
@@ -370,7 +411,7 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
     on_accel = jax.default_backend() in ("tpu", "axon")
     name, batch, size, state, step, step_args = _trainer_setup(
         model_name, quick, on_accel, batch, remat=remat, stem=stem,
-        bn_fast_variance=bn_fast_variance)
+        bn_fast_variance=bn_fast_variance, vit_attention=vit_attention)
 
     import time as _time
     runs = 5 if quick or not on_accel else 30
@@ -443,6 +484,14 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
         raise RuntimeError(
             f"loss went non-finite ({final_loss}) during trainer bench")
     sps = 1e3 / chained_ms
+    flash_corr = 0.0
+    # on_accel only: off-accelerator the flash path resolves to the jnp
+    # oracle (models/long_context.default_attention), whose matmuls cost
+    # analysis DOES count — adding the correction there would double-count.
+    if vit_attention == "flash" and flops and on_accel:
+        flash_corr = _vit_flash_flops_correction(model_name, name, batch,
+                                                 size)
+        flops += flash_corr
     entry = {
         "model": name, "batch": batch, "image": size, "remat": remat,
         "protocol": "scan_chain" if chain_exec is not None else "per_call",
@@ -451,6 +500,11 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
         "peak_flops_per_chip": peak_flops_per_chip(),
         "mfu": estimate_mfu(flops, sps) if flops else None,
     }
+    if flash_corr:
+        # Auditability of the A/B: how much of flops_per_step is the
+        # analytic attention add-back (invisible to XLA cost analysis
+        # inside the Pallas custom call).
+        entry["flops_attention_correction"] = flash_corr
     # Sweeps need one entry per size; plain runs keep the pre-sweep key
     # schema so existing results.json consumers stay comparable.
     key = f"{name}@{batch}" if tag_batch else name
@@ -480,7 +534,8 @@ def run_trainer_ablation(quick: bool, results: dict,
                          batch: int | None = None,
                          stem: str = "conv",
                          remat: bool = False,
-                         bn_fast_variance: bool = False):
+                         bn_fast_variance: bool = False,
+                         vit_attention: str = "xla"):
     """Component attribution of the train step, no profiler needed.
 
     Times three chained programs on the same state/batch and reads the
@@ -503,7 +558,7 @@ def run_trainer_ablation(quick: bool, results: dict,
     on_accel = jax.default_backend() in ("tpu", "axon")
     name, batch, size, state, step, step_args = _trainer_setup(
         model_name, quick, on_accel, batch, stem=stem, remat=remat,
-        bn_fast_variance=bn_fast_variance)
+        bn_fast_variance=bn_fast_variance, vit_attention=vit_attention)
     runs = 5 if quick or not on_accel else 30
     temperature = 0.1
     # The SAME forward and loss the train step runs (fused kernel on
@@ -618,6 +673,13 @@ def main():
                         help="rematerialize the encoder forward in the "
                              "backward pass (jax.checkpoint) — the "
                              "HBM-vs-FLOPs lever for the MFU ladder")
+    parser.add_argument("--vit-attention", choices=["xla", "flash"],
+                        default="xla",
+                        help="ViT tower attention impl: 'flash' swaps "
+                             "nn.MultiHeadDotProductAttention for the "
+                             "fused blockwise Pallas kernel "
+                             "(weight-compatible; the attention lever "
+                             "for the ViT MFU ladder)")
     parser.add_argument("--trace", default=None, metavar="DIR",
                         help="capture an XProf trace of the trainer step "
                              "into DIR (implies --trainer)")
@@ -666,13 +728,15 @@ def main():
                                          batch=b, stem=args.stem,
                                          remat=args.remat,
                                          bn_fast_variance=args
-                                         .bn_fast_variance)
+                                         .bn_fast_variance,
+                                         vit_attention=args.vit_attention)
                 else:
                     run_trainer_bench(args.quick, results, args.trace,
                                       model_name=m, batch=b,
                                       tag_batch=len(batches) > 1,
                                       remat=args.remat, stem=args.stem,
-                                      bn_fast_variance=args.bn_fast_variance)
+                                      bn_fast_variance=args.bn_fast_variance,
+                                      vit_attention=args.vit_attention)
 
     out_dir = Path(args.out)
     out_dir.mkdir(exist_ok=True)

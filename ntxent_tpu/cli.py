@@ -56,6 +56,12 @@ def _add_common_args(p: argparse.ArgumentParser) -> None:
                    help="ResNet ImageNet stem: space_to_depth runs the "
                         "7x7/s2 conv as an MXU-dense 4x4/s1 conv on "
                         "space-to-depth input (weight-compatible)")
+    m.add_argument("--vit-attention", default="xla",
+                   choices=["xla", "flash"],
+                   help="ViT tower attention: 'flash' swaps the XLA "
+                        "dot-product attention for the fused blockwise "
+                        "Pallas kernel (weight-compatible; "
+                        "models/vit.py:EncoderBlock)")
     m.add_argument("--proj-hidden-dim", type=int, default=2048)
     m.add_argument("--proj-dim", type=int, default=128)
     m.add_argument("--moe-experts", type=int, default=0,
@@ -174,7 +180,7 @@ def _npy_store_shape(args) -> tuple:
 
 
 def _make_encoder(name: str, image_size: int, moe_experts: int = 0,
-                  stem: str = "conv"):
+                  stem: str = "conv", vit_attention: str = "xla"):
     from ntxent_tpu import models
 
     if moe_experts > 0 and not name.startswith("vit"):
@@ -183,6 +189,10 @@ def _make_encoder(name: str, image_size: int, moe_experts: int = 0,
         raise SystemExit(f"--stem {stem} applies to ResNet encoders only "
                          f"(got --model {name}); it would be silently "
                          "ignored")
+    if vit_attention != "xla" and not name.startswith("vit"):
+        raise SystemExit(f"--vit-attention {vit_attention} applies to ViT "
+                         f"encoders only (got --model {name}); it would "
+                         "be silently ignored")
     if name == "tiny":
         return functools.partial(models.ResNet, stage_sizes=(1,),
                                  small_images=True)
@@ -207,6 +217,10 @@ def _make_encoder(name: str, image_size: int, moe_experts: int = 0,
         enc = functools.partial(enc, stem=stem)
     if moe_experts > 0:
         enc = functools.partial(enc, moe_experts=moe_experts)
+    if vit_attention != "xla":
+        # Weight-compatible fused-kernel attention (models/vit.py:
+        # EncoderBlock.attention_impl).
+        enc = functools.partial(enc, attention_impl=vit_attention)
     return enc
 
 
@@ -375,7 +389,8 @@ def main(argv=None) -> int:
 
     encoder = _make_encoder(args.model, args.image_size,
                             moe_experts=args.moe_experts,
-                            stem=args.stem)
+                            stem=args.stem,
+                            vit_attention=args.vit_attention)
     model = SimCLRModel(encoder=encoder,
                         proj_hidden_dim=args.proj_hidden_dim,
                         proj_dim=args.proj_dim)
@@ -493,14 +508,17 @@ def _build_clip_model(args):
     if args.model == "tiny":
         image_enc = functools.partial(
             models.VisionTransformer, hidden_dim=32, depth=2, num_heads=2,
-            mlp_dim=64, patch_size=8, moe_experts=moe)
+            mlp_dim=64, patch_size=8, moe_experts=moe,
+            attention_impl=getattr(args, "vit_attention", "xla"))
         text_enc = functools.partial(
             TextTransformer, vocab_size=args.vocab_size,
             max_len=args.token_len, hidden_dim=32, depth=2, num_heads=2)
         embed_dim = 32
     else:
         image_enc = _make_encoder(args.model, args.image_size,
-                                  moe_experts=moe)
+                                  moe_experts=moe,
+                                  vit_attention=getattr(
+                                      args, "vit_attention", "xla"))
         text_enc = functools.partial(TextTransformer,
                                      vocab_size=args.vocab_size,
                                      max_len=args.token_len)

@@ -33,14 +33,40 @@ class EncoderBlock(nn.Module):
     mlp_dim: int
     dtype: jnp.dtype
     moe_experts: int = 0  # >0 swaps the dense MLP for a switch-MoE MLP
+    # "xla": nn.MultiHeadDotProductAttention (XLA fuses the (L, L) score
+    # matrix; fine at ViT's L=197). "flash": the repo's fused blockwise
+    # kernel via SeqParallelSelfAttention — an on-chip A/B lever for the
+    # ViT MFU ladder (BASELINE.md: 49.0% at batch 64, just under the 50%
+    # target). WEIGHT-COMPATIBLE: both paths project through DenseGeneral
+    # submodules named query/key/value/out with identical kernel shapes,
+    # and the flash module reuses the XLA path's auto-generated module
+    # name, so one checkpoint serves either impl.
+    attention_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, mask=None):
+        if self.attention_impl not in ("xla", "flash"):
+            raise ValueError(f"unknown attention_impl "
+                             f"{self.attention_impl!r}: expected 'xla' "
+                             "or 'flash'")
         y = nn.LayerNorm(dtype=jnp.float32)(x)
-        y = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads, dtype=self.dtype,
-            param_dtype=jnp.float32,
-        )(y, y, mask=mask)
+        if self.attention_impl == "flash":
+            if mask is not None:
+                raise ValueError("attention_impl='flash' supports only "
+                                 "the unmasked encoder case (ViT towers)")
+            from .long_context import SeqParallelSelfAttention
+
+            # Explicitly claim the name flax would auto-generate for the
+            # nn.MultiHeadDotProductAttention below — this is what makes
+            # the two impls load each other's checkpoints.
+            y = SeqParallelSelfAttention(
+                num_heads=self.num_heads, dtype=self.dtype,
+                name="MultiHeadDotProductAttention_0")(y)
+        else:
+            y = nn.MultiHeadDotProductAttention(
+                num_heads=self.num_heads, dtype=self.dtype,
+                param_dtype=jnp.float32,
+            )(y, y, mask=mask)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32)(x)
         if self.moe_experts > 0:
@@ -63,6 +89,9 @@ class VisionTransformer(nn.Module):
     # Every-other-block switch-MoE (Switch Transformer layout) when > 0;
     # aux losses surface under intermediates/…/moe_aux_loss.
     moe_experts: int = 0
+    # "xla" | "flash" — see EncoderBlock.attention_impl (weight-compatible
+    # on-chip A/B lever for the ViT MFU ladder).
+    attention_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -87,7 +116,9 @@ class VisionTransformer(nn.Module):
         for i in range(self.depth):
             moe = self.moe_experts if i % 2 == 1 else 0
             x = EncoderBlock(self.num_heads, self.mlp_dim, self.dtype,
-                             moe_experts=moe, name=f"block_{i}")(x)
+                             moe_experts=moe,
+                             attention_impl=self.attention_impl,
+                             name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x)
         return x[:, 0].astype(jnp.float32)  # CLS token
 

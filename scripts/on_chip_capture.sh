@@ -76,7 +76,7 @@ all_done() {
     local n
     for n in headline tpu_tests rn50_b256 rn50_b256_remat rn50_s2d \
              rn50_fastvar rn50_ablate attention_ab loader train_e2e \
-             vit_b64 vit_b64_remat headline_r4b xprof; do
+             vit_b64 vit_b64_remat vit_b64_flash headline_r4b xprof; do
         [ -e "$OUT/.done_$n" ] || return 1
     done
     return 0
@@ -256,6 +256,13 @@ run_step 1500 vit_b64_remat - python benchmarks/run_benchmarks.py \
 guard_mfu_dir "$OUT/mfu_vit_b64_remat" vit_b64_remat
 commit_art "on-chip capture: ViT-B/16 batch-64 remat variant" "$OUT/" \
     || true
+
+run_step 1500 vit_b64_flash - python benchmarks/run_benchmarks.py \
+    --trainer-only --model vit_b16 --batch 64 --vit-attention flash \
+    --out "$OUT/mfu_vit_b64_flash" || true
+guard_mfu_dir "$OUT/mfu_vit_b64_flash" vit_b64_flash
+commit_art "on-chip capture: ViT-B/16 batch-64 flash-attention A/B" \
+    "$OUT/" || true
 
 # 8b. SECOND independent headline capture (VERDICT r3 #3): same protocol,
 #     separate process and point in time, its own file — two committed

@@ -130,3 +130,43 @@ def test_s2d_stem_odd_size_rejected(rng):
     s2d = ResNet(stage_sizes=(1,), stem="space_to_depth", dtype=jnp.float32)
     with pytest.raises(ValueError, match="even"):
         s2d.init(rng, jnp.zeros((1, 31, 31, 3)), train=False)
+
+
+def test_vit_flash_attention_weight_compatible(rng):
+    """attention_impl='flash' (the ViT MFU lever, models/vit.py): same
+    param tree as the XLA path — the flash module claims the name and
+    projection layout flax gives nn.MultiHeadDotProductAttention — and
+    the same numbers on the same weights (flash resolves to the exact
+    oracle off-TPU, the fused kernel on-chip). Also: gradients flow."""
+    from ntxent_tpu.models import VisionTransformer
+
+    kw = dict(hidden_dim=32, depth=2, num_heads=4, mlp_dim=64,
+              patch_size=8, dtype=jnp.float32)
+    x = jax.random.uniform(rng, (2, 16, 16, 3))
+    m_xla = VisionTransformer(**kw)
+    m_flash = VisionTransformer(attention_impl="flash", **kw)
+
+    v = m_xla.init(jax.random.PRNGKey(1), x, train=False)
+    assert jax.tree_util.tree_structure(v) == jax.tree_util.tree_structure(
+        m_flash.init(jax.random.PRNGKey(1), x, train=False))
+
+    y_xla = m_xla.apply(v, x, train=False)
+    y_flash = m_flash.apply(v, x, train=False)  # same weights
+    np.testing.assert_allclose(np.asarray(y_flash), np.asarray(y_xla),
+                               rtol=2e-5, atol=2e-5)
+
+    g = jax.grad(lambda p: jnp.sum(m_flash.apply({"params": p}, x,
+                                                 train=False) ** 2))(
+        v["params"])
+    assert all(bool(jnp.all(jnp.isfinite(leaf)))
+               for leaf in jax.tree.leaves(g))
+
+
+def test_vit_flash_attention_rejects_unknown_impl(rng):
+    from ntxent_tpu.models import VisionTransformer
+
+    model = VisionTransformer(hidden_dim=32, depth=1, num_heads=2,
+                              mlp_dim=64, patch_size=8,
+                              attention_impl="nope")
+    with pytest.raises(ValueError, match="unknown attention_impl"):
+        model.init(rng, jnp.zeros((1, 16, 16, 3)), train=False)
