@@ -327,6 +327,10 @@ def _trainer_setup(model_name: str, quick: bool, on_accel: bool,
         if vit_attention != "xla":
             name = f"{name}[{vit_attention}]"
     else:  # resnet50
+        if vit_attention != "xla":
+            logger.warning("--vit-attention %s is ignored for --model %s "
+                           "(ViT towers only; the entry is recorded "
+                           "untagged)", vit_attention, model_name)
         if small:
             if stem != "conv":
                 logger.warning("--stem %s is ignored in the quick/"

@@ -412,10 +412,6 @@ def main(argv=None) -> int:
         )
         from ntxent_tpu.parallel.mesh import data_sharding
 
-        if args.moe_experts > 0:
-            raise SystemExit("--fsdp does not compose with --moe-experts "
-                             "yet (MoE aux losses ride the shard_map DP "
-                             "path)")
         mesh = _data_mesh(args, fsdp=True)
         has_bs = bool(jax.tree_util.tree_leaves(state.batch_stats))
         # The fused shard_map NT-Xent runs INSIDE the GSPMD step, so
@@ -424,7 +420,8 @@ def main(argv=None) -> int:
         step = make_fsdp_train_step(mesh, cfg.temperature,
                                     remat=args.remat,
                                     has_batch_stats=has_bs,
-                                    loss_impl=args.dp_loss)
+                                    loss_impl=args.dp_loss,
+                                    moe_aux_weight=moe_aux)
         state = shard_train_state_fsdp(state, mesh)
         data = _make_pipeline(args, per_process_batch,
                               sharding=data_sharding(
@@ -644,10 +641,6 @@ def _train_clip(args, info, per_process_batch: int) -> int:
                 shard_train_state_fsdp,
             )
 
-            if args.moe_experts > 0:
-                raise SystemExit("--fsdp does not compose with "
-                                 "--moe-experts yet (MoE rides the "
-                                 "shard_map EP path)")
             mesh = _data_mesh(args, fsdp=True)
             step = make_fsdp_clip_train_step(mesh, remat=args.remat,
                                              moe_aux_weight=moe_aux)

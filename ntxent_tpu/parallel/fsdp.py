@@ -153,25 +153,21 @@ def _resolve_batch_axes(mesh: Mesh, axis: str, batch_axes):
     """(batch_axes tuple, shard_map collective axis arg, device count).
 
     ``batch_axes`` defaults to every mesh axis; the parameter axis must be
-    among them (its gradient reduce-scatter rides the batch program). A
-    single axis keeps the string form for collectives (identical
-    semantics, simpler HLO names); multiple axes pass as the tuple the
-    collectives accept directly.
+    among them (its gradient reduce-scatter rides the batch program).
+    Normalization and the single-vs-tuple collective-axis convention live
+    in ``dist_loss._resolve_loss_axes`` (one copy); this adds only the
+    membership validation.
     """
+    from .dist_loss import _resolve_loss_axes
+
     if batch_axes is None:
         batch_axes = tuple(mesh.axis_names)
-    if isinstance(batch_axes, str):
-        batch_axes = (batch_axes,)
-    batch_axes = tuple(batch_axes)
-    if axis not in batch_axes:
+    axes, loss_axis, n = _resolve_loss_axes(mesh, batch_axes)
+    if axis not in axes:
         raise ValueError(f"param axis {axis!r} must be one of the batch "
-                         f"axes {batch_axes} (its gradient reduce-scatter "
+                         f"axes {axes} (its gradient reduce-scatter "
                          "rides the batch program)")
-    loss_axis = batch_axes[0] if len(batch_axes) == 1 else batch_axes
-    n = 1
-    for a in batch_axes:
-        n *= mesh.shape[a]
-    return batch_axes, loss_axis, n
+    return axes, loss_axis, n
 
 
 def _row_constrainer(mesh: Mesh, batch_axes: tuple):
@@ -193,6 +189,7 @@ def make_fsdp_train_step(
     has_batch_stats: bool = True,
     remat: bool = False,
     loss_impl: str = "strip",
+    moe_aux_weight: float = 0.0,
     interpret: bool | None = None,
 ) -> Callable:
     """Fully-sharded SimCLR train step: batch sharded over ``batch_axes``
@@ -218,6 +215,15 @@ def make_fsdp_train_step(
     gives cross-replica statistics by construction). ``remat=True``
     rematerializes the encoder forward — the usual FSDP companion, since
     both trade compute/comm for HBM.
+
+    ``moe_aux_weight > 0`` adds the MoE towers' load-balance aux loss,
+    computed once over the global batch by the GSPMD program (no
+    per-shard pmean estimator needed, unlike the shard_map DP step) and
+    reported under ``metrics["moe_aux"]``. Expert weights shard by the
+    same shape-driven rule as every other leaf (ZeRO-3 memory scaling);
+    expert COMPUTE stays data-parallel here — the all-to-all
+    expert-parallel schedule remains the shard_map EP path's
+    (``parallel/moe.py``).
     """
     batch_axes, loss_axis, _ = _resolve_batch_axes(mesh, axis, batch_axes)
 
@@ -233,6 +239,7 @@ def make_fsdp_train_step(
             impl=loss_impl)
 
     constrain_rows = _row_constrainer(mesh, batch_axes)
+    collect = moe_aux_weight > 0.0
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, v1, v2):
@@ -240,12 +247,17 @@ def make_fsdp_train_step(
         v2c = constrain_rows(v2)
 
         def encode(params, both):
+            variables = {"params": params}
+            mutable = []
             if has_batch_stats:
-                variables = {"params": params,
-                             "batch_stats": state.batch_stats}
-                return state.apply_fn(variables, both, train=True,
-                                      mutable=["batch_stats"])
-            return state.apply_fn({"params": params}, both, train=True), None
+                variables["batch_stats"] = state.batch_stats
+                mutable.append("batch_stats")
+            if collect:
+                mutable.append("intermediates")
+            if not mutable:
+                return state.apply_fn(variables, both, train=True), {}
+            return state.apply_fn(variables, both, train=True,
+                                  mutable=mutable)
 
         if remat:
             encode = jax.checkpoint(encode, static_argnums=())
@@ -254,24 +266,34 @@ def make_fsdp_train_step(
             both = jnp.concatenate([v1c, v2c], axis=0)
             z, updates = encode(params, both)
             new_stats = updates["batch_stats"] if has_batch_stats else None
+            if collect:
+                from .moe import moe_aux_from
+
+                aux = moe_aux_from(updates)
+            else:
+                aux = 0.0
             if sharded_loss is None:
                 z = constrain_rows(z)
-                return ntxent_loss(z, temperature), new_stats
-            n = v1c.shape[0]
-            # Split the stacked (2N, D) embeddings back into views: the
-            # fused bodies take (z1, z2) row-sharded over the batch axes
-            # and rebuild the [view1; view2] global layout internally
-            # (mesh.local_row_gids).
-            z1 = constrain_rows(z[:n])
-            z2 = constrain_rows(z[n:])
-            return sharded_loss(z1, z2), new_stats
+                loss = ntxent_loss(z, temperature)
+            else:
+                n = v1c.shape[0]
+                # Split the stacked (2N, D) embeddings back into views:
+                # the fused bodies take (z1, z2) row-sharded over the
+                # batch axes and rebuild the [view1; view2] global layout
+                # internally (mesh.local_row_gids).
+                loss = sharded_loss(constrain_rows(z[:n]),
+                                    constrain_rows(z[n:]))
+            return loss + moe_aux_weight * aux, (new_stats, aux)
 
-        (loss, new_stats), grads = jax.value_and_grad(
+        (loss, (new_stats, aux)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
         state2 = state.apply_gradients(grads=grads)
         if new_stats is not None:
             state2 = state2.replace(batch_stats=new_stats)
-        return _constrain_state(state2, mesh, axis), {"loss": loss}
+        metrics = {"loss": loss}
+        if collect:
+            metrics["moe_aux"] = aux
+        return _constrain_state(state2, mesh, axis), metrics
 
     return train_step
 

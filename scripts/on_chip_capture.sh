@@ -134,7 +134,8 @@ guard_mfu_dir() {  # guard_mfu_dir <dir> <done_name>
     backend=$(python3 -c "import json,sys;print(json.load(open(sys.argv[1])).get('backend',''))" "$newest" 2>/dev/null)
     if [ "$backend" != "tpu" ] && [ "$backend" != "axon" ]; then
         say "$done_name: dropping $backend fallback capture $newest"
-        rm -f "$newest"
+        # The companion memory profile came from the same fallback run.
+        rm -f "$newest" "$dir/memory_profile.json"
         rm -f "$OUT/.done_$done_name"
     fi
 }
@@ -278,10 +279,19 @@ run_step 1500 xprof - python benchmarks/run_benchmarks.py \
     --trainer-only --model resnet50 --batch 128 \
     --trace "$OUT/xprof" --out "$OUT/mfu_rn50_traced" || true
 guard_mfu_dir "$OUT/mfu_rn50_traced" xprof
-ls -laR "$OUT/xprof" > "$OUT/xprof_manifest.txt" 2>/dev/null || true
+if [ -e "$OUT/.done_xprof" ]; then
+    ls -laR "$OUT/xprof" > "$OUT/xprof_manifest.txt" 2>/dev/null || true
+else
+    # guard_mfu_dir re-armed the step: the trace in $OUT/xprof came from
+    # the same CPU-fallback run — don't manifest or commit it as on-chip
+    # evidence.
+    rm -rf "$OUT/xprof" "$OUT/xprof_manifest.txt"
+fi
 commit_art "on-chip capture: XProf-traced RN50 step" \
-    "$OUT/mfu_rn50_traced" "$OUT/xprof_manifest.txt" \
-    "$OUT/capture.log" || true
+    "$OUT/mfu_rn50_traced" "$OUT/capture.log" || true
+[ -e "$OUT/xprof_manifest.txt" ] && commit_art \
+    "on-chip capture: XProf trace manifest" "$OUT/xprof_manifest.txt" \
+    || true
 
 if all_done; then
     touch "$OUT/.all_captured"

@@ -101,3 +101,80 @@ def test_elastic_resume_across_mesh_sizes(tmp_path, rng):
         restored, m = step4(restored, *shard_batch(batch_for(t), mesh4))
         assert float(m["loss"]) == pytest.approx(want[t], rel=1e-5), (
             f"step {t}: elastic-resumed loss diverged")
+
+
+def test_fsdp_elastic_resume_across_mesh_sizes(tmp_path, rng):
+    """Elastic recovery for ZeRO-3 (round 4): a checkpoint written from an
+    8-device FSDP mesh restores onto a 4-device FSDP mesh — different
+    PartitionSpecs per leaf (the shape-driven rule keys on axis size), so
+    orbax must reshard on restore.
+
+    What this pins: (a) resharding moves bytes without changing them —
+    every restored leaf equals its saved value bitwise; (b) the first
+    post-restore step on the smaller mesh reproduces the 8-device loss to
+    arithmetic noise; (c) training continues (finite losses). It does NOT
+    pin the longer curve: GSPMD partitions matmuls differently at
+    different mesh sizes, and the ~1e-7 reduction-order noise amplifies
+    chaotically through LARS once warmup ends (measured: a from-scratch
+    4-device run matches the 8-device run to 1e-7 for 3 steps, then
+    diverges 0.8% at step 4 — with the restore machinery verified
+    bit-exact by an 8->8 control).
+    """
+    from ntxent_tpu.parallel import (
+        create_mesh,
+        make_fsdp_train_step,
+        shard_train_state_fsdp,
+    )
+
+    model = SimCLRModel(
+        encoder=functools.partial(ResNet, stage_sizes=(1,),
+                                  small_images=True, dtype=jnp.float32),
+        proj_hidden_dim=16, proj_dim=8)
+    cfg = TrainerConfig(batch_size=8, total_steps=10, warmup_steps=1)
+
+    def fresh_state():
+        return create_train_state(model, jax.random.PRNGKey(0),
+                                  (1, 32, 32, 3), cfg)
+
+    def batch_for(step: int):
+        k = jax.random.fold_in(jax.random.PRNGKey(7), step)
+        k1, k2 = jax.random.split(k)
+        return (jax.random.uniform(k1, (8, 32, 32, 3)),
+                jax.random.uniform(k2, (8, 32, 32, 3)))
+
+    mesh8 = create_mesh(axis_names=("data",))
+    mesh4 = create_mesh(devices=jax.devices()[:4], axis_names=("data",))
+    step8 = make_fsdp_train_step(mesh8, temperature=0.1)
+    step4 = make_fsdp_train_step(mesh4, temperature=0.1)
+
+    want = []
+    state = shard_train_state_fsdp(fresh_state(), mesh8)
+    for t in range(3):
+        state, m = step8(state, *batch_for(t))
+        want.append(float(m["loss"]))
+
+    state = shard_train_state_fsdp(fresh_state(), mesh8)
+    for t in range(2):
+        state, m = step8(state, *batch_for(t))
+    saved_params = jax.device_get(state.params)
+    mgr = CheckpointManager(tmp_path / "fsdp_elastic", max_to_keep=1)
+    assert mgr.save(2, state, force=True)
+    mgr.wait_until_finished()
+
+    # Restore template carries the TARGET mesh's FSDP shardings (axis
+    # size 4): orbax reshards each stored global array onto them.
+    restored = mgr.restore(shard_train_state_fsdp(fresh_state(), mesh4))
+    mgr.close()
+    # (a) resharding is byte-exact
+    for want_leaf, got_leaf in zip(
+            jax.tree_util.tree_leaves(saved_params),
+            jax.tree_util.tree_leaves(jax.device_get(restored.params))):
+        np.testing.assert_array_equal(got_leaf, want_leaf)
+    assert int(restored.step) == 2
+    # (b) first post-restore step matches to arithmetic noise
+    restored, m = step4(restored, *batch_for(2))
+    assert float(m["loss"]) == pytest.approx(want[2], rel=1e-4), (
+        "first post-restore FSDP step diverged beyond arithmetic noise")
+    # (c) training continues
+    restored, m = step4(restored, *batch_for(3))
+    assert jnp.isfinite(m["loss"])

@@ -240,3 +240,103 @@ def test_fsdp_clip_hybrid_mesh():
     _, m = step(fstate, images, tokens)
     np.testing.assert_allclose(float(m["loss"]), float(ref_m["loss"]),
                                rtol=1e-3)
+
+
+def test_fsdp_composes_with_gradient_accumulation():
+    """optax.MultiSteps under ZeRO-3: the accumulator's inner state
+    mirrors the param tree, so the shape-driven spec rule shards it like
+    the moments it wraps — two FSDP micro-steps must equal two unsharded
+    micro-steps (same optimizer, update applied on the second)."""
+    import optax
+
+    from ntxent_tpu.training.trainer import make_train_step
+
+    batch = 16
+    mesh = create_mesh(axis_names=("data",))
+
+    def accum_state():
+        model = SimCLRModel(
+            encoder=functools.partial(ResNet, stage_sizes=(1, 1),
+                                      small_images=True,
+                                      dtype=jnp.float32),
+            proj_hidden_dim=64, proj_dim=32)
+        cfg = TrainerConfig(batch_size=batch, total_steps=4,
+                            warmup_steps=1, accum_steps=2)
+        tx = optax.MultiSteps(optax.sgd(1e-2), every_k_schedule=2)
+        return create_train_state(model, jax.random.PRNGKey(0),
+                                  (1, 16, 16, 3), cfg, tx=tx)
+
+    def batch_for(i):
+        k1, k2 = jax.random.split(jax.random.fold_in(
+            jax.random.PRNGKey(7), i))
+        return (jax.random.uniform(k1, (batch, 16, 16, 3)),
+                jax.random.uniform(k2, (batch, 16, 16, 3)))
+
+    ref_state = accum_state()
+    ref_step = make_train_step(0.1)
+    for i in range(2):
+        ref_state, ref_m = ref_step(ref_state, *batch_for(i))
+
+    fstate = shard_train_state_fsdp(accum_state(), mesh)
+    step = make_fsdp_train_step(mesh, 0.1)
+    for i in range(2):
+        fstate, m = step(fstate, *batch_for(i))
+
+    np.testing.assert_allclose(float(m["loss"]), float(ref_m["loss"]),
+                               rtol=1e-3)
+    for r, g in zip(jax.tree_util.tree_leaves(ref_state.params),
+                    jax.tree_util.tree_leaves(fstate.params)):
+        np.testing.assert_allclose(np.asarray(jax.device_get(g)),
+                                   np.asarray(r), rtol=5e-3, atol=5e-4)
+
+
+def test_fsdp_composes_with_moe_towers():
+    """ZeRO-3 over an MoE-ViT SimCLR encoder (round 4 — previously the
+    CLI refused the combination): expert weights shard by the same
+    shape-driven rule as every other leaf, the load-balance aux loss is
+    collected once over the global batch inside the GSPMD program, and
+    loss + aux + updated params equal the single-device MoE step.
+    (Expert COMPUTE stays data-parallel here; the all-to-all EP schedule
+    remains parallel/moe.py's shard_map path.)"""
+    import optax
+
+    from ntxent_tpu.models import VisionTransformer
+    from ntxent_tpu.training.trainer import make_train_step
+
+    batch = 16
+    mesh = create_mesh(axis_names=("data",))
+
+    def moe_state():
+        # SGD, not LARS/Adam: param deltas stay proportional to the
+        # gradients this test compares (see _tiny_clip_state's note).
+        model = SimCLRModel(
+            encoder=functools.partial(
+                VisionTransformer, hidden_dim=32, depth=2, num_heads=2,
+                mlp_dim=64, patch_size=8, moe_experts=2,
+                dtype=jnp.float32),
+            proj_hidden_dim=64, proj_dim=32)
+        cfg = TrainerConfig(batch_size=batch, total_steps=4,
+                            warmup_steps=1)
+        return create_train_state(model, jax.random.PRNGKey(0),
+                                  (1, 16, 16, 3), cfg,
+                                  tx=optax.sgd(1e-2))
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+    v1 = jax.random.uniform(k1, (batch, 16, 16, 3))
+    v2 = jax.random.uniform(k2, (batch, 16, 16, 3))
+
+    ref_state, ref_m = make_train_step(
+        0.1, use_fused=False, moe_aux_weight=0.01)(moe_state(), v1, v2)
+
+    fstate = shard_train_state_fsdp(moe_state(), mesh)
+    step = make_fsdp_train_step(mesh, 0.1, moe_aux_weight=0.01)
+    fstate2, m = step(fstate, v1, v2)
+
+    np.testing.assert_allclose(float(m["loss"]), float(ref_m["loss"]),
+                               rtol=1e-3)
+    np.testing.assert_allclose(float(m["moe_aux"]),
+                               float(ref_m["moe_aux"]), rtol=1e-3)
+    for r, g in zip(jax.tree_util.tree_leaves(ref_state.params),
+                    jax.tree_util.tree_leaves(fstate2.params)):
+        np.testing.assert_allclose(np.asarray(jax.device_get(g)),
+                                   np.asarray(r), rtol=5e-3, atol=5e-4)
