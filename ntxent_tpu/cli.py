@@ -349,12 +349,6 @@ def main(argv=None) -> int:
             f"{info['global_device_count']} devices")
     per_process_batch = args.batch // info["process_count"]
 
-    if args.objective == "clip" and args.fsdp \
-            and args.clip_parallel == "tp":
-        raise SystemExit("--fsdp and --clip-parallel tp do not compose: "
-                         "ZeRO-3 shards whole weights over the data axis "
-                         "while TP splits them over the model axis — pick "
-                         "one (FSDP rides --clip-parallel dp)")
     if args.objective == "clip":
         # image_size stays None here: the clip branch derives it from the
         # paired data, and a conflicting EXPLICIT flag must fail loudly.
@@ -629,11 +623,29 @@ def _train_clip(args, info, per_process_batch: int) -> int:
             mesh = create_mesh(shape=(n_dev // args.model_par,
                                       args.model_par),
                                axis_names=("data", "model"))
-            state = shard_train_state(state, mesh)
+            if args.fsdp:
+                # Megatron + ZeRO-3: TP claims its dimension, the FSDP
+                # shape rule shards the largest remaining dim over 'data'
+                # (parallel/tp.py:tp_fsdp_param_spec).
+                if getattr(args, "dcn_slices", 1) > 1:
+                    raise SystemExit(
+                        "--dcn-slices > 1 (hybrid ZeRO) does not compose "
+                        "with --clip-parallel tp yet — the TP mesh has no "
+                        "'dcn' axis, so parameter all-gathers would "
+                        "silently span DCN; use --clip-parallel dp for "
+                        "hybrid ZeRO")
+                from ntxent_tpu.parallel import shard_train_state_tp_fsdp
+
+                state = shard_train_state_tp_fsdp(state, mesh)
+                logger.info("CLIP GSPMD Megatron + ZeRO-3 on the "
+                            "(%d, %d) (data, model) mesh",
+                            n_dev // args.model_par, args.model_par)
+            else:
+                state = shard_train_state(state, mesh)
+                logger.info("CLIP GSPMD (%d, %d) (data, model) mesh",
+                            n_dev // args.model_par, args.model_par)
             step = make_tp_clip_train_step(mesh, remat=args.remat,
                                            moe_aux_weight=moe_aux)
-            logger.info("CLIP GSPMD (%d, %d) (data, model) mesh",
-                        n_dev // args.model_par, args.model_par)
             sharding = NamedSharding(mesh, P("data"))
         elif args.fsdp:
             from ntxent_tpu.parallel import (

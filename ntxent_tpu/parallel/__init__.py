@@ -54,6 +54,8 @@ from ntxent_tpu.parallel.tp import (
     make_tp_simclr_train_step,
     param_spec_tree,
     shard_train_state,
+    shard_train_state_tp_fsdp,
+    tp_fsdp_param_spec,
     tp_param_spec,
 )
 
@@ -91,6 +93,8 @@ __all__ = [
     "tp_param_spec",
     "param_spec_tree",
     "shard_train_state",
+    "shard_train_state_tp_fsdp",
+    "tp_fsdp_param_spec",
     "make_tp_simclr_train_step",
     "make_tp_clip_train_step",
     "fsdp_param_spec",

@@ -65,26 +65,37 @@ __all__ = [
 MIN_SHARD_ELEMS = 2 ** 14
 
 
-def fsdp_param_spec(leaf, *, axis: str = "data", axis_size: int,
-                    min_shard_elems: int = MIN_SHARD_ELEMS) -> P:
-    """PartitionSpec sharding the largest ``axis_size``-divisible dim.
+def largest_divisible_dim(shape, axis_size: int, taken=()) -> int | None:
+    """Index of the largest ``axis_size``-divisible dim not in ``taken``.
 
     Ties break toward the TRAILING dimension (weights are (in, out) /
     (H, W, Cin, Cout): the output-feature axis is both the usually-larger
-    and the contraction-friendly choice). Replicates when the leaf is
-    small or nothing divides.
+    and the contraction-friendly choice). None when nothing divides. The
+    ONE copy of the FSDP dim-selection policy — tp.tp_fsdp_param_spec
+    composes it with the Megatron rule via ``taken``.
     """
+    best = None  # (dim_size, index) — max size, later index wins ties
+    for i, d in enumerate(shape):
+        if i in taken or d % axis_size:
+            continue
+        if best is None or d >= best[0]:
+            best = (d, i)
+    return None if best is None else best[1]
+
+
+def fsdp_param_spec(leaf, *, axis: str = "data", axis_size: int,
+                    min_shard_elems: int = MIN_SHARD_ELEMS) -> P:
+    """PartitionSpec sharding the largest ``axis_size``-divisible dim
+    (``largest_divisible_dim``). Replicates when the leaf is small or
+    nothing divides."""
     if not hasattr(leaf, "ndim") or leaf.ndim == 0 \
             or leaf.size < min_shard_elems:
         return P()
-    best = None  # (dim_size, index) — max size, later index wins ties
-    for i, d in enumerate(leaf.shape):
-        if d % axis_size == 0 and (best is None or d >= best[0]):
-            best = (d, i)
-    if best is None:
+    i = largest_divisible_dim(leaf.shape, axis_size)
+    if i is None:
         return P()
     spec = [None] * leaf.ndim
-    spec[best[1]] = axis
+    spec[i] = axis
     return P(*spec)
 
 

@@ -42,8 +42,10 @@ from .moe import moe_aux_from
 
 __all__ = [
     "tp_param_spec",
+    "tp_fsdp_param_spec",
     "param_spec_tree",
     "shard_train_state",
+    "shard_train_state_tp_fsdp",
     "make_tp_simclr_train_step",
     "make_tp_clip_train_step",
 ]
@@ -119,6 +121,57 @@ def tp_param_spec(path, leaf, *, model_axis: str = "model") -> P:
         if leaf_name == "w_down" and leaf.ndim == 3:  # (E, f, d)
             return P(None, model_axis, None)
     return P()
+
+
+def tp_fsdp_param_spec(path, leaf, *, model_axis: str = "model",
+                       data_axis: str = "data", data_size: int,
+                       min_shard_elems: int | None = None) -> P:
+    """Megatron + ZeRO-3 spec for one (path, leaf): the TP rule claims its
+    dimension first, then the FSDP shape rule shards the largest REMAINING
+    ``data_size``-divisible dimension over ``data_axis``.
+
+    The composition large transformer stacks actually deploy: weights that
+    TP splits over ``model`` still carry a full copy per data-replica —
+    ZeRO-3 shards that copy (and the mirrored optimizer moments, since the
+    rule is path+shape-driven) over ``data`` too, so per-device parameter
+    bytes scale 1/(|model|*|data|) for doubly-sharded leaves. Small leaves
+    keep FSDP's replicate-below-threshold policy.
+    """
+    from .fsdp import MIN_SHARD_ELEMS, largest_divisible_dim
+
+    if min_shard_elems is None:
+        min_shard_elems = MIN_SHARD_ELEMS
+    spec = tp_param_spec(path, leaf, model_axis=model_axis)
+    if not hasattr(leaf, "ndim") or leaf.ndim == 0 \
+            or leaf.size < min_shard_elems:
+        return spec
+    entries = list(spec) + [None] * (leaf.ndim - len(spec))
+    taken = tuple(i for i, s in enumerate(entries) if s is not None)
+    i = largest_divisible_dim(leaf.shape, data_size, taken=taken)
+    if i is None:
+        return spec
+    entries[i] = data_axis
+    return P(*entries)
+
+
+def shard_train_state_tp_fsdp(state, mesh: Mesh, *,
+                              model_axis: str = "model",
+                              data_axis: str = "data",
+                              min_shard_elems: int | None = None):
+    """Place a TrainState with the combined Megatron + ZeRO-3 sharding
+    (``tp_fsdp_param_spec`` on every array leaf). Same aliasing caveat as
+    ``shard_train_state``: treat the source state as consumed."""
+    data_size = mesh.shape[data_axis]
+
+    def place(path, leaf):
+        if not hasattr(leaf, "ndim"):
+            return leaf
+        spec = tp_fsdp_param_spec(path, leaf, model_axis=model_axis,
+                                  data_axis=data_axis, data_size=data_size,
+                                  min_shard_elems=min_shard_elems)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, state)
 
 
 def param_spec_tree(params, *, model_axis: str = "model"):

@@ -125,15 +125,6 @@ def test_cli_fsdp_run(tmp_path, dcn_slices):
     assert second.returncode == 0, second.stdout + second.stderr
     assert "nothing to do" in (second.stdout + second.stderr)
 
-    # --fsdp composes with the CLIP objective since round 4 (dp only):
-    # the tensor-parallel combination is the one that must still refuse.
-    bad = subprocess.run(cmd + ["--objective", "clip",
-                                "--clip-parallel", "tp"],
-                         capture_output=True, text=True, timeout=120,
-                         env=env)
-    assert bad.returncode != 0
-    assert "--fsdp and --clip-parallel tp do not compose" \
-        in (bad.stdout + bad.stderr)
 
 
 @pytest.mark.slow
@@ -393,10 +384,17 @@ def test_cli_cifar10_train_then_eval(tmp_path):
 
 
 @pytest.mark.slow
-def test_cli_clip_fsdp_run(tmp_path):
+@pytest.mark.parametrize("clip_parallel,expect", [
+    ("dp", "CLIP FSDP (ZeRO-3, dual loss) over 8 devices"),
+    # Megatron + ZeRO-3: TP shards the towers over 'model', the FSDP
+    # shape rule shards the remaining dims over 'data'.
+    ("tp", "CLIP GSPMD Megatron + ZeRO-3"),
+])
+def test_cli_clip_fsdp_run(tmp_path, clip_parallel, expect):
     """--objective clip --fsdp (round 4): ZeRO-3 dual towers with the
-    fused partial InfoNCE inside the GSPMD step, checkpointed against
-    the sharded template and restored on relaunch."""
+    fused partial InfoNCE inside the GSPMD step (dp), or composed with
+    tensor parallelism (tp), checkpointed against the sharded template
+    and restored on relaunch."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
@@ -410,12 +408,12 @@ def test_cli_clip_fsdp_run(tmp_path):
            "--image-size", "16", "--vocab-size", "64", "--token-len", "8",
            "--batch", "16", "--steps", "2", "--warmup-steps", "1",
            "--ckpt-dir", str(ckpt), "--ckpt-every", "100",
-           "--log-every", "1", "--platform", "cpu", "--fsdp"]
+           "--log-every", "1", "--platform", "cpu", "--fsdp",
+           "--clip-parallel", clip_parallel]
     run = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
                          env=env)
     assert run.returncode == 0, run.stdout + run.stderr
-    assert "CLIP FSDP (ZeRO-3, dual loss) over 8 devices" \
-        in (run.stdout + run.stderr)
+    assert expect in (run.stdout + run.stderr)
     assert ckpt.exists() and any(ckpt.iterdir())
     second = subprocess.run(cmd, capture_output=True, text=True,
                             timeout=600, env=env)

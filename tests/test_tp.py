@@ -172,3 +172,50 @@ def test_tp_multi_step_loss_decreases():
         losses.append(float(metrics["loss"]))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+
+
+def test_tp_fsdp_composed_step_matches_unsharded():
+    """Megatron + ZeRO-3 (round 4): tp_fsdp_param_spec lets TP claim its
+    dimension, then shards the largest remaining data-divisible dim over
+    'data'. Same loss and updated params as the unsharded step, with at
+    least one leaf genuinely sharded over BOTH axes, and the compiled
+    step stable across calls (output shardings round-trip)."""
+    from ntxent_tpu.parallel.tp import shard_train_state_tp_fsdp
+
+    model = tiny_clip()
+    imgs = jax.random.uniform(jax.random.PRNGKey(2), (8, 8, 8, 3))
+    toks = jax.random.randint(jax.random.PRNGKey(3), (8, 16), 1, 64)
+    example = (jnp.zeros((1, 8, 8, 3)), jnp.zeros((1, 16), jnp.int32))
+    state0 = make_state(model, example)
+
+    def loss_fn(params):
+        zi, zt, scale = model.apply({"params": params}, imgs, toks,
+                                    train=True)
+        return info_nce_loss(zi, zt, temperature=1.0 / scale)
+
+    loss_ref, _ = jax.value_and_grad(loss_fn)(state0.params)
+    ref_state = state0.apply_gradients(
+        grads=jax.grad(loss_fn)(state0.params))
+
+    mesh = create_mesh(shape=(4, 2), axis_names=("data", "model"))
+    # min_shard_elems=32: the tiny towers' leaves are all below the
+    # production threshold, which would quietly reduce this test to
+    # plain TP.
+    state_c = shard_train_state_tp_fsdp(make_state(model, example), mesh,
+                                        min_shard_elems=32)
+    step = make_tp_clip_train_step(mesh)
+    state_c, metrics = step(state_c, imgs, toks)
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss_ref),
+                               rtol=1e-5, atol=1e-5)
+    got = jax.device_get(state_c.params)
+    for r, g in zip(jax.tree_util.tree_leaves(ref_state.params),
+                    jax.tree_util.tree_leaves(got)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=5e-3, atol=5e-4)
+    assert any(
+        {"model", "data"} <= {a for a in leaf.sharding.spec
+                              if a is not None}
+        for leaf in jax.tree_util.tree_leaves(state_c.params)), \
+        "no leaf is sharded over both mesh axes"
+    state_c, m2 = step(state_c, imgs, toks)
+    assert np.isfinite(float(m2["loss"]))
