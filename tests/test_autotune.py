@@ -143,3 +143,28 @@ def test_attention_sweep_picks_fastest_and_persists(sweep_env, monkeypatch):
                                       length=5, spans=1, budget_s=None)
     assert again == (128, 256)
     assert calls == []
+
+
+def test_every_vote_is_span_amortized(sweep_env, monkeypatch):
+    """The v3 protocol fix (BASELINE.md "v3 span-amortized votes"): the
+    v2 sweep's short-chain votes were relay-dispatch noise at fast
+    shapes and demonstrably pinned a bad attention tile (the 4.11 ms
+    1024-causal row). Every vote — loss tiles AND attention tiles — must
+    pass min_span_ms >= 400 to time_fn_chained so the chain length is
+    grown until the measured span dwarfs the ~64 ms dispatch overhead.
+    A regression that drops the kwarg silently reverts to v2."""
+    from ntxent_tpu.ops.autotune import autotune_attention_blocks
+
+    spans_seen = []
+
+    def fake_timer(fn, z, length, spans, with_grad, **kw):
+        spans_seen.append(kw.get("min_span_ms"))
+        return 1.0, 0.0
+
+    monkeypatch.setattr(autotune, "time_fn_chained", fake_timer)
+    autotune_blocks(512, 512, 64, length=5, spans=1, budget_s=None)
+    autotune_attention_blocks(1024, 1024, 64, jnp.bfloat16,
+                              length=5, spans=1, budget_s=None)
+    assert spans_seen, "no votes were cast"
+    assert all(s is not None and s >= 400.0 for s in spans_seen), \
+        f"un-amortized (v2-style) votes present: {spans_seen}"
