@@ -99,8 +99,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "(data, model) mesh for towers that need sharding "
                         "(set --model-par > 1 or nothing is model-sharded)")
     t.add_argument("--model-par", type=int, default=2,
-                   help="clip tp: model-axis size of the (data, model) "
-                        "mesh; device count must divide by it")
+                   help="tp runs (--parallel tp / --clip-parallel tp): "
+                        "model-axis size of the (data, model) mesh; "
+                        "device count must divide by it")
+    t.add_argument("--parallel", default="dp", choices=["dp", "tp"],
+                   help="simclr multi-device strategy: dp = shard_map "
+                        "data-parallel with the fused loss (default); "
+                        "tp = compiler-partitioned (data, model) mesh "
+                        "(Megatron sharding for ViT encoders, GSPMD "
+                        "oracle loss) — composes with --fsdp into "
+                        "Megatron + ZeRO-3")
     t.add_argument("--vocab-size", type=int, default=49408,
                    help="clip: text-tower vocabulary")
     t.add_argument("--token-len", type=int, default=None,
@@ -359,6 +367,10 @@ def main(argv=None) -> int:
         if args.loader != "python":
             logger.warning("--loader %s ignored: the CLIP objective uses "
                            "PairedArrayLoader", args.loader)
+        if args.parallel != "dp":
+            logger.warning("--parallel %s ignored: the CLIP objective "
+                           "uses --clip-parallel for its strategy",
+                           args.parallel)
         return _train_clip(args, info, per_process_batch)
     if args.dataset == "npy":
         # No resize path exists for the raw row store: the model MUST be
@@ -399,7 +411,60 @@ def main(argv=None) -> int:
         (1, args.image_size, args.image_size, 3), cfg)
 
     n_dev = info["global_device_count"]
-    if n_dev > 1 and args.fsdp:
+    if n_dev > 1 and args.parallel == "tp":
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ntxent_tpu.parallel import (
+            make_tp_simclr_train_step,
+            shard_train_state,
+            shard_train_state_tp_fsdp,
+            tp_fsdp_spec_fn,
+        )
+
+        if getattr(args, "dcn_slices", 1) > 1:
+            raise SystemExit("--dcn-slices > 1 does not compose with "
+                             "--parallel tp yet (the TP mesh has no "
+                             "'dcn' axis); use --parallel dp")
+        if args.moe_experts > 0:
+            raise SystemExit("--parallel tp does not collect the MoE "
+                             "aux loss (make_tp_simclr_train_step); use "
+                             "--parallel dp for MoE encoders")
+        if n_dev % args.model_par:
+            raise SystemExit(f"--model-par {args.model_par} must divide "
+                             f"{n_dev} devices")
+        if not args.model.startswith("vit"):
+            logger.warning("--parallel tp shards transformer weights "
+                           "only; --model %s keeps everything replicated "
+                           "over the model axis", args.model)
+        if args.dp_loss != "strip":
+            logger.warning("--dp-loss %s ignored under --parallel tp "
+                           "(the TP step uses the GSPMD-sharded oracle "
+                           "loss)", args.dp_loss)
+        if args.remat:
+            logger.warning("--remat ignored under --parallel tp (the TP "
+                           "step has no remat hook yet)")
+        mesh = create_mesh(shape=(n_dev // args.model_par,
+                                  args.model_par),
+                           axis_names=("data", "model"))
+        has_bs = bool(jax.tree_util.tree_leaves(state.batch_stats))
+        if args.fsdp:
+            state = shard_train_state_tp_fsdp(state, mesh)
+            spec_fn = tp_fsdp_spec_fn(mesh)
+            logger.info("SimCLR GSPMD Megatron + ZeRO-3 on the (%d, %d) "
+                        "(data, model) mesh",
+                        n_dev // args.model_par, args.model_par)
+        else:
+            state = shard_train_state(state, mesh)
+            spec_fn = None
+            logger.info("SimCLR GSPMD (%d, %d) (data, model) mesh",
+                        n_dev // args.model_par, args.model_par)
+        step = make_tp_simclr_train_step(mesh, cfg.temperature,
+                                         has_batch_stats=has_bs,
+                                         param_spec_fn=spec_fn)
+        data = _make_pipeline(args, per_process_batch,
+                              sharding=NamedSharding(mesh, P("data")),
+                              mesh=mesh)
+    elif n_dev > 1 and args.fsdp:
         from ntxent_tpu.parallel import (
             make_fsdp_train_step,
             shard_train_state_fsdp,
@@ -448,6 +513,9 @@ def main(argv=None) -> int:
         if args.fsdp:
             logger.warning("--fsdp ignored: single-device run has nothing "
                            "to shard over")
+        if args.parallel != "dp":
+            logger.warning("--parallel %s ignored: single-device run has "
+                           "no model axis", args.parallel)
         if args.dp_loss != "strip":
             logger.warning("--dp-loss %s ignored: single-device run has "
                            "no shard-pair schedule", args.dp_loss)
@@ -635,17 +703,21 @@ def _train_clip(args, info, per_process_batch: int) -> int:
                         "silently span DCN; use --clip-parallel dp for "
                         "hybrid ZeRO")
                 from ntxent_tpu.parallel import shard_train_state_tp_fsdp
+                from ntxent_tpu.parallel.tp import tp_fsdp_spec_fn
 
                 state = shard_train_state_tp_fsdp(state, mesh)
+                spec_fn = tp_fsdp_spec_fn(mesh)
                 logger.info("CLIP GSPMD Megatron + ZeRO-3 on the "
                             "(%d, %d) (data, model) mesh",
                             n_dev // args.model_par, args.model_par)
             else:
                 state = shard_train_state(state, mesh)
+                spec_fn = None
                 logger.info("CLIP GSPMD (%d, %d) (data, model) mesh",
                             n_dev // args.model_par, args.model_par)
             step = make_tp_clip_train_step(mesh, remat=args.remat,
-                                           moe_aux_weight=moe_aux)
+                                           moe_aux_weight=moe_aux,
+                                           param_spec_fn=spec_fn)
             sharding = NamedSharding(mesh, P("data"))
         elif args.fsdp:
             from ntxent_tpu.parallel import (

@@ -56,6 +56,7 @@ from ntxent_tpu.parallel.tp import (
     shard_train_state,
     shard_train_state_tp_fsdp,
     tp_fsdp_param_spec,
+    tp_fsdp_spec_fn,
     tp_param_spec,
 )
 
@@ -95,6 +96,7 @@ __all__ = [
     "shard_train_state",
     "shard_train_state_tp_fsdp",
     "tp_fsdp_param_spec",
+    "tp_fsdp_spec_fn",
     "make_tp_simclr_train_step",
     "make_tp_clip_train_step",
     "fsdp_param_spec",

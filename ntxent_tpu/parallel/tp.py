@@ -154,30 +154,87 @@ def tp_fsdp_param_spec(path, leaf, *, model_axis: str = "model",
     return P(*entries)
 
 
+def tp_fsdp_spec_fn(mesh: Mesh, *, model_axis: str = "model",
+                    data_axis: str = "data",
+                    min_shard_elems: int | None = None):
+    """(path, leaf) -> PartitionSpec closure for the Megatron + ZeRO-3
+    layout on ``mesh``. ONE rule object shared by state placement
+    (``shard_train_state_tp_fsdp``) and the train step's output pinning
+    (``param_spec_fn``) — built twice with different thresholds, the two
+    would disagree and every step would end in a resharding."""
+    data_size = mesh.shape[data_axis]
+
+    def spec_fn(path, leaf):
+        return tp_fsdp_param_spec(path, leaf, model_axis=model_axis,
+                                  data_axis=data_axis,
+                                  data_size=data_size,
+                                  min_shard_elems=min_shard_elems)
+
+    return spec_fn
+
+
 def shard_train_state_tp_fsdp(state, mesh: Mesh, *,
                               model_axis: str = "model",
                               data_axis: str = "data",
                               min_shard_elems: int | None = None):
     """Place a TrainState with the combined Megatron + ZeRO-3 sharding
     (``tp_fsdp_param_spec`` on every array leaf). Same aliasing caveat as
-    ``shard_train_state``: treat the source state as consumed."""
-    data_size = mesh.shape[data_axis]
+    ``shard_train_state``: treat the source state as consumed. Pass the
+    matching ``tp_fsdp_spec_fn(mesh, ...)`` as the train step's
+    ``param_spec_fn`` so output states round-trip."""
+    spec_fn = tp_fsdp_spec_fn(mesh, model_axis=model_axis,
+                              data_axis=data_axis,
+                              min_shard_elems=min_shard_elems)
 
     def place(path, leaf):
         if not hasattr(leaf, "ndim"):
             return leaf
-        spec = tp_fsdp_param_spec(path, leaf, model_axis=model_axis,
-                                  data_axis=data_axis, data_size=data_size,
-                                  min_shard_elems=min_shard_elems)
+        spec = _drop_indivisible(spec_fn(path, leaf), leaf, mesh)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(place, state)
+
+
+def _pin_state(state, mesh: Mesh, spec_fn):
+    """Constrain every array leaf of an output state to ``spec_fn``'s
+    layout (same role as fsdp._constrain_state: without it GSPMD freely
+    picks output shardings — e.g. splitting a replicated LayerNorm bias
+    over 'data' — and the returned state no longer matches the compiled
+    step's input layout on the next call)."""
+
+    def pin(path, leaf):
+        if not hasattr(leaf, "ndim"):
+            return leaf
+        spec = _drop_indivisible(spec_fn(path, leaf), leaf, mesh)
+        return jax.lax.with_sharding_constraint(
+            leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(pin, state)
 
 
 def param_spec_tree(params, *, model_axis: str = "model"):
     """PartitionSpec pytree for a param (or mirrored optimizer-state) tree."""
     return jax.tree_util.tree_map_with_path(
         functools.partial(tp_param_spec, model_axis=model_axis), params)
+
+
+def _drop_indivisible(spec: P, leaf, mesh: Mesh) -> P:
+    """Replicate any spec dimension the mesh axis doesn't divide.
+
+    Megatron's head sharding assumes heads % |model| == 0; a tower whose
+    head count doesn't divide (e.g. 3-head ViT-Ti on a 2-wide model
+    axis) must fall back to replication for that leaf rather than fail
+    placement — the rule is a layout preference, not a shape contract.
+    """
+    entries = list(spec) + [None] * (leaf.ndim - len(spec))
+    changed = False
+    for i, a in enumerate(entries):
+        if a is not None and leaf.shape[i] % mesh.shape[a]:
+            entries[i] = None
+            changed = True
+    if not changed:
+        return spec
+    return P(*entries)
 
 
 def shard_train_state(state, mesh: Mesh, *, model_axis: str = "model"):
@@ -197,7 +254,8 @@ def shard_train_state(state, mesh: Mesh, *, model_axis: str = "model"):
     def place(path, leaf):
         if not hasattr(leaf, "ndim"):  # static fields (apply_fn, tx)
             return leaf
-        spec = tp_param_spec(path, leaf, model_axis=model_axis)
+        spec = _drop_indivisible(
+            tp_param_spec(path, leaf, model_axis=model_axis), leaf, mesh)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(place, state)
@@ -214,6 +272,7 @@ def make_tp_simclr_train_step(
     *,
     data_axis: str = "data",
     has_batch_stats: bool = False,
+    param_spec_fn=None,
 ) -> Callable:
     """Compiler-partitioned SimCLR train step on a (data, model) mesh.
 
@@ -225,7 +284,14 @@ def make_tp_simclr_train_step(
     ``has_batch_stats=True`` is for encoders with BatchNorm (ResNet +
     trainer.TrainState); the default fits the primary TP targets (ViT/CLIP,
     no BatchNorm, plain flax TrainState).
+
+    ``param_spec_fn`` (default: the plain Megatron ``tp_param_spec``
+    rule) pins the OUTPUT state's leaves so they round-trip into the
+    next call — pass ``tp_fsdp_spec_fn(mesh, ...)`` when the state was
+    placed with the composed Megatron + ZeRO-3 layout.
     """
+    if param_spec_fn is None:
+        param_spec_fn = tp_param_spec
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, v1, v2):
@@ -251,7 +317,7 @@ def make_tp_simclr_train_step(
         state2 = state.apply_gradients(grads=grads)
         if new_stats is not None:
             state2 = state2.replace(batch_stats=new_stats)
-        return state2, {"loss": loss}
+        return _pin_state(state2, mesh, param_spec_fn), {"loss": loss}
 
     return train_step
 
@@ -262,6 +328,7 @@ def make_tp_clip_train_step(
     data_axis: str = "data",
     remat: bool = False,
     moe_aux_weight: float = 0.0,
+    param_spec_fn=None,
 ) -> Callable:
     """Compiler-partitioned CLIP train step: dual towers, learnable scale.
 
@@ -272,8 +339,11 @@ def make_tp_clip_train_step(
     matmul over the mesh. ``remat`` rematerializes the tower forwards in
     the backward pass. ``moe_aux_weight > 0`` adds the MoE towers'
     load-balance aux loss (a single global program — no pmean needed).
+    ``param_spec_fn``: see ``make_tp_simclr_train_step``.
     """
     collect = moe_aux_weight > 0.0
+    if param_spec_fn is None:
+        param_spec_fn = tp_param_spec
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, images, tokens):
@@ -304,6 +374,7 @@ def make_tp_clip_train_step(
         metrics = {"loss": loss}
         if collect:
             metrics["moe_aux"] = aux
-        return state.apply_gradients(grads=grads), metrics
+        return _pin_state(state.apply_gradients(grads=grads), mesh,
+                          param_spec_fn), metrics
 
     return train_step

@@ -419,3 +419,41 @@ def test_cli_clip_fsdp_run(tmp_path, clip_parallel, expect):
                             timeout=600, env=env)
     assert second.returncode == 0, second.stdout + second.stderr
     assert "nothing to do" in (second.stdout + second.stderr)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fsdp,expect", [
+    (False, "SimCLR GSPMD (4, 2) (data, model) mesh"),
+    (True, "SimCLR GSPMD Megatron + ZeRO-3"),
+])
+def test_cli_simclr_tp_run(tmp_path, fsdp, expect):
+    """--parallel tp (round 4): the ViT-B/16 SimCLR workload
+    (BASELINE.json configs[3]) gets a compiler-partitioned launch
+    surface — Megatron sharding over the (data, model) mesh, optionally
+    composed with ZeRO-3; checkpoints and resumes."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    repo = os.path.dirname(os.path.dirname(__file__))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    ckpt = tmp_path / "ckpt"
+    cmd = [sys.executable, "-m", "ntxent_tpu.cli",
+           "--dataset", "synthetic", "--model", "vit_t16",
+           "--image-size", "16", "--synthetic-samples", "64",
+           "--batch", "16", "--steps", "2", "--warmup-steps", "1",
+           "--proj-hidden-dim", "16", "--proj-dim", "8",
+           "--ckpt-dir", str(ckpt), "--ckpt-every", "100",
+           "--log-every", "1", "--platform", "cpu", "--parallel", "tp"]
+    if fsdp:
+        cmd.append("--fsdp")
+    run = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                         env=env)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert expect in (run.stdout + run.stderr)
+    assert "final: step 2" in (run.stdout + run.stderr)
+    assert ckpt.exists() and any(ckpt.iterdir())
+    second = subprocess.run(cmd, capture_output=True, text=True,
+                            timeout=600, env=env)
+    assert second.returncode == 0, second.stdout + second.stderr
+    assert "nothing to do" in (second.stdout + second.stderr)

@@ -180,7 +180,10 @@ def test_tp_fsdp_composed_step_matches_unsharded():
     'data'. Same loss and updated params as the unsharded step, with at
     least one leaf genuinely sharded over BOTH axes, and the compiled
     step stable across calls (output shardings round-trip)."""
-    from ntxent_tpu.parallel.tp import shard_train_state_tp_fsdp
+    from ntxent_tpu.parallel.tp import (
+        shard_train_state_tp_fsdp,
+        tp_fsdp_spec_fn,
+    )
 
     model = tiny_clip()
     imgs = jax.random.uniform(jax.random.PRNGKey(2), (8, 8, 8, 3))
@@ -203,7 +206,10 @@ def test_tp_fsdp_composed_step_matches_unsharded():
     # plain TP.
     state_c = shard_train_state_tp_fsdp(make_state(model, example), mesh,
                                         min_shard_elems=32)
-    step = make_tp_clip_train_step(mesh)
+    # Output pinning must use the SAME rule (threshold included) the
+    # placement used, or every step ends in a resharding.
+    step = make_tp_clip_train_step(
+        mesh, param_spec_fn=tp_fsdp_spec_fn(mesh, min_shard_elems=32))
     state_c, metrics = step(state_c, imgs, toks)
     np.testing.assert_allclose(float(metrics["loss"]), float(loss_ref),
                                rtol=1e-5, atol=1e-5)
