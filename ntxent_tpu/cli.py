@@ -440,9 +440,6 @@ def main(argv=None) -> int:
             logger.warning("--dp-loss %s ignored under --parallel tp "
                            "(the TP step uses the GSPMD-sharded oracle "
                            "loss)", args.dp_loss)
-        if args.remat:
-            logger.warning("--remat ignored under --parallel tp (the TP "
-                           "step has no remat hook yet)")
         mesh = create_mesh(shape=(n_dev // args.model_par,
                                   args.model_par),
                            axis_names=("data", "model"))
@@ -460,6 +457,7 @@ def main(argv=None) -> int:
                         n_dev // args.model_par, args.model_par)
         step = make_tp_simclr_train_step(mesh, cfg.temperature,
                                          has_batch_stats=has_bs,
+                                         remat=args.remat,
                                          param_spec_fn=spec_fn)
         data = _make_pipeline(args, per_process_batch,
                               sharding=NamedSharding(mesh, P("data")),

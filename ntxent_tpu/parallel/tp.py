@@ -272,6 +272,7 @@ def make_tp_simclr_train_step(
     *,
     data_axis: str = "data",
     has_batch_stats: bool = False,
+    remat: bool = False,
     param_spec_fn=None,
 ) -> Callable:
     """Compiler-partitioned SimCLR train step on a (data, model) mesh.
@@ -284,6 +285,9 @@ def make_tp_simclr_train_step(
     ``has_batch_stats=True`` is for encoders with BatchNorm (ResNet +
     trainer.TrainState); the default fits the primary TP targets (ViT/CLIP,
     no BatchNorm, plain flax TrainState).
+
+    ``remat=True`` rematerializes the encoder forward in the backward
+    pass (the same HBM-for-FLOPs trade as every other step factory).
 
     ``param_spec_fn`` (default: the plain Megatron ``tp_param_spec``
     rule) pins the OUTPUT state's leaves so they round-trip into the
@@ -298,17 +302,22 @@ def make_tp_simclr_train_step(
         v1c = _constrain_batch(v1, mesh, data_axis)
         v2c = _constrain_batch(v2, mesh, data_axis)
 
-        def loss_fn(params):
-            both = jnp.concatenate([v1c, v2c], axis=0)
+        def encode(params, both):
             if has_batch_stats:
                 variables = {"params": params,
                              "batch_stats": state.batch_stats}
-                z, updates = state.apply_fn(variables, both, train=True,
-                                            mutable=["batch_stats"])
-                new_stats = updates["batch_stats"]
-            else:
-                z = state.apply_fn({"params": params}, both, train=True)
-                new_stats = None
+                return state.apply_fn(variables, both, train=True,
+                                      mutable=["batch_stats"])
+            return state.apply_fn({"params": params}, both,
+                                  train=True), None
+
+        if remat:
+            encode = jax.checkpoint(encode)
+
+        def loss_fn(params):
+            both = jnp.concatenate([v1c, v2c], axis=0)
+            z, updates = encode(params, both)
+            new_stats = updates["batch_stats"] if has_batch_stats else None
             z = _constrain_batch(z, mesh, data_axis)
             return ntxent_loss(z, temperature), new_stats
 

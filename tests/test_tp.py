@@ -97,7 +97,12 @@ def test_param_specs_shard_transformer_weights():
 
 
 @pytest.mark.slow
-def test_tp_simclr_step_matches_unsharded():
+@pytest.mark.parametrize(
+    "remat",
+    [False,
+     # remat recompiles the encoder backward; slow tier only.
+     pytest.param(True, marks=pytest.mark.slow)])
+def test_tp_simclr_step_matches_unsharded(remat):
     model = tiny_vit()
     imgs = jax.random.uniform(jax.random.PRNGKey(1), (8, 8, 8, 3))
     v1, v2 = imgs[:4], imgs[4:]
@@ -120,7 +125,8 @@ def test_tp_simclr_step_matches_unsharded():
         "MlpBlock_0"]["Dense_0"]["kernel"]
     assert kernel.sharding.spec == P(None, "model"), "weights not TP-sharded"
 
-    step = make_tp_simclr_train_step(mesh, 0.1, has_batch_stats=False)
+    step = make_tp_simclr_train_step(mesh, 0.1, has_batch_stats=False,
+                                     remat=remat)
     state_tp, metrics = step(state_tp, v1, v2)
     np.testing.assert_allclose(float(metrics["loss"]), float(loss_ref),
                                rtol=1e-5, atol=1e-5)
