@@ -138,8 +138,32 @@ _WORKER = textwrap.dedent("""
     fsdp_loss = float(fs_m["loss"])
     assert np.isfinite(fsdp_loss), fsdp_loss
 
+    # Hybrid ZeRO across the REAL process boundary: a ('dcn', 'data')
+    # (2, 2) mesh where jax.devices() order puts the process boundary
+    # exactly along the 'dcn' axis (each process's 2 devices are the
+    # inner 'data'/ICI axis) — the actual multi-slice topology, not the
+    # single-process simulation. Params shard over the intra-process
+    # axis only; the batch spans all four devices; per-layer weight
+    # all-gathers never cross the boundary.
+    hy_mesh = create_mesh((2, 2), axis_names=("dcn", "data"))
+    hy_state = create_train_state(model, jax.random.PRNGKey(0),
+                                  (1, 8, 8, 3), cfg)
+    hy_state = shard_train_state_fsdp(hy_state, hy_mesh, axis="data")
+    hy_step = make_fsdp_train_step(hy_mesh, cfg.temperature, axis="data")
+    hv1, hv2 = global_batch((f1[lo:hi], f2[lo:hi]), hy_mesh,
+                            axis=("dcn", "data"))
+    hy_state, hy_m = hy_step(hy_state, hv1, hv2)
+    hybrid_loss = float(hy_m["loss"])
+    assert np.isfinite(hybrid_loss), hybrid_loss
+    # Same init, same batch, same global math as the flat-mesh FSDP step
+    # above — only the collective layout differs (bf16 encoder: allow
+    # reduction-order spread, same bound as dryrun_multichip).
+    assert abs(hybrid_loss - fsdp_loss) < 1e-2 * max(1.0, fsdp_loss), (
+        hybrid_loss, fsdp_loss)
+
     print("MULTIHOST_OK:" + json.dumps(
-        {**info, "losses": losses, "fsdp_loss": fsdp_loss}))
+        {**info, "losses": losses, "fsdp_loss": fsdp_loss,
+         "hybrid_fsdp_loss": hybrid_loss}))
     jax.distributed.shutdown()
 """)
 
@@ -169,7 +193,10 @@ def test_two_process_rendezvous_and_psum(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=180)
+            # Two cold JAX starts + rendezvous + DP/FSDP/hybrid-ZeRO
+            # compiles, on a possibly-contended single-core host: the
+            # round-4 hybrid section pushed the old 180 s budget over.
+            out, _ = p.communicate(timeout=420)
             outs.append(out)
     finally:
         for p in procs:
@@ -191,6 +218,10 @@ def test_two_process_rendezvous_and_psum(tmp_path):
     assert results[0]["losses"] == results[1]["losses"], results
     # FSDP across the boundary: same replicated trajectory requirement.
     assert results[0]["fsdp_loss"] == results[1]["fsdp_loss"], results
+    # Hybrid ZeRO (params on the intra-process axis, batch across the
+    # boundary): both ranks replicate the same loss.
+    assert results[0]["hybrid_fsdp_loss"] == results[1]["hybrid_fsdp_loss"], \
+        results
 
 
 def test_explicit_coordinator_failure_propagates():
