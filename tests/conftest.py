@@ -70,13 +70,15 @@ else:
 # warms its own cache. (Self-written entries also warn, about XLA's own
 # "+prefer-no-scatter" pseudo-features — that one is benign.)
 #
-# CONCURRENCY HAZARD (observed 2026-07-31): several pytest processes
-# sharing this dir can race the cache files and leave a corrupt entry
-# whose execution SIGABRTs the whole tier with no error text (fatal at
-# the first block_until_ready of the poisoned program). If the suite
-# starts dying with a bare "Fatal Python error: Aborted" inside
-# jax Array._value, `rm -rf .jax_cache` and re-run serially — and point
-# concurrent runs at distinct NTXENT_JAX_CACHE dirs.
+# CORRUPTION HAZARD (observed twice, 2026-07-31): a corrupt cache entry
+# SIGABRTs the whole tier with no error text (fatal at the first
+# block_until_ready of the poisoned program). Two triggers seen: (a)
+# several pytest processes sharing this dir racing the cache files, and
+# (b) a pytest process KILLED mid-write whose dir is then reused. If the
+# suite starts dying with a bare "Fatal Python error: Aborted" inside
+# jax Array._value, `rm -rf .jax_cache` and re-run serially — point
+# concurrent runs at distinct NTXENT_JAX_CACHE dirs, and wipe a killed
+# run's dir before reusing it.
 
 
 def _host_cpu_tag() -> str:
