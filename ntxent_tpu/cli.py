@@ -809,10 +809,18 @@ def build_eval_parser() -> argparse.ArgumentParser:
                    help="match the training run's value (it shapes the "
                         "checkpoint's optimizer-state pytree)")
     p.add_argument("--protocol", default="both",
-                   choices=["probe", "knn", "both", "finetune"],
-                   help="frozen-feature probe / kNN, or end-to-end "
+                   choices=["probe", "knn", "both", "finetune", "zeroshot"],
+                   help="frozen-feature probe / kNN; end-to-end "
                         "fine-tuning of the whole encoder (SimCLR-objective "
-                        "checkpoints only)")
+                        "checkpoints only); or zeroshot — CLIP-objective "
+                        "checkpoints classify test images by nearest "
+                        "text-prompt embedding (--class-tokens)")
+    p.add_argument("--class-tokens", default=None, metavar="NPY",
+                   help="zeroshot: (num_classes, token_len) int array of "
+                        "pre-tokenized class prompts (the framework has "
+                        "no tokenizer — tokenize prompts like 'a photo "
+                        "of a dog' with your vocab and save via "
+                        "np.save); row i is the prompt for label i")
     p.add_argument("--finetune-steps", type=int, default=500)
     p.add_argument("--finetune-lr", type=float, default=1e-3)
     p.add_argument("--finetune-batch", type=int, default=64,
@@ -829,9 +837,13 @@ def build_eval_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _labeled_arrays(args):
+def _labeled_arrays(args, test_only: bool = False):
     """(train_images, train_labels, test_images, test_labels) as float32
-    NHWC in [0, 1]."""
+    NHWC in [0, 1]. ``test_only=True`` skips loading/decoding the train
+    split (returning empty train arrays) — the zero-shot protocol needs
+    no training data, and reading 50k CIFAR images or decoding thousands
+    of JPEGs just to discard them is the kind of silent cost the caps
+    exist to prevent."""
     import numpy as np
 
     def subsample(images, labels, cap, seed):
@@ -846,10 +858,14 @@ def _labeled_arrays(args):
 
         if args.data_dir is None:
             raise SystemExit("--dataset cifar10 requires --data-dir")
-        tr = Cifar10Source(args.data_dir, train=True)
         te = Cifar10Source(args.data_dir, train=False)
-        xtr, ytr = tr.images, tr.labels
         xte, yte = te.images, te.labels
+        if test_only:
+            xtr = np.zeros((0,) + xte.shape[1:], xte.dtype)
+            ytr = np.zeros((0,), yte.dtype)
+        else:
+            tr = Cifar10Source(args.data_dir, train=True)
+            xtr, ytr = tr.images, tr.labels
     elif args.dataset == "imagefolder":
         from ntxent_tpu.training.datasets import ImageFolderSource
 
@@ -866,12 +882,18 @@ def _labeled_arrays(args):
                     idxs, cap, replace=False)
             return np.sort(idxs)
 
-        tr_idx = pick(np.arange(0, len(src), 2), args.max_train, args.seed)
         te_idx = pick(np.arange(1, len(src), 2), args.max_test,
                       args.seed + 1)
-        xtr = np.stack([src[int(i)] for i in tr_idx])
         xte = np.stack([src[int(i)] for i in te_idx])
-        ytr, yte = labels[tr_idx], labels[te_idx]
+        yte = labels[te_idx]
+        if test_only:
+            xtr = np.zeros((0,) + xte.shape[1:], xte.dtype)
+            ytr = np.zeros((0,), yte.dtype)
+        else:
+            tr_idx = pick(np.arange(0, len(src), 2), args.max_train,
+                          args.seed)
+            xtr = np.stack([src[int(i)] for i in tr_idx])
+            ytr = labels[tr_idx]
     elif args.dataset == "npy":
         raise SystemExit("--dataset npy has no labels; evaluation needs "
                          "cifar10 or imagefolder")
@@ -902,6 +924,17 @@ def eval_main(argv=None) -> int:
         logger.error("--protocol finetune needs a SimCLR-objective "
                      "checkpoint (an encoder with a features method)")
         return 2
+    if args.protocol == "zeroshot":
+        # Same fail-early policy as finetune: both flags are known now.
+        if args.objective != "clip":
+            logger.error("--protocol zeroshot needs a CLIP-objective "
+                         "checkpoint (a text tower to embed the class "
+                         "prompts); got --objective %s", args.objective)
+            return 2
+        if not args.class_tokens:
+            logger.error("--protocol zeroshot requires --class-tokens "
+                         "(pre-tokenized class prompts; see --help)")
+            return 2
 
     import jax
 
@@ -983,6 +1016,56 @@ def eval_main(argv=None) -> int:
         def apply_features(x):
             return model.apply(variables, x, train=False,
                                method="features")
+
+    if args.protocol == "zeroshot":
+        # The signature CLIP transfer eval: no training on the target
+        # task at all — each class becomes a text-prompt embedding and
+        # test images classify to the nearest one in the shared space.
+        # The candidate set is the WHOLE prompt file (row i = label i) —
+        # not the labels that happened to survive subsampling, which
+        # would silently shrink the argmax competition and inflate the
+        # accuracy — and only the test split is loaded.
+        import json
+
+        import numpy as np
+
+        toks = np.load(args.class_tokens)
+        if toks.ndim != 2 or not np.issubdtype(toks.dtype, np.integer):
+            raise SystemExit(f"--class-tokens must be a 2-D integer "
+                             f"array; got {toks.dtype} {toks.shape}")
+        if toks.shape[1] != args.token_len:
+            raise SystemExit(f"--class-tokens rows are {toks.shape[1]} "
+                             f"tokens but the checkpoint's text tower "
+                             f"takes --token-len {args.token_len}")
+        # Same both-sided id check as the train-side guard (cli.py token
+        # validation): XLA clamps out-of-range embedding gathers
+        # silently, so a bad id would yield a plausible, wrong accuracy.
+        if int(toks.min()) < 0 or int(toks.max()) >= args.vocab_size:
+            raise SystemExit(f"--class-tokens ids must be in [0, "
+                             f"{args.vocab_size}); got range "
+                             f"[{int(toks.min())}, {int(toks.max())}]")
+        _, _, xte, yte = _labeled_arrays(args, test_only=True)
+        n_prompt = int(toks.shape[0])
+        if int(yte.max()) >= n_prompt:
+            raise SystemExit(f"test labels reach {int(yte.max())} but "
+                             f"--class-tokens has only {n_prompt} prompt "
+                             "rows (row i = label i)")
+        # Both encoders L2-normalize (models/clip.py), so the matmul IS
+        # cosine similarity; the learnable scale only rescales logits and
+        # cannot change the argmax.
+        text_emb = model.apply(variables, jnp.asarray(toks),
+                               method="encode_text")
+        fte = extract_features(apply_features, jnp.asarray(xte),
+                               args.batch)
+        pred = jnp.argmax(fte @ text_emb.T, axis=1)
+        acc = float(jnp.mean((pred == jnp.asarray(yte)).astype(
+            jnp.float32)))
+        results = {"step": int(state.step), "zeroshot_top1": acc,
+                   "num_classes": n_prompt, "num_test": int(len(yte))}
+        logger.info("zero-shot top-1: %.4f over %d prompt classes", acc,
+                    n_prompt)
+        print(json.dumps(results))
+        return 0
 
     xtr, ytr, xte, yte = _labeled_arrays(args)
     num_classes = int(max(int(ytr.max()), int(yte.max()))) + 1

@@ -329,6 +329,45 @@ def test_cli_clip_train_then_eval(tmp_path):
         eval_extra=["--probe-steps", "30", "--k", "5",
                     "--max-train", "128", "--max-test", "64"])
 
+    # Zero-shot protocol on the same checkpoint: classes become
+    # pre-tokenized prompt rows, test images classify to the nearest
+    # text embedding in the shared space (no training on the task).
+    import json
+
+    rng = np.random.RandomState(3)
+    toks = rng.randint(1, 64, size=(16, 8)).astype(np.int32)
+    toks_path = tmp_path / "class_tokens.npy"
+    np.save(toks_path, toks)
+    code = ("import sys; from ntxent_tpu.cli import eval_main;"
+            "sys.exit(eval_main(sys.argv[1:]))")
+    zs = subprocess.run(
+        [sys.executable, "-c", code, "--ckpt-dir", str(tmp_path / "ckpt"),
+         "--protocol", "zeroshot", "--class-tokens", str(toks_path),
+         "--max-test", "64"] + common,
+        capture_output=True, text=True, timeout=600,
+        env=_cpu_subprocess_env())
+    assert zs.returncode == 0, zs.stdout + zs.stderr
+    result = json.loads(zs.stdout.strip().splitlines()[-1])
+    assert 0.0 <= result["zeroshot_top1"] <= 1.0, result
+
+    # Fail-early contracts: zeroshot without clip / without prompts.
+    bad = subprocess.run(
+        [sys.executable, "-c", code, "--ckpt-dir", str(tmp_path / "ckpt"),
+         "--protocol", "zeroshot", "--class-tokens", str(toks_path),
+         "--dataset", "synthetic", "--model", "tiny", "--image-size",
+         "16", "--platform", "cpu"],
+        capture_output=True, text=True, timeout=120,
+        env=_cpu_subprocess_env())
+    assert bad.returncode != 0
+    assert "needs a CLIP-objective checkpoint" in (bad.stdout + bad.stderr)
+    bad2 = subprocess.run(
+        [sys.executable, "-c", code, "--ckpt-dir", str(tmp_path / "ckpt"),
+         "--protocol", "zeroshot"] + common,
+        capture_output=True, text=True, timeout=120,
+        env=_cpu_subprocess_env())
+    assert bad2.returncode != 0
+    assert "requires --class-tokens" in (bad2.stdout + bad2.stderr)
+
 
 @pytest.mark.slow
 def test_cli_imagefolder_train_then_eval(tmp_path):
