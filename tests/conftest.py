@@ -70,15 +70,19 @@ else:
 # warms its own cache. (Self-written entries also warn, about XLA's own
 # "+prefer-no-scatter" pseudo-features — that one is benign.)
 #
-# CORRUPTION HAZARD (observed twice, 2026-07-31): a corrupt cache entry
-# SIGABRTs the whole tier with no error text (fatal at the first
-# block_until_ready of the poisoned program). Two triggers seen: (a)
-# several pytest processes sharing this dir racing the cache files, and
-# (b) a pytest process KILLED mid-write whose dir is then reused. If the
+# RELOAD-ABORT HAZARD (root-caused 2026-07-31 after three incidents):
+# certain programs' serialized XLA:CPU executables deterministically
+# SIGABRT with no error text when RELOADED from this cache in a later
+# process (fatal at the first block_until_ready), while fresh compiles
+# of the same program are always green. Known instance: the
+# GSPMD-sharded oracle-InfoNCE step (GSPMD emits scatter; the
+# cpu_aot_loader "+prefer-no-scatter" pseudo-feature mismatch is the
+# suspected class) — its test opts out of the cache via the
+# no_persistent_compilation_cache fixture (tests/test_fsdp.py). If the
 # suite starts dying with a bare "Fatal Python error: Aborted" inside
-# jax Array._value, `rm -rf .jax_cache` and re-run serially — point
-# concurrent runs at distinct NTXENT_JAX_CACHE dirs, and wipe a killed
-# run's dir before reusing it.
+# jax Array._value: identify the test (dots count vs collection order),
+# reproduce it ALONE against the warm cache, and give it the fixture;
+# `rm -rf .jax_cache` only hides the problem until the next warm run.
 
 
 def _host_cpu_tag() -> str:
