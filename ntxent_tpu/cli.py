@@ -106,8 +106,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="simclr multi-device strategy: dp = shard_map "
                         "data-parallel with the fused loss (default); "
                         "tp = compiler-partitioned (data, model) mesh "
-                        "(Megatron sharding for ViT encoders, GSPMD "
-                        "oracle loss) — composes with --fsdp into "
+                        "(Megatron sharding for ViT encoders; the fused "
+                        "--dp-loss bodies run over 'data' inside the "
+                        "GSPMD program) — composes with --fsdp into "
                         "Megatron + ZeRO-3")
     t.add_argument("--vocab-size", type=int, default=49408,
                    help="clip: text-tower vocabulary")
@@ -135,8 +136,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "(local rows x global cols per device) or 'pair' "
                         "(balanced shard-pair schedule — each global "
                         "similarity tile computed once across the mesh); "
-                        "honored by both the shard_map DP step and the "
-                        "fused-loss FSDP step")
+                        "honored by the shard_map DP step and the "
+                        "fused-loss FSDP and TP steps")
     t.add_argument("--remat", action="store_true",
                    help="rematerialize the encoder forward in the backward "
                         "pass (fits bigger batches in HBM at ~1 extra "
@@ -436,10 +437,6 @@ def main(argv=None) -> int:
             logger.warning("--parallel tp shards transformer weights "
                            "only; --model %s keeps everything replicated "
                            "over the model axis", args.model)
-        if args.dp_loss != "strip":
-            logger.warning("--dp-loss %s ignored under --parallel tp "
-                           "(the TP step uses the GSPMD-sharded oracle "
-                           "loss)", args.dp_loss)
         mesh = create_mesh(shape=(n_dev // args.model_par,
                                   args.model_par),
                            axis_names=("data", "model"))
@@ -455,9 +452,12 @@ def main(argv=None) -> int:
             spec_fn = None
             logger.info("SimCLR GSPMD (%d, %d) (data, model) mesh",
                         n_dev // args.model_par, args.model_par)
+        # --dp-loss strip/pair is honored under TP too (round 5: the TP
+        # step embeds the fused shard_map bodies over 'data').
         step = make_tp_simclr_train_step(mesh, cfg.temperature,
                                          has_batch_stats=has_bs,
                                          remat=args.remat,
+                                         loss_impl=args.dp_loss,
                                          param_spec_fn=spec_fn)
         data = _make_pipeline(args, per_process_batch,
                               sharding=NamedSharding(mesh, P("data")),

