@@ -381,6 +381,14 @@ def _vit_flash_flops_correction(model_name: str, name: str, batch: int,
             "clip_tiny": (32, 2, 8), "clip_b16": (768, 12, 16)}
     base = name.split("[")[0]
     if base not in dims:
+        # A tower missing from the table would get MFU silently biased
+        # low by its whole attention share — the exact silent-truncation
+        # class the flops_attention_correction field exists to surface
+        # (ADVICE r4 #3). Loud, so the table gets extended.
+        logger.warning(
+            "no attention-FLOPs dims for %r: flash-attention MFU will "
+            "omit the Pallas attention matmuls (add the tower to "
+            "_vit_flash_flops_correction's dims table)", base)
         return 0.0
     hidden, depth, patch = dims[base]
     # SimCLR pushes both views through the tower; CLIP's image tower sees

@@ -884,6 +884,12 @@ def _labeled_arrays(args, test_only: bool = False):
 
         te_idx = pick(np.arange(1, len(src), 2), args.max_test,
                       args.seed + 1)
+        if len(te_idx) == 0:
+            # np.stack([]) below would raise an opaque ValueError; a
+            # 1-image folder has no odd-index test half (ADVICE r4 #2).
+            raise SystemExit(
+                f"imagefolder {args.data_dir} has no test images (the "
+                "odd-index half is empty); need at least 2 images")
         xte = np.stack([src[int(i)] for i in te_idx])
         yte = labels[te_idx]
         if test_only:
@@ -1046,6 +1052,14 @@ def eval_main(argv=None) -> int:
                              f"[{int(toks.min())}, {int(toks.max())}]")
         _, _, xte, yte = _labeled_arrays(args, test_only=True)
         n_prompt = int(toks.shape[0])
+        if len(yte) == 0:
+            # yte.max() on an empty split raises numpy's opaque
+            # "zero-size array reduction" instead of an actionable exit
+            # (ADVICE r4 #2); defense-in-depth behind the per-dataset
+            # guards in _labeled_arrays.
+            raise SystemExit("zero-shot eval needs a non-empty test "
+                             "split; got 0 test examples (check the "
+                             "dataset's test half)")
         if int(yte.max()) >= n_prompt:
             raise SystemExit(f"test labels reach {int(yte.max())} but "
                              f"--class-tokens has only {n_prompt} prompt "

@@ -290,6 +290,7 @@ def make_tp_simclr_train_step(
     has_batch_stats: bool = False,
     remat: bool = False,
     loss_impl: str = "strip",
+    loss_axes: str | tuple | None = None,
     interpret: bool | None = None,
     param_spec_fn=None,
 ) -> Callable:
@@ -306,14 +307,21 @@ def make_tp_simclr_train_step(
     (``dist_loss.resolve_local_ntxent``); ``"oracle"`` — the all-jnp
     global loss whose (2B, 2B) similarity matmul GSPMD shards across the
     mesh (rows with the batch sharding, columns via its own all-gather;
-    the pre-round-5 behavior, kept for A/B). Under either impl the loss
-    shards over ``data`` only; the ``model`` axis replicates the loss
-    compute, which is negligible next to the tower matmuls it splits.
+    the pre-round-5 behavior, kept for A/B).
+
+    ``loss_axes`` (default ``(data_axis,)``): mesh axes the fused loss
+    shards over. The default replicates the loss compute across
+    ``model`` — negligible next to the tower matmuls at small B. Pass
+    ``(data_axis, model_axis)`` to spread the loss rows over EVERY
+    device (the (2B, 2B) similarity work drops by |model|x at the cost
+    of one embedding reshard into the shard_map) — worthwhile when B is
+    large enough that the loss matmul shows up next to the towers.
 
     Divisibility contract (fused impls only): the per-step batch B (rows
-    of ``v1``/``v2``) must divide by ``mesh.shape[data_axis]`` — the
-    shard_map's ``P(data)`` in_specs reject ragged shards at trace time.
-    ``loss_impl="oracle"`` carries no such constraint (GSPMD pads).
+    of ``v1``/``v2``) must divide by the product of the ``loss_axes``
+    sizes — the shard_map's in_specs reject ragged shards at trace
+    time. ``loss_impl="oracle"`` carries no such constraint (GSPMD
+    pads).
 
     ``has_batch_stats=True`` is for encoders with BatchNorm (ResNet +
     trainer.TrainState); the default fits the primary TP targets (ViT/CLIP,
@@ -333,12 +341,14 @@ def make_tp_simclr_train_step(
         sharded_loss = None
     else:
         # The ONE dispatch point for fused NT-Xent bodies — same factory
-        # the shard_map DP trainer and the FSDP step use.
+        # the shard_map DP trainer and the FSDP step use; its
+        # _resolve_loss_axes owns the str-vs-tuple normalization.
         from .dist_loss import make_sharded_ntxent
 
         sharded_loss = make_sharded_ntxent(
-            mesh, temperature, axis=data_axis, interpret=interpret,
-            impl=loss_impl)
+            mesh, temperature,
+            axis=data_axis if loss_axes is None else loss_axes,
+            interpret=interpret, impl=loss_impl)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, v1, v2):
@@ -386,6 +396,7 @@ def make_tp_clip_train_step(
     data_axis: str = "data",
     remat: bool = False,
     loss_impl: str = "dual",
+    loss_axes: str | tuple | None = None,
     interpret: bool | None = None,
     moe_aux_weight: float = 0.0,
     param_spec_fn=None,
@@ -403,8 +414,12 @@ def make_tp_clip_train_step(
     shard_map over ``data_axis`` inside the GSPMD program; ``"oracle"``
     — the all-jnp global InfoNCE whose (N, N) logit matmul GSPMD shards
     over the mesh (the pre-round-5 behavior, kept for A/B). The fused
-    impls require batch N to divide by ``mesh.shape[data_axis]`` (the
-    shard_map rejects ragged shards at trace time); ``"oracle"`` doesn't.
+    impls require batch N to divide by the product of the ``loss_axes``
+    sizes (the shard_map rejects ragged shards at trace time);
+    ``"oracle"`` doesn't. ``loss_axes``: see
+    ``make_tp_simclr_train_step`` — pass ``(data_axis, model_axis)`` to
+    spread the loss rows over every device instead of replicating the
+    loss compute across ``model``.
 
     ``remat`` rematerializes the tower forwards in the backward pass.
     ``moe_aux_weight > 0`` adds the MoE towers' load-balance aux loss (a
@@ -418,11 +433,13 @@ def make_tp_clip_train_step(
         sharded_loss = None
     else:
         # The ONE dispatch point for fused InfoNCE bodies — same factory
-        # the shard_map DP CLIP trainer and the FSDP CLIP step use.
+        # the shard_map DP CLIP trainer and the FSDP CLIP step use; its
+        # _resolve_loss_axes owns the str-vs-tuple normalization.
         from .dist_loss import make_sharded_infonce
 
         sharded_loss = make_sharded_infonce(
-            mesh, axis=data_axis, interpret=interpret, impl=loss_impl)
+            mesh, axis=data_axis if loss_axes is None else loss_axes,
+            interpret=interpret, impl=loss_impl)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, images, tokens):

@@ -103,11 +103,16 @@ def test_elastic_resume_across_mesh_sizes(tmp_path, rng):
             f"step {t}: elastic-resumed loss diverged")
 
 
+@pytest.mark.slow
 def test_fsdp_elastic_resume_across_mesh_sizes(tmp_path, rng):
     """Elastic recovery for ZeRO-3 (round 4): a checkpoint written from an
     8-device FSDP mesh restores onto a 4-device FSDP mesh — different
     PartitionSpecs per leaf (the shape-driven rule keys on axis size), so
     orbax must reshard on restore.
+
+    Slow tier (round 5 fast-floor budget, VERDICT r4 #9): two FSDP mesh
+    compiles + orbax roundtrip is ~1 min of the fast tier; the fast tier
+    keeps checkpoint_roundtrip and the FSDP equality tests.
 
     What this pins: (a) resharding moves bytes without changing them —
     every restored leaf equals its saved value bitwise; (b) the first

@@ -496,3 +496,24 @@ def test_cli_simclr_tp_run(tmp_path, fsdp, expect):
                             timeout=600, env=env)
     assert second.returncode == 0, second.stdout + second.stderr
     assert "nothing to do" in (second.stdout + second.stderr)
+
+
+def test_labeled_arrays_rejects_one_image_folder(tmp_path):
+    """An imagefolder with a single image has an empty odd-index test
+    half; _labeled_arrays must exit actionably instead of np.stack([])'s
+    opaque ValueError (ADVICE r4 #2)."""
+    import argparse
+
+    from PIL import Image
+
+    from ntxent_tpu.cli import _labeled_arrays
+
+    d = tmp_path / "folder" / "cat"
+    d.mkdir(parents=True)
+    Image.fromarray(np.zeros((16, 16, 3), np.uint8)).save(d / "only.png")
+    args = argparse.Namespace(dataset="imagefolder",
+                              data_dir=str(tmp_path / "folder"),
+                              image_size=16, max_train=0, max_test=0,
+                              seed=0)
+    with pytest.raises(SystemExit, match="no test images"):
+        _labeled_arrays(args, test_only=True)

@@ -284,6 +284,7 @@ class TestPairParallel:
                                        np.asarray(want_g),
                                        rtol=1e-4, atol=1e-6)
 
+    @pytest.mark.slow  # fast-floor budget: pair VALUES stay fast (above)
     def test_grads_match_oracle_even_mesh(self, rng):
         """pair == oracle gradients through the custom VJP (G-tile psum
         assembly) plus the AD-handled positive term, on an even mesh

@@ -277,11 +277,15 @@ def test_fsdp_clip_hybrid_mesh():
                                rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_fsdp_composes_with_gradient_accumulation():
     """optax.MultiSteps under ZeRO-3: the accumulator's inner state
     mirrors the param tree, so the shape-driven spec rule shards it like
     the moments it wraps — two FSDP micro-steps must equal two unsharded
-    micro-steps (same optimizer, update applied on the second)."""
+    micro-steps (same optimizer, update applied on the second).
+
+    Slow tier (round 5 fast-floor budget): four compiled step programs;
+    the plain FSDP==unsharded equality stays fast."""
     import optax
 
     from ntxent_tpu.training.trainer import make_train_step
@@ -325,6 +329,7 @@ def test_fsdp_composes_with_gradient_accumulation():
                                    np.asarray(r), rtol=5e-3, atol=5e-4)
 
 
+@pytest.mark.slow  # fast-floor budget: FSDP equality + MoE cores stay fast
 def test_fsdp_composes_with_moe_towers():
     """ZeRO-3 over an MoE-ViT SimCLR encoder (round 4 — previously the
     CLI refused the combination): expert weights shard by the same

@@ -326,6 +326,7 @@ def test_ring_dual_matches_oracle(rng, mesh):
                                    rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow  # fast-floor budget: robustness corner of the dual path
 def test_distributed_dual_vmem_fallback_matches(rng, mesh, monkeypatch):
     """At the 32k-batch production scale the dual backward's full-length
     accumulators exceed VMEM and every step takes the two-kernel fallback

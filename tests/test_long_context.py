@@ -98,7 +98,16 @@ def test_mesh_plans_match_oracle(tokens, params, plan):
                                rtol=1e-4, atol=1e-4)
 
 
-@pytest.mark.parametrize("plan", ["blockwise", "ring", "ulysses"])
+@pytest.mark.parametrize(
+    "plan",
+    ["blockwise",
+     # fast-floor budget (VERDICT r4 #9): the plan MECHANISM's
+     # AD-transparency runs fast via blockwise; ring/ulysses attention
+     # grads stay fast-covered at the attention level
+     # (test_ring_attention.assert_same_fn), so their 8-device
+     # plan-compose variants ride the slow tier.
+     pytest.param("ring", marks=pytest.mark.slow),
+     pytest.param("ulysses", marks=pytest.mark.slow)])
 def test_plan_grads_match_oracle(tokens, params, plan):
     """Every non-oracle plan's PARAMETER gradients equal the oracle plan's
     — the composed path (QKV projections -> decomposed attention ->

@@ -179,6 +179,7 @@ def _tiny_moe_clip(rng):
     return model, variables, images, tokens
 
 
+@pytest.mark.slow  # fast-floor budget: MoE core + EP equality stay fast
 def test_moe_clip_train_step(rng):
     """CLIP with an MoE image tower: aux joins the InfoNCE objective."""
     import optax
@@ -195,6 +196,7 @@ def test_moe_clip_train_step(rng):
     assert np.isfinite(float(metrics["moe_aux"]))
 
 
+@pytest.mark.slow  # fast-floor budget
 def test_moe_clip_tp_step(rng):
     """GSPMD tensor-parallel CLIP step with an MoE image tower."""
     import optax

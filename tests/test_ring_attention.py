@@ -104,6 +104,7 @@ def test_ulysses_matches_oracle(rng, qkv, mesh, causal):
 
 
 @needs_mesh
+@pytest.mark.slow  # fast-floor budget: ulysses==oracle already runs fast
 def test_ulysses_blockwise_local_path(rng, qkv, mesh):
     fn = make_ulysses_attention(mesh, block_kv=8)
     ref = attention_oracle

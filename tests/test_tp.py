@@ -39,9 +39,11 @@ from ntxent_tpu.ops.oracle import cosine_normalize
 class _NormViT(nn.Module):
     """Tiny ViT + L2 normalization (the contract ntxent_loss expects)."""
 
+    depth: int = 2
+
     @nn.compact
     def __call__(self, x, train: bool = True):
-        z = VisionTransformer(patch_size=4, hidden_dim=32, depth=2,
+        z = VisionTransformer(patch_size=4, hidden_dim=32, depth=self.depth,
                               num_heads=2, mlp_dim=64,
                               dtype=jnp.float32)(x, train=train)
         return cosine_normalize(z)
@@ -52,10 +54,13 @@ def tiny_vit():
 
 
 def tiny_clip():
+    # depth=1 towers: the Megatron rules key on module names, not depth,
+    # and GSPMD partitioning cost scales with block count — one block per
+    # tower halves the fast tier's composed-test compile (VERDICT r4 #9).
     return CLIPModel(
-        image_encoder=tiny_vit,
+        image_encoder=lambda: _NormViT(depth=1),
         text_encoder=lambda: TextTransformer(
-            vocab_size=64, max_len=16, hidden_dim=32, depth=2, num_heads=2,
+            vocab_size=64, max_len=16, hidden_dim=32, depth=1, num_heads=2,
             dtype=jnp.float32),
         embed_dim=16,
     )
@@ -165,6 +170,48 @@ def test_tp_clip_step_matches_unsharded(loss_impl):
     np.testing.assert_allclose(float(metrics["loss"]), float(loss_ref),
                                rtol=1e-5, atol=1e-5)
     assert np.isfinite(float(metrics["loss"]))
+
+
+@pytest.mark.slow
+def test_tp_loss_sharded_over_both_axes_matches_unsharded():
+    """loss_axes=('data', 'model'): the fused loss rows spread over every
+    device of the 2-D mesh (no replicated loss compute on 'model') —
+    must still equal the unsharded oracle. Batch 8 divides the 8-device
+    product; same tuple-axes machinery the hybrid-ZeRO loss uses."""
+    model = tiny_vit()
+    imgs = jax.random.uniform(jax.random.PRNGKey(6), (16, 8, 8, 3))
+    v1, v2 = imgs[:8], imgs[8:]
+    state0 = make_state(model, (jnp.zeros((1, 8, 8, 3)),))
+
+    def loss_fn(params):
+        both = jnp.concatenate([v1, v2], axis=0)
+        z = model.apply({"params": params}, both, train=True)
+        return ntxent_loss(z, 0.1)
+
+    loss_ref = float(loss_fn(state0.params))
+
+    mesh = create_mesh(shape=(4, 2), axis_names=("data", "model"))
+    state_tp = shard_train_state(make_state(model, (jnp.zeros((1, 8, 8, 3)),)),
+                                 mesh)
+    step = make_tp_simclr_train_step(mesh, 0.1, has_batch_stats=False,
+                                     loss_axes=("data", "model"))
+    _, metrics = step(state_tp, v1, v2)
+    np.testing.assert_allclose(float(metrics["loss"]), loss_ref,
+                               rtol=1e-5, atol=1e-5)
+
+    # CLIP variant: dual-direction InfoNCE over both axes.
+    clip = tiny_clip()
+    toks = jax.random.randint(jax.random.PRNGKey(7), (8, 16), 1, 64)
+    example = (jnp.zeros((1, 8, 8, 3)), jnp.zeros((1, 16), jnp.int32))
+    cstate0 = make_state(clip, example)
+    zi, zt, scale = clip.apply({"params": cstate0.params}, v1,
+                               toks, train=True)
+    clip_ref = float(info_nce_loss(zi, zt, temperature=1.0 / scale))
+    cstate = shard_train_state(make_state(clip, example), mesh)
+    cstep = make_tp_clip_train_step(mesh, loss_axes=("data", "model"))
+    _, cmetrics = cstep(cstate, v1, toks)
+    np.testing.assert_allclose(float(cmetrics["loss"]), clip_ref,
+                               rtol=1e-5, atol=1e-5)
 
 
 def test_tp_multi_step_loss_decreases():
