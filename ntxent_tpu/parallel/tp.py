@@ -338,6 +338,12 @@ def make_tp_simclr_train_step(
     if param_spec_fn is None:
         param_spec_fn = tp_param_spec
     if loss_impl == "oracle":
+        if loss_axes is not None:
+            # Silently dropping the requested sharding would let an A/B
+            # pass on one arm and trace-fail on the other with no hint.
+            raise ValueError("loss_axes applies only to the fused "
+                             "shard_map impls; the oracle loss is "
+                             "GSPMD-partitioned over the whole mesh")
         sharded_loss = None
     else:
         # The ONE dispatch point for fused NT-Xent bodies — same factory
@@ -430,6 +436,10 @@ def make_tp_clip_train_step(
     if param_spec_fn is None:
         param_spec_fn = tp_param_spec
     if loss_impl == "oracle":
+        if loss_axes is not None:
+            raise ValueError("loss_axes applies only to the fused "
+                             "shard_map impls; the oracle loss is "
+                             "GSPMD-partitioned over the whole mesh")
         sharded_loss = None
     else:
         # The ONE dispatch point for fused InfoNCE bodies — same factory

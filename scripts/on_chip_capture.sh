@@ -144,12 +144,6 @@ cp -f "${NTXENT_TPU_CACHE:-$HOME/.cache/ntxent_tpu}/autotune.json" \
 commit_art "on-chip capture: bench.py headline (v3 autotune protocol)" \
     "$OUT/" || true
 
-# 2. TPU-gated test tier (conftest auto-resolves the platform name now).
-KEEP_ON_FAIL=1 run_step 1800 tpu_tests "$OUT/pytest_tpu_tier.txt" \
-    env NTXENT_TEST_PLATFORM=tpu \
-    python -m pytest tests/ -m tpu -q --no-header || true
-commit_art "on-chip capture: TPU-gated pytest tier" "$OUT/" || true
-
 # 3. RN50 batch-256 rung, fixed chain protocol (batch as arguments — the
 #    constant-embedding 413 is gone).
 run_step 1800 rn50_b256 - python benchmarks/run_benchmarks.py \
@@ -190,6 +184,15 @@ run_step 1800 rn50_ablate - python benchmarks/run_benchmarks.py \
     --out "$OUT/mfu_rn50_ablation" || true
 guard_mfu_dir "$OUT/mfu_rn50_ablation" rn50_ablate
 commit_art "on-chip capture: RN50 step-component ablation" "$OUT/" || true
+
+# 6pre. TPU-gated test tier (conftest auto-resolves the platform name
+#       now). Runs AFTER the RN50 plateau diagnostics: VERDICT r4 ranks
+#       the undiagnosed MFU north star first, and a short window must not
+#       be eaten by the 30-min tier before those captures land.
+KEEP_ON_FAIL=1 run_step 1800 tpu_tests "$OUT/pytest_tpu_tier.txt" \
+    env NTXENT_TEST_PLATFORM=tpu \
+    python -m pytest tests/ -m tpu -q --no-header || true
+commit_art "on-chip capture: TPU-gated pytest tier" "$OUT/" || true
 
 # 6. Flash-attention A/B rerun: incremental writes now, span-amortized
 #    timing at small L, and the 8192-causal rung that died with the

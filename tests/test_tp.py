@@ -188,16 +188,23 @@ def test_tp_loss_sharded_over_both_axes_matches_unsharded():
         z = model.apply({"params": params}, both, train=True)
         return ntxent_loss(z, 0.1)
 
-    loss_ref = float(loss_fn(state0.params))
+    loss_ref, grads = jax.value_and_grad(loss_fn)(state0.params)
+    state_ref = state0.apply_gradients(grads=grads)
 
     mesh = create_mesh(shape=(4, 2), axis_names=("data", "model"))
     state_tp = shard_train_state(make_state(model, (jnp.zeros((1, 8, 8, 3)),)),
                                  mesh)
     step = make_tp_simclr_train_step(mesh, 0.1, has_batch_stats=False,
                                      loss_axes=("data", "model"))
-    _, metrics = step(state_tp, v1, v2)
-    np.testing.assert_allclose(float(metrics["loss"]), loss_ref,
+    state_tp, metrics = step(state_tp, v1, v2)
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss_ref),
                                rtol=1e-5, atol=1e-5)
+    # Updated params too: a wrong cotangent through the two-axis
+    # all_gather would leave the forward loss right and training wrong.
+    for r, g in zip(jax.tree_util.tree_leaves(state_ref.params),
+                    jax.tree_util.tree_leaves(state_tp.params)):
+        np.testing.assert_allclose(np.asarray(jax.device_get(g)),
+                                   np.asarray(r), rtol=5e-3, atol=1e-4)
 
     # CLIP variant: dual-direction InfoNCE over both axes.
     clip = tiny_clip()
