@@ -102,6 +102,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tp runs (--parallel tp / --clip-parallel tp): "
                         "model-axis size of the (data, model) mesh; "
                         "device count must divide by it")
+    t.add_argument("--tp-loss-axes", default="data",
+                   choices=["data", "both"],
+                   help="tp runs: mesh axes the fused loss shards over — "
+                        "'data' (default; loss compute replicated across "
+                        "'model') or 'both' (loss rows spread over every "
+                        "device, one embedding reshard into the "
+                        "shard_map; pays off at large per-step batch)")
     t.add_argument("--parallel", default="dp", choices=["dp", "tp"],
                    help="simclr multi-device strategy: dp = shard_map "
                         "data-parallel with the fused loss (default); "
@@ -372,6 +379,10 @@ def main(argv=None) -> int:
             logger.warning("--parallel %s ignored: the CLIP objective "
                            "uses --clip-parallel for its strategy",
                            args.parallel)
+        if args.tp_loss_axes != "data" and args.clip_parallel != "tp":
+            logger.warning("--tp-loss-axes %s ignored: only "
+                           "--clip-parallel tp runs shard the loss over "
+                           "the model axis", args.tp_loss_axes)
         return _train_clip(args, info, per_process_batch)
     if args.dataset == "npy":
         # No resize path exists for the raw row store: the model MUST be
@@ -412,6 +423,14 @@ def main(argv=None) -> int:
         (1, args.image_size, args.image_size, 3), cfg)
 
     n_dev = info["global_device_count"]
+    if args.tp_loss_axes != "data" and not (n_dev > 1
+                                            and args.parallel == "tp"):
+        # Same silent-drop hole the step factories guard against for
+        # loss_axes + oracle: an A/B that forgot --parallel tp would
+        # compare two identical configs without noticing.
+        logger.warning("--tp-loss-axes %s ignored: only --parallel tp "
+                       "runs shard the loss over the model axis",
+                       args.tp_loss_axes)
     if n_dev > 1 and args.parallel == "tp":
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -453,11 +472,15 @@ def main(argv=None) -> int:
             logger.info("SimCLR GSPMD (%d, %d) (data, model) mesh",
                         n_dev // args.model_par, args.model_par)
         # --dp-loss strip/pair is honored under TP too (round 5: the TP
-        # step embeds the fused shard_map bodies over 'data').
+        # step embeds the fused shard_map bodies over 'data', or over
+        # both mesh axes with --tp-loss-axes both).
+        loss_axes = (("data", "model") if args.tp_loss_axes == "both"
+                     else None)
         step = make_tp_simclr_train_step(mesh, cfg.temperature,
                                          has_batch_stats=has_bs,
                                          remat=args.remat,
                                          loss_impl=args.dp_loss,
+                                         loss_axes=loss_axes,
                                          param_spec_fn=spec_fn)
         data = _make_pipeline(args, per_process_batch,
                               sharding=NamedSharding(mesh, P("data")),
@@ -713,9 +736,11 @@ def _train_clip(args, info, per_process_batch: int) -> int:
                 spec_fn = None
                 logger.info("CLIP GSPMD (%d, %d) (data, model) mesh",
                             n_dev // args.model_par, args.model_par)
-            step = make_tp_clip_train_step(mesh, remat=args.remat,
-                                           moe_aux_weight=moe_aux,
-                                           param_spec_fn=spec_fn)
+            step = make_tp_clip_train_step(
+                mesh, remat=args.remat, moe_aux_weight=moe_aux,
+                loss_axes=(("data", "model")
+                           if args.tp_loss_axes == "both" else None),
+                param_spec_fn=spec_fn)
             sharding = NamedSharding(mesh, P("data"))
         elif args.fsdp:
             from ntxent_tpu.parallel import (

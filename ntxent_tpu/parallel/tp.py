@@ -156,16 +156,21 @@ def tp_fsdp_param_spec(path, leaf, *, model_axis: str = "model",
             or leaf.size < min_shard_elems:
         return spec
     entries = list(spec) + [None] * (leaf.ndim - len(spec))
+    changed = False
     if model_size is not None:
         for i, a in enumerate(entries):
             if a is not None and leaf.shape[i] % model_size:
                 entries[i] = None
+                changed = True
     taken = tuple(i for i, s in enumerate(entries) if s is not None)
     i = largest_divisible_dim(leaf.shape, data_size, taken=taken)
-    if i is None:
-        return spec
-    entries[i] = data_axis
-    return P(*entries)
+    if i is not None:
+        entries[i] = data_axis
+        changed = True
+    # `changed` also covers the no-data-dim case: a dropped model claim
+    # must not resurface in the returned spec (the rule's output is
+    # always directly placeable when model_size is known).
+    return P(*entries) if changed else spec
 
 
 def tp_fsdp_spec_fn(mesh: Mesh, *, model_axis: str = "model",

@@ -295,6 +295,47 @@ def test_tp_fsdp_composed_step_matches_unsharded():
     assert np.isfinite(float(m2["loss"]))
 
 
+def test_tp_fsdp_spec_invariants_fuzz():
+    """Property fuzz of the composed Megatron+ZeRO rule over random
+    shapes/paths (no compiles — pure spec arithmetic): (i) no dim is
+    claimed by two axes; (ii) a data-claimed dim divides data_size;
+    (iii) with model_size known, every surviving model claim divides it;
+    (iv) the spec never exceeds the leaf's rank."""
+    from ntxent_tpu.parallel.tp import tp_fsdp_param_spec
+
+    class _Key:
+        def __init__(self, key):
+            self.key = key
+
+    rng = np.random.RandomState(0)
+    modules = [("MultiHeadAttention_0", "query", "kernel"),
+               ("MultiHeadAttention_0", "out", "kernel"),
+               ("MlpBlock_0", "Dense_0", "kernel"),
+               ("MlpBlock_0", "Dense_1", "kernel"),
+               ("LayerNorm_0", "scale"), ("Dense_2", "kernel")]
+    for _i in range(200):
+        names = modules[rng.randint(len(modules))]
+        path = tuple(_Key(n) for n in names)
+        ndim = rng.randint(1, 5)
+        shape = tuple(int(rng.choice([1, 3, 4, 6, 8, 16, 24, 64]))
+                      for _ in range(ndim))
+        leaf = jnp.zeros(shape)
+        data_size = int(rng.choice([2, 3, 4, 8]))
+        model_size = int(rng.choice([2, 3, 4]))
+        spec = tp_fsdp_param_spec(path, leaf, data_size=data_size,
+                                  model_size=model_size,
+                                  min_shard_elems=1)
+        entries = list(spec)
+        assert len(entries) <= leaf.ndim, (names, shape, spec)
+        claimed = [a for a in entries if a is not None]
+        assert len(claimed) == len(set(claimed)), (names, shape, spec)
+        for i, a in enumerate(entries):
+            if a == "data":
+                assert shape[i] % data_size == 0, (names, shape, spec)
+            elif a == "model":
+                assert shape[i] % model_size == 0, (names, shape, spec)
+
+
 def test_tp_fsdp_spec_reclaims_indivisible_tp_dim():
     """ADVICE r4 #1: when the model axis can't divide a TP-claimed dim
     (3-head tower on a 2-wide axis), placement replicates it anyway —
