@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""On-chip matmul-precision error ladder for the kernel==oracle contract.
+
+The first real-hardware run of the TPU-gated tier (2026-08-01,
+benchmark_results/tpu/pytest_tpu_tier.txt) failed all six kernel-vs-oracle
+gradient comparisons at rtol=1e-4 while every loss VALUE matched at 1e-5.
+Hypothesis: neither side pins ``precision=``, so on TPU the oracle's f32
+matmuls lower to single-pass bf16 on the MXU (~1e-3 elementwise rounding),
+which interpret-mode CPU runs (true f32) never see — the tolerance is
+unachievable on hardware regardless of kernel correctness.
+
+This probe measures, on the real chip, the max abs/rel gradient error for
+each (kernel precision, oracle precision) pair in
+{default, highest} x {default, highest}, for the fused NT-Xent, triangular,
+dual-InfoNCE, and flash-attention paths. The committed JSON is the evidence
+for whatever tolerance/precision policy the tier adopts.
+
+Usage (chip-alive host, AFTER the capture queue is idle):
+    python scripts/precision_probe.py [--out benchmark_results/tpu/precision_probe.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _finite(x: float):
+    # json.dumps would emit bare NaN/Infinity tokens (invalid JSON) for
+    # non-finite errors — and divergent hardware gradients are exactly
+    # what this probe exists to catch.
+    return float(x) if np.isfinite(x) else repr(float(x))
+
+
+def _err(a, b):
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    abs_err = np.abs(a - b)
+    denom = np.maximum(np.abs(b), 1e-12)
+    return {
+        "max_abs": _finite(abs_err.max()),
+        "max_rel": _finite((abs_err / denom).max()),
+        "mean_abs": _finite(abs_err.mean()),
+    }
+
+
+def _grad_pair(fn_a, fn_b, args, prec_a, prec_b):
+    """value_and_grad both sides, each traced under its own precision."""
+    with jax.default_matmul_precision(prec_a):
+        la, ga = jax.jit(jax.value_and_grad(fn_a))(*args)
+        jax.block_until_ready(ga)
+    with jax.default_matmul_precision(prec_b):
+        lb, gb = jax.jit(jax.value_and_grad(fn_b))(*args)
+        jax.block_until_ready(gb)
+    out = _err(ga, gb)
+    out["loss_abs"] = _finite(abs(float(la) - float(lb)))
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="benchmark_results/tpu/precision_probe.json")
+    args = ap.parse_args()
+
+    backend = jax.default_backend()
+    import os
+    if backend not in ("tpu", "axon") and not os.environ.get("NTXENT_PROBE_FORCE"):
+        print(f"backend={backend}: this probe only means anything on TPU",
+              file=sys.stderr)
+        return 1
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    from ntxent_tpu.ops.ntxent_pallas import ntxent_loss_fused
+    from ntxent_tpu.ops.infonce_pallas import info_nce_fused
+    from ntxent_tpu.ops.oracle import (cosine_normalize, info_nce_loss,
+                                       ntxent_loss)
+    from ntxent_tpu.ops.attention_pallas import flash_attention
+    from ntxent_tpu.parallel.ring_attention import attention_oracle
+
+    key = jax.random.PRNGKey(42)
+    z = cosine_normalize(jax.random.normal(key, (256, 128), jnp.float32))
+    ka, kb = jax.random.split(key)
+    za = cosine_normalize(jax.random.normal(ka, (128, 128), jnp.float32))
+    zb = cosine_normalize(jax.random.normal(kb, (128, 128), jnp.float32))
+
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (2, 512, 4, 64), jnp.float32)
+    k = jax.random.normal(kk, (2, 512, 4, 64), jnp.float32)
+    v = jax.random.normal(kv, (2, 512, 4, 64), jnp.float32)
+
+    precisions = ("default", "highest")
+    report = {"backend": backend,
+              "device_kind": jax.devices()[0].device_kind,
+              "cases": {}}
+
+    ntxent_oracle = lambda zz: ntxent_loss(zz, 0.07)  # noqa: E731
+    cases = {
+        "fused_vs_oracle": (
+            lambda zz: ntxent_loss_fused(zz, 0.07), ntxent_oracle, (z,)),
+        "tri_vs_oracle": (
+            lambda zz: ntxent_loss_fused(zz, 0.07, triangular=True),
+            ntxent_oracle, (z,)),
+        "infonce_vs_oracle": (
+            lambda a: info_nce_fused(a, zb, 0.07),
+            lambda a: info_nce_loss(a, zb, 0.07), (za,)),
+        "flash_vs_xla": (
+            lambda qq: flash_attention(qq, k, v).sum(),
+            lambda qq: attention_oracle(qq, k, v).sum(), (q,)),
+    }
+
+    self_cache: dict = {}
+    for name, (fa, fb, fargs) in cases.items():
+        grid = {}
+        for pa in precisions:
+            for pb in precisions:
+                tag = f"kernel={pa}/oracle={pb}"
+                try:
+                    grid[tag] = _grad_pair(fa, fb, fargs, pa, pb)
+                except Exception as e:  # keep the ladder going
+                    grid[tag] = {"error": repr(e)[:300]}
+                print(f"{name:20s} {tag:32s} {grid[tag]}", flush=True)
+        # oracle self-rounding: highest vs default on the SAME function —
+        # the pure-XLA bf16-pass noise floor the tier must tolerate.
+        # fused/tri share an oracle; don't burn chip time re-measuring it.
+        self_key = (id(fb), id(fargs))
+        if self_key not in self_cache:
+            try:
+                self_cache[self_key] = _grad_pair(
+                    fb, fb, fargs, "default", "highest")
+            except Exception as e:
+                self_cache[self_key] = {"error": repr(e)[:300]}
+        grid["oracle_self_default_vs_highest"] = self_cache[self_key]
+        print(f"{name:20s} {'oracle self d/h':32s} "
+              f"{grid['oracle_self_default_vs_highest']}", flush=True)
+        report["cases"][name] = grid
+
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(report, indent=1))
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
