@@ -37,6 +37,20 @@ pytestmark = [
                % jax.default_backend()),
 ]
 
+# Matmul-precision policy for on-device equality (first real-hardware run
+# 2026-08-01 + scripts/precision_probe.py): under DEFAULT precision every
+# f32 jnp.dot on TPU lowers to a single bf16 pass on the MXU, on BOTH the
+# kernel and the oracle side — each side independently carries a ~1.5e-4
+# abs elementwise rounding that interpret-mode CPU (true f32) never sees,
+# so gradient equality at rtol=1e-4 is only meaningful with both sides
+# traced at HIGHEST (exact f32 via multi-pass decomposition). Probe
+# evidence: matched-highest agrees to ~2.5e-7 abs; any default pairing
+# sits at the ~1.5e-4 oracle-vs-itself noise floor. The production
+# default stays platform-default — that rounding floor is pinned by
+# test_default_precision_noise_floor_on_device below.
+def _highest():
+    return jax.default_matmul_precision("highest")
+
 
 def test_backend_capabilities_native():
     from ntxent_tpu.ops.ntxent_pallas import _default_interpret
@@ -61,8 +75,9 @@ def test_fused_matches_oracle_on_device(rng):
         lambda zz: ntxent_loss_fused(zz, 0.07)))
     oracle = jax.jit(jax.value_and_grad(
         lambda zz: ntxent_loss(zz, 0.07)))
-    lf, gf = fused(z)
-    lo, go = oracle(z)
+    with _highest():
+        lf, gf = fused(z)
+        lo, go = oracle(z)
     np.testing.assert_allclose(float(lf), float(lo), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(gf), np.asarray(go),
                                rtol=1e-4, atol=1e-6)
@@ -75,9 +90,10 @@ def test_triangular_matches_oracle_on_device(rng):
     z = make_embeddings(rng, 256, 128)
     tri = jax.jit(jax.value_and_grad(
         lambda zz: ntxent_loss_fused(zz, 0.07, triangular=True)))
-    lt, gt = tri(z)
-    lo, go = jax.jit(jax.value_and_grad(
-        lambda zz: ntxent_loss(zz, 0.07)))(z)
+    with _highest():
+        lt, gt = tri(z)
+        lo, go = jax.jit(jax.value_and_grad(
+            lambda zz: ntxent_loss(zz, 0.07)))(z)
     np.testing.assert_allclose(float(lt), float(lo), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(gt), np.asarray(go),
                                rtol=1e-4, atol=1e-6)
@@ -104,10 +120,13 @@ def test_infonce_dual_matches_oracle_on_device(rng):
     ka, kb = jax.random.split(rng)
     za = make_embeddings(ka, 256, 128)
     zb = make_embeddings(kb, 256, 128)
-    lf, (ga, gb) = jax.jit(jax.value_and_grad(
-        lambda a, b: info_nce_fused(a, b, 0.07), argnums=(0, 1)))(za, zb)
-    lo, (oa, ob) = jax.jit(jax.value_and_grad(
-        lambda a, b: info_nce_loss(a, b, 0.07), argnums=(0, 1)))(za, zb)
+    with _highest():
+        lf, (ga, gb) = jax.jit(jax.value_and_grad(
+            lambda a, b: info_nce_fused(a, b, 0.07),
+            argnums=(0, 1)))(za, zb)
+        lo, (oa, ob) = jax.jit(jax.value_and_grad(
+            lambda a, b: info_nce_loss(a, b, 0.07),
+            argnums=(0, 1)))(za, zb)
     np.testing.assert_allclose(float(lf), float(lo), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(ga), np.asarray(oa),
                                rtol=1e-4, atol=1e-6)
@@ -121,9 +140,11 @@ def test_flash_attention_matches_oracle_on_device(rng):
 
     ks = jax.random.split(rng, 3)
     q, k, v = (jax.random.normal(kk, (2, 256, 4, 64)) * 0.5 for kk in ks)
-    out = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal=True))(
-        q, k, v)
-    ref = attention_oracle(q, k, v, causal=True)
+    with _highest():
+        out = jax.jit(
+            lambda a, b, c: flash_attention(a, b, c, causal=True))(q, k, v)
+        ref = jax.jit(
+            lambda a, b, c: attention_oracle(a, b, c, causal=True))(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
 
@@ -256,10 +277,11 @@ def test_vit_flash_tower_matches_xla_tower_on_device(rng):
     flash_tower = VisionTransformer(attention_impl="flash", **kw)
     x = jax.random.normal(rng, (2, 32, 32, 3), jnp.float32)
     vars_ = xla_tower.init(jax.random.PRNGKey(0), x, train=False)
-    h_xla = jax.jit(
-        lambda v, xx: xla_tower.apply(v, xx, train=False))(vars_, x)
-    h_flash = jax.jit(
-        lambda v, xx: flash_tower.apply(v, xx, train=False))(vars_, x)
+    with _highest():
+        h_xla = jax.jit(
+            lambda v, xx: xla_tower.apply(v, xx, train=False))(vars_, x)
+        h_flash = jax.jit(
+            lambda v, xx: flash_tower.apply(v, xx, train=False))(vars_, x)
     np.testing.assert_allclose(np.asarray(h_flash), np.asarray(h_xla),
                                rtol=1e-4, atol=1e-5)
 
@@ -280,9 +302,34 @@ def test_partial_fused_matches_oracle_on_device(rng):
     def partial_loss(zz):
         return ntxent_partial_fused(zz, zz, gid, 0.07) / zz.shape[0]
 
-    lp, gp = jax.jit(jax.value_and_grad(partial_loss))(z)
-    lo, go = jax.jit(jax.value_and_grad(
-        lambda zz: ntxent_loss(zz, 0.07)))(z)
+    with _highest():
+        lp, gp = jax.jit(jax.value_and_grad(partial_loss))(z)
+        lo, go = jax.jit(jax.value_and_grad(
+            lambda zz: ntxent_loss(zz, 0.07)))(z)
     np.testing.assert_allclose(float(lp), float(lo), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(gp), np.asarray(go),
                                rtol=1e-4, atol=1e-6)
+
+
+def test_default_precision_noise_floor_on_device(rng):
+    """The production path runs at PLATFORM-DEFAULT matmul precision
+    (single-pass bf16 on the v5e MXU for f32 inputs). This pins that
+    path's distance from the exact-f32 oracle to the expected rounding
+    floor — catching both a precision regression (e.g. an accidental
+    f32->bf16 input cast, which would blow the loss bound) and any
+    future change that silently pins kernels to a slower multi-pass
+    mode (checked by the paired timing assert in the MFU benches, not
+    here). Bounds are 10x the measured floor in
+    benchmark_results/tpu/precision_probe.json."""
+    from ntxent_tpu.ops.ntxent_pallas import ntxent_loss_fused
+    from ntxent_tpu.ops.oracle import ntxent_loss
+
+    z = make_embeddings(rng, 256, 128)
+    lf, gf = jax.jit(jax.value_and_grad(
+        lambda zz: ntxent_loss_fused(zz, 0.07)))(z)
+    with _highest():
+        lo, go = jax.jit(jax.value_and_grad(
+            lambda zz: ntxent_loss(zz, 0.07)))(z)
+    np.testing.assert_allclose(float(lf), float(lo), rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(go),
+                               rtol=5e-2, atol=2e-3)
