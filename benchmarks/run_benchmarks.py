@@ -466,8 +466,12 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
                            "the per-call protocol — numbers may carry "
                            "relay-timing distortion", e)
 
+    bytes_accessed = None
     if chain_exec is not None:
+        from ntxent_tpu.utils.profiling import chain_bytes_per_step
+
         flops = chain_flops_per_step(chain_exec, runs)
+        bytes_accessed = chain_bytes_per_step(chain_exec, runs)
         chained_ms, state, final_loss = time_chain(
             chain_exec, state, *step_args, length=runs, spans=2)
 
@@ -517,6 +521,32 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
         # analytic attention add-back (invisible to XLA cost analysis
         # inside the Pallas custom call).
         entry["flops_attention_correction"] = flash_corr
+    if bytes_accessed and flops:
+        # Roofline attribution (the RN50 ~29%-MFU plateau diagnosis).
+        # Caveat on semantics: XLA's "bytes accessed" counts LOGICAL
+        # per-op bytes, not unique post-fusion HBM traffic, so it
+        # overcounts reused operands — roofline_mfu_cap is a LOWER
+        # bound on the true ceiling and hbm_bw_utilization can read
+        # >100% for matmul-heavy programs (CLIP-B/16: 140%). The
+        # saturation claim is meaningful when measured MFU ~= cap AND
+        # util ~= 100% together (RN50: 31.1% = 31.1% at 99.9%),
+        # corroborated by trace attribution (reduce/convert-dominated
+        # device time in benchmark_results/tpu/xprof).
+        from ntxent_tpu.training.trainer import peak_hbm_bytes_per_chip
+
+        # Consistent numerator/denominator: the flash add-back counts
+        # FLOPs that XLA's "bytes accessed" knows nothing about (the
+        # Pallas custom call is opaque to cost analysis on both sides),
+        # so the roofline uses cost-analysis FLOPs only — flash runs
+        # exclude the kernel's traffic AND its FLOPs rather than
+        # inflating intensity with a mixed ratio.
+        intensity = (flops - flash_corr) / bytes_accessed
+        crossover = peak_flops_per_chip() / peak_hbm_bytes_per_chip()
+        entry["bytes_accessed_per_step"] = bytes_accessed
+        entry["arithmetic_intensity"] = intensity
+        entry["roofline_mfu_cap"] = min(1.0, intensity / crossover)
+        entry["hbm_bw_utilization"] = (
+            bytes_accessed * sps / peak_hbm_bytes_per_chip())
     # Sweeps need one entry per size; plain runs keep the pre-sweep key
     # schema so existing results.json consumers stay comparable.
     key = f"{name}@{batch}" if tag_batch else name
@@ -526,6 +556,11 @@ def run_trainer_bench(quick: bool, results: dict, trace_dir: str | None,
     print(f"\n=== trainer step ({name}, batch {batch}, {size}x{size}) ===")
     print(f"chained {chained_ms:.2f} ms/step over {runs} steps, "
           f"{sps:.2f} steps/s, flops/step={flops_str}, MFU={mfu_str}")
+    if "roofline_mfu_cap" in entry:
+        print(f"roofline: {entry['bytes_accessed_per_step']:.3e} B/step, "
+              f"intensity {entry['arithmetic_intensity']:.1f} FLOP/B, "
+              f"MFU cap {entry['roofline_mfu_cap']:.1%}, "
+              f"HBM BW util {entry['hbm_bw_utilization']:.1%}")
 
     if trace_dir:
         from ntxent_tpu.utils.profiling import trace

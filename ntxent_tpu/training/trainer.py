@@ -577,3 +577,22 @@ def peak_flops_per_chip() -> float:
 
 def estimate_mfu(flops_per_step: float, steps_per_sec: float) -> float:
     return flops_per_step * steps_per_sec / peak_flops_per_chip()
+
+
+def peak_hbm_bytes_per_chip() -> float:
+    """Peak HBM bandwidth (bytes/s) of the local accelerator.
+
+    Pairs with peak_flops_per_chip for roofline accounting: a step whose
+    arithmetic intensity (FLOPs / bytes accessed) sits below
+    peak_flops / peak_bw cannot reach full MFU no matter how well its
+    matmuls tile onto the MXU — its MFU ceiling is
+    intensity / (peak_flops / peak_bw).
+    """
+    kind = jax.local_devices()[0].device_kind.lower()
+    # Public peak numbers: v4 1228, v5e 819, v5p 2765, v6e 1638 GB/s.
+    table = {"v4": 1228e9, "v5 lite": 819e9, "v5e": 819e9,
+             "v5p": 2765e9, "v6e": 1638e9, "v6 lite": 1638e9}
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 819e9  # unknown accelerator: v5e-class placeholder

@@ -174,13 +174,7 @@ def time_fn_chained(loss_fn, z, *, length: int = 100, spans: int = 3,
 def flops_from_compiled(compiled) -> float | None:
     """FLOP count off an already-compiled executable's cost analysis, or
     None when the backend provides no analysis."""
-    try:
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, list):  # some backends wrap it in a list
-            analysis = analysis[0]
-        return float(analysis["flops"])
-    except Exception:  # no analysis on this backend/version
-        return None
+    return _cost_analysis_value(compiled, "flops")
 
 
 _SCAN_FLOP_SEMANTICS: dict[str, str] = {}
@@ -234,6 +228,37 @@ def _scan_body_flop_semantics() -> str:
                else "scaled")
     _SCAN_FLOP_SEMANTICS[backend] = verdict
     return verdict
+
+
+def _cost_analysis_value(compiled, key: str) -> float | None:
+    """One scalar off a compiled executable's cost analysis, or None when
+    the backend provides no analysis (or not this key)."""
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, list):  # some backends wrap it in a list
+            analysis = analysis[0]
+        return float(analysis[key])
+    except Exception:  # no analysis on this backend/version
+        return None
+
+
+def bytes_accessed_from_compiled(compiled) -> float | None:
+    """HBM traffic ("bytes accessed") off a compiled executable's cost
+    analysis — the denominator of roofline arithmetic-intensity
+    accounting."""
+    return _cost_analysis_value(compiled, "bytes accessed")
+
+
+def chain_bytes_per_step(chain_exec, length: int) -> float | None:
+    """Per-step bytes accessed from a compiled scan chain's cost
+    analysis — same scan-body trip-count caveat (and probe) as
+    chain_flops_per_step."""
+    total = bytes_accessed_from_compiled(chain_exec)
+    if not total:
+        return None
+    if _scan_body_flop_semantics() == "once":
+        return total
+    return total / length
 
 
 def chain_flops_per_step(chain_exec, length: int) -> float | None:
