@@ -85,6 +85,34 @@ def test_chain_flops_per_step_matches_single_step(rng):
     assert 0.5 * single < per_step < 3 * single, per_step
 
 
+def test_chain_bytes_per_step_bounds_real_traffic(rng):
+    # The roofline denominator (round 5): "bytes accessed" must be a
+    # positive per-STEP figure under the same scan-body trip-count
+    # probe as FLOPs, and can never be less than the step's live data
+    # (here: read c, write c2 — 2 * n * n * 4 bytes) nor absurdly more
+    # than every operand re-read per consumer would explain. A
+    # misclassified scan semantics would skew it by the chain length,
+    # understating arithmetic intensity 16x in this test (and 30x in
+    # the MFU benches that feed BASELINE.md's roofline claims).
+    # Measured on XLA:CPU: per-step bytes ~9.3x live — so the 4x-live
+    # floor with length=16 catches a scaled misread (9.3/16 = 0.6x
+    # live), which a bare live<= floor at short length would not.
+    from ntxent_tpu.utils.profiling import chain_bytes_per_step
+
+    n, length = 64, 16
+
+    def step(c):
+        c2 = jnp.tanh(c @ c)
+        return c2, jnp.sum(c2)
+
+    exec_ = compile_chain(step, jnp.eye(n, dtype=jnp.float32), length)
+    per_step = chain_bytes_per_step(exec_, length)
+    if per_step is None:  # backend offers no cost analysis: nothing to pin
+        return
+    live = 2 * n * n * 4
+    assert 4 * live <= per_step < 20 * live, per_step
+
+
 def test_chain_flops_probe_failure_not_memoized(monkeypatch):
     # A transiently failed probe must fall back conservatively for THAT
     # call only — memoizing the failure would pin the understated reading
