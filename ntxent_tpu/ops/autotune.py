@@ -42,16 +42,25 @@ __all__ = ["autotune_blocks", "autotune_attention_blocks", "clear_cache",
 _CACHE: dict[tuple, tuple[int, int]] = {}
 _DISK_CACHE: dict[str, list[int]] | None = None
 
-# Bumped whenever the timing protocol changes: v2 = scanned-chain votes
-# (v1 per-iteration votes are relay-distorted and must not be reused);
+# Bumped whenever cached votes stop being comparable — a timing-protocol
+# change OR a candidate-grid change (old votes were best-of-a-smaller-
+# grid). History, newest first:
+# v4 = candidate grid extended to 1024-row / 2048-col tiles: the round-5
+# headline vote landed exactly on the old (512, 1024) corner, the classic
+# sign the optimum may lie outside the sweep; the VMEM working-set filter
+# still prunes illegal corners (e.g. 1024x2048 at D=128 is 10.5 MB > the
+# 8 MB budget), so the grid only grows where it can actually run.
+# Measured: (256, 2048) wins the 4096x128 headline, 0.151 vs 0.161 ms.
 # v3 = span-amortized votes (v2 chains were too short at fast shapes —
 # ~64 ms of fixed tunnel dispatch on a 50x1.7 ms span made sub-ms votes
 # noise; measured consequence: a pinned 1024-causal attention tile 2.4x
 # slower than the heuristic, benchmark_results/tpu/attention_ab.json).
-_PROTOCOL_VERSION = 3
+# v2 = scanned-chain votes (v1 per-iteration votes are relay-distorted
+# and must not be reused).
+_PROTOCOL_VERSION = 4
 
-_ROW_CANDIDATES = (64, 128, 256, 512)
-_COL_CANDIDATES = (128, 256, 512, 1024)
+_ROW_CANDIDATES = (64, 128, 256, 512, 1024)
+_COL_CANDIDATES = (128, 256, 512, 1024, 2048)
 
 
 def cache_path() -> Path:

@@ -141,7 +141,7 @@ guard_mfu_dir() {  # guard_mfu_dir <dir> <done_name>
 }
 cp -f "${NTXENT_TPU_CACHE:-$HOME/.cache/ntxent_tpu}/autotune.json" \
     "$OUT/autotune_cache.json" 2>/dev/null || true
-commit_art "on-chip capture: bench.py headline (v3 autotune protocol)" \
+commit_art "on-chip capture: bench.py headline (current autotune protocol)" \
     "$OUT/" || true
 
 # 3. RN50 batch-256 rung, fixed chain protocol (batch as arguments — the
