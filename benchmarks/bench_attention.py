@@ -91,6 +91,9 @@ def main() -> None:
                     from ntxent_tpu.ops import autotune_attention_blocks
                     from ntxent_tpu.ops.attention_pallas import _blocks
 
+                    # Budget: library default (NTXENT_AUTOTUNE_BUDGET_S,
+                    # 240 s — see autotune._resolve_budget_s for why a
+                    # truncated sweep is expensive).
                     bq, bk = autotune_attention_blocks(
                         l, l, args.head_dim, jnp.bfloat16, causal=causal,
                         batch_heads=args.heads, include_backward=False)

@@ -145,6 +145,18 @@ def _candidates(rows: int, cols: int, dim: int, itemsize: int,
     yield from cands
 
 
+def _resolve_budget_s(budget_s) -> float | None:
+    """Resolve the sweep wall budget: callers that pass nothing get the
+    env-overridable default (one place, so every sweep entry point keeps
+    the same budget); ``None`` stays 'unbounded'. 240 s covers the full
+    v4 loss grid — a truncated sweep's winner is deliberately never
+    persisted, so an under-budgeted sweep re-pays itself in every
+    process (and once voted a 1.4x-slower 8192-causal attention tile)."""
+    if budget_s == "env":
+        return float(os.environ.get("NTXENT_AUTOTUNE_BUDGET_S", "240"))
+    return budget_s
+
+
 def autotune_blocks(
     rows: int,
     cols: int,
@@ -154,7 +166,7 @@ def autotune_blocks(
     include_backward: bool = True,
     length: int = 100,
     spans: int = 2,
-    budget_s: float | None = 120.0,
+    budget_s: float | None | str = "env",
 ) -> tuple[int, int]:
     """Time the candidate grid on the live device; return the fastest tile.
 
@@ -220,6 +232,7 @@ def _measured_sweep(key, candidates, make_loss, example, *, length, spans,
     (time_fn_chained docstring), and a mis-timed vote here would silently
     pin a bad tile in the persistent cache — hence chained votes only.
     """
+    budget_s = _resolve_budget_s(budget_s)
     deadline = None if budget_s is None else time.monotonic() + budget_s
     best, best_ms = None, float("inf")
     truncated = False
@@ -277,7 +290,7 @@ def autotune_attention_blocks(
     include_backward: bool = True,
     length: int = 50,
     spans: int = 2,
-    budget_s: float | None = 120.0,
+    budget_s: float | None | str = "env",
 ) -> tuple[int, int]:
     """Measured (block_q, block_kv) for the fused flash-attention kernels.
 
