@@ -34,6 +34,8 @@ def main() -> None:
                    help="also time the kernel at the measured-sweep tile "
                         "(ops.autotune.autotune_attention_blocks) next to "
                         "the static-heuristic tile")
+    p.add_argument("--backward", action="store_true",
+                   help="also time fwd+bwd (jax.grad) through both paths")
     p.add_argument("--platform", default=None)
     p.add_argument("--out", default=None)
     args = p.parse_args()
@@ -51,6 +53,10 @@ def main() -> None:
 
     backend = jax.default_backend()
     on_accel = backend in ("tpu", "axon")
+    if args.backward and not on_accel:
+        print("warning: --backward only times on an accelerator backend "
+              "(interpret-mode Pallas timing measures the interpreter); "
+              "no fwd+bwd fields will be recorded", file=sys.stderr)
     ladder = [int(x) for x in args.ladder.split(",")]
     if not on_accel:
         ladder = [min(ladder)]
@@ -118,6 +124,36 @@ def main() -> None:
                         entry["pallas_tuned_ms"] = round(ms, 4)
                         entry["tuned_speedup"] = round(
                             entry["xla_oracle_ms"] / ms, 3) if ms else None
+                if args.backward:
+                    # Training runs fwd+bwd: time jax.grad through both
+                    # paths (XLA AD vs the flash-recompute custom VJP) at
+                    # the heuristic tile — the regime where XLA's bwd
+                    # must re-materialize the (L, L) matrix twice over.
+                    # Chain on STACKED (q, k, v) so the gradient covers
+                    # dq AND dk/dv — differentiating w.r.t. q alone lets
+                    # AD dead-code-eliminate ~2/3 of the backward.
+                    qkv = jnp.stack([q, k, v])
+
+                    def oracle_bwd_loss(s, _c=causal):
+                        return jnp.sum(attention_oracle(
+                            s[0], s[1], s[2], causal=_c)
+                            .astype(jnp.float32))
+
+                    def flash_bwd_loss(s, _c=causal):
+                        return jnp.sum(flash_attention(
+                            s[0], s[1], s[2], causal=_c)
+                            .astype(jnp.float32))
+
+                    ms, _ = time_fn_chained(oracle_bwd_loss, qkv, length=n,
+                                            spans=2, with_grad=True,
+                                            min_span_ms=span)
+                    entry["xla_fwd_bwd_ms"] = round(ms, 4)
+                    ms, _ = time_fn_chained(flash_bwd_loss, qkv, length=n,
+                                            spans=2, with_grad=True,
+                                            min_span_ms=span)
+                    entry["flash_fwd_bwd_ms"] = round(ms, 4)
+                    entry["fwd_bwd_speedup"] = round(
+                        entry["xla_fwd_bwd_ms"] / ms, 3) if ms else None
             rows.append(entry)
             print(json.dumps(entry))
             _write(args, on_accel, rows, jax)  # after EVERY row: the
