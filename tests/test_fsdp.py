@@ -58,7 +58,9 @@ def test_fsdp_spec_rules():
      # The GSPMD-sharded jnp-oracle loss (the pre-round-4 default) and
      # the balanced shard-pair fused body, same contract.
      (False, "oracle"),
-     pytest.param(False, "pair", marks=pytest.mark.slow),
+     # pair rides the fast tier too (VERDICT r4 weak #6: "proven equal"
+     # should cover both fused schedules, not the strip slice alone).
+     (False, "pair"),
      # remat recompiles the whole encoder backward; slow tier only.
      pytest.param(True, "strip", marks=pytest.mark.slow)])
 def test_fsdp_step_matches_unsharded(remat, loss_impl):
