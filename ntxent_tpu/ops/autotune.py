@@ -40,7 +40,9 @@ __all__ = ["autotune_blocks", "autotune_attention_blocks", "clear_cache",
            "cache_path"]
 
 _CACHE: dict[tuple, tuple[int, int]] = {}
-_DISK_CACHE: dict[str, list[int]] | None = None
+# Values: [br, bc] = served full-sweep vote; the "...|partial" twin key
+# holds a truncated sweep's progress record (dict) — see _disk_lookup.
+_DISK_CACHE: dict[str, list[int] | dict] | None = None
 
 # Bumped whenever cached votes stop being comparable — a timing-protocol
 # change OR a candidate-grid change (old votes were best-of-a-smaller-
@@ -88,7 +90,7 @@ def _disk_key(key: tuple) -> str:
     return "|".join(str(k) for k in key)
 
 
-def _load_disk_cache() -> dict[str, list[int]]:
+def _load_disk_cache() -> dict[str, list[int] | dict]:
     global _DISK_CACHE
     if _DISK_CACHE is None:
         try:
@@ -98,17 +100,83 @@ def _load_disk_cache() -> dict[str, list[int]]:
     return _DISK_CACHE
 
 
-def _store_disk_cache(key: tuple, best: tuple[int, int]) -> None:
+def _disk_lookup(key: tuple):
+    """``(final, partial)`` for a sweep key.
+
+    ``final`` is a served full-sweep vote (the plain ``[br, bc]`` entry
+    under the sweep key — the only format older readers ever see).
+    ``partial`` is a truncated sweep's progress record, stored under a
+    separate ``...|partial`` key so old checkouts sharing the cache file
+    never parse it: ``{"blocks": [br, bc], "ms": float,
+    "measured": [[br, bc], ...]}``. It is never served as a vote;
+    instead the next sweep anchors its enumeration on ``blocks``
+    (re-measuring it FRESH — the recorded ms came from another process
+    and possibly other load/thermal conditions, and finalizing on a
+    cross-condition comparison is exactly how the v2 protocol pinned
+    bad tiles) and skips the other already-measured candidates, so
+    successive under-budget sweeps partition the grid and the entry
+    finalizes into a served vote once the grid is exhausted.
+    """
     cache = _load_disk_cache()
-    cache[_disk_key(key)] = list(best)
+    entry = cache.get(_disk_key(key))
+    final = None
+    if isinstance(entry, list):
+        final = (int(entry[0]), int(entry[1]))
+    partial = cache.get(_disk_key(key) + "|partial")
+    return final, (partial if isinstance(partial, dict) else None)
+
+
+def _partial_anchor(partial: dict | None) -> tuple[int, int] | None:
+    if partial and partial.get("blocks"):
+        b = partial["blocks"]
+        return int(b[0]), int(b[1])
+    return None
+
+
+def _mutate_disk_cache(mutate) -> None:
+    """Read-merge-write under this process: progress records make writes
+    routine, and serializing this process's stale memo would drop other
+    processes' concurrent votes and progress (lost update). The file is
+    re-read immediately before writing and only the caller's keys are
+    changed; the remaining read-modify-write window is one json dump
+    wide, vs. a whole sweep before."""
+    global _DISK_CACHE
+    try:
+        fresh = json.loads(cache_path().read_text())
+        if not isinstance(fresh, dict):
+            fresh = {}
+    except (OSError, ValueError):
+        fresh = {}
+    mutate(fresh)
+    _DISK_CACHE = fresh
     try:
         path = cache_path()
         path.parent.mkdir(parents=True, exist_ok=True)
         tmp = path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(cache, indent=1, sort_keys=True))
+        tmp.write_text(json.dumps(fresh, indent=1, sort_keys=True))
         tmp.replace(path)
     except OSError as e:  # read-only home etc.: in-process cache still holds
         logger.debug("autotune cache not persisted: %s", e)
+
+
+def _store_final(key: tuple, best: tuple[int, int]) -> None:
+    def m(cache):
+        cache[_disk_key(key)] = list(best)
+        cache.pop(_disk_key(key) + "|partial", None)
+
+    _mutate_disk_cache(m)
+
+
+def _store_partial(key: tuple, record: dict) -> None:
+    def m(cache):
+        prev = cache.get(_disk_key(key) + "|partial")
+        if isinstance(prev, dict):  # merge concurrent sweeps' progress
+            union = {tuple(c) for c in prev.get("measured", [])}
+            union |= {tuple(c) for c in record.get("measured", [])}
+            record["measured"] = sorted(list(c) for c in union)
+        cache[_disk_key(key) + "|partial"] = record
+
+    _mutate_disk_cache(m)
 
 
 def _candidates(rows: int, cols: int, dim: int, itemsize: int,
@@ -149,9 +217,12 @@ def _resolve_budget_s(budget_s) -> float | None:
     """Resolve the sweep wall budget: callers that pass nothing get the
     env-overridable default (one place, so every sweep entry point keeps
     the same budget); ``None`` stays 'unbounded'. 240 s covers the full
-    v4 loss grid — a truncated sweep's winner is deliberately never
-    persisted, so an under-budgeted sweep re-pays itself in every
-    process (and once voted a 1.4x-slower 8192-causal attention tile)."""
+    v4 loss grid in one process; an under-budgeted sweep persists only
+    a progress record (anchor + measured set, never served as a vote),
+    so repeated short sweeps advance through the grid and finalize —
+    but each pays its own chip time until the grid is exhausted (a
+    120 s truncated sweep once voted a 1.4x-slower 8192-causal
+    attention tile before progress records existed)."""
     if budget_s == "env":
         return float(os.environ.get("NTXENT_AUTOTUNE_BUDGET_S", "240"))
     return budget_s
@@ -192,11 +263,11 @@ def autotune_blocks(
            jax.default_backend(), _device_kind())
     if key in _CACHE:
         return _CACHE[key]
-    on_disk = _load_disk_cache().get(_disk_key(key))
+    on_disk, partial = _disk_lookup(key)
     if on_disk is not None:
-        best = (int(on_disk[0]), int(on_disk[1]))
-        _CACHE[key] = best
-        return best
+        _CACHE[key] = on_disk
+        return on_disk
+    anchor = _partial_anchor(partial)
 
     z = jax.random.normal(jax.random.PRNGKey(0), (rows, dim), jnp.float32)
     z = (z / jnp.linalg.norm(z, axis=-1, keepdims=True)).astype(dtype)
@@ -212,9 +283,10 @@ def autotune_blocks(
 
     best = _measured_sweep(
         key, _candidates(rows, cols, dim, jnp.dtype(dtype).itemsize,
-                         near=choose_blocks(rows, cols, dim, dtype)),
+                         near=anchor
+                         or choose_blocks(rows, cols, dim, dtype)),
         make_loss, z, length=length, spans=spans,
-        with_grad=include_backward, budget_s=budget_s)
+        with_grad=include_backward, budget_s=budget_s, prior=partial)
     if best is None:
         best = choose_blocks(rows, cols, dim, dtype)
         _CACHE[key] = best
@@ -222,11 +294,18 @@ def autotune_blocks(
 
 
 def _measured_sweep(key, candidates, make_loss, example, *, length, spans,
-                    with_grad, budget_s):
+                    with_grad, budget_s, prior: dict | None = None):
     """Vote a candidate grid with the scanned-chain protocol; cache the
-    winner (in-process always; on disk only for a full, un-truncated
-    sweep). Returns None when no candidate could be measured — the caller
+    winner. Returns None when no candidate could be measured — the caller
     supplies (and caches) its static fallback.
+
+    ``prior`` is an earlier truncated sweep's progress record
+    (_disk_lookup): its measured candidates are skipped, its (blocks, ms)
+    seeds the best-so-far, and the union of measured sets persists — so
+    under-budget sweeps advance through the grid instead of re-measuring
+    the same prefix, and the entry finalizes into a served vote once the
+    grid is exhausted. A still-incomplete sweep stores only the progress
+    record; the winner is served in-process but never from disk.
 
     Per-iteration timing is relay-distorted on tunneled backends
     (time_fn_chained docstring), and a mis-timed vote here would silently
@@ -235,8 +314,20 @@ def _measured_sweep(key, candidates, make_loss, example, *, length, spans,
     budget_s = _resolve_budget_s(budget_s)
     deadline = None if budget_s is None else time.monotonic() + budget_s
     best, best_ms = None, float("inf")
+    seen: set[tuple[int, int]] = set()
+    ok: set[tuple[int, int]] = set()
+    if prior:
+        seen = {tuple(c) for c in prior.get("measured", [])}
+        # Re-measure the prior best-so-far under THIS process's
+        # conditions rather than trusting its recorded ms (anchor
+        # ordering puts it first): one candidate re-paid per resumed
+        # sweep buys out the cross-condition comparison entirely.
+        seen.discard(_partial_anchor(prior))
+        ok = set(seen)
     truncated = False
     for cand in candidates:
+        if tuple(cand) in seen:
+            continue
         if deadline is not None and time.monotonic() > deadline:
             logger.warning("autotune budget (%.0fs) exhausted; best so far "
                            "wins", budget_s)
@@ -250,18 +341,23 @@ def _measured_sweep(key, candidates, make_loss, example, *, length, spans,
                                     spans=spans, with_grad=with_grad,
                                     min_span_ms=400.0)
         except Exception as e:  # candidate failed to compile/fit: skip it
+            # for THIS sweep only — a transient failure (OOM under a
+            # concurrent job, relay hiccup) persisted as "measured"
+            # would permanently exclude the tile on this device kind.
             logger.debug("autotune candidate %s failed: %s", cand, e)
+            seen.add(tuple(cand))
             continue
+        seen.add(tuple(cand))
+        ok.add(tuple(cand))
         logger.info("autotune %s: %.4f ms", cand, ms)
         if ms < best_ms:
             best, best_ms = tuple(cand), ms
     if best is not None:
-        if not truncated:
-            # A truncated sweep's winner is only best-of-a-partial-grid;
-            # keep it for this process but don't pin it on disk for every
-            # future process on this device kind — the next full sweep
-            # decides.
-            _store_disk_cache(key, best)
+        if truncated:
+            _store_partial(key, {"blocks": list(best), "ms": best_ms,
+                                 "measured": sorted(list(c) for c in ok)})
+        else:
+            _store_final(key, best)
         _CACHE[key] = best
     return best
 
@@ -316,11 +412,11 @@ def autotune_attention_blocks(
            jnp.dtype(dtype).str, jax.default_backend(), _device_kind())
     if key in _CACHE:
         return _CACHE[key]
-    on_disk = _load_disk_cache().get(_disk_key(key))
+    on_disk, partial = _disk_lookup(key)
     if on_disk is not None:
-        best = (int(on_disk[0]), int(on_disk[1]))
-        _CACHE[key] = best
-        return best
+        _CACHE[key] = on_disk
+        return on_disk
+    anchor = _partial_anchor(partial)
 
     kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
     shape = (1, l_q, batch_heads, head_dim)
@@ -347,9 +443,9 @@ def autotune_attention_blocks(
     best = _measured_sweep(
         key, _attention_candidates(l_q, l_kv, head_dim, itemsize,
                                    include_backward=include_backward,
-                                   near=fallback),
+                                   near=anchor or fallback),
         make_loss, q, length=length, spans=spans,
-        with_grad=include_backward, budget_s=budget_s)
+        with_grad=include_backward, budget_s=budget_s, prior=partial)
     if best is None:
         best = fallback
         _CACHE[key] = best

@@ -67,8 +67,13 @@ def test_sweep_picks_fastest_candidate_and_persists(sweep_env, monkeypatch):
     assert calls == [], "disk-cached winner was re-measured"
 
 
-def test_sweep_budget_truncation_not_persisted(sweep_env, monkeypatch):
-    def slow_timer(fn, z, length, spans, with_grad):
+def test_sweep_truncation_stores_progress_and_converges(sweep_env,
+                                                        monkeypatch):
+    import json
+
+    grid = list(_candidates(512, 512, 64, 4))
+
+    def slow_timer(fn, z, **kw):
         import time as _t
         _t.sleep(0.05)
         br, bc = fn.__defaults__
@@ -77,15 +82,45 @@ def test_sweep_budget_truncation_not_persisted(sweep_env, monkeypatch):
     monkeypatch.setattr(autotune, "time_fn_chained", slow_timer)
     # Budget only allows ~the first candidate: winner is best-of-partial.
     best = autotune_blocks(512, 512, 64, length=5, spans=1, budget_s=0.01)
-    assert best in list(_candidates(512, 512, 64, 4))
-    # A truncated sweep must NOT pin its partial winner on disk...
+    assert best in grid
+    # The truncated sweep stores a PROGRESS RECORD under the |partial
+    # twin key — never a servable vote under the sweep key itself (an
+    # old reader scanning served entries must only ever see lists).
+    disk = json.loads(autotune.cache_path().read_text())
+    partial_keys = [k for k in disk if k.endswith("|partial")]
+    assert partial_keys and not any(
+        isinstance(disk[k], dict) for k in disk if not k.endswith("|partial"))
+    rec = disk[partial_keys[0]]
+    assert tuple(rec["blocks"]) == best and rec["measured"]
+    n_measured = len(rec["measured"])
+
+    # A later call re-measures (the partial is not served) but SKIPS the
+    # already-measured candidates — sweeps partition the grid instead of
+    # re-walking the same prefix.
     _CACHE.clear()
     timed = []
     monkeypatch.setattr(
         autotune, "time_fn_chained",
-        lambda fn, z, **kw: (timed.append(fn.__defaults__) or (1.0, 0.0)))
-    autotune_blocks(512, 512, 64, length=5, spans=1, budget_s=None)
+        lambda fn, z, **kw: (timed.append(fn.__defaults__) or (9.0, 0.0)))
+    full = autotune_blocks(512, 512, 64, length=5, spans=1, budget_s=None)
     assert timed, "truncated winner was treated as authoritative"
+    # The anchor (prior best-so-far) is re-measured FIRST under this
+    # process's conditions — its recorded ms is never compared against
+    # fresh timings (the v2 cross-condition lesson) — and every other
+    # already-measured candidate is skipped.
+    assert tuple(timed[0]) == best
+    assert len(timed) == len(grid) - n_measured + 1
+    assert not any(tuple(t) in {tuple(c) for c in rec["measured"]}
+                   for t in timed[1:])
+    # Grid exhausted -> the entry finalizes into a served vote and the
+    # progress record is dropped; a fresh process hits the file.
+    disk = json.loads(autotune.cache_path().read_text())
+    assert not any(k.endswith("|partial") for k in disk)
+    _CACHE.clear()
+    monkeypatch.setattr(autotune, "_DISK_CACHE", None)
+    timed.clear()
+    again = autotune_blocks(512, 512, 64, length=5, spans=1, budget_s=None)
+    assert again == full and timed == []
 
 
 def test_sweep_all_candidates_fail_falls_back(sweep_env, monkeypatch):
