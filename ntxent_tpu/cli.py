@@ -166,8 +166,42 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="SECONDS",
                    help="failure detection: if no train step completes for "
                         "this long, dump all thread stacks (where is it "
-                        "stuck) and log the stall; the run itself is left "
-                        "alive (pair with external supervision to restart)")
+                        "stuck) and log the stall; with --max-restarts the "
+                        "supervisor escalates (stop at a step boundary, "
+                        "checkpoint, restart in-process), otherwise the "
+                        "run is left alive for external supervision")
+
+    r = p.add_argument_group("resilience (self-healing runs; "
+                             "ntxent_tpu/resilience/)")
+    r.add_argument("--max-restarts", type=int, default=0,
+                   help="supervise the run (resilience.Supervisor): on a "
+                        "crash, divergence rollback, SIGTERM, or stall, "
+                        "restart in-process from the newest VALID "
+                        "checkpoint (--ckpt-dir) up to N times with "
+                        "exponential backoff; 0 = single attempt")
+    r.add_argument("--nan-policy", default="off",
+                   choices=["off", "skip", "backoff", "rollback"],
+                   help="in-step divergence guard: 'skip' drops non-finite "
+                        "updates (params/opt-state untouched, step still "
+                        "advances); 'backoff' also halves the gradient "
+                        "scale on repeated skips; 'rollback' also aborts "
+                        "to the last valid checkpoint once the skip budget "
+                        "is spent (pair with --max-restarts); 'off' = "
+                        "unguarded fast path (no per-step host sync)")
+    r.add_argument("--no-ckpt-verify", action="store_true",
+                   help="skip per-save checkpoint CRC manifests (saves "
+                        "stay fully async; restore can no longer detect "
+                        "torn/corrupt checkpoints and fall back to a "
+                        "valid one)")
+    r.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="deterministic fault injection "
+                        "(resilience.FaultPlan), comma list of "
+                        "kind@ordinal: nan@K poisons the K-th batch, "
+                        "sigterm@K / crash@K fire at the K-th batch, "
+                        "fetch@N raises a transient error on the N-th "
+                        "source read, truncate@A corrupts the newest "
+                        "checkpoint after attempt A; implies supervision "
+                        "(uses --max-restarts attempts)")
 
     dist = p.add_argument_group("distributed (multi-host rendezvous; "
                                 "single-host multi-chip needs no flags)")
@@ -279,11 +313,41 @@ def _log_hybrid_zero(mesh):
                     mesh.shape["data"], mesh.shape["dcn"])
 
 
-def _make_pipeline(args, per_process_batch: int, sharding=None, mesh=None):
+def _make_injector(args):
+    """FaultInjector from --chaos, or None (parse errors fail loudly
+    before any backend work)."""
+    if not getattr(args, "chaos", None):
+        return None
+    from ntxent_tpu.resilience import FaultInjector, FaultPlan
+
+    try:
+        plan = FaultPlan.parse(args.chaos, seed=args.seed)
+    except ValueError as e:
+        raise SystemExit(f"--chaos: {e}")
+    logger.warning("chaos mode: %s", plan)
+    return FaultInjector(plan)
+
+
+def _make_step_guard(nan_policy: str):
+    """resilience.DivergenceGuard for --nan-policy (None for 'off')."""
+    if nan_policy == "off":
+        return None
+    from ntxent_tpu.resilience import DivergenceGuard
+
+    if nan_policy == "skip":
+        return DivergenceGuard(backoff_after=None, rollback_after=None)
+    if nan_policy == "backoff":
+        return DivergenceGuard(rollback_after=None)
+    return DivergenceGuard()  # rollback: every tier armed
+
+
+def _make_pipeline(args, per_process_batch: int, sharding=None, mesh=None,
+                   injector=None):
     import numpy as np
 
     import jax
 
+    from ntxent_tpu.resilience import RetryPolicy
     from ntxent_tpu.training.datasets import (
         ArraySource,
         Cifar10Source,
@@ -312,22 +376,34 @@ def _make_pipeline(args, per_process_batch: int, sharding=None, mesh=None):
             args.synthetic_samples, size, size, 3).astype(np.float32))
     # Multi-process: each process streams ITS slice of every global batch
     # (seeded identically, offset by process_id — the per-rank DataLoader).
+    # Fetches retry transient IO errors (resilience/retry.py); --chaos
+    # fetch@N faults inject against exactly this path.
+    fetch_retry = RetryPolicy(max_attempts=3, base_delay_s=0.1,
+                              max_delay_s=5.0, seed=args.seed)
     if args.loader == "native":
+        if injector is not None and injector.plan.fetch_calls:
+            logger.warning("--chaos fetch@N ignored: the native engine "
+                           "reads the mmap'd file directly (no per-item "
+                           "__getitem__ to inject into)")
         from ntxent_tpu.training.native_loader import NativeStreamingLoader
 
         try:
             loader = NativeStreamingLoader(
                 source, per_process_batch, seed=args.seed,
                 shard_index=jax.process_index(),
-                shard_count=jax.process_count())
+                shard_count=jax.process_count(),
+                retry_policy=fetch_retry)
         except (TypeError, ValueError, OSError, RuntimeError) as e:
             # not-a-memmap source AND native-build failures (no compiler,
             # cmake error) both land here: one clean exit, no traceback.
             raise SystemExit(f"--loader native: {e}")
     else:
+        if injector is not None:
+            source = injector.wrap_source(source)
         loader = StreamingLoader(source, per_process_batch, seed=args.seed,
                                  shard_index=jax.process_index(),
-                                 shard_count=jax.process_count())
+                                 shard_count=jax.process_count(),
+                                 retry_policy=fetch_retry)
     key = jax.random.PRNGKey(args.seed + 1)
     if mesh is not None and jax.process_count() > 1:
         # Global assembly before augmentation: only raw bytes cross the
@@ -365,6 +441,8 @@ def main(argv=None) -> int:
             f"{info['global_device_count']} devices")
     per_process_batch = args.batch // info["process_count"]
 
+    injector = _make_injector(args)
+
     if args.objective == "clip":
         # image_size stays None here: the clip branch derives it from the
         # paired data, and a conflicting EXPLICIT flag must fail loudly.
@@ -383,7 +461,8 @@ def main(argv=None) -> int:
             logger.warning("--tp-loss-axes %s ignored: only "
                            "--clip-parallel tp runs shard the loss over "
                            "the model axis", args.tp_loss_axes)
-        return _train_clip(args, info, per_process_batch)
+        return _train_clip(args, info, per_process_batch,
+                           injector=injector)
     if args.dataset == "npy":
         # No resize path exists for the raw row store: the model MUST be
         # built at the store's native resolution.
@@ -418,9 +497,19 @@ def main(argv=None) -> int:
         base_lr=args.base_lr, weight_decay=args.weight_decay,
         warmup_steps=args.warmup_steps, total_steps=args.steps,
         accum_steps=args.accum_steps)
-    state = create_train_state(
-        model, jax.random.PRNGKey(args.seed),
-        (1, args.image_size, args.image_size, 3), cfg)
+
+    def base_state():
+        return create_train_state(
+            model, jax.random.PRNGKey(args.seed),
+            (1, args.image_size, args.image_size, 3), cfg)
+
+    state = base_state()
+    # Per-branch state placement, captured so a supervised restart can
+    # rebuild a FRESH template (a crashed attempt's donated buffers must
+    # not be reused as a restore template; resilience/supervisor.py).
+    prepare_state = lambda s: s  # noqa: E731
+    nan_policy = args.nan_policy
+    guard_steps = nan_policy != "off"
 
     n_dev = info["global_device_count"]
     if args.tp_loss_axes != "data" and not (n_dev > 1
@@ -460,17 +549,23 @@ def main(argv=None) -> int:
                                   args.model_par),
                            axis_names=("data", "model"))
         has_bs = bool(jax.tree_util.tree_leaves(state.batch_stats))
+        if guard_steps:
+            logger.warning("--nan-policy %s ignored: the GSPMD TP step "
+                           "carries no in-step divergence guard yet; use "
+                           "--parallel dp for guarded runs", nan_policy)
+            nan_policy, guard_steps = "off", False
         if args.fsdp:
-            state = shard_train_state_tp_fsdp(state, mesh)
+            prepare_state = lambda s: shard_train_state_tp_fsdp(s, mesh)  # noqa: E731,E501
             spec_fn = tp_fsdp_spec_fn(mesh)
             logger.info("SimCLR GSPMD Megatron + ZeRO-3 on the (%d, %d) "
                         "(data, model) mesh",
                         n_dev // args.model_par, args.model_par)
         else:
-            state = shard_train_state(state, mesh)
+            prepare_state = lambda s: shard_train_state(s, mesh)  # noqa: E731,E501
             spec_fn = None
             logger.info("SimCLR GSPMD (%d, %d) (data, model) mesh",
                         n_dev // args.model_par, args.model_par)
+        state = prepare_state(state)
         # --dp-loss strip/pair is honored under TP too (round 5: the TP
         # step embeds the fused shard_map bodies over 'data', or over
         # both mesh axes with --tp-loss-axes both).
@@ -484,7 +579,7 @@ def main(argv=None) -> int:
                                          param_spec_fn=spec_fn)
         data = _make_pipeline(args, per_process_batch,
                               sharding=NamedSharding(mesh, P("data")),
-                              mesh=mesh)
+                              mesh=mesh, injector=injector)
     elif n_dev > 1 and args.fsdp:
         from ntxent_tpu.parallel import (
             make_fsdp_train_step,
@@ -494,6 +589,11 @@ def main(argv=None) -> int:
 
         mesh = _data_mesh(args, fsdp=True)
         has_bs = bool(jax.tree_util.tree_leaves(state.batch_stats))
+        if guard_steps:
+            logger.warning("--nan-policy %s ignored: the FSDP step "
+                           "carries no in-step divergence guard yet; "
+                           "drop --fsdp for guarded runs", nan_policy)
+            nan_policy, guard_steps = "off", False
         # The fused shard_map NT-Xent runs INSIDE the GSPMD step, so
         # --dp-loss strip/pair is honored under FSDP (round 4; the
         # pre-round-4 oracle loss remains as loss_impl="oracle").
@@ -502,11 +602,12 @@ def main(argv=None) -> int:
                                     has_batch_stats=has_bs,
                                     loss_impl=args.dp_loss,
                                     moe_aux_weight=moe_aux)
-        state = shard_train_state_fsdp(state, mesh)
+        prepare_state = lambda s: shard_train_state_fsdp(s, mesh)  # noqa: E731,E501
+        state = prepare_state(state)
         data = _make_pipeline(args, per_process_batch,
                               sharding=data_sharding(
                                   mesh, tuple(mesh.axis_names)),
-                              mesh=mesh)
+                              mesh=mesh, injector=injector)
         _log_hybrid_zero(mesh)
         logger.info("FSDP (ZeRO-3, %s loss) over %d devices "
                     "(%d process(es))",
@@ -518,16 +619,19 @@ def main(argv=None) -> int:
         step = make_sharded_train_step(mesh, cfg.temperature,
                                        remat=args.remat,
                                        loss_impl=args.dp_loss,
-                                       moe_aux_weight=moe_aux)
+                                       moe_aux_weight=moe_aux,
+                                       guard=guard_steps)
         # Commit params/opt-state replicated on the mesh BEFORE fit's
         # checkpoint restore: a fresh template restores committed to one
         # device and the sharded step then rejects the device mismatch.
-        state = replicate_state(state, mesh)
+        prepare_state = lambda s: replicate_state(s, mesh)  # noqa: E731
+        state = prepare_state(state)
         # Batches arrive already sharded over the mesh: single-process via
         # sharded device_put + sharded augmentation, multi-process via
         # GlobalTwoViewPipeline's uint8 global assembly.
         data = _make_pipeline(args, per_process_batch,
-                              sharding=data_sharding(mesh), mesh=mesh)
+                              sharding=data_sharding(mesh), mesh=mesh,
+                              injector=injector)
         logger.info("data-parallel over %d devices (%d process(es))",
                     n_dev, info["process_count"])
     else:
@@ -541,39 +645,99 @@ def main(argv=None) -> int:
             logger.warning("--dp-loss %s ignored: single-device run has "
                            "no shard-pair schedule", args.dp_loss)
         step = make_train_step(cfg.temperature, remat=args.remat,
-                               moe_aux_weight=moe_aux)
-        data = _make_pipeline(args, per_process_batch)
+                               moe_aux_weight=moe_aux, guard=guard_steps)
+        data = _make_pipeline(args, per_process_batch, injector=injector)
         logger.info("single-device run")
 
-    return _run_fit(data, state, step, args)
+    return _run_fit(data, state, step, args,
+                    state_factory=lambda: prepare_state(base_state()),
+                    step_guard=_make_step_guard(nan_policy),
+                    injector=injector)
 
 
-def _run_fit(data, state, step, args) -> int:
-    """Shared training epilogue: preemption-guarded fit + final report
-    (one copy for both objectives, so the resume hint and MFU line cannot
-    drift)."""
-    import contextlib
-
-    from ntxent_tpu.training import PreemptionGuard, fit
-    from ntxent_tpu.utils import StallWatchdog
-
-    watchdog = (StallWatchdog(timeout_s=args.stall_timeout)
-                if getattr(args, "stall_timeout", None) else None)
-    with PreemptionGuard() as guard, (watchdog or contextlib.nullcontext()):
-        state, history = fit(
-            state, data, step, num_steps=args.steps,
-            checkpoint_dir=args.ckpt_dir, checkpoint_every=args.ckpt_every,
-            log_every=args.log_every, stop_fn=guard.requested,
-            watchdog=watchdog)
+def _log_final(history) -> None:
     if history:
         last = history[-1]
         logger.info("final: step %d loss %.4f (%.2f steps/s%s)",
                     last["step"], last["loss"], last["steps_per_sec"],
                     f", MFU {last['mfu']:.1%}" if "mfu" in last else "")
-    if guard.preempted:
-        logger.warning("run was preempted; checkpoint saved at step %d — "
-                       "relaunch with the same flags to resume",
-                       int(state.step))
+
+
+def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
+             injector=None) -> int:
+    """Shared training epilogue for both objectives.
+
+    Unsupervised (default): one preemption-guarded ``fit`` — SIGTERM means
+    checkpoint-and-exit for an external relauncher. With --max-restarts or
+    --chaos: ``resilience.Supervisor`` runs attempts of the same ``fit``
+    and restarts in-process from the newest valid checkpoint on any
+    detected fault (crash, divergence rollback, SIGTERM, stall).
+    """
+    import contextlib
+
+    from ntxent_tpu.resilience import RetryPolicy
+    from ntxent_tpu.training import PreemptionGuard, fit
+    from ntxent_tpu.utils import StallWatchdog
+
+    ckpt_kwargs = dict(
+        checkpoint_verify_writes=not getattr(args, "no_ckpt_verify", False),
+        checkpoint_retry_policy=RetryPolicy(
+            max_attempts=3, base_delay_s=0.5, max_delay_s=10.0,
+            seed=args.seed))
+    max_restarts = getattr(args, "max_restarts", 0)
+    if max_restarts <= 0 and injector is None:
+        watchdog = (StallWatchdog(timeout_s=args.stall_timeout)
+                    if getattr(args, "stall_timeout", None) else None)
+        with PreemptionGuard() as guard, \
+                (watchdog or contextlib.nullcontext()):
+            state, history = fit(
+                state, data, step, num_steps=args.steps,
+                checkpoint_dir=args.ckpt_dir,
+                checkpoint_every=args.ckpt_every,
+                log_every=args.log_every, stop_fn=guard.requested,
+                watchdog=watchdog, step_guard=step_guard, **ckpt_kwargs)
+        _log_final(history)
+        if guard.preempted:
+            logger.warning("run was preempted; checkpoint saved at step "
+                           "%d — relaunch with the same flags to resume",
+                           int(state.step))
+        return 0
+
+    from ntxent_tpu.resilience import Supervisor
+
+    if args.ckpt_dir is None:
+        logger.warning("supervised run without --ckpt-dir: every restart "
+                       "begins again from step 0 (no checkpoint to "
+                       "resume from)")
+    if injector is not None:
+        data = injector.wrap_iterator(data)
+    first_state = state
+
+    def run_attempt(attempt, stop_fn, watchdog):
+        s = first_state if attempt == 0 or state_factory is None \
+            else state_factory()
+        if step_guard is not None:
+            step_guard.reset_attempt()
+        return fit(s, data, step, num_steps=args.steps,
+                   checkpoint_dir=args.ckpt_dir,
+                   checkpoint_every=args.ckpt_every,
+                   log_every=args.log_every, stop_fn=stop_fn,
+                   watchdog=watchdog, step_guard=step_guard,
+                   **ckpt_kwargs)
+
+    supervisor = Supervisor(
+        run_attempt, num_steps=args.steps, checkpoint_dir=args.ckpt_dir,
+        max_restarts=max_restarts,
+        stall_timeout_s=getattr(args, "stall_timeout", None),
+        injector=injector)
+    result = supervisor.run()
+    _log_final(result.histories[-1] if result.histories else [])
+    if injector is not None and injector.fired:
+        logger.info("chaos faults fired: %s", ", ".join(injector.fired))
+    if not result.completed:
+        logger.error("supervised run did NOT reach step %d (restart "
+                     "budget exhausted)", args.steps)
+        return 1
     return 0
 
 
@@ -607,7 +771,7 @@ def _build_clip_model(args):
                      embed_dim=embed_dim)
 
 
-def _train_clip(args, info, per_process_batch: int) -> int:
+def _train_clip(args, info, per_process_batch: int, injector=None) -> int:
     """CLIP pretraining branch: dual encoder + symmetric InfoNCE.
 
     The BASELINE.json configs[4] workload (text-image contrastive,
@@ -683,18 +847,27 @@ def _train_clip(args, info, per_process_batch: int) -> int:
                                shard_index=info["process_index"],
                                shard_count=info["process_count"])
 
-    variables = model.init(jax.random.PRNGKey(args.seed),
-                           np.zeros((1, args.image_size, args.image_size, 3),
-                                    np.float32),
-                           np.zeros((1, args.token_len), np.int32),
-                           train=False)
+    if args.nan_policy != "off":
+        logger.warning("--nan-policy %s ignored: the CLIP steps carry no "
+                       "in-step divergence guard yet", args.nan_policy)
+
     schedule = cosine_warmup_schedule(args.base_lr, args.warmup_steps,
                                       args.steps)
     tx = optax.adamw(schedule, weight_decay=args.weight_decay)
     if args.accum_steps > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=args.accum_steps)
-    state = TrainState.create(apply_fn=model.apply,
-                              params=variables["params"], tx=tx)
+
+    def base_state():
+        variables = model.init(
+            jax.random.PRNGKey(args.seed),
+            np.zeros((1, args.image_size, args.image_size, 3), np.float32),
+            np.zeros((1, args.token_len), np.int32),
+            train=False)
+        return TrainState.create(apply_fn=model.apply,
+                                 params=variables["params"], tx=tx)
+
+    state = base_state()
+    prepare_state = lambda s: s  # noqa: E731  (see main(): restarts)
 
     n_dev = info["global_device_count"]
     mesh = sharding = None
@@ -726,13 +899,15 @@ def _train_clip(args, info, per_process_batch: int) -> int:
                 from ntxent_tpu.parallel import shard_train_state_tp_fsdp
                 from ntxent_tpu.parallel.tp import tp_fsdp_spec_fn
 
-                state = shard_train_state_tp_fsdp(state, mesh)
+                prepare_state = lambda s: shard_train_state_tp_fsdp(s, mesh)  # noqa: E731,E501
+                state = prepare_state(state)
                 spec_fn = tp_fsdp_spec_fn(mesh)
                 logger.info("CLIP GSPMD Megatron + ZeRO-3 on the "
                             "(%d, %d) (data, model) mesh",
                             n_dev // args.model_par, args.model_par)
             else:
-                state = shard_train_state(state, mesh)
+                prepare_state = lambda s: shard_train_state(s, mesh)  # noqa: E731,E501
+                state = prepare_state(state)
                 spec_fn = None
                 logger.info("CLIP GSPMD (%d, %d) (data, model) mesh",
                             n_dev // args.model_par, args.model_par)
@@ -751,7 +926,8 @@ def _train_clip(args, info, per_process_batch: int) -> int:
             mesh = _data_mesh(args, fsdp=True)
             step = make_fsdp_clip_train_step(mesh, remat=args.remat,
                                              moe_aux_weight=moe_aux)
-            state = shard_train_state_fsdp(state, mesh)
+            prepare_state = lambda s: shard_train_state_fsdp(s, mesh)  # noqa: E731,E501
+            state = prepare_state(state)
             _log_hybrid_zero(mesh)
             logger.info("CLIP FSDP (ZeRO-3, dual loss) over %d devices",
                         n_dev)
@@ -768,7 +944,8 @@ def _train_clip(args, info, per_process_batch: int) -> int:
             # Same rationale as the SimCLR mesh path: restore must land
             # replicated on the mesh, not committed to one device.
             from ntxent_tpu.parallel.mesh import replicate_state
-            state = replicate_state(state, mesh)
+            prepare_state = lambda s: replicate_state(s, mesh)  # noqa: E731
+            state = prepare_state(state)
             logger.info("CLIP shard_map data-parallel over %d devices "
                         "(fused partial InfoNCE)", n_dev)
             sharding = NamedSharding(mesh, P("data"))
@@ -811,7 +988,9 @@ def _train_clip(args, info, per_process_batch: int) -> int:
                 imgs = _normalize(imgs)
             return imgs, toks
 
-    return _run_fit(ClipBatches(), state, step, args)
+    return _run_fit(ClipBatches(), state, step, args,
+                    state_factory=lambda: prepare_state(base_state()),
+                    injector=injector)
 
 
 def build_eval_parser() -> argparse.ArgumentParser:

@@ -32,6 +32,7 @@ from ..ops.infonce_pallas import (
 )
 from ..ops.ntxent_pallas import ntxent_partial_fused
 from .mesh import local_row_gids
+from .mesh import shard_map as _shard_map_compat
 
 __all__ = ["ntxent_loss_distributed", "make_sharded_ntxent",
            "local_ntxent_allgather", "resolve_local_ntxent",
@@ -120,7 +121,7 @@ def make_sharded_ntxent(
     )
     # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
     # annotation, so JAX's vma checker cannot see through the kernel.
-    return jax.shard_map(
+    return _shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(P(axes), P(axes)),
@@ -224,7 +225,7 @@ def make_sharded_infonce(
     def body(za_local, zb_local, scale):
         return local(za_local, zb_local, scale, body_axis, interpret)
 
-    return jax.shard_map(
+    return _shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(P(axes), P(axes), P()),

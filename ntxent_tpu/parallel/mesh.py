@@ -33,7 +33,28 @@ __all__ = [
     "global_batch",
     "local_row_gids",
     "process_info",
+    "shard_map",
 ]
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions (robustness shim).
+
+    jax promoted shard_map to the top level (with ``check_rep`` renamed
+    ``check_vma``) only recently; on older installs the same transform
+    lives at ``jax.experimental.shard_map.shard_map``. Every shard_map in
+    this package routes through here so the whole distributed layer —
+    losses, TP/FSDP/PP steps, ring attention, MoE — degrades to the
+    experimental spelling instead of dying with an AttributeError on the
+    jax the host happens to ship.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
 
 
 def init_distributed(

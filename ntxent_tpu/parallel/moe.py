@@ -44,6 +44,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from .mesh import shard_map as _shard_map_compat
 
 __all__ = ["MoEParams", "init_moe_params", "switch_moe",
            "make_expert_parallel_moe", "MoEMlp", "moe_aux_from"]
@@ -204,7 +205,7 @@ def make_expert_parallel_moe(mesh: Mesh, *, axis: str = "expert",
         return switch_moe(params, x, capacity_factor=capacity_factor,
                           axis=axis)
 
-    return jax.shard_map(
+    return _shard_map_compat(
         body, mesh=mesh, in_specs=(P(), P(tok)),
         out_specs=(P(tok), P()), check_vma=False)
 

@@ -43,6 +43,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.ntxent_pallas import block_grads_dual, block_lse_dual
 from .mesh import local_row_gids
+from .mesh import shard_map as _shard_map_compat
 
 __all__ = ["make_pair_ntxent", "ntxent_loss_pair", "pair_body"]
 
@@ -200,7 +201,7 @@ def make_pair_ntxent(
         num_devices=mesh.shape[axis],
         interpret=interpret,
     )
-    return jax.shard_map(
+    return _shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(P(axis), P(axis)),

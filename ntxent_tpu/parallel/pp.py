@@ -35,6 +35,7 @@ from typing import Any, Callable, Sequence
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from .mesh import shard_map as _shard_map_compat
 
 __all__ = [
     "stack_stage_params",
@@ -153,6 +154,6 @@ def make_gpipe(
         return outs.reshape(batch, *x.shape[1:])
 
     xspec = P(data_axis) if data_axis else P()
-    return jax.shard_map(
+    return _shard_map_compat(
         body, mesh=mesh, in_specs=(P(axis), xspec), out_specs=xspec,
         check_vma=False)

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops.infonce_pallas import resolve_scale
 from ..ops.ntxent_pallas import _exp0, _log_l
 from .mesh import local_row_gids
+from .mesh import shard_map as _shard_map_compat
 
 __all__ = ["ntxent_loss_ring", "make_ring_ntxent",
            "info_nce_loss_ring", "make_ring_infonce"]
@@ -220,16 +221,17 @@ def make_ring_ntxent(mesh: Mesh, temperature: float = 0.07,
         # check_vma=False: pallas_call's out_shape carries no varying-mesh-
         # axes annotation, which check_vma=True rejects inside shard_map —
         # same constraint (and comment) as dist_loss.py's pallas bodies.
-        return jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis)),
-                             out_specs=P(), check_vma=False)
+        return _shard_map_compat(body, mesh=mesh,
+                                 in_specs=(P(axis), P(axis)),
+                                 out_specs=P(), check_vma=False)
     body = functools.partial(
         _ring_body,
         temperature=float(temperature),
         axis=axis,
         num_devices=mesh.shape[axis],
     )
-    return jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis)),
-                         out_specs=P())
+    return _shard_map_compat(body, mesh=mesh, in_specs=(P(axis), P(axis)),
+                             out_specs=P())
 
 
 def ntxent_loss_ring(
@@ -370,8 +372,9 @@ def make_ring_infonce(mesh: Mesh, axis: str = "data", impl: str = "dual"):
     body = functools.partial(
         _infonce_ring_dual_body if impl == "dual" else _infonce_ring_body,
         axis=axis, num_devices=mesh.shape[axis])
-    return jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis), P()),
-                         out_specs=P())
+    return _shard_map_compat(body, mesh=mesh,
+                             in_specs=(P(axis), P(axis), P()),
+                             out_specs=P())
 
 
 def info_nce_loss_ring(

@@ -51,6 +51,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops.attention_pallas import resolve_attention_scale as _resolve_scale
 from ..ops.attention_pallas import _flat, _unflat
 from ..ops.ntxent_pallas import _exp0, _log_l
+from .mesh import shard_map as _shard_map_compat
 
 __all__ = [
     "attention_oracle",
@@ -386,7 +387,7 @@ def make_ring_attention(mesh: Mesh, axis: str = "data", *,
                                          causal, sc, block_q, block_kv)
         return _ring_attention(q, k, v, axis, num_devices, causal, sc)
 
-    return jax.shard_map(
+    return _shard_map_compat(
         body, mesh=mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis),
@@ -435,7 +436,7 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "data", *,
         return jax.lax.all_to_all(oh, axis, split_axis=1, concat_axis=2,
                                   tiled=True)
 
-    return jax.shard_map(
+    return _shard_map_compat(
         body, mesh=mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis),

@@ -1,0 +1,70 @@
+"""Fault tolerance for long training runs: detect, retry, skip, restart.
+
+The reference's failure model was throw-on-CUDA-error and ``exit(1)``
+(SURVEY.md §5.3). A production run dies first from transient faults —
+preempted hosts, flaky IO, NaN-ed batches, torn checkpoint writes — so
+this package composes the framework's detectors into recovery tiers:
+
+======================  =========================  ========================
+fault                   detector                   recovery
+======================  =========================  ========================
+transient IO error      exception filter           RetryPolicy backoff
+                        (retry.py)                 (loader fetch, orbax
+                                                   save/restore)
+NaN/Inf loss or grads   in-step isfinite guard     skip batch → loss-scale
+                        (trainer guard=True)       backoff → rollback
+                                                   (guard.DivergenceGuard)
+SIGTERM / preemption    PreemptionGuard            checkpoint at the step
+                                                   boundary; Supervisor
+                                                   restarts in-process
+hung step / collective  StallWatchdog              stack dumps + one-shot
+                        (utils/watchdog.py)        escalation: stop attempt,
+                                                   restart
+corrupt checkpoint      per-save CRC manifest      restore falls back to the
+                        (training/checkpoint.py)   newest VALID step
+======================  =========================  ========================
+
+Every tier is driven end-to-end by the deterministic fault-injection
+harness in ``faults.py`` (tests/test_resilience.py, scripts/chaos_smoke.sh,
+``ntxent-train --chaos``).
+"""
+
+from ntxent_tpu.resilience.faults import (
+    ChaosError,
+    FaultInjector,
+    FaultPlan,
+    truncate_checkpoint_file,
+)
+from ntxent_tpu.resilience.guard import DivergenceError, DivergenceGuard
+from ntxent_tpu.resilience.retry import (
+    DEFAULT_TRANSIENT,
+    RetryBudgetExceeded,
+    RetryPolicy,
+)
+
+__all__ = [
+    "ChaosError",
+    "FaultInjector",
+    "FaultPlan",
+    "truncate_checkpoint_file",
+    "DivergenceError",
+    "DivergenceGuard",
+    "DEFAULT_TRANSIENT",
+    "RetryBudgetExceeded",
+    "RetryPolicy",
+    "AttemptRecord",
+    "Supervisor",
+    "SupervisorResult",
+]
+
+
+def __getattr__(name):
+    # Supervisor lazily: it imports the training package (PreemptionGuard)
+    # whose checkpoint manager pulls orbax, and orbax import initializes
+    # the JAX backends — `import ntxent_tpu.resilience` for a RetryPolicy
+    # must not pay (or pin) backend discovery.
+    if name in ("Supervisor", "SupervisorResult", "AttemptRecord"):
+        from ntxent_tpu.resilience import supervisor as _supervisor
+
+        return getattr(_supervisor, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

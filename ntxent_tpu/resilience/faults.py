@@ -1,0 +1,240 @@
+"""Deterministic fault injection: the chaos half of the resilience layer.
+
+A recovery path that has never executed is a liability, not a feature — so
+every recovery tier in this package (retry, divergence skip, checkpoint
+fallback, supervisor restart) has a matching *injectable* fault here, and
+``tests/test_resilience.py`` + ``scripts/chaos_smoke.sh`` drive them
+end-to-end on CPU. The same plans run in production shape via
+``ntxent-train --chaos 'nan@3,sigterm@6,truncate@1'``.
+
+Primitives (each fires exactly once per plan entry, at a deterministic
+ordinal — no randomness in WHAT happens, only the seed field for future
+schedule randomization):
+
+* ``nan@k``      — NaN-poison the k-th batch served (float leaves only)
+                   → exercises the step divergence guard (guard.py);
+* ``sigterm@k``  — deliver SIGTERM to this process while serving the k-th
+                   batch → exercises PreemptionGuard save-and-stop plus the
+                   supervisor's resume-at-k restart;
+* ``crash@k``    — raise ``ChaosError`` while serving the k-th batch
+                   → exercises the supervisor's exception-restart path;
+* ``fetch@n``    — raise a transient ``OSError`` on the n-th source fetch
+                   → exercises the loader's RetryPolicy (retry.py);
+* ``truncate@a`` — after attempt number a ends, truncate the newest
+                   checkpoint's largest file → exercises checksum
+                   verification and newest-VALID fallback (checkpoint.py).
+
+``FaultPlan`` is the parsed, immutable spec; ``FaultInjector`` carries the
+runtime counters and the wrapping hooks call sites use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+from pathlib import Path
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ChaosError", "FaultPlan", "FaultInjector",
+           "truncate_checkpoint_file"]
+
+_KINDS = ("nan", "sigterm", "crash", "fetch", "truncate")
+
+
+class ChaosError(RuntimeError):
+    """An injected hard failure (the ``crash@k`` primitive)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Immutable, seeded chaos plan. Ordinals are 1-based."""
+
+    nan_batches: tuple[int, ...] = ()
+    sigterm_batches: tuple[int, ...] = ()
+    crash_batches: tuple[int, ...] = ()
+    fetch_calls: tuple[int, ...] = ()
+    truncate_attempts: tuple[int, ...] = ()
+    seed: int = 0
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        """Parse ``"nan@3,sigterm@6,truncate@1"`` (the --chaos syntax)."""
+        buckets: dict[str, list[int]] = {k: [] for k in _KINDS}
+        for item in filter(None, (s.strip() for s in spec.split(","))):
+            kind, sep, at = item.partition("@")
+            if not sep or kind not in buckets:
+                raise ValueError(
+                    f"bad fault {item!r}: expected one of "
+                    f"{'|'.join(_KINDS)}@<ordinal>, e.g. 'nan@3'")
+            try:
+                ordinal = int(at)
+            except ValueError:
+                raise ValueError(f"bad fault ordinal in {item!r}") from None
+            if ordinal < 1:
+                raise ValueError(f"fault ordinal must be >= 1: {item!r}")
+            buckets[kind].append(ordinal)
+        return cls(nan_batches=tuple(buckets["nan"]),
+                   sigterm_batches=tuple(buckets["sigterm"]),
+                   crash_batches=tuple(buckets["crash"]),
+                   fetch_calls=tuple(buckets["fetch"]),
+                   truncate_attempts=tuple(buckets["truncate"]),
+                   seed=seed)
+
+    def empty(self) -> bool:
+        return not (self.nan_batches or self.sigterm_batches
+                    or self.crash_batches or self.fetch_calls
+                    or self.truncate_attempts)
+
+
+def _poison_leaf(x):
+    """NaN-fill float leaves; leave integer leaves (e.g. CLIP tokens)
+    alone — an integer array has no NaN and the guard watches the loss."""
+    import jax.numpy as jnp
+
+    if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+        return jnp.full_like(x, jnp.nan)
+    return x
+
+
+def truncate_checkpoint_file(directory: str | os.PathLike,
+                             step: int | None = None) -> Path | None:
+    """Truncate the largest file of a checkpoint step dir to half its size
+    (simulating a partial write / torn page). ``step=None`` → newest step.
+    Returns the truncated path, or None when there was nothing to corrupt.
+    """
+    root = Path(directory)
+    if not root.is_dir():
+        return None
+    steps = sorted((int(p.name), p) for p in root.iterdir()
+                   if p.is_dir() and p.name.isdigit())
+    if not steps:
+        return None
+    if step is None:
+        step_dir = steps[-1][1]
+    else:
+        match = [p for s, p in steps if s == step]
+        if not match:
+            return None
+        step_dir = match[0]
+    files = sorted((p for p in step_dir.rglob("*") if p.is_file()),
+                   key=lambda p: p.stat().st_size)
+    if not files or files[-1].stat().st_size == 0:
+        return None
+    victim = files[-1]
+    size = victim.stat().st_size
+    with open(victim, "r+b") as f:
+        f.truncate(size // 2)
+    logger.warning("chaos: truncated %s from %d to %d bytes",
+                   victim, size, size // 2)
+    return victim
+
+
+class FaultInjector:
+    """Runtime counters + wrapping hooks for a ``FaultPlan``.
+
+    One injector per supervised run: batch/fetch/attempt ordinals count
+    across restarts (a resumed attempt continues the sequence), so a plan
+    is a deterministic script for the whole run.
+    """
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._batches = 0
+        self._fetches = 0
+        self._attempts = 0
+        self.fired: list[str] = []
+
+    # -- batch-path faults (wrap the training data iterator) -------------
+    def wrap_iterator(self, data_iter):
+        """Chaos-wrap a batch iterator, preserving the checkpointable
+        ``state()``/``restore()`` protocol when the inner iterator has it
+        (trainer.fit keys on those attributes)."""
+        if hasattr(data_iter, "state") and hasattr(data_iter, "restore"):
+            return _ChaosBatchesStateful(data_iter, self)
+        return _ChaosBatches(data_iter, self)
+
+    def on_batch(self, batch):
+        """Apply due batch faults; returns the (possibly poisoned) batch."""
+        self._batches += 1
+        n = self._batches
+        if n in self.plan.nan_batches:
+            import jax
+
+            logger.warning("chaos: NaN-poisoning batch %d", n)
+            self.fired.append(f"nan@{n}")
+            batch = jax.tree.map(_poison_leaf, batch)
+        if n in self.plan.sigterm_batches:
+            logger.warning("chaos: delivering SIGTERM at batch %d", n)
+            self.fired.append(f"sigterm@{n}")
+            os.kill(os.getpid(), signal.SIGTERM)
+        if n in self.plan.crash_batches:
+            self.fired.append(f"crash@{n}")
+            raise ChaosError(f"chaos: injected crash at batch {n}")
+        return batch
+
+    # -- fetch-path faults (wrap a random-access source) ------------------
+    def wrap_source(self, source):
+        """A source whose n-th ``__getitem__`` raises a transient OSError
+        when the plan says so (StreamingLoader's RetryPolicy target)."""
+        return _FlakySource(source, self)
+
+    def on_fetch(self):
+        self._fetches += 1
+        if self._fetches in self.plan.fetch_calls:
+            self.fired.append(f"fetch@{self._fetches}")
+            raise OSError(
+                f"chaos: injected transient fetch failure "
+                f"(call {self._fetches})")
+
+    # -- checkpoint faults (supervisor calls between attempts) ------------
+    def between_attempts(self, checkpoint_dir: str | os.PathLike | None):
+        self._attempts += 1
+        if self._attempts in self.plan.truncate_attempts \
+                and checkpoint_dir is not None:
+            victim = truncate_checkpoint_file(checkpoint_dir)
+            if victim is not None:
+                self.fired.append(f"truncate@{self._attempts}")
+
+
+class _ChaosBatches:
+    def __init__(self, inner, injector: FaultInjector):
+        self._inner = inner
+        self._injector = injector
+        self._it = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._it is None:
+            self._it = iter(self._inner)
+        return self._injector.on_batch(next(self._it))
+
+
+class _ChaosBatchesStateful(_ChaosBatches):
+    def state(self) -> dict:
+        return self._inner.state()
+
+    def restore(self, state: dict) -> None:
+        self._inner.restore(state)
+        self._it = None  # re-enter the (repositioned) inner iterator
+
+
+class _FlakySource:
+    """Source wrapper raising planned transient fetch errors."""
+
+    def __init__(self, inner, injector: FaultInjector):
+        self._inner = inner
+        self._injector = injector
+
+    def __len__(self) -> int:
+        return len(self._inner)
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        self._injector.on_fetch()
+        return self._inner[idx]

@@ -1,0 +1,134 @@
+"""Host-side divergence policy: skip → loss-scale backoff → rollback.
+
+The jit-side half lives in training/trainer.py: a step built with
+``make_train_step(guard=True)`` (or the sharded equivalent) computes a
+cheap ``jnp.isfinite`` reduction over the loss and global grad norm INSIDE
+the compiled step and, when either is non-finite, applies no update —
+params, optimizer state, and BatchNorm stats keep their pre-step values
+while ``state.step`` still advances (the counter stays monotone for
+checkpoint cadence and supervisor accounting). The step reports
+``metrics["grad_norm"]`` and ``metrics["step_ok"]`` and accepts a trailing
+``scale`` operand that multiplies the gradients (traced, so changing it
+costs no recompile).
+
+This module is the HOST half: ``DivergenceGuard`` consumes the per-step
+``StepOutcome`` (trainer.train_loop feeds it via its ``step_guard`` hook)
+and escalates through three tiers:
+
+1. **skip** — a non-finite step was already dropped by the jitted guard;
+   count it (one bad augmentation draw or data page should not kill a
+   multi-day run).
+2. **loss-scale backoff** — ``backoff_after`` CONSECUTIVE skips halve the
+   gradient scale (``backoff_factor``); after ``regrow_after`` consecutive
+   healthy steps the scale doubles back toward 1.0. This is the classic
+   dynamic-loss-scale move, repurposed: persistent near-divergence usually
+   means the effective LR is momentarily too hot.
+3. **rollback** — ``rollback_after`` TOTAL skips (or the scale collapsing
+   below ``min_scale``) raises ``DivergenceError``: the in-memory state is
+   presumed poisoned beyond local repair, and the supervisor
+   (resilience/supervisor.py) restarts the attempt from the newest VALID
+   checkpoint (training/checkpoint.py verifies content checksums).
+
+Either escalation tier can be disabled by passing ``None`` for its
+threshold (the CLI's ``--nan-policy skip|backoff|rollback`` maps to
+exactly that).
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DivergenceError", "DivergenceGuard"]
+
+
+class DivergenceError(RuntimeError):
+    """Raised by DivergenceGuard when local recovery (skip/backoff) is
+    exhausted; the supervisor's rollback tier catches it."""
+
+
+class DivergenceGuard:
+    """Callable step-guard for ``train_loop(step_guard=...)``.
+
+    Receives a ``trainer.StepOutcome`` per step; raises ``DivergenceError``
+    to demand a rollback. Exposes ``scale_value()`` — the gradient scale
+    the loop passes to guarded steps (jnp scalar: updating it never
+    retraces the step).
+    """
+
+    def __init__(self, backoff_after: int | None = 2,
+                 rollback_after: int | None = 8,
+                 backoff_factor: float = 0.5,
+                 regrow_after: int = 100,
+                 min_scale: float = 2.0 ** -10,
+                 init_scale: float = 1.0):
+        if backoff_after is not None and backoff_after < 1:
+            raise ValueError("backoff_after must be >= 1 or None")
+        if rollback_after is not None and rollback_after < 1:
+            raise ValueError("rollback_after must be >= 1 or None")
+        if not 0.0 < backoff_factor < 1.0:
+            raise ValueError("backoff_factor must be in (0, 1)")
+        self.backoff_after = backoff_after
+        self.rollback_after = rollback_after
+        self.backoff_factor = backoff_factor
+        self.regrow_after = regrow_after
+        self.min_scale = min_scale
+        self.scale = float(init_scale)
+        self.consecutive_skips = 0
+        self.total_skips = 0
+        self._healthy_streak = 0
+
+    def scale_value(self):
+        import jax.numpy as jnp
+
+        return jnp.asarray(self.scale, jnp.float32)
+
+    def reset_attempt(self) -> None:
+        """Per-attempt counter reset (the supervisor's restart boundary).
+        The SCALE survives on purpose: a run that needed backoff before the
+        rollback usually still needs it right after."""
+        self.consecutive_skips = 0
+        self.total_skips = 0
+        self._healthy_streak = 0
+
+    def __call__(self, outcome) -> None:
+        if outcome.ok:
+            self.consecutive_skips = 0
+            self._healthy_streak += 1
+            if self.scale < 1.0 \
+                    and self._healthy_streak >= self.regrow_after:
+                self.scale = min(1.0, self.scale / self.backoff_factor)
+                self._healthy_streak = 0
+                logger.info("divergence guard: %d healthy steps — scale "
+                            "regrown to %g", self.regrow_after, self.scale)
+            return
+
+        self._healthy_streak = 0
+        self.consecutive_skips += 1
+        self.total_skips += 1
+        logger.warning(
+            "divergence guard: non-finite step %d skipped (loss=%s, "
+            "grad_norm=%s; %d consecutive, %d total)", outcome.step,
+            outcome.loss, outcome.grad_norm, self.consecutive_skips,
+            self.total_skips)
+        if self.rollback_after is not None \
+                and self.total_skips >= self.rollback_after:
+            raise DivergenceError(
+                f"{self.total_skips} non-finite steps this attempt "
+                f"(budget {self.rollback_after}): rolling back to the "
+                "last valid checkpoint")
+        if self.backoff_after is not None \
+                and self.consecutive_skips >= self.backoff_after \
+                and self.consecutive_skips % self.backoff_after == 0:
+            self.scale *= self.backoff_factor
+            logger.warning("divergence guard: %d consecutive skips — "
+                           "gradient scale backed off to %g",
+                           self.consecutive_skips, self.scale)
+            if self.scale < self.min_scale:
+                if self.rollback_after is not None:
+                    raise DivergenceError(
+                        f"gradient scale {self.scale:g} collapsed below "
+                        f"{self.min_scale:g}: rolling back to the last "
+                        "valid checkpoint")
+                self.scale = self.min_scale

@@ -1,0 +1,129 @@
+"""Retry policies for transient faults on step/IO paths.
+
+The reference's only failure handling was throw-on-CUDA-error and exit(1)
+(SURVEY.md §5.3); on a real multi-day run the common IO failures are
+TRANSIENT — a GCS blip during a checkpoint write, a flaky NFS read in the
+input pipeline, a wedged native-loader submission. ``RetryPolicy`` is the
+one retry engine for all of them: exponential backoff with seeded jitter,
+exception-class filters (retry only what is plausibly transient), attempt
+and wall-clock budget caps so a *persistent* fault still fails fast enough
+for the supervisor tier (resilience/supervisor.py) to act.
+
+Wired in by:
+
+* ``training/checkpoint.py`` — ``CheckpointManager(retry_policy=...)``
+  retries orbax save/restore;
+* ``training/datasets.py`` — ``StreamingLoader(retry_policy=...)`` retries
+  per-item source fetches inside the read-ahead pool;
+* ``training/native_loader.py`` — ``NativeStreamingLoader`` retries batch
+  submissions to the C++ engine.
+
+Fault injection for all three lives in resilience/faults.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from collections.abc import Callable
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["RetryPolicy", "RetryBudgetExceeded", "DEFAULT_TRANSIENT"]
+
+# What a retry may assume is transient without being told otherwise:
+# filesystem/network hiccups (OSError covers ConnectionError and friends)
+# and timeouts. NOT RuntimeError — a wedged backend usually stays wedged,
+# and retrying it hides the stall the watchdog exists to surface.
+DEFAULT_TRANSIENT: tuple[type[BaseException], ...] = (OSError, TimeoutError)
+
+
+class RetryBudgetExceeded(RuntimeError):
+    """Raised when the policy's wall-clock budget ran out mid-retry.
+
+    Carries the last underlying exception as ``__cause__`` so callers (and
+    the supervisor's logs) still see the root fault.
+    """
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Exponential backoff + jitter, exception filters, budget caps.
+
+    ``call(fn, *args)`` runs ``fn`` up to ``max_attempts`` times, sleeping
+    ``min(base_delay_s * multiplier**k, max_delay_s) * (1 + U*jitter)``
+    between attempts (U uniform in [0, 1) from a ``seed``-derived RNG, so a
+    re-run of a failed job backs off identically). Only exceptions that are
+    instances of ``retry_on`` are retried — anything else propagates on the
+    first throw. ``budget_s`` caps the TOTAL wall clock spent (attempts +
+    sleeps); once exceeded the last exception is re-raised wrapped in
+    ``RetryBudgetExceeded``.
+
+    The policy object is stateless across ``call``s (the jitter RNG is the
+    only mutable member, and it only affects sleep lengths), so one policy
+    can be shared by every fetch thread of a loader.
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.1
+    multiplier: float = 2.0
+    max_delay_s: float = 30.0
+    jitter: float = 0.1
+    retry_on: tuple[type[BaseException], ...] = DEFAULT_TRANSIENT
+    budget_s: float | None = None
+    seed: int = 0
+    # Injectable clock/sleep so tests exercise the schedule without real
+    # waiting (resilience tests pin the exact delay sequence).
+    sleep: Callable[[float], None] = time.sleep
+    monotonic: Callable[[], float] = time.monotonic
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, "
+                             f"got {self.max_attempts}")
+        if self.base_delay_s < 0 or self.max_delay_s < 0:
+            raise ValueError("delays must be non-negative")
+        self._rng = random.Random(self.seed)
+
+    def delay_for(self, attempt: int) -> float:
+        """Backoff before retry number ``attempt`` (1-based: the sleep
+        after the ``attempt``-th failure)."""
+        base = min(self.base_delay_s * self.multiplier ** (attempt - 1),
+                   self.max_delay_s)
+        return base * (1.0 + self._rng.random() * self.jitter)
+
+    def call(self, fn: Callable, *args, **kwargs) -> Any:
+        start = self.monotonic()
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as e:
+                if attempt >= self.max_attempts:
+                    raise
+                delay = self.delay_for(attempt)
+                if self.budget_s is not None and \
+                        self.monotonic() - start + delay > self.budget_s:
+                    raise RetryBudgetExceeded(
+                        f"retry budget {self.budget_s:.1f}s exhausted after "
+                        f"{attempt} attempt(s) of "
+                        f"{getattr(fn, '__name__', fn)!r}") from e
+                logger.warning(
+                    "transient failure in %r (attempt %d/%d): %s — "
+                    "retrying in %.2fs",
+                    getattr(fn, "__name__", fn), attempt, self.max_attempts,
+                    e, delay)
+                self.sleep(delay)
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def wrap(self, fn: Callable) -> Callable:
+        """``fn`` with this policy baked in (for handing to thread pools)."""
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return self.call(fn, *args, **kwargs)
+
+        return wrapped

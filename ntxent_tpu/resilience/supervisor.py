@@ -1,0 +1,182 @@
+"""Self-healing training supervisor: detectors in, restarts out.
+
+The framework already shipped the *detectors* — ``PreemptionGuard``
+(SIGTERM → stop-at-step-boundary + force checkpoint),
+``StallWatchdog`` (silence → stack dumps), checksum-verified
+checkpoints (training/checkpoint.py) and the in-step divergence guard
+(guard.py) — but each one ended at a log line. ``Supervisor.run()`` closes
+the loop: it runs attempts of the training job and, on any fault the
+detectors surface, restarts IN-PROCESS from the newest valid checkpoint,
+up to ``max_restarts`` times with exponential backoff:
+
+* **clean-but-incomplete exit** (SIGTERM during chaos testing, stall
+  escalation, a data pipeline that stopped) → restart; ``fit`` restores
+  the force-saved step, so a kill at step k resumes at k;
+* **exception** (``DivergenceError`` rollback, ``ChaosError``, transient
+  IO that out-lived its RetryPolicy) → restart; the crashed attempt wrote
+  no final checkpoint, so restore lands on the last healthy save — and if
+  THAT file is truncated/corrupt, restore's checksum fallback walks back
+  to the newest valid one;
+* **stall** → the watchdog's one-shot ``on_stall`` asks the current
+  attempt's PreemptionGuard to stop; the attempt checkpoints and exits at
+  the next step boundary and the supervisor restarts it (the
+  watchdog-to-supervisor escalation utils/watchdog.py documents).
+
+The caller supplies ``run_attempt(attempt, stop_fn, watchdog)`` — usually
+a closure over ``trainer.fit`` that builds a FRESH TrainState template per
+attempt (donated buffers from a crashed attempt must not be reused) and
+passes ``stop_fn``/``watchdog`` through. ``ntxent_tpu.cli`` wires exactly
+that for ``--max-restarts``/``--chaos``/``--nan-policy``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from collections.abc import Callable
+
+from ..training.preemption import PreemptionGuard
+from ..utils.watchdog import StallWatchdog
+from .retry import RetryPolicy
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["AttemptRecord", "Supervisor", "SupervisorResult"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AttemptRecord:
+    attempt: int
+    # Step the attempt actually reached; None when it died on an exception
+    # before returning a state (a crashed attempt's progress is unknown —
+    # reporting the previous attempt's step here would be a lie).
+    end_step: int | None
+    preempted: bool
+    stalled: bool
+    error: str | None
+
+
+@dataclasses.dataclass
+class SupervisorResult:
+    completed: bool
+    state: object
+    histories: list
+    records: list
+
+    @property
+    def history(self):
+        """Concatenated per-attempt histories (rollbacks may repeat
+        step numbers across attempt boundaries)."""
+        return [entry for h in self.histories for entry in h]
+
+
+class Supervisor:
+    """Restart-with-backoff harness around an attempt callable.
+
+    ``run_attempt(attempt, stop_fn, watchdog) -> (state, history)`` runs
+    one incarnation of the job (typically ``trainer.fit`` with
+    ``checkpoint_dir`` set so every incarnation resumes itself).
+    Completion = ``int(state.step) >= num_steps``.
+
+    ``backoff`` is a resilience.RetryPolicy used only for its delay
+    schedule (seeded jitter included). ``stall_timeout_s`` arms a
+    StallWatchdog per attempt whose escalation stops the attempt cleanly.
+    ``injector`` (faults.FaultInjector) gets a between-attempts hook —
+    that is where the chaos plan's checkpoint-truncation fault fires.
+    """
+
+    def __init__(self, run_attempt: Callable, num_steps: int,
+                 checkpoint_dir=None, max_restarts: int = 3,
+                 backoff: RetryPolicy | None = None,
+                 stall_timeout_s: float | None = None,
+                 injector=None,
+                 sleep: Callable[[float], None] = time.sleep):
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, "
+                             f"got {max_restarts}")
+        self.run_attempt = run_attempt
+        self.num_steps = int(num_steps)
+        self.checkpoint_dir = checkpoint_dir
+        self.max_restarts = max_restarts
+        self.backoff = backoff or RetryPolicy(
+            max_attempts=max_restarts + 1, base_delay_s=1.0,
+            multiplier=2.0, max_delay_s=60.0, jitter=0.1)
+        self.stall_timeout_s = stall_timeout_s
+        self.injector = injector
+        self.sleep = sleep
+        self._guard: PreemptionGuard | None = None
+
+    def _on_stall(self, quiet_s: float) -> None:
+        guard = self._guard
+        if guard is None:  # stall latched between attempts: nothing to stop
+            return
+        logger.error("supervisor: stall escalation after %.1fs of silence "
+                     "— stopping the attempt at the next step boundary "
+                     "(checkpoint + in-process restart)", quiet_s)
+        guard.request()
+
+    def run(self) -> SupervisorResult:
+        histories: list = []
+        records: list[AttemptRecord] = []
+        state = None
+        watchdog = (StallWatchdog(timeout_s=self.stall_timeout_s,
+                                  on_stall=self._on_stall)
+                    if self.stall_timeout_s else None)
+        total_attempts = self.max_restarts + 1
+        for attempt in range(total_attempts):
+            guard = PreemptionGuard()
+            self._guard = guard
+            error: str | None = None
+            stalled = False
+            attempt_state = None
+            if watchdog is not None:
+                watchdog.reset()
+                watchdog.start()
+            try:
+                with guard:
+                    try:
+                        attempt_state, history = self.run_attempt(
+                            attempt, stop_fn=guard.requested,
+                            watchdog=watchdog)
+                        histories.append(history)
+                    except Exception as e:  # bounded by max_restarts
+                        error = f"{type(e).__name__}: {e}"
+                        logger.exception(
+                            "supervisor: attempt %d/%d died", attempt + 1,
+                            total_attempts)
+            finally:
+                self._guard = None
+                if watchdog is not None:
+                    stalled = watchdog.fired.is_set()
+                    watchdog.stop()
+            end_step = int(attempt_state.step) \
+                if attempt_state is not None else None
+            if attempt_state is not None:
+                state = attempt_state
+            records.append(AttemptRecord(
+                attempt=attempt, end_step=end_step,
+                preempted=guard.preempted, stalled=stalled, error=error))
+            if error is None and not guard.preempted \
+                    and end_step is not None and end_step >= self.num_steps:
+                logger.info("supervisor: run complete at step %d after "
+                            "%d attempt(s)", end_step, attempt + 1)
+                return SupervisorResult(True, state, histories, records)
+            if attempt + 1 >= total_attempts:
+                break
+            if self.injector is not None:
+                self.injector.between_attempts(self.checkpoint_dir)
+            delay = self.backoff.delay_for(attempt + 1)
+            logger.warning(
+                "supervisor: attempt %d/%d ended at step %s "
+                "(preempted=%s, stalled=%s, error=%s) — restarting from "
+                "the last valid checkpoint in %.1fs", attempt + 1,
+                total_attempts,
+                "<unknown: attempt crashed>" if end_step is None
+                else end_step, guard.preempted, stalled, error, delay)
+            self.sleep(delay)
+        logger.error(
+            "supervisor: giving up after %d attempt(s) (last step %s of "
+            "%d) — restart budget exhausted", total_attempts,
+            records[-1].end_step if records else 0, self.num_steps)
+        return SupervisorResult(False, state, histories, records)

@@ -30,6 +30,7 @@ from ntxent_tpu.training.lars import (
 )
 from ntxent_tpu.training.preemption import PreemptionGuard
 from ntxent_tpu.training.trainer import (
+    StepOutcome,
     TrainerConfig,
     TrainState,
     create_train_state,
@@ -66,6 +67,7 @@ __all__ = [
     "grain_loader",
     "streaming_two_view_iterator",
     "PreemptionGuard",
+    "StepOutcome",
     "cosine_warmup_schedule",
     "create_lars",
     "simclr_learning_rate",

@@ -2,27 +2,70 @@
 
 The reference has no persistence beyond benchmark JSON (SURVEY.md §5.4);
 the BASELINE.json configs[2-4] runs (ImageNet/v5e-32 and up) require real
-checkpoint/resume. Orbax handles multi-host coordination and atomic writes."""
+checkpoint/resume. Orbax handles multi-host coordination and atomic writes.
+
+Resilience layer (resilience/ package, SURVEY.md §5.3): every save records
+a content manifest (per-file size + CRC32) in a sidecar
+``manifests.json``; ``verify()`` re-checksums a step, ``restore`` falls
+back past corrupt steps to the newest VALID one (deleting the corrupt
+ones so the step sequence can be re-saved), and ``latest_valid_step()``
+feeds the supervisor's rollback tier (resilience/supervisor.py). A
+``RetryPolicy`` (resilience/retry.py) can wrap the orbax save/restore
+calls for transient-filesystem tolerance, and ``save`` reports transient
+directory failures by returning False instead of killing the run —
+skipping one checkpoint is recoverable; dying mid-run is what this layer
+exists to prevent. Fault injection for the corrupt-checkpoint path:
+``resilience.faults.truncate_checkpoint_file``.
+"""
 
 from __future__ import annotations
 
+import json
 import logging
+import os
+import shutil
+import zlib
 from pathlib import Path
 from typing import Any
 
 import orbax.checkpoint as ocp
 
+from ..resilience.retry import RetryBudgetExceeded
+
 logger = logging.getLogger(__name__)
 
 __all__ = ["CheckpointManager"]
 
+_MANIFEST_NAME = "manifests.json"
+
+
+def _crc32_file(path: Path, chunk: int = 1 << 20) -> int:
+    value = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return value
+            value = zlib.crc32(block, value)
+
 
 class CheckpointManager:
-    """Thin wrapper over orbax CheckpointManager for TrainState pytrees."""
+    """Thin wrapper over orbax CheckpointManager for TrainState pytrees.
+
+    ``retry_policy`` (resilience.RetryPolicy) retries the underlying orbax
+    save/restore on transient errors. ``verify_writes=True`` (default)
+    records a per-save content manifest used by ``verify`` /
+    ``latest_valid_step`` / the restore fallback; it waits for the async
+    save machinery per checksummed save, so a throughput-critical caller
+    that trusts its filesystem can turn it off.
+    """
 
     def __init__(self, directory: str | Path, max_to_keep: int = 3,
-                 save_interval_steps: int = 1):
+                 save_interval_steps: int = 1, retry_policy=None,
+                 verify_writes: bool = True):
         self.directory = Path(directory).absolute()
+        self.retry_policy = retry_policy
+        self.verify_writes = verify_writes
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -32,6 +75,124 @@ class CheckpointManager:
             ),
         )
 
+    def _call(self, fn, *args, **kwargs):
+        if self.retry_policy is not None:
+            return self.retry_policy.call(fn, *args, **kwargs)
+        return fn(*args, **kwargs)
+
+    # -- content manifests -------------------------------------------------
+    def _manifest_path(self) -> Path:
+        return self.directory / _MANIFEST_NAME
+
+    def _load_manifests(self) -> dict:
+        try:
+            with open(self._manifest_path()) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    def _store_manifests(self, manifests: dict) -> None:
+        tmp = self._manifest_path().with_suffix(".tmp")
+        with open(tmp, "w") as f:
+            json.dump(manifests, f)
+        os.replace(tmp, self._manifest_path())
+
+    def _step_dir(self, step: int) -> Path | None:
+        p = self.directory / str(step)
+        if p.is_dir():
+            return p
+        for q in self.directory.iterdir():  # prefixed/padded layouts
+            if q.is_dir():
+                digits = "".join(ch for ch in q.name if ch.isdigit())
+                if digits and int(digits) == step:
+                    return q
+        return None
+
+    def _compute_manifest(self, step: int) -> dict | None:
+        step_dir = self._step_dir(step)
+        if step_dir is None:
+            return None
+        files = {}
+        for p in sorted(step_dir.rglob("*")):
+            if p.is_file():
+                rel = str(p.relative_to(step_dir))
+                files[rel] = [p.stat().st_size, _crc32_file(p)]
+        return {"files": files}
+
+    def _record_manifest(self, step: int) -> None:
+        # The manifest must describe FINAL bytes: drain the async save
+        # machinery first (the documented cost of verify_writes).
+        self.manager.wait_until_finished()
+        manifest = self._compute_manifest(step)
+        if manifest is None:
+            logger.warning("no step dir found for step %d; skipping "
+                           "checksum manifest", step)
+            return
+        manifests = self._load_manifests()
+        manifests[str(step)] = manifest
+        # Drop entries for steps orbax garbage-collected (max_to_keep).
+        live = {str(s) for s in (self.manager.all_steps() or [])}
+        manifests = {k: v for k, v in manifests.items() if k in live}
+        self._store_manifests(manifests)
+
+    def verify(self, step: int) -> bool:
+        """Re-checksum a saved step against its manifest.
+
+        True for steps with no recorded manifest (pre-resilience saves are
+        unverifiable, not invalid). False on any missing file, size drift,
+        or CRC mismatch — e.g. a truncated/partially-written file.
+        """
+        recorded = self._load_manifests().get(str(step))
+        if recorded is None:
+            logger.debug("step %d has no checksum manifest; treating as "
+                         "valid", step)
+            return True
+        actual = self._compute_manifest(step)
+        if actual is None:
+            return False
+        want, got = recorded["files"], actual["files"]
+        for rel, meta in want.items():
+            if rel not in got or got[rel] != meta:
+                logger.error(
+                    "checkpoint step %d failed verification at %s "
+                    "(want size/crc %s, got %s)", step, rel, meta,
+                    got.get(rel))
+                return False
+        return True
+
+    def latest_valid_step(self) -> int | None:
+        """Newest step that passes ``verify`` (the supervisor's rollback
+        target); None when no step verifies."""
+        for step in sorted(self.manager.all_steps() or [], reverse=True):
+            if self.verify(step):
+                return int(step)
+        return None
+
+    def delete_step(self, step: int) -> None:
+        """Remove a (corrupt) step and its manifest entry.
+
+        The manifest entry is dropped only once the files are actually
+        gone: a failed deletion must keep failing ``verify`` (a
+        manifest-less step counts as valid, so popping the entry while
+        the truncated files survive would launder corruption into the
+        restore fallback's 'newest valid' answer).
+        """
+        try:
+            self.manager.delete(step)
+        except Exception:
+            step_dir = self._step_dir(step)
+            if step_dir is not None:
+                shutil.rmtree(step_dir, ignore_errors=True)
+        if self._step_dir(step) is not None:
+            logger.error("could not delete corrupt checkpoint at step %d; "
+                         "keeping its manifest so it stays invalid", step)
+            return
+        manifests = self._load_manifests()
+        if manifests.pop(str(step), None) is not None:
+            self._store_manifests(manifests)
+        logger.warning("deleted corrupt checkpoint at step %d", step)
+
+    # -- save / restore ----------------------------------------------------
     def save(self, step: int, state: Any, force: bool = False,
              data_state: dict | None = None) -> bool:
         """Save the TrainState, optionally with input-pipeline state.
@@ -39,6 +200,11 @@ class CheckpointManager:
         ``data_state`` (a small JSON-able dict, e.g. StreamingLoader.state())
         rides along as a composite item so resume can reposition the data
         iterator exactly instead of replaying host batches.
+
+        Returns False — after logging — when the directory hits a
+        filesystem error (transient NFS/GCS blips survive a missed
+        checkpoint; the next cadence point saves again). Raising here
+        would kill a healthy training run over a recoverable IO fault.
         """
         if data_state is not None:
             args: Any = ocp.args.Composite(
@@ -46,8 +212,25 @@ class CheckpointManager:
                 data_state=ocp.args.JsonSave(data_state))
         else:
             args = ocp.args.StandardSave(state)
-        saved = self.manager.save(step, args=args, force=force)
+        try:
+            saved = self._call(self.manager.save, step, args=args,
+                               force=force)
+        except (OSError, RetryBudgetExceeded) as e:
+            # RetryBudgetExceeded wraps the root OSError once a budgeted
+            # retry_policy's wall clock runs out — same recoverable class,
+            # and the skip-a-checkpoint contract must not depend on which
+            # limit (attempts vs budget) tripped first.
+            logger.error("checkpoint save at step %d failed (%s: %s) — "
+                         "continuing without it", step,
+                         type(e).__name__, e)
+            return False
         if saved:
+            if self.verify_writes:
+                try:
+                    self._record_manifest(step)
+                except OSError as e:
+                    logger.error("checksum manifest for step %d failed "
+                                 "(%s); step stays unverifiable", step, e)
             logger.info("checkpoint saved at step %d -> %s", step,
                         self.directory)
         return saved
@@ -61,23 +244,47 @@ class CheckpointManager:
             step: int | None = None) -> tuple[Any, dict | None]:
         """(state, data_state-or-None); handles both checkpoint layouts
         (plain StandardSave and the composite written when data_state was
-        provided)."""
-        step = step if step is not None else self.manager.latest_step()
+        provided).
+
+        With ``step=None`` the newest step is verified first; corrupt
+        steps are deleted and the search falls back to the newest VALID
+        one (the rollback path the supervisor leans on). An explicit
+        ``step`` is restored as-is after a verification failure is logged
+        — the caller asked for that exact step.
+        """
         if step is None:
-            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+            step = self.manager.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoint in {self.directory}")
+            while not self.verify(step):
+                logger.error("checkpoint at step %d is corrupt; falling "
+                             "back to the previous one", step)
+                self.delete_step(step)
+                step = self.latest_valid_step()
+                if step is None:
+                    raise FileNotFoundError(
+                        f"no VALID checkpoint left in {self.directory} "
+                        "(all candidates failed checksum verification)")
+        elif not self.verify(step):
+            logger.error("explicitly requested checkpoint step %d fails "
+                         "verification; restoring it anyway", step)
         try:
-            restored = self.manager.restore(
-                step,
+            restored = self._call(
+                self.manager.restore, step,
                 args=ocp.args.Composite(
                     state=ocp.args.StandardRestore(state_template),
                     data_state=ocp.args.JsonRestore()))
             return restored["state"], dict(restored["data_state"])
         except Exception:
-            return self.manager.restore(
-                step, args=ocp.args.StandardRestore(state_template)), None
+            return self._call(
+                self.manager.restore, step,
+                args=ocp.args.StandardRestore(state_template)), None
 
     def latest_step(self) -> int | None:
         return self.manager.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(int(s) for s in (self.manager.all_steps() or []))
 
     def wait_until_finished(self):
         self.manager.wait_until_finished()

@@ -15,6 +15,13 @@ steps — SURVEY §7.4 ranks input-boundness the #1 MFU risk. Design:
 * **Checkpointable**: ``state()``/``restore()`` capture (epoch, offset,
   seed) so training resumes mid-epoch without replaying host data
   (trainer.fit wires this up — the fix for round 1's O(steps) fast-forward).
+  ``restore()`` also works on an already-iterated pipeline (the generator
+  is rebuilt at the restored position) — the in-process-restart path
+  ``resilience.Supervisor`` takes after a rollback.
+* **Transient-fault tolerant**: pass ``retry_policy``
+  (``resilience.RetryPolicy``) and per-item source fetches retry with
+  exponential backoff instead of killing the epoch on one flaky
+  NFS/network read (chaos coverage: ``resilience.faults`` ``fetch@n``).
 * **Device overlap**: `device_prefetch` moves batches onto the device (or a
   sharded mesh layout) ahead of consumption; JAX async dispatch overlaps the
   copy with the running step.
@@ -225,12 +232,21 @@ class StreamingLoader(_ShardedShuffle):
     def __init__(self, source, batch_size: int, seed: int = 0,
                  num_threads: int = 8, read_ahead: int = 4,
                  drop_remainder: bool = True,
-                 shard_index: int = 0, shard_count: int = 1):
+                 shard_index: int = 0, shard_count: int = 1,
+                 retry_policy=None):
         self._init_shuffle(len(source), batch_size, seed, shard_index,
                            shard_count, drop_remainder)
         self.source = source
         self.num_threads = num_threads
         self.read_ahead = max(1, read_ahead)
+        self.retry_policy = retry_policy
+
+    def _fetch(self, idx: int) -> np.ndarray:
+        """One source read, retried per ``retry_policy`` (runs on the
+        pool's worker threads; RetryPolicy.call is thread-safe)."""
+        if self.retry_policy is None:
+            return self.source[idx]
+        return self.retry_policy.call(self.source.__getitem__, idx)
 
     def __iter__(self) -> Iterator[np.ndarray]:
         # Not a `with` block: a generator abandoned mid-epoch is finalized
@@ -253,7 +269,7 @@ class StreamingLoader(_ShardedShuffle):
                     while bi < nb and len(pending) < self.read_ahead:
                         idxs = self._batch_indices(order, bi)
                         pending.append([
-                            pool.submit(self.source.__getitem__, int(i))
+                            pool.submit(self._fetch, int(i))
                             for i in idxs])
                         bi += 1
                     batch = np.stack([f.result() for f in pending.pop(0)])
@@ -327,9 +343,13 @@ class TwoViewPipeline:
         return self.loader.state()
 
     def restore(self, state: dict) -> None:
-        if self._gen is not None:
-            raise RuntimeError("restore() must run before iteration starts")
+        # Also valid mid-iteration (the supervisor's in-process restart):
+        # the running generator would not see a mid-epoch reposition (the
+        # loader re-reads its offset only at epoch boundaries), so drop it
+        # and rebuild at the restored position on the next __next__. The
+        # abandoned generator's read-ahead pool shuts down on finalize.
         self.loader.restore(state)
+        self._gen = None
 
     def __iter__(self):
         return self
@@ -366,9 +386,11 @@ class PairedArrayLoader(_ShardedShuffle):
         self._gen = None
 
     def restore(self, state: dict) -> None:
-        if self._gen is not None:
-            raise RuntimeError("restore() must run before iteration starts")
+        # Valid mid-iteration too (see TwoViewPipeline.restore): the
+        # generator reads (epoch, offset) per epoch, so rebuild it at the
+        # restored position.
         super().restore(state)
+        self._gen = None
 
     def __next__(self):
         if self._gen is None:
@@ -421,9 +443,9 @@ class GlobalTwoViewPipeline:
         return self.loader.state()
 
     def restore(self, state: dict) -> None:
-        if self._it is not None:
-            raise RuntimeError("restore() must run before iteration starts")
+        # Valid mid-iteration too (see TwoViewPipeline.restore).
         self.loader.restore(state)
+        self._it = None
 
     def __iter__(self):
         return self

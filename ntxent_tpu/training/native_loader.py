@@ -115,7 +115,8 @@ class NativeStreamingLoader(_ShardedShuffle):
     def __init__(self, source, batch_size: int, seed: int = 0,
                  num_threads: int = 8, read_ahead: int = 4,
                  drop_remainder: bool = True,
-                 shard_index: int = 0, shard_count: int = 1):
+                 shard_index: int = 0, shard_count: int = 1,
+                 retry_policy=None):
         mm, file_off = _as_memmap(source)
         self._init_shuffle(len(mm), batch_size, seed, shard_index,
                            shard_count, drop_remainder)
@@ -127,12 +128,10 @@ class NativeStreamingLoader(_ShardedShuffle):
                                                           dtype=np.int64))
         self.num_threads = num_threads
         self.read_ahead = max(1, read_ahead)
+        self.retry_policy = retry_policy
         self._lib = _library()  # build (or load) eagerly: fail at init
 
-    def _submit(self, handle, order: np.ndarray, bi: int) -> np.ndarray:
-        """Queue batch ``bi``; workers gather straight into the returned
-        buffer (zero staging copies) — it must stay referenced and
-        untouched until the matching next() drains it."""
+    def _submit_once(self, handle, order: np.ndarray, bi: int) -> np.ndarray:
         idxs = np.ascontiguousarray(self._batch_indices(order, bi),
                                     dtype=np.int64)
         out = np.empty((len(idxs), *self._row_shape), self._dtype)
@@ -140,8 +139,19 @@ class NativeStreamingLoader(_ShardedShuffle):
             handle, idxs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             len(idxs), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
         if rc != 0:
-            raise RuntimeError("native loader rejected batch submission")
+            # Surface as OSError: the engine's submit fails on queue/mmap
+            # pressure, the transient class retry_policy defaults cover.
+            raise OSError("native loader rejected batch submission")
         return out
+
+    def _submit(self, handle, order: np.ndarray, bi: int) -> np.ndarray:
+        """Queue batch ``bi``; workers gather straight into the returned
+        buffer (zero staging copies) — it must stay referenced and
+        untouched until the matching next() drains it. Submission is
+        retried per ``retry_policy`` (resilience.RetryPolicy)."""
+        if self.retry_policy is None:
+            return self._submit_once(handle, order, bi)
+        return self.retry_policy.call(self._submit_once, handle, order, bi)
 
     def __iter__(self) -> Iterator[np.ndarray]:
         handle = self._lib.ntx_loader_open(

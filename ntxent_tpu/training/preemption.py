@@ -13,6 +13,13 @@ datasets' checkpointable iterator state carry the resume).
 a flag (async-signal-safe); all real work (device sync, orbax save) happens
 on the main thread at the next step boundary via ``train_loop``'s
 ``stop_fn`` hook.
+
+The guard is also the clean-stop lever of the rest of the resilience layer
+(resilience/supervisor.py): ``resilience.Supervisor`` installs one guard
+per attempt and uses it both for real SIGTERMs and as the target of
+``utils.watchdog.StallWatchdog`` escalation — a stalled attempt is stopped
+at a step boundary, checkpointed, and restarted in-process from the last
+valid checkpoint.
 """
 
 from __future__ import annotations

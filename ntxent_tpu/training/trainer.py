@@ -12,6 +12,7 @@ Metrics include steps/sec and MFU accounting (BASELINE.json north star:
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import logging
 import time
@@ -33,13 +34,64 @@ from ..parallel.dist_loss import (
 )
 from ..parallel.moe import moe_aux_from
 from .lars import cosine_warmup_schedule, create_lars, simclr_learning_rate
+from ..parallel.mesh import shard_map as _shard_map_compat
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["TrainState", "create_train_state", "make_train_step",
            "make_clip_train_step", "make_sharded_train_step",
            "make_sharded_clip_train_step", "train_loop", "fit",
-           "TrainerConfig"]
+           "TrainerConfig", "StepOutcome"]
+
+
+@dataclasses.dataclass(frozen=True)
+class StepOutcome:
+    """Host-side record of one completed train step, handed to the
+    ``step_guard`` hook of ``train_loop`` (resilience.DivergenceGuard is
+    the canonical consumer; any callable taking a StepOutcome works).
+
+    ``ok=False`` means the jitted guard (``make_train_step(guard=True)``)
+    found a non-finite loss or grad norm and SKIPPED the update: params /
+    optimizer state / BN stats kept their pre-step values while
+    ``state.step`` still advanced. ``grad_norm`` is None for steps built
+    without the guard (they report no norm).
+    """
+
+    step: int
+    loss: float
+    grad_norm: float | None
+    ok: bool
+
+
+def _guarded_update(state: TrainState, grads, loss, new_stats=None):
+    """Jit-side divergence guard shared by the guarded step factories.
+
+    One cheap reduction (global grad norm) + two isfinite checks decide
+    ``ok``; on a bad step every leaf of params/opt_state (and BN stats)
+    is selected from the PRE-step state, so a NaN batch can neither move
+    the weights nor poison optimizer moments. ``state.step`` always
+    increments — skip-batch semantics keep the counter monotone for
+    checkpoint cadence and the supervisor (resilience/supervisor.py).
+    Returns ``(new_state, metrics)``.
+    """
+    gnorm = optax.global_norm(grads)
+    ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+    # Zero the grads on a bad step BEFORE the optimizer update: optax
+    # transforms (moments, trust ratios) must never see a NaN even though
+    # their outputs are discarded below — NaN*0 is NaN, where() is not.
+    safe_grads = jax.tree.map(
+        lambda g: jnp.where(ok, g, jnp.zeros_like(g)), grads)
+    updated = state.apply_gradients(grads=safe_grads)
+    keep = functools.partial(jax.tree.map,
+                             lambda new, old: jnp.where(ok, new, old))
+    updated = updated.replace(
+        params=keep(updated.params, state.params),
+        opt_state=keep(updated.opt_state, state.opt_state))
+    if new_stats is not None:
+        updated = updated.replace(
+            batch_stats=keep(new_stats, state.batch_stats))
+    metrics = {"grad_norm": gnorm, "step_ok": ok}
+    return updated, metrics
 
 
 class TrainState(train_state.TrainState):
@@ -124,7 +176,8 @@ def _apply_two_views(state: TrainState, params, v1, v2, train: bool = True,
 def make_train_step(temperature: float = 0.1,
                     use_fused: bool | None = None,
                     remat: bool = False,
-                    moe_aux_weight: float = 0.0) -> Callable:
+                    moe_aux_weight: float = 0.0,
+                    guard: bool = False) -> Callable:
     """Single-device train step: fused Pallas loss, donated state.
 
     ``use_fused=None`` auto-selects: the Pallas kernel where it compiles
@@ -136,6 +189,11 @@ def make_train_step(temperature: float = 0.1,
     ``moe_aux_weight > 0`` adds that multiple of the MoE towers'
     load-balance aux loss (Switch uses 1e-2) to the objective and reports
     it under ``metrics["moe_aux"]``.
+    ``guard=True`` adds the in-step divergence guard (``_guarded_update``):
+    the step takes a trailing ``scale`` operand (gradient multiplier; a
+    traced scalar, so the host can back it off without a recompile),
+    skips non-finite updates, and reports ``grad_norm``/``step_ok`` —
+    pair with ``train_loop(step_guard=resilience.DivergenceGuard(...))``.
     """
     if use_fused is None:
         from ..utils.capability import is_tpu_backend
@@ -147,8 +205,7 @@ def make_train_step(temperature: float = 0.1,
         from ..ops.oracle import ntxent_loss as loss_impl
     collect = moe_aux_weight > 0.0
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def train_step(state: TrainState, v1, v2):
+    def _loss_and_grads(state, v1, v2):
         def loss_fn(params):
             z1, z2, new_stats, aux = _apply_two_views(
                 state, params, v1, v2, remat=remat, collect_moe_aux=collect)
@@ -156,8 +213,31 @@ def make_train_step(temperature: float = 0.1,
             loss = loss_impl(z, temperature) + moe_aux_weight * aux
             return loss, (new_stats, aux)
 
-        (loss, (new_stats, aux)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
+        return jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+
+    if guard:
+        # NO donation on the guarded path (unlike the plain step): every
+        # output leaf here is a where-select between the updated and the
+        # PRE-step value, and XLA:CPU's donation aliasing has been observed
+        # to miscompile that pattern — the int32 ``step`` output comes
+        # back holding the bit pattern of an ~1.0 float (reproduced
+        # deterministically under the full test suite; never without
+        # donation). Guarded runs trade one state copy for correctness.
+        @jax.jit
+        def guarded_step(state: TrainState, v1, v2, scale=1.0):
+            (loss, (new_stats, aux)), grads = _loss_and_grads(state, v1, v2)
+            grads = jax.tree.map(lambda g: g * scale, grads)
+            state, gmetrics = _guarded_update(state, grads, loss, new_stats)
+            metrics = {"loss": loss, **gmetrics}
+            if collect:
+                metrics["moe_aux"] = aux
+            return state, metrics
+
+        return guarded_step
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, v1, v2):
+        (loss, (new_stats, aux)), grads = _loss_and_grads(state, v1, v2)
         state = state.apply_gradients(grads=grads)
         state = state.replace(batch_stats=new_stats)
         metrics = {"loss": loss}
@@ -243,6 +323,7 @@ def make_sharded_train_step(
     remat: bool = False,
     loss_impl: str = "strip",
     moe_aux_weight: float = 0.0,
+    guard: bool = False,
 ) -> Callable:
     """Distributed train step over the mesh's data axis.
 
@@ -259,6 +340,12 @@ def make_sharded_train_step(
     ``moe_aux_weight > 0`` adds the MoE load-balance aux loss, pmean'd
     over the mesh (each device routes its own batch shard, so the mean of
     per-shard aux losses is the dp=ep estimator of balance).
+
+    ``guard=True``: in-step divergence guard, as in ``make_train_step``
+    (trailing replicated ``scale`` operand, skip-on-non-finite,
+    ``grad_norm``/``step_ok`` metrics). The finite check runs AFTER the
+    gradient pmean, so a NaN on any one shard skips the update uniformly
+    on every device — the replicated state stays bitwise identical.
     """
     num_devices = mesh.shape[axis]
     loss_body = resolve_local_ntxent(loss_impl)
@@ -267,29 +354,66 @@ def make_sharded_train_step(
     def local_loss(z1, z2):
         return loss_body(z1, z2, temperature, axis, num_devices, interpret)
 
-    def per_device_step(state: TrainState, v1, v2):
+    def _loss_and_grads(state, v1, v2):
         def loss_fn(params):
             z1, z2, new_stats, aux = _apply_two_views(
                 state, params, v1, v2, remat=remat, collect_moe_aux=collect)
             loss = local_loss(z1, z2) + moe_aux_weight * aux
             return loss, (new_stats, aux)
 
-        (loss, (new_stats, aux)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
+        return jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+
+    def _metrics(loss, aux):
+        # The aux term varies per shard (each device routes its own
+        # batch); pmean the REPORTED loss so it equals the optimized
+        # objective (whose gradient is the pmean'd grads) on every device
+        # — the P() out_spec would otherwise publish one arbitrary
+        # shard's.
+        metrics = {"loss": jax.lax.pmean(loss, axis) if collect else loss}
+        if collect:
+            metrics["moe_aux"] = jax.lax.pmean(aux, axis)
+        return metrics
+
+    if guard:
+        def per_device_guarded(state: TrainState, v1, v2, scale):
+            (loss, (new_stats, aux)), grads = _loss_and_grads(state, v1, v2)
+            grads = jax.lax.pmean(grads, axis)
+            new_stats = jax.lax.pmean(new_stats, axis)
+            grads = jax.tree.map(lambda g: g * scale, grads)
+            # A non-finite local loss whose NaN died in a masked reduction
+            # could leave grads finite; fold the pmean'd loss into the
+            # check so every shard agrees on it either way.
+            loss_all = jax.lax.pmean(loss, axis)
+            state, gmetrics = _guarded_update(state, grads, loss_all,
+                                              new_stats)
+            return state, {**_metrics(loss, aux), **gmetrics}
+
+        sharded_guarded = _shard_map_compat(
+            per_device_guarded,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+
+        # Undonated for the same XLA aliasing reason as the single-device
+        # guarded step (see make_train_step).
+        @jax.jit
+        def guarded_step(state: TrainState, v1, v2, scale=1.0):
+            return sharded_guarded(state, v1, v2,
+                                   jnp.asarray(scale, jnp.float32))
+
+        return guarded_step
+
+    def per_device_step(state: TrainState, v1, v2):
+        (loss, (new_stats, aux)), grads = _loss_and_grads(state, v1, v2)
         grads = jax.lax.pmean(grads, axis)
         new_stats = jax.lax.pmean(new_stats, axis)
         state = state.apply_gradients(grads=grads)
         state = state.replace(batch_stats=new_stats)
-        # The aux term varies per shard (each device routes its own
-        # batch); pmean the REPORTED loss so it equals the optimized
-        # objective (whose gradient is the pmean above) on every device —
-        # the P() out_spec would otherwise publish one arbitrary shard's.
-        metrics = {"loss": jax.lax.pmean(loss, axis) if collect else loss}
-        if collect:
-            metrics["moe_aux"] = jax.lax.pmean(aux, axis)
-        return state, metrics
+        return state, _metrics(loss, aux)
 
-    sharded = jax.shard_map(
+    sharded = _shard_map_compat(
         per_device_step,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis)),
@@ -343,7 +467,7 @@ def make_sharded_clip_train_step(
             metrics["moe_aux"] = jax.lax.pmean(aux, axis)
         return state.apply_gradients(grads=grads), metrics
 
-    sharded = jax.shard_map(
+    sharded = _shard_map_compat(
         per_device_step,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis)),
@@ -374,7 +498,17 @@ def aot_compile_with_flops(train_step, *args):
 
     try:
         compiled = train_step.lower(*args).compile()
-    except Exception:  # not a jit wrapper / backend refused AOT
+    except (AttributeError, TypeError, ValueError, NotImplementedError,
+            RuntimeError) as e:
+        # AttributeError/TypeError: not a jit wrapper (no .lower, or a
+        # signature we can't bind); the rest: the backend refused AOT.
+        # Degrading to per-call dispatch without FLOP/MFU accounting is
+        # legitimate, but it must be OBSERVABLE, not silent.
+        logger.warning(
+            "AOT step compile unavailable on backend %r (%s: %s) — "
+            "falling back to per-call jit dispatch; MFU accounting "
+            "disabled for this run", jax.default_backend(),
+            type(e).__name__, e)
         return None, None
     return flops_from_compiled(compiled), compiled
 
@@ -396,6 +530,7 @@ def train_loop(
     step_hook: Callable | None = None,
     stop_fn: Callable[[], bool] | None = None,
     watchdog=None,
+    step_guard: Callable | None = None,
 ):
     """Simple host loop: step, log loss / steps-per-sec / MFU.
 
@@ -417,8 +552,25 @@ def train_loop(
     once per step, so a hung collective/transfer past its timeout produces
     thread-stack dumps and fires its ``on_stall`` policy (§5.3 failure
     detection — a stalled run should diagnose itself, not go silent).
+
+    ``step_guard`` (e.g. ``resilience.DivergenceGuard``) is called after
+    EVERY step with a ``StepOutcome``; it may raise (DivergenceError) to
+    abort the attempt for the supervisor's rollback tier. When the guard
+    exposes ``scale_value()`` the loop passes its gradient scale as the
+    step's trailing operand — the step must then be built with
+    ``guard=True``. NOTE the cost: building the outcome reads the loss
+    every step, which synchronizes host and device per step (acceptable
+    for guarded runs; leave step_guard None on the raw-throughput path).
     """
     history = []
+    use_scale = step_guard is not None and hasattr(step_guard,
+                                                   "scale_value")
+
+    def run_step(ts, s, a, b):
+        if use_scale:
+            return ts(s, a, b, step_guard.scale_value())
+        return ts(s, a, b)
+
     t0 = time.perf_counter()
     last_t, last_step = t0, 0
     if stop_fn is not None and stop_fn():
@@ -430,16 +582,24 @@ def train_loop(
     for step in range(1, num_steps + 1):
         v1, v2 = next(data_iter)
         if step == 1 and flops_per_step == "auto":
+            aot_args = (state, v1, v2) + (
+                (step_guard.scale_value(),) if use_scale else ())
             flops_per_step, compiled = aot_compile_with_flops(
-                train_step, state, v1, v2)
+                train_step, *aot_args)
             if compiled is not None:
                 train_step = compiled  # reuse the executable we just built
             if flops_per_step is not None:
                 logger.info("compiled step cost: %.3e FLOPs/chip",
                             flops_per_step)
-        state, metrics = train_step(state, v1, v2)
+        state, metrics = run_step(train_step, state, v1, v2)
         if watchdog is not None:
             watchdog.beat()
+        if step_guard is not None:
+            step_guard(StepOutcome(
+                step=step, loss=float(metrics["loss"]),
+                grad_norm=(float(metrics["grad_norm"])
+                           if "grad_norm" in metrics else None),
+                ok=bool(metrics.get("step_ok", True))))
         if step_hook is not None:
             step_hook(state)
         stopped = stop_fn is not None and stop_fn()
@@ -474,10 +634,26 @@ def fit(
     fast_forward_data: bool = False,
     stop_fn: Callable[[], bool] | None = None,
     watchdog=None,
+    step_guard: Callable | None = None,
+    checkpoint_retry_policy=None,
+    checkpoint_verify_writes: bool = True,
 ):
     """Checkpoint-aware training: restore the latest checkpoint if one
     exists, train to ``num_steps`` total, save every ``checkpoint_every``
     steps (on the GLOBAL ``state.step``) and at the end.
+
+    ``step_guard`` / ``watchdog``: forwarded to ``train_loop`` (divergence
+    policy and stall detection). A guard-raised DivergenceError propagates
+    WITHOUT the final force-save — the diverged state must not become the
+    newest checkpoint; resilience.Supervisor catches it and restarts from
+    the last valid one (restore falls back past corrupt saves via
+    CheckpointManager.latest_valid_step).
+
+    ``checkpoint_retry_policy`` / ``checkpoint_verify_writes``: forwarded
+    to CheckpointManager. verify_writes=True (default) records per-save
+    CRC manifests, which drains the async save machinery per checkpointed
+    step — pass False on throughput-critical runs that trust their
+    filesystem to keep saves fully async.
 
     ``stop_fn`` (see ``train_loop``) makes the run preemptible: when it
     trips, the loop exits at the next step boundary and the final
@@ -511,8 +687,10 @@ def fit(
         if checkpoint_dir is not None:
             from .checkpoint import CheckpointManager
 
-            manager = CheckpointManager(checkpoint_dir,
-                                        save_interval_steps=checkpoint_every)
+            manager = CheckpointManager(
+                checkpoint_dir, save_interval_steps=checkpoint_every,
+                retry_policy=checkpoint_retry_policy,
+                verify_writes=checkpoint_verify_writes)
             if manager.latest_step() is not None:
                 state, data_state = manager.restore_with_data_state(state)
                 logger.info("resumed from checkpoint at step %d",
@@ -548,7 +726,7 @@ def fit(
             state, data_iter, train_step, remaining,
             log_every=log_every,
             flops_per_step=flops_per_step, step_hook=step_hook,
-            stop_fn=stop_fn, watchdog=watchdog)
+            stop_fn=stop_fn, watchdog=watchdog, step_guard=step_guard)
         if manager is not None \
                 and manager.latest_step() != int(state.step):
             manager.save(int(state.step), state, force=True,

@@ -12,11 +12,19 @@ silence into a diagnosis and an action:
 * a daemon thread checks the time since the last beat; past ``timeout_s``
   it dumps EVERY thread's Python stack via ``faulthandler`` (to stderr or
   ``dump_path``) — the "where is it stuck" evidence — and invokes
-  ``on_stall`` once (e.g. a preemption-style force-checkpoint, a metrics
-  alarm, or ``os.kill(os.getpid(), SIGTERM)`` to trigger the
-  ``PreemptionGuard`` save-and-exit path).
+  ``on_stall`` through a ONE-SHOT latch (e.g. a preemption-style
+  force-checkpoint, a metrics alarm, or ``os.kill(os.getpid(), SIGTERM)``
+  to trigger the ``PreemptionGuard`` save-and-exit path). The latch stays
+  closed until an explicit ``reset()``: beats resuming after a dump re-arm
+  DETECTION (``stalled`` clears, later stalls still dump), but never the
+  callback — a policy like "checkpoint and restart" firing twice in one
+  incident would race its own recovery. ``resilience.Supervisor`` resets
+  the latch at each attempt boundary.
 
-The watchdog never kills anything by itself: policy lives in ``on_stall``.
+The watchdog never kills anything by itself: policy lives in ``on_stall``
+— escalation to an acting layer is exactly what ``resilience.Supervisor``
+wires up (its ``on_stall`` stops the attempt at a step boundary via
+``PreemptionGuard`` and restarts from the last valid checkpoint).
 """
 
 from __future__ import annotations
@@ -60,14 +68,28 @@ class StallWatchdog:
         self.on_stall = on_stall
         self.dump_path = dump_path
         self.stalled = threading.Event()
+        self.fired = threading.Event()  # one-shot on_stall latch
         self._last_beat = time.monotonic()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def beat(self) -> None:
-        """Record progress; also re-arms the watchdog after a stall."""
+        """Record progress; re-arms stall DETECTION after a stall (the
+        ``on_stall`` latch stays closed — see ``reset``)."""
         self._last_beat = time.monotonic()
         self.stalled.clear()
+
+    def reset(self) -> None:
+        """Re-open the one-shot ``on_stall`` latch (and clear detection).
+
+        Deliberately the ONLY way to re-arm the callback: beats resuming
+        after a dump must not let a second slow step re-fire a policy
+        that is already mid-recovery (e.g. the supervisor's
+        checkpoint-and-restart). Call at a recovery boundary — the
+        supervisor does so before each attempt.
+        """
+        self.fired.clear()
+        self.beat()
 
     def _dump_stacks(self) -> None:
         try:
@@ -94,7 +116,8 @@ class StallWatchdog:
                              "(timeout %.1fs) — dumping thread stacks",
                              quiet, self.timeout_s)
                 self._dump_stacks()
-                if self.on_stall is not None:
+                if self.on_stall is not None and not self.fired.is_set():
+                    self.fired.set()
                     try:
                         self.on_stall(quiet)
                     except Exception:

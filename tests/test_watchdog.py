@@ -46,6 +46,27 @@ def test_beat_rearms_after_stall():
         _wait_for(dog.stalled)  # and a second stall trips again
 
 
+def test_on_stall_is_one_shot_until_reset():
+    """Regression: beats resuming after a dump must NOT re-arm on_stall —
+    a second slow step would re-fire a recovery policy (checkpoint +
+    restart) that is already mid-flight. Only explicit reset() re-opens
+    the latch; stall DETECTION (the ``stalled`` event) still re-arms per
+    beat so later incidents keep dumping stacks."""
+    fired = []
+    dog = StallWatchdog(timeout_s=0.2, poll_s=0.05, on_stall=fired.append)
+    with dog:
+        _wait_for(dog.fired)  # fired is set BEFORE the callback runs...
+        _wait_for(dog.stalled)
+        dog.beat()  # recovery: beats resume...
+        _wait_for(dog.stalled)  # ...then a SECOND stall trips detection
+        time.sleep(0.2)  # give the monitor time to (wrongly) re-fire
+        assert len(fired) == 1  # ...but the callback latch held
+        dog.reset()  # explicit recovery boundary re-opens the latch
+        _wait_for(dog.stalled)
+    # stop() joined the monitor thread: callback counts are now settled.
+    assert len(fired) == 2
+
+
 def test_on_stall_exception_is_contained(tmp_path):
     def boom(_):
         raise RuntimeError("policy failed")
