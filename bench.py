@@ -185,6 +185,111 @@ def _child() -> None:
     print(SENTINEL + json.dumps(payload), flush=True)
 
 
+def _serving_child() -> None:
+    """Per-bucket serving-engine measurement (in-process; spawned by
+    --serving with the same crash/timeout isolation as the headline)."""
+    import jax
+
+    if os.environ.get("NTXENT_BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    import functools
+
+    import numpy as np
+
+    from ntxent_tpu import models
+    from ntxent_tpu.models import SimCLRModel
+    from ntxent_tpu.serving import InferenceEngine
+
+    backend = jax.default_backend()
+    on_accel = backend in ("tpu", "axon")
+    # On an accelerator, measure the real serving encoder; on CPU keep
+    # the tiny encoder so the record is liveness + scheduler overhead,
+    # not a pointless full-ResNet host matmul marathon — the record says
+    # which was measured.
+    if on_accel:
+        encoder, size, model_name = models.ResNet50, 224, "resnet50"
+        runs, warmup = 30, 5
+    else:
+        encoder = functools.partial(models.ResNet, stage_sizes=(1,),
+                                    small_images=True)
+        size, model_name = 32, "tiny"
+        runs, warmup = 10, 2
+
+    model = SimCLRModel(encoder=encoder, proj_hidden_dim=64, proj_dim=32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, size, size, 3), np.float32),
+                           train=False)
+
+    def apply_fn(v, x):
+        return model.apply(v, x, train=False, method="features")
+
+    engine = InferenceEngine(apply_fn, variables,
+                             example_shape=(size, size, 3))
+    t0 = time.monotonic()
+    engine.warmup()
+    warmup_s = time.monotonic() - t0
+
+    rng = np.random.RandomState(0)
+    per_bucket = {}
+    for bucket in engine.buckets:
+        x = rng.rand(bucket, size, size, 3).astype(np.float32)
+        for _ in range(warmup):
+            engine.embed(x)
+        t0 = time.monotonic()
+        for _ in range(runs):
+            engine.embed(x)
+        total_s = time.monotonic() - t0
+        ms = total_s / runs * 1e3
+        per_bucket[str(bucket)] = {
+            "latency_ms": round(ms, 4),
+            "throughput_rows_s": round(bucket / (total_s / runs), 2),
+        }
+
+    payload = {
+        "metric": "serving_embed_per_bucket",
+        "backend": backend,
+        "device_kind": jax.local_devices()[0].device_kind,
+        "model": model_name,
+        "image_size": size,
+        "dtype": engine.dtype.name,
+        "buckets": per_bucket,
+        "warmup_s": round(warmup_s, 3),
+        "compiles": engine.metrics.compiles,
+        "runs_per_bucket": runs,
+    }
+    print(SENTINEL + json.dumps(payload), flush=True)
+
+
+def _serving_main() -> None:
+    """--serving: measure the bucket ladder, write BENCH_serving.json.
+
+    Same robustness contract as the headline: the parent imports no JAX,
+    the child is wall-clock-bounded, and a JSON record is emitted (file
+    + stdout) even on total failure.
+    """
+    backend = _probe_backend()
+    force_cpu = backend not in ("tpu", "axon")
+    payload, diag = _run_child(CHILD_TIMEOUT_S, force_cpu=force_cpu,
+                               child_flag="--serving-child")
+    if payload is None and not force_cpu:
+        payload, diag2 = _run_child(CHILD_TIMEOUT_S, force_cpu=True,
+                                    child_flag="--serving-child")
+        if payload is not None:
+            payload["error"] = f"accelerator path unavailable ({diag})"
+        else:
+            diag = f"{diag}; cpu fallback: {diag2}"
+    if payload is None:
+        payload = {"metric": "serving_embed_per_bucket", "buckets": {},
+                   "error": diag}
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_serving.json")
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(payload))
+
+
 def _probe_backend(timeout_s: float = 150.0) -> str | None:
     """Backend name the ambient config initializes to, probed in a
     disposable subprocess (backend init can wedge indefinitely here —
@@ -202,8 +307,8 @@ def _probe_backend(timeout_s: float = 150.0) -> str | None:
     return None
 
 
-def _run_child(timeout_s: float,
-               force_cpu: bool = False) -> tuple[dict | None, str]:
+def _run_child(timeout_s: float, force_cpu: bool = False,
+               child_flag: str = "--child") -> tuple[dict | None, str]:
     """Run the measurement subprocess; return (payload, diagnostic_tail)."""
     env = dict(os.environ)
     if force_cpu:
@@ -211,7 +316,7 @@ def _run_child(timeout_s: float,
         env["NTXENT_BENCH_FORCE_CPU"] = "1"
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child"],
+            [sys.executable, os.path.abspath(__file__), child_flag],
             capture_output=True, text=True, timeout=timeout_s, env=env)
     except subprocess.TimeoutExpired:
         return None, f"child timed out after {timeout_s:.0f}s (killed)"
@@ -301,7 +406,18 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--child", action="store_true",
                         help="internal: run the measurement in-process")
-    if parser.parse_args().child:
+    parser.add_argument("--serving", action="store_true",
+                        help="measure the serving engine's bucket ladder "
+                             "and write BENCH_serving.json")
+    parser.add_argument("--serving-child", action="store_true",
+                        help="internal: run the serving measurement "
+                             "in-process")
+    _args = parser.parse_args()
+    if _args.child:
         _child()
+    elif _args.serving_child:
+        _serving_child()
+    elif _args.serving:
+        _serving_main()
     else:
         main()

@@ -993,6 +993,176 @@ def _train_clip(args, info, per_process_batch: int, injector=None) -> int:
                     injector=injector)
 
 
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ntxent-serve",
+        description="Embedding inference service: shape-bucketed AOT "
+                    "engine + micro-batching scheduler over HTTP "
+                    "(/embed, /healthz, /metrics; ntxent_tpu/serving/)")
+    m = p.add_argument_group("model (must match the checkpoint's run)")
+    m.add_argument("--model", default="resnet50", choices=MODEL_CHOICES)
+    m.add_argument("--image-size", type=int, default=32,
+                   help="served input resolution (one static shape per "
+                        "ladder bucket)")
+    m.add_argument("--stem", default="conv",
+                   choices=["conv", "space_to_depth"])
+    m.add_argument("--vit-attention", default="xla",
+                   choices=["xla", "flash"])
+    m.add_argument("--proj-hidden-dim", type=int, default=2048)
+    m.add_argument("--proj-dim", type=int, default=128)
+    m.add_argument("--head", default="features",
+                   choices=["features", "embedding"],
+                   help="what /embed returns: encoder features (linear-"
+                        "eval space) or the projected L2-normalized "
+                        "contrastive embedding (similarity-search space)")
+    m.add_argument("--ckpt-dir", default=None,
+                   help="restore weights from a training checkpoint "
+                        "(newest VALID step; omit for random init — "
+                        "useful only for smoke/load tests)")
+    m.add_argument("--accum-steps", type=int, default=1,
+                   help="match the training run (shapes the checkpoint's "
+                        "optimizer pytree for restore)")
+
+    s = p.add_argument_group("serving")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8080,
+                   help="0 picks a free port (printed at startup)")
+    s.add_argument("--buckets", default="1,4,16,64,128",
+                   help="batch-size ladder the forward is compiled for; "
+                        "requests pad up to the nearest rung")
+    s.add_argument("--max-batch", type=int, default=None,
+                   help="coalescing cap per device call (default: the "
+                        "largest bucket)")
+    s.add_argument("--max-delay-ms", type=float, default=5.0,
+                   help="micro-batching window: how long the scheduler "
+                        "holds the first request while coalescing more")
+    s.add_argument("--queue-size", type=int, default=64,
+                   help="bounded request queue; a full queue rejects "
+                        "with 429 + Retry-After (backpressure) instead "
+                        "of growing latency")
+    s.add_argument("--timeout-ms", type=float, default=10000.0,
+                   help="default per-request deadline (overridable per "
+                        "request via the timeout_ms JSON field)")
+    s.add_argument("--max-request-rows", type=int, default=None,
+                   help="per-request row cap (413 above it; default: "
+                        "8x the largest bucket) — one request may chunk "
+                        "through the ladder but not monopolize the "
+                        "device worker")
+    s.add_argument("--no-warmup", action="store_true",
+                   help="skip compiling the bucket ladder at startup "
+                        "(first request per bucket then pays the "
+                        "compile)")
+    s.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="input/compute dtype the buckets compile for")
+
+    r = p.add_argument_group("resilience (ntxent_tpu/resilience/)")
+    r.add_argument("--stall-timeout", type=float, default=None,
+                   metavar="SECONDS",
+                   help="if a device call wedges for this long the "
+                        "watchdog dumps all thread stacks and escalates "
+                        "(with --max-restarts: drain + fresh batcher)")
+    r.add_argument("--max-restarts", type=int, default=0,
+                   help="supervised restarts after stall escalation "
+                        "(resilience.Supervisor; 0 = single attempt)")
+
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default=None, metavar="cpu|tpu")
+    return p
+
+
+def serve_main(argv=None) -> int:
+    """``ntxent-serve``: the inference half of the north star."""
+    args = build_serve_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+
+    try:
+        buckets = tuple(int(b) for b in args.buckets.split(",") if b)
+        if not buckets or min(buckets) < 1:
+            raise ValueError
+    except ValueError:
+        raise SystemExit(f"--buckets must be a comma list of positive "
+                         f"ints, got {args.buckets!r}")
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax.numpy as jnp
+
+    from ntxent_tpu.models import SimCLRModel
+    from ntxent_tpu.resilience import RetryPolicy
+    from ntxent_tpu.serving import EmbeddingServer, InferenceEngine
+    from ntxent_tpu.training import TrainerConfig, create_train_state
+
+    encoder = _make_encoder(args.model, args.image_size, stem=args.stem,
+                            vit_attention=args.vit_attention)
+    model = SimCLRModel(encoder=encoder,
+                        proj_hidden_dim=args.proj_hidden_dim,
+                        proj_dim=args.proj_dim)
+    # Serving state comes from the same template construction eval uses,
+    # so any checkpoint ntxent-eval can read, ntxent-serve can serve.
+    template = create_train_state(
+        model, jax.random.PRNGKey(args.seed),
+        (1, args.image_size, args.image_size, 3),
+        TrainerConfig(accum_steps=args.accum_steps))
+    if args.ckpt_dir is not None:
+        from ntxent_tpu.training.checkpoint import CheckpointManager
+
+        manager = CheckpointManager(args.ckpt_dir)
+        try:
+            if manager.latest_step() is None:
+                raise SystemExit(f"no checkpoint under {args.ckpt_dir}")
+            state = manager.restore(template)
+        finally:
+            manager.close()
+        logger.info("serving checkpoint step %d from %s",
+                    int(state.step), args.ckpt_dir)
+    else:
+        state = template
+        logger.warning("no --ckpt-dir: serving RANDOM weights (smoke/"
+                       "load-test mode)")
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+
+    if args.head == "embedding":
+        def apply_fn(v, x):
+            return model.apply(v, x, train=False)
+    else:
+        def apply_fn(v, x):
+            return model.apply(v, x, train=False, method="features")
+
+    retry_policy = RetryPolicy(max_attempts=2, base_delay_s=0.05,
+                               max_delay_s=1.0, seed=args.seed)
+    engine = InferenceEngine(
+        apply_fn, variables,
+        example_shape=(args.image_size, args.image_size, 3),
+        buckets=buckets,
+        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+        retry_policy=retry_policy)  # per-chunk transient-fault retries
+    if not args.no_warmup:
+        engine.warmup()
+
+    server = EmbeddingServer(
+        engine, host=args.host, port=args.port,
+        max_batch=args.max_batch, max_delay_s=args.max_delay_ms / 1e3,
+        queue_size=args.queue_size,
+        retry_policy=retry_policy,  # 429 Retry-After backoff schedule
+        stall_timeout_s=args.stall_timeout,
+        max_restarts=args.max_restarts,
+        default_timeout_s=args.timeout_ms / 1e3,
+        max_request_rows=args.max_request_rows)
+    try:
+        completed = server.serve_forever()
+    except KeyboardInterrupt:
+        logger.info("interrupted — draining")
+        server.close()
+        return 0
+    return 0 if completed else 1
+
+
 def build_eval_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="ntxent-eval",

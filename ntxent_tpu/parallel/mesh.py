@@ -34,6 +34,8 @@ __all__ = [
     "local_row_gids",
     "process_info",
     "shard_map",
+    "pcast",
+    "axis_size",
 ]
 
 
@@ -55,6 +57,43 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
 
     return _shard_map(f, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_rep=check_vma)
+
+
+def pcast(x, axes, to: str = "varying"):
+    """``jax.lax.pcast`` across jax versions (robustness shim).
+
+    Mirrors the ``shard_map`` shim above: the varying/invariant type
+    system behind ``pcast`` is recent. Ring collectives (parallel/ring.py,
+    ring_attention.py) use it only to mark device-invariant scan inits as
+    ring-varying so carry types agree with what ``ppermute`` produces —
+    a TYPE annotation, not a computation. Fallback ladder:
+
+    * ``jax.lax.pcast`` exists: use it;
+    * only ``jax.lax.pvary`` exists (the earlier spelling of the
+      invariant→varying direction): use that for ``to="varying"``;
+    * neither exists: identity — jax versions without the varying type
+      system don't check carry varying-ness, so the annotation is
+      simply unnecessary there (the seed-era distributed failures were
+      exactly this AttributeError, not a semantic gap).
+    """
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to=to)
+    if to == "varying" and hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axes)
+    return x
+
+
+def axis_size(axis: str) -> int:
+    """``jax.lax.axis_size`` across jax versions (robustness shim).
+
+    Older jax has no ``axis_size``; ``psum(1, axis)`` is the classic
+    spelling there — psum of a non-traced constant over a named axis is
+    evaluated eagerly to the static size, so reshape dims built from it
+    stay static.
+    """
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis)
+    return jax.lax.psum(1, axis)
 
 
 def init_distributed(

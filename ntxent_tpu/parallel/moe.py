@@ -44,6 +44,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from .mesh import axis_size as _axis_size_compat
 from .mesh import shard_map as _shard_map_compat
 
 __all__ = ["MoEParams", "init_moe_params", "switch_moe",
@@ -156,7 +157,7 @@ def switch_moe(params: MoEParams, x: jax.Array, *,
     w_up, b_up, w_down, b_down = (params.w_up, params.b_up,
                                   params.w_down, params.b_down)
     if axis is not None:
-        p = jax.lax.axis_size(axis)
+        p = _axis_size_compat(axis)
         if e % p:
             raise ValueError(f"{e} experts not divisible over {p} devices")
         # Token-sharded (E, C, d) → expert-sharded (E/P, P*C, d): each

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops.infonce_pallas import resolve_scale
 from ..ops.ntxent_pallas import _exp0, _log_l
 from .mesh import local_row_gids
+from .mesh import pcast as _pcast_compat
 from .mesh import shard_map as _shard_map_compat
 
 __all__ = ["ntxent_loss_ring", "make_ring_ntxent",
@@ -76,9 +77,9 @@ def _ring_body(z1_local, z2_local, temperature, axis, num_devices):
     init = (
         z_local,
         my_gid,
-        jax.lax.pcast(jnp.full((two_n_local,), _NEG_INF, jnp.float32),
+        _pcast_compat(jnp.full((two_n_local,), _NEG_INF, jnp.float32),
                       (axis,), to="varying"),
-        jax.lax.pcast(jnp.zeros((two_n_local,), jnp.float32),
+        _pcast_compat(jnp.zeros((two_n_local,), jnp.float32),
                       (axis,), to="varying"),
     )
     # P-1 exchanges suffice: fold the final visiting block outside the scan
@@ -281,7 +282,7 @@ def _infonce_ring_body(za_local, zb_local, scale, axis, num_devices):
         return (za_blk, zb_blk, m_a, l_a, m_b, l_b), None
 
     def stat(v):
-        return jax.lax.pcast(jnp.full((n_local,), v, jnp.float32),
+        return _pcast_compat(jnp.full((n_local,), v, jnp.float32),
                              (axis,), to="varying")
 
     # P-1 exchanges; the final visiting block is folded outside the scan.
@@ -344,7 +345,7 @@ def _infonce_ring_dual_body(za_local, zb_local, scale, axis, num_devices):
         return (zb_blk, m_a, l_a, m_blk, l_blk), None
 
     def stat(v):
-        return jax.lax.pcast(jnp.full((n_local,), v, jnp.float32),
+        return _pcast_compat(jnp.full((n_local,), v, jnp.float32),
                              (axis,), to="varying")
 
     init = (zb_local, stat(_NEG_INF), stat(0.0), stat(_NEG_INF), stat(0.0))

@@ -51,6 +51,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops.attention_pallas import resolve_attention_scale as _resolve_scale
 from ..ops.attention_pallas import _flat, _unflat
 from ..ops.ntxent_pallas import _exp0, _log_l
+from .mesh import pcast as _pcast_compat
 from .mesh import shard_map as _shard_map_compat
 
 __all__ = [
@@ -65,8 +66,11 @@ _NEG_INF = -1e30
 
 def _varying(x, axis):
     """Mark a device-invariant init as ring-varying (scan carries must
-    agree in varying-ness with the values ppermute makes device-local)."""
-    return jax.lax.pcast(x, (axis,), to="varying")
+    agree in varying-ness with the values ppermute makes device-local).
+    Routed through the mesh.pcast version shim: on jax without the
+    varying type system the annotation is unnecessary and this is
+    identity."""
+    return _pcast_compat(x, (axis,), to="varying")
 
 
 def attention_oracle(q, k, v, *, causal: bool = False, scale=None,

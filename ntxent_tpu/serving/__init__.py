@@ -1,0 +1,46 @@
+"""Embedding inference serving: the north star's request-side half.
+
+Training produces encoders; this package makes them servable under the
+static-shape rules of XLA and the failure model of the PR 1 resilience
+layer. The stack, bottom-up:
+
+* ``engine.InferenceEngine`` — shape-bucketed, AOT-compiled forward
+  (pad to a fixed ladder of batch sizes; compiled-executable cache
+  keyed by bucket/dtype/model-hash; ``warmup()`` bounds first-request
+  latency);
+* ``batcher.MicroBatcher`` — dynamic micro-batching with a bounded
+  queue: coalesce concurrent requests into one device call, split
+  results per request, reject-with-retry-after on a full queue,
+  per-request deadlines that never waste device work;
+* ``server.EmbeddingServer`` — stdlib-HTTP ``/embed``, ``/healthz``,
+  ``/metrics``, supervised by ``resilience.Supervisor`` +
+  ``StallWatchdog`` so a wedged device call escalates through the
+  existing stall path;
+* ``metrics.ServingMetrics`` — per-bucket counts, queue depth,
+  batch-fill ratio, padding waste, p50/p95/p99 latency, as JSON.
+
+Launch with ``ntxent-serve`` (cli.py); load-test with
+``scripts/serving_smoke.sh``; benchmark with ``python bench.py
+--serving`` (writes BENCH_serving.json).
+"""
+
+from .batcher import (
+    BatcherClosed,
+    DeadlineExceededError,
+    MicroBatcher,
+    QueueFullError,
+)
+from .engine import DEFAULT_BUCKETS, InferenceEngine
+from .metrics import ServingMetrics
+from .server import EmbeddingServer
+
+__all__ = [
+    "BatcherClosed",
+    "DEFAULT_BUCKETS",
+    "DeadlineExceededError",
+    "EmbeddingServer",
+    "InferenceEngine",
+    "MicroBatcher",
+    "QueueFullError",
+    "ServingMetrics",
+]

@@ -1,0 +1,311 @@
+"""Dynamic micro-batching scheduler: many callers, one device.
+
+The throughput of a TPU/XLA forward is almost flat in batch size until
+the MXU saturates, so the worst way to serve concurrent 1-image requests
+is one device call each. ``MicroBatcher`` coalesces: requests land in a
+bounded queue, a single worker drains it into one concatenated batch
+(closed by ``max_batch`` rows or ``max_delay_s`` after the first row,
+whichever comes first), the engine runs it, and results split back
+per-request. The DLRM serving literature calls this the dominant
+inference lever (PAPERS.md arxiv 2512.05831); it is also what gives the
+smoke test its "batch-fill ratio > 1" acceptance signal.
+
+Failure semantics reuse the resilience vocabulary (PR 1):
+
+* the **bounded queue is the backpressure valve** — a full queue rejects
+  immediately with ``QueueFullError`` carrying a ``retry_after_s`` hint
+  derived from the retry policy's own backoff schedule
+  (``resilience.RetryPolicy.delay_for``), so clients back off the way
+  the framework's own retries do instead of piling latency onto a
+  saturated server;
+* **per-request deadlines**: an expired request is completed with
+  ``DeadlineExceededError`` at dispatch time and NEVER reaches the
+  device — batching a result nobody is waiting for wastes the exact
+  capacity the queue is protecting;
+* **transient device faults** retry PER CHUNK inside
+  ``InferenceEngine`` (its ``retry_policy`` — same filters/backoff as
+  loader fetches and checkpoint writes; chunk-level placement so a
+  retry never re-runs already-completed chunks of an oversized batch
+  and never double-counts dispatch metrics); a persistent fault fails
+  the whole batch loudly. The batcher's own ``retry_policy`` is used
+  only for its backoff schedule — the ``retry_after_s`` hint on
+  queue-full rejections;
+* each worker loop iteration **beats a StallWatchdog** when one is
+  wired (serving.server arms it per attempt) — beats continue while
+  idle, so accumulated silence means exactly one thing: a wedged device
+  call, which escalates through the PR 1 stall path (stack dumps +
+  supervisor restart).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..resilience.retry import RetryPolicy
+from .engine import InferenceEngine
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["BatcherClosed", "DeadlineExceededError", "MicroBatcher",
+           "QueueFullError"]
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure: the request queue is at capacity.
+
+    ``retry_after_s`` is the server's suggested client backoff (surfaced
+    as the HTTP 429 ``Retry-After`` header by serving.server).
+    """
+
+    def __init__(self, depth: int, retry_after_s: float):
+        super().__init__(f"request queue full ({depth} waiting); "
+                         f"retry in {retry_after_s:.2f}s")
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's deadline expired before a device call picked it up."""
+
+
+class BatcherClosed(RuntimeError):
+    """submit() after close() (server draining/restarting)."""
+
+
+@dataclass
+class _Pending:
+    """One queued request and its completion rendezvous."""
+
+    x: np.ndarray
+    enqueued: float                       # monotonic
+    deadline: float | None                # monotonic, None = no deadline
+    done: threading.Event = field(default_factory=threading.Event)
+    result: np.ndarray | None = None
+    error: BaseException | None = None
+
+    def finish(self, result=None, error=None) -> None:
+        self.result = result
+        self.error = error
+        self.done.set()
+
+
+class MicroBatcher:
+    """Bounded-queue request coalescer in front of an InferenceEngine.
+
+    ``submit`` blocks the calling thread until its slice of a batch
+    returns (the natural shape for one-thread-per-request HTTP servers);
+    ``submit_async`` returns the ``_Pending`` for callers managing their
+    own waits. One worker thread owns all engine calls.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        max_batch: int | None = None,
+        max_delay_s: float = 0.005,
+        queue_size: int = 64,
+        retry_policy: RetryPolicy | None = None,
+        watchdog=None,
+        poll_s: float = 0.05,
+    ):
+        if queue_size < 1:
+            raise ValueError(f"queue_size must be >= 1, got {queue_size}")
+        if max_delay_s < 0:
+            raise ValueError(f"max_delay_s must be >= 0, got {max_delay_s}")
+        self.engine = engine
+        self.metrics = engine.metrics
+        self.max_batch = int(max_batch or engine.max_bucket)
+        self.max_delay_s = float(max_delay_s)
+        self.queue_size = int(queue_size)
+        self.retry_policy = retry_policy
+        self.watchdog = watchdog
+        self.poll_s = float(poll_s)
+        self.metrics.queue_capacity = self.queue_size
+        self._queue: deque[_Pending] = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._closed = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ntxent-micro-batcher")
+        self._thread.start()
+
+    # -- client side -----------------------------------------------------
+    def submit_async(self, x: np.ndarray,
+                     timeout_s: float | None = None) -> _Pending:
+        x = np.asarray(x)
+        if x.shape[1:] != self.engine.example_shape or x.shape[0] < 1:
+            raise ValueError(
+                f"request must be (n,) + {self.engine.example_shape} with "
+                f"n >= 1, got {x.shape}")
+        now = time.monotonic()
+        pending = _Pending(
+            x=x, enqueued=now,
+            deadline=now + timeout_s if timeout_s is not None else None)
+        with self._lock:
+            # Closed check INSIDE the lock: the worker's exit and close()'s
+            # drain both observe closed-ness under this same lock, so an
+            # append that won the race is guaranteed to be either served
+            # or drained — never stranded.
+            if self._closed.is_set():
+                raise BatcherClosed("batcher is closed")
+            if len(self._queue) >= self.queue_size:
+                self.metrics.request_rejected("queue_full")
+                raise QueueFullError(len(self._queue),
+                                     self._retry_after_s())
+            self._queue.append(pending)
+            self.metrics.set_queue_depth(len(self._queue))
+            self._not_empty.notify()
+        self.metrics.request_accepted()
+        return pending
+
+    def submit(self, x: np.ndarray,
+               timeout_s: float | None = None) -> np.ndarray:
+        """Embed ``x`` (one request, shape ``(n,) + example_shape``).
+
+        Raises ``QueueFullError`` (backpressure), ``DeadlineExceededError``
+        (``timeout_s`` elapsed), or the device call's own error.
+        """
+        pending = self.submit_async(x, timeout_s=timeout_s)
+        start = pending.enqueued
+        # Grace on top of the deadline: the worker expires the request;
+        # the extra poll interval only covers rendezvous scheduling.
+        wait = None if timeout_s is None else timeout_s + 4 * self.poll_s
+        if not pending.done.wait(wait):
+            # Worker wedged past the grace (a stuck device call): surface
+            # a timeout here; the watchdog owns diagnosing the wedge.
+            # Mark dead so the worker expires it at dispatch (which is
+            # also where the rejected_deadline counter is bumped, once).
+            pending.deadline = time.monotonic()
+            self.metrics.request_done((time.monotonic() - start) * 1e3,
+                                      ok=False)
+            raise DeadlineExceededError(
+                f"no result within {timeout_s:.2f}s (+grace)")
+        total_ms = (time.monotonic() - start) * 1e3
+        if pending.error is not None:
+            self.metrics.request_done(total_ms, ok=False)
+            raise pending.error
+        self.metrics.request_done(total_ms, ok=True)
+        return pending.result
+
+    def _retry_after_s(self) -> float:
+        if self.retry_policy is not None:
+            return self.retry_policy.delay_for(1)
+        return max(self.max_delay_s * 4, 0.05)
+
+    # -- worker side -----------------------------------------------------
+    def _take_batch(self) -> list[_Pending]:
+        """Block for a first request, then coalesce until the batch is
+        full or ``max_delay_s`` has passed since the first arrival."""
+        with self._not_empty:
+            while not self._queue:
+                if self._closed.is_set():
+                    return []
+                self._not_empty.wait(self.poll_s)
+                if self.watchdog is not None:
+                    self.watchdog.beat()  # idle is progress, not a stall
+            batch = [self._queue.popleft()]
+        rows = batch[0].x.shape[0]
+        flush_at = time.monotonic() + self.max_delay_s
+        while rows < self.max_batch:
+            with self._not_empty:
+                if not self._queue:
+                    remaining = flush_at - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._not_empty.wait(min(remaining, self.poll_s))
+                    if not self._queue:
+                        if time.monotonic() >= flush_at:
+                            break
+                        continue
+                nxt = self._queue[0]
+                if rows + nxt.x.shape[0] > self.max_batch:
+                    break  # leave it for the next batch, keep FIFO order
+                batch.append(self._queue.popleft())
+            rows += nxt.x.shape[0]
+        with self._lock:
+            self.metrics.set_queue_depth(len(self._queue))
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                if self._closed.is_set():
+                    self._drain("batcher closed")
+                    return
+                continue
+            try:
+                self._serve_batch(batch)
+            except Exception:  # noqa: BLE001 — last-resort shield: the
+                # worker thread must outlive ANY per-batch failure
+                # (_serve_batch already fails the batch's requests; this
+                # catches bugs in the bookkeeping itself — a dead worker
+                # with /healthz still green is the one unacceptable state).
+                logger.exception("serving: batch bookkeeping failed")
+                for p in batch:
+                    if not p.done.is_set():
+                        p.finish(error=RuntimeError("internal batcher "
+                                                    "error (see log)"))
+            if self.watchdog is not None:
+                self.watchdog.beat()  # a completed cycle is real progress
+
+    def _serve_batch(self, batch: list[_Pending]) -> None:
+        now = time.monotonic()
+        live: list[_Pending] = []
+        for p in batch:
+            if p.deadline is not None and now >= p.deadline:
+                # Expired in the queue: complete it WITHOUT device
+                # work (the edge case tests/test_serving.py pins).
+                self.metrics.request_rejected("deadline")
+                p.finish(error=DeadlineExceededError(
+                    "deadline expired while queued "
+                    f"({(now - p.enqueued) * 1e3:.0f}ms waiting)"))
+            else:
+                self.metrics.queue_wait((now - p.enqueued) * 1e3)
+                live.append(p)
+        if not live:
+            return
+        try:
+            # Concatenate INSIDE the shield: a MemoryError on a large
+            # coalesced batch must fail these requests, not the worker.
+            x = (live[0].x if len(live) == 1
+                 else np.concatenate([p.x for p in live]))
+            out = self.engine.embed(x, n_requests=len(live))
+        except Exception as e:  # noqa: BLE001 — fail the batch, not
+            # the worker: the loop must outlive any one bad batch.
+            logger.exception("serving: device call failed for a batch "
+                             "of %d request(s)", len(live))
+            for p in live:
+                p.finish(error=e)
+        else:
+            off = 0
+            for p in live:
+                n = p.x.shape[0]
+                p.finish(result=out[off:off + n])
+                off += n
+
+    def _drain(self, reason: str) -> None:
+        with self._lock:
+            waiting = list(self._queue)
+            self._queue.clear()
+            self.metrics.set_queue_depth(0)
+        for p in waiting:
+            p.finish(error=BatcherClosed(reason))
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Stop the worker; waiting requests fail with BatcherClosed."""
+        self._closed.set()
+        with self._not_empty:
+            self._not_empty.notify_all()
+        self._thread.join(timeout_s)
+        self._drain("batcher closed")
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()

@@ -1,0 +1,219 @@
+"""Shape-bucketed AOT inference engine: the XLA-static-shape answer to
+variable-size embedding requests.
+
+XLA compiles one executable per input shape, so a service that ran the
+encoder at every arriving batch size would recompile on nearly every
+request — seconds of latency, unbounded executable cache growth. TPU
+serving systems solve this with a fixed ladder of compiled shapes and
+padding (the same static-shape discipline Ragged Paged Attention builds
+its whole kernel around, PAPERS.md arxiv 2604.15464). ``InferenceEngine``
+does exactly that for the SimCLR encoder+projection forward:
+
+* a fixed **bucket ladder** of batch sizes (default 1/4/16/64/128);
+  requests pad up to the nearest bucket, oversized requests split into
+  max-bucket chunks plus one tail bucket;
+* executables are **AOT-lowered per bucket** through the same
+  typed-exception fallback path the trainer uses
+  (``training.trainer.aot_compile_with_flops`` — PR 1): where the backend
+  refuses AOT, the engine degrades to per-call jit dispatch, observably,
+  instead of dying;
+* the compiled cache is keyed by ``(bucket, dtype, model_hash)`` so a
+  weight reload (``update_variables``) can never serve a stale
+  executable closed over old constants;
+* ``warmup()`` compiles the whole ladder up front, bounding
+  first-request latency to one device call.
+
+The engine is deliberately synchronous and thread-safe-for-one-caller:
+request coalescing, queuing, and backpressure live one layer up in
+``serving.batcher.MicroBatcher``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from collections.abc import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .metrics import ServingMetrics
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["DEFAULT_BUCKETS", "InferenceEngine"]
+
+DEFAULT_BUCKETS: tuple[int, ...] = (1, 4, 16, 64, 128)
+
+
+def _model_hash(variables, version: int) -> str:
+    """Cheap structural fingerprint of a variables pytree.
+
+    Covers treedef + leaf shapes/dtypes (a different architecture can
+    never collide into a cached executable) plus an explicit reload
+    version — value-only weight swaps keep the same structure, so the
+    counter is what invalidates their cache entries.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(variables)
+    h = hashlib.sha1(str(treedef).encode())
+    for leaf in leaves:
+        h.update(f"{getattr(leaf, 'shape', ())}:"
+                 f"{getattr(leaf, 'dtype', type(leaf))};".encode())
+    h.update(f"v{version}".encode())
+    return h.hexdigest()[:16]
+
+
+class InferenceEngine:
+    """Bucketed, AOT-compiled forward pass over fixed per-example shape.
+
+    ``apply_fn(variables, x) -> (B, D)`` is the pure forward (e.g.
+    ``lambda v, x: model.apply(v, x, train=False, method="features")``).
+    ``example_shape`` is one example's trailing shape, e.g. ``(H, W, C)``.
+    """
+
+    def __init__(
+        self,
+        apply_fn: Callable,
+        variables,
+        example_shape: Sequence[int],
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        dtype=jnp.float32,
+        metrics: ServingMetrics | None = None,
+        retry_policy=None,
+    ):
+        buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"buckets must be positive ints, got {buckets}")
+        self.buckets = buckets
+        self.max_bucket = buckets[-1]
+        self.example_shape = tuple(int(d) for d in example_shape)
+        self.dtype = jnp.dtype(dtype)
+        self.metrics = metrics or ServingMetrics()
+        # resilience.RetryPolicy for transient device faults, applied PER
+        # CHUNK (not per embed) so a retry never re-runs chunks that
+        # already completed and metrics stay single-counted.
+        self.retry_policy = retry_policy
+        self.variables = variables
+        self._version = 0
+        self._hash = _model_hash(variables, self._version)
+        self._jit_fn = jax.jit(apply_fn)
+        self._apply_fn = apply_fn
+        # (bucket, dtype_name, model_hash) -> executable. The dtype and
+        # hash components look redundant for a single-model engine — they
+        # exist so update_variables() invalidates by KEY MISS, never by a
+        # racy clear a concurrent embed could be mid-lookup through.
+        self._cache: dict[tuple, Callable] = {}
+        self._lock = threading.Lock()
+
+    # -- model lifecycle -------------------------------------------------
+    def update_variables(self, variables) -> None:
+        """Swap model weights (e.g. checkpoint reload on a live server).
+
+        Bumps the cache-key version: old executables become unreachable
+        (and are dropped) rather than served against new weights.
+        """
+        with self._lock:
+            self.variables = variables
+            self._version += 1
+            self._hash = _model_hash(variables, self._version)
+            self._cache.clear()
+
+    # -- bucket math -----------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        """Smallest ladder bucket >= n (n must fit the ladder)."""
+        if n < 1:
+            raise ValueError(f"need at least one row, got {n}")
+        if n > self.max_bucket:
+            raise ValueError(f"{n} rows exceed the largest bucket "
+                             f"{self.max_bucket} (chunking is embed()'s "
+                             "job)")
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def _executable(self, bucket: int) -> Callable:
+        key = (bucket, self.dtype.name, self._hash)
+        with self._lock:
+            exe = self._cache.get(key)
+        if exe is not None:
+            self.metrics.compile_cache_hit()
+            return exe
+        # Compile outside the lock (seconds-long); a concurrent miss on
+        # the same key costs one duplicate compile, never a wrong result.
+        x = jnp.zeros((bucket,) + self.example_shape, self.dtype)
+        from ..training.trainer import aot_compile_with_flops
+
+        t0 = time.monotonic()
+        _, compiled = aot_compile_with_flops(self._jit_fn, self.variables, x)
+        if compiled is None:
+            # Typed-exception fallback already logged by the helper:
+            # degrade to the jit wrapper. Prime its dispatch cache now so
+            # the first real request still pays no compile.
+            jax.block_until_ready(self._jit_fn(self.variables, x))
+            compiled = self._jit_fn
+        logger.info("serving: compiled bucket %d (%s) in %.2fs", bucket,
+                    self.dtype.name, time.monotonic() - t0)
+        self.metrics.compiled()
+        with self._lock:
+            exe = self._cache.setdefault(key, compiled)
+        return exe
+
+    # -- public API ------------------------------------------------------
+    def warmup(self) -> None:
+        """Compile and execute every ladder bucket once, so no request
+        ever pays first-compile latency (the /healthz readiness gate)."""
+        for bucket in self.buckets:
+            exe = self._executable(bucket)
+            x = jnp.zeros((bucket,) + self.example_shape, self.dtype)
+            jax.block_until_ready(exe(self.variables, x))
+        logger.info("serving: warmup complete (%d buckets: %s)",
+                    len(self.buckets), list(self.buckets))
+
+    def _embed_chunk(self, x: np.ndarray) -> np.ndarray:
+        n = x.shape[0]
+        bucket = self.bucket_for(n)
+        pad = bucket - n
+        if pad:
+            x = np.concatenate(
+                [x, np.zeros((pad,) + self.example_shape, x.dtype)])
+        exe = self._executable(bucket)
+        xd = jnp.asarray(x, self.dtype)
+
+        def run_once():
+            return jax.block_until_ready(exe(self.variables, xd))
+
+        t0 = time.monotonic()
+        out = (self.retry_policy.call(run_once)
+               if self.retry_policy is not None else run_once())
+        # device_ms spans retries + backoff when they happen: it is the
+        # chunk's observed service time, which is what queue math needs.
+        self.metrics.device_call(bucket, rows_real=n, rows_padded=pad,
+                                 device_ms=(time.monotonic() - t0) * 1e3)
+        return np.asarray(out)[:n]
+
+    def embed(self, x: np.ndarray, n_requests: int = 1) -> np.ndarray:
+        """Embeddings for ``x`` of shape ``(N,) + example_shape``.
+
+        ``N`` may exceed the largest bucket: the batch splits into
+        max-bucket chunks plus one bucketed tail (each chunk is its own
+        device call and metrics record). ``n_requests`` is accounting
+        only — how many coalesced user requests this one dispatch
+        carries (the batch-fill-ratio numerator).
+        """
+        x = np.asarray(x)
+        if x.shape[1:] != self.example_shape:
+            raise ValueError(f"expected trailing shape {self.example_shape},"
+                             f" got {x.shape[1:]}")
+        if x.shape[0] < 1:
+            raise ValueError("need at least one row")
+        self.metrics.dispatch(n_requests)
+        if x.shape[0] <= self.max_bucket:
+            return self._embed_chunk(x)
+        outs = []
+        for start in range(0, x.shape[0], self.max_bucket):
+            outs.append(self._embed_chunk(x[start:start + self.max_bucket]))
+        return np.concatenate(outs)

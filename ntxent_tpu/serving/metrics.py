@@ -1,0 +1,192 @@
+"""Serving observability: one thread-safe registry, JSON out.
+
+The training side already reports steps/s and MFU (utils/profiling.py,
+trainer.train_loop); serving needs a different vocabulary — queue depth,
+batch-fill ratio, padding waste, tail latency — because an embedding
+service lives or dies by its p99 and by how well the micro-batcher
+amortizes device dispatches (DLRM inference studies put batching and
+memory-traffic decisions first; PAPERS.md arxiv 2512.05831). Everything
+here is stdlib: counters and bounded latency windows behind one lock,
+exported as a plain dict so ``/metrics`` can ``json.dumps`` it and
+``scripts/serving_smoke.sh`` can assert on it.
+
+Percentiles are EXACT over a bounded sliding window (default 2048
+samples per series), not bucket-midpoint estimates: a smoke run emits a
+few hundred requests total, where histogram-bucket error would swamp the
+p50/p95 gap the numbers exist to show. The window bounds memory on
+long-lived servers; cumulative count/sum never reset, so rates stay
+computable from deltas.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+__all__ = ["LatencyWindow", "ServingMetrics"]
+
+
+class LatencyWindow:
+    """Cumulative count/sum plus a bounded window for exact percentiles."""
+
+    def __init__(self, window: int = 2048):
+        self.count = 0
+        self.total_ms = 0.0
+        self._window: deque[float] = deque(maxlen=window)
+
+    def record(self, ms: float) -> None:
+        self.count += 1
+        self.total_ms += ms
+        self._window.append(ms)
+
+    def snapshot(self) -> dict:
+        if not self._window:
+            return {"count": self.count}
+        ordered = sorted(self._window)
+        n = len(ordered)
+
+        def pct(q: float) -> float:
+            return ordered[min(n - 1, int(q * n))]
+
+        return {
+            "count": self.count,
+            "mean_ms": round(self.total_ms / self.count, 4),
+            "p50_ms": round(pct(0.50), 4),
+            "p95_ms": round(pct(0.95), 4),
+            "p99_ms": round(pct(0.99), 4),
+            "max_ms": round(ordered[-1], 4),
+            "window": n,
+        }
+
+
+class ServingMetrics:
+    """The serving stack's shared scoreboard.
+
+    Engine, batcher, and server all write here (each holds a reference to
+    the same instance); ``/metrics`` reads ``to_dict()``. One lock guards
+    everything — every operation is a few counter bumps, so contention is
+    noise next to a device call.
+    """
+
+    def __init__(self, latency_window: int = 2048):
+        self._lock = threading.Lock()
+        self.started_at = time.time()
+        # Request lifecycle.
+        self.requests = 0              # accepted into the queue
+        self.responses = 0             # completed (ok)
+        self.errors = 0                # failed after acceptance
+        self.rejected_queue_full = 0   # backpressure rejections
+        self.rejected_deadline = 0     # expired before reaching the device
+        # Coalescing (batcher level: one dispatch = one engine.embed) vs
+        # device dispatch (engine level: one call = one padded bucket; an
+        # oversized dispatch chunks into several). batch_fill_ratio is
+        # requests/DISPATCH — the scheduler's coalescing claim — so
+        # engine-side chunking can't dilute it below 1.
+        self.dispatches = 0            # engine.embed invocations
+        self.requests_coalesced = 0    # requests riding those dispatches
+        self.device_calls = 0          # bucketed executable calls (chunks)
+        self.rows_real = 0             # rows of actual payload sent
+        self.rows_padded = 0           # zero rows added to reach a bucket
+        # Compile-cache behavior (flat compiles after warmup is the
+        # serving_smoke.sh acceptance signal).
+        self.compiles = 0
+        self.compile_cache_hits = 0
+        # Queue gauge (set by the batcher; capacity fixed at wiring time).
+        self.queue_depth = 0
+        self.queue_capacity = 0
+        # Per-bucket dispatch counters: bucket -> [calls, rows_real,
+        # rows_padded].
+        self._buckets: dict[int, list[int]] = {}
+        # Latency series (ms).
+        self.latency = {
+            "total": LatencyWindow(latency_window),       # submit -> result
+            "queue_wait": LatencyWindow(latency_window),  # submit -> dispatch
+            "device": LatencyWindow(latency_window),      # one engine.embed
+        }
+
+    # -- writers ---------------------------------------------------------
+    def request_accepted(self) -> None:
+        with self._lock:
+            self.requests += 1
+
+    def request_done(self, total_ms: float, ok: bool = True) -> None:
+        with self._lock:
+            if ok:
+                self.responses += 1
+            else:
+                self.errors += 1
+            self.latency["total"].record(total_ms)
+
+    def request_rejected(self, reason: str) -> None:
+        with self._lock:
+            if reason == "queue_full":
+                self.rejected_queue_full += 1
+            else:
+                self.rejected_deadline += 1
+
+    def dispatch(self, n_requests: int) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.requests_coalesced += n_requests
+
+    def device_call(self, bucket: int, rows_real: int, rows_padded: int,
+                    device_ms: float) -> None:
+        with self._lock:
+            self.device_calls += 1
+            self.rows_real += rows_real
+            self.rows_padded += rows_padded
+            b = self._buckets.setdefault(int(bucket), [0, 0, 0])
+            b[0] += 1
+            b[1] += rows_real
+            b[2] += rows_padded
+            self.latency["device"].record(device_ms)
+
+    def queue_wait(self, ms: float) -> None:
+        with self._lock:
+            self.latency["queue_wait"].record(ms)
+
+    def compiled(self) -> None:
+        with self._lock:
+            self.compiles += 1
+
+    def compile_cache_hit(self) -> None:
+        with self._lock:
+            self.compile_cache_hits += 1
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = int(depth)
+
+    # -- reader ----------------------------------------------------------
+    def to_dict(self) -> dict:
+        with self._lock:
+            padded_total = self.rows_real + self.rows_padded
+            return {
+                "uptime_s": round(time.time() - self.started_at, 3),
+                "requests": self.requests,
+                "responses": self.responses,
+                "errors": self.errors,
+                "rejected_queue_full": self.rejected_queue_full,
+                "rejected_deadline": self.rejected_deadline,
+                "dispatches": self.dispatches,
+                "device_calls": self.device_calls,
+                "batch_fill_ratio": round(
+                    self.requests_coalesced / self.dispatches, 4)
+                if self.dispatches else None,
+                "padding_waste": round(self.rows_padded / padded_total, 4)
+                if padded_total else None,
+                "queue_depth": self.queue_depth,
+                "queue_capacity": self.queue_capacity,
+                "compile": {
+                    "compiles": self.compiles,
+                    "cache_hits": self.compile_cache_hits,
+                },
+                "buckets": {
+                    str(b): {"calls": v[0], "rows_real": v[1],
+                             "rows_padded": v[2]}
+                    for b, v in sorted(self._buckets.items())
+                },
+                "latency_ms": {name: win.snapshot()
+                               for name, win in self.latency.items()},
+            }

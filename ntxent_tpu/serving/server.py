@@ -1,0 +1,338 @@
+"""Thread-based embedding service loop: /embed, /healthz, /metrics.
+
+Stdlib ``http.server`` only — the serving stack adds no dependencies the
+container doesn't already have (the same no-new-hard-deps rule the rest
+of the framework follows). ``ThreadingHTTPServer`` gives
+one-thread-per-connection, which is exactly the shape ``MicroBatcher``
+wants: every handler thread blocks in ``submit()`` while the single
+worker thread coalesces their requests into device calls.
+
+Supervision reuses the PR 1 resilience layer verbatim rather than
+growing a parallel one:
+
+* ``serve_forever`` runs attempts under ``resilience.Supervisor`` — the
+  same restart-with-backoff harness the trainer uses. Each attempt gets
+  a fresh ``MicroBatcher`` wired to the supervisor's per-attempt
+  ``StallWatchdog``;
+* the batcher beats the watchdog every worker iteration (idle included),
+  so sustained silence isolates one cause: a wedged device call. The
+  watchdog then dumps all thread stacks and escalates through the
+  supervisor's existing stall path (stop the attempt, restart with a
+  fresh batcher and backoff) while the HTTP listener itself stays up and
+  answers 503 between attempts;
+* ``/healthz`` is the readiness/liveness surface: 200 once warm and
+  serving, 503 while stalled, restarting, or draining.
+
+Wire format (JSON in, JSON out; see README "Serving"):
+
+* ``POST /embed``   body ``{"inputs": [[...], ...]}`` — one request of
+  ``(n,) + example_shape`` rows (a single example may omit the leading
+  dim); optional ``"timeout_ms"``. Replies ``{"embeddings": [...],
+  "dim": D, "rows": n}``; 429 + Retry-After on backpressure, 504 on
+  deadline, 400 on malformed input, 413 over the body/row caps, 503
+  while not serving.
+* ``GET /healthz``  ``{"status": "serving"|"stalled"|"unavailable"}``.
+* ``GET /metrics``  the full ``ServingMetrics.to_dict()`` JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..resilience.retry import RetryPolicy
+from ..resilience.supervisor import Supervisor
+from .batcher import (
+    BatcherClosed,
+    DeadlineExceededError,
+    MicroBatcher,
+    QueueFullError,
+)
+from .engine import InferenceEngine
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["EmbeddingServer"]
+
+# Deadline cap: a client asking for a multi-minute wait would hold a
+# handler thread (and its queue slot's worth of patience) hostage.
+MAX_TIMEOUT_S = 60.0
+# Request-size caps: the bounded queue protects device time, but a body
+# has to be parsed BEFORE it can be queued — without caps a multi-GB
+# JSON body (or one merely-huge valid request hogging the single worker
+# through thousands of chunked device calls) exhausts memory or
+# head-of-line-blocks everything without a single 429. Oversized bodies
+# get 413 + Connection: close without being read.
+MAX_BODY_BYTES = 32 << 20
+MAX_REQUEST_ROWS_BUCKETS = 8  # rows cap = this many max-size buckets
+
+
+@dataclass
+class _AttemptState:
+    """Adapter for Supervisor's ``int(state.step) >= num_steps`` check:
+    step 1 = operator-requested shutdown (complete), 0 = fault exit
+    (restart)."""
+
+    step: int
+
+
+class EmbeddingServer:
+    """HTTP front end over InferenceEngine + MicroBatcher, supervised.
+
+    ``start()`` binds the listener and returns (tests; embedding the
+    server in another loop); ``serve_forever()`` additionally runs the
+    supervised attempt loop in the calling thread until ``shutdown()``.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        max_batch: int | None = None,
+        max_delay_s: float = 0.005,
+        queue_size: int = 64,
+        retry_policy: RetryPolicy | None = None,
+        stall_timeout_s: float | None = None,
+        max_restarts: int = 0,
+        default_timeout_s: float = 10.0,
+        max_body_bytes: int = MAX_BODY_BYTES,
+        max_request_rows: int | None = None,
+    ):
+        self.engine = engine
+        self.metrics = engine.metrics
+        self.host, self.port = host, int(port)
+        self._batcher_kwargs = dict(
+            max_batch=max_batch, max_delay_s=max_delay_s,
+            queue_size=queue_size, retry_policy=retry_policy)
+        self.stall_timeout_s = stall_timeout_s
+        self.max_restarts = int(max_restarts)
+        self.default_timeout_s = float(default_timeout_s)
+        self.max_body_bytes = int(max_body_bytes)
+        self.max_request_rows = int(
+            max_request_rows if max_request_rows is not None
+            else MAX_REQUEST_ROWS_BUCKETS * engine.max_bucket)
+        self.batcher: MicroBatcher | None = None
+        self._watchdog = None
+        self._shutdown = threading.Event()
+        self._terminated_clean = False
+        self._httpd: ThreadingHTTPServer | None = None
+        self._http_thread: threading.Thread | None = None
+
+    # -- status ----------------------------------------------------------
+    @property
+    def serving(self) -> bool:
+        return (self.batcher is not None and not self.batcher.closed
+                and not self._shutdown.is_set())
+
+    def status(self) -> str:
+        dog = self._watchdog
+        if dog is not None and dog.stalled.is_set():
+            return "stalled"
+        return "serving" if self.serving else "unavailable"
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "EmbeddingServer":
+        """Bind the listener and spin up one (unsupervised) batcher."""
+        if self._httpd is not None:
+            raise RuntimeError("server already started")
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self.port), _make_handler(self))
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="ntxent-serve-http")
+        self._http_thread.start()
+        if self.batcher is None:
+            self.batcher = MicroBatcher(self.engine,
+                                        **self._batcher_kwargs)
+        logger.info("serving on http://%s:%d (buckets %s)", self.host,
+                    self.port, list(self.engine.buckets))
+        return self
+
+    def serve_forever(self) -> bool:
+        """Supervised serve loop; returns True on clean shutdown.
+
+        Runs attempts under ``resilience.Supervisor``: a stall escalation
+        (or SIGTERM, when called from the main thread) ends the current
+        attempt, its batcher drains, and a fresh one starts after
+        backoff — up to ``max_restarts`` times. The HTTP listener spans
+        attempts; requests between attempts get 503.
+        """
+        if self._httpd is None:
+            self.start()
+        # start() made an unsupervised batcher for the pre-loop window;
+        # attempts own their batcher from here on.
+        if self.batcher is not None:
+            self.batcher.close()
+            self.batcher = None
+
+        def run_attempt(attempt, stop_fn, watchdog):
+            self._watchdog = watchdog
+            self.batcher = MicroBatcher(self.engine, watchdog=watchdog,
+                                        **self._batcher_kwargs)
+            try:
+                while not stop_fn() and not self._shutdown.is_set():
+                    time.sleep(0.05)
+            finally:
+                batcher, self.batcher = self.batcher, None
+                batcher.close()
+            stalled = watchdog is not None and watchdog.fired.is_set()
+            if stop_fn() and not stalled and not self._shutdown.is_set():
+                # stop_fn without a stall escalation = a real SIGTERM
+                # (PreemptionGuard). For a server that means "terminate",
+                # not "restart": latch shutdown. (The guard that saw the
+                # signal reports preempted, which Supervisor never counts
+                # as complete — _terminated_clean is what makes the exit
+                # code right even with zero restart budget.)
+                logger.warning("serving: termination signal — draining "
+                               "and shutting down")
+                self._shutdown.set()
+            if self._shutdown.is_set() and not stalled:
+                self._terminated_clean = True
+            return _AttemptState(
+                step=1 if self._shutdown.is_set() and not stalled else 0), []
+
+        supervisor = Supervisor(
+            run_attempt, num_steps=1, max_restarts=self.max_restarts,
+            stall_timeout_s=self.stall_timeout_s)
+        result = supervisor.run()
+        self.close()
+        # A SIGTERM'd attempt is 'preempted' to the Supervisor (never
+        # complete), but for a server an operator-requested termination
+        # IS the clean outcome.
+        return result.completed or self._terminated_clean
+
+    def shutdown(self) -> None:
+        """Ask the serve loop to exit cleanly (thread-safe)."""
+        self._shutdown.set()
+
+    def close(self) -> None:
+        self._shutdown.set()
+        if self.batcher is not None:
+            self.batcher.close()
+            self.batcher = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._http_thread = None
+
+
+def _make_handler(server: EmbeddingServer):
+    """Handler class closed over the EmbeddingServer (BaseHTTPRequestHandler
+    instantiates per connection, so state must come from the closure)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # Route access logs through logging, not stderr writes.
+        def log_message(self, fmt, *args):  # noqa: N802
+            logger.debug("%s " + fmt, self.address_string(), *args)
+
+        def _reply(self, code: int, payload: dict,
+                   headers: dict | None = None) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/healthz":
+                status = server.status()
+                self._reply(200 if status == "serving" else 503,
+                            {"status": status})
+            elif self.path == "/metrics":
+                self._reply(200, server.metrics.to_dict())
+            else:
+                self._reply(404, {"error": f"no route {self.path!r}"})
+
+        def do_POST(self):  # noqa: N802
+            # Drain the body BEFORE any early reply: with keep-alive
+            # (protocol_version 1.1) an unread body would be parsed as
+            # the next request on the connection — every 404/503 would
+            # poison the client's connection pool.
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                length = 0
+            if length > server.max_body_bytes:
+                # Too big to even read: closing the connection is what
+                # keeps the unread body from desynchronizing keep-alive.
+                self.close_connection = True
+                self._reply(413, {"error": f"body of {length} bytes "
+                                           f"exceeds the "
+                                           f"{server.max_body_bytes}-byte "
+                                           "cap"},
+                            {"Connection": "close"})
+                return
+            body = self.rfile.read(length) if length > 0 else b""
+            if self.path != "/embed":
+                self._reply(404, {"error": f"no route {self.path!r}"})
+                return
+            batcher = server.batcher
+            if batcher is None or batcher.closed:
+                self._reply(503, {"error": "not serving (restarting or "
+                                           "draining)"})
+                return
+            try:
+                req = json.loads(body or b"{}")
+                x = np.asarray(req["inputs"], dtype=np.float32)
+                if x.shape == server.engine.example_shape:
+                    x = x[None]  # single example without the batch dim
+                if x.ndim != 1 + len(server.engine.example_shape):
+                    # Wrong rank (a scalar, a flat list, ...) must land
+                    # in the 400 handler below — the row-cap check would
+                    # otherwise IndexError on shape () and drop the
+                    # connection with no response at all.
+                    raise ValueError(
+                        f"inputs must be shaped (n,) + "
+                        f"{server.engine.example_shape}, got {x.shape}")
+                timeout_s = min(
+                    float(req.get("timeout_ms",
+                                  server.default_timeout_s * 1e3)) / 1e3,
+                    MAX_TIMEOUT_S)
+            except (KeyError, TypeError, ValueError) as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+                return
+            if x.shape[0] > server.max_request_rows:
+                # One request may chunk through the ladder, but not hog
+                # the single device worker indefinitely: deadlines are
+                # only checked at dispatch, so a huge request would
+                # head-of-line-block everyone past any 429.
+                self._reply(413, {"error": f"{x.shape[0]} rows exceed "
+                                           "the per-request cap of "
+                                           f"{server.max_request_rows}; "
+                                           "split the batch client-side"})
+                return
+            try:
+                out = batcher.submit(x, timeout_s=timeout_s)
+            except QueueFullError as e:
+                self._reply(429, {"error": str(e),
+                                  "retry_after_s": e.retry_after_s},
+                            {"Retry-After": f"{e.retry_after_s:.3f}"})
+            except DeadlineExceededError as e:
+                self._reply(504, {"error": str(e)})
+            except ValueError as e:  # wrong trailing shape
+                self._reply(400, {"error": str(e)})
+            except BatcherClosed:
+                self._reply(503, {"error": "not serving (draining)"})
+            except Exception as e:  # noqa: BLE001 — device-call failure
+                logger.exception("serving: /embed failed")
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            else:
+                self._reply(200, {"embeddings": out.tolist(),
+                                  "dim": int(out.shape[-1]),
+                                  "rows": int(out.shape[0])})
+
+    return Handler

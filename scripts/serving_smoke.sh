@@ -1,0 +1,157 @@
+#!/usr/bin/env bash
+# Serving smoke: drive the whole inference stack through the CLI in <60 s
+# on CPU. Boots ntxent-serve on a tiny encoder, fires concurrent
+# mixed-size /embed requests, and asserts the ISSUE 2 acceptance signals
+# from /metrics:
+#   * coalescing works: batch_fill_ratio > 1 request/device-call;
+#   * no recompilation after warmup: the compile count is FLAT between
+#     post-warmup and end-of-load for in-ladder shapes;
+#   * a full queue answers with a 429 backpressure rejection (plus
+#     Retry-After), never a 5xx or unbounded latency.
+# Any 5xx, request timeout, or failed assertion exits nonzero.
+# Pairs with `pytest -m serving` (the same stack asserted in-process).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+workdir="$(mktemp -d)"
+server_pid=""
+cleanup() {
+    [ -n "$server_pid" ] && kill "$server_pid" 2>/dev/null || true
+    [ -n "$server_pid" ] && wait "$server_pid" 2>/dev/null || true
+    rm -rf "$workdir"
+}
+trap cleanup EXIT
+
+log="$workdir/serve.log"
+port_file="$workdir/port"
+
+# Tiny model, tiny ladder, deliberately small queue so the flood phase
+# can actually fill it; --max-delay-ms 25 gives the coalescing window
+# the concurrency phase relies on.
+JAX_PLATFORMS=cpu python - "$port_file" >"$log" 2>&1 <<'PY' &
+import sys
+from ntxent_tpu import cli
+
+# Resolve port 0 to a real port and publish it for the load generator:
+# patch serve_forever's start() path via EmbeddingServer directly is
+# overkill — instead run serve_main with --port 0 and write the bound
+# port from a tiny wrapper around EmbeddingServer.start.
+from ntxent_tpu.serving import server as _srv
+
+port_file = sys.argv[1]
+_orig_start = _srv.EmbeddingServer.start
+
+def start_and_publish(self):
+    _orig_start(self)
+    with open(port_file, "w") as f:
+        f.write(str(self.port))
+    return self
+
+_srv.EmbeddingServer.start = start_and_publish
+sys.exit(cli.serve_main([
+    "--platform", "cpu", "--model", "tiny",
+    "--image-size", "8", "--proj-hidden-dim", "16", "--proj-dim", "8",
+    "--buckets", "1,4,8", "--queue-size", "6", "--max-delay-ms", "25",
+    "--port", "0", "--stall-timeout", "30",
+]))
+PY
+server_pid=$!
+
+# Wait (<=45 s) for warmup + bind; the port file appears once serving.
+for _ in $(seq 90); do
+    [ -s "$port_file" ] && break
+    kill -0 "$server_pid" 2>/dev/null || { echo "server died:"; tail -20 "$log"; exit 1; }
+    sleep 0.5
+done
+[ -s "$port_file" ] || { echo "server never bound:"; tail -20 "$log"; exit 1; }
+port="$(cat "$port_file")"
+
+# Load generator: mixed-size concurrent requests + a flood phase against
+# the 6-deep queue. Asserts every acceptance criterion; exits nonzero on
+# any 5xx or timeout.
+JAX_PLATFORMS=cpu python - "$port" <<'PY'
+import concurrent.futures as cf
+import json
+import sys
+import urllib.error
+import urllib.request
+
+port = sys.argv[1]
+base = f"http://127.0.0.1:{port}"
+
+
+def get(path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def embed(rows, timeout_ms=30000):
+    body = json.dumps({
+        "inputs": [[[[0.5] * 3] * 8] * 8] * rows,
+        "timeout_ms": timeout_ms,
+    }).encode()
+    req = urllib.request.Request(base + "/embed", data=body, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+status, health = get("/healthz")
+assert status == 200 and health["status"] == "serving", health
+
+# Snapshot the compile count AFTER warmup, BEFORE load.
+_, m0 = get("/metrics")
+compiles_after_warmup = m0["compile"]["compiles"]
+assert compiles_after_warmup >= 3, m0["compile"]  # the 1/4/8 ladder
+
+# Phase 1 — concurrent mixed sizes: 36 requests of 1..3 rows from 12
+# threads; the 25 ms window must coalesce some of them.
+sizes = [1, 2, 3] * 12
+with cf.ThreadPoolExecutor(max_workers=12) as pool:
+    results = list(pool.map(embed, sizes))
+bad = [(s, r) for s, r in results if s != 200]
+assert not bad, f"non-200 during concurrency phase: {bad[:3]}"
+for (rows, (_, resp)) in zip(sizes, results):
+    assert resp["rows"] == rows and resp["dim"] > 0, resp
+
+# Coalescing is asserted on the concurrency phase alone: the flood phase
+# below sends single oversized requests (1 request/dispatch by design),
+# which would dilute a whole-run ratio.
+_, m1 = get("/metrics")
+fill = m1["batch_fill_ratio"]
+assert fill is not None and fill > 1.0, \
+    f"no coalescing: batch_fill_ratio={fill} (metrics {m1})"
+
+# Phase 2 — flood the 6-deep queue with slow-lane requests to force
+# backpressure: 48 oversized (32-row) requests from 48 threads. Each one
+# exceeds the largest bucket, so the engine chunks it into 4 device
+# calls — the queue drains far slower than the burst arrives and MUST
+# fill. Expect a mix of 200s and 429s; any 5xx/timeout is a failure.
+with cf.ThreadPoolExecutor(max_workers=48) as pool:
+    flood = list(pool.map(lambda _: embed(32), range(48)))
+codes = sorted(set(s for s, _ in flood))
+assert all(s in (200, 429) for s, _ in flood), f"flood saw {codes}"
+rejected = [r for s, r in flood if s == 429]
+assert rejected, f"queue never filled (codes {codes}) — backpressure untested"
+assert all("retry_after_s" in r for r in rejected), rejected[0]
+
+_, m = get("/metrics")
+assert m["compile"]["compiles"] == compiles_after_warmup, \
+    (f"recompiled under load: {m['compile']['compiles']} vs "
+     f"{compiles_after_warmup} after warmup")
+assert m["rejected_queue_full"] == len(rejected), m["rejected_queue_full"]
+assert m["responses"] >= 36, m["responses"]
+
+lat = m["latency_ms"]["total"]
+print(f"serving smoke: OK — fill_ratio={fill} "
+      f"compiles={m['compile']['compiles']} (flat after warmup) "
+      f"rejected_429={len(rejected)} p50={lat.get('p50_ms')}ms "
+      f"p99={lat.get('p99_ms')}ms")
+PY
+
+kill "$server_pid"
+wait "$server_pid" 2>/dev/null || true
+server_pid=""
+echo "serving smoke: OK"

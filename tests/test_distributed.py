@@ -167,7 +167,9 @@ def test_local_row_gids_cover_global_range(mesh):
     from jax.sharding import PartitionSpec as P
 
     n_local = 4
-    gids = jax.shard_map(
+    from ntxent_tpu.parallel.mesh import shard_map as shard_map_compat
+
+    gids = shard_map_compat(
         lambda: local_row_gids("data", n_local, jax.device_count()).reshape(1, -1),
         mesh=mesh, in_specs=(), out_specs=P("data"),
     )()

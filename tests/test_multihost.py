@@ -58,8 +58,10 @@ _WORKER = textwrap.dedent("""
         idx = jax.lax.axis_index("data").astype(jnp.float32)
         return jax.lax.psum(idx + 1.0, "data")
 
+    from ntxent_tpu.parallel.mesh import shard_map as shard_map_compat
+
     summed = jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=(), out_specs=P()))()
+        shard_map_compat(body, mesh=mesh, in_specs=(), out_specs=P()))()
     # Devices 0..3 contribute axis_index+1 → 1+2+3+4 = 10; devices 2,3
     # live in the other process, so a wrong fabric cannot produce 10.
     assert float(summed) == 10.0, float(summed)

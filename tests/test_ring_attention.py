@@ -166,7 +166,9 @@ def test_ring_memory_never_gathers_kv(mesh):
             vg = jax.lax.all_gather(vv, "data", axis=1, tiled=True)
             return attention_oracle(qq, kg, vg)
 
-        return jax.shard_map(
+        from ntxent_tpu.parallel.mesh import shard_map as shard_map_compat
+
+        return shard_map_compat(
             body, mesh=mesh,
             in_specs=(P(None, "data"),) * 3, out_specs=P(None, "data"),
             check_vma=False)(qq, kk, vv)
