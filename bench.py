@@ -54,6 +54,39 @@ SENTINEL = "NTXENT_BENCH_RESULT:"
 # ops.autotune._resolve_budget_s — one place for every sweep entry
 # point) plus compile + warmup + the timed protocol.
 CHILD_TIMEOUT_S = float(os.environ.get("NTXENT_BENCH_TIMEOUT_S", "700"))
+PROGRESS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "PROGRESS.jsonl")
+
+
+def _record_progress(record: dict) -> None:
+    """Append the bench record to PROGRESS.jsonl through the obs
+    EventLog writer (ISSUE 3: bench results ride the same typed-JSONL
+    stream as run telemetry, with run/timestamp identity for free).
+
+    obs/events.py is loaded BY FILE PATH: importing the ntxent_tpu
+    package would pull JAX into this parent process, and the parent's
+    no-JAX rule is what keeps a wedged backend from hanging the one
+    driver-visible deliverable. Best-effort by design — a read-only
+    checkout must not fail the bench.
+    """
+    try:
+        import importlib.util
+
+        events_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "ntxent_tpu", "obs", "events.py")
+        spec = importlib.util.spec_from_file_location(
+            "_ntxent_obs_events", events_path)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        log = module.EventLog(PROGRESS_PATH)
+        try:
+            log.emit("bench", **record)
+        finally:
+            log.close()
+    except Exception as e:  # never fail the bench over bookkeeping
+        print(f"note: PROGRESS.jsonl append skipped ({e})",
+              file=sys.stderr)
 
 
 def _child() -> None:
@@ -287,6 +320,7 @@ def _serving_main() -> None:
     with open(out, "w") as f:
         json.dump(payload, f, indent=2, sort_keys=True)
         f.write("\n")
+    _record_progress(payload)
     print(json.dumps(payload))
 
 
@@ -399,6 +433,7 @@ def main() -> None:
             "vs_baseline": 0.0,
             "error": diag,
         }
+    _record_progress(record)
     print(json.dumps(record))
 
 

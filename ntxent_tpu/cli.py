@@ -203,6 +203,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoint after attempt A; implies supervision "
                         "(uses --max-restarts attempts)")
 
+    o = p.add_argument_group("observability (ntxent_tpu/obs/: metrics "
+                             "registry, JSONL event log, profiler)")
+    o.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve the metrics registry over HTTP on this "
+                        "port (/metrics: Prometheus text, ?format=json "
+                        "for JSON; /healthz); 0 picks a free port "
+                        "(logged at startup)")
+    o.add_argument("--log-jsonl", default=None, metavar="PATH",
+                   help="append typed JSONL events (step timeline, "
+                        "retries, divergence, restarts, checkpoints, "
+                        "compiles, traces) to this file")
+    o.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="arm on-demand jax.profiler capture into DIR: a "
+                        "step slower than --slow-step-factor x the "
+                        "rolling median (or touching DIR/TRIGGER, or "
+                        "SIGUSR2) captures the next --trace-steps steps")
+    o.add_argument("--trace-steps", type=int, default=5,
+                   help="steps per profiler capture window")
+    o.add_argument("--slow-step-factor", type=float, default=3.0,
+                   help="slow-step trigger threshold (x rolling median "
+                        "device time; warmup/compile steps never fire it)")
+
     dist = p.add_argument_group("distributed (multi-host rendezvous; "
                                 "single-host multi-chip needs no flags)")
     dist.add_argument("--dcn-slices", type=int, default=1,
@@ -326,6 +348,64 @@ def _make_injector(args):
         raise SystemExit(f"--chaos: {e}")
     logger.warning("chaos mode: %s", plan)
     return FaultInjector(plan)
+
+
+class _ObsContext:
+    """What --metrics-port/--log-jsonl/--trace-dir wired up (inert when
+    none was given); closed by _run_fit's epilogue."""
+
+    def __init__(self):
+        self.event_log = None
+        self.server = None
+        self.profiler = None
+        self.timeline = None
+
+    def close(self) -> None:
+        if self.timeline is not None:
+            self.timeline.close()  # ends any in-flight profiler capture
+        if self.server is not None:
+            self.server.close()
+        if self.event_log is not None:
+            from ntxent_tpu import obs
+
+            obs.install(None)
+            self.event_log.close()
+
+
+def _setup_observability(args) -> _ObsContext:
+    """Telemetry wiring from the observability flag group.
+
+    Any one flag turns the layer on: an EventLog is installed process-
+    wide (so resilience/checkpoint instrumentation publishes even when
+    only --metrics-port was given — their counters need the registry,
+    their events need a log) and a StepTimeline is handed to the train
+    loop. With no flag at all, training keeps the zero-per-step-sync
+    fast path: no timeline, no block_until_ready per step.
+    """
+    ctx = _ObsContext()
+    metrics_port = getattr(args, "metrics_port", None)
+    log_jsonl = getattr(args, "log_jsonl", None)
+    trace_dir = getattr(args, "trace_dir", None)
+    if metrics_port is None and not log_jsonl and not trace_dir:
+        return ctx
+    from ntxent_tpu import obs
+
+    ctx.event_log = obs.EventLog(log_jsonl)  # path None: in-memory tail
+    obs.install(ctx.event_log)
+    logger.info("telemetry: run_id=%s%s", ctx.event_log.run_id,
+                f" events -> {log_jsonl}" if log_jsonl else "")
+    if metrics_port is not None:
+        ctx.server = obs.MetricsServer(port=metrics_port).start()
+    if trace_dir:
+        ctx.profiler = obs.ProfilerTrigger(
+            trace_dir, slow_factor=args.slow_step_factor,
+            capture_steps=args.trace_steps)
+        ctx.profiler.install_sigusr2()
+        logger.info("profiler armed: traces -> %s (touch %s or SIGUSR2 "
+                    "for a manual capture)", trace_dir,
+                    ctx.profiler.trigger_file)
+    ctx.timeline = obs.StepTimeline(profiler=ctx.profiler)
+    return ctx
 
 
 def _make_step_guard(nan_policy: str):
@@ -679,66 +759,74 @@ def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
     from ntxent_tpu.training import PreemptionGuard, fit
     from ntxent_tpu.utils import StallWatchdog
 
+    obs_ctx = _setup_observability(args)
+    timeline = obs_ctx.timeline
     ckpt_kwargs = dict(
         checkpoint_verify_writes=not getattr(args, "no_ckpt_verify", False),
         checkpoint_retry_policy=RetryPolicy(
             max_attempts=3, base_delay_s=0.5, max_delay_s=10.0,
             seed=args.seed))
     max_restarts = getattr(args, "max_restarts", 0)
-    if max_restarts <= 0 and injector is None:
-        watchdog = (StallWatchdog(timeout_s=args.stall_timeout)
-                    if getattr(args, "stall_timeout", None) else None)
-        with PreemptionGuard() as guard, \
-                (watchdog or contextlib.nullcontext()):
-            state, history = fit(
-                state, data, step, num_steps=args.steps,
-                checkpoint_dir=args.ckpt_dir,
-                checkpoint_every=args.ckpt_every,
-                log_every=args.log_every, stop_fn=guard.requested,
-                watchdog=watchdog, step_guard=step_guard, **ckpt_kwargs)
-        _log_final(history)
-        if guard.preempted:
-            logger.warning("run was preempted; checkpoint saved at step "
-                           "%d — relaunch with the same flags to resume",
-                           int(state.step))
+    try:
+        if max_restarts <= 0 and injector is None:
+            watchdog = (StallWatchdog(timeout_s=args.stall_timeout)
+                        if getattr(args, "stall_timeout", None) else None)
+            with PreemptionGuard() as guard, \
+                    (watchdog or contextlib.nullcontext()):
+                state, history = fit(
+                    state, data, step, num_steps=args.steps,
+                    checkpoint_dir=args.ckpt_dir,
+                    checkpoint_every=args.ckpt_every,
+                    log_every=args.log_every, stop_fn=guard.requested,
+                    watchdog=watchdog, step_guard=step_guard,
+                    timeline=timeline, **ckpt_kwargs)
+            _log_final(history)
+            if guard.preempted:
+                logger.warning("run was preempted; checkpoint saved at "
+                               "step %d — relaunch with the same flags "
+                               "to resume", int(state.step))
+            return 0
+
+        from ntxent_tpu.resilience import Supervisor
+
+        if args.ckpt_dir is None:
+            logger.warning("supervised run without --ckpt-dir: every "
+                           "restart begins again from step 0 (no "
+                           "checkpoint to resume from)")
+        if injector is not None:
+            data = injector.wrap_iterator(data)
+        first_state = state
+
+        def run_attempt(attempt, stop_fn, watchdog):
+            s = first_state if attempt == 0 or state_factory is None \
+                else state_factory()
+            if step_guard is not None:
+                step_guard.reset_attempt()
+            return fit(s, data, step, num_steps=args.steps,
+                       checkpoint_dir=args.ckpt_dir,
+                       checkpoint_every=args.ckpt_every,
+                       log_every=args.log_every, stop_fn=stop_fn,
+                       watchdog=watchdog, step_guard=step_guard,
+                       timeline=timeline, **ckpt_kwargs)
+
+        supervisor = Supervisor(
+            run_attempt, num_steps=args.steps,
+            checkpoint_dir=args.ckpt_dir,
+            max_restarts=max_restarts,
+            stall_timeout_s=getattr(args, "stall_timeout", None),
+            injector=injector)
+        result = supervisor.run()
+        _log_final(result.histories[-1] if result.histories else [])
+        if injector is not None and injector.fired:
+            logger.info("chaos faults fired: %s",
+                        ", ".join(injector.fired))
+        if not result.completed:
+            logger.error("supervised run did NOT reach step %d (restart "
+                         "budget exhausted)", args.steps)
+            return 1
         return 0
-
-    from ntxent_tpu.resilience import Supervisor
-
-    if args.ckpt_dir is None:
-        logger.warning("supervised run without --ckpt-dir: every restart "
-                       "begins again from step 0 (no checkpoint to "
-                       "resume from)")
-    if injector is not None:
-        data = injector.wrap_iterator(data)
-    first_state = state
-
-    def run_attempt(attempt, stop_fn, watchdog):
-        s = first_state if attempt == 0 or state_factory is None \
-            else state_factory()
-        if step_guard is not None:
-            step_guard.reset_attempt()
-        return fit(s, data, step, num_steps=args.steps,
-                   checkpoint_dir=args.ckpt_dir,
-                   checkpoint_every=args.ckpt_every,
-                   log_every=args.log_every, stop_fn=stop_fn,
-                   watchdog=watchdog, step_guard=step_guard,
-                   **ckpt_kwargs)
-
-    supervisor = Supervisor(
-        run_attempt, num_steps=args.steps, checkpoint_dir=args.ckpt_dir,
-        max_restarts=max_restarts,
-        stall_timeout_s=getattr(args, "stall_timeout", None),
-        injector=injector)
-    result = supervisor.run()
-    _log_final(result.histories[-1] if result.histories else [])
-    if injector is not None and injector.fired:
-        logger.info("chaos faults fired: %s", ", ".join(injector.fired))
-    if not result.completed:
-        logger.error("supervised run did NOT reach step %d (restart "
-                     "budget exhausted)", args.steps)
-        return 1
-    return 0
+    finally:
+        obs_ctx.close()
 
 
 def _build_clip_model(args):

@@ -1,0 +1,72 @@
+"""Unified telemetry: one registry, one event stream, shared exporters.
+
+The observability layer the north star's "production-scale" claim
+requires (ISSUE 3). Until this package, only serving had metrics (an
+isolated JSON dict) while training observability ended at log lines —
+a stalled or slowly-degrading run could not be diagnosed after the
+fact. The pieces:
+
+* ``registry.MetricsRegistry`` — process-wide counters / gauges /
+  exact-window histograms (``default_registry()``); training,
+  resilience, and serving all publish here;
+* ``events.EventLog`` — typed JSONL records (``step``, ``retry``,
+  ``divergence``, ``restart``, ``checkpoint``, ``compile``, ``trace``)
+  with monotonic timestamps and run/attempt ids; ``install``/``emit``
+  is the process-wide hub deep instrumentation sites use;
+* ``timeline.StepTimeline`` — the per-step training breakdown
+  (data-wait vs device vs checkpoint time, steps/sec, MFU) feeding both
+  of the above;
+* ``profiler.ProfilerTrigger`` — on-demand ``jax.profiler`` capture
+  (slow-step rolling-median trigger, trigger file, SIGUSR2);
+* ``exporters.MetricsServer`` — Prometheus text / JSON over stdlib
+  HTTP (``ntxent-train --metrics-port``); the serving server's
+  ``/metrics`` negotiates the same two formats over the same registry.
+
+Everything here is stdlib except the profiler (lazy jax import), so
+the package is importable — and scrapeable — from processes that never
+initialize a backend (bench.py's parent).
+"""
+
+from .events import (
+    EVENT_TYPES,
+    EventLog,
+    emit,
+    get_event_log,
+    install,
+    read_events,
+    set_attempt,
+)
+from .exporters import PROMETHEUS_CONTENT_TYPE, MetricsServer, choose_format
+from .profiler import ProfilerTrigger
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    prometheus_name,
+    quantile,
+)
+from .timeline import StepTimeline
+
+__all__ = [
+    "EVENT_TYPES",
+    "EventLog",
+    "emit",
+    "get_event_log",
+    "install",
+    "read_events",
+    "set_attempt",
+    "PROMETHEUS_CONTENT_TYPE",
+    "MetricsServer",
+    "choose_format",
+    "ProfilerTrigger",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "prometheus_name",
+    "quantile",
+    "StepTimeline",
+]

@@ -1,0 +1,231 @@
+"""Structured event log: typed JSONL records with run/attempt identity.
+
+The machine-readable counterpart of the framework's log lines (ISSUE 3):
+the divergence guard, RetryPolicy, checkpoint manager, supervisor, and
+the per-step training timeline all append here, so a stalled or
+slowly-degrading run can be diagnosed AFTER the fact from one stream
+instead of grepping free-form logger output.
+
+Record shape (one JSON object per line)::
+
+    {"event": "step", "t": 12.345678, "wall": 1791234567.123,
+     "run_id": "a1b2c3d4", "attempt": 0, ...event-specific fields}
+
+* ``t`` is a MONOTONIC offset (seconds since the log opened): ordering
+  and intervals survive wall-clock jumps (NTP slew mid-run must not
+  reorder a timeline); ``wall`` is epoch time for cross-run correlation.
+* ``run_id`` is fixed per EventLog; ``attempt`` is bumped by the
+  supervisor at restart boundaries (``set_attempt``), so records from a
+  rolled-back attempt are distinguishable from its replacement's.
+* Core event types are ``EVENT_TYPES``; unknown types are accepted (the
+  stream is extensible — bench records ride the same writer) but typos
+  in the core vocabulary would be silent, so callers should prefer it.
+
+The writer is thread-safe and append-only; each record is one
+``write()`` of a complete line onto a line-buffered handle, so
+concurrent writers (watchdog thread, checkpoint thread, train loop)
+never interleave bytes and a reader can tail the file mid-run.
+
+Mirror-to-logger mode (``mirror_logger=True``) duplicates every record
+onto ``logging`` as ``key=value`` pairs via
+``utils.logging_utils.format_kv`` — human-greppable without running a
+JSON parser over the console.
+
+A process-wide hub (``install``/``get_event_log``/``emit``) lets deep
+instrumentation sites (retry loops, the watchdog thread) publish without
+plumbing an EventLog handle through every constructor; with nothing
+installed, ``emit`` is a cheap no-op.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+import time
+import uuid
+from collections import deque
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["EVENT_TYPES", "EventLog", "install", "get_event_log", "emit",
+           "set_attempt", "read_events"]
+
+# The core vocabulary. step: one completed train step's timeline.
+# retry: a transient fault survived by RetryPolicy. divergence: a
+# non-finite step (guarded skip/backoff/rollback, or observed unguarded).
+# restart: a supervisor attempt boundary. checkpoint: save/restore/
+# fallback/delete. compile: an AOT step compile. trace: a profiler
+# capture artifact.
+EVENT_TYPES = ("step", "retry", "divergence", "restart", "checkpoint",
+               "compile", "trace")
+
+
+class EventLog:
+    """Append-only typed JSONL writer with optional logger mirror.
+
+    ``path=None`` keeps records in a bounded in-memory tail only (tests;
+    metrics-only runs) — ``emit`` stays cheap either way.
+    """
+
+    def __init__(self, path: str | None = None, run_id: str | None = None,
+                 mirror_logger: bool = False, tail: int = 256):
+        self.path = path
+        self.run_id = run_id or uuid.uuid4().hex[:8]
+        self.mirror_logger = mirror_logger
+        self._attempt = 0
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._counts: dict[str, int] = {}
+        self._tail: deque[dict] = deque(maxlen=tail)
+        self._fh = None
+        if path is not None:
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            # Line-buffered append: one write per record, tail-able live.
+            self._fh = open(path, "a", buffering=1)
+
+    # -- identity --------------------------------------------------------
+    def set_attempt(self, attempt: int) -> None:
+        """Stamp subsequent records with a supervisor attempt ordinal."""
+        with self._lock:
+            self._attempt = int(attempt)
+
+    @property
+    def attempt(self) -> int:
+        return self._attempt
+
+    # -- writing ---------------------------------------------------------
+    def emit(self, event: str, **fields) -> dict:
+        """Append one record; returns the record (tests; chaining)."""
+        record = {
+            "event": str(event),
+            "t": round(time.monotonic() - self._t0, 6),
+            "wall": round(time.time(), 6),
+            "run_id": self.run_id,
+            "attempt": self._attempt,
+            **fields,
+        }
+        # Serialize only when a sink will consume the bytes: the
+        # path=None metrics-only mode promises emit stays cheap.
+        line = (json.dumps(_sanitize(record), sort_keys=False,
+                           default=_jsonable)
+                if self._fh is not None else None)
+        with self._lock:
+            self._counts[record["event"]] = \
+                self._counts.get(record["event"], 0) + 1
+            self._tail.append(record)
+            if self._fh is not None and line is not None:
+                try:
+                    self._fh.write(line + "\n")
+                except OSError as e:  # a full disk must not kill training
+                    logger.error("event log write failed (%s); record "
+                                 "dropped: %s", e, line[:200])
+        if self.mirror_logger:
+            # Lazy import keeps this module loadable WITHOUT package
+            # context (bench.py's parent loads it by file path so the
+            # JAX-importing package __init__ never runs there).
+            from ..utils.logging_utils import format_kv
+
+            logger.info("%s", format_kv(record))
+        return record
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def tail(self, n: int = 20) -> list[dict]:
+        with self._lock:
+            return list(self._tail)[-n:]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
+
+    def __enter__(self) -> "EventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _sanitize(obj):
+    """Strict-JSON safety, enforced HERE for every emitter: the format
+    has no NaN/inf literal, so non-finite floats become their repr
+    strings instead of json.dumps's invalid bare ``NaN`` tokens (one
+    rule at the write point, not re-implemented per call site)."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else repr(obj)
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    return obj
+
+
+def _jsonable(value):
+    """Last-resort JSON coercion: numpy/jax scalars -> finite float,
+    everything else -> repr (an unserializable field must not drop the
+    record, and must not smuggle a bare NaN past _sanitize either)."""
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return repr(value)
+    return f if math.isfinite(f) else repr(f)
+
+
+def read_events(path: str, event: str | None = None) -> list[dict]:
+    """Parse a JSONL event file (optionally one event type); skips
+    corrupt lines rather than failing the whole read — a live tail can
+    catch a record mid-write only if the writer died inside write()."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except ValueError:
+                continue
+            if event is None or record.get("event") == event:
+                out.append(record)
+    return out
+
+
+# -- process-wide hub ----------------------------------------------------
+_hub_lock = threading.Lock()
+_event_log: EventLog | None = None
+
+
+def install(event_log: EventLog | None) -> EventLog | None:
+    """Install (or clear, with None) the process-wide event log; returns
+    the previous one so tests can restore it."""
+    global _event_log
+    with _hub_lock:
+        previous, _event_log = _event_log, event_log
+    return previous
+
+
+def get_event_log() -> EventLog | None:
+    return _event_log
+
+
+def emit(event: str, **fields) -> None:
+    """Publish to the installed event log, if any (cheap no-op
+    otherwise) — the spelling deep instrumentation sites use."""
+    log = _event_log
+    if log is not None:
+        log.emit(event, **fields)
+
+
+def set_attempt(attempt: int) -> None:
+    log = _event_log
+    if log is not None:
+        log.set_attempt(attempt)

@@ -1,0 +1,205 @@
+"""On-demand jax.profiler capture: slow-step trigger + manual triggers.
+
+Kernel- and comms-level tuning (Ragged Paged Attention, EQuARX — see
+PAPERS.md) is only actionable with a device trace of the BAD steps, and
+the bad steps are rare: tracing a whole multi-day run is not an option,
+and by the time a human attaches a profiler the anomaly is gone.
+``ProfilerTrigger`` watches the per-step device time the StepTimeline
+feeds it and captures exactly the interesting window:
+
+* **slow-step trigger** — a step slower than ``slow_factor`` x the
+  rolling-median step time starts a ``jax.profiler`` trace of the NEXT
+  ``capture_steps`` steps into ``trace_dir``. The median is over a
+  bounded window, so gradual drift re-baselines; arming waits for
+  ``warmup_steps`` SAMPLES so the step-1 AOT compile (orders of
+  magnitude over steady state, and entirely expected) can never fire it.
+* **manual triggers** — touching ``<trace_dir>/TRIGGER`` (checked once
+  per step: one ``os.path.exists`` of host-side cost) or sending
+  SIGUSR2 (installed only from the main thread) requests a capture of
+  the next window, for "it feels slow right now" operator moments.
+
+Every capture appends a ``trace`` event pointing at the artifact
+directory, so the JSONL stream records both that a capture happened and
+where to load it (TensorBoard/XProf). Profiler failures are logged and
+disable further captures — diagnosis must never take training down.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import statistics
+import threading
+import time
+from collections import deque
+
+from . import events
+from .registry import default_registry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ProfilerTrigger"]
+
+
+class ProfilerTrigger:
+    """Feed ``on_step(step, duration_ms)`` once per step; captures fire
+    on the following steps. Thread-safe (the manual ``request`` may come
+    from a signal handler or another thread)."""
+
+    def __init__(self, trace_dir: str, slow_factor: float = 3.0,
+                 capture_steps: int = 5, warmup_steps: int = 5,
+                 window: int = 50, trigger_file: str | None = None,
+                 registry=None):
+        if slow_factor <= 1.0:
+            raise ValueError(f"slow_factor must be > 1, got {slow_factor}")
+        if capture_steps < 1:
+            raise ValueError("capture_steps must be >= 1")
+        self.trace_dir = str(trace_dir)
+        self.slow_factor = float(slow_factor)
+        self.capture_steps = int(capture_steps)
+        self.warmup_steps = int(warmup_steps)
+        self.trigger_file = (trigger_file if trigger_file is not None
+                             else os.path.join(self.trace_dir, "TRIGGER"))
+        os.makedirs(self.trace_dir, exist_ok=True)  # TRIGGER touchable
+        self._window: deque[float] = deque(maxlen=window)
+        self._lock = threading.Lock()
+        # Set by the SIGUSR2 handler WITHOUT taking the lock: the signal
+        # runs on the main thread, which may already hold self._lock
+        # inside on_step — request() there would self-deadlock. A bare
+        # attribute store is atomic; on_step consumes it lock-free.
+        self._signal_pending = False
+        self._requested: str | None = None   # pending capture reason
+        self._active_dir: str | None = None  # capture in flight
+        self._remaining = 0
+        self._started_step = 0
+        self._last_step = 0
+        self._disabled = False
+        self._captures = (registry or default_registry()).counter(
+            "profiler_captures_total", "on-demand jax.profiler captures")
+
+    # -- triggers --------------------------------------------------------
+    def request(self, reason: str = "manual") -> None:
+        """Ask for a capture of the next ``capture_steps`` steps
+        (idempotent while one is pending/active)."""
+        with self._lock:
+            if self._requested is None and self._active_dir is None:
+                self._requested = reason
+
+    def install_sigusr2(self) -> bool:
+        """SIGUSR2 -> request(); False when not installable (non-main
+        thread, e.g. a supervised attempt worker)."""
+        import signal
+
+        def on_signal(*_):
+            # Flag only — no lock: the handler can interrupt on_step
+            # while it already holds self._lock (see __init__).
+            self._signal_pending = True
+
+        try:
+            signal.signal(signal.SIGUSR2, on_signal)
+            return True
+        except ValueError:
+            logger.warning("SIGUSR2 trigger unavailable off the main "
+                           "thread; use the trigger file %s",
+                           self.trigger_file)
+            return False
+
+    def _check_trigger_file(self) -> None:
+        try:
+            if not os.path.exists(self.trigger_file):
+                return
+            # Consume the file only when the request can actually be
+            # accepted: removing it during an active/pending capture
+            # would silently drop the operator's ask — leaving it in
+            # place coalesces it into the next free window instead.
+            with self._lock:
+                busy = (self._requested is not None
+                        or self._active_dir is not None)
+            if busy:
+                return
+            os.remove(self.trigger_file)
+            self.request("trigger_file")
+        except OSError:
+            pass
+
+    # -- per-step driver -------------------------------------------------
+    def on_step(self, step: int, duration_ms: float) -> None:
+        if self._disabled:
+            return
+        if self._signal_pending:
+            self._signal_pending = False
+            self.request("sigusr2")
+        self._check_trigger_file()
+        self._last_step = int(step)
+        with self._lock:
+            if self._active_dir is not None:
+                self._remaining -= 1
+                if self._remaining <= 0:
+                    self._stop_locked(step)
+                # Captured steps stay out of the baseline window: trace
+                # overhead inflates them, and a capture must not shift
+                # the very median it was judged against.
+                return
+            baseline = (statistics.median(self._window)
+                        if len(self._window) >= self.warmup_steps else None)
+            reason = self._requested
+            if reason is None and baseline is not None \
+                    and duration_ms > self.slow_factor * baseline:
+                reason = (f"slow_step:{duration_ms:.1f}ms>"
+                          f"{self.slow_factor:g}x median "
+                          f"{baseline:.1f}ms")
+            if reason is not None:
+                self._requested = None
+                self._start_locked(step, reason)
+                return
+            self._window.append(duration_ms)
+
+    # -- capture lifecycle (lock held) -----------------------------------
+    def _start_locked(self, step: int, reason: str) -> None:
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        target = os.path.join(self.trace_dir, f"step{step}-{stamp}")
+        try:
+            import jax
+
+            os.makedirs(target, exist_ok=True)
+            jax.profiler.start_trace(target)
+        except Exception as e:
+            logger.error("profiler capture failed to start (%s: %s) — "
+                         "disabling further captures", type(e).__name__, e)
+            self._disabled = True
+            return
+        self._active_dir = target
+        self._remaining = self.capture_steps
+        self._started_step = step
+        logger.warning("profiler: capturing %d steps to %s (%s)",
+                       self.capture_steps, target, reason)
+        events.emit("trace", action="start", step=int(step),
+                    reason=reason, trace_dir=target,
+                    capture_steps=self.capture_steps)
+
+    def _stop_locked(self, step: int) -> None:
+        target, self._active_dir = self._active_dir, None
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:
+            logger.error("profiler stop_trace failed (%s: %s) — "
+                         "disabling further captures", type(e).__name__, e)
+            self._disabled = True
+            return
+        self._captures.inc()
+        logger.info("profiler: capture complete -> %s", target)
+        # The trigger step itself is not captured (capture covers the
+        # NEXT steps), so coverage is the span after _started_step.
+        events.emit("trace", action="complete", step=int(step),
+                    trace_dir=target,
+                    steps_captured=int(step) - self._started_step)
+
+    def close(self) -> None:
+        """End any in-flight capture (run teardown); the `complete`
+        event reports how far the truncated capture actually got."""
+        with self._lock:
+            if self._active_dir is not None:
+                self._stop_locked(max(self._last_step,
+                                      self._started_step))

@@ -1,0 +1,176 @@
+"""Per-step training timeline: where each step's wall clock actually went.
+
+``trainer.train_loop`` reports loss and steps/sec at log points; the
+timeline makes every step's breakdown machine-readable (ISSUE 3): how
+long the host waited on the input pipeline (``data_wait_ms``), how long
+the device ran (``device_ms`` — the loop calls ``block_until_ready``
+when a timeline is attached, the same documented per-step host sync a
+step_guard already costs), and how long the step hook (checkpoint
+cadence) took (``checkpoint_ms``). Each step lands in the process-wide
+MetricsRegistry (histograms + gauges) and, when an EventLog is
+installed, as one ``step`` event per ``event_every`` steps.
+
+The timeline is also where two cross-cutting signals hang:
+
+* **unguarded divergence observation** — the timeline reads the loss
+  every step anyway, so a non-finite loss on a step WITHOUT the jit-side
+  guard (no ``step_ok`` metric) still produces a ``divergence`` event
+  and bumps the divergence counter; guarded runs get richer events from
+  resilience.DivergenceGuard instead (``step_ok`` present suppresses
+  the duplicate here);
+* **slow-step profiler trigger** — per-step device time feeds the
+  attached ``ProfilerTrigger`` (obs/profiler.py), which captures a
+  jax.profiler trace when a step blows past its rolling median.
+
+MFU: ``set_flops_per_step`` (train_loop forwards XLA's compiled cost
+analysis) divided by the accelerator's peak — resolved lazily through
+``trainer.peak_flops_per_chip`` so this module stays importable without
+JAX.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+
+from . import events
+from .registry import MetricsRegistry, default_registry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["StepTimeline"]
+
+
+class StepTimeline:
+    """Collects per-step timings from ``train_loop`` and publishes them.
+
+    One instance per run (attempts share it: counters and the profiler's
+    rolling window deliberately survive supervisor restarts, while the
+    event log's ``attempt`` field distinguishes the records).
+    """
+
+    def __init__(self, registry: MetricsRegistry | None = None,
+                 profiler=None, event_every: int = 1,
+                 histogram_window: int = 2048):
+        self.registry = registry or default_registry()
+        self.profiler = profiler
+        self.event_every = max(1, int(event_every))
+        self.flops_per_step: float | None = None
+        self._peak_flops: float | None = None
+        self._last_done: float | None = None
+        r = self.registry
+        self._steps = r.counter(
+            "train_steps_total", "completed train steps")
+        self._divergence = r.counter(
+            "train_divergence_total",
+            "steps whose loss or grad norm was non-finite")
+        self._data_wait = r.histogram(
+            "train_step_data_wait_ms",
+            "host wait on the input pipeline per step",
+            window=histogram_window)
+        self._device = r.histogram(
+            "train_step_device_ms",
+            "device time per step (block_until_ready bracketed)",
+            window=histogram_window)
+        # NB no per-step checkpoint histogram: most steps' hook time is
+        # a microsecond no-op (the cadence filter saves rarely), so a
+        # window of them would bury the real saves. checkpoint_save_ms
+        # (training/checkpoint.py) measures actual saves; the per-step
+        # hook time still rides every `step` event as checkpoint_ms.
+        self._sps = r.gauge(
+            "train_steps_per_sec", "instantaneous steps per second")
+        self._loss = r.gauge("train_loss", "last step's loss")
+        self._mfu = r.gauge(
+            "train_mfu", "model FLOP utilization (0..1)")
+
+    # -- wiring ----------------------------------------------------------
+    def set_flops_per_step(self, flops: float | None) -> None:
+        self.flops_per_step = flops
+
+    def new_attempt(self) -> None:
+        """Reset the inter-step clock at a loop/attempt boundary
+        (train_loop calls this on entry): without it, the first step
+        after a supervisor restart would compute steps_per_sec over the
+        whole backoff+restore+recompile gap — near-zero throughput
+        reported at exactly the moment an operator inspects the run."""
+        self._last_done = None
+
+    def _mfu_of(self, steps_per_sec: float) -> float | None:
+        if not self.flops_per_step:
+            return None
+        if self._peak_flops is None:
+            try:  # lazy: keeps obs importable without JAX
+                from ..training.trainer import peak_flops_per_chip
+
+                self._peak_flops = peak_flops_per_chip()
+            except Exception:
+                self._peak_flops = float("nan")
+        if not math.isfinite(self._peak_flops):
+            return None
+        return self.flops_per_step * steps_per_sec / self._peak_flops
+
+    def record_compile(self, duration_ms: float,
+                       flops: float | None) -> None:
+        """One AOT step compile (train_loop's step-1 auto path)."""
+        self.registry.counter(
+            "train_compiles_total", "AOT train-step compiles").inc()
+        events.emit("compile", duration_ms=round(duration_ms, 3),
+                    flops=flops)
+
+    # -- per step --------------------------------------------------------
+    def record_step(self, step: int, loss: float,
+                    data_wait_s: float, device_s: float,
+                    hook_s: float = 0.0, ok: bool | None = None,
+                    grad_norm: float | None = None) -> None:
+        """One completed step. ``ok=None`` means the step carried no
+        jit-side guard (unguarded fast path)."""
+        now = time.perf_counter()
+        if self._last_done is not None:
+            wall_s = max(now - self._last_done, 1e-9)
+        else:
+            wall_s = max(data_wait_s + device_s + hook_s, 1e-9)
+        self._last_done = now
+        steps_per_sec = 1.0 / wall_s
+
+        self._steps.inc()
+        self._data_wait.observe(data_wait_s * 1e3)
+        self._device.observe(device_s * 1e3)
+        self._sps.set(steps_per_sec)
+        if math.isfinite(loss):
+            self._loss.set(loss)
+        mfu = self._mfu_of(steps_per_sec)
+        if mfu is not None:
+            self._mfu.set(mfu)
+
+        diverged = not math.isfinite(loss) or (ok is False)
+        if diverged:
+            self._divergence.inc()
+        if step % self.event_every == 0 or diverged:
+            # Non-finite loss/grad_norm floats are stringified by the
+            # EventLog itself (events._sanitize) — no per-site handling.
+            fields = dict(step=int(step), loss=float(loss),
+                          data_wait_ms=round(data_wait_s * 1e3, 3),
+                          device_ms=round(device_s * 1e3, 3),
+                          checkpoint_ms=round(hook_s * 1e3, 3),
+                          steps_per_sec=round(steps_per_sec, 4))
+            if mfu is not None:
+                fields["mfu"] = round(mfu, 4)
+            if grad_norm is not None:
+                fields["grad_norm"] = float(grad_norm)
+            if ok is not None:
+                fields["ok"] = bool(ok)
+            events.emit("step", **fields)
+        if diverged and ok is None:
+            # Unguarded step: nobody else will record this. Guarded
+            # steps get their divergence event from DivergenceGuard
+            # (richer: tier decisions, scale), so skip the duplicate.
+            events.emit("divergence", action="observed", step=int(step),
+                        loss=float(loss), guarded=False)
+
+        if self.profiler is not None:
+            self.profiler.on_step(int(step), device_s * 1e3)
+
+    def close(self) -> None:
+        if self.profiler is not None:
+            self.profiler.close()

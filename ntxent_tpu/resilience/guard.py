@@ -38,9 +38,26 @@ from __future__ import annotations
 
 import logging
 
+from ..obs import events as obs_events
+from ..obs.registry import default_registry
+
 logger = logging.getLogger(__name__)
 
 __all__ = ["DivergenceError", "DivergenceGuard"]
+
+# Registry series (ISSUE 3): the guard's decisions were previously
+# logger-only; a post-hoc diagnosis needs them countable and scrapeable.
+_SKIPS = default_registry().counter(
+    "train_divergence_skips_total",
+    "non-finite steps skipped by the in-step guard")
+_BACKOFFS = default_registry().counter(
+    "train_divergence_backoffs_total",
+    "gradient-scale backoff escalations")
+_ROLLBACKS = default_registry().counter(
+    "train_divergence_rollbacks_total",
+    "DivergenceError rollbacks raised to the supervisor")
+_SCALE = default_registry().gauge(
+    "train_grad_scale", "current divergence-guard gradient scale")
 
 
 class DivergenceError(RuntimeError):
@@ -75,6 +92,9 @@ class DivergenceGuard:
         self.regrow_after = regrow_after
         self.min_scale = min_scale
         self.scale = float(init_scale)
+        # Publish the starting scale: a healthy run that never backs
+        # off must scrape 1.0 (init_scale), not the gauge's 0.0 default.
+        _SCALE.set(self.scale)
         self.consecutive_skips = 0
         self.total_skips = 0
         self._healthy_streak = 0
@@ -92,6 +112,20 @@ class DivergenceGuard:
         self.total_skips = 0
         self._healthy_streak = 0
 
+    def _emit(self, action: str, outcome) -> None:
+        # Non-finite loss/grad_norm floats are stringified by the
+        # EventLog itself (obs.events._sanitize).
+        obs_events.emit(
+            "divergence", action=action, step=int(outcome.step),
+            loss=outcome.loss, grad_norm=outcome.grad_norm,
+            consecutive=self.consecutive_skips,
+            total=self.total_skips, scale=self.scale, guarded=True)
+
+    def _rollback(self, outcome, message: str) -> None:
+        _ROLLBACKS.inc()
+        self._emit("rollback", outcome)
+        raise DivergenceError(message)
+
     def __call__(self, outcome) -> None:
         if outcome.ok:
             self.consecutive_skips = 0
@@ -100,6 +134,7 @@ class DivergenceGuard:
                     and self._healthy_streak >= self.regrow_after:
                 self.scale = min(1.0, self.scale / self.backoff_factor)
                 self._healthy_streak = 0
+                _SCALE.set(self.scale)
                 logger.info("divergence guard: %d healthy steps — scale "
                             "regrown to %g", self.regrow_after, self.scale)
             return
@@ -107,6 +142,7 @@ class DivergenceGuard:
         self._healthy_streak = 0
         self.consecutive_skips += 1
         self.total_skips += 1
+        _SKIPS.inc()
         logger.warning(
             "divergence guard: non-finite step %d skipped (loss=%s, "
             "grad_norm=%s; %d consecutive, %d total)", outcome.step,
@@ -114,21 +150,29 @@ class DivergenceGuard:
             self.total_skips)
         if self.rollback_after is not None \
                 and self.total_skips >= self.rollback_after:
-            raise DivergenceError(
+            self._rollback(outcome, (
                 f"{self.total_skips} non-finite steps this attempt "
                 f"(budget {self.rollback_after}): rolling back to the "
-                "last valid checkpoint")
+                "last valid checkpoint"))
         if self.backoff_after is not None \
                 and self.consecutive_skips >= self.backoff_after \
                 and self.consecutive_skips % self.backoff_after == 0:
             self.scale *= self.backoff_factor
+            _BACKOFFS.inc()
+            _SCALE.set(self.scale)  # may be re-set below after clamping
             logger.warning("divergence guard: %d consecutive skips — "
                            "gradient scale backed off to %g",
                            self.consecutive_skips, self.scale)
             if self.scale < self.min_scale:
                 if self.rollback_after is not None:
-                    raise DivergenceError(
+                    self._rollback(outcome, (
                         f"gradient scale {self.scale:g} collapsed below "
                         f"{self.min_scale:g}: rolling back to the last "
-                        "valid checkpoint")
+                        "valid checkpoint"))
                 self.scale = self.min_scale
+            # Publish AFTER the min_scale clamp: the gauge must report
+            # the scale the traced step will actually use.
+            _SCALE.set(self.scale)
+            self._emit("backoff", outcome)
+        else:
+            self._emit("skip", outcome)

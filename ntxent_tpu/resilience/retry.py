@@ -30,7 +30,19 @@ import time
 from collections.abc import Callable
 from typing import Any
 
+from ..obs import events as obs_events
+from ..obs.registry import default_registry
+
 logger = logging.getLogger(__name__)
+
+# Registry series (ISSUE 3): a retried transient is SURVIVED, which is
+# exactly why the log line alone vanishes — after the fact only a
+# counter (and the `retry` event) shows a run was limping.
+_RETRIES = default_registry().counter(
+    "retries_total", "transient faults retried by RetryPolicy")
+_EXHAUSTED = default_registry().counter(
+    "retries_exhausted_total",
+    "RetryPolicy give-ups (attempts or wall-clock budget spent)")
 
 __all__ = ["RetryPolicy", "RetryBudgetExceeded", "DEFAULT_TRANSIENT"]
 
@@ -101,20 +113,30 @@ class RetryPolicy:
             try:
                 return fn(*args, **kwargs)
             except self.retry_on as e:
+                name = getattr(fn, "__name__", repr(fn))
                 if attempt >= self.max_attempts:
+                    _EXHAUSTED.inc()
                     raise
                 delay = self.delay_for(attempt)
                 if self.budget_s is not None and \
                         self.monotonic() - start + delay > self.budget_s:
+                    _EXHAUSTED.inc()
                     raise RetryBudgetExceeded(
                         f"retry budget {self.budget_s:.1f}s exhausted after "
                         f"{attempt} attempt(s) of "
                         f"{getattr(fn, '__name__', fn)!r}") from e
+                _RETRIES.inc()
+                # NB "attempt" is the record's supervisor-attempt id;
+                # the retry ordinal ships as call_attempt.
+                obs_events.emit(
+                    "retry", fn=name, call_attempt=attempt,
+                    max_attempts=self.max_attempts,
+                    error=f"{type(e).__name__}: {e}",
+                    delay_s=round(delay, 4))
                 logger.warning(
                     "transient failure in %r (attempt %d/%d): %s — "
                     "retrying in %.2fs",
-                    getattr(fn, "__name__", fn), attempt, self.max_attempts,
-                    e, delay)
+                    name, attempt, self.max_attempts, e, delay)
                 self.sleep(delay)
         raise AssertionError("unreachable")  # pragma: no cover
 

@@ -36,11 +36,19 @@ import logging
 import time
 from collections.abc import Callable
 
+from ..obs import events as obs_events
+from ..obs.registry import default_registry
 from ..training.preemption import PreemptionGuard
 from ..utils.watchdog import StallWatchdog
 from .retry import RetryPolicy
 
 logger = logging.getLogger(__name__)
+
+_RESTARTS = default_registry().counter(
+    "supervisor_restarts_total",
+    "in-process restarts after a detected fault")
+_ATTEMPTS = default_registry().counter(
+    "supervisor_attempts_total", "supervised attempts started")
 
 __all__ = ["AttemptRecord", "Supervisor", "SupervisorResult"]
 
@@ -127,6 +135,11 @@ class Supervisor:
         for attempt in range(total_attempts):
             guard = PreemptionGuard()
             self._guard = guard
+            _ATTEMPTS.inc()
+            # Stamp subsequent event-log records with this attempt's
+            # ordinal (rollback replays repeat step numbers; the attempt
+            # id is what keeps the timeline unambiguous).
+            obs_events.set_attempt(attempt)
             error: str | None = None
             stalled = False
             attempt_state = None
@@ -167,6 +180,11 @@ class Supervisor:
             if self.injector is not None:
                 self.injector.between_attempts(self.checkpoint_dir)
             delay = self.backoff.delay_for(attempt + 1)
+            _RESTARTS.inc()
+            obs_events.emit(
+                "restart", attempt=attempt, end_step=end_step,
+                preempted=bool(guard.preempted), stalled=bool(stalled),
+                error=error, delay_s=round(delay, 4))
             logger.warning(
                 "supervisor: attempt %d/%d ended at step %s "
                 "(preempted=%s, stalled=%s, error=%s) — restarting from "

@@ -1,192 +1,299 @@
-"""Serving observability: one thread-safe registry, JSON out.
+"""Serving observability, now published through obs.MetricsRegistry.
 
-The training side already reports steps/s and MFU (utils/profiling.py,
-trainer.train_loop); serving needs a different vocabulary — queue depth,
-batch-fill ratio, padding waste, tail latency — because an embedding
-service lives or dies by its p99 and by how well the micro-batcher
-amortizes device dispatches (DLRM inference studies put batching and
-memory-traffic decisions first; PAPERS.md arxiv 2512.05831). Everything
-here is stdlib: counters and bounded latency windows behind one lock,
-exported as a plain dict so ``/metrics`` can ``json.dumps`` it and
-``scripts/serving_smoke.sh`` can assert on it.
+The serving vocabulary is unchanged — queue depth, batch-fill ratio,
+padding waste, exact-window tail latency (DLRM inference studies put
+batching and memory-traffic decisions first; PAPERS.md arxiv
+2512.05831) — but the store is no longer a private dict: every series
+lives in a ``MetricsRegistry`` (ISSUE 3), so serving and training share
+one exporter path (JSON and Prometheus text are two views of the same
+objects, and ``/metrics?format=prometheus`` needs no serving-specific
+renderer).
 
-Percentiles are EXACT over a bounded sliding window (default 2048
-samples per series), not bucket-midpoint estimates: a smoke run emits a
-few hundred requests total, where histogram-bucket error would swamp the
-p50/p95 gap the numbers exist to show. The window bounds memory on
-long-lived servers; cumulative count/sum never reset, so rates stay
-computable from deltas.
+This also fixes the old scrape cost: ``to_dict()`` used to rebuild the
+whole export under ONE lock that every writer also contended for; now
+each metric guards only itself and a scrape reads them one at a time —
+no double-locking, no stop-the-world snapshot. The p50/p95/p99 rule
+previously private to ``LatencyWindow`` is the registry Histogram's
+single-source ``quantile`` (obs/registry.py), shared with the training
+timeline.
+
+``LatencyWindow`` remains as the ms-flavored Histogram the serving wire
+format always exposed (count / mean_ms / p50_ms / p95_ms / p99_ms /
+max_ms / window); percentiles are EXACT over a bounded window, and
+cumulative count/sum never reset, exactly as before.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import deque
+
+from ..obs.registry import Histogram, MetricsRegistry
 
 __all__ = ["LatencyWindow", "ServingMetrics"]
 
 
-class LatencyWindow:
-    """Cumulative count/sum plus a bounded window for exact percentiles."""
+class LatencyWindow(Histogram):
+    """Millisecond-unit Histogram with the serving snapshot shape."""
 
-    def __init__(self, window: int = 2048):
-        self.count = 0
-        self.total_ms = 0.0
-        self._window: deque[float] = deque(maxlen=window)
+    def __init__(self, window: int = 2048, name: str = "latency_ms",
+                 labels: dict | None = None):
+        super().__init__(name, labels=labels, window=window)
 
-    def record(self, ms: float) -> None:
-        self.count += 1
-        self.total_ms += ms
-        self._window.append(ms)
+    @property
+    def total_ms(self) -> float:
+        return self.total
 
     def snapshot(self) -> dict:
-        if not self._window:
-            return {"count": self.count}
-        ordered = sorted(self._window)
-        n = len(ordered)
-
-        def pct(q: float) -> float:
-            return ordered[min(n - 1, int(q * n))]
-
-        return {
-            "count": self.count,
-            "mean_ms": round(self.total_ms / self.count, 4),
-            "p50_ms": round(pct(0.50), 4),
-            "p95_ms": round(pct(0.95), 4),
-            "p99_ms": round(pct(0.99), 4),
-            "max_ms": round(ordered[-1], 4),
-            "window": n,
-        }
+        return self.snapshot_ms()
 
 
 class ServingMetrics:
-    """The serving stack's shared scoreboard.
+    """The serving stack's shared scoreboard, registry-backed.
 
-    Engine, batcher, and server all write here (each holds a reference to
-    the same instance); ``/metrics`` reads ``to_dict()``. One lock guards
-    everything — every operation is a few counter bumps, so contention is
-    noise next to a device call.
+    Engine, batcher, and server all write here (each holds a reference
+    to the same instance); ``/metrics`` reads ``to_dict()`` (JSON) or
+    renders ``self.registry`` (Prometheus). Writer methods are a few
+    per-metric counter bumps — contention is noise next to a device
+    call.
+
+    ``registry=None`` creates a private registry: several stacks can
+    coexist in one process (tests) without cross-counting. Pass
+    ``obs.default_registry()`` to join the process-wide export.
     """
 
-    def __init__(self, latency_window: int = 2048):
-        self._lock = threading.Lock()
+    def __init__(self, latency_window: int = 2048,
+                 registry: MetricsRegistry | None = None):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
         self.started_at = time.time()
-        # Request lifecycle.
-        self.requests = 0              # accepted into the queue
-        self.responses = 0             # completed (ok)
-        self.errors = 0                # failed after acceptance
-        self.rejected_queue_full = 0   # backpressure rejections
-        self.rejected_deadline = 0     # expired before reaching the device
+        r = self.registry
+        self._requests = r.counter(
+            "serving_requests_total", "requests accepted into the queue")
+        self._responses = r.counter(
+            "serving_responses_total", "requests completed ok")
+        self._errors = r.counter(
+            "serving_errors_total", "requests failed after acceptance")
+        self._rejected_queue_full = r.counter(
+            "serving_rejected_queue_full_total",
+            "backpressure rejections (429)")
+        self._rejected_deadline = r.counter(
+            "serving_rejected_deadline_total",
+            "requests expired before reaching the device (504)")
         # Coalescing (batcher level: one dispatch = one engine.embed) vs
-        # device dispatch (engine level: one call = one padded bucket; an
-        # oversized dispatch chunks into several). batch_fill_ratio is
-        # requests/DISPATCH — the scheduler's coalescing claim — so
+        # device dispatch (engine level: one call = one padded bucket;
+        # an oversized dispatch chunks into several). batch_fill_ratio
+        # is requests/DISPATCH — the scheduler's coalescing claim — so
         # engine-side chunking can't dilute it below 1.
-        self.dispatches = 0            # engine.embed invocations
-        self.requests_coalesced = 0    # requests riding those dispatches
-        self.device_calls = 0          # bucketed executable calls (chunks)
-        self.rows_real = 0             # rows of actual payload sent
-        self.rows_padded = 0           # zero rows added to reach a bucket
-        # Compile-cache behavior (flat compiles after warmup is the
-        # serving_smoke.sh acceptance signal).
-        self.compiles = 0
-        self.compile_cache_hits = 0
-        # Queue gauge (set by the batcher; capacity fixed at wiring time).
-        self.queue_depth = 0
-        self.queue_capacity = 0
-        # Per-bucket dispatch counters: bucket -> [calls, rows_real,
-        # rows_padded].
-        self._buckets: dict[int, list[int]] = {}
-        # Latency series (ms).
+        self._dispatches = r.counter(
+            "serving_dispatches_total", "engine.embed invocations")
+        self._requests_coalesced = r.counter(
+            "serving_requests_coalesced_total",
+            "requests riding those dispatches")
+        self._device_calls = r.counter(
+            "serving_device_calls_total",
+            "bucketed executable calls (chunks)")
+        self._rows_real = r.counter(
+            "serving_rows_real_total", "rows of actual payload sent")
+        self._rows_padded = r.counter(
+            "serving_rows_padded_total",
+            "zero rows added to reach a bucket")
+        self._compiles = r.counter(
+            "serving_compiles_total", "bucket executable compiles")
+        self._compile_cache_hits = r.counter(
+            "serving_compile_cache_hits_total",
+            "bucket executable cache hits")
+        self._queue_depth = r.gauge(
+            "serving_queue_depth", "requests waiting in the queue")
+        self._queue_capacity = r.gauge(
+            "serving_queue_capacity", "bounded queue capacity")
+        # Derived gauges kept current at write time so the Prometheus
+        # rendering carries them too (the smoke test asserts
+        # batch_fill_ratio appears in BOTH formats).
+        self._fill_ratio = r.gauge(
+            "serving_batch_fill_ratio",
+            "requests per dispatch (coalescing factor)")
+        self._padding_waste = r.gauge(
+            "serving_padding_waste", "padded-row fraction of device rows")
         self.latency = {
-            "total": LatencyWindow(latency_window),       # submit -> result
-            "queue_wait": LatencyWindow(latency_window),  # submit -> dispatch
-            "device": LatencyWindow(latency_window),      # one engine.embed
+            name: r.histogram("serving_latency_ms",
+                              "request latency by stage",
+                              labels={"stage": name},
+                              window=latency_window)
+            for name in ("total", "queue_wait", "device")
         }
+        # bucket -> (calls, rows_real, rows_padded) labeled counters;
+        # created on first use (the ladder is not known here).
+        self._bucket_lock = threading.Lock()
+        self._buckets: dict[int, tuple] = {}
+
+    # -- compatibility readers (engine/bench read these directly) --------
+    @property
+    def requests(self) -> int:
+        return int(self._requests.value)
+
+    @property
+    def responses(self) -> int:
+        return int(self._responses.value)
+
+    @property
+    def errors(self) -> int:
+        return int(self._errors.value)
+
+    @property
+    def rejected_queue_full(self) -> int:
+        return int(self._rejected_queue_full.value)
+
+    @property
+    def rejected_deadline(self) -> int:
+        return int(self._rejected_deadline.value)
+
+    @property
+    def dispatches(self) -> int:
+        return int(self._dispatches.value)
+
+    @property
+    def requests_coalesced(self) -> int:
+        return int(self._requests_coalesced.value)
+
+    @property
+    def device_calls(self) -> int:
+        return int(self._device_calls.value)
+
+    @property
+    def rows_real(self) -> int:
+        return int(self._rows_real.value)
+
+    @property
+    def rows_padded(self) -> int:
+        return int(self._rows_padded.value)
+
+    @property
+    def compiles(self) -> int:
+        return int(self._compiles.value)
+
+    @property
+    def compile_cache_hits(self) -> int:
+        return int(self._compile_cache_hits.value)
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self._queue_depth.value)
+
+    @property
+    def queue_capacity(self) -> int:
+        return int(self._queue_capacity.value)
+
+    @queue_capacity.setter
+    def queue_capacity(self, value: int) -> None:
+        # The batcher assigns this as a plain attribute at wiring time.
+        self._queue_capacity.set(int(value))
 
     # -- writers ---------------------------------------------------------
     def request_accepted(self) -> None:
-        with self._lock:
-            self.requests += 1
+        self._requests.inc()
 
     def request_done(self, total_ms: float, ok: bool = True) -> None:
-        with self._lock:
-            if ok:
-                self.responses += 1
-            else:
-                self.errors += 1
-            self.latency["total"].record(total_ms)
+        (self._responses if ok else self._errors).inc()
+        self.latency["total"].observe(total_ms)
 
     def request_rejected(self, reason: str) -> None:
-        with self._lock:
-            if reason == "queue_full":
-                self.rejected_queue_full += 1
-            else:
-                self.rejected_deadline += 1
+        if reason == "queue_full":
+            self._rejected_queue_full.inc()
+        else:
+            self._rejected_deadline.inc()
 
     def dispatch(self, n_requests: int) -> None:
-        with self._lock:
-            self.dispatches += 1
-            self.requests_coalesced += n_requests
+        self._dispatches.inc()
+        self._requests_coalesced.inc(n_requests)
+        self._fill_ratio.set(
+            self._requests_coalesced.value / self._dispatches.value)
+
+    def _bucket_counters(self, bucket: int) -> tuple:
+        with self._bucket_lock:
+            counters = self._buckets.get(bucket)
+            if counters is None:
+                labels = {"bucket": str(int(bucket))}
+                counters = (
+                    self.registry.counter(
+                        "serving_bucket_calls_total",
+                        "device calls per ladder bucket", labels=labels),
+                    self.registry.counter(
+                        "serving_bucket_rows_real_total",
+                        "real rows per ladder bucket", labels=labels),
+                    self.registry.counter(
+                        "serving_bucket_rows_padded_total",
+                        "padded rows per ladder bucket", labels=labels),
+                )
+                self._buckets[bucket] = counters
+            return counters
 
     def device_call(self, bucket: int, rows_real: int, rows_padded: int,
                     device_ms: float) -> None:
-        with self._lock:
-            self.device_calls += 1
-            self.rows_real += rows_real
-            self.rows_padded += rows_padded
-            b = self._buckets.setdefault(int(bucket), [0, 0, 0])
-            b[0] += 1
-            b[1] += rows_real
-            b[2] += rows_padded
-            self.latency["device"].record(device_ms)
+        self._device_calls.inc()
+        self._rows_real.inc(rows_real)
+        self._rows_padded.inc(rows_padded)
+        calls, real, padded = self._bucket_counters(int(bucket))
+        calls.inc()
+        real.inc(rows_real)
+        padded.inc(rows_padded)
+        self.latency["device"].observe(device_ms)
+        total = self._rows_real.value + self._rows_padded.value
+        if total:
+            self._padding_waste.set(self._rows_padded.value / total)
 
     def queue_wait(self, ms: float) -> None:
-        with self._lock:
-            self.latency["queue_wait"].record(ms)
+        self.latency["queue_wait"].observe(ms)
 
     def compiled(self) -> None:
-        with self._lock:
-            self.compiles += 1
+        self._compiles.inc()
 
     def compile_cache_hit(self) -> None:
-        with self._lock:
-            self.compile_cache_hits += 1
+        self._compile_cache_hits.inc()
 
     def set_queue_depth(self, depth: int) -> None:
-        with self._lock:
-            self.queue_depth = int(depth)
+        self._queue_depth.set(int(depth))
 
-    # -- reader ----------------------------------------------------------
+    # -- readers ---------------------------------------------------------
     def to_dict(self) -> dict:
-        with self._lock:
-            padded_total = self.rows_real + self.rows_padded
-            return {
-                "uptime_s": round(time.time() - self.started_at, 3),
-                "requests": self.requests,
-                "responses": self.responses,
-                "errors": self.errors,
-                "rejected_queue_full": self.rejected_queue_full,
-                "rejected_deadline": self.rejected_deadline,
-                "dispatches": self.dispatches,
-                "device_calls": self.device_calls,
-                "batch_fill_ratio": round(
-                    self.requests_coalesced / self.dispatches, 4)
-                if self.dispatches else None,
-                "padding_waste": round(self.rows_padded / padded_total, 4)
-                if padded_total else None,
-                "queue_depth": self.queue_depth,
-                "queue_capacity": self.queue_capacity,
-                "compile": {
-                    "compiles": self.compiles,
-                    "cache_hits": self.compile_cache_hits,
-                },
-                "buckets": {
-                    str(b): {"calls": v[0], "rows_real": v[1],
-                             "rows_padded": v[2]}
-                    for b, v in sorted(self._buckets.items())
-                },
-                "latency_ms": {name: win.snapshot()
-                               for name, win in self.latency.items()},
-            }
+        """The JSON wire shape (unchanged keys), assembled metric by
+        metric — no single scrape-wide lock."""
+        rows_real, rows_padded = self.rows_real, self.rows_padded
+        dispatches = self.dispatches
+        padded_total = rows_real + rows_padded
+        with self._bucket_lock:
+            bucket_items = sorted(self._buckets.items())
+        return {
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "requests": self.requests,
+            "responses": self.responses,
+            "errors": self.errors,
+            "rejected_queue_full": self.rejected_queue_full,
+            "rejected_deadline": self.rejected_deadline,
+            "dispatches": dispatches,
+            "device_calls": self.device_calls,
+            "batch_fill_ratio": round(
+                self.requests_coalesced / dispatches, 4)
+            if dispatches else None,
+            "padding_waste": round(rows_padded / padded_total, 4)
+            if padded_total else None,
+            "queue_depth": self.queue_depth,
+            "queue_capacity": self.queue_capacity,
+            "compile": {
+                "compiles": self.compiles,
+                "cache_hits": self.compile_cache_hits,
+            },
+            "buckets": {
+                str(b): {"calls": int(calls.value),
+                         "rows_real": int(real.value),
+                         "rows_padded": int(padded.value)}
+                for b, (calls, real, padded) in bucket_items
+            },
+            "latency_ms": {name: win.snapshot_ms()
+                           for name, win in self.latency.items()},
+        }
+
+    def render_prometheus(self) -> str:
+        """Exposition-format text for everything in this stack's
+        registry (the serving /metrics content-negotiation target)."""
+        return self.registry.render_prometheus()

@@ -43,9 +43,11 @@ import threading
 import time
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
 
 import numpy as np
 
+from ..obs.exporters import PROMETHEUS_CONTENT_TYPE, choose_format
 from ..resilience.retry import RetryPolicy
 from ..resilience.supervisor import Supervisor
 from .batcher import (
@@ -248,12 +250,29 @@ def _make_handler(server: EmbeddingServer):
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802
-            if self.path == "/healthz":
+            route = urlparse(self.path).path
+            if route == "/healthz":
                 status = server.status()
                 self._reply(200 if status == "serving" else 503,
                             {"status": status})
-            elif self.path == "/metrics":
-                self._reply(200, server.metrics.to_dict())
+            elif route == "/metrics":
+                # Content negotiation (ISSUE 3): JSON stays the default
+                # (existing dashboards/smoke parse it); a Prometheus
+                # scraper gets the SAME values from the same registry
+                # via ?format=prometheus or its Accept header.
+                fmt = choose_format(self.path,
+                                    self.headers.get("Accept"),
+                                    default="json")
+                if fmt == "prometheus":
+                    body = server.metrics.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     PROMETHEUS_CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._reply(200, server.metrics.to_dict())
             else:
                 self._reply(404, {"error": f"no route {self.path!r}"})
 

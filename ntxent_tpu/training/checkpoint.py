@@ -24,17 +24,36 @@ import json
 import logging
 import os
 import shutil
+import time
 import zlib
 from pathlib import Path
 from typing import Any
 
 import orbax.checkpoint as ocp
 
+from ..obs import events as obs_events
+from ..obs.registry import default_registry
 from ..resilience.retry import RetryBudgetExceeded
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["CheckpointManager"]
+
+# Registry series (ISSUE 3): save/restore/CRC-fallback used to be
+# logger-only, so a run quietly skipping every save (full disk, bad
+# mount) was indistinguishable from a healthy one on any scrape.
+_SAVES = default_registry().counter(
+    "checkpoint_saves_total", "successful checkpoint saves")
+_SAVE_FAILURES = default_registry().counter(
+    "checkpoint_save_failures_total",
+    "checkpoint saves skipped on filesystem errors")
+_RESTORES = default_registry().counter(
+    "checkpoint_restores_total", "checkpoint restores")
+_FALLBACKS = default_registry().counter(
+    "checkpoint_corrupt_fallbacks_total",
+    "corrupt checkpoints skipped by the restore CRC fallback")
+_SAVE_MS = default_registry().histogram(
+    "checkpoint_save_ms", "wall time of one checkpoint save")
 
 _MANIFEST_NAME = "manifests.json"
 
@@ -212,6 +231,7 @@ class CheckpointManager:
                 data_state=ocp.args.JsonSave(data_state))
         else:
             args = ocp.args.StandardSave(state)
+        t0 = time.perf_counter()
         try:
             saved = self._call(self.manager.save, step, args=args,
                                force=force)
@@ -223,6 +243,9 @@ class CheckpointManager:
             logger.error("checkpoint save at step %d failed (%s: %s) — "
                          "continuing without it", step,
                          type(e).__name__, e)
+            _SAVE_FAILURES.inc()
+            obs_events.emit("checkpoint", action="save", step=int(step),
+                            ok=False, error=f"{type(e).__name__}: {e}")
             return False
         if saved:
             if self.verify_writes:
@@ -231,6 +254,13 @@ class CheckpointManager:
                 except OSError as e:
                     logger.error("checksum manifest for step %d failed "
                                  "(%s); step stays unverifiable", step, e)
+            duration_ms = (time.perf_counter() - t0) * 1e3
+            _SAVES.inc()
+            _SAVE_MS.observe(duration_ms)
+            obs_events.emit("checkpoint", action="save", step=int(step),
+                            ok=True, forced=bool(force),
+                            duration_ms=round(duration_ms, 3),
+                            verified=bool(self.verify_writes))
             logger.info("checkpoint saved at step %d -> %s", step,
                         self.directory)
         return saved
@@ -259,6 +289,9 @@ class CheckpointManager:
             while not self.verify(step):
                 logger.error("checkpoint at step %d is corrupt; falling "
                              "back to the previous one", step)
+                _FALLBACKS.inc()
+                obs_events.emit("checkpoint", action="fallback",
+                                step=int(step), ok=False)
                 self.delete_step(step)
                 step = self.latest_valid_step()
                 if step is None:
@@ -268,17 +301,27 @@ class CheckpointManager:
         elif not self.verify(step):
             logger.error("explicitly requested checkpoint step %d fails "
                          "verification; restoring it anyway", step)
+        t0 = time.perf_counter()
+
+        def _done(result):
+            _RESTORES.inc()
+            obs_events.emit(
+                "checkpoint", action="restore", step=int(step), ok=True,
+                duration_ms=round((time.perf_counter() - t0) * 1e3, 3))
+            return result
+
         try:
             restored = self._call(
                 self.manager.restore, step,
                 args=ocp.args.Composite(
                     state=ocp.args.StandardRestore(state_template),
                     data_state=ocp.args.JsonRestore()))
-            return restored["state"], dict(restored["data_state"])
+            return _done((restored["state"],
+                          dict(restored["data_state"])))
         except Exception:
-            return self._call(
+            return _done((self._call(
                 self.manager.restore, step,
-                args=ocp.args.StandardRestore(state_template)), None
+                args=ocp.args.StandardRestore(state_template)), None))
 
     def latest_step(self) -> int | None:
         return self.manager.latest_step()

@@ -531,6 +531,7 @@ def train_loop(
     stop_fn: Callable[[], bool] | None = None,
     watchdog=None,
     step_guard: Callable | None = None,
+    timeline=None,
 ):
     """Simple host loop: step, log loss / steps-per-sec / MFU.
 
@@ -561,6 +562,12 @@ def train_loop(
     ``guard=True``. NOTE the cost: building the outcome reads the loss
     every step, which synchronizes host and device per step (acceptable
     for guarded runs; leave step_guard None on the raw-throughput path).
+
+    ``timeline`` (``obs.StepTimeline``) records the per-step breakdown —
+    data-fetch wait, ``block_until_ready``-bracketed device time,
+    step-hook (checkpoint) time, steps/sec, MFU — into the metrics
+    registry and event log. Same per-step host-sync cost caveat as
+    ``step_guard``; leave None on the raw-throughput path.
     """
     history = []
     use_scale = step_guard is not None and hasattr(step_guard,
@@ -573,6 +580,15 @@ def train_loop(
 
     t0 = time.perf_counter()
     last_t, last_step = t0, 0
+    # Timeline records carry GLOBAL step numbers (state.step is the
+    # resume point): a run restored at step 200 must not emit step
+    # events restarting at 1 that cannot be correlated with its own
+    # checkpoint/restart events. The one int() sync is paid only on
+    # telemetry-enabled runs.
+    step_base = 0
+    if timeline is not None:
+        step_base = int(state.step)
+        timeline.new_attempt()  # restart gaps are not step time
     if stop_fn is not None and stop_fn():
         # Signal landed before the loop (e.g. during checkpoint restore):
         # don't pull a batch or pay the step-1 AOT compile on the way out.
@@ -580,10 +596,13 @@ def train_loop(
         return state, history
     stopped = False
     for step in range(1, num_steps + 1):
+        t_fetch = time.perf_counter()
         v1, v2 = next(data_iter)
+        data_wait_s = time.perf_counter() - t_fetch
         if step == 1 and flops_per_step == "auto":
             aot_args = (state, v1, v2) + (
                 (step_guard.scale_value(),) if use_scale else ())
+            t_compile = time.perf_counter()
             flops_per_step, compiled = aot_compile_with_flops(
                 train_step, *aot_args)
             if compiled is not None:
@@ -591,7 +610,22 @@ def train_loop(
             if flops_per_step is not None:
                 logger.info("compiled step cost: %.3e FLOPs/chip",
                             flops_per_step)
+            if timeline is not None:
+                timeline.set_flops_per_step(
+                    flops_per_step if isinstance(flops_per_step, float)
+                    else None)
+                timeline.record_compile(
+                    (time.perf_counter() - t_compile) * 1e3,
+                    flops_per_step if isinstance(flops_per_step, float)
+                    else None)
+        t_step = time.perf_counter()
         state, metrics = run_step(train_step, state, v1, v2)
+        if timeline is not None:
+            # Bracket the device time: without the sync, the dispatch
+            # returns immediately and per-step timing measures nothing
+            # (the timeline's documented host-sync cost, as step_guard).
+            metrics = jax.block_until_ready(metrics)
+        device_s = time.perf_counter() - t_step
         if watchdog is not None:
             watchdog.beat()
         if step_guard is not None:
@@ -600,8 +634,18 @@ def train_loop(
                 grad_norm=(float(metrics["grad_norm"])
                            if "grad_norm" in metrics else None),
                 ok=bool(metrics.get("step_ok", True))))
+        t_hook = time.perf_counter()
         if step_hook is not None:
             step_hook(state)
+        if timeline is not None:
+            timeline.record_step(
+                step=step_base + step, loss=float(metrics["loss"]),
+                data_wait_s=data_wait_s, device_s=device_s,
+                hook_s=time.perf_counter() - t_hook,
+                ok=(bool(metrics["step_ok"]) if "step_ok" in metrics
+                    else None),
+                grad_norm=(float(metrics["grad_norm"])
+                           if "grad_norm" in metrics else None))
         stopped = stop_fn is not None and stop_fn()
         if step % log_every == 0 or step == num_steps or stopped:
             loss = float(metrics["loss"])
@@ -635,6 +679,7 @@ def fit(
     stop_fn: Callable[[], bool] | None = None,
     watchdog=None,
     step_guard: Callable | None = None,
+    timeline=None,
     checkpoint_retry_policy=None,
     checkpoint_verify_writes: bool = True,
 ):
@@ -642,8 +687,9 @@ def fit(
     exists, train to ``num_steps`` total, save every ``checkpoint_every``
     steps (on the GLOBAL ``state.step``) and at the end.
 
-    ``step_guard`` / ``watchdog``: forwarded to ``train_loop`` (divergence
-    policy and stall detection). A guard-raised DivergenceError propagates
+    ``step_guard`` / ``watchdog`` / ``timeline``: forwarded to
+    ``train_loop`` (divergence policy, stall detection, per-step
+    telemetry). A guard-raised DivergenceError propagates
     WITHOUT the final force-save — the diverged state must not become the
     newest checkpoint; resilience.Supervisor catches it and restarts from
     the last valid one (restore falls back past corrupt saves via
@@ -726,7 +772,8 @@ def fit(
             state, data_iter, train_step, remaining,
             log_every=log_every,
             flops_per_step=flops_per_step, step_hook=step_hook,
-            stop_fn=stop_fn, watchdog=watchdog, step_guard=step_guard)
+            stop_fn=stop_fn, watchdog=watchdog, step_guard=step_guard,
+            timeline=timeline)
         if manager is not None \
                 and manager.latest_step() != int(state.step):
             manager.save(int(state.step), state, force=True,

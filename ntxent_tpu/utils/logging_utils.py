@@ -1,16 +1,103 @@
-"""Logging setup mirroring the reference harness (python/test.py:18-23)."""
+"""Logging setup mirroring the reference harness (python/test.py:18-23).
+
+Fixed here (ISSUE 3 satellite): ``logging.basicConfig(force=False)`` is a
+silent no-op once ANY handler exists on the root logger, so the second
+caller of ``setup_logging`` (e.g. a test after the CLI, or a notebook
+re-run) kept the first call's level and format without any indication.
+``setup_logging`` now reconfigures deterministically: the requested
+level always takes effect, and the root handler's formatter is updated
+in place instead of being silently ignored.
+
+``format_kv`` / ``KeyValueFormatter`` are the structured ``key=value``
+rendering the event log's mirror-to-logger mode uses
+(obs/events.py:EventLog(mirror_logger=True)): one greppable line per
+record, values quoted only when they need it.
+"""
 
 from __future__ import annotations
 
 import logging
 
-__all__ = ["setup_logging"]
+__all__ = ["setup_logging", "format_kv", "KeyValueFormatter"]
+
+_DEFAULT_FORMAT = "%(asctime)s - %(levelname)s - %(message)s"
+_KV_FORMAT = "%(asctime)s %(levelname)s %(message)s"
 
 
-def setup_logging(level: int = logging.INFO) -> logging.Logger:
-    logging.basicConfig(
-        level=level,
-        format="%(asctime)s - %(levelname)s - %(message)s",
-        force=False,
-    )
+def format_kv(fields: dict) -> str:
+    """``key=value`` pairs in insertion order, shell-grep friendly.
+
+    Values containing whitespace, quotes, or '=' are json-quoted so the
+    line stays splittable on spaces; None renders as ``key=null``.
+    """
+    import json
+
+    parts = []
+    for key, value in fields.items():
+        if value is None:
+            rendered = "null"
+        elif isinstance(value, bool):
+            rendered = "true" if value else "false"
+        elif isinstance(value, (int, float)):
+            rendered = repr(value)
+        else:
+            text = str(value)
+            needs_quote = any(c in text for c in ' \t\n"=') or not text
+            rendered = json.dumps(text) if needs_quote else text
+        parts.append(f"{key}={rendered}")
+    return " ".join(parts)
+
+
+class KeyValueFormatter(logging.Formatter):
+    """Formatter emitting ``asctime level key=value ...`` lines.
+
+    Plain-string records pass through as ``msg="..."``; dict records
+    (``logger.info({"step": 3, ...})``) render as their pairs — the
+    event-log mirror logs pre-rendered ``format_kv`` strings, so both
+    shapes appear in practice.
+    """
+
+    def __init__(self, datefmt: str | None = None):
+        super().__init__(fmt=_KV_FORMAT, datefmt=datefmt)
+
+    def format(self, record: logging.LogRecord) -> str:
+        if isinstance(record.msg, dict):
+            # Render the dict as pairs; bypass %-interpolation (a dict
+            # msg with args would TypeError inside getMessage).
+            record = logging.makeLogRecord(record.__dict__)
+            record.msg = format_kv(record.msg)
+            record.args = ()
+        return super().format(record)
+
+
+def setup_logging(level: int = logging.INFO,
+                  structured: bool = False) -> logging.Logger:
+    """Idempotent root-logger configuration.
+
+    First call: ``basicConfig`` with the framework format. Later calls:
+    instead of basicConfig's silent keep-the-first-config behavior, the
+    root LEVEL is always set to ``level`` and the formatter of the
+    handlers *this function installed* (tagged at creation) is swapped
+    to match ``structured`` — repeated setup converges on the last
+    request instead of the first. Handlers other libraries put on the
+    root logger are never touched: no ``force=True`` teardown, no
+    formatter clobbering.
+
+    ``structured=True`` uses ``KeyValueFormatter`` (key=value lines; the
+    event-log mirror's format) instead of the human default.
+    """
+    root = logging.getLogger()
+    formatter: logging.Formatter = (
+        KeyValueFormatter() if structured
+        else logging.Formatter(_DEFAULT_FORMAT))
+    if not root.handlers:
+        logging.basicConfig(level=level)
+        for handler in root.handlers:
+            handler._ntxent_managed = True
+            handler.setFormatter(formatter)
+    else:
+        root.setLevel(level)
+        for handler in root.handlers:
+            if getattr(handler, "_ntxent_managed", False):
+                handler.setFormatter(formatter)
     return logging.getLogger("ntxent_tpu")

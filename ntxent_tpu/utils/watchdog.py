@@ -112,6 +112,22 @@ class StallWatchdog:
             quiet = self.silent_for()
             if quiet >= self.timeout_s and not self.stalled.is_set():
                 self.stalled.set()
+                # Countable stall evidence (ISSUE 3): the stack dump is
+                # human forensics; the counter is what a scrape (and a
+                # post-mortem of the JSONL stream's absence of `step`
+                # events) can alert on. Lazy import: utils must stay a
+                # leaf package at import time. Shielded like every other
+                # diagnostic here — telemetry failing (e.g. interpreter
+                # teardown) must not kill the monitor thread before the
+                # dump and the on_stall escalation below run.
+                try:
+                    from ..obs.registry import default_registry
+
+                    default_registry().counter(
+                        "watchdog_stalls_total",
+                        "silent-loop stalls detected").inc()
+                except Exception:
+                    logger.exception("watchdog stall counter failed")
                 logger.error("training stalled: no progress for %.1fs "
                              "(timeout %.1fs) — dumping thread stacks",
                              quiet, self.timeout_s)

@@ -1,0 +1,136 @@
+#!/usr/bin/env bash
+# Observability smoke: drive the unified telemetry subsystem (ISSUE 3)
+# through the CLI in <30 s on CPU. One short chaos-mode ntxent-train with
+# --metrics-port/--log-jsonl/--ckpt-dir must:
+#   * serve a mid-run Prometheus /metrics that PARSES and carries the
+#     training counters (steps, divergence, retries, checkpoints), with
+#     ?format=json returning the same values;
+#   * append a JSONL event stream containing at least one `step` event
+#     (with data_wait_ms/device_ms/steps_per_sec), one `checkpoint` save
+#     event, a `divergence` event for the injected NaN, and a `retry`
+#     event for the injected fetch fault;
+#   * exit 0.
+# Pairs with `pytest -m obs` (the same layer asserted in-process).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+workdir="$(mktemp -d)"
+train_pid=""
+cleanup() {
+    [ -n "$train_pid" ] && kill "$train_pid" 2>/dev/null || true
+    [ -n "$train_pid" ] && wait "$train_pid" 2>/dev/null || true
+    rm -rf "$workdir"
+}
+trap cleanup EXIT
+
+log="$workdir/run.log"
+events="$workdir/run.jsonl"
+scrape="$workdir/scrape.prom"
+scrape_json="$workdir/scrape.json"
+
+JAX_PLATFORMS=cpu python -m ntxent_tpu.cli \
+    --platform cpu \
+    --dataset synthetic --synthetic-samples 64 --image-size 8 \
+    --model tiny --proj-hidden-dim 16 --proj-dim 8 \
+    --batch 8 --steps 400 --warmup-steps 2 --log-every 100 \
+    --ckpt-dir "$workdir/ckpt" --ckpt-every 200 \
+    --metrics-port 0 --log-jsonl "$events" \
+    --chaos 'nan@3,fetch@2' \
+    >"$log" 2>&1 &
+train_pid=$!
+
+# Wait for the metrics endpoint to bind (the CLI logs the resolved port).
+port=""
+for _ in $(seq 120); do
+    port="$(sed -n 's/.*metrics endpoint: http:\/\/127\.0\.0\.1:\([0-9]*\)\/metrics.*/\1/p' "$log" | head -1)"
+    [ -n "$port" ] && break
+    kill -0 "$train_pid" 2>/dev/null || { echo "train died before binding:"; tail -20 "$log"; exit 1; }
+    sleep 0.25
+done
+[ -n "$port" ] || { echo "metrics endpoint never bound:"; tail -20 "$log"; exit 1; }
+
+# Mid-run scrape: poll until the step counter is moving AND the injected
+# faults have landed in the registry, keeping the last good scrape. The
+# server dies with the run, so success here PROVES the scrape was mid-run.
+ok=""
+for _ in $(seq 200); do
+    if curl -fsS "http://127.0.0.1:$port/metrics" -o "$scrape.tmp" 2>/dev/null; then
+        if grep -q '^train_steps_total [1-9]' "$scrape.tmp" \
+            && grep -q '^train_divergence_total [1-9]' "$scrape.tmp" \
+            && grep -q '^retries_total [1-9]' "$scrape.tmp"; then
+            mv "$scrape.tmp" "$scrape"
+            curl -fsS "http://127.0.0.1:$port/metrics?format=json" -o "$scrape_json"
+            ok=1
+            break
+        fi
+    fi
+    kill -0 "$train_pid" 2>/dev/null || break
+    sleep 0.1
+done
+[ -n "$ok" ] || { echo "never caught a mid-run scrape with live counters:"; tail -20 "$log"; exit 1; }
+
+wait "$train_pid"
+train_pid=""
+
+# Assert the scrape parses as exposition format and the JSONL stream
+# carries the typed records the acceptance criteria name.
+python - "$scrape" "$scrape_json" "$events" <<'PY'
+import json
+import re
+import sys
+
+scrape, scrape_json, events = sys.argv[1:4]
+
+# -- Prometheus text parses: every line is a comment or a legal sample.
+name = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+label = r'\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"' \
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*")*\}'
+sample = re.compile(rf"^{name}({label})? \S+$")
+values = {}
+for line in open(scrape):
+    line = line.rstrip("\n")
+    if not line:
+        continue
+    if line.startswith("#"):
+        assert re.match(rf"^# (HELP|TYPE) {name}", line), line
+        continue
+    assert sample.match(line), f"illegal sample line: {line!r}"
+    key, _, val = line.rpartition(" ")
+    values[key] = float(val)
+
+for counter in ("train_steps_total", "train_divergence_total",
+                "retries_total", "checkpoint_saves_total"):
+    assert values.get(counter, 0) >= 1, (counter, values.get(counter))
+
+# -- JSON view of the same registry agrees on the same scrape... the two
+# formats are separate scrapes a moment apart, so compare loosely (the
+# JSON one ran second: counters can only have grown).
+snap = json.load(open(scrape_json))
+assert snap["train_steps_total"] >= values["train_steps_total"], snap
+
+# -- JSONL event stream: the typed records.
+records = [json.loads(l) for l in open(events) if l.strip()]
+by_type = {}
+for rec in records:
+    by_type.setdefault(rec["event"], []).append(rec)
+assert by_type.get("step"), "no step events"
+first = by_type["step"][0]
+for field in ("data_wait_ms", "device_ms", "steps_per_sec", "run_id",
+              "attempt", "t"):
+    assert field in first, (field, first)
+assert by_type.get("checkpoint"), "no checkpoint events"
+assert any(r.get("action") == "save" and r.get("ok")
+           for r in by_type["checkpoint"]), by_type["checkpoint"][:3]
+assert by_type.get("divergence"), "no divergence event for the NaN fault"
+assert by_type.get("retry"), "no retry event for the fetch fault"
+assert by_type["retry"][0]["fn"], by_type["retry"][0]
+assert by_type.get("compile"), "no compile event"
+print(f"obs smoke: OK — steps={int(values['train_steps_total'])} "
+      f"divergence={int(values['train_divergence_total'])} "
+      f"retries={int(values['retries_total'])} "
+      f"ckpt_saves={int(values['checkpoint_saves_total'])} "
+      f"jsonl_events={len(records)}")
+PY
+
+grep -q 'chaos faults fired: .*nan@3' "$log"
+echo "obs smoke: OK"

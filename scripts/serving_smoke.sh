@@ -7,7 +7,10 @@
 #   * no recompilation after warmup: the compile count is FLAT between
 #     post-warmup and end-of-load for in-ladder shapes;
 #   * a full queue answers with a 429 backpressure rejection (plus
-#     Retry-After), never a 5xx or unbounded latency.
+#     Retry-After), never a 5xx or unbounded latency;
+#   * /metrics content negotiation (ISSUE 3): ?format=prometheus parses
+#     as exposition text and batch_fill_ratio appears in BOTH formats
+#     with the same value (one registry, two views).
 # Any 5xx, request timeout, or failed assertion exits nonzero.
 # Pairs with `pytest -m serving` (the same stack asserted in-process).
 set -euo pipefail
@@ -143,6 +146,37 @@ assert m["compile"]["compiles"] == compiles_after_warmup, \
      f"{compiles_after_warmup} after warmup")
 assert m["rejected_queue_full"] == len(rejected), m["rejected_queue_full"]
 assert m["responses"] >= 36, m["responses"]
+
+# Content negotiation: the Prometheus view of the SAME registry must
+# parse as exposition text and carry batch_fill_ratio too (ISSUE 3:
+# JSON stays the default; a scraper negotiates the text format).
+import re
+with urllib.request.urlopen(base + "/metrics?format=prometheus",
+                            timeout=30) as r:
+    assert r.status == 200 and r.headers["Content-Type"].startswith(
+        "text/plain"), r.headers["Content-Type"]
+    prom = r.read().decode()
+name_re = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+label_re = (r'\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"'
+            r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*")*\}')
+sample_re = re.compile(rf"^{name_re}({label_re})? \S+$")
+prom_values = {}
+for line in prom.splitlines():
+    if not line or line.startswith("#"):
+        continue
+    assert sample_re.match(line), f"illegal prometheus line: {line!r}"
+    key, _, val = line.rpartition(" ")
+    prom_values[key] = float(val)
+assert "serving_batch_fill_ratio" in prom_values, sorted(prom_values)
+_, m2 = get("/metrics")  # JSON re-read adjacent to the prometheus scrape
+assert m2["batch_fill_ratio"] is not None
+assert abs(prom_values["serving_batch_fill_ratio"]
+           - m2["batch_fill_ratio"]) < 1e-3, \
+    (prom_values["serving_batch_fill_ratio"], m2["batch_fill_ratio"])
+req = urllib.request.Request(base + "/metrics",
+                             headers={"Accept": "text/plain"})
+with urllib.request.urlopen(req, timeout=30) as r:  # header negotiation
+    assert r.read().decode().startswith("#")
 
 lat = m["latency_ms"]["total"]
 print(f"serving smoke: OK — fill_ratio={fill} "

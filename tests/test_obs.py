@@ -1,0 +1,606 @@
+"""Unified telemetry subsystem (ntxent_tpu/obs/): registry, events,
+timeline, exporters, profiler trigger.
+
+CPU-only, JAX-light (the profiler tests monkeypatch jax.profiler — a
+real trace capture is exercised by scripts/obs_smoke.sh, not the fast
+tier). Runs in tier-1 via the `obs` marker (not slow-marked).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import statistics
+import threading
+import urllib.request
+
+import pytest
+
+from ntxent_tpu import obs
+from ntxent_tpu.obs.registry import prometheus_name
+
+pytestmark = pytest.mark.obs
+
+
+# ---------------------------------------------------------------------------
+# MetricsRegistry
+# ---------------------------------------------------------------------------
+class TestRegistry:
+    def test_get_or_create_identity(self):
+        r = obs.MetricsRegistry()
+        assert r.counter("a_total") is r.counter("a_total")
+        assert r.counter("a_total", labels={"k": "1"}) \
+            is not r.counter("a_total", labels={"k": "2"})
+        with pytest.raises(ValueError):
+            r.gauge("a_total")  # same name, different kind
+
+    def test_counter_monotone(self):
+        c = obs.MetricsRegistry().counter("c_total")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_thread_safety_concurrent_writers(self):
+        """Exact totals under contention: the registry's correctness
+        claim is that no increment or observation is ever lost."""
+        r = obs.MetricsRegistry()
+        c = r.counter("hits_total")
+        g = r.gauge("level")
+        h = r.histogram("lat", window=64)
+        n_threads, n_iter = 8, 500
+
+        def writer(tid):
+            for i in range(n_iter):
+                c.inc()
+                g.set(tid)
+                h.observe(float(i))
+                # get-or-create from every thread must stay identical
+                assert r.counter("hits_total") is c
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == n_threads * n_iter
+        assert h.count == n_threads * n_iter
+        assert h.total == n_threads * sum(range(n_iter))
+
+    def test_histogram_percentiles_exact(self):
+        """The single-source quantile rule is exact nearest-rank over
+        the window, and tracks statistics.quantiles within one sample."""
+        import random
+
+        rng = random.Random(0)
+        data = [rng.uniform(0, 100) for _ in range(500)]
+        h = obs.Histogram("x", window=len(data))
+        for v in data:
+            h.observe(v)
+        ordered = sorted(data)
+        pcts = h.percentiles()
+        for q in (0.5, 0.95, 0.99):
+            # exactness vs the documented rule
+            assert pcts[q] == ordered[min(len(data) - 1,
+                                          int(q * len(data)))]
+        # cross-check vs the stdlib estimator: within one sample gap
+        stats_q = statistics.quantiles(data, n=100, method="inclusive")
+        for q, idx in ((0.5, 49), (0.95, 94), (0.99, 98)):
+            i = ordered.index(pcts[q])
+            lo, hi = ordered[max(0, i - 2)], ordered[min(len(data) - 1,
+                                                         i + 2)]
+            assert lo <= stats_q[idx] <= hi or \
+                abs(pcts[q] - stats_q[idx]) <= (ordered[-1] -
+                                                ordered[0]) / 50
+
+    def test_histogram_window_bounds_memory_not_totals(self):
+        h = obs.Histogram("x", window=4)
+        for v in range(100):
+            h.observe(float(v))
+        assert h.count == 100 and h.total == sum(range(100))
+        # percentiles reflect only the window (last 4 samples)
+        assert h.percentiles()[0.5] >= 96.0
+
+    def test_prometheus_rendering_legal(self):
+        """Every sample line must parse under the exposition format:
+        legal metric/label names, escaped label values."""
+        import re
+
+        r = obs.MetricsRegistry()
+        r.counter("serving.requests-total", "counts").inc(3)  # sanitized
+        r.gauge("g", labels={"stage": 'we"ird\nvalue\\x'}).set(1)
+        r.histogram("h_ms", window=8).observe(2.5)
+        text = r.render_prometheus()
+        assert text.endswith("\n")
+        name_re = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+        label_re = (r"\{[a-zA-Z_][a-zA-Z0-9_]*="
+                    r'"(?:[^"\\\n]|\\.)*"'
+                    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\.)*\")*\}")
+        line_re = re.compile(rf"^{name_re}({label_re})? \S+$")
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                assert re.match(rf"^# (HELP|TYPE) {name_re}", line), line
+            else:
+                assert line_re.match(line), f"illegal sample line: {line!r}"
+        assert "serving_requests_total 3" in text
+        assert prometheus_name("serving.requests-total") == \
+            "serving_requests_total"
+
+    def test_collect_matches_prometheus_values(self):
+        r = obs.MetricsRegistry()
+        r.counter("n_total").inc(7)
+        r.gauge("depth").set(2)
+        snap = r.collect()
+        assert snap["n_total"] == 7 and snap["depth"] == 2
+        text = r.render_prometheus()
+        assert "n_total 7" in text and "depth 2" in text
+
+
+# ---------------------------------------------------------------------------
+# EventLog
+# ---------------------------------------------------------------------------
+class TestEventLog:
+    def test_jsonl_round_trip(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        with obs.EventLog(path, run_id="deadbeef") as log:
+            log.emit("step", step=1, loss=0.5)
+            log.set_attempt(2)
+            log.emit("checkpoint", action="save", step=1, ok=True)
+        records = obs.read_events(path)
+        assert [r["event"] for r in records] == ["step", "checkpoint"]
+        assert all(r["run_id"] == "deadbeef" for r in records)
+        assert records[0]["attempt"] == 0 and records[1]["attempt"] == 2
+        # monotonic offsets are ordered even if wall clock jumps
+        assert records[0]["t"] <= records[1]["t"]
+        assert obs.read_events(path, event="checkpoint") == [records[1]]
+
+    def test_append_across_instances(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        with obs.EventLog(path, run_id="r1") as log:
+            log.emit("step", step=1)
+        with obs.EventLog(path, run_id="r2") as log:
+            log.emit("step", step=1)
+        runs = [r["run_id"] for r in obs.read_events(path)]
+        assert runs == ["r1", "r2"]  # append-only, both runs visible
+
+    def test_unserializable_fields_survive(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        with obs.EventLog(path) as log:
+            log.emit("trace", obj=object())
+        (rec,) = obs.read_events(path)
+        assert rec["event"] == "trace" and "obj" in rec
+
+    def test_non_finite_floats_stay_strict_json(self, tmp_path):
+        """The no-bare-NaN rule is enforced at the write point for
+        EVERY emitter (not per call site): lines parse under strict
+        JSON (parse_constant refused)."""
+        path = str(tmp_path / "events.jsonl")
+        with obs.EventLog(path) as log:
+            log.emit("step", loss=float("nan"),
+                     nested={"g": float("inf"), "xs": [1.0,
+                                                       float("-inf")]})
+
+        def refuse(const):
+            raise AssertionError(f"bare {const} in JSONL")
+
+        (line,) = [l for l in open(path) if l.strip()]
+        rec = json.loads(line, parse_constant=refuse)
+        assert rec["loss"] == "nan"
+        assert rec["nested"]["g"] == "inf"
+        assert rec["nested"]["xs"] == [1.0, "-inf"]
+
+    def test_hub_install_emit_noop(self, tmp_path):
+        previous = obs.install(None)
+        try:
+            obs.emit("step", step=1)  # no log installed: must not raise
+            log = obs.EventLog(str(tmp_path / "e.jsonl"))
+            obs.install(log)
+            obs.emit("retry", fn="f")
+            log.close()
+            assert log.counts() == {"retry": 1}
+        finally:
+            obs.install(previous)
+
+    def test_counts_and_tail(self):
+        log = obs.EventLog(None)
+        for i in range(5):
+            log.emit("step", step=i)
+        log.emit("divergence", step=5)
+        assert log.counts() == {"step": 5, "divergence": 1}
+        assert [r["step"] for r in log.tail(3)] == [3, 4, 5]
+
+
+# ---------------------------------------------------------------------------
+# Step timeline
+# ---------------------------------------------------------------------------
+class TestStepTimeline:
+    def test_step_events_and_registry(self):
+        r = obs.MetricsRegistry()
+        log = obs.EventLog(None)
+        previous = obs.install(log)
+        try:
+            tl = obs.StepTimeline(registry=r)
+            for step in range(1, 4):
+                tl.record_step(step=step, loss=1.0 / step,
+                               data_wait_s=0.002, device_s=0.010,
+                               hook_s=0.001)
+        finally:
+            obs.install(previous)
+        snap = r.collect()
+        assert snap["train_steps_total"] == 3
+        assert snap["train_step_device_ms"]["count"] == 3
+        steps = [rec for rec in log.tail(10) if rec["event"] == "step"]
+        assert len(steps) == 3
+        for rec in steps:
+            assert rec["data_wait_ms"] == pytest.approx(2.0)
+            assert rec["device_ms"] == pytest.approx(10.0)
+            assert rec["steps_per_sec"] > 0
+
+    def test_unguarded_nan_emits_divergence(self):
+        r = obs.MetricsRegistry()
+        log = obs.EventLog(None)
+        previous = obs.install(log)
+        try:
+            tl = obs.StepTimeline(registry=r)
+            tl.record_step(step=1, loss=float("nan"),
+                           data_wait_s=0.0, device_s=0.01, ok=None)
+        finally:
+            obs.install(previous)
+        assert r.collect()["train_divergence_total"] == 1
+        div = [rec for rec in log.tail(5)
+               if rec["event"] == "divergence"]
+        assert len(div) == 1 and div[0]["guarded"] is False
+        # the step record itself stays JSON-parseable (no bare NaN)
+        (step_rec,) = [rec for rec in log.tail(5)
+                       if rec["event"] == "step"]
+        json.dumps(step_rec)
+
+    def test_new_attempt_resets_rate_clock(self):
+        """train_loop calls new_attempt() on entry so a restart gap is
+        never counted as step time in steps_per_sec."""
+        tl = obs.StepTimeline(registry=obs.MetricsRegistry())
+        tl.record_step(step=1, loss=1.0, data_wait_s=0.0,
+                       device_s=0.01)
+        assert tl._last_done is not None
+        tl.new_attempt()
+        assert tl._last_done is None
+        # first step of the new attempt falls back to its own breakdown
+        tl.record_step(step=2, loss=1.0, data_wait_s=0.0, device_s=0.01)
+
+    def test_guarded_skip_suppresses_duplicate(self):
+        """A guarded bad step (ok=False) counts but does NOT emit the
+        timeline's divergence event — DivergenceGuard owns that record."""
+        r = obs.MetricsRegistry()
+        log = obs.EventLog(None)
+        previous = obs.install(log)
+        try:
+            tl = obs.StepTimeline(registry=r)
+            tl.record_step(step=1, loss=float("nan"), data_wait_s=0.0,
+                           device_s=0.01, ok=False, grad_norm=float("inf"))
+        finally:
+            obs.install(previous)
+        assert r.collect()["train_divergence_total"] == 1
+        assert not [rec for rec in log.tail(5)
+                    if rec["event"] == "divergence"]
+
+
+# ---------------------------------------------------------------------------
+# DivergenceGuard / RetryPolicy event emission
+# ---------------------------------------------------------------------------
+class TestResilienceEvents:
+    def test_guard_emits_divergence_events(self):
+        from ntxent_tpu.resilience import DivergenceGuard
+        from ntxent_tpu.training.trainer import StepOutcome
+
+        log = obs.EventLog(None)
+        previous = obs.install(log)
+        try:
+            guard = DivergenceGuard(backoff_after=2, rollback_after=None)
+            for step in (1, 2):
+                guard(StepOutcome(step=step, loss=float("nan"),
+                                  grad_norm=None, ok=False))
+        finally:
+            obs.install(previous)
+        events = [rec["action"] for rec in log.tail(10)
+                  if rec["event"] == "divergence"]
+        assert events == ["skip", "backoff"]
+
+    def test_guard_publishes_initial_scale(self):
+        """A healthy run that never backs off must scrape its real
+        scale (init_scale), not the gauge's 0.0 default."""
+        from ntxent_tpu.resilience import DivergenceGuard
+
+        DivergenceGuard(init_scale=0.25)
+        assert obs.default_registry().collect()["train_grad_scale"] \
+            == 0.25
+        DivergenceGuard()  # default init_scale restores 1.0
+        assert obs.default_registry().collect()["train_grad_scale"] == 1.0
+
+    def test_retry_emits_event(self):
+        from ntxent_tpu.resilience import RetryPolicy
+
+        log = obs.EventLog(None)
+        previous = obs.install(log)
+        try:
+            calls = []
+
+            def flaky():
+                calls.append(1)
+                if len(calls) < 2:
+                    raise OSError("blip")
+                return "ok"
+
+            policy = RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                                 sleep=lambda s: None)
+            assert policy.call(flaky) == "ok"
+        finally:
+            obs.install(previous)
+        (rec,) = [r for r in log.tail(5) if r["event"] == "retry"]
+        assert rec["fn"] == "flaky" and rec["call_attempt"] == 1
+        assert "OSError" in rec["error"]
+
+
+# ---------------------------------------------------------------------------
+# Profiler trigger
+# ---------------------------------------------------------------------------
+class _FakeProfiler:
+    def __init__(self):
+        self.started, self.stopped = [], 0
+
+    def patch(self, monkeypatch):
+        import jax
+
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda d: self.started.append(d))
+        monkeypatch.setattr(jax.profiler, "stop_trace",
+                            lambda: setattr(self, "stopped",
+                                            self.stopped + 1))
+
+
+class TestProfilerTrigger:
+    def _trigger(self, tmp_path, **kwargs):
+        defaults = dict(slow_factor=3.0, capture_steps=2,
+                        warmup_steps=3, registry=obs.MetricsRegistry())
+        defaults.update(kwargs)
+        return obs.ProfilerTrigger(str(tmp_path), **defaults)
+
+    def test_fires_on_spike_not_on_warmup(self, tmp_path, monkeypatch):
+        fake = _FakeProfiler()
+        fake.patch(monkeypatch)
+        log = obs.EventLog(None)
+        previous = obs.install(log)
+        try:
+            trig = self._trigger(tmp_path)
+            # Step 1 is a compile step: enormous, but the median window
+            # has no samples yet — must NOT fire.
+            trig.on_step(1, 5000.0)
+            assert not fake.started
+            for step in range(2, 8):  # steady state ~10 ms
+                trig.on_step(step, 10.0)
+            assert not fake.started  # steady state never fires
+            trig.on_step(8, 100.0)   # 10x median: fire
+            assert len(fake.started) == 1
+            trig.on_step(9, 105.0)   # captured step 1/2
+            trig.on_step(10, 11.0)   # captured step 2/2 -> stop
+            assert fake.stopped == 1
+        finally:
+            obs.install(previous)
+        actions = [rec["action"] for rec in log.tail(10)
+                   if rec["event"] == "trace"]
+        assert actions == ["start", "complete"]
+        (start,) = [rec for rec in log.tail(10)
+                    if rec.get("action") == "start"]
+        assert start["reason"].startswith("slow_step")
+        assert start["trace_dir"].startswith(str(tmp_path))
+
+    def test_captured_steps_stay_out_of_baseline(self, tmp_path,
+                                                 monkeypatch):
+        fake = _FakeProfiler()
+        fake.patch(monkeypatch)
+        trig = self._trigger(tmp_path, capture_steps=1)
+        for step in range(1, 6):
+            trig.on_step(step, 10.0)
+        trig.on_step(6, 1000.0)          # fire
+        trig.on_step(7, 1000.0)          # captured (trace overhead)
+        assert fake.stopped == 1
+        # the 1000 ms captured step must not have shifted the median
+        trig.on_step(8, 35.0)            # 3.5x the clean 10 ms median
+        assert len(fake.started) == 2
+
+    def test_manual_trigger_file(self, tmp_path, monkeypatch):
+        fake = _FakeProfiler()
+        fake.patch(monkeypatch)
+        trig = self._trigger(tmp_path, warmup_steps=100)  # slow path off
+        trig.on_step(1, 10.0)
+        assert not fake.started
+        (tmp_path / "TRIGGER").touch()
+        trig.on_step(2, 10.0)
+        assert len(fake.started) == 1
+        assert not (tmp_path / "TRIGGER").exists()  # consumed
+
+    def test_trace_dir_created_for_trigger_file(self, tmp_path):
+        """The documented `touch <trace-dir>/TRIGGER` path must work
+        before any capture: the trigger creates the directory."""
+        import os
+
+        target = tmp_path / "does" / "not" / "exist"
+        self._trigger(target)
+        assert os.path.isdir(target)
+
+    def test_sigusr2_flag_consumed_without_lock(self, tmp_path,
+                                                monkeypatch):
+        """The signal handler only flips a flag (taking the trigger's
+        lock in a handler could self-deadlock the main thread); the
+        next on_step converts it into a capture request."""
+        fake = _FakeProfiler()
+        fake.patch(monkeypatch)
+        trig = self._trigger(tmp_path, warmup_steps=100)
+        trig._signal_pending = True  # what the handler does
+        trig.on_step(1, 10.0)
+        assert len(fake.started) == 1
+        assert trig._signal_pending is False
+
+    def test_request_idempotent_while_active(self, tmp_path, monkeypatch):
+        fake = _FakeProfiler()
+        fake.patch(monkeypatch)
+        trig = self._trigger(tmp_path, warmup_steps=100, capture_steps=3)
+        trig.request("manual")
+        trig.request("manual")
+        trig.on_step(1, 10.0)
+        assert len(fake.started) == 1
+        trig.request("manual")  # ignored: capture in flight
+        trig.on_step(2, 10.0)
+        trig.on_step(3, 10.0)
+        trig.on_step(4, 10.0)
+        assert fake.stopped == 1 and len(fake.started) == 1
+
+
+# ---------------------------------------------------------------------------
+# Exporters: HTTP endpoint + content negotiation
+# ---------------------------------------------------------------------------
+class TestExporters:
+    def test_metrics_server_both_formats(self):
+        r = obs.MetricsRegistry()
+        r.counter("train_steps_total").inc(5)
+        with obs.MetricsServer(registry=r, port=0) as server:
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as resp:
+                assert resp.headers["Content-Type"].startswith(
+                    "text/plain")
+                text = resp.read().decode()
+            assert "# TYPE train_steps_total counter" in text
+            assert "train_steps_total 5" in text
+            with urllib.request.urlopen(base + "/metrics?format=json",
+                                        timeout=10) as resp:
+                payload = json.loads(resp.read())
+            assert payload["train_steps_total"] == 5
+            req = urllib.request.Request(
+                base + "/metrics",
+                headers={"Accept": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert json.loads(resp.read())["train_steps_total"] == 5
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=10) as resp:
+                assert resp.status == 200
+
+    def test_choose_format(self):
+        assert obs.choose_format("/metrics", None) == "json"
+        assert obs.choose_format("/metrics", None,
+                                 default="prometheus") == "prometheus"
+        assert obs.choose_format("/metrics?format=prometheus",
+                                 "application/json") == "prometheus"
+        assert obs.choose_format("/metrics", "text/plain") == "prometheus"
+        assert obs.choose_format("/metrics",
+                                 "application/openmetrics-text") \
+            == "prometheus"
+        assert obs.choose_format("/metrics?format=bogus", None,
+                                 default="json") == "json"
+
+
+# ---------------------------------------------------------------------------
+# ServingMetrics on the registry (single-source percentiles, both formats)
+# ---------------------------------------------------------------------------
+class TestServingMetricsMigration:
+    def _populated(self):
+        from ntxent_tpu.serving.metrics import ServingMetrics
+
+        m = ServingMetrics()
+        m.queue_capacity = 8
+        for _ in range(4):
+            m.request_accepted()
+        m.dispatch(4)
+        m.device_call(4, rows_real=3, rows_padded=1, device_ms=2.0)
+        m.request_done(10.0)
+        m.compiled()
+        return m
+
+    def test_wire_shape_unchanged(self):
+        d = self._populated().to_dict()
+        assert d["requests"] == 4 and d["responses"] == 1
+        assert d["batch_fill_ratio"] == 4.0
+        assert d["padding_waste"] == 0.25
+        assert d["compile"] == {"compiles": 1, "cache_hits": 0}
+        assert d["buckets"]["4"] == {"calls": 1, "rows_real": 3,
+                                     "rows_padded": 1}
+        lat = d["latency_ms"]["total"]
+        assert {"count", "mean_ms", "p50_ms", "p95_ms", "p99_ms",
+                "max_ms", "window"} <= set(lat)
+
+    def test_batch_fill_ratio_in_both_formats(self):
+        m = self._populated()
+        assert m.to_dict()["batch_fill_ratio"] == 4.0
+        text = m.render_prometheus()
+        assert "serving_batch_fill_ratio 4" in text
+        assert 'serving_latency_ms{quantile="0.5",stage="total"}' in text
+
+    def test_instances_do_not_cross_count(self):
+        from ntxent_tpu.serving.metrics import ServingMetrics
+
+        a, b = ServingMetrics(), ServingMetrics()
+        a.request_accepted()
+        assert a.requests == 1 and b.requests == 0
+
+    def test_shared_registry_opt_in(self):
+        from ntxent_tpu.serving.metrics import ServingMetrics
+
+        r = obs.MetricsRegistry()
+        m = ServingMetrics(registry=r)
+        m.request_accepted()
+        assert r.collect()["serving_requests_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# logging_utils satellite
+# ---------------------------------------------------------------------------
+class TestLoggingUtils:
+    def test_setup_logging_idempotent_level(self):
+        from ntxent_tpu.utils.logging_utils import setup_logging
+
+        root = logging.getLogger()
+        saved_level, saved_handlers = root.level, list(root.handlers)
+        try:
+            setup_logging(logging.INFO)
+            assert root.level == logging.INFO
+            # the fix: a SECOND call must take effect, not silently
+            # keep the first configuration
+            setup_logging(logging.DEBUG)
+            assert root.level == logging.DEBUG
+        finally:
+            root.setLevel(saved_level)
+            root.handlers[:] = saved_handlers
+
+    def test_setup_logging_leaves_foreign_handlers_alone(self):
+        from ntxent_tpu.utils.logging_utils import setup_logging
+
+        root = logging.getLogger()
+        saved_level, saved_handlers = root.level, list(root.handlers)
+        foreign = logging.StreamHandler()
+        marker = logging.Formatter("THEIRS %(message)s")
+        foreign.setFormatter(marker)
+        try:
+            root.addHandler(foreign)
+            setup_logging(logging.INFO, structured=True)
+            assert foreign.formatter is marker  # not clobbered
+        finally:
+            root.removeHandler(foreign)
+            root.setLevel(saved_level)
+            root.handlers[:] = saved_handlers
+
+    def test_format_kv(self):
+        from ntxent_tpu.utils.logging_utils import format_kv
+
+        line = format_kv({"event": "step", "loss": 0.5, "ok": True,
+                          "msg": "two words", "none": None})
+        assert line == 'event=step loss=0.5 ok=true msg="two words" ' \
+                       'none=null'
+
+    def test_key_value_formatter_dict_msg(self):
+        from ntxent_tpu.utils.logging_utils import KeyValueFormatter
+
+        record = logging.LogRecord("n", logging.INFO, "p", 1,
+                                   {"step": 3, "loss": 0.25}, (), None)
+        out = KeyValueFormatter().format(record)
+        assert "step=3" in out and "loss=0.25" in out
