@@ -171,6 +171,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoint, restart in-process), otherwise the "
                         "run is left alive for external supervision")
 
+    perf = p.add_argument_group("async input pipeline "
+                                "(ntxent_tpu/training/data.py)")
+    perf.add_argument("--prefetch", type=int, default=0, metavar="DEPTH",
+                      help="device-side prefetch: keep DEPTH batches "
+                           "transferring to the device (committed to the "
+                           "run's mesh sharding) under the running step "
+                           "instead of placing each batch on the critical "
+                           "path; 2-3 is plenty (double/triple buffering), "
+                           "0 = off")
+    perf.add_argument("--lag-metrics", action="store_true",
+                      help="lag-1 metrics drain: read step N-1's loss/"
+                           "grad_norm/step_ok while step N runs, so "
+                           "--nan-policy guards and telemetry "
+                           "(--metrics-port/--log-jsonl) stop syncing "
+                           "host and device every step; divergence "
+                           "handling runs exactly one step late (never "
+                           "missed — the jit-side guard already kept the "
+                           "bad update out of the params)")
+
     r = p.add_argument_group("resilience (self-healing runs; "
                              "ntxent_tpu/resilience/)")
     r.add_argument("--max-restarts", type=int, default=0,
@@ -657,8 +676,9 @@ def main(argv=None) -> int:
                                          loss_impl=args.dp_loss,
                                          loss_axes=loss_axes,
                                          param_spec_fn=spec_fn)
+        batch_sharding = NamedSharding(mesh, P("data"))
         data = _make_pipeline(args, per_process_batch,
-                              sharding=NamedSharding(mesh, P("data")),
+                              sharding=batch_sharding,
                               mesh=mesh, injector=injector)
     elif n_dev > 1 and args.fsdp:
         from ntxent_tpu.parallel import (
@@ -684,9 +704,9 @@ def main(argv=None) -> int:
                                     moe_aux_weight=moe_aux)
         prepare_state = lambda s: shard_train_state_fsdp(s, mesh)  # noqa: E731,E501
         state = prepare_state(state)
+        batch_sharding = data_sharding(mesh, tuple(mesh.axis_names))
         data = _make_pipeline(args, per_process_batch,
-                              sharding=data_sharding(
-                                  mesh, tuple(mesh.axis_names)),
+                              sharding=batch_sharding,
                               mesh=mesh, injector=injector)
         _log_hybrid_zero(mesh)
         logger.info("FSDP (ZeRO-3, %s loss) over %d devices "
@@ -709,8 +729,9 @@ def main(argv=None) -> int:
         # Batches arrive already sharded over the mesh: single-process via
         # sharded device_put + sharded augmentation, multi-process via
         # GlobalTwoViewPipeline's uint8 global assembly.
+        batch_sharding = data_sharding(mesh)
         data = _make_pipeline(args, per_process_batch,
-                              sharding=data_sharding(mesh), mesh=mesh,
+                              sharding=batch_sharding, mesh=mesh,
                               injector=injector)
         logger.info("data-parallel over %d devices (%d process(es))",
                     n_dev, info["process_count"])
@@ -726,13 +747,14 @@ def main(argv=None) -> int:
                            "no shard-pair schedule", args.dp_loss)
         step = make_train_step(cfg.temperature, remat=args.remat,
                                moe_aux_weight=moe_aux, guard=guard_steps)
+        batch_sharding = None
         data = _make_pipeline(args, per_process_batch, injector=injector)
         logger.info("single-device run")
 
     return _run_fit(data, state, step, args,
                     state_factory=lambda: prepare_state(base_state()),
                     step_guard=_make_step_guard(nan_policy),
-                    injector=injector)
+                    injector=injector, sharding=batch_sharding)
 
 
 def _log_final(history) -> None:
@@ -744,7 +766,7 @@ def _log_final(history) -> None:
 
 
 def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
-             injector=None) -> int:
+             injector=None, sharding=None) -> int:
     """Shared training epilogue for both objectives.
 
     Unsupervised (default): one preemption-guarded ``fit`` — SIGTERM means
@@ -752,12 +774,42 @@ def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
     --chaos: ``resilience.Supervisor`` runs attempts of the same ``fit``
     and restarts in-process from the newest valid checkpoint on any
     detected fault (crash, divergence rollback, SIGTERM, stall).
+
+    ``sharding`` is the run's batch ``NamedSharding`` (None on a single
+    device): with --prefetch it binds the DevicePrefetcher to the mesh so
+    batches arrive as committed global arrays (training/data.py).
     """
     import contextlib
 
     from ntxent_tpu.resilience import RetryPolicy
     from ntxent_tpu.training import PreemptionGuard, fit
     from ntxent_tpu.utils import StallWatchdog
+
+    prefetch_depth = getattr(args, "prefetch", 0) or 0
+    if prefetch_depth > 0:
+        import jax
+
+        from ntxent_tpu.training.data import DevicePrefetcher
+
+        if jax.process_count() > 1:
+            # Multi-process pipelines (GlobalTwoViewPipeline / the CLIP
+            # global_batch path) assemble COMMITTED global arrays with
+            # their own per-axis layout; binding a second sharding here
+            # would eagerly device_put non-fully-addressable arrays onto
+            # a possibly different spec every batch. sharding=None makes
+            # the prefetcher pure read-ahead: placed leaves pass through.
+            sharding = None
+        # Innermost wrapper: chaos injection (below) stays consumer-
+        # aligned — faults fire by batch ordinal at consumption, and the
+        # checkpointable state()/restore() chain passes through.
+        data = DevicePrefetcher(data, depth=prefetch_depth,
+                                sharding=sharding)
+        logger.info("device prefetch: depth %d%s", prefetch_depth,
+                    f" onto {sharding}" if sharding is not None else "")
+    metrics_lag = 1 if getattr(args, "lag_metrics", False) else 0
+    if metrics_lag:
+        logger.info("lag-1 metrics drain: guard/telemetry reads run one "
+                    "step behind dispatch")
 
     obs_ctx = _setup_observability(args)
     timeline = obs_ctx.timeline
@@ -779,7 +831,8 @@ def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
                     checkpoint_every=args.ckpt_every,
                     log_every=args.log_every, stop_fn=guard.requested,
                     watchdog=watchdog, step_guard=step_guard,
-                    timeline=timeline, **ckpt_kwargs)
+                    timeline=timeline, metrics_lag=metrics_lag,
+                    **ckpt_kwargs)
             _log_final(history)
             if guard.preempted:
                 logger.warning("run was preempted; checkpoint saved at "
@@ -807,7 +860,8 @@ def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
                        checkpoint_every=args.ckpt_every,
                        log_every=args.log_every, stop_fn=stop_fn,
                        watchdog=watchdog, step_guard=step_guard,
-                       timeline=timeline, **ckpt_kwargs)
+                       timeline=timeline, metrics_lag=metrics_lag,
+                       **ckpt_kwargs)
 
         supervisor = Supervisor(
             run_attempt, num_steps=args.steps,
@@ -1078,7 +1132,7 @@ def _train_clip(args, info, per_process_batch: int, injector=None) -> int:
 
     return _run_fit(ClipBatches(), state, step, args,
                     state_factory=lambda: prepare_state(base_state()),
-                    injector=injector)
+                    injector=injector, sharding=sharding)
 
 
 def build_serve_parser() -> argparse.ArgumentParser:

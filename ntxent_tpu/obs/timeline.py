@@ -69,9 +69,25 @@ class StepTimeline:
             "train_step_data_wait_ms",
             "host wait on the input pipeline per step",
             window=histogram_window)
+        # Transfer-aware split of the data wait (ISSUE 4): where pipeline
+        # time actually goes — producing the batch on the host vs moving
+        # it to the device. Populated when the iterator reports the split
+        # (data.DevicePrefetcher.last_timing); a plain iterator's wait is
+        # recorded as all host fetch.
+        self._host_fetch = r.histogram(
+            "train_step_host_fetch_ms",
+            "host time producing each consumed batch (slice/decode/"
+            "augment dispatch)",
+            window=histogram_window)
+        self._transfer = r.histogram(
+            "train_step_transfer_ms",
+            "host->device transfer dispatch time per consumed batch "
+            "(the copy itself rides under compute)",
+            window=histogram_window)
         self._device = r.histogram(
             "train_step_device_ms",
-            "device time per step (block_until_ready bracketed)",
+            "device time per step (block_until_ready bracketed; "
+            "dispatch-to-ready latency under metrics_lag)",
             window=histogram_window)
         # NB no per-step checkpoint histogram: most steps' hook time is
         # a microsecond no-op (the cadence filter saves rarely), so a
@@ -122,9 +138,27 @@ class StepTimeline:
     def record_step(self, step: int, loss: float,
                     data_wait_s: float, device_s: float,
                     hook_s: float = 0.0, ok: bool | None = None,
-                    grad_norm: float | None = None) -> None:
+                    grad_norm: float | None = None,
+                    host_fetch_s: float | None = None,
+                    transfer_s: float | None = None) -> None:
         """One completed step. ``ok=None`` means the step carried no
-        jit-side guard (unguarded fast path)."""
+        jit-side guard (unguarded fast path).
+
+        ``host_fetch_s``/``transfer_s`` split the input-pipeline time:
+        producing the batch on the host vs dispatching its host->device
+        transfer (``train_loop`` forwards ``DevicePrefetcher.
+        last_timing``). With a prefetcher the split describes the batch
+        consumed this step (whose fetch/transfer ran UNDER earlier
+        steps), while ``data_wait_s`` stays the time this step actually
+        blocked — near zero when the pipeline keeps up. ``host_fetch_s=
+        None`` records the whole wait as host fetch; ``transfer_s=None``
+        (no prefetcher: placement is buried in the iterator) leaves the
+        transfer series untouched.
+
+        Under ``train_loop(metrics_lag=1)`` records arrive one step after
+        dispatch and ``device_s`` is dispatch-to-ready latency — the
+        documented lag-1 semantics.
+        """
         now = time.perf_counter()
         if self._last_done is not None:
             wall_s = max(now - self._last_done, 1e-9)
@@ -135,6 +169,11 @@ class StepTimeline:
 
         self._steps.inc()
         self._data_wait.observe(data_wait_s * 1e3)
+        if host_fetch_s is None:
+            host_fetch_s = data_wait_s  # no split known: all host fetch
+        self._host_fetch.observe(host_fetch_s * 1e3)
+        if transfer_s is not None:
+            self._transfer.observe(transfer_s * 1e3)
         self._device.observe(device_s * 1e3)
         self._sps.set(steps_per_sec)
         if math.isfinite(loss):
@@ -151,9 +190,12 @@ class StepTimeline:
             # EventLog itself (events._sanitize) — no per-site handling.
             fields = dict(step=int(step), loss=float(loss),
                           data_wait_ms=round(data_wait_s * 1e3, 3),
+                          host_fetch_ms=round(host_fetch_s * 1e3, 3),
                           device_ms=round(device_s * 1e3, 3),
                           checkpoint_ms=round(hook_s * 1e3, 3),
                           steps_per_sec=round(steps_per_sec, 4))
+            if transfer_s is not None:
+                fields["transfer_ms"] = round(transfer_s * 1e3, 3)
             if mfu is not None:
                 fields["mfu"] = round(mfu, 4)
             if grad_norm is not None:

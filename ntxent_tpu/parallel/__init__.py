@@ -14,6 +14,7 @@ from ntxent_tpu.parallel.mesh import (
     process_info,
     replicate_state,
     replicated_sharding,
+    sharded_prefetch,
 )
 from ntxent_tpu.parallel.pair import (
     make_pair_ntxent,
@@ -79,6 +80,7 @@ __all__ = [
     "switch_moe",
     "replicate_state",
     "replicated_sharding",
+    "sharded_prefetch",
     "make_sharded_ntxent",
     "ntxent_loss_distributed",
     "make_ring_ntxent",

@@ -30,6 +30,7 @@ __all__ = [
     "create_hybrid_mesh",
     "data_sharding",
     "replicated_sharding",
+    "sharded_prefetch",
     "global_batch",
     "local_row_gids",
     "process_info",
@@ -235,6 +236,23 @@ def data_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def sharded_prefetch(iterator, mesh: Mesh, axis: str = "data",
+                     depth: int = 2):
+    """Async pipeline stage for the sharded train path: batches prefetched
+    as COMMITTED global arrays laid out over the mesh's ``axis``.
+
+    The overlap-friendly replacement for a per-step ``trainer.shard_batch``
+    (which blocks the critical path on placement every step): a
+    ``training.data.DevicePrefetcher`` bound to this mesh's batch sharding
+    keeps ``depth`` batches transferring under the running step, and the
+    sharded step receives arrays that already match its in_specs.
+    """
+    from ..training.data import DevicePrefetcher
+
+    return DevicePrefetcher(iterator, depth=depth,
+                            sharding=data_sharding(mesh, axis))
 
 
 def replicate_state(tree, mesh: Mesh):

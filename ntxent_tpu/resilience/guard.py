@@ -72,6 +72,17 @@ class DivergenceGuard:
     to demand a rollback. Exposes ``scale_value()`` — the gradient scale
     the loop passes to guarded steps (jnp scalar: updating it never
     retraces the step).
+
+    Lag tolerance: under ``train_loop(metrics_lag=1)`` every outcome
+    arrives exactly ONE step after it was dispatched (``outcome.lag ==
+    1``), so each tier fires one step late in wall time but on the same
+    skip counts — a NaN is never missed, only reported late. That is safe
+    because the jit-side guard already withheld the non-finite update
+    from params/opt-state in-step; the host tiers here only decide
+    escalation. The backoff scale reaches the step stream up to two steps
+    after the diverged step (the next step is already in flight when the
+    outcome is read). ``divergence`` events carry the lag so a reader can
+    line them up against ``step`` events.
     """
 
     def __init__(self, backoff_after: int | None = 2,
@@ -119,7 +130,8 @@ class DivergenceGuard:
             "divergence", action=action, step=int(outcome.step),
             loss=outcome.loss, grad_norm=outcome.grad_norm,
             consecutive=self.consecutive_skips,
-            total=self.total_skips, scale=self.scale, guarded=True)
+            total=self.total_skips, scale=self.scale, guarded=True,
+            lag=int(getattr(outcome, "lag", 0)))
 
     def _rollback(self, outcome, message: str) -> None:
         _ROLLBACKS.inc()

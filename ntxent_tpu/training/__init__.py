@@ -7,6 +7,7 @@ from ntxent_tpu.training.evaluation import (
 )
 from ntxent_tpu.training.data import (
     ArrayDataset,
+    DevicePrefetcher,
     PrefetchIterator,
     synthetic_images,
     two_view_iterator,
@@ -53,6 +54,7 @@ __all__ = [
     "knn_accuracy",
     "linear_probe",
     "ArrayDataset",
+    "DevicePrefetcher",
     "PrefetchIterator",
     "synthetic_images",
     "two_view_iterator",

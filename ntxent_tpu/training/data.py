@@ -11,7 +11,10 @@ tests (no dataset downloads are assumed available)."""
 
 from __future__ import annotations
 
+import collections
+import inspect
 import threading
+import time
 import queue as queue_mod
 from collections.abc import Iterator
 
@@ -22,7 +25,7 @@ import numpy as np
 from .augment import augment_batch_pair
 
 __all__ = ["ArrayDataset", "synthetic_images", "two_view_iterator",
-           "PrefetchIterator"]
+           "PrefetchIterator", "DevicePrefetcher"]
 
 
 def synthetic_images(num: int, size: int = 32, channels: int = 3,
@@ -79,6 +82,7 @@ class PrefetchIterator:
         self.queue: queue_mod.Queue = queue_mod.Queue(maxsize=depth)
         self.done = object()
         self.error: BaseException | None = None
+        self._error_raised = False
         self._stop = threading.Event()
         self.thread = threading.Thread(target=self._fill, daemon=True)
         self.thread.start()
@@ -102,20 +106,38 @@ class PrefetchIterator:
             except queue_mod.Full:
                 pass  # consumer stopped; nothing is waiting for the sentinel
 
-    def close(self):
-        """Stop the producer thread and release buffered batches."""
+    def close(self, timeout: float = 5.0):
+        """Stop the producer thread and release buffered batches.
+
+        Joins the producer with ``timeout`` (a producer wedged in a blocking
+        read must not wedge the consumer's shutdown too). A producer error
+        the consumer never observed via ``__next__`` is re-raised here —
+        an epoch abandoned mid-flight must not swallow the reason the
+        producer died.
+        """
         self._stop.set()
         while True:  # drain so the producer can observe the stop flag
             try:
                 self.queue.get_nowait()
             except queue_mod.Empty:
                 break
-        self.thread.join(timeout=5.0)
+        self.thread.join(timeout=timeout)
+        if self.error is not None and not self._error_raised:
+            self._error_raised = True
+            raise self.error
 
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
+        if exc and exc[0] is not None:
+            # Already unwinding: don't let a pending producer error mask
+            # the exception in flight; close() raising would replace it.
+            try:
+                self.close()
+            except BaseException:
+                pass
+            return
         self.close()
 
     def __iter__(self):
@@ -125,6 +147,165 @@ class PrefetchIterator:
         item = self.queue.get()
         if item is self.done:
             if self.error is not None:
-                raise RuntimeError("prefetch producer failed") from self.error
+                # Surface the producer's ORIGINAL exception (type intact:
+                # callers match on OSError/StopIteration-adjacent types,
+                # e.g. a RetryPolicy-exhausted fetch), not a flattened
+                # RuntimeError.
+                self._error_raised = True
+                raise self.error
             raise StopIteration
+        return item
+
+
+class DevicePrefetcher:
+    """Device-side async pipeline stage: keeps ``depth`` batches ALREADY
+    TRANSFERRED (or transferring) on the device ahead of the consumer.
+
+    ``jax.device_put`` is non-blocking: issuing the transfer for batch
+    k+1..k+depth while the step for batch k runs overlaps host->device
+    copy with compute (the big_vision prefetch discipline). Compose with
+    ``PrefetchIterator`` (host-thread fetch) for the full pipeline::
+
+        host thread:   fetch k+2 | fetch k+3 | ...
+        transfers:          put k+1  | put k+2 | ...
+        device:        step k   | step k+1    | ...
+
+    ``sharding`` (a ``NamedSharding``) makes this the sharded path's
+    pipeline stage: batches arrive as COMMITTED global arrays laid out
+    for the mesh, so the train step never pays a blocking per-step
+    ``shard_batch``/``device_put`` re-placement (``parallel.mesh.
+    sharded_prefetch`` builds this from a mesh). Leaves that are already
+    committed ``jax.Array``s with the requested sharding pass through
+    untouched — wrapping an iterator that places its own output (e.g.
+    ``TwoViewPipeline(sharding=...)``) buffers it without re-placing.
+
+    Checkpointable-iterator protocol: when the inner iterator exposes
+    ``state()``/``restore()``, so does the prefetcher — ``state()``
+    returns the position of the next batch the CONSUMER will receive
+    (each buffered batch remembers the state captured before its pull),
+    so a resumed run replays nothing and skips nothing despite the
+    read-ahead. ``last_timing()`` reports the (host_fetch_s, transfer_s)
+    split of the batch most recently yielded; ``train_loop`` feeds it to
+    ``StepTimeline.record_step`` as the data-wait breakdown.
+    """
+
+    def __init__(self, iterator, depth: int = 2, sharding=None):
+        self.iterator = iter(iterator)
+        self.depth = max(1, int(depth))
+        self.sharding = sharding
+        self._stateful = hasattr(iterator, "state") \
+            and hasattr(iterator, "restore")
+        self._inner = iterator  # the stateful/closeable object itself
+        self._buf: collections.deque = collections.deque()
+        self._exhausted = False
+        self._timing: tuple[float, float] | None = None
+        if self._stateful:
+            # Expose the checkpointable-iterator protocol only when the
+            # inner iterator has it: trainer.fit keys on hasattr, and a
+            # prefetcher over a stateless iterator must not pretend.
+            self.state = self._state
+            self.restore = self._restore
+
+    def _placed(self, x) -> bool:
+        return isinstance(x, jax.Array) and (
+            self.sharding is None or x.sharding == self.sharding)
+
+    def _put(self, item):
+        # One device_put for the whole batch tree (it accepts pytrees):
+        # per-leaf calls pay JAX dispatch overhead per view. Trees whose
+        # every leaf is already placed pass through untouched — never
+        # re-commit an iterator's own placement per step.
+        if all(self._placed(leaf) for leaf in jax.tree.leaves(item)):
+            return item
+        if self.sharding is None:
+            return jax.device_put(item)
+        return jax.device_put(item, self.sharding)
+
+    def _pull(self) -> None:
+        st = self._inner.state() if self._stateful else None
+        t0 = time.perf_counter()
+        try:
+            item = next(self.iterator)
+        except StopIteration:
+            self._exhausted = True
+            return
+        t1 = time.perf_counter()
+        item = self._put(item)
+        t2 = time.perf_counter()
+        self._buf.append((item, st, t1 - t0, t2 - t1))
+
+    def last_timing(self) -> tuple[float, float] | None:
+        """(host_fetch_s, transfer_dispatch_s) of the batch the last
+        ``__next__`` returned (None before the first). host_fetch is the
+        blocking pull from the inner iterator; transfer is the
+        ``device_put`` DISPATCH time (the copy itself is async — it rides
+        under the steps that ran between pull and consumption)."""
+        return self._timing
+
+    def _state(self) -> dict:
+        if self._buf:
+            return self._buf[0][1]
+        return self._inner.state()
+
+    def _restore(self, state: dict) -> None:
+        # Read-ahead is position-tagged, not position-free: batches pulled
+        # for the OLD position are dropped and the inner iterator rebuilds
+        # at the restored one.
+        self._buf.clear()
+        self._exhausted = False
+        self._inner.restore(state)
+        # Re-enter the inner iterator: a StreamingLoader-style __iter__
+        # returns a generator that reads its offset only at creation (or
+        # epoch boundaries), so the pre-restore generator would keep
+        # yielding from the stale position. For self-iterating pipelines
+        # (TwoViewPipeline et al.) this is an identity no-op.
+        self.iterator = iter(self._inner)
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Release buffered batches; propagate to a closeable inner
+        iterator (e.g. PrefetchIterator's producer thread), including any
+        pending producer error its ``close()`` re-raises."""
+        self._buf.clear()
+        inner_close = getattr(self._inner, "close", None)
+        if inner_close is None:
+            return
+        # Decide the signature UP FRONT: a try/except TypeError around the
+        # call would also swallow a producer error of type TypeError that
+        # PrefetchIterator.close() re-raises — the exact contract this
+        # propagation exists for.
+        try:
+            takes_arg = bool(inspect.signature(inner_close).parameters)
+        except (TypeError, ValueError):  # builtins without signatures
+            takes_arg = False
+        if takes_arg:
+            inner_close(timeout)
+        else:
+            inner_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if exc and exc[0] is not None:
+            # Already unwinding (e.g. a DivergenceError headed for the
+            # supervisor): an unseen producer error re-raised by the inner
+            # close() must not REPLACE it — same policy as
+            # PrefetchIterator.__exit__.
+            try:
+                self.close()
+            except BaseException:
+                pass
+            return
+        self.close()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while not self._exhausted and len(self._buf) < self.depth:
+            self._pull()
+        if not self._buf:
+            raise StopIteration
+        item, _, host_s, transfer_s = self._buf.popleft()
+        self._timing = (host_s, transfer_s)
         return item

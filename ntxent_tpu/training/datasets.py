@@ -325,8 +325,11 @@ class TwoViewPipeline:
 
     ``state()`` reflects batches the consumer actually pulled, so a resumed
     pipeline replays nothing and skips nothing. The loader's own threaded
-    read-ahead provides host overlap; do NOT wrap this in another host
-    prefetcher (it would decouple loader position from consumer position).
+    read-ahead provides host overlap; do NOT wrap this in another host-
+    thread prefetcher (it would decouple loader position from consumer
+    position). ``data.DevicePrefetcher`` IS safe to wrap around it — its
+    ``state()`` tags each buffered batch with the consumer position, so
+    the exact-resume contract survives device-side read-ahead.
     trainer.fit detects these two methods and checkpoints the state next to
     the model (the fix for round 1's O(steps) fast-forward resume).
     """
@@ -476,29 +479,13 @@ def device_prefetch(iterator, depth: int = 2, sharding=None):
 
     ``jax.device_put`` is asynchronous: issuing the transfer for batch k+1
     while the step for batch k runs overlaps host->device copy with compute.
-    A small deque holds the in-flight handles.
+    Thin constructor over ``training.data.DevicePrefetcher`` (the full
+    pipeline stage: committed-sharding placement, checkpointable-iterator
+    passthrough, per-batch fetch/transfer timing for the step timeline).
     """
-    import collections
+    from .data import DevicePrefetcher
 
-    buf = collections.deque()
-
-    def put(x):
-        return jax.device_put(x, sharding) if sharding is not None \
-            else jax.device_put(x)
-
-    it = iter(iterator)
-    try:
-        for _ in range(depth):
-            buf.append(jax.tree.map(put, next(it)))
-    except StopIteration:
-        pass
-    while buf:
-        out = buf.popleft()
-        try:
-            buf.append(jax.tree.map(put, next(it)))
-        except StopIteration:
-            pass
-        yield out
+    return DevicePrefetcher(iterator, depth=depth, sharding=sharding)
 
 
 def grain_loader(source, batch_size: int, seed: int = 0,

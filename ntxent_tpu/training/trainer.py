@@ -55,12 +55,19 @@ class StepOutcome:
     optimizer state / BN stats kept their pre-step values while
     ``state.step`` still advanced. ``grad_norm`` is None for steps built
     without the guard (they report no norm).
+
+    ``lag`` is how many steps behind dispatch this outcome was read
+    (``train_loop(metrics_lag=1)``: the host drains step N-1's metrics
+    while step N runs, so the guard learns about a divergence exactly one
+    step late — never missing it, because the jit-side guard already kept
+    the bad update out of the params).
     """
 
     step: int
     loss: float
     grad_norm: float | None
     ok: bool
+    lag: int = 0
 
 
 def _guarded_update(state: TrainState, grads, loss, new_stats=None):
@@ -478,7 +485,13 @@ def make_sharded_clip_train_step(
 
 
 def shard_batch(batch, mesh: Mesh, axis: str = "data"):
-    """Place a host batch with its leading dim sharded over the mesh."""
+    """Place a host batch with its leading dim sharded over the mesh.
+
+    This is the BLOCKING per-step spelling (fine for tests and one-off
+    placement); on the training hot path wrap the batch iterator in
+    ``parallel.mesh.sharded_prefetch`` instead, which keeps committed
+    global arrays transferring under the running step.
+    """
     sharding = NamedSharding(mesh, P(axis))
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
@@ -532,6 +545,7 @@ def train_loop(
     watchdog=None,
     step_guard: Callable | None = None,
     timeline=None,
+    metrics_lag: int = 0,
 ):
     """Simple host loop: step, log loss / steps-per-sec / MFU.
 
@@ -564,11 +578,39 @@ def train_loop(
     for guarded runs; leave step_guard None on the raw-throughput path).
 
     ``timeline`` (``obs.StepTimeline``) records the per-step breakdown —
-    data-fetch wait, ``block_until_ready``-bracketed device time,
-    step-hook (checkpoint) time, steps/sec, MFU — into the metrics
-    registry and event log. Same per-step host-sync cost caveat as
-    ``step_guard``; leave None on the raw-throughput path.
+    data-fetch wait (split into host-fetch vs device-transfer when the
+    iterator is a ``data.DevicePrefetcher``), ``block_until_ready``-
+    bracketed device time, step-hook (checkpoint) time, steps/sec, MFU —
+    into the metrics registry and event log. Same per-step host-sync cost
+    caveat as ``step_guard``; leave None on the raw-throughput path — or
+    pair either with ``metrics_lag=1`` to take the sync off the critical
+    path.
+
+    ``metrics_lag=1`` (lag-1 metrics drain): the host reads step N-1's
+    ``loss``/``grad_norm``/``step_ok`` AFTER dispatching step N, so the
+    guard's/timeline's device-to-host reads overlap step N's compute
+    instead of serializing the loop. Semantics under lag, all documented
+    one-step-late, never-missed:
+
+    * ``step_guard`` sees each ``StepOutcome`` (tagged ``lag=1``) exactly
+      one step after it was dispatched; a ``DivergenceError`` therefore
+      aborts with one extra step dispatched — harmless, because the
+      jit-side guard already kept the non-finite update out of the
+      params, and the final pending outcome is always drained (a NaN on
+      the very last step still raises).
+    * A guard-driven gradient ``scale`` change reaches the step stream
+      up to two steps after the diverged step (the next step is already
+      dispatched when the outcome is read).
+    * ``step_hook`` (checkpoint cadence) for step N runs after step
+      N-1's outcome validated, so a diverged attempt never force-saves
+      past its last validated step — same invariant as the sync path,
+      shifted one step.
+    * ``timeline`` records device time as dispatch-to-ready latency
+      (the sync bracket would reintroduce the stall being removed) and
+      ``hook(state, entry)`` observes the newest dispatched state.
     """
+    if metrics_lag not in (0, 1):
+        raise ValueError(f"metrics_lag must be 0 or 1, got {metrics_lag}")
     history = []
     use_scale = step_guard is not None and hasattr(step_guard,
                                                    "scale_value")
@@ -594,11 +636,70 @@ def train_loop(
         # don't pull a batch or pay the step-1 AOT compile on the way out.
         logger.warning("stop requested before training started")
         return state, history
+
+    def outcome_of(step, metrics):
+        return StepOutcome(
+            step=step, loss=float(metrics["loss"]),
+            grad_norm=(float(metrics["grad_norm"])
+                       if "grad_norm" in metrics else None),
+            ok=bool(metrics.get("step_ok", True)), lag=metrics_lag)
+
+    def record_and_log(step, metrics, device_s, waits, hook_s,
+                       force_log=False):
+        """Timeline record + log-boundary reads for one COMPLETED step
+        (metrics already host-readable). Shared by the sync path and the
+        lag-1 drain."""
+        nonlocal last_t, last_step
+        data_wait_s, host_fetch_s, transfer_s = waits
+        if timeline is not None:
+            timeline.record_step(
+                step=step_base + step, loss=float(metrics["loss"]),
+                data_wait_s=data_wait_s, device_s=device_s,
+                hook_s=hook_s,
+                host_fetch_s=host_fetch_s, transfer_s=transfer_s,
+                ok=(bool(metrics["step_ok"]) if "step_ok" in metrics
+                    else None),
+                grad_norm=(float(metrics["grad_norm"])
+                           if "grad_norm" in metrics else None))
+        if step % log_every == 0 or step == num_steps or force_log:
+            loss = float(metrics["loss"])
+            now = time.perf_counter()
+            sps = (step - last_step) / max(now - last_t, 1e-9)
+            last_t, last_step = now, step
+            entry = {"step": step, "loss": loss, "steps_per_sec": sps}
+            if flops_per_step:
+                entry["mfu"] = estimate_mfu(flops_per_step, sps)
+            history.append(entry)
+            logger.info("step %d: loss=%.4f, %.2f steps/s", step, loss, sps)
+            if hook is not None:
+                hook(state, entry)
+
+    def drain(rec, force_log=False):
+        """Lag-1 path: consume a previously dispatched step's metrics.
+        The block here overlaps the step dispatched after it — by drain
+        time the metrics are usually already resident."""
+        step, metrics, t_dispatch, waits, hook_s = rec
+        metrics = jax.block_until_ready(metrics)
+        # Dispatch-to-ready latency, not a bracketed sync (see docstring).
+        device_s = time.perf_counter() - t_dispatch
+        if watchdog is not None:
+            watchdog.beat()
+        if step_guard is not None:
+            step_guard(outcome_of(step, metrics))
+        record_and_log(step, metrics, device_s, waits, hook_s, force_log)
+
+    pending = None  # lag-1: (step, metrics, t_dispatch, waits, hook_s)
     stopped = False
     for step in range(1, num_steps + 1):
         t_fetch = time.perf_counter()
         v1, v2 = next(data_iter)
         data_wait_s = time.perf_counter() - t_fetch
+        # DevicePrefetcher exposes the (host-fetch, transfer) split of the
+        # batch it just yielded; a plain iterator's wait is all host fetch.
+        split = data_iter.last_timing() \
+            if hasattr(data_iter, "last_timing") else None
+        waits = (data_wait_s, split[0] if split else data_wait_s,
+                 split[1] if split else None)
         if step == 1 and flops_per_step == "auto":
             aot_args = (state, v1, v2) + (
                 (step_guard.scale_value(),) if use_scale else ())
@@ -620,6 +721,24 @@ def train_loop(
                     else None)
         t_step = time.perf_counter()
         state, metrics = run_step(train_step, state, v1, v2)
+        if metrics_lag:
+            # Step N is in flight; NOW read step N-1 (overlapped drain).
+            if pending is not None:
+                drain(pending)
+                pending = None
+            t_hook = time.perf_counter()
+            if step_hook is not None:
+                step_hook(state)
+            pending = (step, metrics, t_step, waits,
+                       time.perf_counter() - t_hook)
+            stopped = stop_fn is not None and stop_fn()
+            if stopped:
+                drain(pending, force_log=True)
+                pending = None
+                logger.warning("stop requested: leaving train loop at "
+                               "step %d of %d", step, num_steps)
+                break
+            continue
         if timeline is not None:
             # Bracket the device time: without the sync, the dispatch
             # returns immediately and per-step timing measures nothing
@@ -629,40 +748,23 @@ def train_loop(
         if watchdog is not None:
             watchdog.beat()
         if step_guard is not None:
-            step_guard(StepOutcome(
-                step=step, loss=float(metrics["loss"]),
-                grad_norm=(float(metrics["grad_norm"])
-                           if "grad_norm" in metrics else None),
-                ok=bool(metrics.get("step_ok", True))))
+            step_guard(outcome_of(step, metrics))
         t_hook = time.perf_counter()
         if step_hook is not None:
             step_hook(state)
-        if timeline is not None:
-            timeline.record_step(
-                step=step_base + step, loss=float(metrics["loss"]),
-                data_wait_s=data_wait_s, device_s=device_s,
-                hook_s=time.perf_counter() - t_hook,
-                ok=(bool(metrics["step_ok"]) if "step_ok" in metrics
-                    else None),
-                grad_norm=(float(metrics["grad_norm"])
-                           if "grad_norm" in metrics else None))
+        hook_s = time.perf_counter() - t_hook
         stopped = stop_fn is not None and stop_fn()
-        if step % log_every == 0 or step == num_steps or stopped:
-            loss = float(metrics["loss"])
-            now = time.perf_counter()
-            sps = (step - last_step) / max(now - last_t, 1e-9)
-            last_t, last_step = now, step
-            entry = {"step": step, "loss": loss, "steps_per_sec": sps}
-            if flops_per_step:
-                entry["mfu"] = estimate_mfu(flops_per_step, sps)
-            history.append(entry)
-            logger.info("step %d: loss=%.4f, %.2f steps/s", step, loss, sps)
-            if hook is not None:
-                hook(state, entry)
+        record_and_log(step, metrics, device_s, waits, hook_s,
+                       force_log=stopped)
         if stopped:
             logger.warning("stop requested: leaving train loop at step %d "
                            "of %d", step, num_steps)
             break
+    if pending is not None:
+        # Lag-1 epilogue: the final step's outcome is ALWAYS drained —
+        # a divergence on the last step raises here, before fit's
+        # force-save can persist past it.
+        drain(pending)
     return state, history
 
 
@@ -680,6 +782,7 @@ def fit(
     watchdog=None,
     step_guard: Callable | None = None,
     timeline=None,
+    metrics_lag: int = 0,
     checkpoint_retry_policy=None,
     checkpoint_verify_writes: bool = True,
 ):
@@ -687,10 +790,10 @@ def fit(
     exists, train to ``num_steps`` total, save every ``checkpoint_every``
     steps (on the GLOBAL ``state.step``) and at the end.
 
-    ``step_guard`` / ``watchdog`` / ``timeline``: forwarded to
-    ``train_loop`` (divergence policy, stall detection, per-step
-    telemetry). A guard-raised DivergenceError propagates
-    WITHOUT the final force-save — the diverged state must not become the
+    ``step_guard`` / ``watchdog`` / ``timeline`` / ``metrics_lag``:
+    forwarded to ``train_loop`` (divergence policy, stall detection,
+    per-step telemetry, lag-1 metrics drain). A guard-raised
+    DivergenceError propagates WITHOUT the final force-save — the diverged state must not become the
     newest checkpoint; resilience.Supervisor catches it and restarts from
     the last valid one (restore falls back past corrupt saves via
     CheckpointManager.latest_valid_step).
@@ -773,7 +876,7 @@ def fit(
             log_every=log_every,
             flops_per_step=flops_per_step, step_hook=step_hook,
             stop_fn=stop_fn, watchdog=watchdog, step_guard=step_guard,
-            timeline=timeline)
+            timeline=timeline, metrics_lag=metrics_lag)
         if manager is not None \
                 and manager.latest_step() != int(state.step):
             manager.save(int(state.step), state, force=True,

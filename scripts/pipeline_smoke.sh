@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Async-input-pipeline smoke (ISSUE 4): drive bench.py --pipeline on CPU
+# in <30 s and assert the pipeline actually pipelines:
+#   * prefetch-on (prefetch+lag-1) drops the data-wait fraction vs
+#     prefetch-off on the synthetic run (the host fetch leaves the
+#     critical path);
+#   * steps/s improves over the prefetch-off baseline;
+#   * the timeline's device-transfer split is populated in the prefetch
+#     modes (host_fetch vs transfer, ISSUE 4's measurability criterion).
+# Pairs with `pytest -m perf` (the same layer asserted in-process).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+artifact="BENCH_pipeline.json"
+backup=""
+if [ -f "$artifact" ]; then
+    # The committed record is the full-length run; don't let this smoke's
+    # short A/B replace it.
+    backup="$(mktemp)"
+    cp "$artifact" "$backup"
+fi
+restore() {
+    if [ -n "$backup" ]; then mv "$backup" "$artifact"; fi
+}
+trap restore EXIT
+
+JAX_PLATFORMS=cpu NTXENT_PIPELINE_STEPS=50 NTXENT_PIPELINE_REPS=2 \
+    python bench.py --pipeline >/dev/null
+
+python - "$artifact" <<'PY'
+import json
+import sys
+
+rec = json.load(open(sys.argv[1]))
+assert rec.get("error") is None, rec
+modes = rec["modes"]
+for mode in ("off", "buffered", "prefetch", "prefetch+lag"):
+    assert mode in modes, (mode, list(modes))
+
+off, lag = modes["off"], modes["prefetch+lag"]
+# Prefetch-on must drop the data-wait fraction decisively (the synthetic
+# host fetch costs ~host_ms per batch, all on the critical path when off).
+assert lag["data_wait_frac"] < off["data_wait_frac"] / 2, (off, lag)
+# And the hidden fetch must buy real steps/s on the same workload.
+speedup = rec["speedup_prefetch_lag_vs_baseline"]
+assert speedup > 1.02, (speedup, off, lag)
+# The transfer split exists exactly where a DevicePrefetcher ran.
+for mode in ("prefetch", "prefetch+lag"):
+    assert modes[mode].get("transfer_ms_mean") is not None, modes[mode]
+assert "transfer_ms_mean" not in modes["off"], modes["off"]
+assert rec["platform"], rec
+
+print(f"pipeline smoke: OK — off {off['steps_per_sec']:.1f}/s "
+      f"(wait {off['data_wait_frac']:.2f}) -> prefetch+lag "
+      f"{lag['steps_per_sec']:.1f}/s (wait {lag['data_wait_frac']:.2f}), "
+      f"speedup {speedup:.3f}x on {rec['platform']}")
+PY

@@ -1,0 +1,385 @@
+"""Async input pipeline (ISSUE 4): device-side prefetch + lag-1 drain.
+
+Four contracts under test:
+
+* ``PrefetchIterator`` error semantics — the producer's ORIGINAL
+  exception type reaches the consumer (or ``close()``, if the consumer
+  never pulls it), and a mid-epoch shutdown joins the producer thread.
+* ``DevicePrefetcher`` — order-preserving device placement ahead of
+  consumption, committed mesh sharding on the sharded path, and a
+  checkpointable ``state()`` that tracks the CONSUMER's position (not
+  the read-ahead's).
+* lag-1 metrics drain (``train_loop(metrics_lag=1)``) — numerically
+  identical history to the sync loop, with every guard outcome delivered
+  exactly ONE step late and never missed (a NaN on the final step still
+  escalates).
+* the timeline's transfer-aware data-wait split, populated end to end.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ntxent_tpu import obs
+from ntxent_tpu.models import ResNet, SimCLRModel
+from ntxent_tpu.obs.registry import MetricsRegistry
+from ntxent_tpu.obs.timeline import StepTimeline
+from ntxent_tpu.parallel import create_mesh, sharded_prefetch
+from ntxent_tpu.parallel.mesh import data_sharding
+from ntxent_tpu.resilience import DivergenceError, DivergenceGuard
+from ntxent_tpu.training import (
+    DevicePrefetcher,
+    PrefetchIterator,
+    TrainerConfig,
+    create_train_state,
+    make_train_step,
+    train_loop,
+)
+
+pytestmark = pytest.mark.perf
+
+B, S = 4, 8
+TinyEnc = functools.partial(ResNet, stage_sizes=(1,), small_images=True)
+
+
+def _tiny_state(seed: int = 0):
+    model = SimCLRModel(encoder=TinyEnc, proj_hidden_dim=16, proj_dim=8)
+    cfg = TrainerConfig(batch_size=B, total_steps=20, warmup_steps=1)
+    return create_train_state(model, jax.random.PRNGKey(seed),
+                              (1, S, S, 3), cfg)
+
+
+def _view_batches(nan_at=(), count=None, key_seed=1):
+    """Two-view batch stream; batch ordinals in ``nan_at`` are poisoned."""
+    key = jax.random.PRNGKey(key_seed)
+    i = 0
+    while count is None or i < count:
+        i += 1
+        key, sub = jax.random.split(key)
+        v = jax.random.normal(sub, (B, S, S, 3))
+        if i in nan_at:
+            v = jnp.full_like(v, jnp.nan)
+        yield v, v + 0.01
+
+
+# ---------------------------------------------------------------------------
+# PrefetchIterator error semantics (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_iterator_preserves_producer_exception_type():
+    def boom():
+        yield np.zeros(2)
+        raise KeyError("lost shard")
+
+    it = PrefetchIterator(boom(), depth=2)
+    assert next(it).shape == (2,)
+    with pytest.raises(KeyError, match="lost shard"):
+        next(it)
+    it.close()  # an error the consumer already saw is not re-raised
+
+
+def test_prefetch_iterator_close_reraises_unseen_producer_error():
+    def boom():
+        raise OSError("flaky nfs read")
+        yield  # pragma: no cover  (makes this a generator)
+
+    it = PrefetchIterator(boom(), depth=2)
+    it.thread.join(timeout=5.0)  # let the producer die
+    with pytest.raises(OSError, match="flaky nfs"):
+        it.close()
+    assert not it.thread.is_alive()
+    it.close()  # idempotent: the error is consumed, second close is clean
+
+
+def test_prefetch_iterator_shutdown_mid_epoch():
+    def endless():
+        i = 0
+        while True:
+            yield np.full((2,), i, np.float32)
+            i += 1
+
+    it = PrefetchIterator(endless(), depth=2)
+    assert float(next(it)[0]) == 0.0
+    it.close(timeout=5.0)
+    assert not it.thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# DevicePrefetcher
+# ---------------------------------------------------------------------------
+
+
+def test_device_prefetcher_order_exhaustion_and_timing():
+    batches = [np.full((2, 2), i, np.float32) for i in range(5)]
+    pf = DevicePrefetcher(iter(batches), depth=2)
+    out = list(pf)
+    assert len(out) == 5
+    for i, x in enumerate(out):
+        assert isinstance(x, jax.Array)
+        assert float(x[0, 0]) == float(i)
+    host_s, transfer_s = pf.last_timing()
+    assert host_s >= 0.0 and transfer_s >= 0.0
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_device_prefetcher_close_propagates_producer_type_error():
+    """Regression: a producer error of type TypeError must survive the
+    close() propagation — a naive try/except TypeError around the inner
+    close(timeout) call would swallow exactly this one."""
+    def boom():
+        raise TypeError("bad augment arity")
+        yield  # pragma: no cover
+
+    inner = PrefetchIterator(boom(), depth=2)
+    inner.thread.join(timeout=5.0)
+    pf = DevicePrefetcher(inner, depth=1)
+    with pytest.raises(TypeError, match="bad augment"):
+        pf.close()
+
+
+def test_device_prefetcher_composes_with_prefetch_iterator():
+    inner = PrefetchIterator(_view_batches(count=4), depth=2)
+    with DevicePrefetcher(inner, depth=2) as pf:
+        out = list(pf)
+    assert len(out) == 4
+    assert not inner.thread.is_alive()  # close propagated to the producer
+
+
+@pytest.mark.parametrize("n_devices", [1, 8])
+def test_sharded_prefetch_commits_global_arrays(n_devices):
+    mesh = create_mesh(devices=jax.devices()[:n_devices],
+                       axis_names=("data",))
+    want = data_sharding(mesh)
+
+    def host_batches():
+        for i in range(3):
+            yield (np.full((8, 4), i, np.float32),
+                   np.full((8, 4), -i, np.float32))
+
+    pf = sharded_prefetch(host_batches(), mesh, depth=2)
+    got = list(pf)
+    assert len(got) == 3
+    for v1, v2 in got:
+        for leaf in (v1, v2):
+            assert leaf.sharding == want
+            assert leaf.committed
+    # Committed arrays pass through untouched on a second hop (no
+    # re-placement per step — the point of prefetching the sharding).
+    again = list(DevicePrefetcher(iter(got), depth=1, sharding=want))
+    assert all(a is b for (a, _), (b, _) in zip(again, got))
+
+
+class _StatefulCounter:
+    """Minimal checkpointable iterator: batch k is filled with k."""
+
+    def __init__(self):
+        self.pos = 0
+
+    def state(self):
+        return {"pos": self.pos}
+
+    def restore(self, state):
+        self.pos = int(state["pos"])
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        value = self.pos
+        self.pos += 1
+        return np.full((2,), value, np.float32)
+
+
+def test_device_prefetcher_state_tracks_consumer_not_readahead():
+    inner = _StatefulCounter()
+    pf = DevicePrefetcher(inner, depth=3)
+    assert pf.state() == {"pos": 0}
+    first = next(pf)  # read-ahead pulls past the consumer...
+    assert float(first[0]) == 0.0
+    assert inner.pos >= 2
+    assert pf.state() == {"pos": 1}  # ...but state() is consumer truth
+    pf.restore({"pos": 0})  # buffered read-ahead is dropped
+    assert float(next(pf)[0]) == 0.0
+    assert pf.state() == {"pos": 1}
+
+
+def test_device_prefetcher_restore_reenters_generator_backed_inner():
+    """Regression: a StreamingLoader-style inner hands out a generator
+    that reads its offset only at creation — restore() must re-enter the
+    inner iterator or the prefetcher keeps pulling from the stale one."""
+    from ntxent_tpu.training.datasets import ArraySource, StreamingLoader
+
+    rows = np.arange(64, dtype=np.float32).reshape(64, 1, 1, 1)
+    loader = StreamingLoader(ArraySource(rows), batch_size=4, seed=7,
+                             num_threads=2, read_ahead=1)
+    pf = DevicePrefetcher(loader, depth=2)
+    for _ in range(2):
+        next(pf)
+    saved = pf.state()
+    expected = np.asarray(next(pf))  # the batch a resume must replay
+    pf.restore(saved)
+    np.testing.assert_array_equal(np.asarray(next(pf)), expected)
+
+
+def test_device_prefetcher_exit_does_not_mask_inflight_exception():
+    """Regression: __exit__ during unwinding must not let a pending
+    producer error replace the exception in flight (the supervisor
+    dispatches on DivergenceError and friends by type)."""
+    def boom():
+        raise OSError("producer died")
+        yield  # pragma: no cover
+
+    inner = PrefetchIterator(boom(), depth=2)
+    inner.thread.join(timeout=5.0)
+    with pytest.raises(RuntimeError, match="body error"):
+        with DevicePrefetcher(inner, depth=1):
+            raise RuntimeError("body error")
+
+
+def test_device_prefetcher_hides_protocol_for_plain_iterators():
+    pf = DevicePrefetcher(iter([np.zeros(2)]), depth=1)
+    # trainer.fit keys on these attributes: a prefetcher over a stateless
+    # iterator must not pretend to be checkpointable.
+    assert not hasattr(pf, "state")
+    assert not hasattr(pf, "restore")
+
+
+# ---------------------------------------------------------------------------
+# lag-1 metrics drain
+# ---------------------------------------------------------------------------
+
+
+def test_train_loop_rejects_unsupported_lag():
+    with pytest.raises(ValueError, match="metrics_lag"):
+        train_loop(_tiny_state(), _view_batches(), lambda s, a, b: None,
+                   num_steps=1, metrics_lag=2)
+
+
+def test_lag1_history_matches_sync_loop():
+    state = _tiny_state()
+    step = make_train_step(0.1, use_fused=False, guard=True)
+    histories = {}
+    for lag in (0, 1):
+        _, hist = train_loop(state, _view_batches(), step, num_steps=5,
+                             log_every=2, flops_per_step=None,
+                             metrics_lag=lag)
+        histories[lag] = [(h["step"], h["loss"]) for h in hist]
+    assert histories[0] == histories[1]
+
+
+def test_lag1_guard_sees_nan_exactly_one_step_late_never_missed():
+    state = _tiny_state()
+    step = make_train_step(0.1, use_fused=False, guard=True)
+    hooks_run = 0
+
+    def hook(_s):
+        nonlocal hooks_run
+        hooks_run += 1
+
+    seen = []
+
+    def guard(outcome):
+        seen.append((outcome.step, outcome.ok, outcome.lag, hooks_run))
+
+    train_loop(state, _view_batches(nan_at=(3,)), step, num_steps=6,
+               log_every=100, flops_per_step=None, step_guard=guard,
+               step_hook=hook, metrics_lag=1)
+    assert [s for s, ok, _, _ in seen if not ok] == [3]  # caught, once
+    assert all(lag == 1 for _, _, lag, _ in seen)
+    # Exactly one step late: when outcome N arrives, step N+1 has already
+    # been dispatched and hook N already ran (the sync loop interleaves
+    # guard N BEFORE hook N, i.e. hooks_run == N-1 there).
+    assert [h for s, _, _, h in seen] == [s for s, _, _, h in seen]
+
+
+@pytest.mark.parametrize("lag,batches_consumed", [(0, 3), (1, 4)])
+def test_rollback_fires_one_step_late_under_lag(lag, batches_consumed):
+    """Chaos check for the lag-1 semantics: the rollback escalation for a
+    NaN at step 3 fires during step 3 (sync) vs step 4 (lag-1) — late by
+    exactly one dispatched batch, never skipped."""
+    state = _tiny_state()
+    step = make_train_step(0.1, use_fused=False, guard=True)
+    consumed = 0
+
+    def counting_batches():
+        nonlocal consumed
+        for item in _view_batches(nan_at=(3,)):
+            consumed += 1
+            yield item
+
+    guard = DivergenceGuard(backoff_after=None, rollback_after=1)
+    with pytest.raises(DivergenceError):
+        train_loop(state, counting_batches(), step, num_steps=8,
+                   log_every=100, flops_per_step=None, step_guard=guard,
+                   metrics_lag=lag)
+    assert guard.total_skips == 1
+    assert consumed == batches_consumed
+
+
+def test_lag1_divergence_on_final_step_still_raises():
+    """The epilogue drain: a NaN on the very last step must escalate
+    BEFORE train_loop returns (fit's force-save runs after)."""
+    state = _tiny_state()
+    step = make_train_step(0.1, use_fused=False, guard=True)
+    guard = DivergenceGuard(backoff_after=None, rollback_after=1)
+    with pytest.raises(DivergenceError):
+        train_loop(state, _view_batches(nan_at=(4,)), step, num_steps=4,
+                   log_every=100, flops_per_step=None, step_guard=guard,
+                   metrics_lag=1)
+
+
+# ---------------------------------------------------------------------------
+# transfer-aware timeline split
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_records_transfer_split():
+    registry = MetricsRegistry()
+    timeline = StepTimeline(registry=registry)
+    log = obs.EventLog(None)
+    obs.install(log)
+    try:
+        timeline.record_step(step=1, loss=1.0, data_wait_s=0.001,
+                             device_s=0.01, host_fetch_s=0.004,
+                             transfer_s=0.002)
+        timeline.record_step(step=2, loss=0.9, data_wait_s=0.003,
+                             device_s=0.01)  # no split known
+    finally:
+        obs.install(None)
+        log.close()
+    snap = registry.collect()
+    assert snap["train_step_host_fetch_ms"]["count"] == 2
+    # Unknown split: the whole wait lands in host fetch, transfer untouched.
+    assert snap["train_step_transfer_ms"]["count"] == 1
+    assert snap["train_step_host_fetch_ms"]["max"] == pytest.approx(4.0)
+    events = [r for r in log.tail(10) if r["event"] == "step"]
+    assert events[0]["host_fetch_ms"] == pytest.approx(4.0)
+    assert events[0]["transfer_ms"] == pytest.approx(2.0)
+    assert "transfer_ms" not in events[1]
+    assert events[1]["host_fetch_ms"] == pytest.approx(3.0)
+
+
+def test_train_loop_with_prefetcher_populates_transfer_split():
+    state = _tiny_state()
+    step = make_train_step(0.1, use_fused=False, guard=True)
+    registry = MetricsRegistry()
+    timeline = StepTimeline(registry=registry)
+
+    def numpy_batches():
+        rng = np.random.RandomState(0)
+        while True:
+            v = rng.rand(B, S, S, 3).astype(np.float32)
+            yield v, np.flip(v, axis=2).copy()
+
+    with DevicePrefetcher(numpy_batches(), depth=2) as pf:
+        train_loop(state, pf, step, num_steps=3, log_every=100,
+                   flops_per_step=None, timeline=timeline, metrics_lag=1)
+    snap = registry.collect()
+    assert snap["train_steps_total"] == 3
+    assert snap["train_step_transfer_ms"]["count"] == 3
+    assert snap["train_step_host_fetch_ms"]["count"] == 3
