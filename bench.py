@@ -483,6 +483,198 @@ def _pipeline_child() -> None:
     print(SENTINEL + json.dumps(payload), flush=True)
 
 
+def _checkpoint_child() -> None:
+    """--checkpoint measurement: does async checkpointing hide save cost?
+
+    One tiny training setup run three ways with an artificially slow
+    filesystem (``NTXENT_CKPT_SLOW_MS`` throttles the physical write, so
+    the effect is deterministic on CPU where real writes are too fast to
+    see): ``none`` (no checkpointing), ``sync`` (save on the hot path),
+    ``async`` (AsyncCheckpointer: snapshot + background writer).
+    Interleaved reps, medians. The acceptance shape (ISSUE 5): async
+    lands within a few percent of no-checkpointing while sync shows the
+    full write cost, and the writer's registry series
+    (``checkpoint_queue_depth``, ``checkpoint_save_overlap_ms``) carry
+    samples — the same series /metrics serves.
+    """
+    import jax
+
+    if os.environ.get("NTXENT_BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    import functools
+    import shutil
+    import statistics
+    import tempfile
+
+    import numpy as np
+
+    backend = _child_backend(jax)
+
+    from ntxent_tpu.models import ResNet, SimCLRModel
+    from ntxent_tpu.obs.registry import default_registry
+    from ntxent_tpu.training import (
+        TrainerConfig,
+        create_train_state,
+        make_train_step,
+        train_loop,
+    )
+    from ntxent_tpu.training.checkpoint import (
+        AsyncCheckpointer,
+        CheckpointManager,
+    )
+
+    steps = int(os.environ.get("NTXENT_CKPT_BENCH_STEPS", "32"))
+    reps = int(os.environ.get("NTXENT_CKPT_BENCH_REPS", "3"))
+    slow_ms = float(os.environ.get("NTXENT_CKPT_BENCH_SLOW_MS", "250"))
+    every = int(os.environ.get("NTXENT_CKPT_BENCH_EVERY", "8"))
+    # The throttle models IO latency; real fsyncs on top of it only add
+    # this host's filesystem jitter to an A/B about overlap, so the
+    # bench (and only the bench) skips them.
+    os.environ["NTXENT_CKPT_NO_FSYNC"] = "1"
+    # Batch/size chosen so one step is ~100 ms of real compute: the
+    # writer's CPU work (serialize + CRC + fsync, ~20 ms) must amortize
+    # to noise on this host, because on CPU the "device" computes on the
+    # host's own cores and background CPU work cannot be hidden the way
+    # the throttle sleep (the simulated IO latency) can. On a real
+    # accelerator both components hide under device compute.
+    batch, size = 24, 16
+    os.environ["NTXENT_CKPT_SLOW_MS"] = str(slow_ms)
+
+    enc = functools.partial(ResNet, stage_sizes=(1,), small_images=True)
+    model = SimCLRModel(encoder=enc, proj_hidden_dim=16, proj_dim=8)
+    cfg = TrainerConfig(batch_size=batch, total_steps=steps,
+                        warmup_steps=1)
+    train_step = make_train_step(0.1, use_fused=False)
+
+    def fresh_state():
+        return create_train_state(model, jax.random.PRNGKey(0),
+                                  (1, size, size, 3), cfg)
+
+    def host_views(seed: int = 1):
+        rng = np.random.RandomState(seed)
+        while True:
+            v1 = rng.rand(batch, size, size, 3).astype(np.float32)
+            yield v1, np.flip(v1, axis=2).copy()
+
+    def run_mode(mode: str) -> dict:
+        """Steady-state measurement: the timed window holds the train
+        loop + the same per-step save hook ``fit`` installs; the final
+        writer drain (wait_until_finished + close) is timed SEPARATELY —
+        it is a fixed end-of-run cost that a 32-step window would
+        otherwise smear into the per-step rate."""
+        ckpt_dir = None
+        manager = None
+        if mode != "none":
+            ckpt_dir = tempfile.mkdtemp(prefix=f"ckpt_bench_{mode}_")
+            manager = CheckpointManager(ckpt_dir,
+                                        save_interval_steps=every)
+            if mode == "async":
+                manager = AsyncCheckpointer(manager)
+        hook_step = 0
+
+        def step_hook(s):  # fit's checkpoint hook, verbatim semantics
+            nonlocal hook_step
+            hook_step += 1
+            if manager is not None and manager.should_save(hook_step):
+                manager.save(hook_step, s)
+
+        try:
+            t0 = time.monotonic()
+            state, _ = train_loop(fresh_state(), host_views(),
+                                  train_step, num_steps=steps,
+                                  log_every=10 * steps,
+                                  flops_per_step=None,
+                                  step_hook=step_hook)
+            # Fair wall clock: the none mode never syncs on the device
+            # otherwise, which would time dispatch, not compute.
+            jax.block_until_ready(state.params)
+            wall_s = time.monotonic() - t0
+            t1 = time.monotonic()
+            if manager is not None:
+                manager.wait_until_finished()
+            return {"steps_per_sec": steps / wall_s,
+                    "drain_ms": (time.monotonic() - t1) * 1e3}
+        finally:
+            if manager is not None:
+                manager.close()
+            if ckpt_dir is not None:
+                shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    # One compile outside the timed reps (the jit cache is shared).
+    run_mode("none")
+
+    modes = ("none", "sync", "async")
+    samples: dict[str, list[float]] = {m: [] for m in modes}
+    for _ in range(reps):  # interleaved: drift hits every mode equally
+        for mode in modes:
+            samples[mode].append(run_mode(mode))
+
+    sps = {m: round(statistics.median([r["steps_per_sec"] for r in v]),
+                    2) for m, v in samples.items()}
+    drain = {m: round(statistics.median([r["drain_ms"] for r in v]), 1)
+             for m, v in samples.items()}
+    registry = default_registry()
+    prom = registry.render_prometheus()
+    overlap = registry.histogram("checkpoint_save_overlap_ms")
+    blocked = registry.histogram("checkpoint_save_blocked_ms")
+    payload = {
+        "metric": "train_checkpoint_overlap_steps_per_sec",
+        "backend": backend,
+        "platform": backend,
+        "device_kind": jax.local_devices()[0].device_kind,
+        "model": "tiny_resnet", "batch": batch, "image_size": size,
+        "steps_per_mode": steps, "reps": reps,
+        "ckpt_every": every, "write_throttle_ms": slow_ms,
+        "steps_per_sec": sps,
+        "final_drain_ms": drain,
+        "async_vs_none": round(sps["async"] / sps["none"], 3),
+        "sync_vs_none": round(sps["sync"] / sps["none"], 3),
+        "async_within_5pct_of_none":
+            sps["async"] >= 0.95 * sps["none"],
+        "sync_measurably_slower": sps["sync"] <= 0.9 * sps["none"],
+        "writer_series": {
+            "checkpoint_save_overlap_ms_count": overlap.count,
+            "checkpoint_save_blocked_ms_count": blocked.count,
+            "queue_depth_in_metrics":
+                "checkpoint_queue_depth" in prom,
+            "overlap_in_metrics":
+                "checkpoint_save_overlap_ms" in prom,
+        },
+    }
+    print(SENTINEL + json.dumps(payload), flush=True)
+
+
+def _checkpoint_main() -> None:
+    """--checkpoint: A/B checkpoint modes, write BENCH_checkpoint.json.
+
+    Same robustness contract as the headline: the parent imports no JAX,
+    the child is wall-clock-bounded, and a JSON record is emitted (file
+    + stdout) even on total failure.
+    """
+    backend = _probe_backend()
+    force_cpu = backend not in ("tpu", "axon")
+    payload, diag = _run_child(CHILD_TIMEOUT_S, force_cpu=force_cpu,
+                               child_flag="--checkpoint-child")
+    if payload is None and not force_cpu:
+        payload, diag2 = _run_child(CHILD_TIMEOUT_S, force_cpu=True,
+                                    child_flag="--checkpoint-child")
+        if payload is not None:
+            payload["error"] = f"accelerator path unavailable ({diag})"
+        else:
+            diag = f"{diag}; cpu fallback: {diag2}"
+    if payload is None:
+        payload = {"metric": "train_checkpoint_overlap_steps_per_sec",
+                   "steps_per_sec": {}, "error": diag}
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_checkpoint.json")
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    _record_progress(payload)
+    print(json.dumps(payload))
+
+
 def _pipeline_main() -> None:
     """--pipeline: A/B the async input pipeline, write BENCH_pipeline.json.
 
@@ -674,6 +866,13 @@ if __name__ == "__main__":
     parser.add_argument("--pipeline-child", action="store_true",
                         help="internal: run the pipeline measurement "
                              "in-process")
+    parser.add_argument("--checkpoint", action="store_true",
+                        help="A/B checkpointing (none/sync/async) under "
+                             "a throttled writer and write "
+                             "BENCH_checkpoint.json")
+    parser.add_argument("--checkpoint-child", action="store_true",
+                        help="internal: run the checkpoint measurement "
+                             "in-process")
     _args = parser.parse_args()
     if _args.child:
         _child()
@@ -685,5 +884,9 @@ if __name__ == "__main__":
         _pipeline_child()
     elif _args.pipeline:
         _pipeline_main()
+    elif _args.checkpoint_child:
+        _checkpoint_child()
+    elif _args.checkpoint:
+        _checkpoint_main()
     else:
         main()

@@ -151,6 +151,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "forward of FLOPs)")
     t.add_argument("--ckpt-dir", default=None)
     t.add_argument("--ckpt-every", type=int, default=500)
+    t.add_argument("--async-ckpt", action="store_true",
+                   help="asynchronous checkpointing: snapshot to host and "
+                        "hand serialization/fsync to a bounded background "
+                        "writer (the loop blocks only when a save is "
+                        "already in flight); SIGTERM/preemption still "
+                        "force a synchronous emergency save")
+    t.add_argument("--ckpt-keep-last", type=int, default=3,
+                   metavar="K",
+                   help="retention: keep the newest K checkpoint steps "
+                        "(0 keeps everything); the newest VALID step is "
+                        "never garbage-collected")
+    t.add_argument("--ckpt-keep-every", type=int, default=None,
+                   metavar="N",
+                   help="retention: additionally keep every step "
+                        "divisible by N as a long-horizon anchor")
+    t.add_argument("--ckpt-mirror", default=None, metavar="DIR",
+                   help="replicate every checkpoint to DIR (atomic copy "
+                        "after each save); restore falls back to the "
+                        "mirror when the primary copy is corrupt or "
+                        "missing")
     t.add_argument("--log-every", type=int, default=50)
     def _positive_float(text: str) -> float:
         try:
@@ -216,9 +236,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deterministic fault injection "
                         "(resilience.FaultPlan), comma list of "
                         "kind@ordinal: nan@K poisons the K-th batch, "
-                        "sigterm@K / crash@K fire at the K-th batch, "
-                        "fetch@N raises a transient error on the N-th "
-                        "source read, truncate@A corrupts the newest "
+                        "sigterm@K / kill@K (SIGKILL, no cleanup) / "
+                        "crash@K fire at the K-th batch, fetch@N raises "
+                        "a transient error on the N-th source read, "
+                        "diskfull@N raises ENOSPC on the N-th checkpoint "
+                        "write, truncate@A corrupts the newest "
                         "checkpoint after attempt A; implies supervision "
                         "(uses --max-restarts attempts)")
 
@@ -813,11 +835,18 @@ def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
 
     obs_ctx = _setup_observability(args)
     timeline = obs_ctx.timeline
+    keep_last = getattr(args, "ckpt_keep_last", 3)
     ckpt_kwargs = dict(
         checkpoint_verify_writes=not getattr(args, "no_ckpt_verify", False),
         checkpoint_retry_policy=RetryPolicy(
             max_attempts=3, base_delay_s=0.5, max_delay_s=10.0,
-            seed=args.seed))
+            seed=args.seed),
+        async_checkpointing=getattr(args, "async_ckpt", False),
+        checkpoint_keep_last=keep_last if keep_last else None,
+        checkpoint_keep_every=getattr(args, "ckpt_keep_every", None),
+        checkpoint_mirror=getattr(args, "ckpt_mirror", None),
+        checkpoint_fault_hook=(injector.on_checkpoint_write
+                               if injector is not None else None))
     max_restarts = getattr(args, "max_restarts", 0)
     try:
         if max_restarts <= 0 and injector is None:
@@ -1497,7 +1526,8 @@ def eval_main(argv=None) -> int:
             np.zeros((1, args.token_len), np.int32), train=False)
         # A SCHEDULE (callable), matching _train_clip's tx: adamw with a
         # float LR has an EmptyState where the schedule keeps a count, and
-        # orbax restore is structure-strict.
+        # checkpoint restore is structure-strict (from_bytes walks the
+        # template's state dict).
         tx = optax.adamw(lambda step: 0.0)
         if args.accum_steps > 1:
             tx = optax.MultiSteps(tx, every_k_schedule=args.accum_steps)

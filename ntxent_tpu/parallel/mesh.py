@@ -263,7 +263,8 @@ def replicate_state(tree, mesh: Mesh):
     back from a checkpoint restore are committed to whatever sharding the
     restore template carried (a fresh template ⇒ single-device), and the
     next sharded step fails with "incompatible devices". Replicating the
-    template BEFORE restore makes orbax restore straight onto the mesh —
+    template BEFORE restore places the restored leaves straight onto the
+    mesh (CheckpointManager restores onto the template's shardings) —
     which is also what makes a checkpoint from an 8-device run resume on a
     4-device mesh (elastic recovery: the global computation is
     device-count-invariant for replicated params + synced BatchNorm).

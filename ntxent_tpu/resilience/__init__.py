@@ -9,7 +9,7 @@ this package composes the framework's detectors into recovery tiers:
 fault                   detector                   recovery
 ======================  =========================  ========================
 transient IO error      exception filter           RetryPolicy backoff
-                        (retry.py)                 (loader fetch, orbax
+                        (retry.py)                 (loader fetch, checkpoint
                                                    save/restore)
 NaN/Inf loss or grads   in-step isfinite guard     skip batch → loss-scale
                         (trainer guard=True)       backoff → rollback
@@ -21,12 +21,20 @@ hung step / collective  StallWatchdog              stack dumps + one-shot
                         (utils/watchdog.py)        escalation: stop attempt,
                                                    restart
 corrupt checkpoint      per-save CRC manifest      restore falls back to the
-                        (training/checkpoint.py)   newest VALID step
+                        (training/checkpoint.py)   newest VALID step, then
+                                                   to the mirror replica
+SIGKILL / node loss     nothing can run            atomic checkpoint writes:
+                                                   relaunch resumes bit-
+                                                   exactly (crashsim.py /
+                                                   scripts/crash_audit.sh)
 ======================  =========================  ========================
 
 Every tier is driven end-to-end by the deterministic fault-injection
 harness in ``faults.py`` (tests/test_resilience.py, scripts/chaos_smoke.sh,
-``ntxent-train --chaos``).
+``ntxent-train --chaos``), and the checkpoint path's crash-safety is
+audited against real SIGKILLs by ``crashsim.CrashAudit`` (deliberately
+JAX-free: it orchestrates training subprocesses, so import it without
+paying backend init).
 """
 
 from ntxent_tpu.resilience.faults import (

@@ -1,0 +1,398 @@
+"""Crash-replay audit: SIGKILL a real training run, prove lossless resume.
+
+PR 1 proved "restart works" (SIGTERM → checkpoint → resume, loss curve
+intact). This harness upgrades the claim to "restart is provably
+lossless" against the *hard* death — SIGKILL, the no-cleanup signal the
+OOM-killer and node loss actually deliver — now that the checkpoint path
+writes atomically (training/checkpoint.py) and the PR 4 prefetch/lag-1
+loop holds in-flight state:
+
+1. run one uninterrupted **reference** training subprocess to completion
+   and fingerprint its final checkpoint (CRC32 of the serialized state
+   and of the data-iterator position — flax msgpack bytes are
+   deterministic, so bit-equality of the files IS bit-equality of
+   params/opt-state/step/iterator position);
+2. repeatedly launch the same run in a **crash** directory and kill it
+   with the seeded ``kill@K`` FaultPlan action at a randomized batch
+   ordinal — including rounds throttled with ``NTXENT_CKPT_SLOW_MS`` so
+   the SIGKILL provably lands **mid-save** (a staging dir is on disk at
+   death);
+3. after every kill, assert the checkpoint dir holds **no torn step**
+   (every step dir is complete and CRC-clean; abandoned ``.tmp-*``
+   staging dirs are the only debris and the next incarnation purges
+   them);
+4. run a final incarnation to completion and assert its final
+   checkpoint is **bit-identical** to the reference's.
+
+``scripts/crash_audit.sh`` is the one-command wrapper; a pytest
+(slow-tier) drives a smaller version of the same loop. This module
+deliberately imports no JAX — the harness must stay light enough to
+orchestrate subprocesses without paying backend init itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+import zlib
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CrashAudit", "CrashAuditError", "AuditReport",
+           "checkpoint_fingerprint", "scan_checkpoint_dir"]
+
+_TMP_PREFIX = ".tmp-"
+_STATE_FILE = "state.msgpack"
+_DATA_STATE_FILE = "data_state.json"
+
+
+class CrashAuditError(AssertionError):
+    """An audit invariant failed (torn step, inexact resume, ...)."""
+
+
+def _rmtree(path: Path) -> None:
+    import shutil
+
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def _crc32_file(path: Path, chunk: int = 1 << 20) -> int:
+    value = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return value
+            value = zlib.crc32(block, value)
+
+
+def _step_dirs(ckpt_dir: Path) -> dict[int, Path]:
+    out: dict[int, Path] = {}
+    if not ckpt_dir.is_dir():
+        return out
+    for p in ckpt_dir.iterdir():
+        if p.is_dir() and not p.name.startswith(_TMP_PREFIX) \
+                and p.name.isdigit():
+            out[int(p.name)] = p
+    return out
+
+
+def checkpoint_fingerprint(ckpt_dir: Path, step: int) -> dict:
+    """CRC32 fingerprint of one step's payload files. Serialization is
+    deterministic, so two runs that agree here agree on every param,
+    optimizer moment, the global step, and the iterator position."""
+    step_dir = _step_dirs(Path(ckpt_dir)).get(int(step))
+    if step_dir is None:
+        raise CrashAuditError(
+            f"no checkpoint for step {step} under {ckpt_dir}")
+    fp = {}
+    for name in (_STATE_FILE, _DATA_STATE_FILE):
+        p = step_dir / name
+        if p.exists():
+            fp[name] = [p.stat().st_size, _crc32_file(p)]
+    if _STATE_FILE not in fp:
+        raise CrashAuditError(f"step {step} under {ckpt_dir} has no "
+                              f"{_STATE_FILE}")
+    return fp
+
+
+def scan_checkpoint_dir(ckpt_dir: Path) -> dict:
+    """Post-mortem scan: ``torn`` steps (incomplete, or CRC-mismatching
+    their manifest entry) and leftover ``tmp`` staging dirs.
+
+    Atomic writes make ``torn == []`` the invariant a kill at ANY instant
+    must preserve; ``tmp`` debris is legal immediately after a mid-save
+    kill (it proves the kill WAS mid-save) and must be gone after the
+    next incarnation's manager init.
+    """
+    ckpt_dir = Path(ckpt_dir)
+    torn: list[str] = []
+    try:
+        with open(ckpt_dir / "manifests.json") as f:
+            manifests = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        manifests = {}
+    for step, step_dir in sorted(_step_dirs(ckpt_dir).items()):
+        if not (step_dir / _STATE_FILE).exists():
+            torn.append(f"{step}: missing {_STATE_FILE}")
+            continue
+        recorded = manifests.get(str(step))
+        if recorded is None:
+            continue  # complete-but-unmanifested (killed pre-manifest)
+        for rel, (size, crc) in recorded["files"].items():
+            p = step_dir / rel
+            if not p.exists() or p.stat().st_size != size \
+                    or _crc32_file(p) != crc:
+                torn.append(f"{step}: {rel} fails manifest check")
+                break
+    tmp = sorted(p.name for p in ckpt_dir.iterdir()
+                 if p.is_dir() and p.name.startswith(_TMP_PREFIX)) \
+        if ckpt_dir.is_dir() else []
+    return {"torn": torn, "tmp": tmp}
+
+
+@dataclasses.dataclass
+class AuditReport:
+    kills: int = 0
+    midsave_kills: int = 0
+    completed_early: int = 0
+    bitexact_completions: int = 0
+    rounds: list = dataclasses.field(default_factory=list)
+    final_step: int | None = None
+    bit_exact: bool = False
+    reference_fingerprint: dict = dataclasses.field(default_factory=dict)
+    survivor_fingerprint: dict = dataclasses.field(default_factory=dict)
+    elapsed_s: float = 0.0
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+
+class CrashAudit:
+    """Drive the kill → scan → resume → verify loop against the CLI.
+
+    One audit = one reference run + ``kills`` killed incarnations (the
+    first ``midsave`` of them throttled so the SIGKILL lands inside a
+    checkpoint write) + one final clean incarnation, all sharing the
+    crash directory. ``steps`` stays tiny (CPU, tiny model) so the whole
+    audit fits the <60 s budget of ``scripts/crash_audit.sh``.
+    """
+
+    def __init__(self, workdir: str | Path, steps: int = 8,
+                 seed: int = 0, batch: int = 8, image_size: int = 8,
+                 timeout_s: float = 180.0, slow_save_ms: int = 400):
+        self.workdir = Path(workdir)
+        self.workdir.mkdir(parents=True, exist_ok=True)
+        self.steps = int(steps)
+        self.seed = int(seed)
+        self.batch = int(batch)
+        self.image_size = int(image_size)
+        self.timeout_s = float(timeout_s)
+        self.slow_save_ms = int(slow_save_ms)
+        self.rng = random.Random(seed)
+
+    # -- one training incarnation ----------------------------------------
+    def _cmd(self, ckpt_dir: Path, chaos: str | None) -> list[str]:
+        cmd = [sys.executable, "-m", "ntxent_tpu.cli",
+               "--platform", "cpu",
+               "--dataset", "synthetic",
+               "--synthetic-samples", str(max(64, 2 * self.batch)),
+               "--image-size", str(self.image_size),
+               "--model", "tiny", "--proj-hidden-dim", "16",
+               "--proj-dim", "8",
+               "--batch", str(self.batch),
+               "--steps", str(self.steps),
+               "--warmup-steps", "1",
+               "--seed", str(self.seed),
+               "--ckpt-dir", str(ckpt_dir),
+               "--ckpt-every", "1",
+               "--ckpt-keep-last", "0",  # the audit compares EVERY step
+               "--async-ckpt",
+               "--log-every", "1"]
+        if chaos:
+            cmd += ["--chaos", chaos]
+        return cmd
+
+    def _run(self, ckpt_dir: Path, chaos: str | None = None,
+             slow_save: bool = False) -> tuple[int, str]:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        if slow_save:
+            env["NTXENT_CKPT_SLOW_MS"] = str(self.slow_save_ms)
+        else:
+            env.pop("NTXENT_CKPT_SLOW_MS", None)
+        proc = subprocess.run(
+            self._cmd(ckpt_dir, chaos), env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            timeout=self.timeout_s)
+        return proc.returncode, proc.stdout or ""
+
+    # -- the audit --------------------------------------------------------
+    def run_reference(self) -> dict:
+        ref_dir = self.workdir / "ref"
+        rc, out = self._run(ref_dir)
+        if rc != 0:
+            raise CrashAuditError(
+                f"reference run failed rc={rc}:\n{out[-2000:]}")
+        return checkpoint_fingerprint(ref_dir, self.steps)
+
+    def _finish_and_verify(self, crash_dir: Path, report: AuditReport,
+                           reference_fp: dict) -> None:
+        """Run the crash dir to completion (if it is not already there)
+        and hold its final checkpoint against the reference CRCs."""
+        latest = max(_step_dirs(crash_dir), default=0)
+        if latest < self.steps:
+            rc, out = self._run(crash_dir)
+            if rc != 0:
+                raise CrashAuditError(
+                    f"survivor run failed rc={rc}:\n{out[-2000:]}")
+        scan = scan_checkpoint_dir(crash_dir)
+        if scan["torn"] or scan["tmp"]:
+            raise CrashAuditError(f"survivor left debris: {scan}")
+        report.final_step = max(_step_dirs(crash_dir))
+        if report.final_step != self.steps:
+            raise CrashAuditError(
+                f"survivor finished at step {report.final_step}, "
+                f"wanted {self.steps}")
+        report.survivor_fingerprint = checkpoint_fingerprint(
+            crash_dir, self.steps)
+        if report.survivor_fingerprint != reference_fp:
+            raise CrashAuditError(
+                "survivor's final checkpoint differs from the "
+                f"uninterrupted reference:\nref      = "
+                f"{reference_fp}\nsurvivor = "
+                f"{report.survivor_fingerprint}")
+        report.bitexact_completions += 1
+        report.bit_exact = True
+
+    def _run_lineage(self, name: str, kills: int, midsave: int,
+                     rng: random.Random, ref_fp) -> AuditReport:
+        """One independent kill→scan→resume lineage in its own crash
+        dir. ``ref_fp`` is a zero-arg callable yielding the reference
+        fingerprint (a future: the reference run executes concurrently)."""
+        report = AuditReport()
+        crash_dir = self.workdir / name
+        round_no = 0
+        while report.kills < kills or report.midsave_kills < midsave:
+            round_no += 1
+            if round_no > (kills + midsave) * 6:
+                raise CrashAuditError(
+                    f"{name}: could not land {kills} kills in "
+                    f"{round_no} rounds")
+            latest = max(_step_dirs(crash_dir), default=0)
+            remaining = self.steps - latest
+            if remaining < 3:
+                # This lifecycle is (nearly) done: wipe it and start a
+                # fresh one, restoring the full randomization range for
+                # the next kill point. Every kill already asserted the
+                # no-torn invariant, and the lineage's FINAL lifecycle
+                # (below) is the one driven to a verified bit-exact
+                # completion — finishing every intermediate chain too
+                # would double the audit's subprocess count for a
+                # duplicate of that check.
+                _rmtree(crash_dir)
+                continue
+            # Kill point randomized over the steps THIS incarnation will
+            # actually run (it resumes at the newest step on disk).
+            # k >= 2 leaves batch 1's step time for a pending save to
+            # land, so lineages make progress with high probability; the
+            # round cap above bounds the unlucky tail.
+            k = rng.randint(2, remaining)
+            slow = report.midsave_kills < midsave
+            rc, out = self._run(crash_dir, chaos=f"kill@{k}",
+                                slow_save=slow)
+            if rc == 0:
+                # The kill ordinal never fired (run completed first) —
+                # still a resume check, not a kill.
+                report.completed_early += 1
+                self._finish_and_verify(crash_dir, report, ref_fp())
+                _rmtree(crash_dir)
+                continue
+            if rc != -signal.SIGKILL and rc != 128 + signal.SIGKILL:
+                raise CrashAuditError(
+                    f"{name} round {round_no}: expected SIGKILL death, "
+                    f"got rc={rc}:\n{out[-2000:]}")
+            scan = scan_checkpoint_dir(crash_dir)
+            if scan["torn"]:
+                raise CrashAuditError(
+                    f"{name} round {round_no}: torn checkpoint step(s) "
+                    f"after SIGKILL: {scan['torn']}")
+            mid = bool(scan["tmp"])
+            report.kills += 1
+            report.midsave_kills += int(mid)
+            report.rounds.append({"lineage": name, "round": round_no,
+                                  "kill_at": latest + k,
+                                  "outcome": "killed",
+                                  "midsave": mid, **scan})
+            logger.info("%s round %d: kill@%d ok (midsave=%s, steps on "
+                        "disk=%s)", name, round_no, latest + k, mid,
+                        sorted(_step_dirs(crash_dir)))
+        # Survivor: this lineage's dir runs to completion for its final
+        # bit-exactness verdict.
+        self._finish_and_verify(crash_dir, report, ref_fp())
+        return report
+
+    def audit(self, kills: int = 5, midsave: int = 1,
+              lineages: int = 2) -> AuditReport:
+        """Run the reference and ``lineages`` independent kill lineages
+        concurrently (subprocesses bound the parallelism; each lineage
+        owns its crash dir, so rounds only serialize within a lineage).
+        The mid-save quota rides lineage 0 (its early rounds throttle the
+        writer until a kill provably lands inside a write)."""
+        import concurrent.futures as cf
+
+        t0 = time.monotonic()
+        lineages = max(1, min(int(lineages), kills))
+        quotas = [kills // lineages] * lineages
+        for i in range(kills % lineages):
+            quotas[i] += 1
+        with cf.ThreadPoolExecutor(max_workers=lineages + 1) as pool:
+            ref_future = pool.submit(self.run_reference)
+            lineage_futures = [
+                pool.submit(self._run_lineage, f"crash{i}", quotas[i],
+                            midsave if i == 0 else 0,
+                            random.Random(self.seed * 1000 + i),
+                            ref_future.result)
+                for i in range(lineages)]
+            reports = [f.result() for f in lineage_futures]
+            reference_fp = ref_future.result()
+
+        report = AuditReport()
+        report.reference_fingerprint = reference_fp
+        for sub in reports:
+            report.kills += sub.kills
+            report.midsave_kills += sub.midsave_kills
+            report.completed_early += sub.completed_early
+            report.bitexact_completions += sub.bitexact_completions
+            report.rounds.extend(sub.rounds)
+            report.final_step = sub.final_step
+            report.survivor_fingerprint = sub.survivor_fingerprint
+        report.bit_exact = all(sub.bit_exact for sub in reports)
+        if report.midsave_kills < midsave:
+            raise CrashAuditError(
+                f"only {report.midsave_kills}/{midsave} kills landed "
+                "mid-save (no staging dir observed at death)")
+        report.elapsed_s = round(time.monotonic() - t0, 2)
+        return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Crash-replay audit: kill a real training run at "
+                    "randomized points (incl. mid-save) and prove "
+                    "bit-exact resume.")
+    parser.add_argument("--workdir", required=True)
+    parser.add_argument("--steps", type=int, default=8)
+    parser.add_argument("--kills", type=int, default=5)
+    parser.add_argument("--midsave", type=int, default=1)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--timeout-s", type=float, default=180.0)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(levelname)s %(message)s")
+    audit = CrashAudit(args.workdir, steps=args.steps, seed=args.seed,
+                       timeout_s=args.timeout_s)
+    try:
+        report = audit.audit(kills=args.kills, midsave=args.midsave)
+    except CrashAuditError as e:
+        print(f"CRASH AUDIT FAILED: {e}", file=sys.stderr)
+        return 1
+    print(report.to_json())
+    print(f"crash audit: OK — {report.kills} kills "
+          f"({report.midsave_kills} mid-save), resume bit-exact at "
+          f"step {report.final_step} in {report.elapsed_s}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

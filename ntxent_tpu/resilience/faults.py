@@ -16,10 +16,20 @@ schedule randomization):
 * ``sigterm@k``  — deliver SIGTERM to this process while serving the k-th
                    batch → exercises PreemptionGuard save-and-stop plus the
                    supervisor's resume-at-k restart;
+* ``kill@k``     — deliver SIGKILL to this process while serving the k-th
+                   batch: NO cleanup, no atexit, no final checkpoint — the
+                   hard-death case (OOM-killer, node loss) the crash-replay
+                   audit (crashsim.py / scripts/crash_audit.sh) drives to
+                   prove restart is lossless, not merely possible;
 * ``crash@k``    — raise ``ChaosError`` while serving the k-th batch
                    → exercises the supervisor's exception-restart path;
 * ``fetch@n``    — raise a transient ``OSError`` on the n-th source fetch
                    → exercises the loader's RetryPolicy (retry.py);
+* ``diskfull@n`` — raise ``OSError(ENOSPC)`` at the start of the n-th
+                   physical checkpoint write (wired through
+                   ``CheckpointManager(fault_hook=...)``) → exercises the
+                   skip-a-checkpoint contract (failure counter + ok=false
+                   event, run continues) on both sync and async writers;
 * ``truncate@a`` — after attempt number a ends, truncate the newest
                    checkpoint's largest file → exercises checksum
                    verification and newest-VALID fallback (checkpoint.py).
@@ -31,6 +41,7 @@ runtime counters and the wrapping hooks call sites use.
 from __future__ import annotations
 
 import dataclasses
+import errno
 import logging
 import os
 import signal
@@ -43,7 +54,8 @@ logger = logging.getLogger(__name__)
 __all__ = ["ChaosError", "FaultPlan", "FaultInjector",
            "truncate_checkpoint_file"]
 
-_KINDS = ("nan", "sigterm", "crash", "fetch", "truncate")
+_KINDS = ("nan", "sigterm", "kill", "crash", "fetch", "diskfull",
+          "truncate")
 
 
 class ChaosError(RuntimeError):
@@ -56,14 +68,17 @@ class FaultPlan:
 
     nan_batches: tuple[int, ...] = ()
     sigterm_batches: tuple[int, ...] = ()
+    kill_batches: tuple[int, ...] = ()
     crash_batches: tuple[int, ...] = ()
     fetch_calls: tuple[int, ...] = ()
+    diskfull_writes: tuple[int, ...] = ()
     truncate_attempts: tuple[int, ...] = ()
     seed: int = 0
 
     @classmethod
     def parse(cls, spec: str, seed: int = 0) -> "FaultPlan":
-        """Parse ``"nan@3,sigterm@6,truncate@1"`` (the --chaos syntax)."""
+        """Parse ``"nan@3,sigterm@6,kill@4,diskfull@2,truncate@1"``
+        (the --chaos syntax)."""
         buckets: dict[str, list[int]] = {k: [] for k in _KINDS}
         for item in filter(None, (s.strip() for s in spec.split(","))):
             kind, sep, at = item.partition("@")
@@ -80,14 +95,17 @@ class FaultPlan:
             buckets[kind].append(ordinal)
         return cls(nan_batches=tuple(buckets["nan"]),
                    sigterm_batches=tuple(buckets["sigterm"]),
+                   kill_batches=tuple(buckets["kill"]),
                    crash_batches=tuple(buckets["crash"]),
                    fetch_calls=tuple(buckets["fetch"]),
+                   diskfull_writes=tuple(buckets["diskfull"]),
                    truncate_attempts=tuple(buckets["truncate"]),
                    seed=seed)
 
     def empty(self) -> bool:
         return not (self.nan_batches or self.sigterm_batches
-                    or self.crash_batches or self.fetch_calls
+                    or self.kill_batches or self.crash_batches
+                    or self.fetch_calls or self.diskfull_writes
                     or self.truncate_attempts)
 
 
@@ -146,6 +164,7 @@ class FaultInjector:
         self.plan = plan
         self._batches = 0
         self._fetches = 0
+        self._ckpt_writes = 0
         self._attempts = 0
         self.fired: list[str] = []
 
@@ -172,6 +191,17 @@ class FaultInjector:
             logger.warning("chaos: delivering SIGTERM at batch %d", n)
             self.fired.append(f"sigterm@{n}")
             os.kill(os.getpid(), signal.SIGTERM)
+        if n in self.plan.kill_batches:
+            # SIGKILL is uncatchable: nothing after this line runs — no
+            # cleanup, no final save. Write the marker straight to fd 2
+            # (the logger's buffers would die with us) so crash harnesses
+            # can still see the fault fired.
+            self.fired.append(f"kill@{n}")
+            try:
+                os.write(2, f"chaos: SIGKILL at batch {n}\n".encode())
+            except OSError:
+                pass
+            os.kill(os.getpid(), signal.SIGKILL)
         if n in self.plan.crash_batches:
             self.fired.append(f"crash@{n}")
             raise ChaosError(f"chaos: injected crash at batch {n}")
@@ -190,6 +220,22 @@ class FaultInjector:
             raise OSError(
                 f"chaos: injected transient fetch failure "
                 f"(call {self._fetches})")
+
+    # -- checkpoint-writer faults (CheckpointManager fault_hook) ----------
+    def on_checkpoint_write(self):
+        """Raise ENOSPC at the start of the n-th physical checkpoint
+        write when the plan says so (the ``diskfull@n`` primitive). Wire
+        as ``CheckpointManager(fault_hook=injector.on_checkpoint_write)``
+        — the CLI does this whenever a chaos plan is active. NOTE: may be
+        called from the AsyncCheckpointer writer thread; counters here
+        are only ever touched by one writer at a time."""
+        self._ckpt_writes += 1
+        if self._ckpt_writes in self.plan.diskfull_writes:
+            self.fired.append(f"diskfull@{self._ckpt_writes}")
+            raise OSError(
+                errno.ENOSPC,
+                f"chaos: injected ENOSPC on checkpoint write "
+                f"{self._ckpt_writes}")
 
     # -- checkpoint faults (supervisor calls between attempts) ------------
     def between_attempts(self, checkpoint_dir: str | os.PathLike | None):

@@ -12,7 +12,7 @@ for the supervisor tier (resilience/supervisor.py) to act.
 Wired in by:
 
 * ``training/checkpoint.py`` — ``CheckpointManager(retry_policy=...)``
-  retries orbax save/restore;
+  retries the native checkpoint write/read;
 * ``training/datasets.py`` — ``StreamingLoader(retry_policy=...)`` retries
   per-item source fetches inside the read-ahead pool;
 * ``training/native_loader.py`` — ``NativeStreamingLoader`` retries batch

@@ -48,7 +48,9 @@ from ntxent_tpu.training.trainer import (
 __all__ = [
     "augment_batch_pair",
     "augment_pair",
+    "AsyncCheckpointer",
     "CheckpointManager",
+    "RetentionPolicy",
     "extract_features",
     "finetune",
     "knn_accuracy",
@@ -88,12 +90,14 @@ __all__ = [
 
 
 def __getattr__(name):
-    # CheckpointManager lazily: its orbax import initializes the JAX
-    # backends as a side effect, which (a) pins the platform before callers
-    # can choose one and (b) blocks on accelerator discovery — neither is
-    # acceptable for `import ntxent_tpu.training` itself.
-    if name == "CheckpointManager":
-        from ntxent_tpu.training.checkpoint import CheckpointManager
+    # Checkpoint classes lazily: the module imports jax at top level,
+    # which initializes the backends as a side effect — that (a) pins the
+    # platform before callers can choose one and (b) blocks on
+    # accelerator discovery; neither is acceptable for
+    # `import ntxent_tpu.training` itself.
+    if name in ("CheckpointManager", "AsyncCheckpointer",
+                "RetentionPolicy"):
+        from ntxent_tpu.training import checkpoint
 
-        return CheckpointManager
+        return getattr(checkpoint, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

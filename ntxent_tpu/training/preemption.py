@@ -10,9 +10,12 @@ and the next incarnation resumes exactly (training/checkpoint.py +
 datasets' checkpointable iterator state carry the resume).
 
 ``PreemptionGuard`` is deliberately signal-minimal: the handler only flips
-a flag (async-signal-safe); all real work (device sync, orbax save) happens
-on the main thread at the next step boundary via ``train_loop``'s
-``stop_fn`` hook.
+a flag (async-signal-safe); all real work (device sync, checkpoint save)
+happens on the main thread at the next step boundary via ``train_loop``'s
+``stop_fn`` hook. Under async checkpointing the stop additionally routes
+``fit``'s final save through ``AsyncCheckpointer.emergency_save`` — the
+writer queue drains and the stopped step is written synchronously before
+the grace window can expire (training/checkpoint.py).
 
 The guard is also the clean-stop lever of the rest of the resilience layer
 (resilience/supervisor.py): ``resilience.Supervisor`` installs one guard

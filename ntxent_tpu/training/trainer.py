@@ -785,10 +785,30 @@ def fit(
     metrics_lag: int = 0,
     checkpoint_retry_policy=None,
     checkpoint_verify_writes: bool = True,
+    async_checkpointing: bool = False,
+    checkpoint_keep_last: int | None = 3,
+    checkpoint_keep_every: int | None = None,
+    checkpoint_mirror: str | None = None,
+    checkpoint_fault_hook: Callable | None = None,
 ):
     """Checkpoint-aware training: restore the latest checkpoint if one
     exists, train to ``num_steps`` total, save every ``checkpoint_every``
     steps (on the GLOBAL ``state.step``) and at the end.
+
+    ``async_checkpointing=True`` wraps the manager in an
+    ``AsyncCheckpointer``: cadence saves snapshot to host and serialize
+    on a bounded background writer (the loop blocks only when a save is
+    already in flight) — and when the run is stopped by ``stop_fn``
+    (SIGTERM / preemption / supervisor stall escalation), the final save
+    goes through ``emergency_save``: pending writes drain and the
+    stopped step is written synchronously before ``fit`` returns, so the
+    grace window cannot expire with the last step still queued.
+    ``checkpoint_keep_last`` / ``checkpoint_keep_every`` set the
+    retention policy (keep-last-k + keep-every-n; the newest VALID step
+    is never collected); ``checkpoint_mirror`` replicates every save to
+    a second directory that restore falls back to when the primary is
+    corrupt or missing; ``checkpoint_fault_hook`` is the chaos hook run
+    at the start of every physical write (``diskfull@N``).
 
     ``step_guard`` / ``watchdog`` / ``timeline`` / ``metrics_lag``:
     forwarded to ``train_loop`` (divergence policy, stall detection,
@@ -800,9 +820,9 @@ def fit(
 
     ``checkpoint_retry_policy`` / ``checkpoint_verify_writes``: forwarded
     to CheckpointManager. verify_writes=True (default) records per-save
-    CRC manifests, which drains the async save machinery per checkpointed
-    step — pass False on throughput-critical runs that trust their
-    filesystem to keep saves fully async.
+    CRC manifests; writes are atomic either way (tmp-dir + fsync +
+    rename), so the manifest guards post-write corruption, not torn
+    saves.
 
     ``stop_fn`` (see ``train_loop``) makes the run preemptible: when it
     trips, the loop exits at the next step boundary and the final
@@ -834,12 +854,18 @@ def fit(
         and hasattr(data_iter, "restore")
     try:
         if checkpoint_dir is not None:
-            from .checkpoint import CheckpointManager
+            from .checkpoint import AsyncCheckpointer, CheckpointManager
 
             manager = CheckpointManager(
                 checkpoint_dir, save_interval_steps=checkpoint_every,
                 retry_policy=checkpoint_retry_policy,
-                verify_writes=checkpoint_verify_writes)
+                verify_writes=checkpoint_verify_writes,
+                max_to_keep=checkpoint_keep_last,
+                keep_every=checkpoint_keep_every,
+                mirror_dir=checkpoint_mirror,
+                fault_hook=checkpoint_fault_hook)
+            if async_checkpointing:
+                manager = AsyncCheckpointer(manager)
             if manager.latest_step() is not None:
                 state, data_state = manager.restore_with_data_state(state)
                 logger.info("resumed from checkpoint at step %d",
@@ -863,11 +889,20 @@ def fit(
                     return state, []
                 next(data_iter)
 
+        # The hook tracks the global step on the HOST (state.step advances
+        # exactly once per train_step call, even under MultiSteps or the
+        # guard's skip): reading int(s.step) here would sync host and
+        # device EVERY step, putting the device round-trip this PR's
+        # async writer exists to hide right back on the hot path.
+        hook_step = done
+
         def step_hook(s):
-            # Every step; orbax's FixedIntervalPolicy filters to global steps
+            # Every step; the manager's interval filter keeps global steps
             # divisible by checkpoint_every (a resumed run keeps the cadence).
-            if manager is not None:
-                manager.save(int(s.step), s,
+            nonlocal hook_step
+            hook_step += 1
+            if manager is not None and manager.should_save(hook_step):
+                manager.save(hook_step, s,
                              data_state=data_iter.state()
                              if stateful_data else None)
 
@@ -877,11 +912,24 @@ def fit(
             flops_per_step=flops_per_step, step_hook=step_hook,
             stop_fn=stop_fn, watchdog=watchdog, step_guard=step_guard,
             timeline=timeline, metrics_lag=metrics_lag)
-        if manager is not None \
-                and manager.latest_step() != int(state.step):
-            manager.save(int(state.step), state, force=True,
-                         data_state=data_iter.state()
-                         if stateful_data else None)
+        if manager is not None:
+            # Drain pending async saves BEFORE deciding on the final
+            # force-save: a cadence save of this very step may still be
+            # in the writer queue.
+            manager.wait_until_finished()
+            if manager.latest_step() != int(state.step):
+                final_data_state = data_iter.state() \
+                    if stateful_data else None
+                if async_checkpointing \
+                        and stop_fn is not None and stop_fn():
+                    # Preemption/stall stop: the process may be inside a
+                    # SIGTERM grace window — write synchronously NOW
+                    # (PreemptionGuard -> stop_fn -> here is the wiring).
+                    manager.emergency_save(int(state.step), state,
+                                           data_state=final_data_state)
+                else:
+                    manager.save(int(state.step), state, force=True,
+                                 data_state=final_data_state)
         return state, history
     finally:
         # Always drain + close the manager (its async save machinery holds

@@ -28,4 +28,63 @@ grep -q 'chaos faults fired: .*nan@3' "$log"
 grep -q 'sigterm@6' "$log"
 grep -q 'truncate@1' "$log"
 grep -q 'supervisor: run complete at step 10' "$log"
+
+# Round 2 (ISSUE 5): kill -9 MID-SAVE. Async checkpointing with a
+# throttled writer + the kill@N chaos action SIGKILLs the process while a
+# checkpoint write is demonstrably in flight (save every step, 300 ms per
+# write). The invariant: the checkpoint dir holds ZERO torn steps (atomic
+# rename — only complete, CRC-clean step dirs are visible), and a restore
+# lands on the last VALID step.
+kill_log="$workdir/kill.log"
+kill_ckpt="$workdir/kill_ckpt"
+# Whether the SIGKILL lands inside a write is a (heavily loaded) race:
+# with 300 ms throttled writes and save-every-step it almost always
+# does, but a fast host can slip the kill into the gap between two
+# writes — retry the round a few times rather than flake on scheduling.
+midsave=""
+for attempt in 1 2 3; do
+    rm -rf "$kill_ckpt"
+    rc=0
+    JAX_PLATFORMS=cpu NTXENT_CKPT_SLOW_MS=300 python -m ntxent_tpu.cli \
+        --platform cpu \
+        --dataset synthetic --synthetic-samples 64 --image-size 8 \
+        --model tiny --proj-hidden-dim 16 --proj-dim 8 \
+        --batch 8 --steps 10 --warmup-steps 1 \
+        --ckpt-dir "$kill_ckpt" --ckpt-every 1 --async-ckpt --log-every 1 \
+        --chaos 'kill@5' \
+        >"$kill_log" 2>&1 || rc=$?
+    [ "$rc" -eq 137 ] || { echo "expected SIGKILL death (137), got rc=$rc:"; tail -20 "$kill_log"; exit 1; }
+    grep -q 'chaos: SIGKILL at batch 5' "$kill_log"
+    if ls -d "$kill_ckpt"/.tmp-* >/dev/null 2>&1; then midsave=1; break; fi
+    echo "kill round $attempt landed between writes; retrying for a mid-save kill"
+done
+[ -n "$midsave" ] || { echo "no kill landed mid-save in 3 rounds"; exit 1; }
+
+python - "$kill_ckpt" <<'PY'
+import sys
+from pathlib import Path
+
+from ntxent_tpu.resilience.crashsim import scan_checkpoint_dir
+
+ckpt = Path(sys.argv[1])
+scan = scan_checkpoint_dir(ckpt)
+assert not scan["torn"], f"torn step dirs after kill -9: {scan['torn']}"
+assert scan["tmp"], "staging dir vanished between the shell check and here"
+
+# Restore must land on the newest VALID (complete) step and purge the
+# abandoned staging dir.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from ntxent_tpu.training.checkpoint import CheckpointManager  # noqa: E402
+
+mgr = CheckpointManager(ckpt)
+steps = mgr.all_steps()
+assert steps, "no complete checkpoint survived the mid-save kill"
+latest_valid = mgr.latest_valid_step()
+assert latest_valid == max(steps), (latest_valid, steps)
+assert not scan_checkpoint_dir(ckpt)["tmp"], "staging dir not purged"
+print(f"kill -9 mid-save: OK — restore target step {latest_valid}, "
+      f"steps on disk {steps}, zero torn files")
+PY
 echo "chaos smoke: OK"

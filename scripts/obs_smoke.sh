@@ -33,7 +33,7 @@ JAX_PLATFORMS=cpu python -m ntxent_tpu.cli \
     --dataset synthetic --synthetic-samples 64 --image-size 8 \
     --model tiny --proj-hidden-dim 16 --proj-dim 8 \
     --batch 8 --steps 400 --warmup-steps 2 --log-every 100 \
-    --ckpt-dir "$workdir/ckpt" --ckpt-every 200 \
+    --ckpt-dir "$workdir/ckpt" --ckpt-every 200 --async-ckpt \
     --metrics-port 0 --log-jsonl "$events" \
     --chaos 'nan@3,fetch@2' \
     >"$log" 2>&1 &
@@ -101,6 +101,15 @@ for line in open(scrape):
 for counter in ("train_steps_total", "train_divergence_total",
                 "retries_total", "checkpoint_saves_total"):
     assert values.get(counter, 0) >= 1, (counter, values.get(counter))
+
+# Async checkpointing (ISSUE 5): the writer's series are scraped from the
+# same endpoint — queue depth gauge plus the save-overlap histogram
+# (its saves ran in the background, so overlap samples must exist).
+assert "checkpoint_queue_depth" in values, sorted(values)[:40]
+assert values.get("checkpoint_async_saves_total", 0) >= 1, (
+    values.get("checkpoint_async_saves_total"))
+assert values.get("checkpoint_save_overlap_ms_count", 0) >= 1, (
+    "no background-writer samples in checkpoint_save_overlap_ms")
 
 # -- JSON view of the same registry agrees on the same scrape... the two
 # formats are separate scrapes a moment apart, so compare loosely (the

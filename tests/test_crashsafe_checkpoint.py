@@ -1,0 +1,583 @@
+"""Crash-safe checkpointing subsystem (ISSUE 5).
+
+The native checkpoint path's durability invariants, asserted in-process
+on tiny CPU states: atomic writes leave no debris on failure, the
+retention policy never collects the only restorable state, the mirror
+serves restores when the primary is corrupt or missing, the async writer
+keeps the skip-a-checkpoint contract, and the emergency path writes
+synchronously. ``scripts/crash_audit.sh`` proves the same properties
+against real SIGKILLs; these tests keep each mechanism green in tier-1.
+"""
+
+from __future__ import annotations
+
+import errno
+import functools
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from ntxent_tpu.models import ResNet, SimCLRModel
+from ntxent_tpu.resilience import FaultInjector, FaultPlan
+from ntxent_tpu.resilience.crashsim import (
+    checkpoint_fingerprint,
+    scan_checkpoint_dir,
+)
+from ntxent_tpu.training import TrainerConfig, create_train_state
+from ntxent_tpu.training.checkpoint import (
+    AsyncCheckpointer,
+    CheckpointManager,
+    RetentionPolicy,
+    snapshot_state,
+)
+
+pytestmark = pytest.mark.crashsafe
+
+TinyEnc = functools.partial(ResNet, stage_sizes=(1,), small_images=True)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _no_persistent_compilation_cache():
+    """Run this file against cold compiles only.
+
+    With the warm persistent cache, one of this file's tiny programs
+    dies with heap corruption ("malloc(): invalid next size") when its
+    serialized XLA:CPU executable RELOADS in a later process — the
+    reload-abort hazard tests/conftest.py documents (same class as
+    test_fsdp's no_persistent_compilation_cache fixture; the crash audit
+    reproduced it independently through the CLI). Everything here is a
+    sub-second compile, so opting the whole file out removes the failure
+    mode for ~1 s.
+
+    NOTE this fixture cannot protect against the IN-PROCESS jit cache:
+    a program another test file already compiled (possibly reloading a
+    poisoned persistent-cache entry) is reused without consulting this
+    config. That is why every model/step in this file uses shapes no
+    other file compiles (proj 24/12, batch 12) — shared shapes here
+    reproduced a deterministic abort inside the step whenever
+    tests/test_api.py ran first against a warm cache.
+    """
+    import jax
+
+    old = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", old)
+
+
+def _tiny_state(seed=0, steps=10):
+    # proj 24/12 (not the suite-wide 16/8): see the cache fixture's NOTE.
+    model = SimCLRModel(encoder=TinyEnc, proj_hidden_dim=24, proj_dim=12)
+    cfg = TrainerConfig(batch_size=12, total_steps=steps, warmup_steps=1)
+    return create_train_state(model, jax.random.PRNGKey(seed),
+                              (1, 8, 8, 3), cfg)
+
+
+@pytest.fixture(scope="module")
+def tiny_state():
+    return _tiny_state()
+
+
+def _params_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ---------------------------------------------------------------------------
+# RetentionPolicy
+# ---------------------------------------------------------------------------
+
+def test_retention_keep_last():
+    policy = RetentionPolicy(keep_last=2)
+    assert policy.keep([1, 2, 3, 4, 5], lambda s: True) == {4, 5}
+
+
+def test_retention_keep_every_boundary():
+    """keep-every-n keeps exactly the steps divisible by n — including
+    when the anchor IS the newest or oldest step — alongside keep-last."""
+    policy = RetentionPolicy(keep_last=1, keep_every=4)
+    assert policy.keep(list(range(1, 10)), lambda s: True) == {4, 8, 9}
+    # Anchor == newest step: no duplicate-keep confusion.
+    assert policy.keep([2, 4, 6, 8], lambda s: True) == {4, 8}
+    # All steps below the first anchor: only keep-last applies.
+    assert policy.keep([1, 2, 3], lambda s: True) == {3}
+
+
+def test_retention_never_drops_newest_valid():
+    """Newer-but-corrupt steps must not starve the only restorable one."""
+    policy = RetentionPolicy(keep_last=2)
+    valid = {3}.__contains__
+    assert policy.keep([1, 2, 3, 4, 5], valid) == {3, 4, 5}
+
+
+def test_retention_disabled_keeps_everything():
+    policy = RetentionPolicy(keep_last=None)
+    steps = list(range(1, 8))
+    assert policy.keep(steps, lambda s: True) == set(steps)
+    assert RetentionPolicy(keep_last=0).keep(steps, lambda s: True) \
+        == set(steps)
+
+
+def test_gc_applies_policy_and_prunes_manifests(tmp_path, tiny_state):
+    mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=2,
+                            keep_every=4)
+    for step in range(1, 7):
+        assert mgr.save(step, tiny_state, force=True)
+    assert mgr.all_steps() == [4, 5, 6]  # keep-last 2 + the step-4 anchor
+    manifests = json.loads((tmp_path / "ckpt" / "manifests.json")
+                           .read_text())
+    assert sorted(manifests) == ["4", "5", "6"]
+    mgr.close()
+
+
+def test_gc_never_removes_only_valid_step(tmp_path, tiny_state):
+    """keep_last=1 with the newest steps corrupted: GC must keep the
+    older VALID step the restore fallback needs."""
+    from ntxent_tpu.resilience import truncate_checkpoint_file
+
+    mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=2)
+    assert mgr.save(2, tiny_state, force=True)
+    assert mgr.save(4, tiny_state, force=True)
+    assert mgr.all_steps() == [2, 4]
+    assert truncate_checkpoint_file(tmp_path / "ckpt", step=4) is not None
+    mgr.close()
+    # A tighter policy arrives (e.g. a restarted run with keep_last=1):
+    # its GC must still keep step 2 — the only VALID state left.
+    mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=1)
+    deleted = mgr.gc()
+    assert 2 not in deleted
+    assert 2 in mgr.all_steps()  # newest VALID survived keep_last=1
+    assert mgr.latest_valid_step() == 2
+    restored = mgr.restore(_tiny_state(seed=9))
+    _params_equal(restored.params, tiny_state.params)
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Atomic writes + diskfull injection (satellites 1 & 2)
+# ---------------------------------------------------------------------------
+
+def test_faultplan_parses_kill_and_diskfull():
+    plan = FaultPlan.parse("kill@4,diskfull@2,nan@3")
+    assert plan.kill_batches == (4,)
+    assert plan.diskfull_writes == (2,)
+    assert not plan.empty()
+    with pytest.raises(ValueError, match="bad fault"):
+        FaultPlan.parse("killl@4")
+
+
+def test_diskfull_injection_keeps_skip_contract(tmp_path, tiny_state):
+    """ENOSPC in the writer: save returns False, bumps the failure
+    counter, leaves NO partial step and NO staging debris, and the next
+    write (disk 'freed') succeeds."""
+    from ntxent_tpu.obs.registry import default_registry
+
+    injector = FaultInjector(FaultPlan.parse("diskfull@1"))
+    mgr = CheckpointManager(tmp_path / "ckpt",
+                            fault_hook=injector.on_checkpoint_write)
+    failures = default_registry().counter("checkpoint_save_failures_total")
+    before = failures.value
+    assert mgr.save(1, tiny_state, force=True) is False
+    assert injector.fired == ["diskfull@1"]
+    assert failures.value == before + 1
+    scan = scan_checkpoint_dir(tmp_path / "ckpt")
+    assert scan == {"torn": [], "tmp": []}
+    assert mgr.all_steps() == []
+    # Write 2 is past the plan: the cadence recovers.
+    assert mgr.save(2, tiny_state, force=True) is True
+    assert mgr.verify(2)
+    mgr.close()
+
+
+def test_failed_write_leaves_no_debris_mid_file(tmp_path, tiny_state):
+    """An OSError AFTER files are partially staged (not just at the
+    hook) must clean its staging dir — a torn step is impossible."""
+    calls = []
+
+    def hook():
+        calls.append(1)
+        if len(calls) == 1:
+            raise OSError(errno.ENOSPC, "no space")
+
+    mgr = CheckpointManager(tmp_path / "ckpt", fault_hook=hook)
+    assert mgr.save(3, tiny_state, force=True) is False
+    assert scan_checkpoint_dir(tmp_path / "ckpt") == {"torn": [],
+                                                      "tmp": []}
+    mgr.close()
+
+
+def test_first_save_of_fresh_directory_always_lands(tmp_path, tiny_state):
+    mgr = CheckpointManager(tmp_path / "ckpt", save_interval_steps=100)
+    assert mgr.should_save(1)
+    assert mgr.save(1, tiny_state)
+    assert not mgr.should_save(2)  # cadence owns it from here
+    assert mgr.save(2, tiny_state) is False
+    assert mgr.all_steps() == [1]
+    mgr.close()
+    # A resumed manager over a non-empty dir keeps cadence-only.
+    mgr2 = CheckpointManager(tmp_path / "ckpt", save_interval_steps=100)
+    assert not mgr2.should_save(3)
+    mgr2.close()
+
+
+def test_init_purges_abandoned_staging_dirs(tmp_path, tiny_state):
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    debris = ckpt / ".tmp-5-deadbeef"
+    debris.mkdir()
+    (debris / "state.msgpack").write_bytes(b"partial")
+    mgr = CheckpointManager(ckpt)
+    assert not debris.exists()
+    assert mgr.all_steps() == []  # debris never enumerates as a step
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Mirror replication
+# ---------------------------------------------------------------------------
+
+def test_mirror_replicates_and_serves_corrupt_primary(tmp_path,
+                                                      tiny_state):
+    from ntxent_tpu.resilience import truncate_checkpoint_file
+
+    mgr = CheckpointManager(tmp_path / "ckpt",
+                            mirror_dir=tmp_path / "mirror")
+    assert mgr.save(2, tiny_state, force=True,
+                    data_state={"epoch": 0, "offset": 2, "seed": 5})
+    assert (tmp_path / "mirror" / "2" / "state.msgpack").exists()
+    assert mgr.mirror_verify(2)
+
+    assert truncate_checkpoint_file(tmp_path / "ckpt", step=2) is not None
+    assert not mgr.verify(2)
+    assert mgr.latest_valid_step() == 2  # the mirror copy still counts
+    restored, data_state = mgr.restore_with_data_state(_tiny_state(seed=9))
+    _params_equal(restored.params, tiny_state.params)
+    assert data_state == {"epoch": 0, "offset": 2, "seed": 5}
+    mgr.close()
+
+
+def test_mirror_serves_when_primary_manifest_corrupt(tmp_path,
+                                                     tiny_state):
+    """Garbage manifests.json + a truncated primary payload: the primary
+    can neither verify nor be trusted, and restore must fall through to
+    the mirror copy."""
+    from ntxent_tpu.resilience import truncate_checkpoint_file
+
+    mgr = CheckpointManager(tmp_path / "ckpt",
+                            mirror_dir=tmp_path / "mirror")
+    assert mgr.save(3, tiny_state, force=True)
+    (tmp_path / "ckpt" / "manifests.json").write_text("{not json")
+    assert truncate_checkpoint_file(tmp_path / "ckpt", step=3) is not None
+    # With the manifest gone the truncated primary would verify as
+    # "unverifiable == valid" — the mirror's CRCs are what catch it.
+    restored, _ = mgr.restore_with_data_state(_tiny_state(seed=9))
+    # The restore must carry the TRUE bytes (mirror), not the torn ones:
+    # a successful from_bytes over truncated msgpack would have raised.
+    _params_equal(restored.params, tiny_state.params)
+    mgr.close()
+
+
+def test_mirror_serves_when_primary_step_missing(tmp_path, tiny_state):
+    import shutil
+
+    mgr = CheckpointManager(tmp_path / "ckpt",
+                            mirror_dir=tmp_path / "mirror")
+    assert mgr.save(5, tiny_state, force=True)
+    shutil.rmtree(tmp_path / "ckpt" / "5")
+    assert mgr.latest_valid_step() == 5
+    restored = mgr.restore(_tiny_state(seed=9))
+    _params_equal(restored.params, tiny_state.params)
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# AsyncCheckpointer
+# ---------------------------------------------------------------------------
+
+def test_async_save_roundtrip(tmp_path, tiny_state):
+    mgr = AsyncCheckpointer(CheckpointManager(tmp_path / "ckpt"))
+    assert mgr.save(1, tiny_state, force=True)
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [1]
+    assert mgr.verify(1)
+    restored = mgr.restore(_tiny_state(seed=9))
+    _params_equal(restored.params, tiny_state.params)
+    mgr.close()
+
+
+def test_async_snapshot_is_immune_to_buffer_reuse(tmp_path, tiny_state):
+    """The host snapshot must be a REAL copy: on CPU ``device_get``
+    returns zero-copy views of the device buffers, and a donated train
+    step overwriting them under the background writer serialized a LATER
+    step's params under this step's label (the crash audit caught it).
+    """
+    from flax import serialization
+
+    snap = snapshot_state(tiny_state)
+    views = jax.device_get(serialization.to_state_dict(tiny_state))
+    for copied, view in zip(jax.tree.leaves(snap.state_dict),
+                            jax.tree.leaves(views)):
+        if isinstance(copied, np.ndarray) \
+                and isinstance(view, np.ndarray) and copied.size:
+            assert not np.shares_memory(copied, view), \
+                "snapshot aliases the live device buffer"
+    mgr = AsyncCheckpointer(CheckpointManager(tmp_path / "ckpt"))
+    assert mgr.save(1, snap, force=True)
+    mgr.wait_until_finished()
+    restored = mgr.restore(_tiny_state(seed=9))
+    _params_equal(restored.params, tiny_state.params)
+    mgr.close()
+
+
+def test_async_writer_failure_keeps_contract(tmp_path, tiny_state):
+    """A writer-thread OSError must not raise on the train loop; it
+    lands in the failure counter + last_error and later saves recover."""
+    from ntxent_tpu.obs.registry import default_registry
+
+    injector = FaultInjector(FaultPlan.parse("diskfull@1"))
+    mgr = AsyncCheckpointer(CheckpointManager(
+        tmp_path / "ckpt", fault_hook=injector.on_checkpoint_write))
+    failures = default_registry().counter("checkpoint_save_failures_total")
+    before = failures.value
+    assert mgr.save(1, tiny_state, force=True)  # accepted
+    mgr.wait_until_finished()
+    assert failures.value == before + 1
+    assert mgr.last_error is not None
+    assert mgr.all_steps() == []
+    assert mgr.save(2, tiny_state, force=True)
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [2]
+    mgr.close()
+
+
+def test_emergency_save_is_synchronous(tmp_path, tiny_state):
+    mgr = AsyncCheckpointer(CheckpointManager(tmp_path / "ckpt"))
+    assert mgr.emergency_save(7, tiny_state,
+                              data_state={"epoch": 1, "offset": 3,
+                                          "seed": 0})
+    # No wait_until_finished: the write must already be durable.
+    assert (tmp_path / "ckpt" / "7" / "state.msgpack").exists()
+    assert mgr.manager.verify(7)
+    _, data_state = mgr.restore_with_data_state(_tiny_state(seed=9))
+    assert data_state == {"epoch": 1, "offset": 3, "seed": 0}
+    mgr.close()
+
+
+def test_async_queue_depth_is_bounded(tmp_path, tiny_state,
+                                      monkeypatch):
+    """With a slow writer, a second save blocks until the first lands —
+    the queue never grows past max_pending (the bounded-writer
+    contract), and every accepted save is eventually durable."""
+    monkeypatch.setenv("NTXENT_CKPT_SLOW_MS", "50")
+    mgr = AsyncCheckpointer(CheckpointManager(tmp_path / "ckpt"),
+                            max_pending=1)
+    for step in (1, 2, 3):
+        assert mgr.save(step, tiny_state, force=True)
+        assert mgr._queue.qsize() <= 1
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [1, 2, 3]
+    mgr.close()
+
+
+def test_async_first_save_claim_leaves_no_phantom_error(tmp_path,
+                                                        tiny_state,
+                                                        monkeypatch):
+    """Review regression: with a slow writer and a wide cadence, the
+    empty-dir first-save rule must fire ONCE — a second accepted 'first
+    save' would later be cadence-filtered in the writer and misread as a
+    write failure (phantom last_error on a healthy run)."""
+    monkeypatch.setenv("NTXENT_CKPT_SLOW_MS", "100")
+    mgr = AsyncCheckpointer(CheckpointManager(tmp_path / "ckpt",
+                                              save_interval_steps=100))
+    assert mgr.save(1, tiny_state) is True  # first-save rule, claimed
+    # Writer still sleeping on save 1: the probe must NOT re-fire.
+    assert mgr.save(2, tiny_state) is False
+    mgr.wait_until_finished()
+    assert mgr.last_error is None
+    assert mgr.all_steps() == [1]
+    mgr.close()
+
+
+def test_purge_keeps_live_writers_staging(tmp_path):
+    """Staging dirs embed the writer PID: purge must remove a dead
+    writer's debris but keep another LIVE process's in-flight save."""
+    import subprocess
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    live = subprocess.Popen(["sleep", "60"])
+    try:
+        live_tmp = ckpt / f".tmp-5-{live.pid}-abcd1234"
+        live_tmp.mkdir()
+        dead = subprocess.Popen(["true"])
+        dead.wait()
+        dead_tmp = ckpt / f".tmp-6-{dead.pid}-abcd1234"
+        dead_tmp.mkdir()
+        legacy_tmp = ckpt / ".tmp-7-deadbeef"  # pre-PID naming
+        legacy_tmp.mkdir()
+        mgr = CheckpointManager(ckpt)
+        assert live_tmp.exists(), "live writer's staging dir was purged"
+        assert not dead_tmp.exists()
+        assert not legacy_tmp.exists()
+        mgr.close()
+    finally:
+        live.kill()
+        live.wait()
+
+
+def test_explicit_step_restore_reads_mirror_when_primary_gone(
+        tmp_path, tiny_state):
+    """An explicitly requested step whose primary dir is gone and whose
+    mirror copy fails verification is still restored from the mirror —
+    the caller asked for that exact step."""
+    import shutil
+
+    mgr = CheckpointManager(tmp_path / "ckpt",
+                            mirror_dir=tmp_path / "mirror")
+    assert mgr.save(4, tiny_state, force=True)
+    shutil.rmtree(tmp_path / "ckpt" / "4")
+    # Poison the mirror's manifest entry so mirror_verify fails while
+    # the copied bytes stay restorable.
+    manifests = json.loads((tmp_path / "mirror" / "manifests.json")
+                           .read_text())
+    manifests["4"]["files"]["state.msgpack"][1] ^= 0xFFFF
+    (tmp_path / "mirror" / "manifests.json").write_text(
+        json.dumps(manifests))
+    assert not mgr.verify(4) and not mgr.mirror_verify(4)
+    restored = mgr.restore(_tiny_state(seed=9), step=4)
+    _params_equal(restored.params, tiny_state.params)
+    mgr.close()
+
+
+def test_restore_never_deletes_unreadable_foreign_steps(tmp_path,
+                                                        tiny_state):
+    """Review regression: a CRC-clean step that cannot be deserialized
+    (e.g. a directory written by the old orbax backend) must not be
+    deleted by the restore fallback — destroying every older-format
+    checkpoint one candidate at a time before raising."""
+    ckpt = tmp_path / "ckpt"
+    (ckpt / "3").mkdir(parents=True)
+    (ckpt / "3" / "checkpoint").write_bytes(b"some-other-format bytes")
+    mgr = CheckpointManager(ckpt)
+    mgr._record_manifest(3)
+    assert mgr.verify(3)
+    with pytest.raises(Exception, match="cannot be deserialized"):
+        mgr.restore_with_data_state(_tiny_state(seed=9))
+    assert (ckpt / "3" / "checkpoint").exists(), \
+        "foreign-format checkpoint was destroyed"
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# fit() integration: async + emergency on preemption
+# ---------------------------------------------------------------------------
+
+def _fit_setup(steps=6):
+    from ntxent_tpu.training import make_train_step
+
+    state = _tiny_state(steps=steps)
+    step = make_train_step(0.1, use_fused=False)
+
+    def gen():
+        key = jax.random.PRNGKey(7)
+        i = 0
+        while True:
+            k1, k2 = jax.random.split(jax.random.fold_in(key, i))
+            yield (jax.random.uniform(k1, (12, 8, 8, 3)),
+                   jax.random.uniform(k2, (12, 8, 8, 3)))
+            i += 1
+
+    return state, step, gen()
+
+
+def test_fit_async_checkpointing_saves_and_resumes(tmp_path):
+    from ntxent_tpu.training import fit
+
+    state, step, it = _fit_setup()
+    state, _ = fit(state, it, step, num_steps=4,
+                   checkpoint_dir=str(tmp_path), checkpoint_every=2,
+                   log_every=100, flops_per_step=None,
+                   async_checkpointing=True)
+    assert int(state.step) == 4
+    mgr = CheckpointManager(tmp_path)
+    assert mgr.latest_step() == 4
+    assert mgr.verify(4)
+    mgr.close()
+    # Resume: the same dir continues to the full step count.
+    state2, step2, it2 = _fit_setup()
+    state2, _ = fit(state2, it2, step2, num_steps=6,
+                    checkpoint_dir=str(tmp_path), checkpoint_every=2,
+                    log_every=100, flops_per_step=None,
+                    async_checkpointing=True)
+    assert int(state2.step) == 6
+
+
+def test_fit_preemption_takes_emergency_path(tmp_path, monkeypatch):
+    """A stop_fn trip under async checkpointing routes the final save
+    through emergency_save (synchronous, emergency-tagged event)."""
+    from ntxent_tpu.training import fit
+    from ntxent_tpu.training.checkpoint import AsyncCheckpointer as AC
+
+    calls = []
+    real = AC.emergency_save
+
+    def spying(self, step, state, data_state=None):
+        calls.append(int(step))
+        return real(self, step, state, data_state=data_state)
+
+    monkeypatch.setattr(AC, "emergency_save", spying)
+    state, step, it = _fit_setup()
+    stops = {"n": 0}
+
+    def stop():
+        stops["n"] += 1
+        return stops["n"] > 3  # trip after step 3
+
+    state, _ = fit(state, it, step, num_steps=6,
+                   checkpoint_dir=str(tmp_path), checkpoint_every=100,
+                   log_every=100, flops_per_step=None, stop_fn=stop,
+                   async_checkpointing=True)
+    assert calls, "emergency_save was not used on the preemption path"
+    mgr = CheckpointManager(tmp_path)
+    assert mgr.latest_step() == int(state.step)
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# crashsim helpers
+# ---------------------------------------------------------------------------
+
+def test_scan_detects_torn_step_and_tmp_debris(tmp_path, tiny_state):
+    from ntxent_tpu.resilience import truncate_checkpoint_file
+
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    assert mgr.save(1, tiny_state, force=True)
+    assert mgr.save(2, tiny_state, force=True)
+    mgr.close()
+    assert scan_checkpoint_dir(tmp_path / "ckpt") == {"torn": [],
+                                                      "tmp": []}
+    assert truncate_checkpoint_file(tmp_path / "ckpt", step=2) is not None
+    (tmp_path / "ckpt" / ".tmp-3-feedface").mkdir()
+    scan = scan_checkpoint_dir(tmp_path / "ckpt")
+    assert scan["torn"] and "2" in scan["torn"][0]
+    assert scan["tmp"] == [".tmp-3-feedface"]
+
+
+def test_fingerprint_tracks_payload_bytes(tmp_path, tiny_state):
+    mgr = CheckpointManager(tmp_path / "a")
+    mgr2 = CheckpointManager(tmp_path / "b")
+    assert mgr.save(1, tiny_state, force=True,
+                    data_state={"epoch": 0, "offset": 1, "seed": 0})
+    assert mgr2.save(1, tiny_state, force=True,
+                     data_state={"epoch": 0, "offset": 1, "seed": 0})
+    fp_a = checkpoint_fingerprint(tmp_path / "a", 1)
+    fp_b = checkpoint_fingerprint(tmp_path / "b", 1)
+    assert fp_a == fp_b  # deterministic serialization, CRC for CRC
+    assert mgr2.save(1, _tiny_state(seed=9), force=True)
+    assert checkpoint_fingerprint(tmp_path / "b", 1) != fp_a
+    with pytest.raises(Exception, match="no checkpoint"):
+        checkpoint_fingerprint(tmp_path / "a", 99)
+    mgr.close()
+    mgr2.close()
