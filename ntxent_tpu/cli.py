@@ -166,6 +166,17 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="N",
                    help="retention: additionally keep every step "
                         "divisible by N as a long-horizon anchor")
+    t.add_argument("--restore-step", type=int, default=None, metavar="N",
+                   help="resume from this exact historical checkpoint "
+                        "step instead of the newest valid one (same "
+                        "mirror-fallback semantics as normal restore; a "
+                        "step present in no replica fails loudly). "
+                        "Rewind semantics: checkpoint steps NEWER than N "
+                        "are deleted (both replicas, logged) so the "
+                        "replayed lineage owns the timeline — its saves "
+                        "land, and a crash mid-replay resumes the replay, "
+                        "not the abandoned future. A supervised run "
+                        "applies this to its FIRST attempt only")
     t.add_argument("--ckpt-mirror", default=None, metavar="DIR",
                    help="replicate every checkpoint to DIR (atomic copy "
                         "after each save); restore falls back to the "
@@ -293,7 +304,16 @@ def _npy_store_shape(args) -> tuple:
 
 
 def _make_encoder(name: str, image_size: int, moe_experts: int = 0,
-                  stem: str = "conv", vit_attention: str = "xla"):
+                  stem: str = "conv", vit_attention: str = "xla",
+                  axis_name: str | None = None):
+    """``axis_name``: mesh data axis for cross-replica BatchNorm
+    statistics in ResNet encoders (the dp shard_map branch passes
+    "data"). Global-batch BN is both the SimCLR recipe and what makes
+    the sharded loss DEVICE-COUNT INVARIANT — the property elastic
+    shrink/grow restores are audited against (a per-shard-local BN
+    normalizes over batch/P rows, so the same global batch would produce
+    a different loss on a different mesh size). ViT encoders use
+    LayerNorm (per-row) and ignore it."""
     from ntxent_tpu import models
 
     if moe_experts > 0 and not name.startswith("vit"):
@@ -308,7 +328,7 @@ def _make_encoder(name: str, image_size: int, moe_experts: int = 0,
                          "be silently ignored")
     if name == "tiny":
         return functools.partial(models.ResNet, stage_sizes=(1,),
-                                 small_images=True)
+                                 small_images=True, axis_name=axis_name)
     table = {
         "resnet18": models.ResNet18, "resnet34": models.ResNet34,
         "resnet50": models.ResNet50, "resnet50x2": models.ResNet50x2,
@@ -328,6 +348,8 @@ def _make_encoder(name: str, image_size: int, moe_experts: int = 0,
         # MXU-dense ImageNet stem (weight-compatible with the plain one;
         # models/resnet.py:SpaceToDepthStem).
         enc = functools.partial(enc, stem=stem)
+    if name.startswith("resnet") and axis_name is not None:
+        enc = functools.partial(enc, axis_name=axis_name)
     if moe_experts > 0:
         enc = functools.partial(enc, moe_experts=moe_experts)
     if vit_attention != "xla":
@@ -605,13 +627,25 @@ def main(argv=None) -> int:
     )
     from ntxent_tpu.training.trainer import make_sharded_train_step
 
+    # Cross-replica BatchNorm on the plain data-parallel branch: the
+    # model forward runs inside shard_map there, so BN can psum its
+    # batch statistics over 'data' — global-batch normalization (the
+    # SimCLR recipe) AND device-count-invariant math, which is what lets
+    # the elastic audit hold a shrunken/regrown run's loss curve against
+    # a fixed-mesh reference. The TP/FSDP branches run the forward under
+    # GSPMD (no named axis in scope) and keep local stats.
+    dp_bn_axis = "data" if (info["global_device_count"] > 1
+                            and args.parallel == "dp"
+                            and not args.fsdp) else None
     encoder = _make_encoder(args.model, args.image_size,
                             moe_experts=args.moe_experts,
                             stem=args.stem,
-                            vit_attention=args.vit_attention)
+                            vit_attention=args.vit_attention,
+                            axis_name=dp_bn_axis)
     model = SimCLRModel(encoder=encoder,
                         proj_hidden_dim=args.proj_hidden_dim,
-                        proj_dim=args.proj_dim)
+                        proj_dim=args.proj_dim,
+                        axis_name=dp_bn_axis)
     moe_aux = args.moe_aux_weight if args.moe_experts > 0 else 0.0
     cfg = TrainerConfig(
         batch_size=args.batch, temperature=args.temperature,
@@ -629,6 +663,9 @@ def main(argv=None) -> int:
     # rebuild a FRESH template (a crashed attempt's donated buffers must
     # not be reused as a restore template; resilience/supervisor.py).
     prepare_state = lambda s: s  # noqa: E731
+    # Elastic rebuild seam, set by the data-parallel branch only (the
+    # one whose world is rebuildable over a device subset in-process).
+    elastic_builder = None
     nan_policy = args.nan_policy
     guard_steps = nan_policy != "off"
 
@@ -757,6 +794,30 @@ def main(argv=None) -> int:
                               injector=injector)
         logger.info("data-parallel over %d devices (%d process(es))",
                     n_dev, info["process_count"])
+
+        if info["process_count"] == 1 and getattr(args, "dcn_slices", 1) <= 1:
+            # Elastic seam (shrink@K/grow@K): rebuild the whole dp world
+            # over a device subset. Single-process flat meshes only — a
+            # multi-process pool changes membership at the process level
+            # (relaunch; crashsim drives that boundary), and hybrid
+            # DCN meshes shrink by slices, not by arbitrary halving.
+            def topology_builder(n_active):
+                devices = jax.devices()[:n_active]
+                mesh_n = create_mesh(devices=devices,
+                                     axis_names=("data",))
+                step_n = make_sharded_train_step(
+                    mesh_n, cfg.temperature, remat=args.remat,
+                    loss_impl=args.dp_loss, moe_aux_weight=moe_aux,
+                    guard=guard_steps)
+                sharding_n = data_sharding(mesh_n)
+                data_n = _make_pipeline(args, per_process_batch,
+                                        sharding=sharding_n, mesh=mesh_n,
+                                        injector=injector)
+                factory_n = lambda: replicate_state(  # noqa: E731
+                    base_state(), mesh_n)
+                return data_n, step_n, factory_n, sharding_n
+
+            elastic_builder = topology_builder
     else:
         if args.fsdp:
             logger.warning("--fsdp ignored: single-device run has nothing "
@@ -776,7 +837,8 @@ def main(argv=None) -> int:
     return _run_fit(data, state, step, args,
                     state_factory=lambda: prepare_state(base_state()),
                     step_guard=_make_step_guard(nan_policy),
-                    injector=injector, sharding=batch_sharding)
+                    injector=injector, sharding=batch_sharding,
+                    topology_builder=elastic_builder)
 
 
 def _log_final(history) -> None:
@@ -788,7 +850,7 @@ def _log_final(history) -> None:
 
 
 def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
-             injector=None, sharding=None) -> int:
+             injector=None, sharding=None, topology_builder=None) -> int:
     """Shared training epilogue for both objectives.
 
     Unsupervised (default): one preemption-guarded ``fit`` — SIGTERM means
@@ -800,6 +862,16 @@ def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
     ``sharding`` is the run's batch ``NamedSharding`` (None on a single
     device): with --prefetch it binds the DevicePrefetcher to the mesh so
     batches arrive as committed global arrays (training/data.py).
+
+    ``topology_builder(n_active) -> (data, step, state_factory,
+    sharding)`` is the elastic seam (data-parallel branch only): when a
+    supervised attempt dies with a ``TopologyChange`` (chaos
+    ``shrink@K``/``grow@K``, or a resource manager surfacing a pool
+    change), the supervisor's topology hook calls it to rebuild the
+    world over the new device count — shrink halves the active devices
+    (skipping counts the batch does not divide), grow restores the full
+    set — and the next attempt restores the newest checkpoint onto the
+    rebuilt mesh (the checkpoint topology sidecar makes that a re-shard).
     """
     import contextlib
 
@@ -808,7 +880,15 @@ def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
     from ntxent_tpu.utils import StallWatchdog
 
     prefetch_depth = getattr(args, "prefetch", 0) or 0
-    if prefetch_depth > 0:
+    restore_step = getattr(args, "restore_step", None)
+
+    def wrap_data(raw, shard):
+        """The run's data-side wrappers, reapplied on every topology
+        rebuild: device prefetch innermost (chaos injection stays
+        consumer-aligned; the checkpointable state()/restore() chain
+        passes through)."""
+        if prefetch_depth <= 0:
+            return raw
         import jax
 
         from ntxent_tpu.training.data import DevicePrefetcher
@@ -820,14 +900,14 @@ def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
             # would eagerly device_put non-fully-addressable arrays onto
             # a possibly different spec every batch. sharding=None makes
             # the prefetcher pure read-ahead: placed leaves pass through.
-            sharding = None
-        # Innermost wrapper: chaos injection (below) stays consumer-
-        # aligned — faults fire by batch ordinal at consumption, and the
-        # checkpointable state()/restore() chain passes through.
-        data = DevicePrefetcher(data, depth=prefetch_depth,
-                                sharding=sharding)
+            shard = None
+        wrapped = DevicePrefetcher(raw, depth=prefetch_depth,
+                                   sharding=shard)
         logger.info("device prefetch: depth %d%s", prefetch_depth,
-                    f" onto {sharding}" if sharding is not None else "")
+                    f" onto {shard}" if shard is not None else "")
+        return wrapped
+
+    data = wrap_data(data, sharding)
     metrics_lag = 1 if getattr(args, "lag_metrics", False) else 0
     if metrics_lag:
         logger.info("lag-1 metrics drain: guard/telemetry reads run one "
@@ -861,6 +941,7 @@ def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
                     log_every=args.log_every, stop_fn=guard.requested,
                     watchdog=watchdog, step_guard=step_guard,
                     timeline=timeline, metrics_lag=metrics_lag,
+                    restore_step=restore_step,
                     **ckpt_kwargs)
             _log_final(history)
             if guard.preempted:
@@ -878,26 +959,61 @@ def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
         if injector is not None:
             data = injector.wrap_iterator(data)
         first_state = state
+        # The supervised attempt's world, swapped wholesale by the
+        # topology hook (elastic restarts rebuild mesh + step + pipeline).
+        current = {"data": data, "step": step,
+                   "state_factory": state_factory}
 
         def run_attempt(attempt, stop_fn, watchdog):
-            s = first_state if attempt == 0 or state_factory is None \
-                else state_factory()
+            s = first_state if attempt == 0 \
+                or current["state_factory"] is None \
+                else current["state_factory"]()
             if step_guard is not None:
                 step_guard.reset_attempt()
-            return fit(s, data, step, num_steps=args.steps,
+            return fit(s, current["data"], current["step"],
+                       num_steps=args.steps,
                        checkpoint_dir=args.ckpt_dir,
                        checkpoint_every=args.ckpt_every,
                        log_every=args.log_every, stop_fn=stop_fn,
                        watchdog=watchdog, step_guard=step_guard,
                        timeline=timeline, metrics_lag=metrics_lag,
+                       restore_step=restore_step if attempt == 0 else None,
                        **ckpt_kwargs)
+
+        topology_hook = None
+        if topology_builder is not None:
+            import jax
+
+            n_all = jax.device_count()
+            active = {"n": n_all}
+
+            def topology_hook(action):
+                n = active["n"]
+                n_new = n_all if action == "grow" else max(1, n // 2)
+                while n_new > 1 and args.batch % n_new:
+                    n_new //= 2
+                if n_new == n:
+                    logger.warning("topology %s: device count stays at "
+                                   "%d (batch %d divisibility)", action,
+                                   n, args.batch)
+                    return
+                logger.warning("topology %s: rebuilding the world over "
+                               "%d -> %d devices", action, n, n_new)
+                raw, new_step, new_factory, new_sharding = \
+                    topology_builder(n_new)
+                d = wrap_data(raw, new_sharding)
+                if injector is not None:
+                    d = injector.wrap_iterator(d)
+                current.update(data=d, step=new_step,
+                               state_factory=new_factory)
+                active["n"] = n_new
 
         supervisor = Supervisor(
             run_attempt, num_steps=args.steps,
             checkpoint_dir=args.ckpt_dir,
             max_restarts=max_restarts,
             stall_timeout_s=getattr(args, "stall_timeout", None),
-            injector=injector)
+            injector=injector, topology_hook=topology_hook)
         result = supervisor.run()
         _log_final(result.histories[-1] if result.histories else [])
         if injector is not None and injector.fired:

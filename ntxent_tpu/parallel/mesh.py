@@ -16,9 +16,11 @@ This module realizes that declared capability TPU-natively:
 from __future__ import annotations
 
 import logging
-from typing import Sequence
+import re
+from typing import Any, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -37,7 +39,18 @@ __all__ = [
     "shard_map",
     "pcast",
     "axis_size",
+    "axis_index",
+    "mesh_topology",
+    "tree_partition_specs",
+    "match_partition_rules",
+    "resolve_restore_specs",
+    "place_with_specs",
 ]
+
+# Feature gate shared by every shim below: recent jax promoted shard_map
+# to the top level; installs without it need the experimental spelling
+# AND carry the two lowering/transpose bugs the shims own.
+_OLD_JAX = not hasattr(jax, "shard_map")
 
 
 def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
@@ -95,6 +108,136 @@ def axis_size(axis: str) -> int:
     if hasattr(jax.lax, "axis_size"):
         return jax.lax.axis_size(axis)
     return jax.lax.psum(1, axis)
+
+
+def axis_index(axis: str):
+    """``jax.lax.axis_index`` spelled to survive old-jax lowering
+    (robustness shim).
+
+    On old jax, an ``axis_index`` inside a ``jax.custom_vjp`` body that is
+    itself inside a jit-compiled ``shard_map`` lowers to a bare GSPMD
+    ``partition-id`` that XLA's SPMD partitioner rejects as UNIMPLEMENTED
+    (the seed-era ring-attention-under-jit failure). Collectives lower
+    correctly in exactly that position, so the fallback derives the index
+    from one: every device contributes ``arange(P)`` to a psum-scatter,
+    so device d receives ``P * d`` — a reduce-scatter the partitioner
+    understands anywhere a ppermute works. Use this (not the raw lax op)
+    inside custom_vjp bodies that run under ``shard_map``; on new jax it
+    is the native op.
+    """
+    if not _OLD_JAX:
+        return jax.lax.axis_index(axis)
+    n = int(axis_size(axis))  # static: psum of a non-traced constant
+    if n == 1:
+        return jnp.int32(0)
+    scattered = jax.lax.psum_scatter(
+        jnp.arange(n, dtype=jnp.int32), axis, scatter_dimension=0,
+        tiled=True)
+    return jnp.squeeze(scattered, 0) // n
+
+
+def _install_old_jax_transpose_fix() -> None:
+    """Own the old-jax ``shard_map`` gradient seam (robustness shim).
+
+    On old jax, differentiating THROUGH a ``shard_map`` whose linearized
+    body carries residuals fails with ``_SpecError`` whenever the
+    backward pass leaks a cotangent onto a residual input: upstream's
+    transpose rule turns every nonzero cotangent ``ad.backward_pass``
+    returns into an output of the transposed shard_map, zipped against
+    the FORWARD's ``in_names`` — but cotangents are only owed to the
+    undefined primals, and a leaked residual cotangent (the transpose of
+    ``add`` writes to both operands; a promoted scalar residual arrives
+    back as a bare scalar) fails the output spec check. The fixed rule
+    below keeps upstream's structure and simply drops cotangents at
+    non-undefined positions before binding the transposed shard_map —
+    transposition by definition owes nothing there. New jax fixed this
+    upstream; old installs get the same semantics from here, which is
+    what lets ``jax.grad`` flow through the distributed losses, the TP/
+    FSDP steps and the GPipe schedule on this fleet (the pre-elastic
+    tier-1 failure set).
+    """
+    import jax.experimental.shard_map as _shmap
+    from jax._src import core as _core
+    from jax._src import dtypes as _dtypes
+    from jax._src import linear_util as _lu
+    from jax._src.interpreters import ad as _ad
+    from jax._src.interpreters import partial_eval as _pe
+    from jax._src.util import partition_list as _partition_list
+    from jax.api_util import flatten_fun_nokwargs as _flatten_nokwargs
+    from jax.tree_util import tree_flatten, tree_unflatten
+    from math import prod as _prod
+
+    def _transpose_fixed(out_cts, *args, jaxpr, mesh, in_names, out_names,
+                         check_rep, rewrite, auto):
+        mb_div = lambda x, y: x / y if y != 1 else x  # noqa: E731
+        out_cts = [
+            _ad.Zero(_shmap._shard_aval(mesh, ns, x.aval))
+            if type(x) is _ad.Zero
+            else x if rewrite or _dtypes.dtype(x) == _dtypes.float0
+            else mb_div(x, _prod(map(mesh.shape.get,
+                                     _shmap._unmentioned2(mesh, ns, auto))))
+            for ns, x in zip(out_names, out_cts)]
+        args = [x if type(x) is not _ad.UndefinedPrimal else
+                _ad.UndefinedPrimal(_shmap._shard_aval(mesh, ns, x.aval))
+                for ns, x in zip(in_names, args)]
+        all_args, in_tree = tree_flatten((out_cts, args))
+
+        @_lu.wrap_init
+        def fun_trans(out_cts, args):
+            undef = list(map(_ad.is_undefined_primal, args))
+            res, undefs = _partition_list(undef, args)
+            jaxpr_known, jaxpr_unknown, _, _ = _pe.partial_eval_jaxpr_nounits(
+                _pe.close_jaxpr(jaxpr), undef, False)
+            res_reshaped = _core.jaxpr_as_fun(jaxpr_known)(*res)
+            all_cts = _ad.backward_pass(
+                jaxpr_unknown.jaxpr, False, (),
+                (*res_reshaped, *undefs), out_cts)
+            # jaxpr_unknown's invars are [*new_residuals, *undefined
+            # primals]: keep only the trailing undefined-primal
+            # cotangents (THE FIX — leaked residual cotangents must not
+            # become outputs of the transposed shard_map).
+            undef_cts = iter(all_cts[len(all_cts) - len(undefs):])
+            out = [next(undef_cts) if u
+                   else _ad.Zero(_core.get_aval(x).to_tangent_aval())
+                   for u, x in zip(undef, args)]
+            out = [_ad.Zero(_shmap._unshard_aval(mesh, ns, x.aval))
+                   if type(x) is _ad.Zero
+                   else x if rewrite
+                   else jax.lax.psum(x, tuple(
+                       _shmap._unmentioned2(mesh, ns, auto)))
+                   for ns, x in zip(in_names, out)]
+            return out
+
+        fun_trans, nz_arg_cts = _ad.nonzero_outputs(fun_trans)
+        fun_trans_flat, out_tree = _flatten_nokwargs(fun_trans, in_tree)
+
+        new_in_names = \
+            [n for n, x in zip(out_names, out_cts)
+             if type(x) is not _ad.Zero] + \
+            [n for n, x in zip(in_names, args)
+             if type(x) is not _ad.UndefinedPrimal]
+
+        def new_out_names_thunk():
+            return tuple(names for names, nz in zip(in_names, nz_arg_cts())
+                         if nz)
+
+        out_flat = _shmap.shard_map_p.bind(
+            fun_trans_flat, *all_args, mesh=mesh,
+            in_names=tuple(new_in_names),
+            out_names_thunk=new_out_names_thunk, check_rep=check_rep,
+            rewrite=rewrite, auto=auto)
+        return tree_unflatten(out_tree(), out_flat)
+
+    _ad.primitive_transposes[_shmap.shard_map_p] = _transpose_fixed
+
+
+if _OLD_JAX:
+    try:
+        _install_old_jax_transpose_fix()
+    except Exception:  # never break import over a shim install
+        logger.exception(
+            "old-jax shard_map transpose fix failed to install; "
+            "grad-through-shard_map keeps upstream's _SpecError behavior")
 
 
 def init_distributed(
@@ -315,3 +458,167 @@ def process_info() -> dict:
         "local_device_count": jax.local_device_count(),
         "global_device_count": jax.device_count(),
     }
+
+
+# ---------------------------------------------------------------------------
+# Elastic topology: logical PartitionSpec trees that survive mesh changes
+# ---------------------------------------------------------------------------
+#
+# A checkpoint taken on an N-device mesh must restore onto an M-device one
+# (preemptible fleets shrink and grow back; ROADMAP item 5). The physical
+# layout dies with the old mesh, so what gets persisted is the LOGICAL
+# placement — a JSON-able PartitionSpec tree over flattened tree paths plus
+# the mesh's shape/axis names — and restore re-resolves it against whatever
+# mesh the new incarnation built. The helpers below are that vocabulary
+# (the match_partition_rules/shard-fn pattern); training/checkpoint.py is
+# the consumer.
+
+
+def _tree_paths_and_leaves(tree: Any, sep: str = "/"):
+    """[(path_string, leaf)] over a pytree, flax-style ``a/b/c`` paths."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        parts = []
+        for entry in path:
+            if hasattr(entry, "key"):
+                parts.append(str(entry.key))
+            elif hasattr(entry, "idx"):
+                parts.append(str(entry.idx))
+            elif hasattr(entry, "name"):
+                parts.append(str(entry.name))
+            else:
+                parts.append(str(entry))
+        out.append((sep.join(parts), leaf))
+    return out
+
+
+def _spec_to_json(spec: P | None) -> list | None:
+    """PartitionSpec -> JSON (list per dim: axis name, list of names, or
+    None). None means 'no recorded spec' (a non-jax leaf)."""
+    if spec is None:
+        return None
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append(list(entry))
+        else:
+            out.append(entry)
+    return out
+
+
+def _spec_from_json(entry: list | None) -> P:
+    if not entry:
+        return P()
+    return P(*[tuple(e) if isinstance(e, list) else e for e in entry])
+
+
+def mesh_topology(mesh: Mesh | None) -> dict:
+    """JSON-able identity of a mesh: what restore compares against the
+    ambient world to decide whether re-sharding is needed."""
+    if mesh is None:
+        return {"device_count": jax.device_count(), "shape": None,
+                "axis_names": None,
+                "process_count": jax.process_count()}
+    return {"device_count": int(mesh.size),
+            "shape": [int(s) for s in mesh.devices.shape],
+            "axis_names": list(mesh.axis_names),
+            "process_count": jax.process_count()}
+
+
+def tree_partition_specs(tree: Any, sep: str = "/") -> dict:
+    """Record the logical placement of a (device) pytree: flattened path ->
+    JSON spec, plus the mesh identity. Leaves without a ``NamedSharding``
+    (host numpy, scalars) record ``None`` (placement decided at restore).
+    """
+    specs: dict[str, list | None] = {}
+    mesh = None
+    for path, leaf in _tree_paths_and_leaves(tree, sep):
+        spec = None
+        sharding = getattr(leaf, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            spec = sharding.spec
+            if mesh is None:
+                mesh = sharding.mesh
+        specs[path] = _spec_to_json(spec)
+    return {"specs": specs, "mesh": mesh_topology(mesh), "version": 1}
+
+
+def match_partition_rules(rules: Sequence[tuple[str, P]], tree: Any,
+                          sep: str = "/") -> Any:
+    """Pytree of PartitionSpecs from regex rules over flattened paths.
+
+    The classic spec-resolver pattern: ``rules`` is an ordered list of
+    ``(regex, PartitionSpec)``; the first regex that ``re.search``-matches
+    a leaf's ``a/b/c`` path decides its spec. Scalars (and 1-element
+    arrays) are never partitioned regardless of rules. A path no rule
+    matches raises — silent replication of a tensor meant to be sharded
+    is how elastic restores corrupt layouts quietly.
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def resolve(path: str, leaf: Any) -> P:
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        for pat, spec in compiled:
+            if pat.search(path) is not None:
+                return spec
+        raise ValueError(f"no partition rule matches {path!r}")
+
+    paths = _tree_paths_and_leaves(tree, sep)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    resolved = [resolve(path, leaf) for path, leaf in paths]
+    assert len(resolved) == len(leaves)
+    return jax.tree_util.tree_unflatten(treedef, resolved)
+
+
+def resolve_restore_specs(recorded: dict, mesh: Mesh, tree: Any,
+                          sep: str = "/") -> Any:
+    """Re-resolve a recorded spec tree against a NEW mesh.
+
+    For every leaf: take the recorded logical spec (by flattened path),
+    drop axis names the new mesh does not have, and drop any sharded dim
+    the leaf's shape no longer divides by the new axis size — the leaf
+    then falls back toward replication one axis at a time instead of
+    failing the whole restore. Unrecorded paths (grown params, pre-elastic
+    checkpoints) resolve to replicated. Returns a PartitionSpec pytree
+    shaped like ``tree``.
+    """
+    specs = recorded.get("specs", {}) if recorded else {}
+
+    def resolve(path: str, leaf: Any) -> P:
+        entry = specs.get(path)
+        if not entry:
+            return P()
+        shape = getattr(leaf, "shape", ())
+        out = []
+        for dim, names in enumerate(_spec_from_json(entry)):
+            if names is None:
+                out.append(None)
+                continue
+            group = names if isinstance(names, tuple) else (names,)
+            kept = tuple(n for n in group if n in mesh.shape)
+            size = int(np.prod([mesh.shape[n] for n in kept])) \
+                if kept else 1
+            if not kept or dim >= len(shape) or shape[dim] % size:
+                out.append(None)
+                continue
+            out.append(kept if len(kept) > 1 else kept[0])
+        return P(*out)
+
+    paths = _tree_paths_and_leaves(tree, sep)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    resolved = [resolve(path, leaf) for path, leaf in paths]
+    assert len(resolved) == len(leaves)
+    return jax.tree_util.tree_unflatten(treedef, resolved)
+
+
+def place_with_specs(tree: Any, mesh: Mesh, specs: Any):
+    """Commit every leaf onto ``mesh`` under its spec (the shard-fn half
+    of the pattern: host values in, mesh-committed global arrays out)."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree, specs)

@@ -51,6 +51,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops.attention_pallas import resolve_attention_scale as _resolve_scale
 from ..ops.attention_pallas import _flat, _unflat
 from ..ops.ntxent_pallas import _exp0, _log_l
+from .mesh import axis_index as _axis_index_compat
 from .mesh import pcast as _pcast_compat
 from .mesh import shard_map as _shard_map_compat
 
@@ -171,7 +172,10 @@ def _hop_perm(axis, num_devices):
 
 
 def _positions(axis, l_loc):
-    d = jax.lax.axis_index(axis)
+    # mesh.axis_index, not the raw lax op: these custom-VJP ring bodies
+    # are exactly the old-jax partition-id-under-GSPMD lowering seam the
+    # shim exists for (see parallel/mesh.py).
+    d = _axis_index_compat(axis)
     return d * l_loc + jnp.arange(l_loc)
 
 
@@ -277,12 +281,12 @@ def _ring_flash_fwd(q, k, v, axis, num_devices, causal, sc,
     b, l_loc, h, d = q.shape
     bh = b * h
     perm = _hop_perm(axis, num_devices)
-    q_off = jax.lax.axis_index(axis) * l_loc
+    q_off = _axis_index_compat(axis) * l_loc
     qf = _flat(q)
 
     init = (
         _flat(k), _flat(v),
-        (jax.lax.axis_index(axis) * l_loc).reshape(1),
+        (_axis_index_compat(axis) * l_loc).reshape(1),
         _varying(jnp.full((bh, l_loc), _NEG_INF, jnp.float32), axis),
         _varying(jnp.zeros((bh, l_loc), jnp.float32), axis),
         _varying(jnp.zeros((bh, l_loc, d), jnp.float32), axis),
@@ -314,14 +318,14 @@ def _ring_flash_bwd(axis, num_devices, causal, sc, bq, bk, res, g):
     b, l_loc, h, d = q.shape
     bh = b * h
     perm = _hop_perm(axis, num_devices)
-    q_off = jax.lax.axis_index(axis) * l_loc
+    q_off = _axis_index_compat(axis) * l_loc
     qf, dof, outf = _flat(q), _flat(g), _flat(out)
     delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
                     axis=-1)
 
     init = (
         _flat(k), _flat(v),
-        (jax.lax.axis_index(axis) * l_loc).reshape(1),
+        (_axis_index_compat(axis) * l_loc).reshape(1),
         _varying(jnp.zeros((bh, l_loc, d), jnp.float32), axis),  # dk
         _varying(jnp.zeros((bh, l_loc, d), jnp.float32), axis),  # dv
         _varying(jnp.zeros((bh, l_loc, d), jnp.float32), axis),  # dq home

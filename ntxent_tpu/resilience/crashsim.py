@@ -43,12 +43,14 @@ import subprocess
 import sys
 import time
 import zlib
+from collections.abc import Sequence
 from pathlib import Path
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["CrashAudit", "CrashAuditError", "AuditReport",
-           "checkpoint_fingerprint", "scan_checkpoint_dir"]
+           "checkpoint_fingerprint", "scan_checkpoint_dir",
+           "losses_from_jsonl", "restore_reshards_from_jsonl"]
 
 _TMP_PREFIX = ".tmp-"
 _STATE_FILE = "state.msgpack"
@@ -140,6 +142,43 @@ def scan_checkpoint_dir(ckpt_dir: Path) -> dict:
     return {"torn": torn, "tmp": tmp}
 
 
+def _read_events(path: Path, event: str) -> list[dict]:
+    """obs.events.read_events (tolerant JSONL parse — a killed
+    incarnation may die mid-write of its last line), loaded BY FILE PATH
+    so this harness stays JAX-free (the bench.py idiom: the package
+    __init__ would pull the full framework). Missing file -> []."""
+    import importlib.util
+
+    events_path = Path(__file__).resolve().parent.parent / "obs" / \
+        "events.py"
+    spec = importlib.util.spec_from_file_location("_ntxent_obs_events",
+                                                  events_path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    try:
+        return module.read_events(str(path), event)
+    except OSError:
+        return []
+
+
+def losses_from_jsonl(path: Path) -> dict[int, float]:
+    """{global step: loss} from an obs JSONL event log (``step`` events
+    carry GLOBAL step numbers, so curves from resumed incarnations merge
+    by key)."""
+    return {int(rec["step"]): float(rec["loss"])
+            for rec in _read_events(path, "step")
+            if "step" in rec and "loss" in rec}
+
+
+def restore_reshards_from_jsonl(path: Path) -> list[str]:
+    """The ``reshard`` field of every checkpoint-restore event in a JSONL
+    log — the structured proof a topology-changed incarnation re-placed
+    state instead of crashing."""
+    return [str(rec.get("reshard"))
+            for rec in _read_events(path, "checkpoint")
+            if rec.get("action") == "restore"]
+
+
 @dataclasses.dataclass
 class AuditReport:
     kills: int = 0
@@ -181,7 +220,8 @@ class CrashAudit:
         self.rng = random.Random(seed)
 
     # -- one training incarnation ----------------------------------------
-    def _cmd(self, ckpt_dir: Path, chaos: str | None) -> list[str]:
+    def _cmd(self, ckpt_dir: Path, chaos: str | None,
+             log_jsonl: Path | None = None) -> list[str]:
         cmd = [sys.executable, "-m", "ntxent_tpu.cli",
                "--platform", "cpu",
                "--dataset", "synthetic",
@@ -200,19 +240,29 @@ class CrashAudit:
                "--log-every", "1"]
         if chaos:
             cmd += ["--chaos", chaos]
+        if log_jsonl is not None:
+            cmd += ["--log-jsonl", str(log_jsonl)]
         return cmd
 
     def _run(self, ckpt_dir: Path, chaos: str | None = None,
-             slow_save: bool = False) -> tuple[int, str]:
+             slow_save: bool = False,
+             device_count: int | None = None,
+             log_jsonl: Path | None = None) -> tuple[int, str]:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("XLA_FLAGS", None)
+        if device_count is not None and device_count > 1:
+            # The subprocess boundary IS the elastic boundary: simulated
+            # device count is fixed at backend init, so shrink/grow
+            # across incarnations means a different XLA_FLAGS per launch.
+            env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                                f"{device_count}")
         if slow_save:
             env["NTXENT_CKPT_SLOW_MS"] = str(self.slow_save_ms)
         else:
             env.pop("NTXENT_CKPT_SLOW_MS", None)
         proc = subprocess.run(
-            self._cmd(ckpt_dir, chaos), env=env,
+            self._cmd(ckpt_dir, chaos, log_jsonl=log_jsonl), env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             timeout=self.timeout_s)
         return proc.returncode, proc.stdout or ""
@@ -320,7 +370,31 @@ class CrashAudit:
         # Survivor: this lineage's dir runs to completion for its final
         # bit-exactness verdict.
         self._finish_and_verify(crash_dir, report, ref_fp())
+        self._write_summary(f"summary_{name}.json", {
+            "lineage": name, "mode": "kill",
+            "kills": report.kills,
+            "midsave_kills": report.midsave_kills,
+            "restarts": report.kills + report.completed_early,
+            "device_counts": [1] * (report.kills
+                                    + report.completed_early + 1),
+            "rounds": report.rounds,
+            "final_step": report.final_step,
+            "crc_exact": report.bit_exact,
+            "verdict": "PASS:bitexact" if report.bit_exact
+            else "FAIL:crc_mismatch",
+        })
         return report
+
+    def _write_summary(self, name: str, payload: dict) -> Path:
+        """Atomically write a structured per-lineage JSON artifact —
+        what crash_audit.sh / elastic_smoke.sh assert on instead of
+        grepping logs."""
+        path = self.workdir / name
+        tmp = path.with_suffix(".tmp")
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        return path
 
     def audit(self, kills: int = 5, midsave: int = 1,
               lineages: int = 2) -> AuditReport:
@@ -349,7 +423,14 @@ class CrashAudit:
 
         report = AuditReport()
         report.reference_fingerprint = reference_fp
-        for sub in reports:
+        lineage_summaries = []
+        for i, sub in enumerate(reports):
+            lineage_summaries.append({
+                "lineage": f"crash{i}", "kills": sub.kills,
+                "midsave_kills": sub.midsave_kills,
+                "restarts": sub.kills + sub.completed_early,
+                "final_step": sub.final_step,
+                "crc_exact": sub.bit_exact})
             report.kills += sub.kills
             report.midsave_kills += sub.midsave_kills
             report.completed_early += sub.completed_early
@@ -363,18 +444,184 @@ class CrashAudit:
                 f"only {report.midsave_kills}/{midsave} kills landed "
                 "mid-save (no staging dir observed at death)")
         report.elapsed_s = round(time.monotonic() - t0, 2)
+        self._write_summary("audit_summary.json", {
+            "mode": "kill",
+            "kills": report.kills,
+            "midsave_kills": report.midsave_kills,
+            "restarts": report.kills + report.completed_early,
+            "lineages": lineage_summaries,
+            "final_step": report.final_step,
+            "crc_exact": report.bit_exact,
+            "reference_fingerprint": report.reference_fingerprint,
+            "survivor_fingerprint": report.survivor_fingerprint,
+            "elapsed_s": report.elapsed_s,
+            "verdict": "PASS:bitexact" if report.bit_exact
+            else "FAIL:crc_mismatch",
+        })
         return report
+
+    # -- the elastic audit -------------------------------------------------
+    def elastic(self, schedule: Sequence[int] = (8, 4, 8),
+                rtol: float = 0.05, atol: float = 0.02) -> dict:
+        """Shrink/grow chaos lineage: ``kill@K`` then restore across a
+        changing simulated-device schedule, loss-curve continuity
+        asserted against an uninterrupted reference on the full mesh.
+
+        One reference run executes the whole job on ``schedule[0]``
+        devices; the elastic lineage then runs one incarnation per
+        schedule entry — every non-final incarnation is SIGKILLed at a
+        seeded-random batch ordinal, and each successor launches with a
+        DIFFERENT ``--xla_force_host_platform_device_count`` (the
+        subprocess boundary is where real fleets change size), restoring
+        the previous world's checkpoint onto its own mesh. Asserts after
+        every death: no torn steps; across the lineage: at least one
+        restore re-sharded (``reshard="gather_replace"`` in the JSONL
+        restore events — the topology sidecar worked), the final step was
+        reached, and every step's loss matches the reference within
+        ``rtol``/``atol`` (the global batch is device-count-invariant;
+        only reduction order may differ). Bit-exactness is REPORTED, not
+        asserted — psum order across different mesh sizes is allowed to
+        move float ulps, which is exactly why the assert is on the loss
+        curve. Writes ``elastic_summary.json`` and returns it.
+        """
+        t0 = time.monotonic()
+        rng = random.Random(self.seed * 7919 + 1)
+        ref_dir = self.workdir / "elastic_ref"
+        ref_jsonl = self.workdir / "elastic_ref.jsonl"
+        rc, out = self._run(ref_dir, device_count=schedule[0],
+                            log_jsonl=ref_jsonl)
+        if rc != 0:
+            raise CrashAuditError(
+                f"elastic reference run failed rc={rc}:\n{out[-2000:]}")
+        ref_losses = losses_from_jsonl(ref_jsonl)
+        if len(ref_losses) < self.steps:
+            raise CrashAuditError(
+                f"elastic reference logged {len(ref_losses)} step "
+                f"events, wanted {self.steps}")
+
+        crash_dir = self.workdir / "elastic0"
+        incarnations: list[dict] = []
+        kills = 0
+        merged_losses: dict[int, float] = {}
+        for i, devices in enumerate(schedule):
+            final = i == len(schedule) - 1
+            latest = max(_step_dirs(crash_dir), default=0)
+            jsonl = self.workdir / f"elastic0_run{i}.jsonl"
+            chaos = None
+            if not final:
+                remaining = self.steps - latest
+                if remaining <= 2:
+                    raise CrashAuditError(
+                        f"elastic incarnation {i} has only {remaining} "
+                        "steps left to kill inside; raise --steps")
+                # Leave >= 1 step for the next incarnation to TRAIN on
+                # its changed mesh (a restore-only hop would still
+                # re-shard, but prove less).
+                chaos = f"kill@{rng.randint(2, max(2, remaining - 2))}"
+            rc, out = self._run(crash_dir, chaos=chaos,
+                                device_count=devices, log_jsonl=jsonl)
+            scan = scan_checkpoint_dir(crash_dir)
+            if scan["torn"]:
+                raise CrashAuditError(
+                    f"elastic incarnation {i} ({devices} devices): torn "
+                    f"checkpoint step(s): {scan['torn']}")
+            if chaos is None:
+                if rc != 0:
+                    raise CrashAuditError(
+                        f"elastic survivor failed rc={rc}:\n{out[-2000:]}")
+            elif rc in (-signal.SIGKILL, 128 + signal.SIGKILL):
+                kills += 1
+            elif rc != 0:
+                raise CrashAuditError(
+                    f"elastic incarnation {i}: expected SIGKILL death or "
+                    f"completion, got rc={rc}:\n{out[-2000:]}")
+            merged_losses.update(losses_from_jsonl(jsonl))
+            incarnations.append({
+                "devices": int(devices), "chaos": chaos, "rc": rc,
+                "resumed_from": latest,
+                "reshards": restore_reshards_from_jsonl(jsonl)})
+            logger.info("elastic incarnation %d: devices=%d chaos=%s "
+                        "rc=%s resumed_from=%d", i, devices, chaos, rc,
+                        latest)
+
+        final_step = max(_step_dirs(crash_dir), default=0)
+        if final_step != self.steps:
+            raise CrashAuditError(
+                f"elastic lineage finished at step {final_step}, wanted "
+                f"{self.steps}")
+        reshards = [r for inc in incarnations[1:] for r in inc["reshards"]]
+        if "gather_replace" not in reshards:
+            raise CrashAuditError(
+                "no topology re-shard observed across the device "
+                f"schedule {tuple(schedule)} (restore events: {reshards})")
+        compared = sorted(set(merged_losses) & set(ref_losses))
+        if len(compared) < self.steps // 2:
+            raise CrashAuditError(
+                f"only {len(compared)} comparable steps between elastic "
+                "and reference loss curves")
+        worst_step, worst_abs, worst_rel, continuity_ok = None, 0.0, 0.0, True
+        for s in compared:
+            diff = abs(merged_losses[s] - ref_losses[s])
+            rel = diff / max(1e-9, abs(ref_losses[s]))
+            if diff > worst_abs:
+                worst_step, worst_abs, worst_rel = s, diff, rel
+            if diff > atol + rtol * abs(ref_losses[s]):
+                continuity_ok = False
+        try:
+            ref_fp = checkpoint_fingerprint(ref_dir, self.steps)
+            got_fp = checkpoint_fingerprint(crash_dir, self.steps)
+            crc_exact = ref_fp == got_fp
+        except CrashAuditError:
+            ref_fp, got_fp, crc_exact = {}, {}, False
+        summary = {
+            "lineage": "elastic0", "mode": "elastic",
+            "device_schedule": [int(d) for d in schedule],
+            "kills": kills,
+            "restarts": len(incarnations) - 1,
+            "device_counts": [inc["devices"] for inc in incarnations],
+            "incarnations": incarnations,
+            "final_step": final_step,
+            "crc_exact": crc_exact,
+            "reference_fingerprint": ref_fp,
+            "survivor_fingerprint": got_fp,
+            "loss_continuity": {
+                "steps_compared": len(compared),
+                "worst_step": worst_step,
+                "max_abs_diff": round(worst_abs, 6),
+                "rel_at_worst": round(worst_rel, 6),
+                "rtol": rtol, "atol": atol,
+                "ok": continuity_ok,
+            },
+            "elapsed_s": round(time.monotonic() - t0, 2),
+            "verdict": "PASS:loss_continuity" if continuity_ok
+            else "FAIL:loss_divergence",
+        }
+        self._write_summary("elastic_summary.json", summary)
+        if not continuity_ok:
+            raise CrashAuditError(
+                "elastic loss curve diverged from the uninterrupted "
+                f"reference: step {worst_step} differs by {worst_abs} "
+                f"(rel {worst_rel:.4f}); see elastic_summary.json")
+        return summary
 
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         description="Crash-replay audit: kill a real training run at "
                     "randomized points (incl. mid-save) and prove "
-                    "bit-exact resume.")
+                    "bit-exact resume — or, with --mode elastic, kill "
+                    "across a shrink/grow device schedule and prove "
+                    "loss-curve continuity through re-sharded restores.")
     parser.add_argument("--workdir", required=True)
+    parser.add_argument("--mode", default="kill",
+                        choices=["kill", "elastic"])
     parser.add_argument("--steps", type=int, default=8)
     parser.add_argument("--kills", type=int, default=5)
     parser.add_argument("--midsave", type=int, default=1)
+    parser.add_argument("--schedule", default="8,4,8",
+                        help="elastic mode: comma list of simulated "
+                             "device counts, one incarnation each; every "
+                             "non-final one is SIGKILLed mid-run")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--timeout-s", type=float, default=180.0)
     args = parser.parse_args(argv)
@@ -383,6 +630,18 @@ def main(argv: list[str] | None = None) -> int:
     audit = CrashAudit(args.workdir, steps=args.steps, seed=args.seed,
                        timeout_s=args.timeout_s)
     try:
+        if args.mode == "elastic":
+            summary = audit.elastic(
+                schedule=[int(s) for s in args.schedule.split(",")])
+            print(json.dumps(summary, indent=2, sort_keys=True))
+            print(f"elastic audit: OK — schedule "
+                  f"{summary['device_schedule']}, {summary['kills']} "
+                  f"kills, loss continuity over "
+                  f"{summary['loss_continuity']['steps_compared']} steps "
+                  f"(max abs diff "
+                  f"{summary['loss_continuity']['max_abs_diff']}) in "
+                  f"{summary['elapsed_s']}s")
+            return 0
         report = audit.audit(kills=args.kills, midsave=args.midsave)
     except CrashAuditError as e:
         print(f"CRASH AUDIT FAILED: {e}", file=sys.stderr)

@@ -30,6 +30,19 @@ schedule randomization):
                    ``CheckpointManager(fault_hook=...)``) → exercises the
                    skip-a-checkpoint contract (failure counter + ok=false
                    event, run continues) on both sync and async writers;
+* ``shrink@k``   — raise ``TopologyChange("shrink")`` while serving the
+                   k-th batch: the world got smaller (a preemptible pool
+                   lost devices). The supervisor's topology hook rebuilds
+                   the mesh over FEWER devices before the next attempt
+                   and restore re-shards the checkpoint onto it
+                   (training/checkpoint.py topology sidecar); crashsim's
+                   elastic audit drives the same transition across a
+                   subprocess boundary by changing the simulated device
+                   count (``XLA_FLAGS``) between incarnations;
+* ``grow@k``     — ``TopologyChange("grow")``: the pool came back — the
+                   next attempt rebuilds the mesh over the full device
+                   set and restore re-shards the shrunken checkpoint up
+                   onto it;
 * ``truncate@a`` — after attempt number a ends, truncate the newest
                    checkpoint's largest file → exercises checksum
                    verification and newest-VALID fallback (checkpoint.py).
@@ -51,15 +64,27 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["ChaosError", "FaultPlan", "FaultInjector",
+__all__ = ["ChaosError", "TopologyChange", "FaultPlan", "FaultInjector",
            "truncate_checkpoint_file"]
 
 _KINDS = ("nan", "sigterm", "kill", "crash", "fetch", "diskfull",
-          "truncate")
+          "shrink", "grow", "truncate")
 
 
 class ChaosError(RuntimeError):
     """An injected hard failure (the ``crash@k`` primitive)."""
+
+
+class TopologyChange(RuntimeError):
+    """The world changed under the run (``shrink@k`` / ``grow@k``): the
+    attempt must die and the next one rebuild its mesh over a different
+    device set. Raised out of the batch path; the Supervisor's
+    ``topology_hook`` is the handler that actually reshapes the world."""
+
+    def __init__(self, action: str, batch: int):
+        super().__init__(f"chaos: injected {action} at batch {batch}")
+        self.action = action
+        self.batch = batch
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,12 +97,14 @@ class FaultPlan:
     crash_batches: tuple[int, ...] = ()
     fetch_calls: tuple[int, ...] = ()
     diskfull_writes: tuple[int, ...] = ()
+    shrink_batches: tuple[int, ...] = ()
+    grow_batches: tuple[int, ...] = ()
     truncate_attempts: tuple[int, ...] = ()
     seed: int = 0
 
     @classmethod
     def parse(cls, spec: str, seed: int = 0) -> "FaultPlan":
-        """Parse ``"nan@3,sigterm@6,kill@4,diskfull@2,truncate@1"``
+        """Parse ``"nan@3,sigterm@6,kill@4,shrink@5,grow@9,truncate@1"``
         (the --chaos syntax)."""
         buckets: dict[str, list[int]] = {k: [] for k in _KINDS}
         for item in filter(None, (s.strip() for s in spec.split(","))):
@@ -99,6 +126,8 @@ class FaultPlan:
                    crash_batches=tuple(buckets["crash"]),
                    fetch_calls=tuple(buckets["fetch"]),
                    diskfull_writes=tuple(buckets["diskfull"]),
+                   shrink_batches=tuple(buckets["shrink"]),
+                   grow_batches=tuple(buckets["grow"]),
                    truncate_attempts=tuple(buckets["truncate"]),
                    seed=seed)
 
@@ -106,6 +135,7 @@ class FaultPlan:
         return not (self.nan_batches or self.sigterm_batches
                     or self.kill_batches or self.crash_batches
                     or self.fetch_calls or self.diskfull_writes
+                    or self.shrink_batches or self.grow_batches
                     or self.truncate_attempts)
 
 
@@ -205,6 +235,14 @@ class FaultInjector:
         if n in self.plan.crash_batches:
             self.fired.append(f"crash@{n}")
             raise ChaosError(f"chaos: injected crash at batch {n}")
+        if n in self.plan.shrink_batches:
+            logger.warning("chaos: topology shrink at batch %d", n)
+            self.fired.append(f"shrink@{n}")
+            raise TopologyChange("shrink", n)
+        if n in self.plan.grow_batches:
+            logger.warning("chaos: topology grow at batch %d", n)
+            self.fired.append(f"grow@{n}")
+            raise TopologyChange("grow", n)
         return batch
 
     # -- fetch-path faults (wrap a random-access source) ------------------

@@ -40,6 +40,7 @@ from ..obs import events as obs_events
 from ..obs.registry import default_registry
 from ..training.preemption import PreemptionGuard
 from ..utils.watchdog import StallWatchdog
+from .faults import TopologyChange
 from .retry import RetryPolicy
 
 logger = logging.getLogger(__name__)
@@ -49,6 +50,10 @@ _RESTARTS = default_registry().counter(
     "in-process restarts after a detected fault")
 _ATTEMPTS = default_registry().counter(
     "supervisor_attempts_total", "supervised attempts started")
+_TOPOLOGY_RESTARTS = default_registry().counter(
+    "supervisor_topology_restarts_total",
+    "restarts that rebuilt the mesh over a changed device set "
+    "(shrink@K / grow@K)")
 
 __all__ = ["AttemptRecord", "Supervisor", "SupervisorResult"]
 
@@ -63,6 +68,10 @@ class AttemptRecord:
     preempted: bool
     stalled: bool
     error: str | None
+    # The topology action ("shrink"/"grow") that ended this attempt, None
+    # for every other exit: elastic restarts are visible in the records,
+    # not just in the mesh the next attempt happens to build.
+    topology: str | None = None
 
 
 @dataclasses.dataclass
@@ -92,6 +101,19 @@ class Supervisor:
     StallWatchdog per attempt whose escalation stops the attempt cleanly.
     ``injector`` (faults.FaultInjector) gets a between-attempts hook —
     that is where the chaos plan's checkpoint-truncation fault fires.
+
+    ``topology_hook(action)`` is the elastic-restart seam: when an
+    attempt dies with ``faults.TopologyChange`` (the ``shrink@K`` /
+    ``grow@K`` plan actions — or a real resource manager surfacing a
+    pool change the same way), the hook runs BEFORE the next attempt and
+    must rebuild the world for it — mesh over the new device set, train
+    step compiled for that mesh, data pipeline bound to its sharding
+    (``ntxent_tpu.cli`` wires exactly that for the data-parallel branch).
+    The next attempt then restores the newest valid checkpoint onto the
+    rebuilt mesh; the checkpoint layer's topology sidecar makes that a
+    re-shard, not a crash. Without a hook, a topology fault restarts
+    onto the unchanged world (logged — the fault then only proved the
+    restart path).
     """
 
     def __init__(self, run_attempt: Callable, num_steps: int,
@@ -99,6 +121,7 @@ class Supervisor:
                  backoff: RetryPolicy | None = None,
                  stall_timeout_s: float | None = None,
                  injector=None,
+                 topology_hook: Callable[[str], None] | None = None,
                  sleep: Callable[[float], None] = time.sleep):
         if max_restarts < 0:
             raise ValueError(f"max_restarts must be >= 0, "
@@ -112,6 +135,7 @@ class Supervisor:
             multiplier=2.0, max_delay_s=60.0, jitter=0.1)
         self.stall_timeout_s = stall_timeout_s
         self.injector = injector
+        self.topology_hook = topology_hook
         self.sleep = sleep
         self._guard: PreemptionGuard | None = None
 
@@ -142,6 +166,7 @@ class Supervisor:
             obs_events.set_attempt(attempt)
             error: str | None = None
             stalled = False
+            topology: str | None = None
             attempt_state = None
             if watchdog is not None:
                 watchdog.reset()
@@ -153,6 +178,16 @@ class Supervisor:
                             attempt, stop_fn=guard.requested,
                             watchdog=watchdog)
                         histories.append(history)
+                    except TopologyChange as e:
+                        # Not a crash: the world changed shape. The next
+                        # attempt must run on a rebuilt mesh (hook below).
+                        topology = e.action
+                        error = f"TopologyChange: {e}"
+                        logger.warning(
+                            "supervisor: attempt %d/%d ended by a "
+                            "topology %s — rebuilding the mesh before "
+                            "restart", attempt + 1, total_attempts,
+                            e.action)
                     except Exception as e:  # bounded by max_restarts
                         error = f"{type(e).__name__}: {e}"
                         logger.exception(
@@ -169,7 +204,8 @@ class Supervisor:
                 state = attempt_state
             records.append(AttemptRecord(
                 attempt=attempt, end_step=end_step,
-                preempted=guard.preempted, stalled=stalled, error=error))
+                preempted=guard.preempted, stalled=stalled, error=error,
+                topology=topology))
             if error is None and not guard.preempted \
                     and end_step is not None and end_step >= self.num_steps:
                 logger.info("supervisor: run complete at step %d after "
@@ -177,6 +213,21 @@ class Supervisor:
                 return SupervisorResult(True, state, histories, records)
             if attempt + 1 >= total_attempts:
                 break
+            if topology is not None:
+                if self.topology_hook is not None:
+                    try:
+                        self.topology_hook(topology)
+                        _TOPOLOGY_RESTARTS.inc()
+                    except Exception:
+                        # A world that failed to rebuild is still a world:
+                        # restart on the old one rather than giving up.
+                        logger.exception(
+                            "supervisor: topology hook failed for %r — "
+                            "restarting on the unchanged mesh", topology)
+                else:
+                    logger.warning(
+                        "supervisor: topology %s with no topology_hook — "
+                        "restarting on the unchanged mesh", topology)
             if self.injector is not None:
                 self.injector.between_attempts(self.checkpoint_dir)
             delay = self.backoff.delay_for(attempt + 1)
@@ -184,7 +235,7 @@ class Supervisor:
             obs_events.emit(
                 "restart", attempt=attempt, end_step=end_step,
                 preempted=bool(guard.preempted), stalled=bool(stalled),
-                error=error, delay_s=round(delay, 4))
+                error=error, topology=topology, delay_s=round(delay, 4))
             logger.warning(
                 "supervisor: attempt %d/%d ended at step %s "
                 "(preempted=%s, stalled=%s, error=%s) — restarting from "

@@ -35,6 +35,17 @@ auditable here:
   SIGTERM/preemption path (PreemptionGuard → stop_fn → fit's final
   save), so a preempted run's last step is durable before exit even
   when normal saves are async.
+* **Topology portability (elastic restore)** — every save records its
+  logical placement in a ``topology.json`` sidecar (per-leaf
+  PartitionSpec tree over flattened state-dict paths + mesh shape/axis
+  names/device count; parallel/mesh.py owns the vocabulary). Restore
+  compares it against the ambient mesh: on a mismatch the host-gathered
+  values are re-placed under the NEW mesh's NamedShardings
+  (``reshard="gather_replace"`` on the restore event,
+  ``checkpoint_reshard_total``/``_ms`` in the registry) — a checkpoint
+  taken on N devices restores onto M, the restart mode preemptible
+  fleets actually exercise. Pre-elastic checkpoints (no sidecar) restore
+  exactly as before, with a warning.
 
 A ``RetryPolicy`` (resilience/retry.py) can wrap the physical write, and
 ``save`` reports filesystem failures by returning False (plus a
@@ -77,6 +88,12 @@ from flax import serialization as flax_ser
 
 from ..obs import events as obs_events
 from ..obs.registry import default_registry
+from ..parallel.mesh import (
+    mesh_topology,
+    place_with_specs,
+    resolve_restore_specs,
+    tree_partition_specs,
+)
 from ..resilience.retry import RetryBudgetExceeded
 
 logger = logging.getLogger(__name__)
@@ -123,12 +140,22 @@ _MIRROR_FAILURES = default_registry().counter(
 _MIRROR_RESTORES = default_registry().counter(
     "checkpoint_mirror_restores_total",
     "restores served from the mirror after primary corruption/loss")
+# ISSUE 6 series: elastic restore across topology changes.
+_RESHARDS = default_registry().counter(
+    "checkpoint_reshard_total",
+    "restores that re-placed state onto a mesh differing from the "
+    "recorded save-time topology")
+_RESHARD_MS = default_registry().histogram(
+    "checkpoint_reshard_ms",
+    "wall time of the host-gather -> re-place step on topology-"
+    "mismatched restores")
 
 _MANIFEST_NAME = "manifests.json"
 _TMP_PREFIX = ".tmp-"
 _STATE_FILE = "state.msgpack"
 _DATA_STATE_FILE = "data_state.json"
 _META_FILE = "meta.json"
+_TOPOLOGY_FILE = "topology.json"
 
 
 def _crc32_file(path: Path, chunk: int = 1 << 20) -> int:
@@ -197,9 +224,13 @@ def _write_delay_s() -> float:
 @dataclasses.dataclass(frozen=True)
 class _Snapshot:
     """A host-side copy of a train state (pure numpy state dict), ready
-    for background serialization with no device or donation hazards."""
+    for background serialization with no device or donation hazards.
+    ``topology`` is the save-time logical placement (PartitionSpec tree +
+    mesh identity, parallel/mesh.py) that makes the checkpoint portable
+    across mesh changes."""
 
     state_dict: dict
+    topology: dict | None = None
 
 
 def snapshot_state(state: Any) -> _Snapshot:
@@ -213,10 +244,18 @@ def snapshot_state(state: Any) -> _Snapshot:
     the background writer — serializing a later step's params under this
     step's label (caught by the crash audit's CRC comparison; np.array's
     forced copy is the fix).
+
+    The snapshot also records the state's LOGICAL placement (per-leaf
+    PartitionSpecs over flattened state-dict paths, plus the mesh's
+    shape/axis names/device count): the host copy is by construction a
+    full gather, so placement is the only thing a topology change would
+    otherwise lose. Restore compares it against the ambient mesh and
+    re-places under the new mesh's NamedShardings when they differ.
     """
     if isinstance(state, _Snapshot):
         return state
     state_dict = flax_ser.to_state_dict(state)
+    topology = tree_partition_specs(state_dict)
 
     def to_host_copy(leaf):
         if isinstance(leaf, jax.Array):
@@ -231,7 +270,7 @@ def snapshot_state(state: Any) -> _Snapshot:
             return leaf.copy()
         return leaf
 
-    return _Snapshot(jax.tree.map(to_host_copy, state_dict))
+    return _Snapshot(jax.tree.map(to_host_copy, state_dict), topology)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -365,6 +404,11 @@ class _NativeBackend:
                 time.sleep(delay)
             if data_state is not None:
                 write(_DATA_STATE_FILE, json.dumps(data_state).encode())
+            if snapshot.topology is not None:
+                # The elastic-restore sidecar: logical PartitionSpec tree
+                # + mesh identity, CRC'd like every other payload file.
+                write(_TOPOLOGY_FILE,
+                      json.dumps(snapshot.topology).encode())
             write(_META_FILE,
                   json.dumps({"step": step, "format": 1}).encode())
             for p in tmp.iterdir():
@@ -422,6 +466,64 @@ def _place_like(template: Any, restored: Any) -> Any:
         return v
 
     return jax.tree.map(place, template, restored)
+
+
+def _template_mesh(template: Any):
+    """The mesh the template's committed leaves live on (None when no
+    leaf carries a NamedSharding — a fresh single-device template)."""
+    from jax.sharding import NamedSharding
+
+    for leaf in jax.tree_util.tree_leaves(template):
+        sharding = getattr(leaf, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            return sharding.mesh
+    return None
+
+
+def _topology_differs(recorded: dict | None, ambient: dict) -> bool:
+    """Did the world change between save and restore? Device count is
+    the primary signal; mesh shape/axis names are compared only when
+    BOTH sides actually had a mesh — a side with no NamedSharding leaves
+    records shape=None, and treating None != [8] as a topology change
+    would stamp every uncommitted-template restore on an unchanged host
+    (eval/serve paths) as a spurious ``gather_replace``, polluting the
+    very counter the elastic audit treats as proof of a real re-shard."""
+    if not recorded:
+        return False
+    if recorded.get("device_count") != ambient.get("device_count"):
+        return True
+    if recorded.get("shape") is None or ambient.get("shape") is None:
+        return False
+    return recorded.get("shape") != ambient.get("shape") \
+        or recorded.get("axis_names") != ambient.get("axis_names")
+
+
+def _place_elastic(template: Any, restored: Any, mesh, topology: dict):
+    """Re-place host-gathered values under the AMBIENT mesh after a
+    topology change. The template's committed shardings stay
+    authoritative (the new incarnation's train step was built for them);
+    the recorded logical spec tree decides placement only for leaves the
+    template left uncommitted — resolved against the new mesh with
+    missing axes / non-dividing dims falling back toward replication
+    (parallel/mesh.py resolve_restore_specs)."""
+    from jax.sharding import NamedSharding
+
+    template_sd = flax_ser.to_state_dict(template)
+    restored_sd = flax_ser.to_state_dict(restored)
+    specs = resolve_restore_specs(topology, mesh, restored_sd)
+
+    def place(t, v, spec):
+        sharding = getattr(t, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            return jax.device_put(v, sharding)
+        if isinstance(t, (jax.Array, np.ndarray)):
+            # Uncommitted template leaf (fresh device array or host
+            # numpy): the recorded logical spec decides placement.
+            return jax.device_put(v, NamedSharding(mesh, spec))
+        return v
+
+    placed = jax.tree.map(place, template_sd, restored_sd, specs)
+    return flax_ser.from_state_dict(template, placed)
 
 
 class CheckpointManager:
@@ -860,13 +962,36 @@ class CheckpointManager:
             f"step {step} has no valid copy in {self.directory}"
             + (f" or {self.mirror_dir}" if self._mirror else ""))
 
-    def restore(self, state_template: Any, step: int | None = None) -> Any:
-        state, _ = self.restore_with_data_state(state_template, step)
+    def _load_topology(self, step: int) -> dict | None:
+        """The step's recorded save-time topology (spec tree + mesh
+        identity), from the first source that has it; None for
+        pre-elastic checkpoints (no ``topology.json``)."""
+        for backend, _root, _label in self._restore_sources(step):
+            step_dir = backend.step_dir(step)
+            if step_dir is None:
+                continue
+            path = step_dir / _TOPOLOGY_FILE
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except FileNotFoundError:
+                continue
+            except (OSError, json.JSONDecodeError) as e:
+                logger.warning("unreadable %s for step %d (%s); treating "
+                               "as pre-elastic", path, step, e)
+                continue
+        return None
+
+    def restore(self, state_template: Any, step: int | None = None,
+                mesh=None) -> Any:
+        state, _ = self.restore_with_data_state(state_template, step,
+                                                mesh=mesh)
         return state
 
     def restore_with_data_state(
             self, state_template: Any,
-            step: int | None = None) -> tuple[Any, dict | None]:
+            step: int | None = None,
+            mesh=None) -> tuple[Any, dict | None]:
         """(state, data_state-or-None), leaves placed onto the template's
         shardings.
 
@@ -876,6 +1001,17 @@ class CheckpointManager:
         path the supervisor leans on). An explicit ``step`` is restored
         as-is after a verification failure is logged — the caller asked
         for that exact step.
+
+        Elastic restore: every step carries its save-time topology
+        (``topology.json``: logical PartitionSpec tree + mesh shape/axis
+        names/device count). When that differs from the ambient world —
+        ``mesh``, or the mesh the template's committed leaves live on —
+        the host-gathered values are re-placed under the NEW mesh's
+        NamedShardings (``reshard="gather_replace"`` on the restore
+        event, ``checkpoint_reshard_total``/``checkpoint_reshard_ms`` in
+        the registry): a checkpoint taken on N devices restores onto M.
+        Pre-elastic checkpoints (no topology sidecar) keep the old
+        behavior — template placement, with a warning.
         """
         t0 = time.perf_counter()
         chosen: tuple[Any, dict | None, str] | None = None
@@ -938,13 +1074,80 @@ class CheckpointManager:
             else:
                 chosen = self._load_step(step, state_template)
         restored_host, data_state, source = chosen
-        restored = _place_like(state_template, restored_host)
+        reshard = "none"
+        topology = self._load_topology(step)
+        ambient_mesh = mesh if mesh is not None \
+            else _template_mesh(state_template)
+        if topology is None:
+            logger.warning(
+                "checkpoint step %d carries no topology metadata "
+                "(pre-elastic save); restoring onto the template's "
+                "placement", step)
+            restored = _place_like(state_template, restored_host)
+        elif _topology_differs(topology.get("mesh"),
+                               mesh_topology(ambient_mesh)):
+            reshard = "gather_replace"
+            t_reshard = time.perf_counter()
+            if ambient_mesh is not None:
+                restored = _place_elastic(state_template, restored_host,
+                                          ambient_mesh, topology)
+            else:
+                # The new world has no mesh (single-device restore of a
+                # mesh-born save): the host-gathered values land on the
+                # template's placement, which IS the re-shard here.
+                restored = _place_like(state_template, restored_host)
+            _RESHARDS.inc()
+            _RESHARD_MS.observe((time.perf_counter() - t_reshard) * 1e3)
+            logger.warning(
+                "checkpoint step %d re-sharded onto a changed topology: "
+                "saved on %s, restoring onto %s", step,
+                topology.get("mesh"), mesh_topology(ambient_mesh))
+        else:
+            restored = _place_like(state_template, restored_host)
         _RESTORES.inc()
         obs_events.emit(
             "checkpoint", action="restore", step=int(step), ok=True,
-            source=source,
+            source=source, reshard=reshard,
             duration_ms=round((time.perf_counter() - t0) * 1e3, 3))
         return restored, data_state
+
+    def truncate_after(self, step: int) -> list[int]:
+        """Delete every step NEWER than ``step``, in the primary AND the
+        mirror. This is the explicit-rewind path (``fit(restore_step=)``):
+        a replay from a historical step owns the timeline from there —
+        leaving the old lineage's future steps on disk would (a) make
+        every cadence save of the replay a silent no-op (an existing step
+        dir wins over a non-forced save) and (b) hand any crash-mid-
+        replay restart the OLD lineage's newest step as its "newest
+        valid" resume point, discarding exactly the rollback the caller
+        asked for. Unlike ``delete_step`` (corruption path, where the
+        mirror copy is the redundancy being kept), rewind must clear both
+        replicas — a stale future surviving in the mirror would still win
+        the newest-valid race. Returns the deleted steps.
+        """
+        step = int(step)
+        deleted = set()
+        for s in [s for s in self.manager.all_steps() if s > step]:
+            self.delete_step(s, reason="rewind")
+            if self._step_dir(s) is None:
+                deleted.add(s)
+        if self._mirror is not None:
+            m_manifests = self._load_manifests(self.mirror_dir)
+            changed = False
+            for s in [s for s in self._mirror.all_steps() if s > step]:
+                try:
+                    self._mirror.delete(s)
+                except OSError:
+                    continue
+                deleted.add(s)
+                if m_manifests.pop(str(s), None) is not None:
+                    changed = True
+            if changed:
+                try:
+                    self._store_manifests(m_manifests, self.mirror_dir)
+                except OSError:
+                    pass
+        return sorted(deleted)
 
     def latest_step(self) -> int | None:
         return self.manager.latest_step()
@@ -1100,14 +1303,20 @@ class AsyncCheckpointer:
     def delete_step(self, step: int, reason: str = "corrupt") -> None:
         self.manager.delete_step(step, reason)
 
-    def restore(self, state_template: Any, step: int | None = None):
+    def truncate_after(self, step: int) -> list[int]:
         self.wait_until_finished()
-        return self.manager.restore(state_template, step)
+        return self.manager.truncate_after(step)
+
+    def restore(self, state_template: Any, step: int | None = None,
+                mesh=None):
+        self.wait_until_finished()
+        return self.manager.restore(state_template, step, mesh=mesh)
 
     def restore_with_data_state(self, state_template: Any,
-                                step: int | None = None):
+                                step: int | None = None, mesh=None):
         self.wait_until_finished()
-        return self.manager.restore_with_data_state(state_template, step)
+        return self.manager.restore_with_data_state(state_template, step,
+                                                    mesh=mesh)
 
     def wait_until_finished(self) -> None:
         self._queue.join()

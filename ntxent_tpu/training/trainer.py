@@ -790,10 +790,22 @@ def fit(
     checkpoint_keep_every: int | None = None,
     checkpoint_mirror: str | None = None,
     checkpoint_fault_hook: Callable | None = None,
+    restore_step: int | None = None,
 ):
     """Checkpoint-aware training: restore the latest checkpoint if one
     exists, train to ``num_steps`` total, save every ``checkpoint_every``
     steps (on the GLOBAL ``state.step``) and at the end.
+
+    ``restore_step`` pins the resume point to an explicit historical step
+    instead of the newest valid one (CLI ``--restore-step``): the named
+    step is restored with the same mirror-fallback semantics restore
+    always has, and a step that exists in NO replica raises — silently
+    training from scratch when the caller named a specific step would
+    discard exactly the history they asked for. Rewinding is git-reset,
+    not a detached checkout: steps NEWER than the restore point are
+    deleted from both replicas (loudly), so the replay's own saves land
+    and a crash mid-replay resumes the REPLAYED lineage, never the
+    abandoned one.
 
     ``async_checkpointing=True`` wraps the manager in an
     ``AsyncCheckpointer``: cadence saves snapshot to host and serialize
@@ -852,6 +864,13 @@ def fit(
     manager = None
     stateful_data = hasattr(data_iter, "state") \
         and hasattr(data_iter, "restore")
+    if restore_step is not None and checkpoint_dir is None:
+        # The feature's contract is fail-loud: silently training from
+        # step 0 when the caller named a specific resume step would
+        # discard exactly the history they asked for.
+        raise ValueError(
+            f"restore_step={restore_step} requires checkpoint_dir "
+            "(there is no store to restore the named step from)")
     try:
         if checkpoint_dir is not None:
             from .checkpoint import AsyncCheckpointer, CheckpointManager
@@ -866,10 +885,27 @@ def fit(
                 fault_hook=checkpoint_fault_hook)
             if async_checkpointing:
                 manager = AsyncCheckpointer(manager)
-            if manager.latest_step() is not None:
-                state, data_state = manager.restore_with_data_state(state)
-                logger.info("resumed from checkpoint at step %d",
-                            int(state.step))
+            if restore_step is not None or manager.latest_step() is not None:
+                state, data_state = manager.restore_with_data_state(
+                    state, restore_step)
+                logger.info("resumed from checkpoint at step %d%s",
+                            int(state.step),
+                            " (explicit --restore-step)"
+                            if restore_step is not None else "")
+                if restore_step is not None:
+                    # The replay OWNS the timeline from here: stale
+                    # future steps would silently swallow every cadence
+                    # save (existing dir beats a non-forced write) and
+                    # would win the newest-valid race on any crash-mid-
+                    # replay restart — resuming the lineage the caller
+                    # explicitly rewound away from.
+                    stale = manager.truncate_after(int(state.step))
+                    if stale:
+                        logger.warning(
+                            "explicit restore_step=%d: deleted %d "
+                            "newer checkpoint step(s) %s — the replay "
+                            "owns the timeline from here",
+                            restore_step, len(stale), stale)
                 if stateful_data and data_state is not None:
                     data_iter.restore(data_state)
                     logger.info("data iterator repositioned: %s", data_state)

@@ -27,4 +27,31 @@ python -m ntxent_tpu.resilience.crashsim \
     --workdir "$workdir/audit" \
     --steps 8 --kills 5 --midsave 1 --seed "${CRASH_AUDIT_SEED:-0}"
 
+# The audit writes structured per-lineage + aggregate JSON artifacts
+# (ISSUE 6): assert the verdict on those, not on log text.
+python - "$workdir/audit" <<'PY'
+import json
+import pathlib
+import sys
+
+workdir = pathlib.Path(sys.argv[1])
+summary = json.load(open(workdir / "audit_summary.json"))
+assert summary["verdict"] == "PASS:bitexact", summary["verdict"]
+assert summary["crc_exact"] is True, summary
+assert summary["kills"] >= 5, summary["kills"]
+assert summary["midsave_kills"] >= 1, summary["midsave_kills"]
+assert summary["survivor_fingerprint"] == summary["reference_fingerprint"]
+lineages = summary["lineages"]
+assert lineages and all(ln["crc_exact"] for ln in lineages), lineages
+per_lineage = sorted(p.name for p in workdir.glob("summary_*.json"))
+assert len(per_lineage) == len(lineages), (per_lineage, len(lineages))
+for name in per_lineage:
+    ln = json.load(open(workdir / name))
+    assert ln["verdict"] == "PASS:bitexact", (name, ln["verdict"])
+    assert len(ln["device_counts"]) == ln["restarts"] + 1, ln
+print(f"audit summary: OK — {summary['kills']} kills "
+      f"({summary['midsave_kills']} mid-save) across "
+      f"{len(lineages)} lineages, all bit-exact")
+PY
+
 echo "crash audit: OK"

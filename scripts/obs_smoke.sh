@@ -9,10 +9,18 @@
 #     (with data_wait_ms/device_ms/steps_per_sec), one `checkpoint` save
 #     event, a `divergence` event for the injected NaN, and a `retry`
 #     event for the injected fetch fault;
+#   * surface the ELASTIC telemetry (ISSUE 6): the run executes on an
+#     8-device simulated mesh and the chaos plan shrinks it mid-run, so
+#     the scrape must carry `checkpoint_reshard_total`/`_ms` and the
+#     JSONL restore event a `reshard="gather_replace"` field;
 #   * exit 0.
 # Pairs with `pytest -m obs` (the same layer asserted in-process).
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+# 8 simulated devices: the run trains data-parallel, so the shrink@6
+# topology fault has a mesh to shrink (8 -> 4) and restore re-shards.
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 
 workdir="$(mktemp -d)"
 train_pid=""
@@ -35,7 +43,7 @@ JAX_PLATFORMS=cpu python -m ntxent_tpu.cli \
     --batch 8 --steps 400 --warmup-steps 2 --log-every 100 \
     --ckpt-dir "$workdir/ckpt" --ckpt-every 200 --async-ckpt \
     --metrics-port 0 --log-jsonl "$events" \
-    --chaos 'nan@3,fetch@2' \
+    --chaos 'nan@3,fetch@2,shrink@6' --max-restarts 2 \
     >"$log" 2>&1 &
 train_pid=$!
 
@@ -57,7 +65,8 @@ for _ in $(seq 200); do
     if curl -fsS "http://127.0.0.1:$port/metrics" -o "$scrape.tmp" 2>/dev/null; then
         if grep -q '^train_steps_total [1-9]' "$scrape.tmp" \
             && grep -q '^train_divergence_total [1-9]' "$scrape.tmp" \
-            && grep -q '^retries_total [1-9]' "$scrape.tmp"; then
+            && grep -q '^retries_total [1-9]' "$scrape.tmp" \
+            && grep -q '^checkpoint_reshard_total [1-9]' "$scrape.tmp"; then
             mv "$scrape.tmp" "$scrape"
             curl -fsS "http://127.0.0.1:$port/metrics?format=json" -o "$scrape_json"
             ok=1
@@ -111,6 +120,14 @@ assert values.get("checkpoint_async_saves_total", 0) >= 1, (
 assert values.get("checkpoint_save_overlap_ms_count", 0) >= 1, (
     "no background-writer samples in checkpoint_save_overlap_ms")
 
+# Elastic telemetry (ISSUE 6): the shrink@6 topology fault restarted the
+# run on a 4-device mesh, so the restore must have re-sharded — counter,
+# latency histogram, and the restore event's reshard field all agree.
+assert values.get("checkpoint_reshard_total", 0) >= 1, (
+    values.get("checkpoint_reshard_total"))
+assert values.get("checkpoint_reshard_ms_count", 0) >= 1, (
+    "no samples in checkpoint_reshard_ms")
+
 # -- JSON view of the same registry agrees on the same scrape... the two
 # formats are separate scrapes a moment apart, so compare loosely (the
 # JSON one ran second: counters can only have grown).
@@ -130,6 +147,10 @@ for field in ("data_wait_ms", "device_ms", "steps_per_sec", "run_id",
 assert by_type.get("checkpoint"), "no checkpoint events"
 assert any(r.get("action") == "save" and r.get("ok")
            for r in by_type["checkpoint"]), by_type["checkpoint"][:3]
+restores = [r for r in by_type["checkpoint"]
+            if r.get("action") == "restore"]
+assert restores and all("reshard" in r for r in restores), restores[:3]
+assert any(r["reshard"] == "gather_replace" for r in restores), restores
 assert by_type.get("divergence"), "no divergence event for the NaN fault"
 assert by_type.get("retry"), "no retry event for the fetch fault"
 assert by_type["retry"][0]["fn"], by_type["retry"][0]
@@ -138,6 +159,7 @@ print(f"obs smoke: OK — steps={int(values['train_steps_total'])} "
       f"divergence={int(values['train_divergence_total'])} "
       f"retries={int(values['retries_total'])} "
       f"ckpt_saves={int(values['checkpoint_saves_total'])} "
+      f"reshards={int(values['checkpoint_reshard_total'])} "
       f"jsonl_events={len(records)}")
 PY
 

@@ -1,0 +1,441 @@
+"""Elastic training (ISSUE 6): topology-portable checkpoints and
+shrink/grow restarts.
+
+The crash-safe layer proved bit-exact resume onto an IDENTICAL mesh;
+these tests pin the elastic upgrade: every save records its logical
+placement (PartitionSpec tree + mesh identity), restore re-places
+host-gathered values under whatever mesh the new incarnation built
+(``reshard="gather_replace"``), pre-elastic checkpoints keep the old
+behavior, the spec-resolver vocabulary in parallel/mesh.py behaves, the
+``shrink@K``/``grow@K`` chaos actions drive the supervisor's
+topology-rebuild restart path, and ``fit(restore_step=)`` resumes from
+an explicit historical step. CPU-cheap (tiny pytrees, one tiny model),
+NOT slow-marked — tier-1 keeps the elasticity invariants green;
+``scripts/elastic_smoke.sh`` drives the same story end-to-end through
+the CLI across real subprocess device-count changes.
+"""
+
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ntxent_tpu.parallel.mesh import (
+    create_mesh,
+    match_partition_rules,
+    mesh_topology,
+    resolve_restore_specs,
+    tree_partition_specs,
+)
+from ntxent_tpu.resilience import FaultInjector, FaultPlan, Supervisor
+from ntxent_tpu.resilience.faults import TopologyChange
+from ntxent_tpu.training.checkpoint import CheckpointManager, _Snapshot
+
+pytestmark = pytest.mark.elastic
+
+needs_mesh = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs an 8-device mesh")
+
+
+@pytest.fixture
+def mesh8():
+    return create_mesh(axis_names=("data",))
+
+
+@pytest.fixture
+def mesh4():
+    return create_mesh(devices=jax.devices()[:4], axis_names=("data",))
+
+
+def sharded_tree(mesh):
+    return {
+        "params": {
+            "w": jax.device_put(jnp.arange(32.0).reshape(8, 4),
+                                NamedSharding(mesh, P("data"))),
+            "b": jax.device_put(jnp.ones((4,)),
+                                NamedSharding(mesh, P())),
+        },
+        "step": jnp.int32(5),
+    }
+
+
+def host_values(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+# ---------------------------------------------------------------------------
+# Spec vocabulary (parallel/mesh.py)
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_tree_partition_specs_records_layout_and_mesh(mesh8):
+    rec = tree_partition_specs(sharded_tree(mesh8))
+    assert rec["specs"]["params/w"] == ["data"]
+    assert rec["specs"]["params/b"] == []
+    assert rec["mesh"]["device_count"] == 8
+    assert rec["mesh"]["axis_names"] == ["data"]
+    assert rec["mesh"]["shape"] == [8]
+    # JSON-able by construction: the checkpoint sidecar is json.dump'd.
+    json.dumps(rec)
+
+
+@needs_mesh
+def test_resolve_restore_specs_across_meshes(mesh8, mesh4):
+    tree = sharded_tree(mesh8)
+    rec = tree_partition_specs(tree)
+    specs = resolve_restore_specs(rec, mesh4, host_values(tree))
+    assert specs["params"]["w"] == P("data")
+    assert specs["params"]["b"] == P()
+    assert specs["step"] == P()
+
+
+@needs_mesh
+def test_resolve_restore_specs_falls_back_toward_replication(mesh8):
+    """A recorded axis the new mesh lacks, or a dim the new axis size no
+    longer divides, resolves to replicated for that dim — never a crash."""
+    tree = {"w": jax.device_put(jnp.ones((8, 4)),
+                                NamedSharding(mesh8, P("data", None)))}
+    rec = tree_partition_specs(tree)
+    other_axis = create_mesh(devices=jax.devices()[:4],
+                             axis_names=("model",))
+    specs = resolve_restore_specs(rec, other_axis, host_values(tree))
+    assert specs["w"] == P(None, None)
+    mesh3 = create_mesh(devices=jax.devices()[:3], axis_names=("data",))
+    specs3 = resolve_restore_specs(rec, mesh3, host_values(tree))
+    assert specs3["w"] == P(None, None)  # 8 % 3 != 0
+
+
+@needs_mesh
+def test_match_partition_rules(mesh8):
+    tree = {"dense": {"kernel": jnp.ones((8, 4)),
+                      "bias": jnp.ones((4,)),
+                      "scale": jnp.ones(())},
+            "head": {"kernel": jnp.ones((4, 2))}}
+    specs = match_partition_rules(
+        [("dense/kernel", P("data", None)), (".*", P())], tree)
+    assert specs["dense"]["kernel"] == P("data", None)
+    assert specs["head"]["kernel"] == P()
+    assert specs["dense"]["scale"] == P()  # scalars never partitioned
+    with pytest.raises(ValueError, match="no partition rule"):
+        match_partition_rules([("dense/kernel", P())], tree)
+
+
+@needs_mesh
+def test_mesh_topology_identity(mesh8, mesh4):
+    assert mesh_topology(mesh8) != mesh_topology(mesh4)
+    assert mesh_topology(mesh8) == mesh_topology(
+        create_mesh(axis_names=("data",)))
+
+
+# ---------------------------------------------------------------------------
+# Topology-portable checkpoints (training/checkpoint.py)
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_topology_sidecar_round_trip(tmp_path, mesh8):
+    tree = sharded_tree(mesh8)
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    assert mgr.save(5, tree, force=True)
+    sidecar = json.load(open(tmp_path / "ckpt" / "5" / "topology.json"))
+    assert sidecar == tree_partition_specs(
+        jax.tree.map(lambda x: x, tree))
+    # The sidecar rides the CRC manifest like every other payload file.
+    manifest = json.load(open(tmp_path / "ckpt" / "manifests.json"))
+    assert "topology.json" in manifest["5"]["files"]
+
+
+@needs_mesh
+def test_restore_onto_smaller_mesh_resharding(tmp_path, mesh8, mesh4):
+    """A checkpoint taken on 8 devices restores onto 4: identical
+    (host-gathered) values, placed under the NEW mesh's NamedSharding,
+    with the reshard counter moving."""
+    from ntxent_tpu.obs.registry import default_registry
+
+    tree = sharded_tree(mesh8)
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    assert mgr.save(5, tree, force=True)
+
+    template = jax.tree.map(jnp.zeros_like, host_values(tree))
+    template = {
+        "params": {
+            "w": jax.device_put(template["params"]["w"],
+                                NamedSharding(mesh4, P("data"))),
+            "b": jax.device_put(template["params"]["b"],
+                                NamedSharding(mesh4, P())),
+        },
+        "step": template["step"],
+    }
+    before = default_registry().counter(
+        "checkpoint_reshard_total", "").value
+    out = CheckpointManager(tmp_path / "ckpt").restore(template)
+    after = default_registry().counter("checkpoint_reshard_total", "").value
+    assert after == before + 1
+    assert out["params"]["w"].sharding.mesh.size == 4
+    np.testing.assert_array_equal(np.asarray(out["params"]["w"]),
+                                  np.arange(32.0).reshape(8, 4))
+    np.testing.assert_array_equal(np.asarray(out["params"]["b"]),
+                                  np.ones((4,)))
+
+
+@needs_mesh
+def test_restore_uncommitted_template_uses_recorded_specs(tmp_path, mesh8,
+                                                          mesh4):
+    """With an uncommitted template and an explicit ``mesh=``, the
+    RECORDED logical specs decide placement on the new mesh — the
+    match_partition_rules/shard-fn restore path."""
+    tree = sharded_tree(mesh8)
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    assert mgr.save(5, tree, force=True)
+    template = host_values(tree)
+    out = CheckpointManager(tmp_path / "ckpt").restore(template, mesh=mesh4)
+    w = out["params"]["w"]
+    assert isinstance(w.sharding, NamedSharding)
+    assert w.sharding.mesh.size == 4
+    assert w.sharding.spec == P("data")
+    np.testing.assert_array_equal(np.asarray(w),
+                                  np.arange(32.0).reshape(8, 4))
+
+
+@needs_mesh
+def test_pre_elastic_checkpoint_restores_with_warning(tmp_path, mesh8,
+                                                      caplog):
+    """A checkpoint with NO topology sidecar (pre-elastic save) still
+    restores onto a matching mesh with the old template-placement
+    behavior — a warning, never a crash."""
+    from flax import serialization as flax_ser
+
+    tree = sharded_tree(mesh8)
+    snap = _Snapshot(
+        jax.tree.map(np.array, flax_ser.to_state_dict(tree)), None)
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    assert mgr.save(5, snap, force=True)
+    assert not (tmp_path / "ckpt" / "5" / "topology.json").exists()
+
+    template = jax.tree.map(jnp.zeros_like, sharded_tree(mesh8))
+    with caplog.at_level("WARNING"):
+        out = CheckpointManager(tmp_path / "ckpt").restore(template)
+    assert any("pre-elastic" in rec.message for rec in caplog.records)
+    np.testing.assert_array_equal(np.asarray(out["params"]["w"]),
+                                  np.arange(32.0).reshape(8, 4))
+    # Template placement preserved exactly (no behavior change).
+    assert out["params"]["w"].sharding == template["params"]["w"].sharding
+
+
+@needs_mesh
+def test_uncommitted_template_same_host_is_not_a_reshard(tmp_path, mesh8):
+    """An uncommitted template (no NamedSharding leaves — the eval/serve
+    restore shape) on an UNCHANGED host must not be stamped as a
+    re-shard: ambient shape is unknowable there, and device count alone
+    says nothing moved."""
+    from ntxent_tpu.obs.registry import default_registry
+
+    tree = sharded_tree(mesh8)
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    assert mgr.save(5, tree, force=True)
+    before = default_registry().counter(
+        "checkpoint_reshard_total", "").value
+    out = CheckpointManager(tmp_path / "ckpt").restore(host_values(tree))
+    assert default_registry().counter(
+        "checkpoint_reshard_total", "").value == before
+    np.testing.assert_array_equal(np.asarray(out["params"]["w"]),
+                                  np.arange(32.0).reshape(8, 4))
+
+
+def test_fit_restore_step_without_dir_fails_loudly():
+    from ntxent_tpu.training.trainer import fit
+
+    state, step, data = _tiny_fit_setup()
+    with pytest.raises(ValueError, match="restore_step"):
+        fit(state, data, step, num_steps=4, checkpoint_dir=None,
+            restore_step=2)
+
+
+@needs_mesh
+def test_matching_topology_restore_is_not_a_reshard(tmp_path, mesh8):
+    from ntxent_tpu.obs.registry import default_registry
+
+    tree = sharded_tree(mesh8)
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    assert mgr.save(5, tree, force=True)
+    before = default_registry().counter(
+        "checkpoint_reshard_total", "").value
+    out = CheckpointManager(tmp_path / "ckpt").restore(
+        jax.tree.map(jnp.zeros_like, tree))
+    assert default_registry().counter(
+        "checkpoint_reshard_total", "").value == before
+    np.testing.assert_array_equal(np.asarray(out["params"]["w"]),
+                                  np.arange(32.0).reshape(8, 4))
+
+
+# ---------------------------------------------------------------------------
+# shrink@K / grow@K chaos actions + supervisor topology restarts
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_parses_shrink_grow():
+    plan = FaultPlan.parse("shrink@5,grow@9,nan@3")
+    assert plan.shrink_batches == (5,)
+    assert plan.grow_batches == (9,)
+    assert not plan.empty()
+    with pytest.raises(ValueError, match="shrink"):
+        FaultPlan.parse("shrink@zero")
+
+
+def test_injector_raises_topology_change():
+    injector = FaultInjector(FaultPlan.parse("shrink@2,grow@4"))
+    batches = iter(injector.wrap_iterator(iter(range(10))))
+    assert next(batches) == 0
+    with pytest.raises(TopologyChange) as e:
+        next(batches)
+    assert e.value.action == "shrink" and e.value.batch == 2
+    assert next(batches) == 2
+    with pytest.raises(TopologyChange) as e:
+        next(batches)
+    assert e.value.action == "grow"
+    assert injector.fired == ["shrink@2", "grow@4"]
+
+
+def test_supervisor_topology_hook_rebuilds_between_attempts():
+    """A TopologyChange attempt triggers the hook BEFORE the next
+    attempt, the record carries the action, and the run completes on the
+    rebuilt world."""
+    calls = []
+    world = {"devices": 8}
+
+    class S:
+        step = 10
+
+    def run_attempt(attempt, stop_fn, watchdog):
+        if attempt == 0:
+            assert world["devices"] == 8
+            raise TopologyChange("shrink", 5)
+        if attempt == 1:
+            assert world["devices"] == 4  # hook ran first
+            raise TopologyChange("grow", 9)
+        assert world["devices"] == 8
+        return S(), [{"step": 10}]
+
+    def hook(action):
+        calls.append(action)
+        world["devices"] = 4 if action == "shrink" else 8
+
+    sup = Supervisor(run_attempt, num_steps=10, max_restarts=3,
+                     topology_hook=hook, sleep=lambda _s: None)
+    result = sup.run()
+    assert result.completed
+    assert calls == ["shrink", "grow"]
+    assert [r.topology for r in result.records] == ["shrink", "grow", None]
+
+
+def test_supervisor_topology_without_hook_restarts_unchanged():
+    attempts = []
+
+    class S:
+        step = 10
+
+    def run_attempt(attempt, stop_fn, watchdog):
+        attempts.append(attempt)
+        if attempt == 0:
+            raise TopologyChange("shrink", 3)
+        return S(), []
+
+    sup = Supervisor(run_attempt, num_steps=10, max_restarts=1,
+                     sleep=lambda _s: None)
+    result = sup.run()
+    assert result.completed and attempts == [0, 1]
+    assert result.records[0].topology == "shrink"
+
+
+# ---------------------------------------------------------------------------
+# fit(restore_step=): explicit historical resume
+# ---------------------------------------------------------------------------
+
+def _tiny_fit_setup():
+    from ntxent_tpu.models import ResNet, SimCLRModel
+    from ntxent_tpu.training import TrainerConfig, create_train_state
+    from ntxent_tpu.training.trainer import make_train_step
+
+    model = SimCLRModel(
+        encoder=functools.partial(ResNet, stage_sizes=(1,),
+                                  small_images=True, dtype=jnp.float32),
+        proj_hidden_dim=16, proj_dim=8)
+    cfg = TrainerConfig(batch_size=4, total_steps=8, warmup_steps=1)
+    state = create_train_state(model, jax.random.PRNGKey(0),
+                               (1, 8, 8, 3), cfg)
+    step = make_train_step(temperature=0.1)
+
+    def data_iter():
+        k = jax.random.PRNGKey(1)
+        i = 0
+        while True:
+            i += 1
+            ka, kb = jax.random.split(jax.random.fold_in(k, i))
+            yield (jax.random.uniform(ka, (4, 8, 8, 3)),
+                   jax.random.uniform(kb, (4, 8, 8, 3)))
+
+    return state, step, data_iter()
+
+
+def test_fit_restore_step_resumes_historical(tmp_path):
+    from ntxent_tpu.training.checkpoint import CheckpointManager
+    from ntxent_tpu.training.trainer import fit
+
+    state, step, data = _tiny_fit_setup()
+    state, _ = fit(state, data, step, num_steps=6,
+                   checkpoint_dir=str(tmp_path / "ckpt"),
+                   checkpoint_every=2, log_every=10,
+                   checkpoint_keep_last=None)
+    assert int(state.step) == 6
+
+    # Resume from step 2, NOT the newest (6): fit must restore exactly
+    # the named step, DELETE the abandoned future (rewind is git-reset —
+    # stale steps 4/6 would otherwise swallow the replay's saves and win
+    # any crash-mid-replay newest-valid race), and train forward.
+    state2, step2, data2 = _tiny_fit_setup()
+    state2, history = fit(state2, data2, step2, num_steps=4,
+                          checkpoint_dir=str(tmp_path / "ckpt"),
+                          checkpoint_every=2, log_every=10,
+                          checkpoint_keep_last=None, restore_step=2)
+    assert int(state2.step) == 4
+    mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=None)
+    # 1 = the first run's save-immediately step, 2 = the restore point;
+    # 6 was rewound away and the REPLAYED 4 was actually persisted.
+    assert mgr.all_steps() == [1, 2, 4]
+    # The persisted step 4 is the REPLAY's, not the old lineage's: its
+    # bytes restore to the replayed state.
+    restored = mgr.restore(jax.tree.map(jnp.zeros_like, state2), step=4)
+    for a, b in zip(jax.tree.leaves(restored.params),
+                    jax.tree.leaves(state2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@needs_mesh
+def test_truncate_after_clears_both_replicas(tmp_path, mesh8):
+    """Rewind must clear the MIRROR's future too: a stale future step
+    surviving in either replica would win the newest-valid race after a
+    crash mid-replay (latest_valid_step consults both)."""
+    tree = sharded_tree(mesh8)
+    mgr = CheckpointManager(tmp_path / "ckpt", max_to_keep=None,
+                            mirror_dir=tmp_path / "mirror")
+    for s in (2, 4, 6):
+        assert mgr.save(s, tree, force=True)
+    deleted = mgr.truncate_after(2)
+    assert deleted == [4, 6]
+    assert mgr.all_steps() == [2]
+    assert mgr.latest_valid_step() == 2  # the mirror can't resurrect 4/6
+    mirror = CheckpointManager(tmp_path / "mirror", max_to_keep=None)
+    assert mirror.all_steps() == [2]
+
+
+def test_fit_restore_step_missing_raises(tmp_path):
+    from ntxent_tpu.training.trainer import fit
+
+    state, step, data = _tiny_fit_setup()
+    (tmp_path / "ckpt").mkdir()
+    with pytest.raises(FileNotFoundError):
+        fit(state, data, step, num_steps=4,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            checkpoint_every=2, restore_step=3)

@@ -225,7 +225,14 @@ class TestPipelinedLongContext:
         mesh = create_mesh(devices=jax.devices()[:4],
                            axis_names=("stage",))
         pipe = make_pipelined_apply(model, mesh, num_microbatches=2)
-        tx = optax.sgd(0.1)
+        # lr 0.02, not 0.1: this tiny contrastive surface is steep enough
+        # that sgd(0.1) overshoots past the minimum (loss RISES 0.38 ->
+        # 0.90 even for the plain un-pipelined model, jax-version-
+        # dependent ulps deciding which side of the cliff the step lands
+        # on). The property under test is grads-flow-end-to-end, so the
+        # step must be small enough that a correct descent direction
+        # provably decreases the loss.
+        tx = optax.sgd(0.02)
 
         def loss_fn(v, toks):
             z = jnp.mean(pipe(v, toks), axis=1)  # (B, hidden) pooled
