@@ -67,8 +67,13 @@ def _record_progress(record: dict) -> None:
     package would pull JAX into this parent process, and the parent's
     no-JAX rule is what keeps a wedged backend from hanging the one
     driver-visible deliverable. Best-effort by design — a read-only
-    checkout must not fail the bench.
+    checkout must not fail the bench. ``NTXENT_BENCH_NO_PROGRESS=1``
+    suppresses the append (the gate's own self-test runs an
+    intentionally failing compare that should not pollute the
+    trajectory).
     """
+    if os.environ.get("NTXENT_BENCH_NO_PROGRESS") == "1":
+        return
     try:
         import importlib.util
 
@@ -753,9 +758,12 @@ def _probe_backend(timeout_s: float = 150.0) -> str | None:
 
 
 def _run_child(timeout_s: float, force_cpu: bool = False,
-               child_flag: str = "--child") -> tuple[dict | None, str]:
+               child_flag: str = "--child",
+               extra_env: dict | None = None) -> tuple[dict | None, str]:
     """Run the measurement subprocess; return (payload, diagnostic_tail)."""
     env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
     if force_cpu:
         env["JAX_PLATFORMS"] = "cpu"
         env["NTXENT_BENCH_FORCE_CPU"] = "1"
@@ -775,6 +783,224 @@ def _run_child(timeout_s: float, force_cpu: bool = False,
                 return None, f"unparseable child payload: {e}"
     tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
     return None, f"child rc={proc.returncode}: " + " | ".join(tail)
+
+
+# ---------------------------------------------------------------------------
+# --check: the perf-regression gate (ISSUE 7)
+#
+# The committed BENCH_*.json files are this repo's performance contract;
+# until now nothing ENFORCED them — a PR could halve serving throughput and
+# tier-1 would stay green. `bench.py --check` re-runs a quick profile of
+# each gated record, compares metric-by-metric against the committed value
+# with a per-metric tolerance, appends the verdict to PROGRESS.jsonl (the
+# bench trajectory), and exits nonzero on any regression past tolerance —
+# scripts/bench_gate.sh turns that into a CI step.
+#
+# Gate rules:
+# * only records measured on the CURRENT platform are compared (a CPU CI
+#   box must not judge a committed TPU number); mismatches are recorded
+#   as skipped, never failed;
+# * direction-aware: only a WORSE current value can fail (throughput down,
+#   latency up); improvements pass and show up in the trajectory;
+# * a metric fails when its fractional degradation is >= its tolerance
+#   (default 0.15 x --check-tol-scale), so an injected >= 20 % regression
+#   fails while re-measurement noise passes;
+# * sub-threshold serving buckets (< GATE_LATENCY_FLOOR_MS committed
+#   latency) are skipped — single-digit-ms CPU numbers jitter more than
+#   they inform.
+
+GATE_CHECKS = ("pipeline", "serving")
+GATE_TOL = 0.15
+GATE_SERVING_TOL = 0.30
+GATE_LATENCY_FLOOR_MS = 5.0
+
+
+def _gate_spec(name: str) -> tuple[str, dict]:
+    """(child flag, quick-mode env) for one gated record."""
+    if name == "pipeline":
+        return "--pipeline-child", {"NTXENT_PIPELINE_STEPS": "60",
+                                    "NTXENT_PIPELINE_REPS": "1"}
+    if name == "serving":
+        return "--serving-child", {}
+    raise ValueError(f"unknown gate {name!r}")
+
+
+def _gate_platform(payload: dict) -> str | None:
+    return payload.get("platform") or payload.get("backend")
+
+
+def gate_metrics(name: str, payload: dict | None,
+                 reference: bool = True) -> dict:
+    """Extract the gated metrics of one payload:
+    ``{metric: {"value", "higher_is_better", "tol"}}``.
+
+    ``reference=True`` (the committed side) applies the gating filters —
+    nonzero values only (a 0 baseline cannot be regressed against) and
+    the serving latency floor. ``reference=False`` (the current
+    measurement) extracts every numeric value, floor or not: which
+    metrics are gated is decided ONLY by the committed record, so a
+    current value that collapsed to 0 or dropped under the floor is
+    still compared (and fails) rather than silently vanishing from the
+    comparison.
+    """
+    out: dict = {}
+    if not payload:
+        return out
+
+    def keep(v) -> bool:
+        if v is None:
+            return False
+        return bool(v) if reference else True
+
+    if name == "pipeline":
+        for mode, rec in sorted((payload.get("modes") or {}).items()):
+            v = rec.get("steps_per_sec")
+            if keep(v):
+                out[f"pipeline/{mode}/steps_per_sec"] = {
+                    "value": float(v), "higher_is_better": True,
+                    "tol": GATE_TOL}
+        v = payload.get("speedup_prefetch_lag_vs_baseline")
+        if keep(v):
+            out["pipeline/speedup_prefetch_lag_vs_baseline"] = {
+                "value": float(v), "higher_is_better": True,
+                "tol": GATE_TOL}
+    elif name == "serving":
+        for bucket, rec in sorted((payload.get("buckets") or {}).items(),
+                                  key=lambda kv: int(kv[0])):
+            lat = rec.get("latency_ms")
+            if keep(lat) and (not reference
+                              or float(lat) >= GATE_LATENCY_FLOOR_MS):
+                out[f"serving/bucket{bucket}/latency_ms"] = {
+                    "value": float(lat), "higher_is_better": False,
+                    "tol": GATE_SERVING_TOL}
+    return out
+
+
+def compare_gate(current: dict, committed: dict,
+                 tol_scale: float = 1.0) -> dict:
+    """Compare measured payloads against committed records.
+
+    ``current`` / ``committed``: ``{gate-name: payload-dict}``. Pure
+    function of its inputs (no measurement, no IO) so tests can pin the
+    pass/fail boundary hermetically. Returns ``{"ok", "metrics",
+    "failures", "skipped"}``.
+    """
+    metrics: dict = {}
+    failures: list[str] = []
+    skipped: dict = {}
+    for name in sorted(set(committed) | set(current)):
+        ref = committed.get(name)
+        cur = current.get(name)
+        if not ref or ref.get("error"):
+            skipped[name] = "no committed record (or it carries an error)"
+            continue
+        if not cur or cur.get("error"):
+            # A record exists but nothing measured against it: that is a
+            # broken gate, not a skippable one — fail loudly.
+            failures.append(name)
+            metrics[name] = {"ok": False,
+                             "error": (cur or {}).get("error",
+                                                      "no measurement")}
+            continue
+        ref_platform, cur_platform = _gate_platform(ref), \
+            _gate_platform(cur)
+        if ref_platform != cur_platform:
+            skipped[name] = (f"platform mismatch: committed on "
+                             f"{ref_platform!r}, measured on "
+                             f"{cur_platform!r}")
+            continue
+        cur_metrics = gate_metrics(name, cur, reference=False)
+        gated = gate_metrics(name, ref)
+        # Committed values the reference-side filters excluded (zero
+        # baseline, sub-floor latency) must be VISIBLE as skips in the
+        # verdict — an auditor of the trajectory record should never
+        # have to re-derive which metrics were silently out of scope.
+        for key in gate_metrics(name, ref, reference=False):
+            if key not in gated:
+                skipped[key] = ("committed value below the gate floor "
+                                "(or zero)")
+        for key, spec in gated.items():
+            cur_spec = cur_metrics.get(key)
+            if cur_spec is None:
+                # A committed metric the current profile no longer
+                # produces is a BROKEN gate (renamed key, dead mode) —
+                # silently skipping it would let a regression on exactly
+                # that metric ride through green.
+                failures.append(key)
+                metrics[key] = {"committed": spec["value"], "ok": False,
+                                "error": "metric absent from the "
+                                         "current run"}
+                continue
+            rv, cv = spec["value"], cur_spec["value"]
+            if spec["higher_is_better"]:
+                degradation = (rv - cv) / rv
+            else:
+                degradation = (cv - rv) / rv
+            tol = spec["tol"] * float(tol_scale)
+            ok = degradation < tol
+            metrics[key] = {"committed": rv, "current": cv,
+                            "degradation": round(degradation, 4),
+                            "tol": round(tol, 4), "ok": ok}
+            if not ok:
+                failures.append(key)
+    return {"ok": not failures, "metrics": metrics,
+            "failures": failures, "skipped": skipped}
+
+
+def _check_main(args) -> int:
+    """``--check``: measure quick profiles, gate against the committed
+    records, append the verdict to PROGRESS.jsonl, rc 1 on regression."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    against = args.check_against or repo
+    committed: dict = {}
+    for name in GATE_CHECKS:
+        path = os.path.join(against, f"BENCH_{name}.json")
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    committed[name] = json.load(f)
+            except ValueError as e:
+                committed[name] = {"error": f"unreadable record: {e}"}
+    if not committed:
+        print(json.dumps({"metric": "bench_regression_gate", "ok": False,
+                          "error": f"no BENCH_*.json records under "
+                                   f"{against}"}))
+        return 1
+
+    if args.check_current:
+        with open(args.check_current) as f:
+            current = json.load(f)
+    else:
+        backend = _probe_backend()
+        force_cpu = backend not in ("tpu", "axon")
+        current = {}
+        for name in committed:
+            child_flag, extra_env = _gate_spec(name)
+            payload, diag = _run_child(CHILD_TIMEOUT_S,
+                                       force_cpu=force_cpu,
+                                       child_flag=child_flag,
+                                       extra_env=extra_env)
+            current[name] = payload if payload is not None \
+                else {"error": diag}
+    if args.check_save_current:
+        with open(args.check_save_current, "w") as f:
+            json.dump(current, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    result = compare_gate(current, committed,
+                          tol_scale=args.check_tol_scale)
+    record = {
+        "metric": "bench_regression_gate",
+        "ok": result["ok"],
+        "failures": result["failures"],
+        "skipped": result["skipped"],
+        "metrics": result["metrics"],
+        "tol_scale": args.check_tol_scale,
+        "checked_against": against,
+    }
+    _record_progress(record)
+    print(json.dumps(record))
+    return 0 if result["ok"] else 1
 
 
 def main() -> None:
@@ -873,8 +1099,45 @@ if __name__ == "__main__":
     parser.add_argument("--checkpoint-child", action="store_true",
                         help="internal: run the checkpoint measurement "
                              "in-process")
+    parser.add_argument("--check", action="store_true",
+                        help="perf-regression gate: quick re-profile of "
+                             "the committed BENCH_*.json records, "
+                             "per-metric tolerance compare, trajectory "
+                             "record to PROGRESS.jsonl; rc 1 on any "
+                             "regression past tolerance "
+                             "(scripts/bench_gate.sh)")
+    parser.add_argument("--check-against", default=None, metavar="DIR",
+                        help="directory holding the committed "
+                             "BENCH_*.json records (default: repo root)")
+    parser.add_argument("--check-current", default=None, metavar="FILE",
+                        help="skip measurement: compare this saved "
+                             "{gate: payload} JSON instead (pairs with "
+                             "--check-save-current for a measure-once/"
+                             "compare-twice CI step)")
+    parser.add_argument("--check-save-current", default=None,
+                        metavar="FILE",
+                        help="save the measured {gate: payload} JSON "
+                             "for later --check-current runs")
+    try:
+        _tol_scale_env = float(
+            os.environ.get("NTXENT_BENCH_GATE_TOL_SCALE", "1.0"))
+    except ValueError:
+        # A typo'd env var must not take down the headline bench (this
+        # default is evaluated on EVERY invocation, not just --check).
+        print("bench: ignoring malformed NTXENT_BENCH_GATE_TOL_SCALE="
+              f"{os.environ['NTXENT_BENCH_GATE_TOL_SCALE']!r}",
+              file=sys.stderr)
+        _tol_scale_env = 1.0
+    parser.add_argument(
+        "--check-tol-scale",
+        type=float,
+        default=_tol_scale_env,
+        help="multiply every gate tolerance (loosen a noisy CI box "
+             "without editing the per-metric defaults)")
     _args = parser.parse_args()
-    if _args.child:
+    if _args.check:
+        sys.exit(_check_main(_args))
+    elif _args.child:
         _child()
     elif _args.serving_child:
         _serving_child()

@@ -1353,6 +1353,18 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="supervised restarts after stall escalation "
                         "(resilience.Supervisor; 0 = single attempt)")
 
+    o = p.add_argument_group("observability (ntxent_tpu/obs/)")
+    o.add_argument("--log-jsonl", default=None, metavar="PATH",
+                   help="append typed JSONL events (request/queue/device "
+                        "spans with request ids — export with "
+                        "ntxent-trace) to this file")
+    o.add_argument("--run-id", default=None, metavar="ID",
+                   help="identity stamped on every event and surfaced "
+                        "in /metrics (serving_run_info{run_id=...} and "
+                        "the JSON run_id key); pass the TRAINING run's "
+                        "id to correlate a serving process with the run "
+                        "whose checkpoints it serves (default: random)")
+
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None, metavar="cpu|tpu")
     return p
@@ -1421,6 +1433,26 @@ def serve_main(argv=None) -> int:
         def apply_fn(v, x):
             return model.apply(v, x, train=False, method="features")
 
+    # Serving-side telemetry identity (ISSUE 7): one EventLog whenever
+    # spans should persist (--log-jsonl) or the operator pinned a run id;
+    # every span/event then carries run_id, and /metrics exposes it as
+    # serving_run_info — the cross-process join key back to the training
+    # run. Without either flag the span emits stay the hub's no-op.
+    event_log = None
+    if args.log_jsonl or args.run_id:
+        from ntxent_tpu import obs
+
+        # async_io: span emits ride the micro-batcher's dispatch loop,
+        # so the file writes must come off the request hot path (a
+        # per-record flush measurably backs up the bounded queue under
+        # burst load — obs/events.EventLog docstring).
+        event_log = obs.EventLog(args.log_jsonl, run_id=args.run_id,
+                                 async_io=True)
+        obs.install(event_log)
+        logger.info("serving telemetry: run_id=%s%s", event_log.run_id,
+                    f" events -> {args.log_jsonl}" if args.log_jsonl
+                    else "")
+
     retry_policy = RetryPolicy(max_attempts=2, base_delay_s=0.05,
                                max_delay_s=1.0, seed=args.seed)
     engine = InferenceEngine(
@@ -1429,6 +1461,8 @@ def serve_main(argv=None) -> int:
         buckets=buckets,
         dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
         retry_policy=retry_policy)  # per-chunk transient-fault retries
+    if event_log is not None:
+        engine.metrics.set_run_id(event_log.run_id)
     if not args.no_warmup:
         engine.warmup()
 
@@ -1447,6 +1481,12 @@ def serve_main(argv=None) -> int:
         logger.info("interrupted — draining")
         server.close()
         return 0
+    finally:
+        if event_log is not None:
+            from ntxent_tpu import obs
+
+            obs.install(None)
+            event_log.close()
     return 0 if completed else 1
 
 

@@ -20,7 +20,11 @@ fact. The pieces:
   (slow-step rolling-median trigger, trigger file, SIGUSR2);
 * ``exporters.MetricsServer`` — Prometheus text / JSON over stdlib
   HTTP (``ntxent-train --metrics-port``); the serving server's
-  ``/metrics`` negotiates the same two formats over the same registry.
+  ``/metrics`` negotiates the same two formats over the same registry;
+* ``trace`` — span tracing over the same event stream (ISSUE 7):
+  ``span``/``emit_span`` producers, the ``ntxent-trace`` exporter to
+  Perfetto/Chrome ``trace.json``, and the flight recorder
+  (``dump_flight``) that writes the event tail on stalls and signals.
 
 Everything here is stdlib except the profiler (lazy jax import), so
 the package is importable — and scrapeable — from processes that never
@@ -30,6 +34,7 @@ initialize a backend (bench.py's parent).
 from .events import (
     EVENT_TYPES,
     EventLog,
+    dump_flight,
     emit,
     get_event_log,
     install,
@@ -48,10 +53,19 @@ from .registry import (
     quantile,
 )
 from .timeline import StepTimeline
+from .trace import (
+    current_span_id,
+    emit_span,
+    export_chrome_trace,
+    new_request_id,
+    span,
+    validate_chrome_trace,
+)
 
 __all__ = [
     "EVENT_TYPES",
     "EventLog",
+    "dump_flight",
     "emit",
     "get_event_log",
     "install",
@@ -69,4 +83,10 @@ __all__ = [
     "prometheus_name",
     "quantile",
     "StepTimeline",
+    "current_span_id",
+    "emit_span",
+    "export_chrome_trace",
+    "new_request_id",
+    "span",
+    "validate_chrome_trace",
 ]

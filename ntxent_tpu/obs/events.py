@@ -51,16 +51,17 @@ from collections import deque
 logger = logging.getLogger(__name__)
 
 __all__ = ["EVENT_TYPES", "EventLog", "install", "get_event_log", "emit",
-           "set_attempt", "read_events"]
+           "set_attempt", "read_events", "dump_flight"]
 
 # The core vocabulary. step: one completed train step's timeline.
 # retry: a transient fault survived by RetryPolicy. divergence: a
 # non-finite step (guarded skip/backoff/rollback, or observed unguarded).
 # restart: a supervisor attempt boundary. checkpoint: save/restore/
 # fallback/delete. compile: an AOT step compile. trace: a profiler
-# capture artifact.
+# capture artifact. span: one timed causal interval (obs/trace.py —
+# serving request stages, or any `with trace.span(...)` block).
 EVENT_TYPES = ("step", "retry", "divergence", "restart", "checkpoint",
-               "compile", "trace")
+               "compile", "trace", "span")
 
 
 class EventLog:
@@ -68,24 +69,52 @@ class EventLog:
 
     ``path=None`` keeps records in a bounded in-memory tail only (tests;
     metrics-only runs) — ``emit`` stays cheap either way.
+
+    ``async_io=True`` moves the file write off the emitting thread: one
+    daemon writer drains a bounded queue onto the same line-buffered
+    handle (records still never interleave — single consumer — and the
+    file stays tail-able with ~one-queue-drain latency). This is the
+    mode for emitters on latency-critical paths: the serving stack's
+    span emits ride the micro-batcher's dispatch loop, where a
+    per-record flush syscall measurably backs up the bounded request
+    queue under burst load (ISSUE 7; serving_smoke's concurrency phase
+    is the regression test). Overflow drops the OLDEST queued record
+    and counts it (``dropped_writes``) — backpressure from a slow disk
+    must throttle telemetry, never requests. The in-memory tail (and so
+    the flight recorder) always sees every record. ``close()`` drains
+    the queue before closing, so nothing is lost on a clean shutdown.
     """
 
     def __init__(self, path: str | None = None, run_id: str | None = None,
-                 mirror_logger: bool = False, tail: int = 256):
+                 mirror_logger: bool = False, tail: int = 256,
+                 async_io: bool = False, write_queue_max: int = 4096):
         self.path = path
         self.run_id = run_id or uuid.uuid4().hex[:8]
         self.mirror_logger = mirror_logger
+        self.dropped_writes = 0
         self._attempt = 0
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self._counts: dict[str, int] = {}
         self._tail: deque[dict] = deque(maxlen=tail)
         self._fh = None
+        self._write_queue: deque[str] | None = None
+        self._write_queue_max = int(write_queue_max)
+        self._writer: threading.Thread | None = None
+        self._writer_wake = threading.Event()
+        self._inflight = 0
+        self._closing = False
         if path is not None:
             parent = os.path.dirname(os.path.abspath(path))
             os.makedirs(parent, exist_ok=True)
             # Line-buffered append: one write per record, tail-able live.
             self._fh = open(path, "a", buffering=1)
+            if async_io:
+                self._write_queue = deque()
+                self._writer = threading.Thread(
+                    target=self._drain_writes, daemon=True,
+                    name="ntxent-eventlog-writer")
+                self._writer.start()
 
     # -- identity --------------------------------------------------------
     def set_attempt(self, attempt: int) -> None:
@@ -118,11 +147,21 @@ class EventLog:
                 self._counts.get(record["event"], 0) + 1
             self._tail.append(record)
             if self._fh is not None and line is not None:
-                try:
-                    self._fh.write(line + "\n")
-                except OSError as e:  # a full disk must not kill training
-                    logger.error("event log write failed (%s); record "
-                                 "dropped: %s", e, line[:200])
+                if self._write_queue is not None:
+                    # Async mode: hand the line to the writer thread;
+                    # the emitter never waits on the filesystem.
+                    if len(self._write_queue) >= self._write_queue_max:
+                        self._write_queue.popleft()
+                        self.dropped_writes += 1
+                    self._write_queue.append(line)
+                    self._writer_wake.set()
+                else:
+                    try:
+                        self._fh.write(line + "\n")
+                    except OSError as e:  # a full disk must not kill
+                        # training
+                        logger.error("event log write failed (%s); "
+                                     "record dropped: %s", e, line[:200])
         if self.mirror_logger:
             # Lazy import keeps this module loadable WITHOUT package
             # context (bench.py's parent loads it by file path so the
@@ -136,11 +175,143 @@ class EventLog:
         with self._lock:
             return dict(self._counts)
 
+    # -- flight recorder -------------------------------------------------
+    def dump_flight(self, directory: str | None = None,
+                    reason: str = "manual",
+                    routine: bool = False) -> str | None:
+        """Write the bounded in-memory tail to ``flight_<ts>.jsonl``.
+
+        The postmortem path for runs that did NOT enable ``--log-jsonl``:
+        the tail ring exists on every EventLog (path=None included), so a
+        stall escalation or a shutdown signal can still leave the last N
+        typed events on disk. Target directory: explicit arg, then
+        ``NTXENT_FLIGHT_DIR``, then the log file's own directory, then
+        the CWD. ``routine=True`` (the graceful-preemption path: SIGTERM
+        on a preemptible VM is normal, not a fault) skips the CWD
+        fallback — an expected shutdown must not litter the working
+        directory; a stall escalation dumps unconditionally. Returns the
+        written path, or None when skipped, the ring is empty, or the
+        write failed (a postmortem helper must never take the process
+        down on a full disk).
+        """
+        with self._lock:
+            records = list(self._tail)
+        if not records:
+            return None
+        directory = (directory or os.environ.get("NTXENT_FLIGHT_DIR")
+                     or (os.path.dirname(os.path.abspath(self.path))
+                         if self.path else None))
+        if directory is None:
+            if routine:
+                return None
+            directory = "."
+        ts = time.strftime("%Y%m%d-%H%M%S")
+        path = os.path.join(directory,
+                            f"flight_{ts}-{uuid.uuid4().hex[:6]}.jsonl")
+        header = {"event": "flight", "reason": str(reason),
+                  "run_id": self.run_id, "attempt": self._attempt,
+                  "records": len(records),
+                  "wall": round(time.time(), 6)}
+        try:
+            os.makedirs(directory, exist_ok=True)
+            with open(path, "w") as f:
+                for record in [header] + records:
+                    f.write(json.dumps(_sanitize(record),
+                                       default=_jsonable) + "\n")
+        except OSError as e:
+            logger.error("flight recorder dump to %s failed: %s", path, e)
+            return None
+        logger.warning("flight recorder: dumped last %d events to %s "
+                       "(reason: %s)", len(records), path, reason)
+        return path
+
     def tail(self, n: int = 20) -> list[dict]:
         with self._lock:
             return list(self._tail)[-n:]
 
+    def _drain_writes(self) -> None:
+        """Writer-thread loop (async_io): batch-drain queued lines onto
+        the line-buffered handle. Single consumer — records never
+        interleave, exactly as in the synchronous mode. ``_inflight``
+        stays nonzero from pop to write-complete so ``flush`` cannot
+        return while a popped batch has yet to reach the file. A failed
+        write REQUEUES the popped batch at the front of the queue and
+        retries after a short backoff — a transient EIO/ENOSPC on one
+        syscall must cost a retry, not a whole popped batch (up to
+        ``write_queue_max`` records, where sync mode would lose exactly
+        one). The queue bound still holds: requeue overflow drops the
+        oldest records into ``dropped_writes``, and once ``close()`` has
+        latched ``_closing`` a failing final attempt drops-and-counts
+        instead of retrying forever against a dead disk."""
+        while True:
+            self._writer_wake.wait(0.2)
+            self._writer_wake.clear()
+            lines: list[str] = []
+            with self._lock:
+                while self._write_queue:
+                    lines.append(self._write_queue.popleft())
+                self._inflight = len(lines)
+                fh = self._fh
+                closing = self._closing
+            failed = False
+            if lines and fh is not None:
+                try:
+                    fh.write("\n".join(lines) + "\n")
+                except (OSError, ValueError) as e:  # full disk / closed
+                    failed = True
+                    with self._lock:
+                        closing = closing or self._closing
+                        if closing or self._write_queue is None:
+                            self.dropped_writes += len(lines)
+                        else:
+                            for line in reversed(lines):
+                                self._write_queue.appendleft(line)
+                            while (len(self._write_queue)
+                                   > self._write_queue_max):
+                                self._write_queue.popleft()
+                                self.dropped_writes += 1
+                    logger.error("event log async write failed (%s); "
+                                 "%d record(s) %s", e, len(lines),
+                                 "dropped" if closing else "requeued")
+            with self._lock:
+                self._inflight = 0
+            if closing and not lines:
+                return
+            if failed and not closing:
+                time.sleep(0.05)  # back off a sick disk before retrying
+
+    def flush(self, timeout_s: float = 5.0) -> bool:
+        """Block until queued async writes have reached the file (no-op
+        in synchronous mode) — tests and pre-export sync points.
+
+        Returns True when everything queued at call time is in the
+        file; False when the timeout expired or nothing can drain the
+        remainder (writer thread dead after ``close()``, or writes
+        still failing) — a pre-export sync point must be able to tell
+        a truncated file from a synced one instead of proceeding on
+        silence."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                pending = bool(self._write_queue) or self._inflight > 0
+            if not pending:
+                return True
+            writer = self._writer
+            if writer is None or not writer.is_alive():
+                return False
+            if time.monotonic() >= deadline:
+                return False
+            self._writer_wake.set()
+            time.sleep(0.005)
+
     def close(self) -> None:
+        writer = self._writer
+        if writer is not None:
+            with self._lock:
+                self._closing = True
+            self._writer_wake.set()
+            writer.join(5.0)  # drains the queue before the handle closes
+            self._writer = None
         with self._lock:
             if self._fh is not None:
                 try:
@@ -229,3 +400,14 @@ def set_attempt(attempt: int) -> None:
     log = _event_log
     if log is not None:
         log.set_attempt(attempt)
+
+
+def dump_flight(reason: str = "manual", directory: str | None = None,
+                routine: bool = False) -> str | None:
+    """Dump the installed event log's tail ring (no-op without one) —
+    the spelling the supervisor's stall escalation and the preemption
+    guard's signal path use."""
+    log = _event_log
+    if log is not None:
+        return log.dump_flight(directory, reason=reason, routine=routine)
+    return None

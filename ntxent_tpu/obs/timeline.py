@@ -99,6 +99,21 @@ class StepTimeline:
         self._loss = r.gauge("train_loss", "last step's loss")
         self._mfu = r.gauge(
             "train_mfu", "model FLOP utilization (0..1)")
+        # Static per-compiled-step collective traffic (ISSUE 7): set once
+        # from the comms-accounting delta bracketing the step compile
+        # (parallel/mesh.py records op counts/bytes at trace time;
+        # train_loop forwards the delta). The per-(op, axis) cumulative
+        # counters live in collective_*_total; these gauges are the
+        # per-STEP view the quantization/overlap ROADMAP items baseline
+        # against. None until a compile has been bracketed.
+        self._comms_bytes_per_step: float | None = None
+        self._comms_bytes = r.gauge(
+            "train_step_comms_bytes",
+            "bytes moved per device per compiled step (trace-time "
+            "static, ring-algorithm model)")
+        self._comms_calls = r.gauge(
+            "train_step_comms_calls",
+            "collective ops per compiled step (trace-time static)")
 
     # -- wiring ----------------------------------------------------------
     def set_flops_per_step(self, flops: float | None) -> None:
@@ -133,6 +148,27 @@ class StepTimeline:
             "train_compiles_total", "AOT train-step compiles").inc()
         events.emit("compile", duration_ms=round(duration_ms, 3),
                     flops=flops)
+
+    def set_comms_per_step(self, profile: dict) -> None:
+        """Publish one compiled step's static collective profile.
+
+        ``profile`` is a comms-accounting delta (``{(op, axis): (calls,
+        bytes)}`` — parallel/mesh.CommsAccounting.delta) captured around
+        the step's trace; an empty delta (single-device runs, steps with
+        no hand-written collectives) leaves the series untouched.
+        """
+        calls = sum(c for c, _ in profile.values())
+        nbytes = sum(b for _, b in profile.values())
+        if not calls:
+            return
+        self._comms_bytes_per_step = float(nbytes)
+        self._comms_bytes.set(nbytes)
+        self._comms_calls.set(calls)
+        events.emit("comms_profile", calls=int(calls),
+                    bytes=float(nbytes),
+                    by_op={f"{op}|{ax}": {"calls": int(c),
+                                          "bytes": float(b)}
+                           for (op, ax), (c, b) in sorted(profile.items())})
 
     # -- per step --------------------------------------------------------
     def record_step(self, step: int, loss: float,
@@ -196,6 +232,8 @@ class StepTimeline:
                           steps_per_sec=round(steps_per_sec, 4))
             if transfer_s is not None:
                 fields["transfer_ms"] = round(transfer_s * 1e3, 3)
+            if self._comms_bytes_per_step is not None:
+                fields["comms_bytes"] = self._comms_bytes_per_step
             if mfu is not None:
                 fields["mfu"] = round(mfu, 4)
             if grad_norm is not None:

@@ -457,9 +457,15 @@ def _infonce_dual_local_fwd(za_local, zb_g, row_gid, scale, axis, br, bc,
     lse_a = lse_a_p[:n_local, 0]
     lse_b_part = lse_b_p[:n, 0]
     # Global column logsumexp: logsumexp-merge of the per-device partial
-    # stats — an (N,) collective, not a matmul.
-    m = jax.lax.pmax(lse_b_part, axis)
-    lse_b = m + jnp.log(jax.lax.psum(jnp.exp(lse_b_part - m), axis))
+    # stats — an (N,) collective, not a matmul. Routed through the mesh
+    # shims so the comms accounting sees it (imported at call time:
+    # trace-time only, and it keeps this ops module import-order-neutral
+    # with the parallel package that imports it).
+    from ..parallel.mesh import pmax as _pmax_acct
+    from ..parallel.mesh import psum as _psum_acct
+
+    m = _pmax_acct(lse_b_part, axis)
+    lse_b = m + jnp.log(_psum_acct(jnp.exp(lse_b_part - m), axis))
     # Positive logits s_ii for the local pairs: zb row gid(i) gathered from
     # the already-present zb_g.
     pos = scale * jnp.sum(

@@ -31,7 +31,9 @@ from ..ops.infonce_pallas import (
     resolve_scale,
 )
 from ..ops.ntxent_pallas import ntxent_partial_fused
+from .mesh import all_gather as _all_gather_acct
 from .mesh import local_row_gids
+from .mesh import psum as _psum_acct
 from .mesh import shard_map as _shard_map_compat
 
 __all__ = ["ntxent_loss_distributed", "make_sharded_ntxent",
@@ -62,15 +64,15 @@ def local_ntxent_allgather(z1_local, z2_local, temperature, axis, num_devices,
     below and the trainer's sharded train step."""
     n_local = z1_local.shape[0]
     # tiled=True concatenates shards along axis 0: (n_local, D) -> (N, D).
-    z1_g = jax.lax.all_gather(z1_local, axis, tiled=True)
-    z2_g = jax.lax.all_gather(z2_local, axis, tiled=True)
+    z1_g = _all_gather_acct(z1_local, axis, tiled=True)
+    z2_g = _all_gather_acct(z2_local, axis, tiled=True)
     z_global = jnp.concatenate([z1_g, z2_g], axis=0)          # (2N, D)
     z_local = jnp.concatenate([z1_local, z2_local], axis=0)   # (2n, D)
     gid = local_row_gids(axis, n_local, num_devices)
     loss_sum = ntxent_partial_fused(
         z_local, z_global, gid, temperature, interpret=interpret
     )
-    return jax.lax.psum(loss_sum, axis) / z_global.shape[0]
+    return _psum_acct(loss_sum, axis) / z_global.shape[0]
 
 
 def resolve_local_ntxent(impl: str):
@@ -155,8 +157,8 @@ def local_infonce_allgather(za_local, zb_local, scale, axis,
     the reduce-scatter gradient of both all-gathers — falls out of AD.
     """
     n_local = za_local.shape[0]
-    za_g = jax.lax.all_gather(za_local, axis, tiled=True)    # (N, D)
-    zb_g = jax.lax.all_gather(zb_local, axis, tiled=True)
+    za_g = _all_gather_acct(za_local, axis, tiled=True)    # (N, D)
+    zb_g = _all_gather_acct(zb_local, axis, tiled=True)
     n = za_g.shape[0]
     d = jax.lax.axis_index(axis)
     gid = d * n_local + jnp.arange(n_local, dtype=jnp.int32)
@@ -164,7 +166,7 @@ def local_infonce_allgather(za_local, zb_local, scale, axis,
                                     interpret=interpret)
     loss_b = info_nce_partial_fused(zb_local, za_g, gid, scale=scale,
                                     interpret=interpret)
-    return jax.lax.psum(loss_a + loss_b, axis) / (2 * n)
+    return _psum_acct(loss_a + loss_b, axis) / (2 * n)
 
 
 def local_infonce_dual(za_local, zb_local, scale, axis, interpret=None):
@@ -180,13 +182,13 @@ def local_infonce_dual(za_local, zb_local, scale, axis, interpret=None):
     the learnable scale's psum through shard_map AD.
     """
     n_local = za_local.shape[0]
-    zb_g = jax.lax.all_gather(zb_local, axis, tiled=True)     # (N, D)
+    zb_g = _all_gather_acct(zb_local, axis, tiled=True)     # (N, D)
     n = zb_g.shape[0]
     d = jax.lax.axis_index(axis)
     gid = d * n_local + jnp.arange(n_local, dtype=jnp.int32)
     part = info_nce_dual_partial(za_local, zb_g, gid, axis, scale=scale,
                                  interpret=interpret)
-    return jax.lax.psum(part, axis) / (2 * n)
+    return _psum_acct(part, axis) / (2 * n)
 
 
 def resolve_local_infonce(impl: str):

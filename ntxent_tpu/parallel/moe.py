@@ -44,7 +44,9 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from .mesh import all_to_all as _all_to_all_acct
 from .mesh import axis_size as _axis_size_compat
+from .mesh import pmean as _pmean_acct
 from .mesh import shard_map as _shard_map_compat
 
 __all__ = ["MoEParams", "init_moe_params", "switch_moe",
@@ -146,8 +148,8 @@ def switch_moe(params: MoEParams, x: jax.Array, *,
     if axis is not None:
         # Equal shard sizes → pmean of per-shard token means IS the global
         # mean, so the load-balance loss below matches the unsharded layer.
-        frac = jax.lax.pmean(frac, axis)
-        mean_p = jax.lax.pmean(mean_p, axis)
+        frac = _pmean_acct(frac, axis)
+        mean_p = _pmean_acct(mean_p, axis)
     # Switch load-balance loss (eq. 4): differentiable through probs only.
     aux = e * jnp.sum(frac * mean_p)
 
@@ -162,7 +164,7 @@ def switch_moe(params: MoEParams, x: jax.Array, *,
             raise ValueError(f"{e} experts not divisible over {p} devices")
         # Token-sharded (E, C, d) → expert-sharded (E/P, P*C, d): each
         # device keeps only its experts' rows, from every device.
-        xin = jax.lax.all_to_all(xin, axis, split_axis=0, concat_axis=1,
+        xin = _all_to_all_acct(xin, axis, split_axis=0, concat_axis=1,
                                  tiled=True)
         i = jax.lax.axis_index(axis)
         sl = e // p
@@ -179,7 +181,7 @@ def switch_moe(params: MoEParams, x: jax.Array, *,
 
     if axis is not None:
         # Inverse exchange: expert-sharded rows come home token-sharded.
-        yout = jax.lax.all_to_all(yout, axis, split_axis=1, concat_axis=0,
+        yout = _all_to_all_acct(yout, axis, split_axis=1, concat_axis=0,
                                   tiled=True)
 
     y = jnp.einsum("tec,ecd->td", combine,

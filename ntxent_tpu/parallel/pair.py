@@ -42,7 +42,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.ntxent_pallas import block_grads_dual, block_lse_dual
+from .mesh import all_gather as _all_gather_acct
 from .mesh import local_row_gids
+from .mesh import pmax as _pmax_acct
+from .mesh import psum as _psum_acct
 from .mesh import shard_map as _shard_map_compat
 
 __all__ = ["make_pair_ntxent", "ntxent_loss_pair", "pair_body"]
@@ -106,7 +109,7 @@ def _make_pair_lse_sum(temperature: float, axis: str, num_devices: int,
         two_n_local = z_local.shape[0]
         two_n = two_n_local * num_devices
         d = jax.lax.axis_index(axis)
-        z_g = jax.lax.all_gather(z_local, axis, tiled=True)
+        z_g = _all_gather_acct(z_local, axis, tiled=True)
         lse_part = jnp.full((two_n,), _NEG_INF, jnp.float32)
         for k, w, ze, gid_e in _tiles(z_g, d, two_n_local):
             lr, lc = block_lse_dual(z_local, ze, my_gid, gid_e,
@@ -122,9 +125,9 @@ def _make_pair_lse_sum(temperature: float, axis: str, num_devices: int,
                 # would double-count the self pair.
                 lse_part = lse_part.at[gid_e].set(
                     jnp.logaddexp(lse_part[gid_e], lc))
-        m = jax.lax.pmax(lse_part, axis)
+        m = _pmax_acct(lse_part, axis)
         lse_all = m + jnp.log(
-            jax.lax.psum(jnp.exp(lse_part - m), axis))
+            _psum_acct(jnp.exp(lse_part - m), axis))
         return z_g, lse_all
 
     def _fwd(z_local, my_gid):
@@ -150,7 +153,7 @@ def _make_pair_lse_sum(temperature: float, axis: str, num_devices: int,
             else:
                 buf = buf.at[my_gid].add(w * gr)
                 buf = buf.at[gid_e].add(w * gc)
-        grad_full = jax.lax.psum(buf, axis)
+        grad_full = _psum_acct(buf, axis)
         grad = jnp.take(grad_full, my_gid, axis=0) * (ct / temperature)
         return grad.astype(z_local.dtype), None
 
@@ -174,7 +177,7 @@ def _pair_body(z1_local, z2_local, temperature, axis, num_devices,
     lse_sum = _make_pair_lse_sum(temperature, axis, num_devices,
                                  interpret)(z_local, my_gid)
     loss_sum = lse_sum - jnp.sum(pos)
-    return jax.lax.psum(loss_sum, axis) / two_n
+    return _psum_acct(loss_sum, axis) / two_n
 
 
 # Public alias: the per-device body shared with the train-step factory

@@ -35,6 +35,9 @@ from typing import Any, Callable, Sequence
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from .mesh import comms_scaled as _comms_scaled
+from .mesh import ppermute as _ppermute_acct
+from .mesh import psum as _psum_acct
 from .mesh import shard_map as _shard_map_compat
 
 __all__ = [
@@ -138,17 +141,19 @@ def make_gpipe(
                 outs, jnp.where(done, out, cur), idx, 0)
             # Hand activations to the successor; stage 0 ignores arrivals
             # (devices with no inbound edge receive zeros).
-            state = jax.lax.ppermute(out, axis, shift) \
+            state = _ppermute_acct(out, axis, shift) \
                 if num_stages > 1 else state
             return (state, outs), None
 
         outs0 = jnp.zeros_like(xs)
         state0 = jnp.zeros_like(xs[0])
-        (_, outs), _ = jax.lax.scan(
-            tick, (state0, outs0), jnp.arange(m + num_stages - 1))
+        # comms_scaled: the tick's ppermute traces once, runs per tick.
+        with _comms_scaled(m + num_stages - 1):
+            (_, outs), _ = jax.lax.scan(
+                tick, (state0, outs0), jnp.arange(m + num_stages - 1))
         # Only the last stage holds real outputs; psum replicates them so
         # the out_spec can be P() (or P(data_axis)) without lying.
-        outs = jax.lax.psum(
+        outs = _psum_acct(
             jnp.where(s == num_stages - 1, outs, jnp.zeros_like(outs)),
             axis)
         return outs.reshape(batch, *x.shape[1:])

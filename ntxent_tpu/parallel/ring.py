@@ -28,8 +28,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.infonce_pallas import resolve_scale
 from ..ops.ntxent_pallas import _exp0, _log_l
+from .mesh import comms_scaled as _comms_scaled
 from .mesh import local_row_gids
 from .mesh import pcast as _pcast_compat
+from .mesh import ppermute as _ppermute_acct
+from .mesh import psum as _psum_acct
 from .mesh import shard_map as _shard_map_compat
 
 __all__ = ["ntxent_loss_ring", "make_ring_ntxent",
@@ -67,8 +70,8 @@ def _ring_body(z1_local, z2_local, temperature, axis, num_devices):
     def step(carry, _):
         block, block_gid, m, l = carry
         m, l = fold(block, block_gid, m, l)
-        block = jax.lax.ppermute(block, axis, perm)
-        block_gid = jax.lax.ppermute(block_gid, axis, perm)
+        block = _ppermute_acct(block, axis, perm)
+        block_gid = _ppermute_acct(block_gid, axis, perm)
         return (block, block_gid, m, l), None
 
     # pcast to 'varying': the m/l statistics start device-invariant but
@@ -84,13 +87,15 @@ def _ring_body(z1_local, z2_local, temperature, axis, num_devices):
     )
     # P-1 exchanges suffice: fold the final visiting block outside the scan
     # instead of permuting it back to its origin (a wasted ICI hop).
-    (block, block_gid, m, l), _ = jax.lax.scan(
-        step, init, None, length=num_devices - 1
-    )
+    # comms_scaled: the body's collectives trace once but run P-1 times.
+    with _comms_scaled(num_devices - 1):
+        (block, block_gid, m, l), _ = jax.lax.scan(
+            step, init, None, length=num_devices - 1
+        )
     m, l = fold(block, block_gid, m, l)
     lse = m + _log_l(l)
     loss_sum = jnp.sum(lse - pos)
-    return jax.lax.psum(loss_sum, axis) / two_n
+    return _psum_acct(loss_sum, axis) / two_n
 
 
 def _make_ring_lse_sum(temperature: float, axis: str, num_devices: int,
@@ -124,16 +129,17 @@ def _make_ring_lse_sum(temperature: float, axis: str, num_devices: int,
                               two_n, interpret=interpret)
             m_new = jnp.maximum(m, lse_k)
             l = l * jnp.exp(m - m_new) + jnp.exp(lse_k - m_new)
-            blk = jax.lax.ppermute(blk, axis, perm)
-            bgid = jax.lax.ppermute(bgid, axis, perm)
+            blk = _ppermute_acct(blk, axis, perm)
+            bgid = _ppermute_acct(bgid, axis, perm)
             return (blk, bgid, m_new, l), None
 
         rows = z_local.shape[0]
         init = (z_local, my_gid,
                 jnp.full((rows,), _NEG_INF, jnp.float32),
                 jnp.zeros((rows,), jnp.float32))
-        (blk, bgid, m, l), _ = jax.lax.scan(
-            step, init, None, length=num_devices - 1)
+        with _comms_scaled(num_devices - 1):
+            (blk, bgid, m, l), _ = jax.lax.scan(
+                step, init, None, length=num_devices - 1)
         lse_k = block_lse(z_local, blk, my_gid, bgid, temperature,
                           two_n, interpret=interpret)
         m_new = jnp.maximum(m, lse_k)
@@ -157,16 +163,17 @@ def _make_ring_lse_sum(temperature: float, axis: str, num_devices: int,
             gblk = gblk + gc_k
             # gblk rides WITH its block: after num_devices hops both are
             # home, gblk holding every device's column-side contribution.
-            blk = jax.lax.ppermute(blk, axis, perm)
-            bgid = jax.lax.ppermute(bgid, axis, perm)
-            gblk = jax.lax.ppermute(gblk, axis, perm)
+            blk = _ppermute_acct(blk, axis, perm)
+            bgid = _ppermute_acct(bgid, axis, perm)
+            gblk = _ppermute_acct(gblk, axis, perm)
             return (blk, bgid, gblk, grows), None
 
         init = (z_local, my_gid,
                 jnp.zeros(z_local.shape, jnp.float32),
                 jnp.zeros(z_local.shape, jnp.float32))
-        (_, _, gblk, grows), _ = jax.lax.scan(
-            step, init, None, length=num_devices)
+        with _comms_scaled(num_devices):
+            (_, _, gblk, grows), _ = jax.lax.scan(
+                step, init, None, length=num_devices)
         grad = (grows + gblk) * (ct / temperature)
         return grad.astype(z_local.dtype), None
 
@@ -192,7 +199,7 @@ def _ring_body_fused(z1_local, z2_local, temperature, axis, num_devices,
     lse_sum = _make_ring_lse_sum(temperature, axis, num_devices,
                                  interpret)(z_local, my_gid)
     loss_sum = lse_sum - 2.0 * jnp.sum(pos)
-    return jax.lax.psum(loss_sum, axis) / two_n
+    return _psum_acct(loss_sum, axis) / two_n
 
 
 def make_ring_ntxent(mesh: Mesh, temperature: float = 0.07,
@@ -277,8 +284,8 @@ def _infonce_ring_body(za_local, zb_local, scale, axis, num_devices):
         za_blk, zb_blk, m_a, l_a, m_b, l_b = carry
         m_a, l_a = fold(za_local, zb_blk, m_a, l_a)  # row direction: s rows
         m_b, l_b = fold(zb_local, za_blk, m_b, l_b)  # col direction: s.T rows
-        za_blk = jax.lax.ppermute(za_blk, axis, perm)
-        zb_blk = jax.lax.ppermute(zb_blk, axis, perm)
+        za_blk = _ppermute_acct(za_blk, axis, perm)
+        zb_blk = _ppermute_acct(zb_blk, axis, perm)
         return (za_blk, zb_blk, m_a, l_a, m_b, l_b), None
 
     def stat(v):
@@ -288,15 +295,16 @@ def _infonce_ring_body(za_local, zb_local, scale, axis, num_devices):
     # P-1 exchanges; the final visiting block is folded outside the scan.
     init = (za_local, zb_local,
             stat(_NEG_INF), stat(0.0), stat(_NEG_INF), stat(0.0))
-    (za_blk, zb_blk, m_a, l_a, m_b, l_b), _ = jax.lax.scan(
-        step, init, None, length=num_devices - 1
-    )
+    with _comms_scaled(num_devices - 1):
+        (za_blk, zb_blk, m_a, l_a, m_b, l_b), _ = jax.lax.scan(
+            step, init, None, length=num_devices - 1
+        )
     m_a, l_a = fold(za_local, zb_blk, m_a, l_a)
     m_b, l_b = fold(zb_local, za_blk, m_b, l_b)
     lse_a = m_a + _log_l(l_a)
     lse_b = m_b + _log_l(l_b)
     loss_sum = jnp.sum(lse_a - pos) + jnp.sum(lse_b - pos)
-    return jax.lax.psum(loss_sum, axis) / (2 * n)
+    return _psum_acct(loss_sum, axis) / (2 * n)
 
 
 def _infonce_ring_dual_body(za_local, zb_local, scale, axis, num_devices):
@@ -341,7 +349,7 @@ def _infonce_ring_dual_body(za_local, zb_local, scale, axis, num_devices):
         zb_blk, m_a, l_a, m_blk, l_blk = carry
         m_a, l_a, m_blk, l_blk = fold_both(zb_blk, m_a, l_a, m_blk, l_blk)
         zb_blk, m_blk, l_blk = (
-            jax.lax.ppermute(t, axis, perm) for t in (zb_blk, m_blk, l_blk))
+            _ppermute_acct(t, axis, perm) for t in (zb_blk, m_blk, l_blk))
         return (zb_blk, m_a, l_a, m_blk, l_blk), None
 
     def stat(v):
@@ -349,16 +357,17 @@ def _infonce_ring_dual_body(za_local, zb_local, scale, axis, num_devices):
                              (axis,), to="varying")
 
     init = (zb_local, stat(_NEG_INF), stat(0.0), stat(_NEG_INF), stat(0.0))
-    (zb_blk, m_a, l_a, m_blk, l_blk), _ = jax.lax.scan(
-        step, init, None, length=num_devices - 1
-    )
+    with _comms_scaled(num_devices - 1):
+        (zb_blk, m_a, l_a, m_blk, l_blk), _ = jax.lax.scan(
+            step, init, None, length=num_devices - 1
+        )
     m_a, l_a, m_blk, l_blk = fold_both(zb_blk, m_a, l_a, m_blk, l_blk)
     # The block is one hop short of home — send its finished stats there.
-    m_blk, l_blk = (jax.lax.ppermute(t, axis, perm) for t in (m_blk, l_blk))
+    m_blk, l_blk = (_ppermute_acct(t, axis, perm) for t in (m_blk, l_blk))
     lse_a = m_a + _log_l(l_a)
     lse_b = m_blk + _log_l(l_blk)
     loss_sum = jnp.sum(lse_a - pos) + jnp.sum(lse_b - pos)
-    return jax.lax.psum(loss_sum, axis) / (2 * n)
+    return _psum_acct(loss_sum, axis) / (2 * n)
 
 
 def make_ring_infonce(mesh: Mesh, axis: str = "data", impl: str = "dual"):

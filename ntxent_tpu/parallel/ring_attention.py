@@ -51,8 +51,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops.attention_pallas import resolve_attention_scale as _resolve_scale
 from ..ops.attention_pallas import _flat, _unflat
 from ..ops.ntxent_pallas import _exp0, _log_l
+from .mesh import all_to_all as _all_to_all_acct
 from .mesh import axis_index as _axis_index_compat
+from .mesh import comms_scaled as _comms_scaled
 from .mesh import pcast as _pcast_compat
+from .mesh import ppermute as _ppermute_acct
 from .mesh import shard_map as _shard_map_compat
 
 __all__ = [
@@ -195,13 +198,16 @@ def _ring_fwd(q, k, v, axis, num_devices, causal, sc):
     def step(carry, _):
         kb, vb, kpos, m, l, o = carry
         m, l, o = _fold(q_, kb, vb, qpos, kpos, m, l, o, sc, causal)
-        kb = jax.lax.ppermute(kb, axis, perm)
-        vb = jax.lax.ppermute(vb, axis, perm)
-        kpos = jax.lax.ppermute(kpos, axis, perm)
+        kb = _ppermute_acct(kb, axis, perm)
+        vb = _ppermute_acct(vb, axis, perm)
+        kpos = _ppermute_acct(kpos, axis, perm)
         return (kb, vb, kpos, m, l, o), None
 
-    (_, _, _, m, l, o), _ = jax.lax.scan(step, init, None,
-                                         length=num_devices)
+    # comms_scaled on every scanned ring below: the body's ppermutes
+    # trace once but run `length` times.
+    with _comms_scaled(num_devices):
+        (_, _, _, m, l, o), _ = jax.lax.scan(step, init, None,
+                                             length=num_devices)
     lse = m + _log_l(l)                      # (B, H, Lq)
     out = (o / l[..., None]).transpose(0, 2, 1, 3).astype(q.dtype)
     return out, (q, k, v, out, lse)
@@ -242,15 +248,16 @@ def _ring_bwd(axis, num_devices, causal, sc, res, g):
         ds = p * (dp - drow[..., None]) * sc
         dq = dq + jnp.einsum("bhlm,bmhd->bhld", ds, kb.astype(jnp.float32))
         dkb = dkb + jnp.einsum("bhlm,bhld->bmhd", ds, q_)
-        kb = jax.lax.ppermute(kb, axis, perm)
-        vb = jax.lax.ppermute(vb, axis, perm)
-        kpos = jax.lax.ppermute(kpos, axis, perm)
-        dkb = jax.lax.ppermute(dkb, axis, perm)
-        dvb = jax.lax.ppermute(dvb, axis, perm)
+        kb = _ppermute_acct(kb, axis, perm)
+        vb = _ppermute_acct(vb, axis, perm)
+        kpos = _ppermute_acct(kpos, axis, perm)
+        dkb = _ppermute_acct(dkb, axis, perm)
+        dvb = _ppermute_acct(dvb, axis, perm)
         return (kb, vb, kpos, dkb, dvb, dq), None
 
-    (_, _, _, dk, dv, dq), _ = jax.lax.scan(step, init, None,
-                                            length=num_devices)
+    with _comms_scaled(num_devices):
+        (_, _, _, dk, dv, dq), _ = jax.lax.scan(step, init, None,
+                                                length=num_devices)
     dq = dq.transpose(0, 2, 1, 3)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -298,13 +305,14 @@ def _ring_flash_fwd(q, k, v, axis, num_devices, causal, sc,
                                q_offset=q_off, k_offset=k_off[0],
                                scale=sc, causal=causal,
                                block_q=bq, block_kv=bk)
-        kf = jax.lax.ppermute(kf, axis, perm)
-        vf = jax.lax.ppermute(vf, axis, perm)
-        k_off = jax.lax.ppermute(k_off, axis, perm)
+        kf = _ppermute_acct(kf, axis, perm)
+        vf = _ppermute_acct(vf, axis, perm)
+        k_off = _ppermute_acct(k_off, axis, perm)
         return (kf, vf, k_off, m, l, acc), None
 
-    (_, _, _, m, l, acc), _ = jax.lax.scan(step, init, None,
-                                           length=num_devices)
+    with _comms_scaled(num_devices):
+        (_, _, _, m, l, acc), _ = jax.lax.scan(step, init, None,
+                                               length=num_devices)
     lse = m + _log_l(l)
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = _unflat((acc / l_safe[..., None]).astype(q.dtype), b, h)
@@ -338,15 +346,16 @@ def _ring_flash_bwd(axis, num_devices, causal, sc, bq, bk, res, g):
         dqf = dqf + flash_dq_hop(qf, kf, vf, dof, lse, delta, **kwargs)
         dkc, dvc = flash_dkv_hop(qf, kf, vf, dof, lse, delta, **kwargs)
         dkf, dvf = dkf + dkc, dvf + dvc
-        kf = jax.lax.ppermute(kf, axis, perm)
-        vf = jax.lax.ppermute(vf, axis, perm)
-        k_off = jax.lax.ppermute(k_off, axis, perm)
-        dkf = jax.lax.ppermute(dkf, axis, perm)
-        dvf = jax.lax.ppermute(dvf, axis, perm)
+        kf = _ppermute_acct(kf, axis, perm)
+        vf = _ppermute_acct(vf, axis, perm)
+        k_off = _ppermute_acct(k_off, axis, perm)
+        dkf = _ppermute_acct(dkf, axis, perm)
+        dvf = _ppermute_acct(dvf, axis, perm)
         return (kf, vf, k_off, dkf, dvf, dqf), None
 
-    (_, _, _, dkf, dvf, dqf), _ = jax.lax.scan(step, init, None,
-                                               length=num_devices)
+    with _comms_scaled(num_devices):
+        (_, _, _, dkf, dvf, dqf), _ = jax.lax.scan(step, init, None,
+                                                   length=num_devices)
     return (_unflat(dqf, b, h).astype(q.dtype),
             _unflat(dkf, b, h).astype(k.dtype),
             _unflat(dvf, b, h).astype(v.dtype))
@@ -431,7 +440,7 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "data", *,
                 f"({num_devices}); use make_ring_attention instead")
 
         def to_heads(x):   # (B, L/P, H, D) -> (B, L, H/P, D)
-            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+            return _all_to_all_acct(x, axis, split_axis=2, concat_axis=1,
                                       tiled=True)
 
         qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
@@ -441,7 +450,7 @@ def make_ulysses_attention(mesh: Mesh, axis: str = "data", *,
         else:
             oh = attention_oracle(qh, kh, vh, causal=causal, scale=scale)
         # (B, L, H/P, D) -> (B, L/P, H, D)
-        return jax.lax.all_to_all(oh, axis, split_axis=1, concat_axis=2,
+        return _all_to_all_acct(oh, axis, split_axis=1, concat_axis=2,
                                   tiled=True)
 
     return _shard_map_compat(

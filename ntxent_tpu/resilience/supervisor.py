@@ -146,6 +146,13 @@ class Supervisor:
         logger.error("supervisor: stall escalation after %.1fs of silence "
                      "— stopping the attempt at the next step boundary "
                      "(checkpoint + in-process restart)", quiet_s)
+        # Flight recorder (ISSUE 7): persist the event tail BEFORE the
+        # restart machinery runs — a stalled attempt's last N events are
+        # the postmortem, and --log-jsonl may not have been enabled.
+        try:
+            obs_events.dump_flight(reason=f"stall:{quiet_s:.1f}s")
+        except Exception:  # the dump must never block the escalation
+            logger.exception("flight recorder dump failed on stall")
         guard.request()
 
     def run(self) -> SupervisorResult:

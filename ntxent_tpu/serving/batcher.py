@@ -47,6 +47,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..obs import trace as _trace
 from ..resilience.retry import RetryPolicy
 from .engine import InferenceEngine
 
@@ -84,6 +85,7 @@ class _Pending:
     x: np.ndarray
     enqueued: float                       # monotonic
     deadline: float | None                # monotonic, None = no deadline
+    request_id: str | None = None         # span linkage (obs/trace.py)
     done: threading.Event = field(default_factory=threading.Event)
     result: np.ndarray | None = None
     error: BaseException | None = None
@@ -136,7 +138,8 @@ class MicroBatcher:
 
     # -- client side -----------------------------------------------------
     def submit_async(self, x: np.ndarray,
-                     timeout_s: float | None = None) -> _Pending:
+                     timeout_s: float | None = None,
+                     request_id: str | None = None) -> _Pending:
         x = np.asarray(x)
         if x.shape[1:] != self.engine.example_shape or x.shape[0] < 1:
             raise ValueError(
@@ -145,7 +148,8 @@ class MicroBatcher:
         now = time.monotonic()
         pending = _Pending(
             x=x, enqueued=now,
-            deadline=now + timeout_s if timeout_s is not None else None)
+            deadline=now + timeout_s if timeout_s is not None else None,
+            request_id=request_id)
         with self._lock:
             # Closed check INSIDE the lock: the worker's exit and close()'s
             # drain both observe closed-ness under this same lock, so an
@@ -164,13 +168,17 @@ class MicroBatcher:
         return pending
 
     def submit(self, x: np.ndarray,
-               timeout_s: float | None = None) -> np.ndarray:
+               timeout_s: float | None = None,
+               request_id: str | None = None) -> np.ndarray:
         """Embed ``x`` (one request, shape ``(n,) + example_shape``).
 
         Raises ``QueueFullError`` (backpressure), ``DeadlineExceededError``
         (``timeout_s`` elapsed), or the device call's own error.
+        ``request_id`` (when the caller minted one at ingest) links the
+        queue-wait span the worker emits at dispatch to the request.
         """
-        pending = self.submit_async(x, timeout_s=timeout_s)
+        pending = self.submit_async(x, timeout_s=timeout_s,
+                                    request_id=request_id)
         start = pending.enqueued
         # Grace on top of the deadline: the worker expires the request;
         # the extra poll interval only covers rendezvous scheduling.
@@ -257,6 +265,7 @@ class MicroBatcher:
     def _serve_batch(self, batch: list[_Pending]) -> None:
         now = time.monotonic()
         live: list[_Pending] = []
+        expired: list[_Pending] = []
         for p in batch:
             if p.deadline is not None and now >= p.deadline:
                 # Expired in the queue: complete it WITHOUT device
@@ -265,29 +274,60 @@ class MicroBatcher:
                 p.finish(error=DeadlineExceededError(
                     "deadline expired while queued "
                     f"({(now - p.enqueued) * 1e3:.0f}ms waiting)"))
+                expired.append(p)
             else:
                 self.metrics.queue_wait((now - p.enqueued) * 1e3)
                 live.append(p)
-        if not live:
-            return
-        try:
-            # Concatenate INSIDE the shield: a MemoryError on a large
-            # coalesced batch must fail these requests, not the worker.
-            x = (live[0].x if len(live) == 1
-                 else np.concatenate([p.x for p in live]))
-            out = self.engine.embed(x, n_requests=len(live))
-        except Exception as e:  # noqa: BLE001 — fail the batch, not
-            # the worker: the loop must outlive any one bad batch.
-            logger.exception("serving: device call failed for a batch "
-                             "of %d request(s)", len(live))
-            for p in live:
-                p.finish(error=e)
-        else:
-            off = 0
-            for p in live:
-                n = p.x.shape[0]
-                p.finish(result=out[off:off + n])
-                off += n
+        if live:
+            try:
+                # Concatenate INSIDE the shield: a MemoryError on a
+                # large coalesced batch must fail these requests, not
+                # the worker.
+                x = (live[0].x if len(live) == 1
+                     else np.concatenate([p.x for p in live]))
+                batch_span = _trace.span(
+                    "serve.batch", requests=len(live),
+                    rows=int(x.shape[0]),
+                    request_ids=[p.request_id for p in live
+                                 if p.request_id is not None])
+                with batch_span:
+                    out = self.engine.embed(x, n_requests=len(live))
+            except Exception as e:  # noqa: BLE001 — fail the batch, not
+                # the worker: the loop must outlive any one bad batch.
+                logger.exception("serving: device call failed for a "
+                                 "batch of %d request(s)", len(live))
+                for p in live:
+                    p.finish(error=e)
+            else:
+                off = 0
+                for p in live:
+                    n = p.x.shape[0]
+                    p.finish(result=out[off:off + n])
+                    off += n
+        # Queue-wait spans are emitted LAST, after every requester has
+        # been woken: each emit is a line-buffered file write, and a
+        # handful of synchronous writes between queue drain and dispatch
+        # measurably clusters arrivals against the bounded queue under
+        # burst load (serving_smoke's concurrency phase catches exactly
+        # that). dur_ms still reaches back to the true wait, and the
+        # record's end-time skew (~one batch) is visible-but-harmless in
+        # the exported trace. Same reasoning keeps the batch span's emit
+        # (its __exit__ above) adjacent to the device call rather than
+        # before the finish loop: one emit, not one per request.
+        # Deadline-expired requests get the span too, tagged error=
+        # "deadline" — the slow requests are exactly the ones whose
+        # queue_wait the trace exists to explain.
+        for p in live:
+            if p.request_id is not None:
+                _trace.emit_span("serve.queue_wait",
+                                 (now - p.enqueued) * 1e3,
+                                 request_id=p.request_id)
+        for p in expired:
+            if p.request_id is not None:
+                _trace.emit_span("serve.queue_wait",
+                                 (now - p.enqueued) * 1e3,
+                                 request_id=p.request_id,
+                                 error="deadline")
 
     def _drain(self, reason: str) -> None:
         with self._lock:

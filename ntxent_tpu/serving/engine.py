@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import trace as _trace
 from .metrics import ServingMetrics
 
 logger = logging.getLogger(__name__)
@@ -187,8 +188,13 @@ class InferenceEngine:
             return jax.block_until_ready(exe(self.variables, xd))
 
         t0 = time.monotonic()
-        out = (self.retry_policy.call(run_once)
-               if self.retry_policy is not None else run_once())
+        # The chunk span nests under the batcher's serve.batch span
+        # (same worker thread) in the exported trace — one slice per
+        # padded executable call, bucket/pad in its args.
+        with _trace.span("serve.device_chunk", bucket=int(bucket),
+                         rows=int(n), pad=int(pad)):
+            out = (self.retry_policy.call(run_once)
+                   if self.retry_policy is not None else run_once())
         # device_ms spans retries + backoff when they happen: it is the
         # chunk's observed service time, which is what queue math needs.
         self.metrics.device_call(bucket, rows_real=n, rows_padded=pad,

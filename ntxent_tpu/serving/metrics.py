@@ -126,6 +126,27 @@ class ServingMetrics:
         # created on first use (the ladder is not known here).
         self._bucket_lock = threading.Lock()
         self._buckets: dict[int, tuple] = {}
+        # Cross-process correlation (ISSUE 7): run identity, stamped by
+        # set_run_id. None until a run id is known (tests, bare engines).
+        self.run_id: str | None = None
+
+    def set_run_id(self, run_id: str | None) -> None:
+        """Label this serving process's metrics with a run id.
+
+        Training has stamped run_id on every JSONL record since PR 3;
+        serving scrapes were anonymous. The id lands as the standard
+        info-metric pattern (``serving_run_info{run_id="..."} 1`` — a
+        constant-label series a scraper joins on) plus a ``run_id`` key
+        in the JSON wire shape, so a serving scrape correlates with the
+        training run whose checkpoints it serves.
+        """
+        if not run_id:
+            return
+        self.run_id = str(run_id)
+        self.registry.gauge(
+            "serving_run_info",
+            "serving process identity (join key for cross-process "
+            "correlation)", labels={"run_id": self.run_id}).set(1)
 
     # -- compatibility readers (engine/bench read these directly) --------
     @property
@@ -265,6 +286,7 @@ class ServingMetrics:
             bucket_items = sorted(self._buckets.items())
         return {
             "uptime_s": round(time.time() - self.started_at, 3),
+            "run_id": self.run_id,
             "requests": self.requests,
             "responses": self.responses,
             "errors": self.errors,

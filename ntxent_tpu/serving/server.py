@@ -47,6 +47,7 @@ from urllib.parse import urlparse
 
 import numpy as np
 
+from ..obs import trace as _trace
 from ..obs.exporters import PROMETHEUS_CONTENT_TYPE, choose_format
 from ..resilience.retry import RetryPolicy
 from ..resilience.supervisor import Supervisor
@@ -277,6 +278,34 @@ def _make_handler(server: EmbeddingServer):
                 self._reply(404, {"error": f"no route {self.path!r}"})
 
         def do_POST(self):  # noqa: N802
+            # Request identity is minted AT INGEST (ISSUE 7): every POST
+            # response echoes it as X-Request-Id, and the span layer
+            # threads it queue -> batch-coalesce -> device-chunk ->
+            # respond, so one slow request can be followed through the
+            # whole stack in the exported trace (obs/trace.py).
+            rid = _trace.new_request_id()
+            t_ingest = time.monotonic()
+            status = {"code": None, "rows": None}
+
+            def reply(code: int, payload: dict,
+                      headers: dict | None = None) -> None:
+                status["code"] = code
+                merged = {"X-Request-Id": rid}
+                if headers:
+                    merged.update(headers)
+                self._reply(code, payload, merged)
+
+            try:
+                self._do_embed_post(reply, rid, status)
+            finally:
+                if self.path == "/embed" and status["code"] is not None:
+                    _trace.emit_span(
+                        "serve.request",
+                        (time.monotonic() - t_ingest) * 1e3,
+                        request_id=rid, status=status["code"],
+                        rows=status["rows"])
+
+        def _do_embed_post(self, reply, rid, status) -> None:
             # Drain the body BEFORE any early reply: with keep-alive
             # (protocol_version 1.1) an unread body would be parsed as
             # the next request on the connection — every 404/503 would
@@ -289,20 +318,20 @@ def _make_handler(server: EmbeddingServer):
                 # Too big to even read: closing the connection is what
                 # keeps the unread body from desynchronizing keep-alive.
                 self.close_connection = True
-                self._reply(413, {"error": f"body of {length} bytes "
-                                           f"exceeds the "
-                                           f"{server.max_body_bytes}-byte "
-                                           "cap"},
-                            {"Connection": "close"})
+                reply(413, {"error": f"body of {length} bytes "
+                                     f"exceeds the "
+                                     f"{server.max_body_bytes}-byte "
+                                     "cap"},
+                      {"Connection": "close"})
                 return
             body = self.rfile.read(length) if length > 0 else b""
             if self.path != "/embed":
-                self._reply(404, {"error": f"no route {self.path!r}"})
+                reply(404, {"error": f"no route {self.path!r}"})
                 return
             batcher = server.batcher
             if batcher is None or batcher.closed:
-                self._reply(503, {"error": "not serving (restarting or "
-                                           "draining)"})
+                reply(503, {"error": "not serving (restarting or "
+                                     "draining)"})
                 return
             try:
                 req = json.loads(body or b"{}")
@@ -322,36 +351,38 @@ def _make_handler(server: EmbeddingServer):
                                   server.default_timeout_s * 1e3)) / 1e3,
                     MAX_TIMEOUT_S)
             except (KeyError, TypeError, ValueError) as e:
-                self._reply(400, {"error": f"bad request: {e}"})
+                reply(400, {"error": f"bad request: {e}"})
                 return
+            status["rows"] = int(x.shape[0])
             if x.shape[0] > server.max_request_rows:
                 # One request may chunk through the ladder, but not hog
                 # the single device worker indefinitely: deadlines are
                 # only checked at dispatch, so a huge request would
                 # head-of-line-block everyone past any 429.
-                self._reply(413, {"error": f"{x.shape[0]} rows exceed "
-                                           "the per-request cap of "
-                                           f"{server.max_request_rows}; "
-                                           "split the batch client-side"})
+                reply(413, {"error": f"{x.shape[0]} rows exceed "
+                                     "the per-request cap of "
+                                     f"{server.max_request_rows}; "
+                                     "split the batch client-side"})
                 return
             try:
-                out = batcher.submit(x, timeout_s=timeout_s)
+                out = batcher.submit(x, timeout_s=timeout_s,
+                                     request_id=rid)
             except QueueFullError as e:
-                self._reply(429, {"error": str(e),
-                                  "retry_after_s": e.retry_after_s},
-                            {"Retry-After": f"{e.retry_after_s:.3f}"})
+                reply(429, {"error": str(e),
+                            "retry_after_s": e.retry_after_s},
+                      {"Retry-After": f"{e.retry_after_s:.3f}"})
             except DeadlineExceededError as e:
-                self._reply(504, {"error": str(e)})
+                reply(504, {"error": str(e)})
             except ValueError as e:  # wrong trailing shape
-                self._reply(400, {"error": str(e)})
+                reply(400, {"error": str(e)})
             except BatcherClosed:
-                self._reply(503, {"error": "not serving (draining)"})
+                reply(503, {"error": "not serving (draining)"})
             except Exception as e:  # noqa: BLE001 — device-call failure
                 logger.exception("serving: /embed failed")
-                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                reply(500, {"error": f"{type(e).__name__}: {e}"})
             else:
-                self._reply(200, {"embeddings": out.tolist(),
-                                  "dim": int(out.shape[-1]),
-                                  "rows": int(out.shape[0])})
+                reply(200, {"embeddings": out.tolist(),
+                            "dim": int(out.shape[-1]),
+                            "rows": int(out.shape[0])})
 
     return Handler

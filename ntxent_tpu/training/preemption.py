@@ -76,6 +76,21 @@ class PreemptionGuard:
             self._announced = True
             logger.warning("shutdown signal received: finishing current "
                            "step, saving checkpoint, then exiting")
+            # Flight recorder (ISSUE 7): the signal path persists the
+            # event tail NOW, from the polling thread (async-signal-safe
+            # by construction — the handler only flipped the flag), so a
+            # preempted run leaves its last N events on disk even when
+            # --log-jsonl was never enabled. Best-effort: the checkpoint
+            # save this poll unblocks must never wait on a full disk.
+            try:
+                from ..obs import events as _obs_events
+
+                # routine=True: a SIGTERM is normal preemption, so the
+                # dump lands only where telemetry already lives (the
+                # --log-jsonl dir or NTXENT_FLIGHT_DIR), never the CWD.
+                _obs_events.dump_flight(reason="signal", routine=True)
+            except Exception:
+                logger.exception("flight recorder dump failed on signal")
         return self._event.is_set()
 
     @property

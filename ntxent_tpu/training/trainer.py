@@ -34,6 +34,8 @@ from ..parallel.dist_loss import (
 )
 from ..parallel.moe import moe_aux_from
 from .lars import cosine_warmup_schedule, create_lars, simclr_learning_rate
+from ..parallel.mesh import comms_accounting
+from ..parallel.mesh import pmean as _pmean_acct
 from ..parallel.mesh import shard_map as _shard_map_compat
 
 logger = logging.getLogger(__name__)
@@ -376,21 +378,21 @@ def make_sharded_train_step(
         # objective (whose gradient is the pmean'd grads) on every device
         # — the P() out_spec would otherwise publish one arbitrary
         # shard's.
-        metrics = {"loss": jax.lax.pmean(loss, axis) if collect else loss}
+        metrics = {"loss": _pmean_acct(loss, axis) if collect else loss}
         if collect:
-            metrics["moe_aux"] = jax.lax.pmean(aux, axis)
+            metrics["moe_aux"] = _pmean_acct(aux, axis)
         return metrics
 
     if guard:
         def per_device_guarded(state: TrainState, v1, v2, scale):
             (loss, (new_stats, aux)), grads = _loss_and_grads(state, v1, v2)
-            grads = jax.lax.pmean(grads, axis)
-            new_stats = jax.lax.pmean(new_stats, axis)
+            grads = _pmean_acct(grads, axis)
+            new_stats = _pmean_acct(new_stats, axis)
             grads = jax.tree.map(lambda g: g * scale, grads)
             # A non-finite local loss whose NaN died in a masked reduction
             # could leave grads finite; fold the pmean'd loss into the
             # check so every shard agrees on it either way.
-            loss_all = jax.lax.pmean(loss, axis)
+            loss_all = _pmean_acct(loss, axis)
             state, gmetrics = _guarded_update(state, grads, loss_all,
                                               new_stats)
             return state, {**_metrics(loss, aux), **gmetrics}
@@ -414,8 +416,8 @@ def make_sharded_train_step(
 
     def per_device_step(state: TrainState, v1, v2):
         (loss, (new_stats, aux)), grads = _loss_and_grads(state, v1, v2)
-        grads = jax.lax.pmean(grads, axis)
-        new_stats = jax.lax.pmean(new_stats, axis)
+        grads = _pmean_acct(grads, axis)
+        new_stats = _pmean_acct(new_stats, axis)
         state = state.apply_gradients(grads=grads)
         state = state.replace(batch_stats=new_stats)
         return state, _metrics(loss, aux)
@@ -466,12 +468,12 @@ def make_sharded_clip_train_step(
 
         (loss, aux), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
-        grads = jax.lax.pmean(grads, axis)
+        grads = _pmean_acct(grads, axis)
         # Same rationale as make_sharded_train_step: the per-shard aux
         # makes loss shard-varying; report the pmean (== the objective).
-        metrics = {"loss": jax.lax.pmean(loss, axis) if collect else loss}
+        metrics = {"loss": _pmean_acct(loss, axis) if collect else loss}
         if collect:
-            metrics["moe_aux"] = jax.lax.pmean(aux, axis)
+            metrics["moe_aux"] = _pmean_acct(aux, axis)
         return state.apply_gradients(grads=grads), metrics
 
     sharded = _shard_map_compat(
@@ -628,9 +630,14 @@ def train_loop(
     # checkpoint/restart events. The one int() sync is paid only on
     # telemetry-enabled runs.
     step_base = 0
+    comms_mark = None
     if timeline is not None:
         step_base = int(state.step)
         timeline.new_attempt()  # restart gaps are not step time
+        # Bracket the step's trace (AOT lowering below, or the first
+        # call's jit trace) so the comms-accounting delta is exactly one
+        # compiled step's static collective profile (obs/timeline.py).
+        comms_mark = comms_accounting().totals()
     if stop_fn is not None and stop_fn():
         # Signal landed before the loop (e.g. during checkpoint restore):
         # don't pull a batch or pay the step-1 AOT compile on the way out.
@@ -721,6 +728,12 @@ def train_loop(
                     else None)
         t_step = time.perf_counter()
         state, metrics = run_step(train_step, state, v1, v2)
+        if step == 1 and comms_mark is not None:
+            # Dispatch returned, so the step is traced: the delta is its
+            # per-compiled-step comms profile (empty on single-device).
+            timeline.set_comms_per_step(
+                comms_accounting().delta(comms_mark))
+            comms_mark = None
         if metrics_lag:
             # Step N is in flight; NOW read step N-1 (overlapped drain).
             if pending is not None:

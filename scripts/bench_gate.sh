@@ -1,0 +1,91 @@
+#!/usr/bin/env bash
+# Perf-regression gate smoke (ISSUE 7): prove `bench.py --check` in both
+# directions in <60 s on CPU.
+#   1. Measure ONE quick profile per committed record (pipeline quick
+#      mode + serving ladder) and gate it against the committed
+#      BENCH_*.json — must PASS (rc 0) and append a bench_regression_gate
+#      trajectory record to PROGRESS.jsonl.
+#   2. Re-compare the SAME measurement against a doctored copy of the
+#      records whose pipeline throughput numbers are inflated 1.25x —
+#      the measurement then reads as a ~20 % regression and the gate
+#      must FAIL (rc 1) naming the regressed metrics. One measurement,
+#      two verdicts: the self-test costs no second profile.
+# Wired alongside the other smoke scripts as the CI perf step.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+workdir="$(mktemp -d)"
+trap 'rm -rf "$workdir"' EXIT
+
+current="$workdir/current.json"
+verdict="$workdir/verdict.json"
+
+# Phase 1 — measure once, gate against the committed records.
+python bench.py --check --check-save-current "$current" >"$verdict"
+python - "$verdict" <<'PY'
+import json
+import sys
+
+rec = json.load(open(sys.argv[1]))
+assert rec["metric"] == "bench_regression_gate", rec
+assert rec["ok"] is True, f"gate failed on committed records: {rec}"
+assert rec["metrics"], "gate compared nothing (no metrics extracted)"
+gated = [k for k, v in rec["metrics"].items() if "degradation" in v]
+assert any(k.startswith("pipeline/") for k in gated), gated
+print(f"bench gate: PASS on committed records ({len(gated)} metrics, "
+      f"skipped: {list(rec['skipped']) or 'none'})")
+PY
+
+# The trajectory record landed in PROGRESS.jsonl.
+python - <<'PY'
+import json
+
+records = [json.loads(line) for line in open("PROGRESS.jsonl")
+           if line.strip()]
+gates = [r for r in records if r.get("metric") == "bench_regression_gate"]
+assert gates, "no bench_regression_gate record in PROGRESS.jsonl"
+assert gates[-1]["ok"] is True, gates[-1]
+print("bench gate: trajectory record appended to PROGRESS.jsonl")
+PY
+
+# Phase 2 — doctor the committed records (+25 % pipeline throughput =
+# the measurement reads ~20 % slow) and require the gate to fail.
+doctored="$workdir/doctored"
+mkdir -p "$doctored"
+python - "$doctored" <<'PY'
+import json
+import shutil
+import sys
+
+out = sys.argv[1]
+rec = json.load(open("BENCH_pipeline.json"))
+for mode in rec.get("modes", {}).values():
+    if "steps_per_sec" in mode:
+        mode["steps_per_sec"] = round(mode["steps_per_sec"] * 1.25, 2)
+for key in ("speedup_prefetch_vs_baseline",
+            "speedup_prefetch_lag_vs_baseline"):
+    if key in rec:
+        rec[key] = round(rec[key] * 1.25, 3)
+with open(f"{out}/BENCH_pipeline.json", "w") as f:
+    json.dump(rec, f, indent=2, sort_keys=True)
+shutil.copy("BENCH_serving.json", f"{out}/BENCH_serving.json")
+PY
+
+rc=0
+NTXENT_BENCH_NO_PROGRESS=1 python bench.py --check \
+    --check-current "$current" --check-against "$doctored" \
+    >"$workdir/fail.json" || rc=$?
+[ "$rc" -eq 1 ] || { echo "gate did NOT fail on the injected regression (rc=$rc):"; cat "$workdir/fail.json"; exit 1; }
+python - "$workdir/fail.json" <<'PY'
+import json
+import sys
+
+rec = json.load(open(sys.argv[1]))
+assert rec["ok"] is False, rec
+assert any(k.startswith("pipeline/") for k in rec["failures"]), \
+    rec["failures"]
+print(f"bench gate: FAIL on injected 20% regression "
+      f"({len(rec['failures'])} metric(s): {rec['failures'][:3]} ...)")
+PY
+
+echo "bench gate: OK"

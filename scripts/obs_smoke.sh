@@ -13,8 +13,15 @@
 #     8-device simulated mesh and the chaos plan shrinks it mid-run, so
 #     the scrape must carry `checkpoint_reshard_total`/`_ms` and the
 #     JSONL restore event a `reshard="gather_replace"` field;
+#   * surface the COMMS baseline (ISSUE 7): the sharded step's traced
+#     collectives must put nonzero `collective_bytes_total{op,axis}`
+#     and `train_step_comms_bytes` on the same scrape;
+#   * export to a Perfetto-loadable trace (ISSUE 7): `ntxent-trace`
+#     over the run's JSONL must produce a schema-valid trace.json with
+#     step slices;
 #   * exit 0.
-# Pairs with `pytest -m obs` (the same layer asserted in-process).
+# Pairs with `pytest -m obs` / `pytest -m trace` (the same layers
+# asserted in-process).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -66,6 +73,7 @@ for _ in $(seq 200); do
         if grep -q '^train_steps_total [1-9]' "$scrape.tmp" \
             && grep -q '^train_divergence_total [1-9]' "$scrape.tmp" \
             && grep -q '^retries_total [1-9]' "$scrape.tmp" \
+            && grep -Eq '^collective_bytes_total\{[^}]*\} [1-9]' "$scrape.tmp" \
             && grep -q '^checkpoint_reshard_total [1-9]' "$scrape.tmp"; then
             mv "$scrape.tmp" "$scrape"
             curl -fsS "http://127.0.0.1:$port/metrics?format=json" -o "$scrape_json"
@@ -128,6 +136,17 @@ assert values.get("checkpoint_reshard_total", 0) >= 1, (
 assert values.get("checkpoint_reshard_ms_count", 0) >= 1, (
     "no samples in checkpoint_reshard_ms")
 
+# Comms baseline (ISSUE 7): the sharded step's traced collectives are
+# accounted per (op, axis) — the all_gather of embeddings and the psum/
+# pmean reductions must show nonzero bytes — and the timeline publishes
+# the per-compiled-step totals.
+comms = {k: v for k, v in values.items()
+         if k.startswith("collective_bytes_total{")}
+assert comms and any(v > 0 for v in comms.values()), sorted(values)[:40]
+assert any('op="all_gather"' in k for k in comms), sorted(comms)
+assert values.get("train_step_comms_bytes", 0) > 0, (
+    values.get("train_step_comms_bytes"))
+
 # -- JSON view of the same registry agrees on the same scrape... the two
 # formats are separate scrapes a moment apart, so compare loosely (the
 # JSON one ran second: counters can only have grown).
@@ -164,4 +183,29 @@ print(f"obs smoke: OK — steps={int(values['train_steps_total'])} "
 PY
 
 grep -q 'chaos faults fired: .*nan@3' "$log"
+
+# ISSUE 7: the chaos run's JSONL exports to a Perfetto-loadable trace —
+# schema-validated by the exporter's own validator, with step slices and
+# the chaos run's restart/divergence instants on it.
+trace_json="$workdir/trace.json"
+JAX_PLATFORMS=cpu python -c \
+    'import sys; from ntxent_tpu.obs.trace import main; sys.exit(main(sys.argv[1:]))' \
+    "$events" -o "$trace_json"
+JAX_PLATFORMS=cpu python - "$trace_json" <<'PY'
+import json
+import sys
+
+from ntxent_tpu.obs.trace import validate_chrome_trace
+
+trace = json.load(open(sys.argv[1]))
+n = validate_chrome_trace(trace)
+events = trace["traceEvents"]
+steps = [e for e in events if e.get("cat") == "step"]
+assert steps, "no step slices in the exported trace"
+phases = {e["name"] for e in events if e.get("cat") == "step_phase"}
+assert {"data_wait", "device"} <= phases, phases
+cats = {e.get("cat") for e in events}
+assert "divergence" in cats, cats  # the injected NaN is on the trace
+print(f"obs smoke: trace.json valid ({n} events, {len(steps)} steps)")
+PY
 echo "obs smoke: OK"

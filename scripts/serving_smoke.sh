@@ -10,9 +10,15 @@
 #     Retry-After), never a 5xx or unbounded latency;
 #   * /metrics content negotiation (ISSUE 3): ?format=prometheus parses
 #     as exposition text and batch_fill_ratio appears in BOTH formats
-#     with the same value (one registry, two views).
+#     with the same value (one registry, two views);
+#   * request tracing (ISSUE 7): every /embed response carries an
+#     X-Request-Id header, the run id pins /metrics (serving_run_info +
+#     the JSON run_id key), and the serve JSONL exports to a
+#     Perfetto-loadable trace whose request spans thread queue ->
+#     batch -> device-chunk -> respond.
 # Any 5xx, request timeout, or failed assertion exits nonzero.
-# Pairs with `pytest -m serving` (the same stack asserted in-process).
+# Pairs with `pytest -m serving` / `pytest -m trace` (the same stack
+# asserted in-process).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -30,7 +36,12 @@ port_file="$workdir/port"
 
 # Tiny model, tiny ladder, deliberately small queue so the flood phase
 # can actually fill it; --max-delay-ms 25 gives the coalescing window
-# the concurrency phase relies on.
+# the concurrency phase relies on. Queue depth = the concurrency
+# phase's 12 client threads: a shallower queue sits exactly AT capacity
+# in that closed loop (12 outstanding vs queue + one forming batch) and
+# passes or fails on scheduler luck — with span telemetry enabled it
+# reliably tips over. The 48-thread flood phase still fills 12 slots
+# instantly, so the backpressure assertion keeps its teeth.
 JAX_PLATFORMS=cpu python - "$port_file" >"$log" 2>&1 <<'PY' &
 import sys
 from ntxent_tpu import cli
@@ -51,11 +62,15 @@ def start_and_publish(self):
     return self
 
 _srv.EmbeddingServer.start = start_and_publish
+import os
 sys.exit(cli.serve_main([
     "--platform", "cpu", "--model", "tiny",
     "--image-size", "8", "--proj-hidden-dim", "16", "--proj-dim", "8",
-    "--buckets", "1,4,8", "--queue-size", "6", "--max-delay-ms", "25",
+    "--buckets", "1,4,8", "--queue-size", "12", "--max-delay-ms", "25",
     "--port", "0", "--stall-timeout", "30",
+    "--log-jsonl", os.path.join(os.path.dirname(port_file),
+                                "serve.jsonl"),
+    "--run-id", "smokerun1",
 ]))
 PY
 server_pid=$!
@@ -108,6 +123,25 @@ assert status == 200 and health["status"] == "serving", health
 _, m0 = get("/metrics")
 compiles_after_warmup = m0["compile"]["compiles"]
 assert compiles_after_warmup >= 3, m0["compile"]  # the 1/4/8 ladder
+# ISSUE 7: the run id pins this serving process for cross-process
+# correlation — JSON key and info metric both carry it.
+assert m0["run_id"] == "smokerun1", m0.get("run_id")
+
+# ISSUE 7: every /embed response echoes the request id minted at ingest
+# (the key the exported trace threads queue -> device-chunk with).
+body = json.dumps({"inputs": [[[[0.5] * 3] * 8] * 8]}).encode()
+req = urllib.request.Request(base + "/embed", data=body, method="POST")
+with urllib.request.urlopen(req, timeout=30) as r:
+    rid = r.headers.get("X-Request-Id")
+    assert r.status == 200 and rid, f"no X-Request-Id header ({rid!r})"
+# Error replies carry it too (a rejected request still needs tracing).
+bad = urllib.request.Request(base + "/embed", data=b'{"inputs": 3}',
+                             method="POST")
+try:
+    urllib.request.urlopen(bad, timeout=30)
+    raise AssertionError("expected 400")
+except urllib.error.HTTPError as e:
+    assert e.code == 400 and e.headers.get("X-Request-Id"), e.headers
 
 # Phase 1 — concurrent mixed sizes: 36 requests of 1..3 rows from 12
 # threads; the 25 ms window must coalesce some of them.
@@ -127,7 +161,7 @@ fill = m1["batch_fill_ratio"]
 assert fill is not None and fill > 1.0, \
     f"no coalescing: batch_fill_ratio={fill} (metrics {m1})"
 
-# Phase 2 — flood the 6-deep queue with slow-lane requests to force
+# Phase 2 — flood the 12-deep queue with slow-lane requests to force
 # backpressure: 48 oversized (32-row) requests from 48 threads. Each one
 # exceeds the largest bucket, so the engine chunks it into 4 device
 # calls — the queue drains far slower than the burst arrives and MUST
@@ -168,6 +202,8 @@ for line in prom.splitlines():
     key, _, val = line.rpartition(" ")
     prom_values[key] = float(val)
 assert "serving_batch_fill_ratio" in prom_values, sorted(prom_values)
+assert prom_values.get('serving_run_info{run_id="smokerun1"}') == 1.0, \
+    sorted(k for k in prom_values if k.startswith("serving_run_info"))
 _, m2 = get("/metrics")  # JSON re-read adjacent to the prometheus scrape
 assert m2["batch_fill_ratio"] is not None
 assert abs(prom_values["serving_batch_fill_ratio"]
@@ -188,4 +224,31 @@ PY
 kill "$server_pid"
 wait "$server_pid" 2>/dev/null || true
 server_pid=""
+
+# ISSUE 7: the serve JSONL exports to a Perfetto-loadable trace whose
+# request spans carry request ids and thread the full pipeline.
+serve_events="$workdir/serve.jsonl"
+serve_trace="$workdir/serve_trace.json"
+[ -s "$serve_events" ] || { echo "no serve JSONL written"; exit 1; }
+JAX_PLATFORMS=cpu python -c \
+    'import sys; from ntxent_tpu.obs.trace import main; sys.exit(main(sys.argv[1:]))' \
+    "$serve_events" -o "$serve_trace"
+JAX_PLATFORMS=cpu python - "$serve_trace" <<'PY'
+import json
+import sys
+
+from ntxent_tpu.obs.trace import validate_chrome_trace
+
+trace = json.load(open(sys.argv[1]))
+n = validate_chrome_trace(trace)
+spans = [e for e in trace["traceEvents"] if e.get("cat") == "span"]
+names = {e["name"] for e in spans}
+assert {"serve.request", "serve.queue_wait", "serve.batch",
+        "serve.device_chunk"} <= names, names
+reqs = [e for e in spans if e["name"] == "serve.request"]
+assert all(e["args"].get("request_id") for e in reqs), reqs[:2]
+assert trace["otherData"]["run_ids"] == ["smokerun1"], trace["otherData"]
+print(f"serving smoke: trace valid ({n} events, "
+      f"{len(reqs)} request spans)")
+PY
 echo "serving smoke: OK"

@@ -1,0 +1,876 @@
+"""Span tracing, comms accounting, flight recorder, perf-regression gate.
+
+The ISSUE 7 layer asserted in-process: span nesting and thread safety on
+the trace stack, the Chrome-trace exporter's schema (the same validator
+the smoke scripts call on real runs), the serving request-id round trip
+over HTTP (X-Request-Id echoed, spans threaded queue -> batch -> chunk
+-> respond), the mesh collective shims' trace-time byte model, the
+timeline's per-step comms series, the flight-recorder ring dump, and
+the `bench.py --check` tolerance boundary (pure compare — the end-to-end
+measurement runs in scripts/bench_gate.sh).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import urllib.error
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ntxent_tpu import obs
+from ntxent_tpu.obs import trace as trace_mod
+from ntxent_tpu.obs.registry import MetricsRegistry
+from ntxent_tpu.obs.timeline import StepTimeline
+
+pytestmark = pytest.mark.trace
+
+
+@pytest.fixture
+def event_log(tmp_path):
+    """A file-backed EventLog installed as the process hub, removed on
+    exit (the hub is process-global state)."""
+    log = obs.EventLog(str(tmp_path / "events.jsonl"))
+    previous = obs.install(log)
+    yield log
+    obs.install(previous)
+    log.close()
+
+
+def _spans(log):
+    return [r for r in log.tail(200) if r["event"] == "span"]
+
+
+# ---------------------------------------------------------------------------
+# span API
+
+
+class TestSpans:
+    def test_nesting_links_parents(self, event_log):
+        with trace_mod.span("outer") as outer:
+            assert trace_mod.current_span_id() == outer.span_id
+            with trace_mod.span("inner") as inner:
+                assert inner.parent_id == outer.span_id
+            assert trace_mod.current_span_id() == outer.span_id
+        assert trace_mod.current_span_id() is None
+        by_name = {r["name"]: r for r in _spans(event_log)}
+        assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+        assert "parent_id" not in by_name["outer"]
+        assert by_name["outer"]["dur_ms"] >= by_name["inner"]["dur_ms"]
+
+    def test_exception_pops_and_tags(self, event_log):
+        with pytest.raises(RuntimeError):
+            with trace_mod.span("boom"):
+                raise RuntimeError("x")
+        assert trace_mod.current_span_id() is None
+        (rec,) = _spans(event_log)
+        assert rec["error"] == "RuntimeError"
+
+    def test_explicit_parent_crosses_threads(self, event_log):
+        with trace_mod.span("root") as root:
+            done = threading.Event()
+
+            def worker():
+                with trace_mod.span("child", parent_id=root.span_id):
+                    pass
+                done.set()
+
+            threading.Thread(target=worker).start()
+            assert done.wait(5.0)
+        by_name = {r["name"]: r for r in _spans(event_log)}
+        assert by_name["child"]["parent_id"] == by_name["root"]["span_id"]
+
+    def test_thread_stacks_are_independent(self, event_log):
+        """Concurrent nesting in N threads: every inner span's parent is
+        its OWN thread's outer span, never another thread's."""
+        errors: list[str] = []
+        barrier = threading.Barrier(4)
+
+        def worker(i):
+            try:
+                barrier.wait(5.0)
+                with trace_mod.span(f"outer{i}") as outer:
+                    barrier.wait(5.0)
+                    with trace_mod.span(f"inner{i}") as inner:
+                        if inner.parent_id != outer.span_id:
+                            errors.append(f"{i}: crossed threads")
+            except Exception as e:  # pragma: no cover - diagnostic
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10.0)
+        assert not errors, errors
+        spans = _spans(event_log)
+        by_name = {r["name"]: r for r in spans}
+        assert len(spans) == 8
+        for i in range(4):
+            assert by_name[f"inner{i}"]["parent_id"] \
+                == by_name[f"outer{i}"]["span_id"]
+
+    def test_emit_span_without_hub_is_noop(self):
+        assert obs.get_event_log() is None or True  # hub state unknown
+        previous = obs.install(None)
+        try:
+            trace_mod.emit_span("orphan", 1.0)  # must not raise
+            with trace_mod.span("orphan2"):
+                pass
+        finally:
+            obs.install(previous)
+
+
+# ---------------------------------------------------------------------------
+# exporter
+
+
+class TestExporter:
+    def _sample_log(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        log = obs.EventLog(path)
+        previous = obs.install(log)
+        try:
+            with trace_mod.span("serve.request", request_id="r1",
+                                status=200, rows=2):
+                trace_mod.emit_span("serve.queue_wait", 3.0,
+                                    request_id="r1")
+            log.emit("step", step=7, loss=1.25, data_wait_ms=2.0,
+                     device_ms=8.0, checkpoint_ms=0.5,
+                     steps_per_sec=50.0, comms_bytes=1024.0)
+            log.emit("checkpoint", action="save", step=7, ok=True)
+            log.emit("divergence", action="observed", step=8,
+                     loss="nan")
+        finally:
+            obs.install(previous)
+            log.close()
+        return path
+
+    def test_export_validates_and_structures(self, tmp_path):
+        path = self._sample_log(tmp_path)
+        trace = obs.export_chrome_trace(path)
+        n = obs.validate_chrome_trace(trace)
+        assert n >= 7  # 2 spans + step + 3 phases + 2 instants
+        events = trace["traceEvents"]
+        xs = [e for e in events if e["ph"] == "X"]
+        # The step slice and its three phase children, on the train lane.
+        step = next(e for e in xs if e["cat"] == "step")
+        assert step["name"] == "step 7"
+        assert step["args"]["comms_bytes"] == 1024.0
+        phases = [e for e in xs if e["cat"] == "step_phase"]
+        assert {p["name"] for p in phases} \
+            == {"data_wait", "device", "checkpoint"}
+        assert all(p["tid"] == step["tid"] for p in phases)
+        # Phases tile the step slice sequentially.
+        dev = next(p for p in phases if p["name"] == "device")
+        wait = next(p for p in phases if p["name"] == "data_wait")
+        assert abs(wait["ts"] + wait["dur"] - dev["ts"]) < 1.0  # us
+        # Request-id spans share one lane distinct from the train lane.
+        req = [e for e in xs if e.get("args", {}).get("request_id") == "r1"]
+        assert len(req) == 2
+        assert len({e["tid"] for e in req}) == 1
+        assert req[0]["tid"] != step["tid"]
+        # Instants carry their scope and land on their own tracks.
+        instants = [e for e in events if e["ph"] == "i"]
+        assert {e["name"] for e in instants} \
+            == {"checkpoint:save", "divergence:observed"}
+
+    def test_validator_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            obs.validate_chrome_trace({"events": []})
+        with pytest.raises(ValueError):
+            obs.validate_chrome_trace(
+                {"traceEvents": [{"ph": "X", "name": "a", "ts": 0,
+                                  "pid": 1, "tid": 1}]})  # no dur
+        with pytest.raises(ValueError):
+            obs.validate_chrome_trace(
+                {"traceEvents": [{"ph": "B", "name": "a", "ts": 0,
+                                  "pid": 1, "tid": 1}]})  # unknown phase
+
+    def test_cli_writes_loadable_trace(self, tmp_path, capsys):
+        path = self._sample_log(tmp_path)
+        out = str(tmp_path / "trace.json")
+        assert trace_mod.main([path, "-o", out]) == 0
+        with open(out) as f:
+            trace = json.load(f)
+        assert obs.validate_chrome_trace(trace) >= 7
+        assert "wrote" in capsys.readouterr().out
+
+    def test_cli_run_id_filter(self, tmp_path):
+        path = str(tmp_path / "two_runs.jsonl")
+        for rid in ("aaa", "bbb"):
+            log = obs.EventLog(path, run_id=rid)
+            log.emit("step", step=1, loss=0.5, data_wait_ms=1.0,
+                     device_ms=1.0, checkpoint_ms=0.0, steps_per_sec=1.0)
+            log.close()
+        both = obs.export_chrome_trace(path)
+        only = obs.export_chrome_trace(path, run_id="aaa")
+        count = lambda t: sum(1 for e in t["traceEvents"]  # noqa: E731
+                              if e["ph"] != "M")
+        assert count(both) > count(only)
+        assert only["otherData"]["run_ids"] == ["aaa"]
+
+    def test_cli_empty_input_fails(self, tmp_path):
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert trace_mod.main([str(empty),
+                               "-o", str(tmp_path / "t.json")]) == 1
+
+    def test_request_lanes_bounded(self, tmp_path):
+        # A production serving log has one request_id per request;
+        # the exporter must not mint an unbounded Perfetto track (and
+        # thread_name metadata record) per id.
+        path = str(tmp_path / "many_reqs.jsonl")
+        log = obs.EventLog(path)
+        n = trace_mod.REQUEST_LANES_MAX * 3
+        for i in range(n):
+            log.emit("span", name="serve.request", span_id=f"s{i}",
+                     dur_ms=1.0, request_id=f"r{i:04d}")
+        log.close()
+        trace = obs.export_chrome_trace(path)
+        slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+        assert len(slices) == n  # every span survives the multiplexing
+        assert len(meta) <= trace_mod.REQUEST_LANES_MAX
+        assert len({e["tid"] for e in slices}) \
+            <= trace_mod.REQUEST_LANES_MAX
+        # request_id attribution survives in args on every slice.
+        assert all(e["args"]["request_id"].startswith("r")
+                   for e in slices)
+
+
+# ---------------------------------------------------------------------------
+# serving request-id round trip over HTTP
+
+
+@pytest.mark.serving
+class TestRequestIdRoundTrip:
+    def test_embed_echoes_request_id_and_threads_spans(self, event_log):
+        from ntxent_tpu.serving import EmbeddingServer, InferenceEngine
+
+        w = jnp.asarray(np.random.RandomState(0).rand(2, 3), jnp.float32)
+        engine = InferenceEngine(lambda v, x: x @ v, w,
+                                 example_shape=(2,), buckets=(1, 4))
+        server = EmbeddingServer(engine, port=0).start()
+        try:
+            body = json.dumps({"inputs": [[0.1, 0.2], [0.3, 0.4]]}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/embed", data=body,
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                rid = r.headers.get("X-Request-Id")
+                payload = json.loads(r.read())
+            assert rid, "no X-Request-Id on the 200 response"
+            assert payload["rows"] == 2
+            # Queue-wait spans are emitted AFTER the requester is woken
+            # (the documented emit-last ordering), so the worker may
+            # still be a beat behind the HTTP response: poll briefly.
+            deadline = time.monotonic() + 5.0
+            spans = {}
+            while ("serve.queue_wait" not in spans
+                   and time.monotonic() < deadline):
+                spans = {r["name"]: r for r in _spans(event_log)}
+                time.sleep(0.01)
+            # queue -> batch-coalesce -> device-chunk -> respond.
+            assert spans["serve.queue_wait"]["request_id"] == rid
+            assert spans["serve.request"]["request_id"] == rid
+            assert spans["serve.request"]["status"] == 200
+            assert rid in spans["serve.batch"]["request_ids"]
+            assert spans["serve.device_chunk"]["parent_id"] \
+                == spans["serve.batch"]["span_id"]
+            assert spans["serve.device_chunk"]["bucket"] == 4
+        finally:
+            server.close()
+
+    def test_error_replies_carry_request_id(self):
+        from ntxent_tpu.serving import EmbeddingServer, InferenceEngine
+
+        w = jnp.asarray(np.random.RandomState(0).rand(2, 3), jnp.float32)
+        engine = InferenceEngine(lambda v, x: x @ v, w,
+                                 example_shape=(2,), buckets=(1,))
+        server = EmbeddingServer(engine, port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/embed",
+                data=b'{"inputs": "garbage"}', method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError("expected a 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                assert e.headers.get("X-Request-Id")
+        finally:
+            server.close()
+
+    def test_expired_request_gets_queue_wait_span(self, event_log):
+        # A deadline-expired request is exactly the one whose queue wait
+        # the trace exists to explain: it must still get its
+        # serve.queue_wait span, tagged error="deadline".
+        from ntxent_tpu.serving import MicroBatcher, ServingMetrics
+        from ntxent_tpu.serving.batcher import DeadlineExceededError
+
+        class _BlockingEngine:
+            def __init__(self):
+                self.metrics = ServingMetrics()
+                self.max_bucket = 8
+                self.example_shape = (2,)
+                self.busy = threading.Event()
+                self.release = threading.Event()
+
+            def embed(self, x, n_requests=1):
+                self.metrics.dispatch(n_requests)
+                self.busy.set()
+                try:
+                    self.release.wait(10.0)
+                    return np.asarray(x) * 2.0
+                finally:
+                    self.busy.clear()
+
+        eng = _BlockingEngine()
+        b = MicroBatcher(eng, max_batch=8, max_delay_s=0.01, queue_size=8)
+        try:
+            # Worker blocks on the sentinel; the doomed request expires
+            # IN the queue before any dispatch can include it.
+            b.submit_async(np.zeros((1, 2), np.float32))
+            assert eng.busy.wait(5.0)
+            doomed = b.submit_async(np.full((2, 2), 7.0, np.float32),
+                                    timeout_s=0.05, request_id="doomed-1")
+            time.sleep(0.2)
+            eng.release.set()
+            assert doomed.done.wait(5.0)
+            assert isinstance(doomed.error, DeadlineExceededError)
+            deadline = time.monotonic() + 5.0
+            waits: list[dict] = []
+            while not waits and time.monotonic() < deadline:
+                waits = [r for r in _spans(event_log)
+                         if r["name"] == "serve.queue_wait"
+                         and r.get("request_id") == "doomed-1"]
+                time.sleep(0.01)
+            (rec,) = waits
+            assert rec["error"] == "deadline"
+            assert rec["dur_ms"] >= 50.0
+        finally:
+            b.close()
+
+    def test_metrics_run_id_label(self):
+        from ntxent_tpu.serving import ServingMetrics
+
+        m = ServingMetrics()
+        assert m.to_dict()["run_id"] is None
+        m.set_run_id("abc123")
+        assert m.to_dict()["run_id"] == "abc123"
+        prom = m.render_prometheus()
+        assert 'serving_run_info{run_id="abc123"} 1' in prom
+
+
+# ---------------------------------------------------------------------------
+# comms accounting
+
+
+class TestCommsAccounting:
+    def test_byte_model_inside_shard_map(self):
+        from jax.sharding import PartitionSpec as P
+
+        from ntxent_tpu.parallel import mesh as pm
+
+        m = pm.create_mesh(axis_names=("data",))
+        p = jax.device_count()
+        acct = pm.comms_accounting()
+        mark = acct.totals()
+
+        def body(x):
+            g = pm.all_gather(x, "data", tiled=True)
+            y = pm.ppermute(x, "data",
+                            [(i, (i + 1) % p) for i in range(p)])
+            s = pm.psum_scatter(g[:, 0], "data", scatter_dimension=0,
+                                tiled=True)
+            return pm.psum(jnp.sum(y) + jnp.sum(s) + jnp.sum(g), "data")
+
+        f = jax.jit(pm.shard_map(body, mesh=m, in_specs=P("data"),
+                                 out_specs=P(), check_vma=False))
+        x = jnp.ones((p * 2, 4), jnp.float32)  # shard (2, 4) = 32 B
+        float(f(x))
+        delta = acct.delta(mark)
+        shard_b = 2 * 4 * 4
+        assert delta[("all_gather", "data")] == (1, (p - 1) * shard_b)
+        assert delta[("ppermute", "data")] == (1, float(shard_b))
+        # psum_scatter input: the gathered column, (p*2,) f32 per device.
+        assert delta[("psum_scatter", "data")][0] == 1
+        assert delta[("psum_scatter", "data")][1] \
+            == pytest.approx((p - 1) / p * (p * 2 * 4))
+        # psum of a scalar: 2 * (p-1)/p * 4 bytes.
+        assert delta[("psum", "data")][1] == pytest.approx(
+            2 * (p - 1) / p * 4)
+
+    def test_all_to_all_pmax_and_scan_scaling(self):
+        """The review-hardening set: all_to_all/pmax byte models, and
+        comms_scaled multiplying scanned collectives by their iteration
+        count (a scan body traces once but runs `length` times)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ntxent_tpu.parallel import mesh as pm
+
+        m = pm.create_mesh(axis_names=("data",))
+        p = jax.device_count()
+        acct = pm.comms_accounting()
+        mark = acct.totals()
+
+        def body(x):
+            y = pm.all_to_all(x, "data", split_axis=1, concat_axis=0,
+                              tiled=True)
+            mx = pm.pmax(jnp.max(y), "data")
+
+            def step(carry, _):
+                return pm.ppermute(
+                    carry, "data",
+                    [(i, (i + 1) % p) for i in range(p)]), None
+
+            with pm.comms_scaled(p - 1):
+                z, _ = jax.lax.scan(step, x, None, length=p - 1)
+            return jnp.sum(z) + mx
+
+        f = jax.jit(pm.shard_map(body, mesh=m, in_specs=P("data"),
+                                 out_specs=P(), check_vma=False))
+        x = jnp.ones((p * 2, p * 4), jnp.float32)  # shard (2, 4p) f32
+        float(f(x))
+        delta = acct.delta(mark)
+        shard_b = 2 * (p * 4) * 4
+        assert delta[("all_to_all", "data")] == \
+            (1, pytest.approx((p - 1) / p * shard_b))
+        assert delta[("pmax", "data")][1] == pytest.approx(
+            2 * (p - 1) / p * 4)
+        # The scanned ppermute is counted once PER ITERATION.
+        assert delta[("ppermute", "data")] == \
+            (p - 1, pytest.approx((p - 1) * shard_b))
+
+    def test_ring_loss_counts_all_hops(self):
+        """The ring NT-Xent's scanned exchanges must account ~P-1 hops
+        per traced loss, not 1 (the undercount the scan scaling fixes)."""
+        from ntxent_tpu.parallel import mesh as pm
+        from ntxent_tpu.parallel.ring import make_ring_ntxent
+
+        m = pm.create_mesh(axis_names=("data",))
+        p = jax.device_count()
+        acct = pm.comms_accounting()
+        mark = acct.totals()
+        loss = jax.jit(make_ring_ntxent(m, 0.1))  # auto -> jnp on CPU
+        z = jnp.asarray(np.random.RandomState(0).rand(2 * p, 8),
+                        jnp.float32)
+        float(loss(z, z))
+        delta = acct.delta(mark)
+        calls, _ = delta[("ppermute", "data")]
+        assert calls >= 2 * (p - 1), delta  # 2 tensors x P-1 hops
+
+    def test_counters_land_in_default_registry(self):
+        from ntxent_tpu.obs.registry import default_registry
+        from ntxent_tpu.parallel import mesh as pm
+        from ntxent_tpu.parallel.dist_loss import make_sharded_ntxent
+
+        m = pm.create_mesh(axis_names=("data",))
+        loss = jax.jit(make_sharded_ntxent(m, 0.1, interpret=True))
+        z = jnp.asarray(np.random.RandomState(0).rand(
+            2 * jax.device_count(), 8), jnp.float32)
+        float(loss(z, z))
+        prom = default_registry().render_prometheus()
+        gather_lines = [
+            line for line in prom.splitlines()
+            if line.startswith("collective_bytes_total")
+            and 'op="all_gather"' in line and 'axis="data"' in line]
+        assert gather_lines, prom[:2000]
+        assert float(gather_lines[0].rsplit(" ", 1)[1]) > 0
+
+    def test_accounting_never_breaks_outside_mesh(self):
+        """The shims must be safe to trace with no axis bound — the
+        accounting is skipped, jax raises its own NameError later or the
+        caller is inside vmap: either way no telemetry crash."""
+        from ntxent_tpu.parallel import mesh as pm
+
+        mark = pm.comms_accounting().totals()
+        with pytest.raises(Exception):
+            jax.jit(lambda x: pm.psum(x, "nonexistent"))(jnp.ones(3))
+        assert pm.comms_accounting().delta(mark) == {}
+
+    def test_timeline_comms_series(self):
+        registry = MetricsRegistry()
+        timeline = StepTimeline(registry=registry)
+        timeline.set_comms_per_step({})  # empty: series untouched
+        assert registry.gauge("train_step_comms_bytes").value == 0
+        timeline.set_comms_per_step(
+            {("all_gather", "data"): (2, 896.0),
+             ("psum", "data"): (1, 7.0)})
+        assert registry.gauge("train_step_comms_bytes").value == 903.0
+        assert registry.gauge("train_step_comms_calls").value == 3
+
+    def test_train_loop_brackets_the_step_compile(self, event_log):
+        """A sharded train step run under a timeline publishes a nonzero
+        per-step comms profile (the acceptance signal obs_smoke scrapes)."""
+        import functools
+
+        from ntxent_tpu.models import ResNet, SimCLRModel
+        from ntxent_tpu.parallel import mesh as pm
+        from ntxent_tpu.training import (
+            TrainerConfig,
+            create_train_state,
+            train_loop,
+        )
+        from ntxent_tpu.training.trainer import make_sharded_train_step
+
+        m = pm.create_mesh(axis_names=("data",))
+        enc = functools.partial(ResNet, stage_sizes=(1,),
+                                small_images=True, axis_name="data")
+        model = SimCLRModel(encoder=enc, proj_hidden_dim=16, proj_dim=8,
+                            axis_name="data")
+        batch, size = jax.device_count() * 2, 8
+        cfg = TrainerConfig(batch_size=batch, total_steps=2,
+                            warmup_steps=1)
+        state = pm.replicate_state(
+            create_train_state(model, jax.random.PRNGKey(0),
+                               (1, size, size, 3), cfg), m)
+        step = make_sharded_train_step(m, 0.1)
+        registry = MetricsRegistry()
+        timeline = StepTimeline(registry=registry)
+        rng = np.random.RandomState(0)
+
+        def batches():
+            while True:
+                v = rng.rand(batch, size, size, 3).astype(np.float32)
+                yield v, np.flip(v, axis=2).copy()
+
+        train_loop(state, batches(), step, num_steps=2, log_every=10,
+                   flops_per_step=None, timeline=timeline)
+        assert registry.gauge("train_step_comms_bytes").value > 0
+        profile = [r for r in event_log.tail(50)
+                   if r["event"] == "comms_profile"]
+        assert profile and profile[0]["bytes"] > 0
+        steps = [r for r in event_log.tail(50) if r["event"] == "step"]
+        assert steps and steps[-1]["comms_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# async event-log IO (the serving hot path's write mode)
+
+
+class TestAsyncEventLog:
+    def test_round_trip_and_close_drains(self, tmp_path):
+        path = str(tmp_path / "async.jsonl")
+        log = obs.EventLog(path, async_io=True)
+        for i in range(200):
+            log.emit("span", name="s", span_id=str(i), dur_ms=1.0)
+        log.close()  # drains the writer queue before closing the handle
+        records = obs.read_events(path, event="span")
+        assert len(records) == 200
+        assert [r["span_id"] for r in records] == [str(i)
+                                                   for i in range(200)]
+
+    def test_flush_makes_records_readable_mid_run(self, tmp_path):
+        path = str(tmp_path / "async2.jsonl")
+        log = obs.EventLog(path, async_io=True)
+        log.emit("retry", fn="fetch")
+        log.flush()
+        assert obs.read_events(path, event="retry")
+        log.close()
+
+    def test_overflow_drops_oldest_and_counts(self, tmp_path):
+        log = obs.EventLog(str(tmp_path / "o.jsonl"), async_io=True,
+                           write_queue_max=4)
+        # Stall the writer by holding the wake path busy: emit faster
+        # than the 5 ms writer latency can drain is racy, so drive the
+        # queue directly under the lock instead.
+        with log._lock:
+            for i in range(10):
+                if len(log._write_queue) >= 4:
+                    log._write_queue.popleft()
+                    log.dropped_writes += 1
+                log._write_queue.append(f'{{"i": {i}}}')
+        assert log.dropped_writes == 6
+        assert len(log._write_queue) == 4
+        log.close()
+
+    def test_write_failure_requeues_not_drops(self, tmp_path):
+        # One transient ENOSPC on the writer's batched syscall must cost
+        # a retry, not the whole popped batch (sync mode loses exactly
+        # one record per failure; async must not lose thousands).
+        path = str(tmp_path / "flaky.jsonl")
+        log = obs.EventLog(path, async_io=True)
+
+        class _FlakyHandle:
+            def __init__(self, fh, failures):
+                self._fh = fh
+                self.failures = failures
+
+            def write(self, s):
+                if self.failures > 0:
+                    self.failures -= 1
+                    raise OSError(28, "No space left on device")
+                return self._fh.write(s)
+
+            def close(self):
+                self._fh.close()
+
+        with log._lock:
+            log._fh = _FlakyHandle(log._fh, failures=1)
+        for i in range(50):
+            log.emit("span", name="s", span_id=str(i), dur_ms=1.0)
+        assert log.flush(timeout_s=10.0) is True
+        assert log.dropped_writes == 0
+        records = obs.read_events(path, event="span")
+        assert [r["span_id"] for r in records] == [str(i)
+                                                   for i in range(50)]
+        log.close()
+
+    def test_flush_reports_stuck_and_dead_writers(self, tmp_path):
+        path = str(tmp_path / "stuck.jsonl")
+        log = obs.EventLog(path, async_io=True)
+        real = log._fh
+
+        class _DeadDisk:
+            def write(self, s):
+                raise OSError(5, "Input/output error")
+
+            def close(self):
+                real.close()
+
+        with log._lock:
+            log._fh = _DeadDisk()
+        log.emit("retry", fn="fetch")
+        # Failing writes keep the record queued (not dropped) and flush
+        # must SAY the file is not synced rather than return on silence.
+        assert log.flush(timeout_s=0.3) is False
+        assert log.dropped_writes == 0
+        with log._lock:
+            log._fh = real  # the disk recovers
+        assert log.flush(timeout_s=10.0) is True
+        assert obs.read_events(path, event="retry")
+        log.close()
+        # Dead writer: queued work nothing will ever drain fails fast,
+        # not after the full timeout.
+        log._write_queue.append("{}")
+        t0 = time.monotonic()
+        assert log.flush(timeout_s=5.0) is False
+        assert time.monotonic() - t0 < 1.0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+class TestFlightRecorder:
+    def test_dump_writes_ring_with_header(self, tmp_path):
+        log = obs.EventLog(None, tail=4)
+        for i in range(8):
+            log.emit("step", step=i, loss=float(i))
+        path = log.dump_flight(str(tmp_path), reason="test")
+        records = [json.loads(line) for line in open(path)]
+        assert records[0]["event"] == "flight"
+        assert records[0]["reason"] == "test"
+        assert records[0]["records"] == 4
+        # Bounded ring: only the LAST 4 steps survived.
+        assert [r["step"] for r in records[1:]] == [4, 5, 6, 7]
+
+    def test_hub_dump_and_empty_ring(self, tmp_path):
+        assert obs.dump_flight("noop") is None  # no hub installed
+        log = obs.EventLog(str(tmp_path / "ev.jsonl"))
+        previous = obs.install(log)
+        try:
+            assert obs.dump_flight("empty") is None  # nothing recorded
+            log.emit("retry", fn="fetch")
+            path = obs.dump_flight("stall:3s")
+            assert path is not None \
+                and os.path.dirname(path) == str(tmp_path)
+        finally:
+            obs.install(previous)
+            log.close()
+
+    def test_routine_dump_needs_a_home(self, tmp_path, monkeypatch):
+        """A graceful preemption (routine=True) must not litter the CWD:
+        with neither a log file nor NTXENT_FLIGHT_DIR there is nowhere
+        sanctioned to write, so the dump is skipped; a stall
+        (routine=False) still falls back to the CWD."""
+        monkeypatch.delenv("NTXENT_FLIGHT_DIR", raising=False)
+        monkeypatch.chdir(tmp_path)
+        log = obs.EventLog(None)
+        log.emit("step", step=1, loss=0.1)
+        assert log.dump_flight(reason="signal", routine=True) is None
+        assert not list(tmp_path.iterdir())
+        path = log.dump_flight(reason="stall")  # a fault always dumps
+        assert path is not None and os.path.exists(path)
+
+    def test_preemption_signal_dumps(self, tmp_path, monkeypatch):
+        from ntxent_tpu.training.preemption import PreemptionGuard
+
+        monkeypatch.setenv("NTXENT_FLIGHT_DIR", str(tmp_path))
+        log = obs.EventLog(None)
+        previous = obs.install(log)
+        try:
+            log.emit("step", step=1, loss=0.1)
+            guard = PreemptionGuard()
+            guard.request()
+            assert guard.requested()
+            flights = [f for f in os.listdir(tmp_path)
+                       if f.startswith("flight_")]
+            assert len(flights) == 1
+            # Announce (and dump) exactly once.
+            assert guard.requested()
+            assert len([f for f in os.listdir(tmp_path)
+                        if f.startswith("flight_")]) == 1
+        finally:
+            obs.install(previous)
+
+
+# ---------------------------------------------------------------------------
+# perf-regression gate (pure compare; the measurement path runs in
+# scripts/bench_gate.sh)
+
+
+def _load_bench():
+    """bench.py by file path — the module is not part of the package
+    (and must stay JAX-free to import)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    spec = importlib.util.spec_from_file_location("_bench_gate", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+class TestBenchGate:
+    def _payloads(self):
+        pipeline = {
+            "platform": "cpu",
+            "modes": {"off": {"steps_per_sec": 80.0},
+                      "prefetch+lag": {"steps_per_sec": 92.0}},
+            "speedup_prefetch_lag_vs_baseline": 1.15,
+        }
+        serving = {
+            "platform": "cpu",
+            "buckets": {"1": {"latency_ms": 1.0},       # under the floor
+                        "64": {"latency_ms": 160.0}},
+        }
+        return {"pipeline": pipeline, "serving": serving}
+
+    def test_identical_payloads_pass(self):
+        bench = _load_bench()
+        result = bench.compare_gate(self._payloads(), self._payloads())
+        assert result["ok"], result
+        assert "pipeline/off/steps_per_sec" in result["metrics"]
+        assert "serving/bucket64/latency_ms" in result["metrics"]
+        # The sub-floor bucket is not gated at all.
+        assert "serving/bucket1/latency_ms" not in result["metrics"]
+
+    def test_twenty_percent_regression_fails(self):
+        bench = _load_bench()
+        current = self._payloads()
+        current["pipeline"]["modes"]["off"]["steps_per_sec"] = 80.0 * 0.8
+        result = bench.compare_gate(current, self._payloads())
+        assert not result["ok"]
+        assert result["failures"] == ["pipeline/off/steps_per_sec"]
+        entry = result["metrics"]["pipeline/off/steps_per_sec"]
+        assert entry["degradation"] == pytest.approx(0.2)
+
+    def test_improvement_and_small_noise_pass(self):
+        bench = _load_bench()
+        current = self._payloads()
+        current["pipeline"]["modes"]["off"]["steps_per_sec"] = 95.0  # up
+        current["serving"]["buckets"]["64"]["latency_ms"] = 175.0  # +9 %
+        result = bench.compare_gate(current, self._payloads())
+        assert result["ok"], result
+
+    def test_latency_regression_fails_lower_is_better(self):
+        bench = _load_bench()
+        current = self._payloads()
+        current["serving"]["buckets"]["64"]["latency_ms"] = 160.0 * 1.4
+        result = bench.compare_gate(current, self._payloads())
+        assert result["failures"] == ["serving/bucket64/latency_ms"]
+
+    def test_platform_mismatch_skips_not_fails(self):
+        bench = _load_bench()
+        committed = self._payloads()
+        committed["pipeline"]["platform"] = "tpu"
+        current = self._payloads()
+        current["pipeline"]["modes"]["off"]["steps_per_sec"] = 1.0
+        result = bench.compare_gate(current, committed)
+        assert result["ok"], result
+        assert "pipeline" in result["skipped"]
+
+    def test_missing_measurement_fails_loudly(self):
+        bench = _load_bench()
+        result = bench.compare_gate({}, self._payloads())
+        assert not result["ok"]
+        assert set(result["failures"]) == {"pipeline", "serving"}
+
+    def test_committed_metric_absent_from_current_fails(self):
+        # A renamed key / dead mode must break the gate, not silently
+        # shrink the compared set (which metrics are gated is decided by
+        # the committed record alone).
+        bench = _load_bench()
+        current = self._payloads()
+        del current["pipeline"]["modes"]["off"]
+        result = bench.compare_gate(current, self._payloads())
+        assert not result["ok"]
+        assert "pipeline/off/steps_per_sec" in result["failures"]
+        entry = result["metrics"]["pipeline/off/steps_per_sec"]
+        assert entry["ok"] is False and "absent" in entry["error"]
+
+    def test_current_value_collapsed_to_zero_fails(self):
+        # 0.0 is falsy but it is a MEASUREMENT: the reference-side
+        # nonzero filter must not apply to the current side, or a mode
+        # whose throughput collapsed would vanish from the comparison.
+        bench = _load_bench()
+        current = self._payloads()
+        current["pipeline"]["modes"]["off"]["steps_per_sec"] = 0.0
+        result = bench.compare_gate(current, self._payloads())
+        assert "pipeline/off/steps_per_sec" in result["failures"]
+
+    def test_sub_floor_bucket_is_a_visible_skip(self):
+        # The floor-excluded bucket must appear in the verdict's skipped
+        # map — an auditor of the trajectory record should not have to
+        # re-derive which committed metrics were out of scope.
+        bench = _load_bench()
+        result = bench.compare_gate(self._payloads(), self._payloads())
+        assert result["ok"]
+        assert "serving/bucket1/latency_ms" in result["skipped"]
+
+    def test_malformed_tol_scale_env_does_not_crash(self, tmp_path):
+        import subprocess
+        import sys as _sys
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [_sys.executable, os.path.join(root, "bench.py"), "--help"],
+            env={**os.environ, "NTXENT_BENCH_GATE_TOL_SCALE": "1.5x"},
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        assert "ignoring malformed" in r.stderr
+
+    def test_tol_scale_loosens(self):
+        bench = _load_bench()
+        current = self._payloads()
+        current["pipeline"]["modes"]["off"]["steps_per_sec"] = 80.0 * 0.8
+        assert not bench.compare_gate(current, self._payloads())["ok"]
+        assert bench.compare_gate(current, self._payloads(),
+                                  tol_scale=2.0)["ok"]
+
+    def test_committed_records_extract(self):
+        """The real committed records must yield gated metrics (the gate
+        cannot silently go vacuous if a record's shape drifts)."""
+        bench = _load_bench()
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        committed = {}
+        for name in bench.GATE_CHECKS:
+            path = os.path.join(root, f"BENCH_{name}.json")
+            if os.path.exists(path):
+                committed[name] = json.load(open(path))
+        assert committed, "no committed BENCH records in the repo"
+        total = sum(len(bench.gate_metrics(n, p))
+                    for n, p in committed.items())
+        assert total >= 4, {n: list(bench.gate_metrics(n, p))
+                            for n, p in committed.items()}
