@@ -7,24 +7,35 @@ exact custom-VJP gradients, and the reference-compatible
 forward/backward/check_tensor_core_support API; ``ntxent_tpu.utils`` holds
 the capability/memory/profiling helpers. See SURVEY.md at the repo root for
 the full mapping to the reference.
+
+Exports resolve lazily (PEP 562): importing ``ntxent_tpu`` does NOT import
+JAX. That keeps the JAX-free processes honest — the fleet router tier
+(``ntxent-fleet``), the crashsim harness, and bench.py's parent all live
+inside this package namespace but must never pay the multi-second JAX
+import (let alone backend init) just to exist. The first access to a loss
+API name triggers the real import.
 """
 
-from ntxent_tpu.api import backward, check_tensor_core_support, forward, ntxent
-from ntxent_tpu.ops.infonce_pallas import info_nce_fused
-from ntxent_tpu.ops.ntxent_pallas import (
-    ntxent_loss_and_lse,
-    ntxent_loss_fused,
-    ntxent_partial_fused,
-)
-from ntxent_tpu.ops.oracle import (
-    cosine_normalize,
-    info_nce_loss,
-    ntxent_loss,
-    ntxent_loss_compat,
-    ntxent_loss_paired,
-)
+import importlib
 
 __version__ = "0.1.0"
+
+# name -> defining submodule; resolved on first attribute access.
+_EXPORTS = {
+    "forward": "ntxent_tpu.api",
+    "backward": "ntxent_tpu.api",
+    "check_tensor_core_support": "ntxent_tpu.api",
+    "ntxent": "ntxent_tpu.api",
+    "info_nce_fused": "ntxent_tpu.ops.infonce_pallas",
+    "ntxent_loss_and_lse": "ntxent_tpu.ops.ntxent_pallas",
+    "ntxent_loss_fused": "ntxent_tpu.ops.ntxent_pallas",
+    "ntxent_partial_fused": "ntxent_tpu.ops.ntxent_pallas",
+    "cosine_normalize": "ntxent_tpu.ops.oracle",
+    "info_nce_loss": "ntxent_tpu.ops.oracle",
+    "ntxent_loss": "ntxent_tpu.ops.oracle",
+    "ntxent_loss_compat": "ntxent_tpu.ops.oracle",
+    "ntxent_loss_paired": "ntxent_tpu.ops.oracle",
+}
 
 __all__ = [
     "forward",
@@ -42,3 +53,17 @@ __all__ = [
     "info_nce_fused",
     "__version__",
 ]
+
+
+def __getattr__(name):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(importlib.import_module(module), name)
+    globals()[name] = value  # cache: later access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -19,6 +19,7 @@ from __future__ import annotations
 import argparse
 import functools
 import logging
+import os
 import sys
 
 logger = logging.getLogger("ntxent_tpu.cli")
@@ -1353,6 +1354,27 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="supervised restarts after stall escalation "
                         "(resilience.Supervisor; 0 = single attempt)")
 
+    w = p.add_argument_group("fleet worker (ntxent_tpu/serving/fleet.py "
+                             "spawns ntxent-serve with these)")
+    w.add_argument("--port-file", default=None, metavar="PATH",
+                   help="publish the bound port to this file and bind "
+                        "BEFORE warmup (/readyz 503s and /embed sheds "
+                        "with Retry-After until the ladder is compiled "
+                        "— the router never routes to a cold worker)")
+    w.add_argument("--watch-ckpt", action="store_true",
+                   help="watch --ckpt-dir for new manifest-valid steps "
+                        "and hot-swap weights (zero-downtime rollout: "
+                        "warm first, swap atomically; POST /rollback "
+                        "reverts + blocklists a step)")
+    w.add_argument("--watch-poll", type=float, default=2.0,
+                   metavar="SECONDS",
+                   help="checkpoint watch poll interval")
+    w.add_argument("--watch-delay", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="adoption delay after first seeing a new step "
+                        "(the fleet staggers workers so the earliest "
+                        "becomes the router's canary cohort)")
+
     o = p.add_argument_group("observability (ntxent_tpu/obs/)")
     o.add_argument("--log-jsonl", default=None, metavar="PATH",
                    help="append typed JSONL events (request/queue/device "
@@ -1414,12 +1436,22 @@ def serve_main(argv=None) -> int:
         manager = CheckpointManager(args.ckpt_dir)
         try:
             if manager.latest_step() is None:
-                raise SystemExit(f"no checkpoint under {args.ckpt_dir}")
-            state = manager.restore(template)
+                if not args.watch_ckpt:
+                    raise SystemExit(f"no checkpoint under "
+                                     f"{args.ckpt_dir}")
+                # Watch mode may boot BEFORE the first checkpoint lands
+                # (a fleet starting alongside training): serve random
+                # weights, stay not-ready-looking via checkpoint_step=-1,
+                # adopt the first valid step the watcher sees.
+                state = template
+                logger.warning("no checkpoint under %s yet — watching "
+                               "for the first valid step", args.ckpt_dir)
+            else:
+                state = manager.restore(template)
+                logger.info("serving checkpoint step %d from %s",
+                            int(state.step), args.ckpt_dir)
         finally:
             manager.close()
-        logger.info("serving checkpoint step %d from %s",
-                    int(state.step), args.ckpt_dir)
     else:
         state = template
         logger.warning("no --ckpt-dir: serving RANDOM weights (smoke/"
@@ -1463,8 +1495,11 @@ def serve_main(argv=None) -> int:
         retry_policy=retry_policy)  # per-chunk transient-fault retries
     if event_log is not None:
         engine.metrics.set_run_id(event_log.run_id)
-    if not args.no_warmup:
-        engine.warmup()
+    initial_step = (int(state.step)
+                    if args.ckpt_dir is not None and state is not template
+                    else None)
+    if initial_step is not None:
+        engine.metrics.set_checkpoint_step(initial_step)
 
     server = EmbeddingServer(
         engine, host=args.host, port=args.port,
@@ -1475,6 +1510,40 @@ def serve_main(argv=None) -> int:
         max_restarts=args.max_restarts,
         default_timeout_s=args.timeout_ms / 1e3,
         max_request_rows=args.max_request_rows)
+
+    watcher = None
+    if args.watch_ckpt:
+        if args.ckpt_dir is None:
+            raise SystemExit("--watch-ckpt requires --ckpt-dir")
+        from ntxent_tpu.serving.worker import CheckpointWatcher
+
+        watcher = CheckpointWatcher(
+            args.ckpt_dir, template, engine,
+            poll_s=args.watch_poll, delay_s=args.watch_delay,
+            initial_step=initial_step)
+        server.reloader = watcher
+
+    if args.port_file:
+        # Fleet-worker boot order: mark the ladder cold BEFORE the
+        # listener binds (a probe racing the bind must never see
+        # ready=true), then bind (the supervisor learns the port and
+        # /readyz immediately), THEN compile — /embed sheds with
+        # Retry-After and /readyz stays red until warm, so the router
+        # never routes to a cold worker.
+        server.begin_warmup()
+        server.start()
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(server.port))
+        os.replace(tmp, args.port_file)
+        if not args.no_warmup:
+            engine.warmup()
+        server.end_warmup()
+    elif not args.no_warmup:
+        engine.warmup()
+
+    if watcher is not None:
+        watcher.start()
     try:
         completed = server.serve_forever()
     except KeyboardInterrupt:
@@ -1482,12 +1551,270 @@ def serve_main(argv=None) -> int:
         server.close()
         return 0
     finally:
+        if watcher is not None:
+            watcher.stop()
         if event_log is not None:
             from ntxent_tpu import obs
 
             obs.install(None)
             event_log.close()
     return 0 if completed else 1
+
+
+def build_fleet_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ntxent-fleet",
+        description="Serving fleet: a fault-tolerant router tier over N "
+                    "supervised ntxent-serve worker replicas — embedding "
+                    "cache, per-request retry failover, 429 load "
+                    "shedding, canaried zero-downtime checkpoint "
+                    "rollout (ntxent_tpu/serving/{router,fleet,cache,"
+                    "worker}.py)")
+    m = p.add_argument_group("model (forwarded to every worker)")
+    m.add_argument("--model", default="resnet50", choices=MODEL_CHOICES)
+    m.add_argument("--image-size", type=int, default=32)
+    m.add_argument("--stem", default="conv",
+                   choices=["conv", "space_to_depth"])
+    m.add_argument("--vit-attention", default="xla",
+                   choices=["xla", "flash"])
+    m.add_argument("--proj-hidden-dim", type=int, default=2048)
+    m.add_argument("--proj-dim", type=int, default=128)
+    m.add_argument("--head", default="features",
+                   choices=["features", "embedding"])
+    m.add_argument("--ckpt-dir", default=None,
+                   help="checkpoint dir the workers restore from AND "
+                        "watch for new steps (zero-downtime rollout); "
+                        "omit for random weights (smoke/load tests)")
+    m.add_argument("--accum-steps", type=int, default=1)
+
+    w = p.add_argument_group("workers")
+    w.add_argument("--workers", type=int, default=2,
+                   help="worker replica count")
+    w.add_argument("--buckets", default="1,4,16,64,128")
+    w.add_argument("--max-batch", type=int, default=None)
+    w.add_argument("--max-delay-ms", type=float, default=5.0)
+    w.add_argument("--queue-size", type=int, default=64)
+    w.add_argument("--timeout-ms", type=float, default=10000.0)
+    w.add_argument("--max-request-rows", type=int, default=None)
+    w.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    w.add_argument("--stall-timeout", type=float, default=None)
+    w.add_argument("--watch-poll", type=float, default=2.0,
+                   help="worker checkpoint-watch poll interval")
+    w.add_argument("--worker-stagger", type=float, default=3.0,
+                   metavar="SECONDS",
+                   help="per-worker delay step before adopting a new "
+                        "checkpoint (worker i waits i*stagger): the "
+                        "earliest adopter is the router's canary "
+                        "cohort")
+
+    rt = p.add_argument_group("router")
+    rt.add_argument("--host", default="127.0.0.1")
+    rt.add_argument("--port", type=int, default=8080,
+                    help="router port (0 picks a free one)")
+    rt.add_argument("--port-file", default=None, metavar="PATH",
+                    help="publish the router's bound port to this file")
+    rt.add_argument("--retries", type=int, default=2,
+                    help="per-request failover budget: extra workers "
+                         "tried after a 5xx/unreachable forward")
+    rt.add_argument("--forward-timeout", type=float, default=30.0)
+    rt.add_argument("--cache-rows", type=int, default=4096,
+                    help="embedding cache LRU capacity in rows")
+    rt.add_argument("--cache-ttl", type=float, default=300.0,
+                    help="embedding cache TTL seconds")
+    rt.add_argument("--no-cache", action="store_true")
+    rt.add_argument("--canary-fraction", type=float, default=0.25,
+                    help="traffic fraction routed to new-checkpoint "
+                         "workers while their canary is undecided")
+    rt.add_argument("--canary-min-requests", type=int, default=20,
+                    help="canary outcomes before a promote/rollback "
+                         "verdict")
+    rt.add_argument("--canary-max-error-rate", type=float, default=0.1,
+                    help="canary error rate above which the step is "
+                         "rolled back fleet-wide")
+
+    f = p.add_argument_group("fleet supervision")
+    f.add_argument("--workdir", default=None,
+                   help="port files + per-worker logs (default: a "
+                        "temp dir)")
+    f.add_argument("--health-poll", type=float, default=0.5,
+                   help="supervision tick: /readyz probe interval")
+    f.add_argument("--eject-after", type=int, default=3,
+                   help="consecutive probe/forward failures before a "
+                        "worker is killed and restarted")
+    f.add_argument("--worker-max-restarts", type=int, default=8,
+                   help="per-worker restart budget")
+    f.add_argument("--chaos", default=None, metavar="PLAN",
+                   help="fleet fault plan, e.g. 'killworker@10,"
+                        "slowworker@30' (ordinals are supervision "
+                        "ticks; resilience/faults.py grammar)")
+
+    o = p.add_argument_group("observability (ntxent_tpu/obs/)")
+    o.add_argument("--log-jsonl", default=None, metavar="PATH",
+                   help="router-side typed JSONL events (fleet.request/"
+                        "fleet.cache/fleet.forward spans; workers log "
+                        "to <workdir>/wN.jsonl with the same run id)")
+    o.add_argument("--run-id", default=None, metavar="ID")
+
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default=None, metavar="cpu|tpu")
+    return p
+
+
+def fleet_main(argv=None) -> int:
+    """``ntxent-fleet``: router + N supervised workers in one command.
+
+    The router process imports no JAX — workers pay backend init, the
+    router only moves bytes, which is what lets it restart in
+    milliseconds and makes its cache a robustness layer (warm keys keep
+    serving through any worker's death).
+    """
+    import signal as _signal
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    args = build_fleet_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    if args.workers < 1:
+        raise SystemExit(f"--workers must be >= 1, got {args.workers}")
+    try:
+        bucket_list = tuple(int(b) for b in args.buckets.split(",") if b)
+        if not bucket_list or min(bucket_list) < 1:
+            raise ValueError
+    except ValueError:
+        raise SystemExit(f"--buckets must be a comma list of positive "
+                         f"ints, got {args.buckets!r}")
+
+    from ntxent_tpu import obs
+    from ntxent_tpu.resilience import FaultInjector, FaultPlan
+    from ntxent_tpu.serving import (
+        EmbeddingCache,
+        FleetRouter,
+        ServingFleet,
+        WorkerPool,
+    )
+
+    injector = None
+    if args.chaos:
+        plan = FaultPlan.parse(args.chaos, seed=args.seed)
+        if plan.killworker_ticks or plan.slowworker_ticks:
+            injector = FaultInjector(plan)
+        else:
+            logger.warning("--chaos %r has no fleet actions "
+                           "(killworker@T/slowworker@T) — ignored here",
+                           args.chaos)
+
+    workdir = Path(args.workdir) if args.workdir \
+        else Path(tempfile.mkdtemp(prefix="ntxent-fleet-"))
+    workdir.mkdir(parents=True, exist_ok=True)
+
+    event_log = None
+    if args.log_jsonl or args.run_id:
+        event_log = obs.EventLog(args.log_jsonl, run_id=args.run_id,
+                                 async_io=True)
+        obs.install(event_log)
+        logger.info("fleet telemetry: run_id=%s%s", event_log.run_id,
+                    f" events -> {args.log_jsonl}" if args.log_jsonl
+                    else "")
+    run_id = event_log.run_id if event_log is not None else None
+
+    # Worker argv: ntxent-serve through a -c shim (module __main__ is
+    # the trainer). Every worker shares the SAME --seed so random-init
+    # smoke fleets serve identical weights.
+    shim = ("import sys\nfrom ntxent_tpu.cli import serve_main\n"
+            "sys.exit(serve_main(sys.argv[1:]))")
+
+    def make_cmd(worker_id: str, port_file) -> list[str]:
+        idx = int(worker_id.lstrip("w"))
+        cmd = [sys.executable, "-c", shim,
+               "--model", args.model,
+               "--image-size", str(args.image_size),
+               "--stem", args.stem,
+               "--vit-attention", args.vit_attention,
+               "--proj-hidden-dim", str(args.proj_hidden_dim),
+               "--proj-dim", str(args.proj_dim),
+               "--head", args.head,
+               "--accum-steps", str(args.accum_steps),
+               "--buckets", args.buckets,
+               "--max-delay-ms", str(args.max_delay_ms),
+               "--queue-size", str(args.queue_size),
+               "--timeout-ms", str(args.timeout_ms),
+               "--dtype", args.dtype,
+               "--seed", str(args.seed),
+               "--port", "0",
+               "--port-file", str(port_file),
+               "--watch-poll", str(args.watch_poll),
+               "--watch-delay", str(idx * args.worker_stagger)]
+        if args.max_batch is not None:
+            cmd += ["--max-batch", str(args.max_batch)]
+        if args.max_request_rows is not None:
+            cmd += ["--max-request-rows", str(args.max_request_rows)]
+        if args.stall_timeout is not None:
+            cmd += ["--stall-timeout", str(args.stall_timeout)]
+        if args.ckpt_dir is not None:
+            cmd += ["--ckpt-dir", args.ckpt_dir, "--watch-ckpt"]
+        if args.platform:
+            cmd += ["--platform", args.platform]
+        if args.log_jsonl:
+            cmd += ["--log-jsonl", str(workdir / f"{worker_id}.jsonl")]
+        if run_id:
+            cmd += ["--run-id", run_id]
+        return cmd
+
+    registry = obs.default_registry()
+    pool = WorkerPool(canary_fraction=args.canary_fraction,
+                      canary_min_requests=args.canary_min_requests,
+                      canary_max_error_rate=args.canary_max_error_rate,
+                      registry=registry)
+    cache = None
+    if not args.no_cache:
+        cache = EmbeddingCache(capacity_rows=args.cache_rows,
+                               ttl_s=args.cache_ttl,
+                               buckets=bucket_list, registry=registry)
+    fleet = ServingFleet(make_cmd, n_workers=args.workers,
+                         workdir=workdir, pool=pool,
+                         poll_s=args.health_poll,
+                         eject_after=args.eject_after,
+                         max_restarts=args.worker_max_restarts,
+                         injector=injector, registry=registry)
+    router = FleetRouter(
+        pool, cache=cache,
+        example_shape=(args.image_size, args.image_size, 3),
+        host=args.host, port=args.port, retries=args.retries,
+        forward_timeout_s=args.forward_timeout, registry=registry)
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):  # noqa: ARG001
+        logger.info("fleet: signal %d — draining", signum)
+        stop.set()
+
+    _signal.signal(_signal.SIGTERM, _on_signal)
+    _signal.signal(_signal.SIGINT, _on_signal)
+
+    fleet.start()
+    router.start()
+    if args.port_file:
+        tmp = args.port_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(router.port))
+        os.replace(tmp, args.port_file)
+    logger.info("fleet: router on http://%s:%d over %d worker(s) "
+                "(workdir %s)", args.host, router.port, args.workers,
+                workdir)
+    try:
+        while not stop.is_set():
+            stop.wait(0.2)
+    finally:
+        router.close()
+        fleet.stop()
+        if event_log is not None:
+            obs.install(None)
+            event_log.close()
+    return 0
 
 
 def build_eval_parser() -> argparse.ArgumentParser:

@@ -39,6 +39,7 @@ import logging
 import os
 import random
 import signal
+import socket
 import subprocess
 import sys
 import time
@@ -50,7 +51,8 @@ logger = logging.getLogger(__name__)
 
 __all__ = ["CrashAudit", "CrashAuditError", "AuditReport",
            "checkpoint_fingerprint", "scan_checkpoint_dir",
-           "losses_from_jsonl", "restore_reshards_from_jsonl"]
+           "losses_from_jsonl", "restore_reshards_from_jsonl",
+           "parse_schedule"]
 
 _TMP_PREFIX = ".tmp-"
 _STATE_FILE = "state.msgpack"
@@ -179,6 +181,32 @@ def restore_reshards_from_jsonl(path: Path) -> list[str]:
             if rec.get("action") == "restore"]
 
 
+def parse_schedule(spec: str) -> list[tuple[int, int]]:
+    """Parse an elastic schedule: ``"8,4x2,8"`` -> ``[(8, 1), (4, 2),
+    (8, 1)]``. Each entry is a TOTAL simulated device count, optionally
+    ``xP`` to spread it over P coordinated OS processes (``--coordinator``
+    rendezvous, devices split evenly — the first step beyond
+    single-process topology changes, ROADMAP item 5)."""
+    out: list[tuple[int, int]] = []
+    for item in filter(None, (s.strip() for s in spec.split(","))):
+        dev, _, procs = item.partition("x")
+        try:
+            d = int(dev)
+            p = int(procs) if procs else 1
+        except ValueError:
+            raise ValueError(
+                f"bad schedule entry {item!r}: expected DEVICES or "
+                f"DEVICESxPROCESSES, e.g. '8' or '4x2'") from None
+        if d < 1 or p < 1 or d % p:
+            raise ValueError(
+                f"bad schedule entry {item!r}: devices must be a "
+                f"positive multiple of processes (got {d} over {p})")
+        out.append((d, p))
+    if not out:
+        raise ValueError(f"empty schedule {spec!r}")
+    return out
+
+
 @dataclasses.dataclass
 class AuditReport:
     kills: int = 0
@@ -244,28 +272,92 @@ class CrashAudit:
             cmd += ["--log-jsonl", str(log_jsonl)]
         return cmd
 
-    def _run(self, ckpt_dir: Path, chaos: str | None = None,
-             slow_save: bool = False,
-             device_count: int | None = None,
-             log_jsonl: Path | None = None) -> tuple[int, str]:
+    def _env(self, slow_save: bool,
+             local_device_count: int | None) -> dict:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("XLA_FLAGS", None)
-        if device_count is not None and device_count > 1:
+        if local_device_count is not None and local_device_count > 1:
             # The subprocess boundary IS the elastic boundary: simulated
             # device count is fixed at backend init, so shrink/grow
             # across incarnations means a different XLA_FLAGS per launch.
             env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
-                                f"{device_count}")
+                                f"{local_device_count}")
         if slow_save:
             env["NTXENT_CKPT_SLOW_MS"] = str(self.slow_save_ms)
         else:
             env.pop("NTXENT_CKPT_SLOW_MS", None)
+        return env
+
+    def _run(self, ckpt_dir: Path, chaos: str | None = None,
+             slow_save: bool = False,
+             device_count: int | None = None,
+             log_jsonl: Path | None = None,
+             process_count: int = 1) -> tuple[int, str]:
+        if process_count > 1:
+            return self._run_multiprocess(ckpt_dir, chaos=chaos,
+                                          device_count=device_count or 1,
+                                          process_count=process_count,
+                                          log_jsonl=log_jsonl)
+        env = self._env(slow_save, device_count)
         proc = subprocess.run(
             self._cmd(ckpt_dir, chaos, log_jsonl=log_jsonl), env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             timeout=self.timeout_s)
         return proc.returncode, proc.stdout or ""
+
+    def _run_multiprocess(self, ckpt_dir: Path, chaos: str | None,
+                          device_count: int, process_count: int,
+                          log_jsonl: Path | None) -> tuple[int, str]:
+        """One incarnation as P coordinated OS processes (the real
+        multi-host shape): rendezvous via ``--coordinator`` on a free
+        localhost port, ``device_count`` simulated devices split evenly.
+
+        Every process runs the SAME chaos plan against the same seeded
+        batch schedule, so a ``kill@K`` drops the whole world at the
+        same batch ordinal — the coordinated-crash case a pod-level
+        preemption actually delivers. Process 0 owns the JSONL (loss is
+        replicated) and its exit code is the incarnation's verdict; a
+        straggler that outlives the timeout is killed and reported.
+        """
+        local_devices = device_count // process_count
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+        env = self._env(False, local_devices)
+        procs = []
+        for pid in range(process_count):
+            cmd = self._cmd(ckpt_dir, chaos,
+                            log_jsonl=log_jsonl if pid == 0 else None)
+            cmd += ["--coordinator", coordinator,
+                    "--num-processes", str(process_count),
+                    "--process-id", str(pid)]
+            procs.append(subprocess.Popen(
+                cmd, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        deadline = time.monotonic() + self.timeout_s
+        rcs: list[int | None] = [None] * process_count
+        outs: list[str] = [""] * process_count
+        for i, proc in enumerate(procs):
+            try:
+                outs[i] = proc.communicate(
+                    timeout=max(0.1, deadline - time.monotonic()))[0] \
+                    or ""
+                rcs[i] = proc.returncode
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                outs[i] = (proc.communicate()[0] or "") + \
+                    "\n[crashsim: straggler killed at timeout]"
+                rcs[i] = proc.returncode
+        combined = "\n".join(
+            f"--- process {i} (rc={rcs[i]}) ---\n{out}"
+            for i, out in enumerate(outs))
+        if chaos is None:
+            # A clean incarnation must complete on EVERY rank.
+            rc = next((r for r in rcs if r != 0), 0)
+        else:
+            rc = rcs[0]
+        return rc, combined
 
     # -- the audit --------------------------------------------------------
     def run_reference(self) -> dict:
@@ -461,7 +553,7 @@ class CrashAudit:
         return report
 
     # -- the elastic audit -------------------------------------------------
-    def elastic(self, schedule: Sequence[int] = (8, 4, 8),
+    def elastic(self, schedule: Sequence = (8, 4, 8),
                 rtol: float = 0.05, atol: float = 0.02) -> dict:
         """Shrink/grow chaos lineage: ``kill@K`` then restore across a
         changing simulated-device schedule, loss-curve continuity
@@ -473,7 +565,16 @@ class CrashAudit:
         seeded-random batch ordinal, and each successor launches with a
         DIFFERENT ``--xla_force_host_platform_device_count`` (the
         subprocess boundary is where real fleets change size), restoring
-        the previous world's checkpoint onto its own mesh. Asserts after
+        the previous world's checkpoint onto its own mesh.
+
+        A schedule entry may also be a ``(devices, processes)`` pair
+        (the ``"4x2"`` CLI syntax, ``parse_schedule``): that incarnation
+        runs as P coordinated OS processes rendezvousing through
+        ``--coordinator`` with ``devices/P`` simulated devices each — so
+        the lineage can change PROCESS topology across a death, not just
+        device count (a ``kill@K`` entry drops all P ranks at the same
+        batch ordinal; the successor restores their world onto its own
+        process count). Asserts after
         every death: no torn steps; across the lineage: at least one
         restore re-sharded (``reshard="gather_replace"`` in the JSONL
         restore events — the topology sidecar worked), the final step was
@@ -486,9 +587,13 @@ class CrashAudit:
         """
         t0 = time.monotonic()
         rng = random.Random(self.seed * 7919 + 1)
+        norm: list[tuple[int, int]] = [
+            (int(e), 1) if not isinstance(e, (tuple, list))
+            else (int(e[0]), int(e[1]))
+            for e in schedule]
         ref_dir = self.workdir / "elastic_ref"
         ref_jsonl = self.workdir / "elastic_ref.jsonl"
-        rc, out = self._run(ref_dir, device_count=schedule[0],
+        rc, out = self._run(ref_dir, device_count=norm[0][0],
                             log_jsonl=ref_jsonl)
         if rc != 0:
             raise CrashAuditError(
@@ -503,8 +608,8 @@ class CrashAudit:
         incarnations: list[dict] = []
         kills = 0
         merged_losses: dict[int, float] = {}
-        for i, devices in enumerate(schedule):
-            final = i == len(schedule) - 1
+        for i, (devices, processes) in enumerate(norm):
+            final = i == len(norm) - 1
             latest = max(_step_dirs(crash_dir), default=0)
             jsonl = self.workdir / f"elastic0_run{i}.jsonl"
             chaos = None
@@ -519,7 +624,8 @@ class CrashAudit:
                 # re-shard, but prove less).
                 chaos = f"kill@{rng.randint(2, max(2, remaining - 2))}"
             rc, out = self._run(crash_dir, chaos=chaos,
-                                device_count=devices, log_jsonl=jsonl)
+                                device_count=devices, log_jsonl=jsonl,
+                                process_count=processes)
             scan = scan_checkpoint_dir(crash_dir)
             if scan["torn"]:
                 raise CrashAuditError(
@@ -537,12 +643,13 @@ class CrashAudit:
                     f"completion, got rc={rc}:\n{out[-2000:]}")
             merged_losses.update(losses_from_jsonl(jsonl))
             incarnations.append({
-                "devices": int(devices), "chaos": chaos, "rc": rc,
+                "devices": int(devices), "processes": int(processes),
+                "chaos": chaos, "rc": rc,
                 "resumed_from": latest,
                 "reshards": restore_reshards_from_jsonl(jsonl)})
-            logger.info("elastic incarnation %d: devices=%d chaos=%s "
-                        "rc=%s resumed_from=%d", i, devices, chaos, rc,
-                        latest)
+            logger.info("elastic incarnation %d: devices=%d processes=%d "
+                        "chaos=%s rc=%s resumed_from=%d", i, devices,
+                        processes, chaos, rc, latest)
 
         final_step = max(_step_dirs(crash_dir), default=0)
         if final_step != self.steps:
@@ -553,7 +660,7 @@ class CrashAudit:
         if "gather_replace" not in reshards:
             raise CrashAuditError(
                 "no topology re-shard observed across the device "
-                f"schedule {tuple(schedule)} (restore events: {reshards})")
+                f"schedule {tuple(norm)} (restore events: {reshards})")
         compared = sorted(set(merged_losses) & set(ref_losses))
         if len(compared) < self.steps // 2:
             raise CrashAuditError(
@@ -575,7 +682,8 @@ class CrashAudit:
             ref_fp, got_fp, crc_exact = {}, {}, False
         summary = {
             "lineage": "elastic0", "mode": "elastic",
-            "device_schedule": [int(d) for d in schedule],
+            "device_schedule": [d for d, _ in norm],
+            "process_schedule": [p for _, p in norm],
             "kills": kills,
             "restarts": len(incarnations) - 1,
             "device_counts": [inc["devices"] for inc in incarnations],
@@ -621,7 +729,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--schedule", default="8,4,8",
                         help="elastic mode: comma list of simulated "
                              "device counts, one incarnation each; every "
-                             "non-final one is SIGKILLed mid-run")
+                             "non-final one is SIGKILLed mid-run. An "
+                             "entry DxP (e.g. 4x2) runs that incarnation "
+                             "as P coordinated OS processes with D/P "
+                             "devices each (multi-process topology "
+                             "change)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--timeout-s", type=float, default=180.0)
     args = parser.parse_args(argv)
@@ -632,10 +744,11 @@ def main(argv: list[str] | None = None) -> int:
     try:
         if args.mode == "elastic":
             summary = audit.elastic(
-                schedule=[int(s) for s in args.schedule.split(",")])
+                schedule=parse_schedule(args.schedule))
             print(json.dumps(summary, indent=2, sort_keys=True))
             print(f"elastic audit: OK — schedule "
-                  f"{summary['device_schedule']}, {summary['kills']} "
+                  f"{summary['device_schedule']} over processes "
+                  f"{summary['process_schedule']}, {summary['kills']} "
                   f"kills, loss continuity over "
                   f"{summary['loss_continuity']['steps_compared']} steps "
                   f"(max abs diff "

@@ -45,10 +45,25 @@ schedule randomization):
                    onto it;
 * ``truncate@a`` — after attempt number a ends, truncate the newest
                    checkpoint's largest file → exercises checksum
-                   verification and newest-VALID fallback (checkpoint.py).
+                   verification and newest-VALID fallback (checkpoint.py);
+* ``killworker@t`` — SIGKILL one serving-fleet worker on the t-th fleet
+                   supervision tick, counted from the first tick where
+                   every worker is ready (serving/fleet.py polls health
+                   once per tick; targets rotate round-robin over the
+                   live workers) → exercises the router's per-request retry
+                   budget (zero client-visible 5xx) and the fleet's
+                   restart-with-backoff path;
+* ``slowworker@t`` — SIGSTOP one worker on the t-th fleet tick for a few
+                   seconds (then SIGCONT): the gray failure — a process
+                   that is alive but answers nothing → exercises
+                   health-probe failure counting and ejection, without
+                   the clean signal a death gives.
 
 ``FaultPlan`` is the parsed, immutable spec; ``FaultInjector`` carries the
-runtime counters and the wrapping hooks call sites use.
+runtime counters and the wrapping hooks call sites use. Batch-path
+ordinals (nan/sigterm/kill/crash/shrink/grow) count served batches;
+``fetch``/``diskfull`` count their own IO calls; ``truncate`` counts
+supervisor attempts; the fleet actions count supervision ticks.
 """
 
 from __future__ import annotations
@@ -68,7 +83,7 @@ __all__ = ["ChaosError", "TopologyChange", "FaultPlan", "FaultInjector",
            "truncate_checkpoint_file"]
 
 _KINDS = ("nan", "sigterm", "kill", "crash", "fetch", "diskfull",
-          "shrink", "grow", "truncate")
+          "shrink", "grow", "truncate", "killworker", "slowworker")
 
 
 class ChaosError(RuntimeError):
@@ -100,19 +115,28 @@ class FaultPlan:
     shrink_batches: tuple[int, ...] = ()
     grow_batches: tuple[int, ...] = ()
     truncate_attempts: tuple[int, ...] = ()
+    killworker_ticks: tuple[int, ...] = ()
+    slowworker_ticks: tuple[int, ...] = ()
     seed: int = 0
 
     @classmethod
     def parse(cls, spec: str, seed: int = 0) -> "FaultPlan":
-        """Parse ``"nan@3,sigterm@6,kill@4,shrink@5,grow@9,truncate@1"``
-        (the --chaos syntax)."""
+        """Parse ``"nan@3,sigterm@6,kill@4,shrink@5,killworker@7"``
+        (the --chaos syntax). An unknown action names the full valid
+        set — a typo'd chaos plan must fail loud and teachable, not
+        with a bare error."""
         buckets: dict[str, list[int]] = {k: [] for k in _KINDS}
         for item in filter(None, (s.strip() for s in spec.split(","))):
             kind, sep, at = item.partition("@")
-            if not sep or kind not in buckets:
+            if not sep:
                 raise ValueError(
-                    f"bad fault {item!r}: expected one of "
-                    f"{'|'.join(_KINDS)}@<ordinal>, e.g. 'nan@3'")
+                    f"bad fault {item!r}: expected <action>@<ordinal>, "
+                    f"e.g. 'nan@3'; valid actions: "
+                    f"{', '.join(sorted(_KINDS))}")
+            if kind not in buckets:
+                raise ValueError(
+                    f"unknown fault action {kind!r} in {item!r}; valid "
+                    f"actions: {', '.join(sorted(_KINDS))}")
             try:
                 ordinal = int(at)
             except ValueError:
@@ -129,6 +153,8 @@ class FaultPlan:
                    shrink_batches=tuple(buckets["shrink"]),
                    grow_batches=tuple(buckets["grow"]),
                    truncate_attempts=tuple(buckets["truncate"]),
+                   killworker_ticks=tuple(buckets["killworker"]),
+                   slowworker_ticks=tuple(buckets["slowworker"]),
                    seed=seed)
 
     def empty(self) -> bool:
@@ -136,7 +162,8 @@ class FaultPlan:
                     or self.kill_batches or self.crash_batches
                     or self.fetch_calls or self.diskfull_writes
                     or self.shrink_batches or self.grow_batches
-                    or self.truncate_attempts)
+                    or self.truncate_attempts or self.killworker_ticks
+                    or self.slowworker_ticks)
 
 
 def _poison_leaf(x):
@@ -196,6 +223,7 @@ class FaultInjector:
         self._fetches = 0
         self._ckpt_writes = 0
         self._attempts = 0
+        self._fleet_ticks = 0
         self.fired: list[str] = []
 
     # -- batch-path faults (wrap the training data iterator) -------------
@@ -274,6 +302,22 @@ class FaultInjector:
                 errno.ENOSPC,
                 f"chaos: injected ENOSPC on checkpoint write "
                 f"{self._ckpt_writes}")
+
+    # -- fleet faults (serving/fleet.py calls once per supervision tick) --
+    def on_fleet_tick(self) -> list[str]:
+        """Advance the fleet-tick ordinal; return the fleet actions due
+        this tick (``["killworker@3", "slowworker@5"]``-style strings —
+        the fleet picks WHICH worker, round-robin over the live set, so
+        the plan stays deterministic without naming pids)."""
+        self._fleet_ticks += 1
+        t = self._fleet_ticks
+        due: list[str] = []
+        if t in self.plan.killworker_ticks:
+            due.append(f"killworker@{t}")
+        if t in self.plan.slowworker_ticks:
+            due.append(f"slowworker@{t}")
+        self.fired.extend(due)
+        return due
 
     # -- checkpoint faults (supervisor calls between attempts) ------------
     def between_attempts(self, checkpoint_dir: str | os.PathLike | None):

@@ -19,28 +19,81 @@ layer. The stack, bottom-up:
 * ``metrics.ServingMetrics`` — per-bucket counts, queue depth,
   batch-fill ratio, padding waste, p50/p95/p99 latency, as JSON.
 
-Launch with ``ntxent-serve`` (cli.py); load-test with
-``scripts/serving_smoke.sh``; benchmark with ``python bench.py
---serving`` (writes BENCH_serving.json).
+One process is not a fleet (ISSUE 8 / ROADMAP item 4); the fleet tier
+sits in front of N of the above:
+
+* ``cache.EmbeddingCache`` — content-hash keyed per-row cache with TTL
+  + LRU bounds: repeated rows never reach a worker (and keep serving
+  through a worker crash);
+* ``router.FleetRouter`` / ``router.WorkerPool`` — the routing tier:
+  least-in-flight spread, per-request retry budget (a worker SIGKILL
+  under load yields zero client-visible 5xx), 429 load-shedding when
+  all workers saturate, canary fractions + automatic rollback across
+  checkpoint rollouts;
+* ``worker.CheckpointWatcher`` — worker-side zero-downtime rollout:
+  watch the crash-safe checkpoint dir, warm the ladder, swap
+  atomically, roll back on router command;
+* ``fleet.ServingFleet`` — spawn/supervise the worker subprocesses
+  (health-checked, ejected on consecutive failures, restarted with
+  backoff; ``killworker@K``/``slowworker@K`` chaos).
+
+Launch with ``ntxent-serve`` (one worker) or ``ntxent-fleet`` (router
++ N workers); load-test with ``scripts/serving_smoke.sh`` /
+``scripts/fleet_smoke.sh``; benchmark with ``python bench.py
+--serving`` / ``--fleet`` (BENCH_serving.json / BENCH_fleet.json).
+
+Exports resolve lazily (PEP 562): the router tier (cache/router/fleet)
+is JAX-free, and the ``ntxent-fleet`` router process importing it must
+not pay the JAX import that ``engine``/``server``/``worker`` (the
+worker-process half) would drag in eagerly.
 """
 
-from .batcher import (
-    BatcherClosed,
-    DeadlineExceededError,
-    MicroBatcher,
-    QueueFullError,
-)
-from .engine import DEFAULT_BUCKETS, InferenceEngine
-from .metrics import ServingMetrics
-from .server import EmbeddingServer
+import importlib
+
+# name -> defining submodule; resolved on first attribute access.
+_EXPORTS = {
+    "BatcherClosed": "batcher",
+    "DeadlineExceededError": "batcher",
+    "MicroBatcher": "batcher",
+    "QueueFullError": "batcher",
+    "EmbeddingCache": "cache",
+    "DEFAULT_BUCKETS": "engine",
+    "InferenceEngine": "engine",
+    "ServingFleet": "fleet",
+    "ServingMetrics": "metrics",
+    "FleetRouter": "router",
+    "WorkerPool": "router",
+    "EmbeddingServer": "server",
+    "CheckpointWatcher": "worker",
+}
+
+
+def __getattr__(name):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(importlib.import_module(f".{module}", __name__), name)
+    globals()[name] = value  # cache: later access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
+
 
 __all__ = [
     "BatcherClosed",
+    "CheckpointWatcher",
     "DEFAULT_BUCKETS",
     "DeadlineExceededError",
+    "EmbeddingCache",
     "EmbeddingServer",
+    "FleetRouter",
     "InferenceEngine",
     "MicroBatcher",
     "QueueFullError",
+    "ServingFleet",
     "ServingMetrics",
+    "WorkerPool",
 ]

@@ -50,19 +50,29 @@ __all__ = ["DEFAULT_BUCKETS", "InferenceEngine"]
 DEFAULT_BUCKETS: tuple[int, ...] = (1, 4, 16, 64, 128)
 
 
-def _model_hash(variables, version: int) -> str:
-    """Cheap structural fingerprint of a variables pytree.
-
-    Covers treedef + leaf shapes/dtypes (a different architecture can
-    never collide into a cached executable) plus an explicit reload
-    version — value-only weight swaps keep the same structure, so the
-    counter is what invalidates their cache entries.
-    """
+def _structure_hash(variables) -> str:
+    """Structural fingerprint of a variables pytree: treedef + leaf
+    shapes/dtypes. Two pytrees that agree here are interchangeable
+    ARGUMENTS to the same compiled executable (weights are passed in,
+    not closed over) — which is exactly what makes the fleet's
+    zero-downtime weight swap compile-free."""
     leaves, treedef = jax.tree_util.tree_flatten(variables)
     h = hashlib.sha1(str(treedef).encode())
     for leaf in leaves:
         h.update(f"{getattr(leaf, 'shape', ())}:"
                  f"{getattr(leaf, 'dtype', type(leaf))};".encode())
+    return h.hexdigest()[:16]
+
+
+def _model_hash(variables, version: int) -> str:
+    """Cheap cache-key fingerprint of a variables pytree.
+
+    Covers treedef + leaf shapes/dtypes (a different architecture can
+    never collide into a cached executable) plus an explicit reload
+    version — ``update_variables`` value swaps keep the same structure,
+    so the counter is what invalidates their cache entries.
+    """
+    h = hashlib.sha1(_structure_hash(variables).encode())
     h.update(f"v{version}".encode())
     return h.hexdigest()[:16]
 
@@ -122,6 +132,65 @@ class InferenceEngine:
             self._hash = _model_hash(variables, self._version)
             self._cache.clear()
 
+    def swap_variables(self, variables, warm: bool = True) -> str:
+        """Zero-downtime weight swap (the fleet rollout path).
+
+        Unlike ``update_variables`` (invalidate now, recompile on the
+        next request), this never serves a cold bucket:
+
+        * same structure (the overwhelmingly common case — a newer
+          checkpoint of the same model): compiled executables take the
+          weights as an ARGUMENT, so they remain valid for the new
+          values. The swap is one reference assignment under the lock —
+          no compile, no cache invalidation. Returns ``"reused"``.
+        * changed structure: the full ladder is compiled against the new
+          weights FIRST (requests keep flowing to the old set), then
+          weights + cache key are published atomically. Returns
+          ``"warmed"`` (or ``"cold"`` with ``warm=False``).
+
+        In-flight ``embed`` calls snapshot (weights, executable) as a
+        consistent pair, so a request that raced the swap runs entirely
+        on the old model or entirely on the new one — never an old
+        executable over new weights.
+        """
+        if _structure_hash(variables) == _structure_hash(self.variables):
+            with self._lock:
+                self.variables = variables
+            self.metrics.model_swap("reused")
+            logger.info("serving: swapped weights (structure unchanged — "
+                        "compiled ladder reused)")
+            return "reused"
+        version = self._version + 1
+        new_hash = _model_hash(variables, version)
+        if warm:
+            for bucket in self.buckets:
+                exe = self._executable(bucket, new_hash, variables)
+                x = jnp.zeros((bucket,) + self.example_shape, self.dtype)
+                jax.block_until_ready(exe(variables, x))
+        with self._lock:
+            self.variables = variables
+            self._version = version
+            self._hash = new_hash
+            # Drop the previous structure's executables: they are
+            # unreachable by key from here on, and each one pins device
+            # allocations — a worker that swaps structures repeatedly
+            # (or ping-pongs via rollback) must not grow the cache
+            # without bound. In-flight chunks hold their own (weights,
+            # exe) snapshot references, so eviction cannot yank an
+            # executable out from under them.
+            self._cache = {k: v for k, v in self._cache.items()
+                           if k[2] == new_hash}
+        self.metrics.model_swap("warmed" if warm else "cold")
+        logger.info("serving: swapped weights (structure changed — "
+                    "ladder %s)", "pre-warmed" if warm else "cold")
+        return "warmed" if warm else "cold"
+
+    def _snapshot(self) -> tuple:
+        """(variables, cache hash) as a consistent pair — the unit a
+        chunk must hold constant across a concurrent swap."""
+        with self._lock:
+            return self.variables, self._hash
+
     # -- bucket math -----------------------------------------------------
     def bucket_for(self, n: int) -> int:
         """Smallest ladder bucket >= n (n must fit the ladder)."""
@@ -136,8 +205,11 @@ class InferenceEngine:
                 return b
         raise AssertionError("unreachable")  # pragma: no cover
 
-    def _executable(self, bucket: int) -> Callable:
-        key = (bucket, self.dtype.name, self._hash)
+    def _executable(self, bucket: int, model_hash: str | None = None,
+                    variables=None) -> Callable:
+        if model_hash is None or variables is None:
+            variables, model_hash = self._snapshot()
+        key = (bucket, self.dtype.name, model_hash)
         with self._lock:
             exe = self._cache.get(key)
         if exe is not None:
@@ -149,12 +221,12 @@ class InferenceEngine:
         from ..training.trainer import aot_compile_with_flops
 
         t0 = time.monotonic()
-        _, compiled = aot_compile_with_flops(self._jit_fn, self.variables, x)
+        _, compiled = aot_compile_with_flops(self._jit_fn, variables, x)
         if compiled is None:
             # Typed-exception fallback already logged by the helper:
             # degrade to the jit wrapper. Prime its dispatch cache now so
             # the first real request still pays no compile.
-            jax.block_until_ready(self._jit_fn(self.variables, x))
+            jax.block_until_ready(self._jit_fn(variables, x))
             compiled = self._jit_fn
         logger.info("serving: compiled bucket %d (%s) in %.2fs", bucket,
                     self.dtype.name, time.monotonic() - t0)
@@ -166,11 +238,12 @@ class InferenceEngine:
     # -- public API ------------------------------------------------------
     def warmup(self) -> None:
         """Compile and execute every ladder bucket once, so no request
-        ever pays first-compile latency (the /healthz readiness gate)."""
+        ever pays first-compile latency (the /readyz readiness gate)."""
+        variables, model_hash = self._snapshot()
         for bucket in self.buckets:
-            exe = self._executable(bucket)
+            exe = self._executable(bucket, model_hash, variables)
             x = jnp.zeros((bucket,) + self.example_shape, self.dtype)
-            jax.block_until_ready(exe(self.variables, x))
+            jax.block_until_ready(exe(variables, x))
         logger.info("serving: warmup complete (%d buckets: %s)",
                     len(self.buckets), list(self.buckets))
 
@@ -181,11 +254,15 @@ class InferenceEngine:
         if pad:
             x = np.concatenate(
                 [x, np.zeros((pad,) + self.example_shape, x.dtype)])
-        exe = self._executable(bucket)
+        # One consistent (weights, executable) pair per chunk: a swap
+        # landing mid-request flips the NEXT chunk, never mixes models
+        # inside one call.
+        variables, model_hash = self._snapshot()
+        exe = self._executable(bucket, model_hash, variables)
         xd = jnp.asarray(x, self.dtype)
 
         def run_once():
-            return jax.block_until_ready(exe(self.variables, xd))
+            return jax.block_until_ready(exe(variables, xd))
 
         t0 = time.monotonic()
         # The chunk span nests under the batcher's serve.batch span

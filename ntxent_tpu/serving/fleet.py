@@ -1,0 +1,372 @@
+"""Worker-replica supervision: spawn, health-check, eject, restart.
+
+``ServingFleet`` owns the worker PROCESSES the way ``crashsim`` owns
+training lineages: each worker is a subprocess (an ``ntxent-serve``
+with ``--port-file`` + ``--watch-ckpt``), its stdout goes to a
+per-worker log, its bound port is published through a port file, and a
+single monitor thread runs the supervision loop:
+
+* **liveness**: a dead process (SIGKILL, OOM, crash) is detected by
+  ``poll()`` and restarted after ``RetryPolicy`` backoff — the same
+  restart-with-backoff vocabulary the training Supervisor uses, with
+  the per-worker restart count as the backoff ordinal;
+* **health**: each tick probes ``/readyz`` (readiness distinct from
+  liveness — a warming worker is alive but takes no traffic) and feeds
+  ``WorkerPool.set_health``, so the router's routing table is never
+  more than one poll behind reality. The router's own forward failures
+  land in the same ``consecutive_failures`` counter;
+* **ejection**: ``eject_after`` consecutive failures (probe or
+  forward) SIGKILLs the worker and schedules a restart — a wedged-but-
+  listening worker is indistinguishable from a slow one except by this
+  counter, which is why slowworker chaos drives exactly this path;
+* **fleet chaos**: ``FaultPlan``'s ``killworker@K`` / ``slowworker@K``
+  fire on the K-th supervision tick — counted from the first tick
+  where every worker is ready, so a plan hits a SERVING fleet at a
+  deterministic point rather than a booting one — via
+  ``FaultInjector.on_fleet_tick``: SIGKILL (no cleanup, the crash the
+  retry budget must hide) and SIGSTOP-for-a-while (the gray failure
+  health checks must catch).
+
+The fleet mutates the pool; the router only reads it. Everything here
+is JAX-free — supervision must never pay backend-init latency.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+from ..obs.registry import MetricsRegistry
+from ..resilience.retry import RetryPolicy
+from .router import WorkerPool
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ManagedWorker", "ServingFleet"]
+
+
+class ManagedWorker:
+    """One supervised worker subprocess (mutated by the monitor only)."""
+
+    def __init__(self, worker_id: str, cmd: list[str], port_file: Path,
+                 log_path: Path):
+        self.worker_id = worker_id
+        self.cmd = cmd
+        self.port_file = port_file
+        self.log_path = log_path
+        self.proc: subprocess.Popen | None = None
+        self.port: int | None = None
+        self.restarts = 0
+        self.restart_at: float | None = None
+        self.slow_until: float | None = None
+
+    @property
+    def url(self) -> str | None:
+        return f"http://127.0.0.1:{self.port}" if self.port else None
+
+    @property
+    def pid(self) -> int | None:
+        return self.proc.pid if self.proc is not None else None
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class ServingFleet:
+    """Spawn and supervise N workers; keep a ``WorkerPool`` truthful.
+
+    ``make_cmd(worker_id, port_file) -> list[str]`` builds the worker's
+    argv (the CLI passes serve flags through; tests pass any process
+    that writes its port to ``port_file`` and answers ``/readyz``).
+    """
+
+    def __init__(self, make_cmd, n_workers: int, workdir,
+                 pool: WorkerPool | None = None,
+                 poll_s: float = 0.5,
+                 eject_after: int = 3,
+                 health_timeout_s: float = 2.0,
+                 max_restarts: int = 8,
+                 backoff: RetryPolicy | None = None,
+                 injector=None,
+                 slowworker_s: float = 3.0,
+                 env: dict | None = None,
+                 registry: MetricsRegistry | None = None):
+        if n_workers < 1:
+            raise ValueError(f"n_workers must be >= 1, got {n_workers}")
+        self.make_cmd = make_cmd
+        self.workdir = Path(workdir)
+        self.workdir.mkdir(parents=True, exist_ok=True)
+        self.pool = pool if pool is not None else WorkerPool()
+        self.poll_s = float(poll_s)
+        self.eject_after = int(eject_after)
+        self.health_timeout_s = float(health_timeout_s)
+        self.max_restarts = int(max_restarts)
+        self.backoff = backoff or RetryPolicy(
+            max_attempts=max_restarts + 1, base_delay_s=0.5,
+            multiplier=2.0, max_delay_s=15.0, jitter=0.1)
+        self.injector = injector
+        self.slowworker_s = float(slowworker_s)
+        self.env = env
+        self.registry = registry if registry is not None \
+            else self.pool.registry
+        r = self.registry
+        self._spawns = r.counter("fleet_worker_spawns_total",
+                                 "worker processes launched")
+        self._worker_restarts = r.counter(
+            "fleet_worker_restarts_total",
+            "workers relaunched after death or ejection")
+        self._ejections = r.counter(
+            "fleet_worker_ejections_total",
+            "workers killed after consecutive health failures")
+        self._chaos_armed = False
+        self._chaos_kills = 0
+        self._chaos_slows = 0
+        self.workers = [
+            ManagedWorker(f"w{i}",
+                          cmd=None,  # built at spawn (port file fresh)
+                          port_file=self.workdir / f"w{i}.port",
+                          log_path=self.workdir / f"w{i}.log")
+            for i in range(int(n_workers))
+        ]
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- process control ---------------------------------------------------
+    def _spawn(self, worker: ManagedWorker) -> None:
+        worker.port_file.unlink(missing_ok=True)
+        worker.port = None
+        worker.restart_at = None
+        worker.slow_until = None
+        worker.cmd = self.make_cmd(worker.worker_id, worker.port_file)
+        try:
+            log = open(worker.log_path, "ab")
+            try:
+                worker.proc = subprocess.Popen(
+                    worker.cmd, stdout=log, stderr=subprocess.STDOUT,
+                    env=self.env)
+            finally:
+                log.close()  # the child holds its own fd now
+        except OSError as e:
+            # A failed launch (fork/exec ENOMEM, transient FS trouble)
+            # must reschedule, not strand the worker: restart_at was
+            # cleared above and proc is None, so without this no later
+            # tick would ever look at the worker again — silently lost
+            # capacity.
+            worker.proc = None
+            logger.error("fleet: spawn of %s failed: %r",
+                         worker.worker_id, e)
+            self._schedule_restart(worker, f"spawn failed: {e}")
+            return
+        self._spawns.inc()
+        logger.info("fleet: spawned %s (pid %d)", worker.worker_id,
+                    worker.proc.pid)
+
+    def _kill(self, worker: ManagedWorker) -> None:
+        if worker.proc is not None and worker.proc.poll() is None:
+            try:
+                worker.proc.kill()
+                worker.proc.wait(timeout=5.0)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+
+    def _schedule_restart(self, worker: ManagedWorker,
+                          reason: str) -> None:
+        # The failure count belonged to the incarnation that just died;
+        # the replacement must boot with a clean slate or the eject
+        # check fires again before its port file even appears.
+        self.pool.clear_failures(worker.worker_id)
+        worker.restarts += 1
+        if worker.restarts > self.max_restarts:
+            logger.error("fleet: %s exceeded %d restarts (%s) — leaving "
+                         "it down", worker.worker_id, self.max_restarts,
+                         reason)
+            worker.restart_at = None
+            return
+        delay = self.backoff.delay_for(min(worker.restarts,
+                                           self.backoff.max_attempts))
+        worker.restart_at = time.monotonic() + delay
+        self._worker_restarts.inc()
+        logger.warning("fleet: %s down (%s) — restart %d/%d in %.2fs",
+                       worker.worker_id, reason, worker.restarts,
+                       self.max_restarts, delay)
+
+    # -- health ------------------------------------------------------------
+    def _probe(self, worker: ManagedWorker) -> None:
+        """One /readyz probe; updates the pool and the failure count."""
+        if worker.port is None:
+            try:
+                text = worker.port_file.read_text().strip()
+                worker.port = int(text) if text else None
+            except (OSError, ValueError):
+                worker.port = None
+            if worker.port is None:
+                return  # still booting: not a failure, not ready
+            self.pool.upsert(worker.worker_id, worker.url)
+        try:
+            req = urllib.request.Request(worker.url + "/readyz")
+            with urllib.request.urlopen(
+                    req, timeout=self.health_timeout_s) as resp:
+                body = json.loads(resp.read())
+            self.pool.set_health(worker.worker_id, alive=True, ready=True,
+                                 checkpoint_step=body.get(
+                                     "checkpoint_step"))
+        except urllib.error.HTTPError as e:
+            # 503 = alive but warming/draining: healthy process, no
+            # traffic. Anything else odd counts as a failure.
+            try:
+                body = json.loads(e.read())
+            except Exception:  # noqa: BLE001
+                body = {}
+            if e.code == 503:
+                self.pool.set_health(worker.worker_id, alive=True,
+                                     ready=False,
+                                     checkpoint_step=body.get(
+                                         "checkpoint_step"))
+            else:
+                self.pool.set_health(worker.worker_id, alive=True,
+                                     ready=False)
+                self.pool.report_failure(worker.worker_id,
+                                         f"readyz http {e.code}",
+                                         kind="probe")
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            self.pool.set_health(worker.worker_id, alive=worker.alive(),
+                                 ready=False)
+            self.pool.report_failure(worker.worker_id, repr(e),
+                                     kind="probe")
+
+    # -- chaos -------------------------------------------------------------
+    def _apply_chaos(self) -> None:
+        if self.injector is None:
+            return
+        if not self._chaos_armed:
+            # Chaos ordinals count from the first tick where EVERY
+            # worker is ready: a plan like killworker@20 must hit a
+            # serving fleet at a deterministic point, not a booting one
+            # at whatever tick JAX init happened to finish on.
+            if sum(1 for w in self.pool.workers()
+                   if w.ready) < len(self.workers):
+                return
+            self._chaos_armed = True
+        for action in self.injector.on_fleet_tick():
+            live = [w for w in self.workers if w.alive()]
+            if not live:
+                logger.warning("fleet chaos: %s due but no live worker",
+                               action)
+                continue
+            if action.startswith("killworker"):
+                target = live[self._chaos_kills % len(live)]
+                self._chaos_kills += 1
+                logger.warning("fleet chaos: SIGKILL %s (pid %s)",
+                               target.worker_id, target.pid)
+                try:
+                    os.kill(target.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+            elif action.startswith("slowworker"):
+                target = live[self._chaos_slows % len(live)]
+                self._chaos_slows += 1
+                logger.warning("fleet chaos: SIGSTOP %s for %.1fs "
+                               "(pid %s)", target.worker_id,
+                               self.slowworker_s, target.pid)
+                try:
+                    os.kill(target.pid, signal.SIGSTOP)
+                    target.slow_until = (time.monotonic()
+                                         + self.slowworker_s)
+                except OSError:
+                    pass
+
+    # -- the supervision loop ----------------------------------------------
+    def tick(self) -> None:
+        """One supervision cycle (public: tests drive it directly)."""
+        self._apply_chaos()
+        now = time.monotonic()
+        for worker in self.workers:
+            if worker.slow_until is not None and now >= worker.slow_until:
+                try:
+                    os.kill(worker.pid, signal.SIGCONT)
+                except (OSError, TypeError):
+                    pass
+                worker.slow_until = None
+            if not worker.alive():
+                if worker.proc is not None and worker.restart_at is None:
+                    rc = worker.proc.poll()
+                    self.pool.set_health(worker.worker_id, alive=False,
+                                         ready=False)
+                    self._schedule_restart(worker, f"exited rc={rc}")
+                    worker.proc = None
+                if worker.restart_at is not None \
+                        and now >= worker.restart_at:
+                    self._spawn(worker)
+                continue
+            self._probe(worker)
+            entry = next((w for w in self.pool.workers()
+                          if w.worker_id == worker.worker_id), None)
+            if entry is not None \
+                    and entry.consecutive_failures >= self.eject_after:
+                self._ejections.inc()
+                logger.warning(
+                    "fleet: ejecting %s after %d consecutive failures "
+                    "(last: %s)", worker.worker_id,
+                    entry.consecutive_failures, entry.last_error)
+                self.pool.set_health(worker.worker_id, alive=False,
+                                     ready=False)
+                self._kill(worker)
+                self._schedule_restart(worker, "ejected")
+                worker.proc = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — supervision must survive
+                # any single bad tick (a worker dying mid-probe, a
+                # filesystem hiccup on a port file).
+                logger.exception("fleet: supervision tick failed")
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ServingFleet":
+        if self._thread is not None:
+            raise RuntimeError("fleet already started")
+        for worker in self.workers:
+            self._spawn(worker)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ntxent-fleet-monitor")
+        self._thread.start()
+        return self
+
+    def wait_ready(self, n: int | None = None,
+                   timeout_s: float = 120.0) -> bool:
+        """Block until ``n`` workers (default: all) pass /readyz."""
+        want = len(self.workers) if n is None else int(n)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if sum(1 for w in self.pool.workers() if w.ready) >= want:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.poll_s * 4 + 5.0)
+            self._thread = None
+        for worker in self.workers:
+            if worker.proc is not None and worker.proc.poll() is None:
+                worker.proc.terminate()
+        deadline = time.monotonic() + 5.0
+        for worker in self.workers:
+            if worker.proc is None:
+                continue
+            try:
+                worker.proc.wait(timeout=max(0.1, deadline
+                                             - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                self._kill(worker)

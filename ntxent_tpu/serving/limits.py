@@ -1,0 +1,17 @@
+"""Request-size limits shared by the worker AND the router tier.
+
+This module exists to stay import-light: ``router.py`` (the JAX-free
+fleet front door) needs the same body cap ``server.py`` (the worker
+half, which imports the engine and therefore JAX) enforces, and must
+not drag the whole worker stack in to read one constant.
+"""
+
+# Request-size caps: the bounded queue protects device time, but a body
+# has to be parsed BEFORE it can be queued — without caps a multi-GB
+# JSON body (or one merely-huge valid request hogging the single worker
+# through thousands of chunked device calls) exhausts memory or
+# head-of-line-blocks everything without a single 429. Oversized bodies
+# get 413 + Connection: close without being read.
+MAX_BODY_BYTES = 32 << 20
+
+__all__ = ["MAX_BODY_BYTES"]

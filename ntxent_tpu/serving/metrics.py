@@ -122,6 +122,21 @@ class ServingMetrics:
                               window=latency_window)
             for name in ("total", "queue_wait", "device")
         }
+        # Zero-downtime rollout (fleet workers): weight swaps by mode
+        # ("reused" = same structure, compiled ladder kept; "warmed" =
+        # structure changed, new ladder compiled BEFORE the swap) plus
+        # the checkpoint step currently served — what the router's
+        # canary logic and the fleet smoke read per worker.
+        self._swap_lock = threading.Lock()
+        self._swaps: dict[str, object] = {}
+        self._ckpt_step = r.gauge(
+            "serving_checkpoint_step",
+            "training step of the checkpoint currently served "
+            "(-1 = random init)")
+        self._ckpt_step.set(-1)
+        self._rollbacks = r.counter(
+            "serving_rollbacks_total",
+            "weight rollbacks after a canary breach")
         # bucket -> (calls, rows_real, rows_padded) labeled counters;
         # created on first use (the ladder is not known here).
         self._bucket_lock = threading.Lock()
@@ -275,6 +290,30 @@ class ServingMetrics:
     def set_queue_depth(self, depth: int) -> None:
         self._queue_depth.set(int(depth))
 
+    def model_swap(self, mode: str) -> None:
+        with self._swap_lock:
+            counter = self._swaps.get(mode)
+            if counter is None:
+                counter = self._swaps[mode] = self.registry.counter(
+                    "serving_model_swaps_total",
+                    "live weight swaps by mode", labels={"mode": mode})
+        counter.inc()
+
+    def set_checkpoint_step(self, step: int) -> None:
+        self._ckpt_step.set(int(step))
+
+    def rollback(self) -> None:
+        self._rollbacks.inc()
+
+    @property
+    def checkpoint_step(self) -> int:
+        return int(self._ckpt_step.value)
+
+    @property
+    def model_swaps(self) -> int:
+        with self._swap_lock:
+            return int(sum(c.value for c in self._swaps.values()))
+
     # -- readers ---------------------------------------------------------
     def to_dict(self) -> dict:
         """The JSON wire shape (unchanged keys), assembled metric by
@@ -305,6 +344,8 @@ class ServingMetrics:
                 "compiles": self.compiles,
                 "cache_hits": self.compile_cache_hits,
             },
+            "checkpoint_step": self.checkpoint_step,
+            "model_swaps": self.model_swaps,
             "buckets": {
                 str(b): {"calls": int(calls.value),
                          "rows_real": int(real.value),

@@ -1,0 +1,914 @@
+"""Fault-tolerant router tier: many workers, one front door.
+
+One ``EmbeddingServer`` is a single point of failure, a single queue,
+and a restart-equals-outage deployment model. ``FleetRouter`` is the
+stdlib-HTTP tier that fixes all three (ISSUE 8 / ROADMAP item 4): it
+spreads ``/embed`` load over N worker replicas, retries failed
+forwards on the surviving workers, sheds load with the existing 429 +
+Retry-After semantics when every worker is saturated, serves repeated
+rows from the ``EmbeddingCache`` without any worker seeing them, and
+canaries new-checkpoint workers at a configurable traffic fraction
+with automatic rollback on an error-rate breach.
+
+Failure semantics per forwarded request (the per-request retry budget
+that turns a worker SIGKILL into zero client-visible 5xx):
+
+* connection errors and worker 5xx count against the worker
+  (``WorkerPool.report_failure`` — the fleet supervisor ejects after
+  consecutive failures) and the request retries on a DIFFERENT worker,
+  up to ``retries`` extra attempts;
+* a worker 429 is saturation, not failure: the router tries another
+  worker, and only when every attempted worker is saturated does the
+  client see a 429 carrying the largest Retry-After observed;
+* worker 4xx (bad request, 413, 504) is the CLIENT's problem and
+  passes through verbatim on the first occurrence — retrying a 400 on
+  another replica would just fail twice;
+* budget exhausted on 5xx: the client receives the WORKER's status
+  code and error body (never a synthetic router error that hides the
+  cause); with no ready workers at all the answer is an immediate 503,
+  never a hang.
+
+Canary state machine (one rollout at a time, owned by the pool lock):
+
+  ``trusted`` — all ready workers serve the trusted step: plain
+  least-in-flight routing.
+  ``canarying`` — some ready worker reports a step newer than the
+  trusted one (the staggered watcher put it there): the router routes
+  ``canary_fraction`` of requests to the new-step cohort and counts
+  outcomes. 429s are neutral (saturation says nothing about the
+  model).
+  promote — at ``canary_min_requests`` outcomes with error rate <=
+  ``canary_max_error_rate`` the new step becomes trusted (and the
+  cache flushes: embeddings from the old model must not outlive it).
+  rollback — on breach the step is marked bad, every worker serving
+  it gets ``POST /rollback`` (worker.py reverts and blocklists), and
+  routing is old-cohort-only again — "canary rollback restores
+  old-checkpoint routing".
+
+Request identity: the router mints ``X-Request-Id`` at its edge and
+forwards it, so one id threads cache -> route -> worker queue ->
+device chunk in the exported trace; a cache hit emits a ``fleet.cache``
+slice under the same id — a cached answer explains itself instead of
+looking like a mysteriously fast worker.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
+
+import numpy as np
+
+from ..obs import trace as _trace
+from ..obs.exporters import PROMETHEUS_CONTENT_TYPE, choose_format
+from ..obs.registry import MetricsRegistry
+from .cache import EmbeddingCache
+from .limits import MAX_BODY_BYTES
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["WorkerEntry", "WorkerPool", "FleetRouter"]
+
+
+def _step_header(headers) -> int | None:
+    """Parse the worker's ``X-Checkpoint-Step`` response label. The
+    worker stamps it at reply time, so it names the model that ACTUALLY
+    served — the pool's health-probe view lags a hot swap by up to a
+    poll interval, and cache/canary accounting must not mislabel that
+    window's responses."""
+    raw = headers.get("X-Checkpoint-Step") if headers is not None else None
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return None
+
+
+class WorkerEntry:
+    """One worker replica as the router sees it (mutated under the
+    pool's lock; plain attributes — this is a record, not an actor)."""
+
+    def __init__(self, worker_id: str, url: str):
+        self.worker_id = worker_id
+        self.url = url.rstrip("/")
+        self.alive = False
+        self.ready = False
+        self.checkpoint_step: int | None = None
+        self.inflight = 0
+        self.consecutive_failures = 0
+        # What produced the latest failure ("probe" | "forward"): a
+        # healthy /readyz probe is evidence against a PROBE-failure
+        # streak only — it says nothing about /embed, so it must not
+        # wipe router-reported forward failures before the fleet's
+        # eject check ever sees them.
+        self.last_failure_kind: str | None = None
+        self.last_error: str | None = None
+
+    def snapshot(self) -> dict:
+        return {"url": self.url, "alive": self.alive, "ready": self.ready,
+                "checkpoint_step": self.checkpoint_step,
+                "inflight": self.inflight,
+                "consecutive_failures": self.consecutive_failures,
+                "last_error": self.last_error}
+
+
+class WorkerPool:
+    """Thread-safe worker table + selection + canary state machine.
+
+    The fleet supervisor (fleet.py) writes membership and health; the
+    router reads selections and reports per-request outcomes. Both the
+    router's forward failures and the supervisor's health-probe
+    failures land in ``consecutive_failures`` — one ejection signal,
+    two observers. Resets are evidence-matched: a successful forward
+    clears the counter outright, while a passing /readyz probe clears
+    it only when the streak is probe-originated (a listening worker
+    that 500s every /embed must still reach the eject threshold).
+    """
+
+    def __init__(self, canary_fraction: float = 0.25,
+                 canary_min_requests: int = 20,
+                 canary_max_error_rate: float = 0.1,
+                 registry: MetricsRegistry | None = None):
+        if not 0.0 < canary_fraction <= 1.0:
+            raise ValueError(f"canary_fraction must be in (0, 1], got "
+                             f"{canary_fraction}")
+        self.canary_fraction = float(canary_fraction)
+        self.canary_min_requests = int(canary_min_requests)
+        self.canary_max_error_rate = float(canary_max_error_rate)
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self._lock = threading.Lock()
+        self._workers: dict[str, WorkerEntry] = {}
+        self.trusted_step: int | None = None
+        # Fired (outside the lock) when the FIRST checkpoint step is
+        # adopted as trusted via set_health — there was no canary to
+        # decide, so this is the router's only signal to flush
+        # random-init-weight embeddings out of its cache.
+        self.on_trusted_adopt = None
+        self.bad_steps: set[int] = set()
+        self._canary_step: int | None = None
+        self._canary_ok = 0
+        self._canary_err = 0
+        self._rr = 0  # request counter driving the canary fraction
+        r = self.registry
+        self._ready_gauge = r.gauge("fleet_workers_ready",
+                                    "workers passing /readyz")
+        self._alive_gauge = r.gauge("fleet_workers_alive",
+                                    "workers with a live process")
+        self._trusted_gauge = r.gauge(
+            "fleet_trusted_step",
+            "checkpoint step the router currently trusts "
+            "(-1 = none yet)")
+        self._trusted_gauge.set(-1)
+        self._canary_requests = r.counter(
+            "fleet_canary_requests_total",
+            "requests routed to a canary-step worker")
+        self._canary_errors = r.counter(
+            "fleet_canary_errors_total",
+            "canary-routed requests that failed (5xx/unreachable)")
+        self._promotions = r.counter(
+            "fleet_promotions_total",
+            "canary steps promoted to trusted")
+        self._rollbacks = r.counter(
+            "fleet_rollbacks_total",
+            "canary steps rolled back on error-rate breach")
+
+    # -- membership / health (the fleet supervisor's surface) -------------
+    def upsert(self, worker_id: str, url: str) -> WorkerEntry:
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            if entry is None or entry.url != url.rstrip("/"):
+                entry = WorkerEntry(worker_id, url)
+                self._workers[worker_id] = entry
+            self._update_gauges()
+            return entry
+
+    def remove(self, worker_id: str) -> None:
+        with self._lock:
+            self._workers.pop(worker_id, None)
+            self._update_gauges()
+
+    def set_health(self, worker_id: str, alive: bool, ready: bool,
+                   checkpoint_step: int | None = None) -> None:
+        adopted: int | None = None
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            if entry is None:
+                return
+            entry.alive = alive
+            entry.ready = ready and alive
+            if checkpoint_step is not None:
+                entry.checkpoint_step = int(checkpoint_step)
+            if ready and alive \
+                    and entry.last_failure_kind != "forward":
+                # A passing probe closes a probe-failure streak. It is
+                # NOT evidence that /embed works — a worker 500ing
+                # every forward while answering /readyz 200 must still
+                # accumulate toward ejection (only a successful forward
+                # resets that streak).
+                entry.consecutive_failures = 0
+                entry.last_failure_kind = None
+            if self.trusted_step is None \
+                    and entry.checkpoint_step is not None:
+                # First observed version becomes the trusted baseline —
+                # there is nothing to canary against before it.
+                self.trusted_step = adopted = entry.checkpoint_step
+                self._trusted_gauge.set(self.trusted_step)
+            self._update_gauges()
+        if adopted is not None and self.on_trusted_adopt is not None:
+            # Outside the lock: the hook flushes the router's cache
+            # (which takes its own lock) — any embeddings cached while
+            # workers served random init must not outlive the first
+            # real model.
+            try:
+                self.on_trusted_adopt(adopted)
+            except Exception:  # noqa: BLE001 — a hook failure must not
+                # poison health reporting.
+                logger.exception("on_trusted_adopt hook failed")
+
+    def report_failure(self, worker_id: str, error: str = "",
+                       kind: str = "forward") -> int:
+        """A failed forward or health probe (``kind``: "forward" |
+        "probe"); returns the consecutive count (the fleet ejects past
+        its threshold)."""
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            if entry is None:
+                return 0
+            entry.consecutive_failures += 1
+            entry.last_failure_kind = kind
+            entry.last_error = error or entry.last_error
+            return entry.consecutive_failures
+
+    def report_success(self, worker_id: str) -> None:
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            if entry is not None:
+                entry.consecutive_failures = 0
+                entry.last_failure_kind = None
+                entry.last_error = None
+
+    def clear_failures(self, worker_id: str) -> None:
+        """Reset the consecutive-failure count but KEEP last_error (the
+        post-mortem). The fleet calls this when it schedules a restart:
+        the failures belonged to the dead incarnation, and carrying
+        them over would insta-eject the replacement while it is still
+        booting — before it can even publish its port."""
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            if entry is not None:
+                entry.consecutive_failures = 0
+                entry.last_failure_kind = None
+
+    def _update_gauges(self) -> None:
+        self._ready_gauge.set(sum(1 for w in self._workers.values()
+                                  if w.ready))
+        self._alive_gauge.set(sum(1 for w in self._workers.values()
+                                  if w.alive))
+
+    # -- selection ---------------------------------------------------------
+    def _is_canary(self, entry: WorkerEntry) -> bool:
+        return (self.trusted_step is not None
+                and entry.checkpoint_step is not None
+                and entry.checkpoint_step > self.trusted_step
+                and entry.checkpoint_step not in self.bad_steps)
+
+    def pick(self, exclude: set[str] | None = None) -> WorkerEntry | None:
+        """Least-in-flight selection with canary fractioning; None when
+        no ready worker remains (the router's immediate-503 case).
+        Increments the chosen worker's inflight (caller must ``done``).
+        """
+        exclude = exclude or set()
+        with self._lock:
+            all_ready = [w for w in self._workers.values() if w.ready]
+            ready = [w for w in all_ready
+                     if w.worker_id not in exclude]
+            if not ready:
+                return None
+            # Canary ARMING considers every ready worker: a failover
+            # retry that excludes the canary (its 5xx is exactly the
+            # evidence being counted) must not reset the breach
+            # accounting mid-verdict.
+            armed = [w for w in all_ready if self._is_canary(w)]
+            if armed:
+                # One rollout at a time: canary the NEWEST new step.
+                newest = max(w.checkpoint_step for w in armed)
+                if self._canary_step != newest:
+                    self._canary_step = newest
+                    self._canary_ok = self._canary_err = 0
+            else:
+                self._canary_step = None
+            canaries = [w for w in ready
+                        if self._is_canary(w)
+                        and w.checkpoint_step == self._canary_step]
+            bad = [w for w in ready
+                   if w.checkpoint_step in self.bad_steps]
+            old = [w for w in ready if not self._is_canary(w)
+                   and w not in bad]
+            if canaries and old:
+                self._rr += 1
+                period = max(1, round(1.0 / self.canary_fraction))
+                cohort = canaries if self._rr % period == 0 else old
+            elif canaries:
+                cohort = canaries  # nothing older is ready
+            else:
+                # A bad-step worker beats a 503; ``ready`` itself is the
+                # last resort (every selectable worker is a non-newest
+                # canary — traffic must still flow).
+                cohort = old or bad or ready
+            entry = min(cohort, key=lambda w: (w.inflight, w.worker_id))
+            entry.inflight += 1
+            return entry
+
+    def done(self, worker_id: str) -> None:
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            if entry is not None and entry.inflight > 0:
+                entry.inflight -= 1
+
+    def allow_cache_insert(self, served_step: int | None) -> bool:
+        """Only embeddings from the TRUSTED model may enter the cache:
+        no inserts while a canary is undecided (a canary model's
+        embeddings must not survive its own rollback), and a response
+        from a non-trusted step (a promote/rollback raced the forward)
+        must not poison the freshly flushed cache."""
+        with self._lock:
+            if self._canary_step is not None:
+                return False
+            if served_step is None or self.trusted_step is None:
+                return True
+            return served_step == self.trusted_step
+
+    # -- canary accounting -------------------------------------------------
+    def observe(self, worker_id: str, step: int | None,
+                ok: bool) -> tuple[str, int] | None:
+        """Record one forwarded outcome. Returns ``("promote", step)``,
+        ``("rollback", step)``, or None. 429s must NOT be reported here
+        (saturation is not model quality)."""
+        with self._lock:
+            if (self._canary_step is None or step is None
+                    or step != self._canary_step):
+                return None
+            self._canary_requests.inc()
+            if ok:
+                self._canary_ok += 1
+            else:
+                self._canary_err += 1
+                self._canary_errors.inc()
+            total = self._canary_ok + self._canary_err
+            if total < self.canary_min_requests:
+                return None
+            rate = self._canary_err / total
+            decided = self._canary_step
+            self._canary_step = None
+            self._canary_ok = self._canary_err = 0
+            if rate <= self.canary_max_error_rate:
+                self.trusted_step = decided
+                self._trusted_gauge.set(decided)
+                self._promotions.inc()
+                logger.info("canary: promoted step %d (error rate "
+                            "%.3f over %d requests)", decided, rate,
+                            total)
+                return ("promote", decided)
+            self.bad_steps.add(decided)
+            self._rollbacks.inc()
+            logger.warning("canary: BREACH on step %d (error rate %.3f "
+                           "> %.3f over %d requests) — rolling back",
+                           decided, rate, self.canary_max_error_rate,
+                           total)
+            return ("rollback", decided)
+
+    # -- readers -----------------------------------------------------------
+    def workers(self) -> list[WorkerEntry]:
+        with self._lock:
+            return list(self._workers.values())
+
+    def workers_at_step(self, step: int) -> list[WorkerEntry]:
+        with self._lock:
+            return [w for w in self._workers.values()
+                    if w.checkpoint_step == step]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "workers": {w.worker_id: w.snapshot()
+                            for w in sorted(self._workers.values(),
+                                            key=lambda w: w.worker_id)},
+                "trusted_step": self.trusted_step,
+                "bad_steps": sorted(self.bad_steps),
+                "canary_step": self._canary_step,
+                "canary_fraction": self.canary_fraction,
+            }
+
+
+class FleetRouter:
+    """HTTP front door over a ``WorkerPool`` (+ optional cache).
+
+    Same lifecycle idiom as ``EmbeddingServer``: ``start()`` binds and
+    returns (the fleet CLI owns the foreground loop); ``close()`` tears
+    down. The router holds no model and compiles nothing — it can
+    restart in milliseconds, which is exactly why the cache lives here
+    and not in the workers.
+    """
+
+    def __init__(self, pool: WorkerPool,
+                 cache: EmbeddingCache | None = None,
+                 example_shape=None,
+                 host: str = "127.0.0.1", port: int = 8080,
+                 retries: int = 2,
+                 forward_timeout_s: float = 30.0,
+                 control_timeout_s: float = 5.0,
+                 max_body_bytes: int = MAX_BODY_BYTES,
+                 registry: MetricsRegistry | None = None):
+        self.pool = pool
+        self.cache = cache
+        if cache is not None:
+            # First-checkpoint adoption (None -> step) is a model change
+            # with no canary verdict to hang the flush on: embeddings
+            # from pre-checkpoint (random-init) weights must not
+            # survive it.
+            pool.on_trusted_adopt = \
+                lambda step: cache.clear(reason="adopt")
+        self.example_shape = (tuple(int(d) for d in example_shape)
+                              if example_shape is not None else None)
+        self.host, self.port = host, int(port)
+        self.retries = int(retries)
+        self.forward_timeout_s = float(forward_timeout_s)
+        self.control_timeout_s = float(control_timeout_s)
+        self.max_body_bytes = int(max_body_bytes)
+        self.registry = registry if registry is not None \
+            else pool.registry
+        r = self.registry
+        self._requests = r.counter("fleet_requests_total",
+                                   "requests arriving at the router")
+        self._responses = r.counter("fleet_responses_total",
+                                    "2xx responses sent by the router")
+        self._cache_only = r.counter(
+            "fleet_cache_only_responses_total",
+            "requests answered entirely from the cache (no worker)")
+        self._forwards = r.counter("fleet_forwards_total",
+                                   "forward attempts to workers")
+        self._retries_ctr = r.counter(
+            "fleet_retries_total",
+            "forward attempts beyond the first (failover)")
+        self._rejects: dict[str, object] = {}
+        self._reject_lock = threading.Lock()
+        self.latency = {
+            stage: r.histogram("fleet_latency_ms",
+                               "router latency by stage",
+                               labels={"stage": stage})
+            for stage in ("total", "forward")
+        }
+        self._httpd: ThreadingHTTPServer | None = None
+        self._http_thread: threading.Thread | None = None
+        self._shutdown = threading.Event()
+
+    def _reject(self, reason: str) -> None:
+        with self._reject_lock:
+            counter = self._rejects.get(reason)
+            if counter is None:
+                counter = self._rejects[reason] = self.registry.counter(
+                    "fleet_rejected_total",
+                    "non-2xx router outcomes by reason",
+                    labels={"reason": reason})
+        counter.inc()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "FleetRouter":
+        if self._httpd is not None:
+            raise RuntimeError("router already started")
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          _make_router_handler(self))
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="ntxent-fleet-router")
+        self._http_thread.start()
+        logger.info("fleet router on http://%s:%d", self.host, self.port)
+        return self
+
+    def close(self) -> None:
+        self._shutdown.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._http_thread = None
+
+    # -- forwarding --------------------------------------------------------
+    def _post(self, url: str, body: bytes, rid: str,
+              timeout_s: float) -> tuple[int, bytes, int | None]:
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": rid})
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, resp.read(), _step_header(resp.headers)
+
+    def _broadcast_rollback(self, step: int) -> None:
+        """Tell every worker serving the breached step to revert (the
+        staggered laggards get the bad step blocklisted before they
+        ever adopt it — rollback() blocklists even when not serving)."""
+        for entry in self.pool.workers():
+            if not entry.alive:
+                continue
+            try:
+                self._post(entry.url + "/rollback",
+                           json.dumps({"step": step}).encode(),
+                           _trace.new_request_id(),
+                           self.control_timeout_s)
+                logger.info("rollback of step %d sent to %s", step,
+                            entry.worker_id)
+            except (urllib.error.URLError, OSError, ValueError):
+                logger.warning("rollback of step %d failed to reach %s "
+                               "(its watcher will still refuse the step "
+                               "once ejected/restarted)", step,
+                               entry.worker_id)
+
+    def _handle_decision(self, decision: tuple[str, int] | None) -> None:
+        if decision is None:
+            return
+        action, step = decision
+        if action == "rollback":
+            # Broadcast off the request thread: the verdict fires
+            # inside the handler of whichever client request tripped
+            # the breach, and serial /rollback POSTs (up to
+            # workers x control_timeout_s against a wedged worker)
+            # must not stall that client's response. Routing is safe
+            # immediately — observe() already blocklisted the step
+            # under the pool lock before returning the decision.
+            threading.Thread(
+                target=self._broadcast_rollback, args=(step,),
+                daemon=True, name="fleet-rollback").start()
+            if self.cache is not None:
+                self.cache.clear(reason="rollback")
+        elif action == "promote" and self.cache is not None:
+            # Embeddings from the previous model must not outlive it.
+            self.cache.clear(reason="promote")
+
+    def forward(self, body: bytes, rid: str) -> tuple[int, dict,
+                                                      dict | None,
+                                                      int | None]:
+        """Forward one /embed body with failover; returns ``(status,
+        payload, payload_extra_headers, served_checkpoint_step)`` — the
+        step of the worker that produced the answer (None on failure),
+        which is what gates cache inserts. Never raises for worker-side
+        trouble — every failure mode maps to a status."""
+        tried: set[str] = set()
+        attempts = 0
+        last_5xx: tuple[str, int, dict] | None = None
+        last_unreachable: str | None = None
+        saturated_retry_after = 0.0
+        saturated = False
+        while attempts <= self.retries:
+            entry = self.pool.pick(exclude=tried)
+            if entry is None:
+                break
+            tried.add(entry.worker_id)
+            attempts += 1
+            self._forwards.inc()
+            if attempts > 1:
+                self._retries_ctr.inc()
+            # Provisional attribution from the routing table; the
+            # worker's own X-Checkpoint-Step reply label overrides it
+            # (a hot swap between health probe and forward would
+            # otherwise mislabel the response's model).
+            step = entry.checkpoint_step
+            t0 = time.monotonic()
+            try:
+                with _trace.span("fleet.forward", request_id=rid,
+                                 worker=entry.worker_id, attempt=attempts):
+                    status, payload, hdr_step = self._post(
+                        entry.url + "/embed", body, rid,
+                        self.forward_timeout_s)
+                if hdr_step is not None:
+                    step = hdr_step
+            except urllib.error.HTTPError as e:
+                hdr_step = _step_header(e.headers)
+                if hdr_step is not None:
+                    step = hdr_step
+                raw = e.read()
+                try:
+                    detail = json.loads(raw)
+                except ValueError:
+                    detail = None
+                if not isinstance(detail, dict):
+                    # Valid-JSON-but-not-an-object bodies (a recycled
+                    # port answering "busy" or null) must not crash the
+                    # .get() consumers below — forward() never raises
+                    # for worker-side trouble.
+                    detail = {"error": raw.decode(errors="replace")[:500]}
+                if e.code == 429:
+                    # Saturation: not a worker failure, not a canary
+                    # signal — try a sibling.
+                    saturated = True
+                    try:
+                        retry_after = float(
+                            detail.get("retry_after_s", 0.05))
+                    except (TypeError, ValueError):
+                        # Same recycled-port threat model as the
+                        # non-dict guard above: a null/string value
+                        # must not raise out of forward().
+                        retry_after = 0.05
+                    saturated_retry_after = max(saturated_retry_after,
+                                                retry_after)
+                    continue
+                if e.code == 504:
+                    # Deadline exceeded: the CLIENT's timeout_ms ran
+                    # out (usually queue wait under load). The worker
+                    # answered sanely — not a failure to eject on, not
+                    # model-quality evidence for the canary (same
+                    # neutrality as 429), and retrying would burn
+                    # another full deadline past an already-expired
+                    # one. Pass through.
+                    self.pool.report_success(entry.worker_id)
+                    return e.code, detail, None, step
+                if e.code >= 500:
+                    last_5xx = (entry.worker_id, e.code, detail)
+                    self.pool.report_failure(
+                        entry.worker_id, f"http {e.code}")
+                    self._handle_decision(
+                        self.pool.observe(entry.worker_id, step,
+                                          ok=False))
+                    continue
+                # 4xx: the client's problem — pass through verbatim.
+                # The worker itself is healthy, so the outcome still
+                # counts toward a pending canary verdict — and a
+                # verdict decided HERE must take effect like any other.
+                self._handle_decision(
+                    self.pool.observe(entry.worker_id, step, ok=True))
+                self.pool.report_success(entry.worker_id)
+                return e.code, detail, None, step
+            except (urllib.error.URLError, OSError) as e:
+                last_unreachable = entry.worker_id
+                self.pool.report_failure(entry.worker_id, repr(e))
+                self._handle_decision(
+                    self.pool.observe(entry.worker_id, step, ok=False))
+                continue
+            finally:
+                self.pool.done(entry.worker_id)
+                self.latency["forward"].observe(
+                    (time.monotonic() - t0) * 1e3)
+            try:
+                result = json.loads(payload)
+                if not isinstance(result, dict):
+                    raise ValueError("non-object JSON body")
+            except ValueError:
+                last_5xx = (entry.worker_id, 502,
+                            {"error": "unparseable worker response"})
+                self.pool.report_failure(entry.worker_id, "bad payload")
+                # Garbage out of a canary is exactly the model-quality
+                # evidence the verdict counts.
+                self._handle_decision(
+                    self.pool.observe(entry.worker_id, step, ok=False))
+                continue
+            self.pool.report_success(entry.worker_id)
+            self._handle_decision(
+                self.pool.observe(entry.worker_id, step, ok=True))
+            return status, result, None, step
+        if last_5xx is not None:
+            worker_id, code, detail = last_5xx
+            self._reject("worker_error")
+            # Budget exhausted: surface the WORKER's status — the
+            # router must not translate a diagnosable failure into a
+            # generic one.
+            return code, {"error": f"worker {worker_id} failed after "
+                                   f"{attempts} attempt(s)",
+                          "worker_error": detail.get("error"),
+                          "worker": worker_id,
+                          "attempts": attempts}, None, None
+        if saturated:
+            self._reject("saturated")
+            return 429, {"error": "all workers saturated",
+                         "retry_after_s": saturated_retry_after}, \
+                {"Retry-After": f"{saturated_retry_after:.3f}"}, None
+        if last_unreachable is not None:
+            self._reject("unreachable")
+            return 503, {"error": f"no worker reachable (last tried "
+                                  f"{last_unreachable}, {attempts} "
+                                  "attempt(s))"}, None, None
+        self._reject("no_workers")
+        return 503, {"error": "no ready workers"}, None, None
+
+    # -- metrics -----------------------------------------------------------
+    def metrics_dict(self) -> dict:
+        out = {
+            "requests": int(self._requests.value),
+            "responses": int(self._responses.value),
+            "cache_only_responses": int(self._cache_only.value),
+            "forwards": int(self._forwards.value),
+            "retries": int(self._retries_ctr.value),
+            "latency_ms": {stage: h.snapshot_ms()
+                           for stage, h in self.latency.items()},
+            **self.pool.snapshot(),
+        }
+        if self.cache is not None:
+            out["cache"] = self.cache.snapshot()
+        return out
+
+
+def _make_router_handler(router: FleetRouter):
+    pool = router.pool
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: N802
+            logger.debug("%s " + fmt, self.address_string(), *args)
+
+        def _reply(self, code: int, payload: dict,
+                   headers: dict | None = None) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802
+            route = urlparse(self.path).path
+            if route == "/healthz":
+                ready = sum(1 for w in pool.workers() if w.ready)
+                self._reply(200 if ready else 503,
+                            {"status": "routing" if ready
+                             else "no_ready_workers",
+                             "workers_ready": ready,
+                             "trusted_step": pool.trusted_step})
+            elif route == "/metrics":
+                fmt = choose_format(self.path,
+                                    self.headers.get("Accept"),
+                                    default="json")
+                if fmt == "prometheus":
+                    body = router.registry.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     PROMETHEUS_CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._reply(200, router.metrics_dict())
+            else:
+                self._reply(404, {"error": f"no route {self.path!r}"})
+
+        def do_POST(self):  # noqa: N802
+            rid = (self.headers.get("X-Request-Id")
+                   or _trace.new_request_id())
+            t0 = time.monotonic()
+            status = {"code": None, "rows": None}
+
+            def reply(code: int, payload: dict,
+                      headers: dict | None = None) -> None:
+                status["code"] = code
+                merged = {"X-Request-Id": rid}
+                if headers:
+                    merged.update(headers)
+                self._reply(code, payload, merged)
+                if code < 300:
+                    router._responses.inc()
+
+            try:
+                self._do_post(reply, rid, status)
+            finally:
+                if self.path == "/embed" and status["code"] is not None:
+                    dur_ms = (time.monotonic() - t0) * 1e3
+                    router.latency["total"].observe(dur_ms)
+                    _trace.emit_span("fleet.request", dur_ms,
+                                     request_id=rid,
+                                     status=status["code"],
+                                     rows=status["rows"])
+
+        def _do_post(self, reply, rid, status) -> None:
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                length = 0
+            if length > router.max_body_bytes:
+                self.close_connection = True
+                reply(413, {"error": f"body of {length} bytes exceeds "
+                                     f"the {router.max_body_bytes}-byte "
+                                     "cap"},
+                      {"Connection": "close"})
+                return
+            body = self.rfile.read(length) if length > 0 else b""
+            if self.path != "/embed":
+                reply(404, {"error": f"no route {self.path!r}"})
+                return
+            router._requests.inc()
+            parsed = self._parse_rows(body)
+            if parsed is None or router.cache is None:
+                # Unparseable here (the worker owns the 400) or no
+                # cache: pure pass-through.
+                code, payload, headers, _ = router.forward(body, rid)
+                if isinstance(payload, dict) and "rows" in payload:
+                    status["rows"] = payload.get("rows")
+                reply(code, payload, headers)
+                return
+            x, timeout_ms = parsed
+            status["rows"] = int(x.shape[0])
+            self._do_cached_embed(reply, rid, x, timeout_ms)
+
+        def _parse_rows(self, body: bytes):
+            """Best-effort parse for cache keying; None = pass through
+            and let a worker produce the authoritative 400. Caching
+            requires ``example_shape`` (without it a batchless single
+            example is indistinguishable from a batch of smaller rows,
+            and a wrong split would poison the cache)."""
+            if router.example_shape is None:
+                return None
+            try:
+                req = json.loads(body or b"{}")
+                x = np.asarray(req["inputs"], dtype=np.float32)
+                if x.shape == router.example_shape:
+                    x = x[None]
+                if x.shape[1:] != router.example_shape or x.shape[0] < 1:
+                    return None
+                timeout_ms = req.get("timeout_ms")
+                return x, timeout_ms
+            except (KeyError, TypeError, ValueError):
+                return None
+
+        def _do_cached_embed(self, reply, rid, x, timeout_ms) -> None:
+            cache = router.cache
+            t0 = time.monotonic()
+            generation = cache.generation
+            hits, miss_idx = cache.lookup(x)
+            _trace.emit_span("fleet.cache",
+                             (time.monotonic() - t0) * 1e3,
+                             request_id=rid, rows=int(x.shape[0]),
+                             hits=len(hits), misses=len(miss_idx))
+            if not miss_idx:
+                # A full hit is single-model by construction (every row
+                # came from the same cache generation) even if a flush
+                # lands right now — no mixing possible, serve it.
+                out = np.stack([hits[i] for i in range(x.shape[0])])
+                router._cache_only.inc()
+                reply(200, {"embeddings": out.tolist(),
+                            "dim": int(out.shape[-1]),
+                            "rows": int(out.shape[0]),
+                            "cache_hits": int(out.shape[0])})
+                return
+            sub = {"inputs": x[miss_idx].tolist()}
+            if timeout_ms is not None:
+                sub["timeout_ms"] = timeout_ms
+            code, payload, headers, served_step = router.forward(
+                json.dumps(sub).encode(), rid)
+            if code == 200 and hits and (
+                    cache.generation != generation
+                    or (served_step is not None
+                        and pool.trusted_step is not None
+                        and served_step != pool.trusted_step)):
+                # The cached rows and the fetched rows came from
+                # different models — one response must never mix two
+                # embedding spaces. Two ways there: a flush
+                # (promote/rollback/adopt — a MODEL change) landed
+                # while the misses were in flight, or the forward hit a
+                # non-trusted worker (a post-promote laggard still on
+                # the old step, or a canary) while the cache holds the
+                # trusted model. Re-forward the whole request — a
+                # single worker reply is internally consistent
+                # regardless of later flushes.
+                hits = {}
+                miss_idx = list(range(x.shape[0]))
+                full = {"inputs": x.tolist()}
+                if timeout_ms is not None:
+                    full["timeout_ms"] = timeout_ms
+                code, payload, headers, served_step = router.forward(
+                    json.dumps(full).encode(), rid)
+            if code != 200:
+                reply(code, payload, headers)
+                return
+            try:
+                fetched = np.asarray(payload["embeddings"],
+                                     dtype=np.float32)
+                if fetched.shape[0] != len(miss_idx):
+                    raise ValueError(f"worker returned "
+                                     f"{fetched.shape[0]} rows for "
+                                     f"{len(miss_idx)} misses")
+            except (KeyError, TypeError, ValueError) as e:
+                router._reject("bad_worker_payload")
+                reply(502, {"error": f"malformed worker response: {e}"})
+                return
+            if pool.allow_cache_insert(served_step):
+                cache.insert(x[miss_idx], fetched)
+            merged = np.empty((x.shape[0], fetched.shape[-1]),
+                              dtype=np.float32)
+            for j, i in enumerate(miss_idx):
+                merged[i] = fetched[j]
+            for i, vec in hits.items():
+                merged[i] = vec
+            reply(200, {"embeddings": merged.tolist(),
+                        "dim": int(merged.shape[-1]),
+                        "rows": int(merged.shape[0]),
+                        "cache_hits": len(hits)})
+
+    return Handler

@@ -58,6 +58,7 @@ from .batcher import (
     QueueFullError,
 )
 from .engine import InferenceEngine
+from .limits import MAX_BODY_BYTES
 
 logger = logging.getLogger(__name__)
 
@@ -66,13 +67,6 @@ __all__ = ["EmbeddingServer"]
 # Deadline cap: a client asking for a multi-minute wait would hold a
 # handler thread (and its queue slot's worth of patience) hostage.
 MAX_TIMEOUT_S = 60.0
-# Request-size caps: the bounded queue protects device time, but a body
-# has to be parsed BEFORE it can be queued — without caps a multi-GB
-# JSON body (or one merely-huge valid request hogging the single worker
-# through thousands of chunked device calls) exhausts memory or
-# head-of-line-blocks everything without a single 429. Oversized bodies
-# get 413 + Connection: close without being read.
-MAX_BODY_BYTES = 32 << 20
 MAX_REQUEST_ROWS_BUCKETS = 8  # rows cap = this many max-size buckets
 
 
@@ -127,12 +121,43 @@ class EmbeddingServer:
         self._terminated_clean = False
         self._httpd: ThreadingHTTPServer | None = None
         self._http_thread: threading.Thread | None = None
+        # Readiness is distinct from liveness (/readyz vs /healthz): a
+        # worker whose ladder is still compiling is ALIVE but must not
+        # receive router traffic — /embed answers 503 + Retry-After and
+        # /readyz stays red until end_warmup(). Servers that never call
+        # begin_warmup() (direct construction, tests) are ready as soon
+        # as they serve.
+        self._warming = threading.Event()
+        self.warmup_retry_after_s = 2.0
+        # Checkpoint hot-reload seam (serving/worker.py): when set, the
+        # handler exposes its current step on /healthz//readyz and
+        # routes POST /rollback to it.
+        self.reloader = None
 
     # -- status ----------------------------------------------------------
     @property
     def serving(self) -> bool:
         return (self.batcher is not None and not self.batcher.closed
                 and not self._shutdown.is_set())
+
+    @property
+    def ready(self) -> bool:
+        return self.serving and not self._warming.is_set()
+
+    def begin_warmup(self) -> None:
+        """Mark the ladder cold: /readyz 503s and /embed sheds with
+        Retry-After until ``end_warmup()`` (cli wires this around
+        ``engine.warmup()`` when the listener binds first)."""
+        self._warming.set()
+
+    def end_warmup(self) -> None:
+        self._warming.clear()
+
+    def checkpoint_step(self) -> int | None:
+        if self.reloader is not None:
+            return self.reloader.current_step
+        step = self.metrics.checkpoint_step
+        return step if step >= 0 else None
 
     def status(self) -> str:
         dog = self._watchdog
@@ -255,7 +280,25 @@ def _make_handler(server: EmbeddingServer):
             if route == "/healthz":
                 status = server.status()
                 self._reply(200 if status == "serving" else 503,
-                            {"status": status})
+                            {"status": status,
+                             "ready": server.ready,
+                             "checkpoint_step": server.checkpoint_step()})
+            elif route == "/readyz":
+                # Readiness gate (distinct from liveness): the router
+                # must never send traffic to a cold worker. Ready =
+                # warmup complete AND the batcher accepting.
+                if server.ready:
+                    self._reply(200, {
+                        "status": "ready",
+                        "checkpoint_step": server.checkpoint_step()})
+                else:
+                    retry = server.warmup_retry_after_s
+                    self._reply(503, {
+                        "status": "warming" if server._warming.is_set()
+                        else server.status(),
+                        "retry_after_s": retry,
+                        "checkpoint_step": server.checkpoint_step()},
+                        {"Retry-After": f"{retry:.3f}"})
             elif route == "/metrics":
                 # Content negotiation (ISSUE 3): JSON stays the default
                 # (existing dashboards/smoke parse it); a Prometheus
@@ -282,8 +325,12 @@ def _make_handler(server: EmbeddingServer):
             # response echoes it as X-Request-Id, and the span layer
             # threads it queue -> batch-coalesce -> device-chunk ->
             # respond, so one slow request can be followed through the
-            # whole stack in the exported trace (obs/trace.py).
-            rid = _trace.new_request_id()
+            # whole stack in the exported trace (obs/trace.py). A
+            # request arriving WITH an id keeps it (ISSUE 8): the fleet
+            # router mints at its edge and forwards, so one id threads
+            # cache -> route -> worker queue -> device chunk.
+            rid = (self.headers.get("X-Request-Id")
+                   or _trace.new_request_id())
             t_ingest = time.monotonic()
             status = {"code": None, "rows": None}
 
@@ -291,6 +338,13 @@ def _make_handler(server: EmbeddingServer):
                       headers: dict | None = None) -> None:
                 status["code"] = code
                 merged = {"X-Request-Id": rid}
+                # The step that ACTUALLY served this response (ISSUE 8):
+                # the router's health-probe view lags a hot swap, so the
+                # worker labels every reply itself — the label is what
+                # gates cache inserts and canary accounting upstream.
+                step = server.checkpoint_step()
+                if step is not None:
+                    merged["X-Checkpoint-Step"] = str(step)
                 if headers:
                     merged.update(headers)
                 self._reply(code, payload, merged)
@@ -304,6 +358,27 @@ def _make_handler(server: EmbeddingServer):
                         (time.monotonic() - t_ingest) * 1e3,
                         request_id=rid, status=status["code"],
                         rows=status["rows"])
+
+        def _do_rollback(self, reply, body: bytes) -> None:
+            """Control surface for the router's canary breach (ISSUE 8):
+            revert to the previously served weights and blocklist the
+            named step so the watcher never re-adopts it."""
+            if server.reloader is None:
+                reply(404, {"error": "no checkpoint reloader on this "
+                                     "server (start with --watch-ckpt)"})
+                return
+            try:
+                req = json.loads(body or b"{}")
+                step = req.get("step")
+                step = int(step) if step is not None else None
+            except (ValueError, TypeError) as e:
+                reply(400, {"error": f"bad request: {e}"})
+                return
+            rolled = server.reloader.rollback(step)
+            reply(200, {"rolled_back": rolled,
+                        "checkpoint_step": server.reloader.current_step,
+                        "blocked_steps":
+                            sorted(server.reloader.blocked_steps)})
 
         def _do_embed_post(self, reply, rid, status) -> None:
             # Drain the body BEFORE any early reply: with keep-alive
@@ -325,8 +400,20 @@ def _make_handler(server: EmbeddingServer):
                       {"Connection": "close"})
                 return
             body = self.rfile.read(length) if length > 0 else b""
+            if self.path == "/rollback":
+                self._do_rollback(reply, body)
+                return
             if self.path != "/embed":
                 reply(404, {"error": f"no route {self.path!r}"})
+                return
+            if server._warming.is_set():
+                # Cold ladder: shed with the same Retry-After semantics
+                # as backpressure — a client (or router) retries once
+                # the ladder is compiled instead of paying the compile.
+                retry = server.warmup_retry_after_s
+                reply(503, {"error": "warming up (ladder compiling)",
+                            "retry_after_s": retry},
+                      {"Retry-After": f"{retry:.3f}"})
                 return
             batcher = server.batcher
             if batcher is None or batcher.closed:

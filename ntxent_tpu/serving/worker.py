@@ -1,0 +1,197 @@
+"""Worker-side zero-downtime rollout: watch checkpoints, swap warm.
+
+A fleet worker is an ``EmbeddingServer`` plus this module's
+``CheckpointWatcher``: a daemon thread that polls the crash-safe
+checkpoint directory (training/checkpoint.py) with the SAME validity
+rules training restores use — manifest-verified, newest-VALID step, a
+torn or corrupt step is invisible — and hot-swaps the engine's weights
+when a new step lands:
+
+* **warm, then swap**: ``engine.swap_variables`` reuses the compiled
+  ladder when the pytree structure is unchanged (the overwhelmingly
+  common case — executables take weights as arguments) and pre-compiles
+  the full ladder BEFORE publishing when it changed. Requests never see
+  a cold bucket, which is what keeps per-worker compile counts flat
+  across a rollout (the fleet smoke's acceptance signal);
+* **staggered adoption** (``delay_s``): the fleet hands each worker a
+  different delay, so a new checkpoint reaches one worker first — that
+  worker IS the canary cohort the router routes a configured traffic
+  fraction to;
+* **rollback** (``rollback()``, wired to the worker's ``POST
+  /rollback``): revert to the previously served weights and blocklist
+  the bad step so the watcher never re-adopts it. The router calls this
+  on every worker at the bad step when the canary error rate breaches.
+
+The watcher never writes to the checkpoint directory (no GC, no saves)
+— it is a pure reader beside the training job that owns the dir.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections.abc import Callable
+
+from ..obs import events as obs_events
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["CheckpointWatcher"]
+
+
+def default_variables_fn(state) -> dict:
+    """TrainState -> the variables dict the serving forward applies
+    (the same shape cli.serve_main builds at startup)."""
+    return {"params": state.params, "batch_stats": state.batch_stats}
+
+
+class CheckpointWatcher:
+    """Poll a checkpoint dir; warm-swap the engine on a new valid step.
+
+    ``template`` is the TrainState template restores deserialize into
+    (cli builds it from the same model flags as the engine).
+    ``initial_step`` is the step already being served (None = random
+    init — the first valid step on disk is adopted as an upgrade).
+    """
+
+    def __init__(self, ckpt_dir, template, engine,
+                 poll_s: float = 2.0, delay_s: float = 0.0,
+                 initial_step: int | None = None,
+                 variables_fn: Callable = default_variables_fn,
+                 on_swap: Callable[[int, str], None] | None = None):
+        from ..training.checkpoint import CheckpointManager
+
+        # max_to_keep=None: retention/GC belong to the training process
+        # that owns the directory; a reader must never collect its steps.
+        self.manager = CheckpointManager(ckpt_dir, max_to_keep=None)
+        self.template = template
+        self.engine = engine
+        self.poll_s = float(poll_s)
+        self.delay_s = float(delay_s)
+        self.variables_fn = variables_fn
+        self.on_swap = on_swap
+        self.current_step: int | None = initial_step
+        self.blocked_steps: set[int] = set()
+        self.swaps = 0
+        self.rollbacks = 0
+        self._prev: tuple[int | None, object] | None = None
+        self._first_seen: dict[int, float] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if initial_step is not None:
+            engine.metrics.set_checkpoint_step(initial_step)
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "CheckpointWatcher":
+        if self._thread is not None:
+            raise RuntimeError("watcher already started")
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ntxent-ckpt-watcher")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+            self._thread = None
+        self.manager.close()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — a bad poll must not kill
+                # the watcher: the worker keeps serving current weights.
+                logger.exception("checkpoint watcher: poll failed")
+
+    # -- adoption ---------------------------------------------------------
+    def _candidate_step(self) -> int | None:
+        """Newest manifest-VALID step that is not blocklisted and not
+        what we already serve (newest-valid semantics from PR 5: a torn
+        or corrupt step can never be adopted)."""
+        for step in sorted(self.manager.all_steps(), reverse=True):
+            if step in self.blocked_steps:
+                continue
+            if step == self.current_step:
+                return None  # already serving the newest acceptable step
+            if self.manager.verify(step):
+                return step
+            logger.warning("checkpoint watcher: step %d fails "
+                           "verification — skipping", step)
+        return None
+
+    def poll_once(self) -> bool:
+        """One poll cycle; returns True when a swap happened."""
+        with self._lock:
+            step = self._candidate_step()
+            if step is None:
+                return False
+            if self.delay_s > 0:
+                first = self._first_seen.setdefault(step, time.monotonic())
+                if time.monotonic() - first < self.delay_s:
+                    return False  # staggered: not this worker's turn yet
+            return self._adopt(step)
+
+    def _adopt(self, step: int) -> bool:
+        try:
+            state = self.manager.restore(self.template, step=step)
+        except Exception as e:  # noqa: BLE001 — a CRC-clean step that
+            # fails to deserialize (foreign format) must not wedge the
+            # watcher in a retry loop: block it and keep serving.
+            logger.exception("checkpoint watcher: restore of step %d "
+                             "failed — blocklisting it", step)
+            self.blocked_steps.add(step)
+            obs_events.emit("rollout", action="restore_failed", step=step,
+                            error=f"{type(e).__name__}: {e}")
+            return False
+        variables = self.variables_fn(state)
+        prev = (self.current_step, self.engine.variables)
+        mode = self.engine.swap_variables(variables)
+        self._prev = prev
+        self.current_step = step
+        self.swaps += 1
+        self._first_seen.pop(step, None)
+        self.engine.metrics.set_checkpoint_step(step)
+        obs_events.emit("rollout", action="swap", step=step, mode=mode,
+                        previous_step=prev[0])
+        logger.info("checkpoint watcher: now serving step %d (%s, "
+                    "previous %s)", step, mode, prev[0])
+        if self.on_swap is not None:
+            self.on_swap(step, mode)
+        return True
+
+    # -- rollback ---------------------------------------------------------
+    def rollback(self, step: int | None = None) -> bool:
+        """Revert to the previously served weights; blocklist the bad
+        step. ``step=None`` blocks whatever is currently served. Returns
+        True when weights actually changed (False: the named step is not
+        the one being served — still blocklisted so it is never
+        adopted)."""
+        with self._lock:
+            bad = step if step is not None else self.current_step
+            if bad is not None:
+                self.blocked_steps.add(bad)
+                self._first_seen.pop(bad, None)
+            if bad is None or bad != self.current_step:
+                return False
+            if self._prev is None:
+                logger.warning("checkpoint watcher: rollback of step %s "
+                               "requested but no previous weights held",
+                               bad)
+                return False
+            prev_step, prev_vars = self._prev
+            self.engine.swap_variables(prev_vars)
+            self.current_step = prev_step
+            self._prev = None
+            self.rollbacks += 1
+            self.engine.metrics.set_checkpoint_step(
+                prev_step if prev_step is not None else -1)
+            self.engine.metrics.rollback()
+            obs_events.emit("rollout", action="rollback", step=bad,
+                            restored_step=prev_step)
+            logger.warning("checkpoint watcher: rolled back step %d -> "
+                           "%s (step blocklisted)", bad, prev_step)
+            return True

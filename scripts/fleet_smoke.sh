@@ -1,0 +1,292 @@
+#!/usr/bin/env bash
+# Fleet smoke: the ISSUE 8 chaos drill in <60 s on CPU. Boots a 2-worker
+# ntxent-fleet (router + embedding cache + supervised ntxent-serve
+# replicas) on a real 2-step checkpoint, then — under sustained
+# mixed-size /embed load through the router — SIGKILLs one worker
+# (killworker@16 fleet chaos) AND rolls a new checkpoint (a concurrent
+# training run advances the dir to step 4). Asserts the acceptance
+# signals:
+#   * zero client-visible 5xx: every request answers 200 (or 429
+#     backpressure) while a worker dies and weights swap;
+#   * the kill was real and survived: fleet_worker_restarts_total >= 1
+#     and both workers are ready again at the end;
+#   * zero-downtime rollout happened: the router's trusted step reaches
+#     the new checkpoint and every ready worker serves it;
+#   * per-worker compile counts are FLAT between post-warmup and
+#     end-of-drill (the warm swap reused the compiled ladder);
+#   * the cache absorbed load: hit counters > 0 and hits served with no
+#     worker forward.
+# Any 5xx, hang, or failed assertion exits nonzero.
+# Pairs with `pytest -m fleet` (the same tier asserted in-process).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+t_start=$SECONDS
+
+workdir="$(mktemp -d)"
+fleet_pid=""
+train_pid=""
+cleanup() {
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "--- fleet log tail (rc=$rc) ---" >&2
+        tail -40 "$workdir/fleet.log" >&2 2>/dev/null || true
+        for wlog in "$workdir"/fleet/w*.log; do
+            [ -f "$wlog" ] || continue
+            echo "--- $(basename "$wlog") tail ---" >&2
+            tail -15 "$wlog" >&2
+        done
+    fi
+    [ -n "$fleet_pid" ] && kill "$fleet_pid" 2>/dev/null || true
+    [ -n "$train_pid" ] && kill "$train_pid" 2>/dev/null || true
+    [ -n "$fleet_pid" ] && wait "$fleet_pid" 2>/dev/null || true
+    rm -rf "$workdir"
+}
+trap cleanup EXIT
+
+ckpt="$workdir/ckpt"
+train_flags=(--platform cpu --dataset synthetic --synthetic-samples 64
+             --image-size 8 --model tiny --proj-hidden-dim 16
+             --proj-dim 8 --batch 8 --warmup-steps 1 --seed 0
+             --ckpt-dir "$ckpt" --ckpt-every 1 --log-every 1)
+
+# Phase 0 — a real checkpoint for the workers to restore (step 2).
+JAX_PLATFORMS=cpu python -m ntxent_tpu.cli "${train_flags[@]}" \
+    --steps 2 >"$workdir/train0.log" 2>&1 \
+    || { echo "seed training failed:"; tail -20 "$workdir/train0.log"; exit 1; }
+
+# Phase 1 — the fleet: 2 workers, tiny ladder, fast health/watch polls,
+# killworker@16 = SIGKILL one worker 4 s after BOTH are ready (chaos
+# ordinals count from full readiness), i.e. mid-load below.
+port_file="$workdir/router.port"
+JAX_PLATFORMS=cpu python -c \
+    'import sys; from ntxent_tpu.cli import fleet_main; sys.exit(fleet_main(sys.argv[1:]))' \
+    --platform cpu --model tiny --image-size 8 --proj-hidden-dim 16 \
+    --proj-dim 8 --ckpt-dir "$ckpt" --workers 2 --buckets 1,4 \
+    --max-delay-ms 10 --queue-size 32 --watch-poll 0.25 \
+    --worker-stagger 1 --health-poll 0.25 --canary-fraction 0.5 \
+    --canary-min-requests 4 --chaos killworker@16 --port 0 \
+    --port-file "$port_file" --workdir "$workdir/fleet" \
+    >"$workdir/fleet.log" 2>&1 &
+fleet_pid=$!
+
+for _ in $(seq 120); do
+    [ -s "$port_file" ] && break
+    kill -0 "$fleet_pid" 2>/dev/null || { echo "fleet died:"; tail -20 "$workdir/fleet.log"; exit 1; }
+    sleep 0.5
+done
+[ -s "$port_file" ] || { echo "router never bound:"; tail -20 "$workdir/fleet.log"; exit 1; }
+port="$(cat "$port_file")"
+
+# Wait for BOTH workers to pass /readyz (cold JAX + ladder warmup).
+JAX_PLATFORMS=cpu python - "$port" <<'PY'
+import json, sys, time, urllib.request
+port = sys.argv[1]
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+            h = json.loads(r.read())
+        if h.get("workers_ready") == 2:
+            assert h["trusted_step"] == 2, h  # restored the seed ckpt
+            sys.exit(0)
+    except OSError:
+        pass
+    time.sleep(0.5)
+sys.exit("workers never became ready")
+PY
+
+# Phase 2 — new checkpoint lands DURING the load: advance the same dir
+# to step 4 in a concurrent training process (restores step 2 first).
+JAX_PLATFORMS=cpu python -m ntxent_tpu.cli "${train_flags[@]}" \
+    --steps 4 >"$workdir/train1.log" 2>&1 &
+train_pid=$!
+
+# Sustained mixed-size load through the router while the SIGKILL and the
+# rollout land; then the assertions.
+JAX_PLATFORMS=cpu python - "$port" "$workdir/fleet" <<'PY'
+import json, sys, threading, time, urllib.error, urllib.request
+from pathlib import Path
+
+port, fleet_dir = sys.argv[1], Path(sys.argv[2])
+base = f"http://127.0.0.1:{port}"
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=15) as r:
+        return json.loads(r.read())
+
+
+def worker_metrics():
+    """{worker_id: (port, compiles, checkpoint_step)} via port files."""
+    out = {}
+    for pf in sorted(fleet_dir.glob("w*.port")):
+        try:
+            wport = int(pf.read_text().strip())
+            m = get(f"http://127.0.0.1:{wport}/metrics")
+            out[pf.stem] = (wport, m["compile"]["compiles"],
+                            m["checkpoint_step"])
+        except (OSError, ValueError):
+            pass
+    return out
+
+
+before = worker_metrics()
+assert len(before) == 2, f"expected 2 worker ports, saw {before}"
+
+codes = {}
+codes_lock = threading.Lock()
+stop = threading.Event()
+hot = json.dumps({"inputs": [[[[0.5] * 3] * 8] * 8] * 2,
+                  "timeout_ms": 20000}).encode()  # the repeated payload
+
+
+def fresh(tid, i):
+    """A never-before-seen mixed-size payload: unique pixel value per
+    (thread, iteration) so the cache cannot absorb it — the canary
+    needs ROUTED traffic to reach a verdict."""
+    v = round((tid * 100000 + i) * 1e-6, 6)
+    rows = (1, 2, 4)[i % 3]
+    return json.dumps({"inputs": [[[[v] * 3] * 8] * 8] * rows,
+                       "timeout_ms": 20000}).encode()
+
+
+def client(tid):
+    i = 0
+    while not stop.is_set():
+        i += 1
+        body = hot if i % 3 == 0 else fresh(tid, i)
+        req = urllib.request.Request(base + "/embed", data=body,
+                                     method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=25) as r:
+                code = r.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            code = e.code
+        except OSError:
+            code = -1  # router itself unreachable: always a failure
+        with codes_lock:
+            codes[code] = codes.get(code, 0) + 1
+        time.sleep(0.02)
+
+
+threads = [threading.Thread(target=client, args=(t,)) for t in range(6)]
+for t in threads:
+    t.start()
+
+
+def fleet_state():
+    try:
+        return get(base + "/healthz")
+    except OSError:
+        return {}
+
+
+# Sustained-load window: the kill fires ~4 s in (killworker@16 at the
+# 0.25 s health poll) and the new checkpoint lands a few seconds later.
+# Run at least 12 s so both are under load; stop early once the rollout
+# has completed AND the killed worker is back.
+t0 = time.monotonic()
+while time.monotonic() - t0 < 20:
+    time.sleep(1.0)
+    s = fleet_state()
+    if time.monotonic() - t0 >= 12 and s.get("workers_ready") == 2 \
+            and (s.get("trusted_step") or 0) >= 4:
+        break
+stop.set()
+for t in threads:
+    t.join(30.0)
+
+# Recovery window: the respawned worker pays a fresh JAX cold start —
+# give it quiet CPU, but keep a trickle of fresh traffic flowing so the
+# canary can still reach its verdict if the rollout landed late. Done
+# when the fleet has CONVERGED: both ready, new step trusted, and every
+# worker's watcher has adopted it (the laggard swaps one poll later).
+deadline = time.monotonic() + 45
+i = 10**6
+while time.monotonic() < deadline:
+    s = fleet_state()
+    if s.get("workers_ready") == 2 and (s.get("trusted_step") or 0) >= 4:
+        w = get(base + "/metrics")["workers"]
+        if {e["checkpoint_step"] for e in w.values()} == \
+                {s["trusted_step"]}:
+            break
+    i += 1
+    req = urllib.request.Request(base + "/embed", data=fresh(9, i),
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=25) as r:
+            code = r.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        code = e.code
+    except OSError:
+        code = -1
+    codes[code] = codes.get(code, 0) + 1
+    time.sleep(1.0)
+
+m = get(base + "/metrics")
+with urllib.request.urlopen(base + "/metrics?format=prometheus",
+                            timeout=15) as r:
+    prom = {}
+    for line in r.read().decode().splitlines():
+        if line and not line.startswith("#"):
+            key, _, val = line.rpartition(" ")
+            prom[key] = float(val)
+
+# 1) zero client-visible 5xx under SIGKILL + rollout.
+bad = {c: n for c, n in codes.items() if c not in (200, 429)}
+total = sum(codes.values())
+assert not bad, f"non-200/429 under chaos: {bad} (all: {codes})"
+assert codes.get(200, 0) >= 50, f"too little load served: {codes}"
+
+# 2) the kill landed and was survived.
+assert prom.get("fleet_worker_restarts_total", 0) >= 1, \
+    f"no worker restart recorded: {sorted(prom)}"
+assert m["workers"] and all(w["ready"] for w in m["workers"].values()), \
+    m["workers"]
+
+# 3) zero-downtime rollout: new step trusted, every worker serves it.
+assert m["trusted_step"] >= 4, m
+steps = {w["checkpoint_step"] for w in m["workers"].values()}
+assert steps == {m["trusted_step"]}, (steps, m["trusted_step"])
+
+# 4) compile counts flat after warmup on same-incarnation workers (the
+# warm swap reused the ladder; a restarted worker re-warms by design —
+# its fresh count equals the ladder size, which the equality still
+# catches if a swap recompiled on top).
+after = worker_metrics()
+flat = 0
+for wid, (wport, compiles, _) in after.items():
+    if wid in before and before[wid][0] == wport:
+        assert compiles == before[wid][1], \
+            (f"{wid} recompiled across the rollout: {compiles} vs "
+             f"{before[wid][1]} after warmup")
+        flat += 1
+assert flat >= 1, f"no surviving worker to assert flatness on: {after}"
+
+# 5) the cache absorbed load.
+cache = m["cache"]
+assert cache["hits"] > 0 and cache["hit_rate"] > 0, cache
+assert m["cache_only_responses"] > 0, m["cache_only_responses"]
+
+print(f"fleet smoke: OK — {total} requests "
+      f"({codes.get(200, 0)}x200, {codes.get(429, 0)}x429, zero 5xx), "
+      f"restarts={int(prom['fleet_worker_restarts_total'])}, "
+      f"trusted_step={m['trusted_step']}, "
+      f"cache_hit_rate={cache['hit_rate']}, "
+      f"compile-flat workers={flat}/2")
+PY
+
+kill "$fleet_pid"
+wait "$fleet_pid" 2>/dev/null || true
+fleet_pid=""
+wait "$train_pid" 2>/dev/null || true
+train_pid=""
+
+elapsed=$((SECONDS - t_start))
+echo "fleet smoke: OK (${elapsed}s)"
+if [ "$elapsed" -ge 60 ]; then
+    echo "fleet smoke: WARNING — exceeded the 60 s CPU budget" >&2
+fi

@@ -164,7 +164,7 @@ def test_faultplan_parses_kill_and_diskfull():
     assert plan.kill_batches == (4,)
     assert plan.diskfull_writes == (2,)
     assert not plan.empty()
-    with pytest.raises(ValueError, match="bad fault"):
+    with pytest.raises(ValueError, match="valid actions.*killworker"):
         FaultPlan.parse("killl@4")
 
 

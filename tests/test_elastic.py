@@ -439,3 +439,18 @@ def test_fit_restore_step_missing_raises(tmp_path):
         fit(state, data, step, num_steps=4,
             checkpoint_dir=str(tmp_path / "ckpt"),
             checkpoint_every=2, restore_step=3)
+
+
+def test_parse_schedule_single_and_multiprocess_entries():
+    from ntxent_tpu.resilience.crashsim import parse_schedule
+
+    assert parse_schedule("8,4,8") == [(8, 1), (4, 1), (8, 1)]
+    assert parse_schedule("8, 4x2 ,8") == [(8, 1), (4, 2), (8, 1)]
+    with pytest.raises(ValueError, match="DEVICESxPROCESSES"):
+        parse_schedule("8,four")
+    with pytest.raises(ValueError, match="multiple of processes"):
+        parse_schedule("8x3")
+    with pytest.raises(ValueError, match="multiple of processes"):
+        parse_schedule("0x1")
+    with pytest.raises(ValueError, match="empty"):
+        parse_schedule(" , ")

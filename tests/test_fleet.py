@@ -1,0 +1,1398 @@
+"""Serving-fleet tier: router failover, cache bounds, canary rollout.
+
+The router edge cases ISSUE 8 pins are all here: all-workers-down is an
+immediate 503 (never a hang), an exhausted retry budget surfaces the
+WORKER's status code, a cache TTL expiry re-dispatches to a worker, and
+a canary error-rate breach rolls the fleet back to old-checkpoint
+routing. Router tests run against fake HTTP workers (no JAX in the
+loop — behavior and bookkeeping are the subjects); engine-swap and
+readiness tests run the real ``InferenceEngine``/``EmbeddingServer``
+over a linear model; supervision tests drive ``ServingFleet.tick()``
+against a real (but JAX-free) worker subprocess.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import sys
+import textwrap
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ntxent_tpu.resilience import FaultInjector, FaultPlan, RetryPolicy
+from ntxent_tpu.serving import (
+    EmbeddingCache,
+    EmbeddingServer,
+    FleetRouter,
+    InferenceEngine,
+    ServingFleet,
+    WorkerPool,
+)
+
+pytestmark = pytest.mark.fleet
+
+
+# ---------------------------------------------------------------------------
+# fakes / helpers
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeWorker:
+    """One scriptable worker replica: answers /embed per ``mode`` and
+    records everything the router sends it."""
+
+    def __init__(self, dim: int = 4, step: int | None = 1):
+        self.dim = dim
+        self.step = step
+        # When set, every reply carries X-Checkpoint-Step (the reply-
+        # time label a real EmbeddingServer stamps) — lets tests make
+        # the served step DISAGREE with the pool's routing-table view.
+        self.step_header: int | None = None
+        # ok | err500 | busy429 | bad400 | garbage200 | scalar500 |
+        # scalar429 (the scalar modes answer with valid-JSON NON-OBJECT
+        # bodies — what a recycled port's foreign service might say).
+        self.mode = "ok"
+        self.embed_calls: list[int] = []   # row count per /embed
+        self.rollbacks: list[dict] = []
+        self.request_ids: list[str] = []
+        # Called with the row count before each /embed reply — lets a
+        # test interleave router-side events (e.g. a cache flush) with
+        # an in-flight forward deterministically.
+        self.on_embed = None
+        self.rollback_delay_s = 0.0
+        worker = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: N802
+                pass
+
+            def _reply_raw(self, code, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                if worker.step_header is not None:
+                    self.send_header("X-Checkpoint-Step",
+                                     str(worker.step_header))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply(self, code, payload):
+                self._reply_raw(code, json.dumps(payload).encode())
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                req = json.loads(body or b"{}")
+                if self.path == "/rollback":
+                    if worker.rollback_delay_s:
+                        time.sleep(worker.rollback_delay_s)
+                    worker.rollbacks.append(req)
+                    self._reply(200, {"rolled_back": True})
+                    return
+                worker.request_ids.append(
+                    self.headers.get("X-Request-Id"))
+                rows = len(req.get("inputs", []))
+                worker.embed_calls.append(rows)
+                if worker.on_embed is not None:
+                    worker.on_embed(rows)
+                if worker.mode == "err500":
+                    self._reply(500, {"error": "injected worker error"})
+                elif worker.mode == "busy429":
+                    self._reply(429, {"error": "queue full",
+                                      "retry_after_s": 0.25})
+                elif worker.mode == "bad400":
+                    self._reply(400, {"error": "injected bad request"})
+                elif worker.mode == "garbage200":
+                    self._reply_raw(200, b"not json {")
+                elif worker.mode == "scalar500":
+                    self._reply_raw(500, b'"busy"')
+                elif worker.mode == "scalar429":
+                    self._reply_raw(429, b'"try later"')
+                elif worker.mode == "deadline504":
+                    self._reply(504, {"error": "deadline exceeded "
+                                               "in queue"})
+                else:
+                    emb = [[float(worker.step or 0)] * worker.dim
+                           for _ in range(rows)]
+                    self._reply(200, {"embeddings": emb,
+                                      "dim": worker.dim, "rows": rows})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _pool_with(workers: dict[str, FakeWorker], **kw) -> WorkerPool:
+    pool = WorkerPool(**kw)
+    for wid, w in workers.items():
+        pool.upsert(wid, w.url)
+        pool.set_health(wid, alive=True, ready=True,
+                        checkpoint_step=w.step)
+    return pool
+
+
+def _post_router(router, payload, path="/embed"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}{path}",
+        data=json.dumps(payload).encode(), method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _rows(n, value=0.5):
+    return [[value, value] for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# embedding cache
+
+
+class TestEmbeddingCache:
+    def test_row_level_hits_and_misses_split_mixed_requests(self):
+        cache = EmbeddingCache(capacity_rows=8, ttl_s=60)
+        x = np.arange(6, dtype=np.float32).reshape(3, 2)
+        hits, misses = cache.lookup(x)
+        assert hits == {} and misses == [0, 1, 2]
+        cache.insert(x, np.ones((3, 4), np.float32))
+        # A new request repeating rows 0 and 2 hits on exactly those.
+        mixed = np.stack([x[0], np.full(2, 9.0, np.float32), x[2]])
+        hits, misses = cache.lookup(mixed)
+        assert sorted(hits) == [0, 2] and misses == [1]
+        np.testing.assert_array_equal(hits[0], np.ones(4))
+        assert cache.hits == 2 and cache.misses == 4
+        assert cache.hit_rate() == pytest.approx(2 / 6)
+
+    def test_ttl_expiry_is_a_miss_and_evicts(self):
+        clock = FakeClock()
+        cache = EmbeddingCache(capacity_rows=8, ttl_s=10, clock=clock)
+        x = np.ones((1, 2), np.float32)
+        cache.insert(x, np.zeros((1, 4), np.float32))
+        hits, misses = cache.lookup(x)
+        assert misses == []
+        clock.advance(10.001)
+        hits, misses = cache.lookup(x)
+        assert hits == {} and misses == [0]
+        assert len(cache) == 0
+        assert cache.snapshot()["evictions"] == {"ttl": 1}
+
+    def test_lru_capacity_evicts_coldest_first(self):
+        cache = EmbeddingCache(capacity_rows=2, ttl_s=60)
+        rows = np.arange(6, dtype=np.float32).reshape(3, 2)
+        cache.insert(rows[:2], np.zeros((2, 4), np.float32))
+        # Touch row 0 so row 1 is the coldest when row 2 arrives.
+        cache.lookup(rows[:1])
+        cache.insert(rows[2:], np.zeros((1, 4), np.float32))
+        hits, misses = cache.lookup(rows)
+        assert sorted(hits) == [0, 2] and misses == [1]
+        assert cache.snapshot()["evictions"] == {"lru": 1}
+
+    def test_shape_and_dtype_guard_the_content_key(self):
+        cache = EmbeddingCache(capacity_rows=8, ttl_s=60)
+        flat = np.zeros((1, 4), np.float32)
+        cache.insert(flat, np.ones((1, 4), np.float32))
+        # Same bytes, different trailing shape: must NOT alias.
+        square = np.zeros((1, 2, 2), np.float32)
+        hits, misses = cache.lookup(square)
+        assert hits == {} and misses == [0]
+
+    def test_insert_copies_rows_instead_of_pinning_the_batch(self):
+        # Regression: caching a VIEW of the worker's response batch
+        # keeps the whole (N, D) array alive per cached row — and a
+        # later caller mutating its buffer would corrupt the cache.
+        cache = EmbeddingCache(capacity_rows=8, ttl_s=60)
+        x = np.arange(4, dtype=np.float32).reshape(2, 2)
+        batch = np.ones((2, 4), np.float32)
+        cache.insert(x, batch)
+        hits, _ = cache.lookup(x)
+        assert not np.shares_memory(hits[0], batch)
+        batch[:] = 99.0
+        hits, _ = cache.lookup(x)
+        np.testing.assert_array_equal(hits[1], np.ones(4))
+
+    def test_clear_reports_reason_and_counts(self):
+        cache = EmbeddingCache(capacity_rows=8, ttl_s=60)
+        cache.insert(np.arange(4, dtype=np.float32).reshape(2, 2),
+                     np.ones((2, 4), np.float32))
+        assert cache.clear(reason="promote") == 2
+        assert len(cache) == 0
+        assert cache.snapshot()["evictions"] == {"promote": 2}
+
+    def test_clear_bumps_the_generation(self):
+        # The generation is how a reader detects a model change that
+        # landed between its lookup and its merge (clear() is only ever
+        # called for model changes: adopt/promote/rollback).
+        cache = EmbeddingCache(capacity_rows=8, ttl_s=60)
+        g0 = cache.generation
+        cache.insert(np.zeros((1, 2), np.float32),
+                     np.ones((1, 4), np.float32))
+        assert cache.generation == g0  # inserts don't bump
+        cache.clear(reason="promote")
+        assert cache.generation == g0 + 1
+
+
+# ---------------------------------------------------------------------------
+# worker pool (selection + canary state machine, no sockets)
+
+
+class TestWorkerPool:
+    def test_first_observed_step_becomes_trusted(self):
+        pool = WorkerPool()
+        pool.upsert("w0", "http://127.0.0.1:1")
+        assert pool.trusted_step is None
+        pool.set_health("w0", alive=True, ready=True, checkpoint_step=5)
+        assert pool.trusted_step == 5
+
+    def test_pick_is_least_in_flight_and_honors_exclude(self):
+        pool = WorkerPool()
+        for wid in ("w0", "w1"):
+            pool.upsert(wid, f"http://127.0.0.1:{1 + int(wid[1])}")
+            pool.set_health(wid, alive=True, ready=True,
+                            checkpoint_step=1)
+        first = pool.pick()
+        assert first.worker_id == "w0"  # tie broken by id
+        second = pool.pick()            # w0 now has 1 in flight
+        assert second.worker_id == "w1"
+        assert pool.pick(exclude={"w0", "w1"}) is None
+        pool.done("w0")
+        pool.done("w1")
+
+    def test_no_ready_worker_picks_none(self):
+        pool = WorkerPool()
+        pool.upsert("w0", "http://127.0.0.1:1")
+        pool.set_health("w0", alive=True, ready=False)
+        assert pool.pick() is None
+
+    def test_canary_fraction_routes_one_in_period(self):
+        pool = WorkerPool(canary_fraction=0.25)
+        for wid, step in (("w0", 1), ("w1", 1), ("w2", 2)):
+            pool.upsert(wid, "http://127.0.0.1:9")
+            pool.set_health(wid, alive=True, ready=True,
+                            checkpoint_step=step)
+        assert pool.trusted_step == 1
+        picks = []
+        for _ in range(20):
+            entry = pool.pick()
+            picks.append(entry.worker_id)
+            pool.done(entry.worker_id)
+        assert picks.count("w2") == 5  # exactly 1 in 4
+        assert pool.snapshot()["canary_step"] == 2
+
+    def test_observe_promotes_on_clean_canary(self):
+        pool = WorkerPool(canary_min_requests=4,
+                          canary_max_error_rate=0.25)
+        for wid, step in (("w0", 1), ("w1", 2)):
+            pool.upsert(wid, "http://127.0.0.1:9")
+            pool.set_health(wid, alive=True, ready=True,
+                            checkpoint_step=step)
+        entry = pool.pick()
+        pool.done(entry.worker_id)  # arms the canary state
+        decisions = [pool.observe("w1", 2, ok=True) for _ in range(4)]
+        assert decisions[:3] == [None, None, None]
+        assert decisions[3] == ("promote", 2)
+        assert pool.trusted_step == 2
+
+    def test_observe_rolls_back_on_error_rate_breach(self):
+        pool = WorkerPool(canary_min_requests=4,
+                          canary_max_error_rate=0.25)
+        for wid, step in (("w0", 1), ("w1", 2)):
+            pool.upsert(wid, "http://127.0.0.1:9")
+            pool.set_health(wid, alive=True, ready=True,
+                            checkpoint_step=step)
+        entry = pool.pick()
+        pool.done(entry.worker_id)
+        for _ in range(3):
+            assert pool.observe("w1", 2, ok=False) is None
+        assert pool.observe("w1", 2, ok=True) == ("rollback", 2)
+        assert pool.trusted_step == 1 and 2 in pool.bad_steps
+        # A bad-step worker is never a canary again; with old workers
+        # ready, routing is old-cohort-only.
+        picks = {pool.pick().worker_id for _ in range(8)}
+        assert picks == {"w0"}
+
+    def test_healthy_probe_does_not_wipe_forward_failures(self):
+        # Regression: the fleet tick probes (set_health) immediately
+        # before its eject check — if a passing /readyz reset the
+        # shared counter, router-reported forward failures could NEVER
+        # reach the threshold and a worker 500ing every /embed while
+        # answering probes would live forever.
+        pool = WorkerPool()
+        pool.upsert("w0", "http://127.0.0.1:9")
+        pool.set_health("w0", alive=True, ready=True, checkpoint_step=1)
+        pool.report_failure("w0", "http 500")          # forward kind
+        pool.report_failure("w0", "http 500")
+        pool.set_health("w0", alive=True, ready=True)  # healthy probe
+        assert pool.workers()[0].consecutive_failures == 2
+        # Only a successful FORWARD is evidence /embed works.
+        pool.report_success("w0")
+        assert pool.workers()[0].consecutive_failures == 0
+        # A probe-originated streak IS closed by a passing probe.
+        pool.report_failure("w0", "timeout", kind="probe")
+        pool.report_failure("w0", "timeout", kind="probe")
+        pool.set_health("w0", alive=True, ready=True)
+        assert pool.workers()[0].consecutive_failures == 0
+
+
+# ---------------------------------------------------------------------------
+# router edge cases (real sockets, fake workers)
+
+
+class TestFleetRouter:
+    def _router(self, pool, cache=None, example_shape=(2,), retries=2):
+        router = FleetRouter(pool, cache=cache,
+                             example_shape=example_shape, port=0,
+                             retries=retries, forward_timeout_s=10.0,
+                             control_timeout_s=2.0)
+        router.start()
+        return router
+
+    def test_all_workers_down_is_an_immediate_503_not_a_hang(self):
+        pool = WorkerPool()
+        pool.upsert("w0", "http://127.0.0.1:9")
+        pool.set_health("w0", alive=False, ready=False)
+        router = self._router(pool)
+        try:
+            t0 = time.monotonic()
+            status, resp, _ = _post_router(router,
+                                           {"inputs": _rows(1)})
+            assert status == 503 and "no ready workers" in resp["error"]
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            router.close()
+
+    def test_unreachable_workers_yield_503_with_attempts(self):
+        # Ready in the table but nothing listening: connection refused
+        # on every attempt -> 503 naming the last worker tried.
+        pool = WorkerPool()
+        pool.upsert("w0", "http://127.0.0.1:1")
+        pool.set_health("w0", alive=True, ready=True, checkpoint_step=1)
+        router = self._router(pool)
+        try:
+            status, resp, _ = _post_router(router, {"inputs": _rows(1)})
+            assert status == 503 and "no worker reachable" in resp["error"]
+            assert pool.workers()[0].consecutive_failures >= 1
+        finally:
+            router.close()
+
+    def test_failover_hides_a_dead_worker_from_the_client(self):
+        good = FakeWorker()
+        pool = _pool_with({"w1": good})
+        pool.upsert("w0", "http://127.0.0.1:1")  # dead, tried first
+        pool.set_health("w0", alive=True, ready=True, checkpoint_step=1)
+        router = self._router(pool)
+        try:
+            # w0 sorts first on the in-flight tie, so every request
+            # must fail over; the client must never see it.
+            for _ in range(4):
+                status, resp, _ = _post_router(router,
+                                               {"inputs": _rows(2)})
+                assert status == 200 and resp["rows"] == 2
+            assert int(router._retries_ctr.value) >= 1
+        finally:
+            router.close()
+            good.close()
+
+    def test_retry_budget_exhausted_surfaces_worker_status(self):
+        workers = {f"w{i}": FakeWorker() for i in range(2)}
+        for w in workers.values():
+            w.mode = "err500"
+        pool = _pool_with(workers)
+        router = self._router(pool, retries=1)
+        try:
+            status, resp, _ = _post_router(router, {"inputs": _rows(1)})
+            assert status == 500  # the WORKER's code, not a synthetic 502
+            assert resp["worker_error"] == "injected worker error"
+            assert resp["attempts"] == 2  # budget: first + 1 retry
+        finally:
+            router.close()
+            for w in workers.values():
+                w.close()
+
+    def test_all_saturated_aggregates_429_with_retry_after(self):
+        workers = {f"w{i}": FakeWorker() for i in range(2)}
+        for w in workers.values():
+            w.mode = "busy429"
+        pool = _pool_with(workers)
+        router = self._router(pool)
+        try:
+            status, resp, headers = _post_router(router,
+                                                 {"inputs": _rows(1)})
+            assert status == 429
+            assert resp["retry_after_s"] == pytest.approx(0.25)
+            assert float(headers["Retry-After"]) == pytest.approx(0.25)
+            # Saturation is not failure: nobody's ejection counter moved.
+            assert all(w.consecutive_failures == 0
+                       for w in pool.workers())
+        finally:
+            router.close()
+            for w in workers.values():
+                w.close()
+
+    def test_worker_4xx_passes_through_without_retry(self):
+        workers = {f"w{i}": FakeWorker() for i in range(2)}
+        for w in workers.values():
+            w.mode = "bad400"
+        pool = _pool_with(workers)
+        router = self._router(pool)
+        try:
+            status, resp, _ = _post_router(router, {"inputs": _rows(1)})
+            assert status == 400 and "bad request" in resp["error"]
+            # First worker answered; no failover happened for a 4xx.
+            assert sum(len(w.embed_calls)
+                       for w in workers.values()) == 1
+        finally:
+            router.close()
+            for w in workers.values():
+                w.close()
+
+    def test_cache_hit_answers_without_any_worker(self):
+        worker = FakeWorker()
+        pool = _pool_with({"w0": worker})
+        cache = EmbeddingCache(capacity_rows=16, ttl_s=60)
+        router = self._router(pool, cache=cache)
+        try:
+            payload = {"inputs": _rows(3)}
+            status, resp, h = _post_router(router, payload)
+            assert status == 200 and resp["cache_hits"] == 0
+            assert h.get("X-Request-Id")
+            assert worker.embed_calls == [3]
+            status, resp, _ = _post_router(router, payload)
+            assert status == 200 and resp["cache_hits"] == 3
+            assert worker.embed_calls == [3]  # nothing new dispatched
+            assert int(router._cache_only.value) == 1
+            # Mixed request: repeated rows hit, the new row dispatches.
+            mixed = {"inputs": _rows(2) + _rows(1, value=9.0)}
+            status, resp, _ = _post_router(router, mixed)
+            assert status == 200 and resp["cache_hits"] == 2
+            assert worker.embed_calls == [3, 1]
+        finally:
+            router.close()
+            worker.close()
+
+    def test_cache_ttl_expiry_re_dispatches(self):
+        worker = FakeWorker()
+        pool = _pool_with({"w0": worker})
+        clock = FakeClock()
+        cache = EmbeddingCache(capacity_rows=16, ttl_s=5, clock=clock)
+        router = self._router(pool, cache=cache)
+        try:
+            payload = {"inputs": [[0.0, 0.0], [1.0, 1.0]]}
+            _post_router(router, payload)
+            _post_router(router, payload)
+            assert worker.embed_calls == [2]  # second was a pure hit
+            clock.advance(5.01)
+            status, resp, _ = _post_router(router, payload)
+            assert status == 200 and resp["cache_hits"] == 0
+            assert worker.embed_calls == [2, 2]  # expired -> re-dispatch
+            assert cache.snapshot()["evictions"]["ttl"] == 2
+        finally:
+            router.close()
+            worker.close()
+
+    def test_canary_rollback_restores_old_checkpoint_routing(self):
+        old0, old1 = FakeWorker(step=1), FakeWorker(step=1)
+        canary = FakeWorker(step=2)
+        canary.mode = "err500"
+        pool = _pool_with({"w0": old0, "w1": old1, "w2": canary},
+                          canary_fraction=0.5, canary_min_requests=2,
+                          canary_max_error_rate=0.1)
+        cache = EmbeddingCache(capacity_rows=16, ttl_s=60)
+        router = self._router(pool, cache=cache, retries=2)
+        try:
+            assert pool.trusted_step == 1
+            # Distinct inputs defeat the cache so every request routes;
+            # the canary's 500s fail over to old workers -> clients
+            # still see 200 while the breach is being counted.
+            for i in range(12):
+                status, _, _ = _post_router(
+                    router, {"inputs": _rows(1, value=float(i))})
+                assert status == 200
+                if 2 in pool.bad_steps:
+                    break
+            assert 2 in pool.bad_steps and pool.trusted_step == 1
+            # The breached step's worker was told to roll back.
+            deadline = time.monotonic() + 5.0
+            while not canary.rollbacks and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert canary.rollbacks and canary.rollbacks[0]["step"] == 2
+            assert int(pool._rollbacks.value) == 1
+            # Old-checkpoint routing is restored: the canary worker
+            # receives NO further /embed traffic.
+            seen = len(canary.embed_calls)
+            for i in range(8):
+                status, _, _ = _post_router(
+                    router, {"inputs": _rows(1, value=100.0 + i)})
+                assert status == 200
+            assert len(canary.embed_calls) == seen
+        finally:
+            router.close()
+            for w in (old0, old1, canary):
+                w.close()
+
+    def test_canary_promote_flushes_stale_embeddings(self):
+        old, canary = FakeWorker(step=1), FakeWorker(step=2)
+        pool = _pool_with({"w0": old}, canary_fraction=0.5,
+                          canary_min_requests=2,
+                          canary_max_error_rate=0.5)
+        cache = EmbeddingCache(capacity_rows=16, ttl_s=60)
+        router = self._router(pool, cache=cache)
+        try:
+            # Pre-rollout: an OLD-model embedding enters the cache.
+            stale = {"inputs": _rows(1, value=77.0)}
+            _post_router(router, stale)
+            _post_router(router, stale)
+            assert old.embed_calls == [1]  # second was a hit
+            # The rollout begins: a step-2 worker joins; while its
+            # canary is undecided, nothing new may be inserted.
+            pool.upsert("w1", canary.url)
+            pool.set_health("w1", alive=True, ready=True,
+                            checkpoint_step=2)
+            for i in range(10):
+                status, _, _ = _post_router(
+                    router, {"inputs": _rows(1, value=float(i))})
+                assert status == 200
+                if pool.trusted_step == 2:
+                    break
+            assert pool.trusted_step == 2
+            assert int(pool._promotions.value) == 1
+            # Promote flushed: the old model's embedding must not
+            # outlive it — the stale payload re-dispatches to a worker.
+            calls_before = len(old.embed_calls) + len(canary.embed_calls)
+            status, resp, _ = _post_router(router, stale)
+            assert status == 200 and resp["cache_hits"] == 0
+            assert len(old.embed_calls) + len(canary.embed_calls) == \
+                calls_before + 1
+        finally:
+            router.close()
+            for w in (old, canary):
+                w.close()
+
+    def test_canary_verdict_decided_on_a_4xx_takes_effect(self):
+        # Regression: a promote/rollback decision returned by observe()
+        # on the 4xx passthrough path was silently dropped — the pool
+        # promoted but the cache kept the OLD model's embeddings.
+        old = FakeWorker(step=1)
+        canary = FakeWorker(step=2)
+        canary.mode = "bad400"
+        pool = _pool_with({"w0": old}, canary_fraction=1.0,
+                          canary_min_requests=2,
+                          canary_max_error_rate=0.5)
+        cache = EmbeddingCache(capacity_rows=16, ttl_s=60)
+        router = self._router(pool, cache=cache)
+        try:
+            stale = {"inputs": _rows(1, value=77.0)}
+            _post_router(router, stale)
+            _post_router(router, stale)
+            assert old.embed_calls == [1]  # cached
+            pool.upsert("w1", canary.url)
+            pool.set_health("w1", alive=True, ready=True,
+                            checkpoint_step=2)
+            # fraction 1.0: every routed request goes to the canary,
+            # whose 400s are healthy-worker outcomes (ok=True) — the
+            # SECOND one decides the promote.
+            for i in range(2):
+                status, _, _ = _post_router(
+                    router, {"inputs": _rows(1, value=float(i))})
+                assert status == 400
+            assert pool.trusted_step == 2
+            assert int(pool._promotions.value) == 1
+            # The decision must have flushed the cache.
+            canary.mode = "ok"
+            status, resp, _ = _post_router(router, stale)
+            assert status == 200 and resp["cache_hits"] == 0
+        finally:
+            router.close()
+            for w in (old, canary):
+                w.close()
+
+    def test_unparseable_200_counts_as_a_canary_error(self):
+        # Regression: a 200 whose body does not parse marked the worker
+        # failed but never reached canary accounting — a canary model
+        # emitting garbage was failed over forever, never rolled back.
+        old = FakeWorker(step=1)
+        canary = FakeWorker(step=2)
+        canary.mode = "garbage200"
+        pool = _pool_with({"w0": old, "w1": canary},
+                          canary_fraction=1.0, canary_min_requests=2,
+                          canary_max_error_rate=0.1)
+        router = self._router(pool, retries=2)
+        try:
+            # Each request hits the canary first (fraction 1.0), fails
+            # over to the old worker: clients see 200 throughout.
+            for i in range(2):
+                status, _, _ = _post_router(
+                    router, {"inputs": _rows(1, value=float(i))})
+                assert status == 200
+            assert 2 in pool.bad_steps and pool.trusted_step == 1
+            assert int(pool._rollbacks.value) == 1
+            deadline = time.monotonic() + 5.0
+            while not canary.rollbacks and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert canary.rollbacks and canary.rollbacks[0]["step"] == 2
+        finally:
+            router.close()
+            for w in (old, canary):
+                w.close()
+
+    def test_reply_step_label_overrides_the_routing_table(self):
+        # Regression: the served step was snapshotted from the routing
+        # table at pick time — a worker that hot-swapped between health
+        # probe and forward had its NEW model's embeddings cached as if
+        # the trusted model produced them. The worker's reply-time
+        # X-Checkpoint-Step label is authoritative.
+        worker = FakeWorker(step=1)
+        worker.step_header = 2  # already swapped; the table still says 1
+        pool = _pool_with({"w0": worker})
+        assert pool.trusted_step == 1
+        cache = EmbeddingCache(capacity_rows=16, ttl_s=60)
+        router = self._router(pool, cache=cache)
+        try:
+            payload = {"inputs": _rows(1)}
+            status, _, _ = _post_router(router, payload)
+            assert status == 200
+            # Served step 2 != trusted 1: the insert must be refused,
+            # so the repeat re-dispatches instead of serving a wrong-
+            # model embedding from the cache.
+            status, resp, _ = _post_router(router, payload)
+            assert status == 200 and resp["cache_hits"] == 0
+            assert worker.embed_calls == [1, 1]
+        finally:
+            router.close()
+            worker.close()
+
+    def test_first_trusted_adoption_flushes_random_init_cache(self):
+        # Regression: the None -> step trusted transition (first valid
+        # checkpoint observed) is a model change with no canary verdict
+        # — without a flush, embeddings computed from random init
+        # weights kept serving after the fleet adopted a real model.
+        worker = FakeWorker(step=None)  # serving random init
+        pool = _pool_with({"w0": worker})
+        assert pool.trusted_step is None
+        cache = EmbeddingCache(capacity_rows=16, ttl_s=60)
+        router = self._router(pool, cache=cache)
+        try:
+            payload = {"inputs": _rows(1)}
+            _post_router(router, payload)
+            status, resp, _ = _post_router(router, payload)
+            assert status == 200 and resp["cache_hits"] == 1
+            # The first checkpoint lands and is adopted as trusted.
+            pool.set_health("w0", alive=True, ready=True,
+                            checkpoint_step=3)
+            assert pool.trusted_step == 3
+            assert len(cache) == 0
+            worker.step = 3
+            status, resp, _ = _post_router(router, payload)
+            assert status == 200 and resp["cache_hits"] == 0
+            assert worker.embed_calls == [1, 1]
+        finally:
+            router.close()
+            worker.close()
+
+    def test_scalar_json_error_bodies_never_crash_the_handler(self):
+        # Regression: a 429/5xx body that is valid JSON but NOT an
+        # object (a recycled port's foreign service answering "busy")
+        # hit detail.get() and raised AttributeError out of forward(),
+        # dropping the client's connection with no response at all.
+        w500 = {f"w{i}": FakeWorker() for i in range(2)}
+        for w in w500.values():
+            w.mode = "scalar500"
+        router = self._router(_pool_with(w500), retries=1)
+        try:
+            status, resp, _ = _post_router(router, {"inputs": _rows(1)})
+            assert status == 500 and resp["attempts"] == 2
+            assert "busy" in resp["worker_error"]
+        finally:
+            router.close()
+            for w in w500.values():
+                w.close()
+        w429 = {f"w{i}": FakeWorker() for i in range(2)}
+        for w in w429.values():
+            w.mode = "scalar429"
+        router = self._router(_pool_with(w429))
+        try:
+            status, resp, headers = _post_router(router,
+                                                 {"inputs": _rows(1)})
+            assert status == 429  # default retry-after, not a crash
+            assert resp["retry_after_s"] == pytest.approx(0.05)
+            assert "Retry-After" in headers
+        finally:
+            router.close()
+            for w in w429.values():
+                w.close()
+
+    def test_flush_mid_flight_never_mixes_models(self):
+        # Regression: rows cached before a promote/rollback flush were
+        # merged with rows fetched AFTER it — one response mixing two
+        # models' embedding spaces. A generation change between lookup
+        # and merge must re-forward the whole request instead.
+        worker = FakeWorker(step=1)
+        pool = _pool_with({"w0": worker})
+        cache = EmbeddingCache(capacity_rows=16, ttl_s=60)
+        router = self._router(pool, cache=cache)
+        try:
+            row_a = _rows(1, value=1.0)
+            _post_router(router, {"inputs": row_a})  # caches row A
+            assert worker.embed_calls == [1]
+
+            def flush_once(rows):
+                worker.on_embed = None
+                cache.clear(reason="promote")
+
+            worker.on_embed = flush_once
+            # Row A hits, row B forwards; the flush lands while B's
+            # forward is in flight.
+            status, resp, _ = _post_router(
+                router, {"inputs": row_a + _rows(1, value=2.0)})
+            assert status == 200
+            # No stale merge: the response reports zero cache hits and
+            # the whole request was re-dispatched (1-row sub-request,
+            # then the full 2-row one).
+            assert resp["cache_hits"] == 0
+            assert worker.embed_calls == [1, 1, 2]
+        finally:
+            router.close()
+            worker.close()
+
+    def test_worker_504_passes_through_without_retry_or_ejection(self):
+        # Regression: 504 sat in the `>= 500` failure class, so a
+        # client-chosen timeout_ms expiring under load retried on other
+        # workers (burning another full deadline each) and counted
+        # toward ejection and canary breach — healthy workers got
+        # SIGKILLed for their clients' impatience. The module contract
+        # lists 504 with the 4xx pass-throughs.
+        workers = {f"w{i}": FakeWorker() for i in range(2)}
+        for w in workers.values():
+            w.mode = "deadline504"
+        pool = _pool_with(workers)
+        router = self._router(pool, retries=2)
+        try:
+            status, resp, _ = _post_router(router, {"inputs": _rows(1)})
+            assert status == 504 and "deadline" in resp["error"]
+            # One attempt total, and nobody's ejection counter moved.
+            assert sum(len(w.embed_calls)
+                       for w in workers.values()) == 1
+            assert all(w.consecutive_failures == 0
+                       for w in pool.workers())
+        finally:
+            router.close()
+            for w in workers.values():
+                w.close()
+
+    def test_laggard_fetch_never_merges_with_a_newer_models_cache(self):
+        # Regression: post-promote, the cache holds the NEW trusted
+        # model's rows while staggered laggards still serve the old
+        # step in the same routing cohort — a partial-hit request whose
+        # misses landed on a laggard merged two models' embeddings into
+        # one response. served-step vs trusted-step gates the merge,
+        # not just the insert.
+        new = FakeWorker(step=2)
+        new.step_header = 2
+        lag = FakeWorker(step=1)
+        lag.step_header = 1
+        pool = _pool_with({"w0": new})
+        assert pool.trusted_step == 2
+        cache = EmbeddingCache(capacity_rows=16, ttl_s=60)
+        router = self._router(pool, cache=cache)
+        try:
+            row = _rows(1, value=7.0)
+            _post_router(router, {"inputs": row})  # caches the new
+            assert new.embed_calls == [1]          # model's embedding
+            # The laggard joins (old cohort) and becomes the only
+            # routable worker — the stagger window, concentrated.
+            pool.upsert("w1", lag.url)
+            pool.set_health("w1", alive=True, ready=True,
+                            checkpoint_step=1)
+            pool.set_health("w0", alive=True, ready=False)
+            status, resp, _ = _post_router(
+                router, {"inputs": row + _rows(1, value=8.0)})
+            assert status == 200
+            # No mixed merge: the cached step-2 row was refused and the
+            # whole request re-forwarded to the laggard (1-row sub-
+            # request, then the full 2-row one).
+            assert resp["cache_hits"] == 0
+            assert lag.embed_calls == [1, 2]
+        finally:
+            router.close()
+            new.close()
+            lag.close()
+
+    def test_rollback_broadcast_is_off_the_request_thread(self):
+        # Regression: the breach-deciding client's own request ran the
+        # serial /rollback broadcast inline — with a wedged worker that
+        # is up to workers x control_timeout_s of added latency on one
+        # unlucky response. The pool blocklists synchronously, so the
+        # broadcast can be async.
+        worker = FakeWorker(step=2)
+        worker.rollback_delay_s = 1.0
+        pool = _pool_with({"w0": worker})
+        router = self._router(pool)
+        try:
+            t0 = time.monotonic()
+            router._handle_decision(("rollback", 2))
+            assert time.monotonic() - t0 < 0.5
+            deadline = time.monotonic() + 5.0
+            while not worker.rollbacks and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert worker.rollbacks and worker.rollbacks[0]["step"] == 2
+        finally:
+            router.close()
+            worker.close()
+
+    def test_router_healthz_and_metrics_surface_the_pool(self):
+        worker = FakeWorker()
+        pool = _pool_with({"w0": worker})
+        # One registry, two views: the cache shares the pool's so its
+        # counters render in the router's Prometheus exposition.
+        router = self._router(
+            pool, cache=EmbeddingCache(registry=pool.registry))
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{router.port}/healthz",
+                    timeout=10) as r:
+                health = json.loads(r.read())
+            assert r.status == 200 and health["workers_ready"] == 1
+            _post_router(router, {"inputs": _rows(2)})
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{router.port}/metrics",
+                    timeout=10) as r:
+                m = json.loads(r.read())
+            assert m["requests"] == 1 and m["forwards"] == 1
+            assert m["workers"]["w0"]["ready"] is True
+            assert m["cache"]["misses"] == 2
+            # Prometheus negotiation serves the shared registry.
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{router.port}"
+                    "/metrics?format=prometheus", timeout=10) as r:
+                prom = r.read().decode()
+            assert "fleet_requests_total 1" in prom
+            assert "fleet_cache_misses_total 2" in prom
+        finally:
+            router.close()
+            worker.close()
+
+
+# ---------------------------------------------------------------------------
+# engine warm swap + server readiness (real JAX, linear model)
+
+
+def _linear_engine(buckets=(1, 2), dim=3):
+    w = jnp.asarray(np.random.RandomState(0).rand(2, dim), jnp.float32)
+    return InferenceEngine(lambda v, x: x @ v, w, example_shape=(2,),
+                           buckets=buckets)
+
+
+class TestSwapVariables:
+    def test_same_structure_swap_reuses_the_compiled_ladder(self):
+        eng = _linear_engine()
+        eng.warmup()
+        compiles = eng.metrics.compiles
+        x = np.ones((1, 2), np.float32)
+        out0 = eng.embed(x)
+        new_w = jnp.asarray(np.asarray(eng.variables) + 1.0)
+        assert eng.swap_variables(new_w) == "reused"
+        out1 = eng.embed(x)
+        assert eng.metrics.compiles == compiles  # zero new compiles
+        assert not np.allclose(out0, out1)
+        np.testing.assert_allclose(out1, x @ np.asarray(new_w), rtol=1e-6)
+        assert eng.metrics.model_swaps == 1
+
+    def test_changed_structure_swap_warms_before_publishing(self):
+        eng = _linear_engine(buckets=(1, 2), dim=3)
+        eng.warmup()
+        compiles = eng.metrics.compiles
+        wider = jnp.asarray(np.random.RandomState(1).rand(2, 5),
+                            jnp.float32)
+        assert eng.swap_variables(wider) == "warmed"
+        # The whole ladder compiled during the swap...
+        assert eng.metrics.compiles == compiles + 2
+        # ...so serving it costs no further compiles.
+        out = eng.embed(np.ones((2, 2), np.float32))
+        assert out.shape == (2, 5)
+        assert eng.metrics.compiles == compiles + 2
+
+    def test_changed_structure_swap_evicts_the_old_ladder(self):
+        # Regression: structural swaps only ADDED executables under the
+        # new hash — a long-lived worker adopting structure-changing
+        # checkpoints grew the compile cache (and its pinned device
+        # allocations) without bound.
+        eng = _linear_engine(buckets=(1, 2), dim=3)
+        eng.warmup()
+        assert len(eng._cache) == 2
+        wider = jnp.asarray(np.random.RandomState(1).rand(2, 5),
+                            jnp.float32)
+        eng.swap_variables(wider)
+        assert len(eng._cache) == 2  # old structure's entries dropped
+        assert all(key[2] == eng._hash for key in eng._cache)
+
+
+class TestReadiness:
+    def test_readyz_is_distinct_from_healthz_while_warming(self):
+        eng = _linear_engine()
+        eng.warmup()
+        srv = EmbeddingServer(eng, port=0, max_delay_s=0.01,
+                              queue_size=4)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            srv.begin_warmup()
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=10) as r:
+                assert r.status == 200  # alive...
+            try:
+                urllib.request.urlopen(base + "/readyz", timeout=10)
+                raise AssertionError("expected 503")
+            except urllib.error.HTTPError as e:
+                body = json.loads(e.read())
+                assert e.code == 503 and body["status"] == "warming"
+                assert float(e.headers["Retry-After"]) > 0
+            # /embed sheds while cold, with the same semantics.
+            req = urllib.request.Request(
+                base + "/embed",
+                data=json.dumps({"inputs": _rows(1)}).encode(),
+                method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                raise AssertionError("expected 503")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert "retry_after_s" in json.loads(e.read())
+            srv.end_warmup()
+            with urllib.request.urlopen(base + "/readyz",
+                                        timeout=10) as r:
+                body = json.loads(r.read())
+                assert r.status == 200 and body["status"] == "ready"
+        finally:
+            srv.close()
+
+    def test_begin_warmup_before_start_is_red_from_the_first_probe(self):
+        # Regression: the fleet-worker CLI marked the ladder cold only
+        # AFTER binding and publishing the port — a probe racing that
+        # window saw ready=true on a cold worker. The supported order
+        # is cold-before-bind.
+        eng = _linear_engine()
+        eng.warmup()
+        srv = EmbeddingServer(eng, port=0, max_delay_s=0.01,
+                              queue_size=4)
+        srv.begin_warmup()
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            try:
+                urllib.request.urlopen(base + "/readyz", timeout=10)
+                raise AssertionError("expected 503")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert json.loads(e.read())["status"] == "warming"
+            srv.end_warmup()
+            with urllib.request.urlopen(base + "/readyz",
+                                        timeout=10) as r:
+                assert r.status == 200
+        finally:
+            srv.close()
+
+    def test_embed_replies_carry_the_checkpoint_step_label(self):
+        # The reply-time X-Checkpoint-Step label is what the router
+        # trusts over its own (hot-swap-lagged) routing table.
+        eng = _linear_engine()
+        eng.warmup()
+        eng.metrics.set_checkpoint_step(4)
+        srv = EmbeddingServer(eng, port=0, max_delay_s=0.01,
+                              queue_size=4)
+        srv.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/embed",
+                data=json.dumps({"inputs": _rows(1)}).encode(),
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 200
+                assert r.headers["X-Checkpoint-Step"] == "4"
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# fault-plan fleet actions
+
+
+class TestFleetFaults:
+    def test_parse_fleet_actions(self):
+        plan = FaultPlan.parse("killworker@3,slowworker@5,killworker@8")
+        assert plan.killworker_ticks == (3, 8)
+        assert plan.slowworker_ticks == (5,)
+        assert not plan.empty()
+
+    def test_unknown_action_error_lists_the_valid_set(self):
+        with pytest.raises(ValueError) as exc:
+            FaultPlan.parse("killwrker@3")
+        msg = str(exc.value)
+        assert "killwrker" in msg
+        for kind in ("killworker", "slowworker", "nan", "sigterm",
+                     "truncate"):
+            assert kind in msg, f"{kind} missing from: {msg}"
+
+    def test_on_fleet_tick_fires_at_the_named_ordinals(self):
+        inj = FaultInjector(FaultPlan.parse("killworker@2,slowworker@2,"
+                                            "killworker@4"))
+        fired = [inj.on_fleet_tick() for _ in range(5)]
+        assert fired == [[], ["killworker@2", "slowworker@2"], [],
+                         ["killworker@4"], []]
+        assert inj.fired == ["killworker@2", "slowworker@2",
+                             "killworker@4"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint watcher (real CheckpointManager, fake engine)
+
+
+class FakeSwapEngine:
+    """Engine double for watcher tests: records swaps, no JAX."""
+
+    def __init__(self):
+        from ntxent_tpu.serving import ServingMetrics
+
+        self.metrics = ServingMetrics()
+        self.variables = {"w": np.zeros(2, np.float32)}
+        self.swaps: list = []
+
+    def swap_variables(self, variables, warm=True):
+        self.swaps.append(variables)
+        self.variables = variables
+        return "reused"
+
+
+def _save_step(ckpt_dir, step: int, value: float):
+    from ntxent_tpu.training.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(ckpt_dir, max_to_keep=None)
+    try:
+        assert mgr.save(step, {"w": np.full(2, value, np.float32)},
+                        force=True)
+    finally:
+        mgr.close()
+
+
+def _watcher(ckpt_dir, engine, **kw):
+    from ntxent_tpu.serving import CheckpointWatcher
+
+    return CheckpointWatcher(ckpt_dir, {"w": np.zeros(2, np.float32)},
+                             engine, variables_fn=lambda s: s, **kw)
+
+
+class TestCheckpointWatcher:
+    def test_adopts_newest_valid_step_and_skips_corrupt(self, tmp_path):
+        from ntxent_tpu.resilience.faults import truncate_checkpoint_file
+
+        ckpt = tmp_path / "ckpt"
+        _save_step(ckpt, 1, 1.0)
+        _save_step(ckpt, 2, 2.0)
+        truncate_checkpoint_file(ckpt, step=2)  # torn: must be invisible
+        eng = FakeSwapEngine()
+        watcher = _watcher(ckpt, eng)
+        try:
+            assert watcher.poll_once() is True
+            assert watcher.current_step == 1
+            np.testing.assert_array_equal(eng.variables["w"],
+                                          np.full(2, 1.0))
+            assert watcher.poll_once() is False  # nothing newer valid
+            _save_step(ckpt, 3, 3.0)
+            assert watcher.poll_once() is True
+            assert watcher.current_step == 3
+            assert eng.metrics.checkpoint_step == 3
+        finally:
+            watcher.stop()
+
+    def test_delay_staggers_adoption(self, tmp_path):
+        ckpt = tmp_path / "ckpt"
+        _save_step(ckpt, 1, 1.0)
+        eng = FakeSwapEngine()
+        watcher = _watcher(ckpt, eng, delay_s=0.4)
+        try:
+            assert watcher.poll_once() is False  # seen, not adopted yet
+            time.sleep(0.45)
+            assert watcher.poll_once() is True
+            assert watcher.current_step == 1
+        finally:
+            watcher.stop()
+
+    def test_rollback_reverts_and_blocklists(self, tmp_path):
+        ckpt = tmp_path / "ckpt"
+        _save_step(ckpt, 1, 1.0)
+        _save_step(ckpt, 2, 2.0)
+        eng = FakeSwapEngine()
+        watcher = _watcher(ckpt, eng)
+        try:
+            watcher.poll_once()  # adopts 2 directly
+            assert watcher.current_step == 2
+            assert watcher.rollback(2) is True
+            # Reverted to the previously served weights (random init
+            # here — step None) and the bad step can never come back.
+            assert watcher.current_step is None
+            assert 2 in watcher.blocked_steps
+            assert watcher.poll_once() is True  # falls back to step 1
+            assert watcher.current_step == 1
+            assert watcher.poll_once() is False  # 2 stays blocked
+            assert eng.metrics.to_dict()["checkpoint_step"] == 1
+        finally:
+            watcher.stop()
+
+    def test_rollback_of_a_non_served_step_only_blocklists(self, tmp_path):
+        ckpt = tmp_path / "ckpt"
+        _save_step(ckpt, 1, 1.0)
+        eng = FakeSwapEngine()
+        watcher = _watcher(ckpt, eng)
+        try:
+            watcher.poll_once()
+            assert watcher.current_step == 1
+            swaps = len(eng.swaps)
+            assert watcher.rollback(7) is False  # not what we serve
+            assert 7 in watcher.blocked_steps
+            assert len(eng.swaps) == swaps  # weights untouched
+        finally:
+            watcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet supervision (real subprocesses, JAX-free fake worker)
+
+
+_FAKE_WORKER = textwrap.dedent("""
+    import json, signal, sys
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    port_file = sys.argv[1]
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        def log_message(self, *a):
+            pass
+        def do_GET(self):
+            body = json.dumps({"status": "ready",
+                               "checkpoint_step": 1}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    with open(port_file + ".tmp", "w") as f:
+        f.write(str(httpd.server_address[1]))
+    import os
+    os.replace(port_file + ".tmp", port_file)
+    httpd.serve_forever()
+""")
+
+
+def _fake_worker_cmd(worker_id, port_file):
+    return [sys.executable, "-c", _FAKE_WORKER, str(port_file)]
+
+
+def _fast_fleet(tmp_path, n=1, **kw):
+    kw.setdefault("backoff", RetryPolicy(max_attempts=10,
+                                         base_delay_s=0.05,
+                                         multiplier=1.0, jitter=0.0))
+    return ServingFleet(_fake_worker_cmd, n_workers=n,
+                        workdir=tmp_path / "fleet", poll_s=0.1,
+                        health_timeout_s=2.0, **kw)
+
+
+def _tick_until(fleet, predicate, timeout_s=15.0, sleep_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        fleet.tick()
+        if predicate():
+            return True
+        time.sleep(sleep_s)
+    return False
+
+
+class TestServingFleet:
+    def test_spawns_and_reports_ready(self, tmp_path):
+        fleet = _fast_fleet(tmp_path, n=2)
+        for w in fleet.workers:
+            fleet._spawn(w)
+        try:
+            assert _tick_until(
+                fleet, lambda: sum(1 for w in fleet.pool.workers()
+                                   if w.ready) == 2)
+            assert {w.checkpoint_step
+                    for w in fleet.pool.workers()} == {1}
+        finally:
+            fleet.stop()
+
+    def test_sigkilled_worker_is_detected_and_restarted(self, tmp_path):
+        fleet = _fast_fleet(tmp_path, n=1)
+        worker = fleet.workers[0]
+        fleet._spawn(worker)
+        try:
+            assert _tick_until(
+                fleet, lambda: any(w.ready
+                                   for w in fleet.pool.workers()))
+            first_pid = worker.pid
+            import os
+
+            os.kill(first_pid, signal.SIGKILL)
+            worker.proc.wait(5.0)
+            fleet.tick()  # detects death, marks not-ready, schedules
+            entry = fleet.pool.workers()[0]
+            assert not entry.ready and worker.restarts == 1
+            assert _tick_until(
+                fleet, lambda: any(w.ready
+                                   for w in fleet.pool.workers()))
+            assert worker.pid != first_pid
+            assert int(fleet._worker_restarts.value) == 1
+        finally:
+            fleet.stop()
+
+    def test_restart_clears_the_dead_incarnations_failures(self, tmp_path):
+        # Regression: a SIGKILL under load leaves router-observed
+        # forward failures (>= eject_after) on the pool entry. The
+        # replacement process must NOT inherit them — it would be
+        # ejected while still booting, before its port file appears,
+        # in an endless eject/backoff loop.
+        fleet = _fast_fleet(tmp_path, n=1, eject_after=3)
+        worker = fleet.workers[0]
+        fleet._spawn(worker)
+        try:
+            assert _tick_until(
+                fleet, lambda: any(w.ready
+                                   for w in fleet.pool.workers()))
+            # The router saw the worker die mid-forward, three times.
+            for _ in range(3):
+                fleet.pool.report_failure(worker.worker_id,
+                                          "connection reset")
+            import os
+
+            os.kill(worker.pid, signal.SIGKILL)
+            worker.proc.wait(5.0)
+            assert _tick_until(
+                fleet, lambda: any(w.ready
+                                   for w in fleet.pool.workers()))
+            assert worker.restarts == 1  # exactly one, not a loop
+            assert int(fleet._ejections.value) <= 1
+        finally:
+            fleet.stop()
+
+    def test_forward_failures_eject_a_probe_passing_worker(self, tmp_path):
+        # Regression: the tick probes (healthy -> counter reset) right
+        # before its eject check, so router-reported forward failures
+        # were wiped before the check ever saw them — a worker that
+        # answers /readyz but 500s every /embed was never ejected.
+        fleet = _fast_fleet(tmp_path, n=1, eject_after=3)
+        worker = fleet.workers[0]
+        fleet._spawn(worker)
+        try:
+            assert _tick_until(
+                fleet, lambda: any(w.ready
+                                   for w in fleet.pool.workers()))
+            for _ in range(3):
+                fleet.pool.report_failure(worker.worker_id, "http 500")
+            fleet.tick()  # probe passes; the eject check must still fire
+            assert int(fleet._ejections.value) == 1
+            assert worker.restarts == 1
+            # The replacement boots clean and serves again.
+            assert _tick_until(
+                fleet, lambda: any(w.ready
+                                   for w in fleet.pool.workers()))
+        finally:
+            fleet.stop()
+
+    def test_failed_spawn_reschedules_instead_of_stranding(self, tmp_path):
+        # Regression: _spawn cleared restart_at before Popen — a launch
+        # failure (exec ENOMEM, missing binary) left proc=None AND
+        # restart_at=None, a state no later tick ever looks at: the
+        # worker was silently lost forever. It must keep rescheduling
+        # until the restart budget rules.
+        fleet = ServingFleet(
+            lambda wid, pf: ["/nonexistent-binary-xyzzy"],
+            n_workers=1, workdir=tmp_path / "fleet", poll_s=0.05,
+            max_restarts=2,
+            backoff=RetryPolicy(max_attempts=10, base_delay_s=0.01,
+                                multiplier=1.0, jitter=0.0))
+        worker = fleet.workers[0]
+        fleet._spawn(worker)  # fails, must not raise
+        assert worker.proc is None and worker.restart_at is not None
+        assert worker.restarts == 1
+        deadline = time.monotonic() + 10.0
+        while worker.restarts <= fleet.max_restarts \
+                and time.monotonic() < deadline:
+            fleet.tick()
+            time.sleep(0.02)
+        # Budget exhausted: gave up EXPLICITLY (restart_at cleared by
+        # the budget check, not by the lost-worker bug).
+        assert worker.restarts == fleet.max_restarts + 1
+        assert worker.restart_at is None and worker.proc is None
+
+    def test_router_tier_import_is_jax_free(self):
+        # The ntxent-fleet router process must restart in milliseconds:
+        # its entire import surface (cli + cache/router/fleet + obs +
+        # faults) must not drag in JAX. Lazy package inits (PEP 562)
+        # keep this true — this test is the tripwire for an eager
+        # import sneaking back in anywhere on the chain.
+        import subprocess
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import sys\n"
+             "import ntxent_tpu.cli\n"
+             "from ntxent_tpu.serving import (EmbeddingCache, "
+             "FleetRouter, ServingFleet, WorkerPool)\n"
+             "from ntxent_tpu import obs\n"
+             "from ntxent_tpu.resilience import FaultInjector, "
+             "FaultPlan\n"
+             "assert 'jax' not in sys.modules, 'jax leaked'\n"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+
+    def test_chaos_killworker_fires_on_the_named_tick(self, tmp_path):
+        inj = FaultInjector(FaultPlan.parse("killworker@3"))
+        fleet = _fast_fleet(tmp_path, n=1, injector=inj)
+        worker = fleet.workers[0]
+        fleet._spawn(worker)
+        try:
+            assert _tick_until(
+                fleet, lambda: any(w.ready
+                                   for w in fleet.pool.workers()),
+                timeout_s=10.0)
+            # _tick_until advanced an unknown number of ticks; drive
+            # until the plan's ordinal passes and the kill lands.
+            deadline = time.monotonic() + 10.0
+            while not inj.fired and time.monotonic() < deadline:
+                fleet.tick()
+                time.sleep(0.05)
+            assert inj.fired == ["killworker@3"]
+            assert worker.proc is None or worker.proc.poll() is not None \
+                or worker.restarts >= 1 or _tick_until(
+                    fleet, lambda: worker.restarts >= 1, timeout_s=5.0)
+        finally:
+            fleet.stop()

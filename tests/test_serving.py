@@ -282,8 +282,8 @@ class TestMicroBatcher:
         fails = {"armed": True}
         orig_exec = eng._executable
 
-        def flaky_executable(bucket):
-            exe = orig_exec(bucket)
+        def flaky_executable(bucket, *snap):
+            exe = orig_exec(bucket, *snap)
 
             def wrapper(v, x):
                 chunk_starts.append(int(x.shape[0]))

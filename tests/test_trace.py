@@ -859,6 +859,30 @@ class TestBenchGate:
         assert bench.compare_gate(current, self._payloads(),
                                   tol_scale=2.0)["ok"]
 
+    def test_fleet_speedup_floored_with_its_denominator(self):
+        # cache_hit_speedup = miss_p50 / hit_p50: when the committed
+        # hit p50 sits under the latency floor the ratio inherits that
+        # series' jitter (a sub-floor swing moves the ratio far past
+        # the tolerance), so the floor rule must cover the ratio too —
+        # visible as a skip, like the raw series.
+        bench = _load_bench()
+        fleet = {"platform": "cpu",
+                 "direct": {"p50_ms": 8.0},
+                 "router_miss": {"p50_ms": 20.0},
+                 "router_hit": {"p50_ms": 4.0},  # under the floor
+                 "cache_hit_speedup": 5.0}
+        committed = {**self._payloads(), "fleet": fleet}
+        result = bench.compare_gate(committed, committed)
+        assert result["ok"], result
+        assert "fleet/cache_hit_speedup" in result["skipped"]
+        assert "fleet/router_hit/p50_ms" in result["skipped"]
+        assert "fleet/direct/p50_ms" in result["metrics"]
+        # With the denominator above the floor the ratio IS gated.
+        hot = {**self._payloads(),
+               "fleet": dict(fleet, router_hit={"p50_ms": 6.0})}
+        result = bench.compare_gate(hot, hot)
+        assert "fleet/cache_hit_speedup" in result["metrics"]
+
     def test_committed_records_extract(self):
         """The real committed records must yield gated metrics (the gate
         cannot silently go vacuous if a record's shape drifts)."""
