@@ -38,6 +38,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import statistics
 import subprocess
 import sys
 import time
@@ -92,6 +93,18 @@ def _record_progress(record: dict) -> None:
     except Exception as e:  # never fail the bench over bookkeeping
         print(f"note: PROGRESS.jsonl append skipped ({e})",
               file=sys.stderr)
+
+
+def _latency_stats(samples: list) -> dict:
+    """p50/p99/mean/count over one latency series (ms)."""
+    ordered = sorted(samples)
+    return {
+        "p50_ms": round(statistics.median(ordered), 4),
+        "p99_ms": round(ordered[min(len(ordered) - 1,
+                                    int(len(ordered) * 0.99))], 4),
+        "mean_ms": round(statistics.fmean(ordered), 4),
+        "count": len(ordered),
+    }
 
 
 def _child_backend(jax) -> str:
@@ -340,7 +353,6 @@ def _fleet_child() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     import functools
-    import statistics
 
     import numpy as np
 
@@ -412,15 +424,7 @@ def _fleet_child() -> None:
     def series(port: int, bodies) -> list[float]:
         return [post(port, b) for b in bodies]
 
-    def stats(samples: list[float]) -> dict:
-        ordered = sorted(samples)
-        return {
-            "p50_ms": round(statistics.median(ordered), 4),
-            "p99_ms": round(ordered[min(len(ordered) - 1,
-                                        int(len(ordered) * 0.99))], 4),
-            "mean_ms": round(statistics.fmean(ordered), 4),
-            "count": len(ordered),
-        }
+    stats = _latency_stats
 
     try:
         unique = [body() for _ in range(warmup + 1 + 2 * runs)]
@@ -461,6 +465,143 @@ def _fleet_child() -> None:
     # The hit series must have been genuine cache hits (zero worker
     # forwards for it) or the record is mislabeled.
     assert snap["hits"] >= runs * rows, snap
+    print(SENTINEL + json.dumps(payload), flush=True)
+
+
+def _ragged_child() -> None:
+    """--ragged measurement: what does the adaptive ladder buy on mixed
+    traffic? (ISSUE 9 / ROADMAP item 1)
+
+    Two identical engines over the same deterministic mixed-size trace
+    (sizes that the default fixed ladder pads badly — between-rung
+    values like 3/5/7 under a 1/4/16/64 ladder):
+
+    * ``fixed``    — the static prior ladder, every request pads up;
+    * ``adaptive`` — same prior, but the first slice of the trace feeds
+      the size histogram, ``refresh_ladder()`` runs one observe ->
+      optimize -> re-AOT -> swap cycle (the deterministic stand-in for
+      the background worker), and the timed slice replays on the
+      learned rungs.
+
+    The record carries padding waste + latency percentiles per engine
+    over the SAME timed slice, the learned ladder, and
+    ``waste_improvement`` (fixed/adaptive) — the committed number the
+    regression gate enforces. The trace, the decayed histogram, and
+    the DP are all deterministic, so the waste figures reproduce
+    exactly on re-measurement.
+    """
+    import jax
+
+    if os.environ.get("NTXENT_BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    import functools
+
+    import numpy as np
+
+    from ntxent_tpu import models
+    from ntxent_tpu.models import SimCLRModel
+    from ntxent_tpu.serving import InferenceEngine
+
+    backend = _child_backend(jax)
+    on_accel = backend in ("tpu", "axon")
+    if on_accel:
+        encoder, size, model_name = models.ResNet50, 224, "resnet50"
+    else:
+        encoder = functools.partial(models.ResNet, stage_sizes=(1,),
+                                    small_images=True)
+        size, model_name = 32, "tiny"
+
+    prior = (1, 4, 16, 64)
+    model = SimCLRModel(encoder=encoder, proj_hidden_dim=64, proj_dim=32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, size, size, 3), np.float32),
+                           train=False)
+
+    def apply_fn(v, x):
+        return model.apply(v, x, train=False, method="features")
+
+    def make_engine(adaptive: bool) -> InferenceEngine:
+        return InferenceEngine(
+            apply_fn, variables, example_shape=(size, size, 3),
+            buckets=prior, adaptive=adaptive, ladder_max_buckets=5,
+            ladder_min_requests=32)
+
+    fixed = make_engine(False)
+    adaptive = make_engine(True)
+    fixed.warmup()
+    adaptive.warmup()
+
+    # Mixed-size trace: request row counts BETWEEN the prior's rungs
+    # (the padding worst case the ISSUE targets), skewed the way real
+    # traffic is. Deterministic: seeded draw, shared by both engines.
+    n_observe = int(os.environ.get("NTXENT_RAGGED_OBSERVE", "120"))
+    n_timed = int(os.environ.get("NTXENT_RAGGED_TIMED", "150"))
+    rng = np.random.RandomState(0)
+    trace = rng.choice([2, 3, 5, 7, 12], size=n_observe + n_timed,
+                       p=[0.05, 0.35, 0.30, 0.20, 0.10])
+    payloads = {n: rng.rand(int(n), size, size, 3).astype(np.float32)
+                for n in set(int(n) for n in trace)}
+
+    # Observe phase (adaptive only): the histogram learns the mix, then
+    # ONE refresh cycle re-AOTs and swaps — deterministically, where a
+    # live server's background worker would have done it mid-traffic.
+    for n in trace[:n_observe]:
+        adaptive.embed(payloads[int(n)])
+    swapped = adaptive.refresh_ladder(force=True)
+    assert swapped, "adaptive ladder never swapped"
+    compiles_at_swap = adaptive.metrics.compiles
+
+    def run_timed(engine) -> tuple:
+        lat = []
+        base_real = engine.metrics.rows_real
+        base_pad = engine.metrics.rows_padded
+        for n in trace[n_observe:]:
+            x = payloads[int(n)]
+            t0 = time.monotonic()
+            engine.embed(x)
+            lat.append((time.monotonic() - t0) * 1e3)
+        real = engine.metrics.rows_real - base_real
+        pad = engine.metrics.rows_padded - base_pad
+        return lat, pad / (real + pad) if (real + pad) else 0.0
+
+    fixed_lat, fixed_waste = run_timed(fixed)
+    adaptive_lat, adaptive_waste = run_timed(adaptive)
+    # The swap must be invisible to requests: zero request-visible
+    # compiles across the whole timed replay.
+    assert adaptive.metrics.compiles == compiles_at_swap, \
+        "a request paid a compile after the ladder swap"
+
+    fixed_stats = _latency_stats(fixed_lat)
+    adaptive_stats = _latency_stats(adaptive_lat)
+    improvement = fixed_waste / max(adaptive_waste, 1e-4)
+    payload = {
+        "metric": "serving_ragged_ladder",
+        "backend": backend,
+        "platform": backend,
+        "device_kind": jax.local_devices()[0].device_kind,
+        "model": model_name,
+        "image_size": size,
+        "prior_buckets": list(prior),
+        "trace": {"observe": n_observe, "timed": n_timed,
+                  "sizes": sorted(payloads)},
+        "fixed": {"padding_waste": round(fixed_waste, 4),
+                  **fixed_stats},
+        "adaptive": {"padding_waste": round(adaptive_waste, 4),
+                     "ladder": [int(b) for b in adaptive.buckets],
+                     "generation": adaptive.ladder_generation,
+                     "ladder_compiles":
+                         adaptive.metrics.ladder_compiles,
+                     **adaptive_stats},
+        "waste_improvement": round(improvement, 2),
+        "p99_ratio": round(adaptive_stats["p99_ms"]
+                           / max(fixed_stats["p99_ms"], 1e-6), 3),
+    }
+    # The acceptance shape (ROADMAP item 1): >2x waste cut, p99 flat or
+    # better (with jitter slack — smaller buckets do less device work,
+    # so the true effect is a speedup).
+    assert improvement > 2.0, payload
+    assert payload["p99_ratio"] <= 1.25, payload
     print(SENTINEL + json.dumps(payload), flush=True)
 
 
@@ -826,6 +967,35 @@ def _checkpoint_main() -> None:
     print(json.dumps(payload))
 
 
+def _ragged_main() -> None:
+    """--ragged: A/B fixed vs adaptive ladder, write BENCH_ragged.json.
+
+    Same robustness contract as the headline: the parent imports no JAX,
+    the child is wall-clock-bounded, and a JSON record is emitted (file
+    + stdout) even on total failure.
+    """
+    backend = _probe_backend()
+    force_cpu = backend not in ("tpu", "axon")
+    payload, diag = _run_child(CHILD_TIMEOUT_S, force_cpu=force_cpu,
+                               child_flag="--ragged-child")
+    if payload is None and not force_cpu:
+        payload, diag2 = _run_child(CHILD_TIMEOUT_S, force_cpu=True,
+                                    child_flag="--ragged-child")
+        if payload is not None:
+            payload["error"] = f"accelerator path unavailable ({diag})"
+        else:
+            diag = f"{diag}; cpu fallback: {diag2}"
+    if payload is None:
+        payload = {"metric": "serving_ragged_ladder", "error": diag}
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_ragged.json")
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    _record_progress(payload)
+    print(json.dumps(payload))
+
+
 def _pipeline_main() -> None:
     """--pipeline: A/B the async input pipeline, write BENCH_pipeline.json.
 
@@ -985,7 +1155,7 @@ def _run_child(timeout_s: float, force_cpu: bool = False,
 #   latency) are skipped — single-digit-ms CPU numbers jitter more than
 #   they inform.
 
-GATE_CHECKS = ("pipeline", "serving", "fleet")
+GATE_CHECKS = ("pipeline", "serving", "fleet", "ragged")
 GATE_TOL = 0.15
 GATE_SERVING_TOL = 0.30
 GATE_LATENCY_FLOOR_MS = 5.0
@@ -1000,6 +1170,8 @@ def _gate_spec(name: str) -> tuple[str, dict]:
         return "--serving-child", {}
     if name == "fleet":
         return "--fleet-child", {}
+    if name == "ragged":
+        return "--ragged-child", {}
     raise ValueError(f"unknown gate {name!r}")
 
 
@@ -1075,6 +1247,23 @@ def gate_metrics(name: str, payload: dict | None,
             out["fleet/cache_hit_speedup"] = {
                 "value": float(v), "higher_is_better": True,
                 "tol": GATE_SERVING_TOL}
+    elif name == "ragged":
+        # The padding A/B is deterministic (seeded trace, exact DP), so
+        # waste_improvement is gated at the standard tolerance; the
+        # latency percentiles get the serving floor rule (sub-floor CPU
+        # numbers jitter more than they inform).
+        v = payload.get("waste_improvement")
+        if keep(v):
+            out["ragged/waste_improvement"] = {
+                "value": float(v), "higher_is_better": True,
+                "tol": GATE_TOL}
+        for mode in ("fixed", "adaptive"):
+            lat = (payload.get(mode) or {}).get("p99_ms")
+            if keep(lat) and (not reference
+                              or float(lat) >= GATE_LATENCY_FLOOR_MS):
+                out[f"ragged/{mode}/p99_ms"] = {
+                    "value": float(lat), "higher_is_better": False,
+                    "tol": GATE_SERVING_TOL}
     return out
 
 
@@ -1294,6 +1483,13 @@ if __name__ == "__main__":
     parser.add_argument("--fleet-child", action="store_true",
                         help="internal: run the fleet measurement "
                              "in-process")
+    parser.add_argument("--ragged", action="store_true",
+                        help="A/B the fixed vs traffic-adaptive bucket "
+                             "ladder on a mixed-size trace and write "
+                             "BENCH_ragged.json")
+    parser.add_argument("--ragged-child", action="store_true",
+                        help="internal: run the ragged measurement "
+                             "in-process")
     parser.add_argument("--pipeline", action="store_true",
                         help="A/B the async input pipeline (prefetch "
                              "off/on/on+lag-1) and write "
@@ -1356,6 +1552,10 @@ if __name__ == "__main__":
         _fleet_child()
     elif _args.fleet:
         _fleet_main()
+    elif _args.ragged_child:
+        _ragged_child()
+    elif _args.ragged:
+        _ragged_main()
     elif _args.pipeline_child:
         _pipeline_child()
     elif _args.pipeline:

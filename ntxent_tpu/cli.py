@@ -1317,7 +1317,27 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="0 picks a free port (printed at startup)")
     s.add_argument("--buckets", default="1,4,16,64,128",
                    help="batch-size ladder the forward is compiled for; "
-                        "requests pad up to the nearest rung")
+                        "requests pad up to the nearest rung (with "
+                        "--adaptive-buckets this is the cold-start "
+                        "prior; the largest rung stays the chunking "
+                        "cap)")
+    s.add_argument("--adaptive-buckets", action="store_true",
+                   help="learn the ladder from live traffic: an online "
+                        "decayed request-size histogram feeds a DP "
+                        "optimizer that picks rungs minimizing expected "
+                        "padded rows; a background worker AOT-compiles "
+                        "the new ladder off the hot path and swaps it "
+                        "atomically (serving/ladder.py; requests never "
+                        "pay a compile across a swap)")
+    s.add_argument("--ladder-max-buckets", type=int, default=6,
+                   help="ladder-size budget for the optimizer (total "
+                        "rungs incl. the fixed top one)")
+    s.add_argument("--ladder-min-requests", type=int, default=200,
+                   help="observed device chunks before the first "
+                        "re-optimization may swap the ladder")
+    s.add_argument("--ladder-interval", type=float, default=2.0,
+                   metavar="SECONDS",
+                   help="background ladder re-optimization period")
     s.add_argument("--max-batch", type=int, default=None,
                    help="coalescing cap per device call (default: the "
                         "largest bucket)")
@@ -1492,7 +1512,12 @@ def serve_main(argv=None) -> int:
         example_shape=(args.image_size, args.image_size, 3),
         buckets=buckets,
         dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
-        retry_policy=retry_policy)  # per-chunk transient-fault retries
+        retry_policy=retry_policy,  # per-chunk transient-fault retries
+        adaptive=args.adaptive_buckets,
+        ladder_max_buckets=args.ladder_max_buckets,
+        ladder_min_requests=args.ladder_min_requests,
+        ladder_interval_s=(args.ladder_interval
+                           if args.adaptive_buckets else 0.0))
     if event_log is not None:
         engine.metrics.set_run_id(event_log.run_id)
     initial_step = (int(state.step)
@@ -1553,6 +1578,7 @@ def serve_main(argv=None) -> int:
     finally:
         if watcher is not None:
             watcher.stop()
+        engine.close()  # stop the ladder re-AOT worker, if any
         if event_log is not None:
             from ntxent_tpu import obs
 
@@ -1591,6 +1617,16 @@ def build_fleet_parser() -> argparse.ArgumentParser:
     w.add_argument("--workers", type=int, default=2,
                    help="worker replica count")
     w.add_argument("--buckets", default="1,4,16,64,128")
+    w.add_argument("--adaptive-buckets", action="store_true",
+                   help="each worker learns its ladder from its own "
+                        "traffic (ntxent-serve --adaptive-buckets); "
+                        "workers adapt independently — the router's "
+                        "cache keys hash row content, never buckets, "
+                        "so per-worker ladders cannot skew routing or "
+                        "caching")
+    w.add_argument("--ladder-max-buckets", type=int, default=6)
+    w.add_argument("--ladder-min-requests", type=int, default=200)
+    w.add_argument("--ladder-interval", type=float, default=2.0)
     w.add_argument("--max-batch", type=int, default=None)
     w.add_argument("--max-delay-ms", type=float, default=5.0)
     w.add_argument("--queue-size", type=int, default=64)
@@ -1622,6 +1658,10 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                     help="embedding cache LRU capacity in rows")
     rt.add_argument("--cache-ttl", type=float, default=300.0,
                     help="embedding cache TTL seconds")
+    rt.add_argument("--cache-warm-rows", type=int, default=32,
+                    help="hot rows replayed through a newly promoted "
+                         "model right after the promote flush "
+                         "(0 = boot the cache cold as before)")
     rt.add_argument("--no-cache", action="store_true")
     rt.add_argument("--canary-fraction", type=float, default=0.25,
                     help="traffic fraction routed to new-checkpoint "
@@ -1637,6 +1677,14 @@ def build_fleet_parser() -> argparse.ArgumentParser:
     f.add_argument("--workdir", default=None,
                    help="port files + per-worker logs (default: a "
                         "temp dir)")
+    f.add_argument("--attach-workdir", default=None, metavar="PATH",
+                   help="REPLICA router mode: spawn no workers — "
+                        "attach to the worker pool a primary "
+                        "ntxent-fleet already runs in PATH (its w*.port "
+                        "files), probe health, and route. N routers "
+                        "over one pool is the stateless-router "
+                        "replication proof (ROADMAP item 4 follow-up); "
+                        "process supervision stays with the primary")
     f.add_argument("--health-poll", type=float, default=0.5,
                    help="supervision tick: /readyz probe interval")
     f.add_argument("--eject-after", type=int, default=3,
@@ -1697,19 +1745,31 @@ def fleet_main(argv=None) -> int:
         WorkerPool,
     )
 
+    attach = args.attach_workdir is not None
     injector = None
     if args.chaos:
-        plan = FaultPlan.parse(args.chaos, seed=args.seed)
-        if plan.killworker_ticks or plan.slowworker_ticks:
-            injector = FaultInjector(plan)
+        if attach:
+            logger.warning("--chaos is ignored in --attach-workdir "
+                           "mode: a replica router does not own the "
+                           "worker processes")
         else:
-            logger.warning("--chaos %r has no fleet actions "
-                           "(killworker@T/slowworker@T) — ignored here",
-                           args.chaos)
+            plan = FaultPlan.parse(args.chaos, seed=args.seed)
+            if plan.killworker_ticks or plan.slowworker_ticks:
+                injector = FaultInjector(plan)
+            else:
+                logger.warning("--chaos %r has no fleet actions "
+                               "(killworker@T/slowworker@T) — ignored "
+                               "here", args.chaos)
 
-    workdir = Path(args.workdir) if args.workdir \
-        else Path(tempfile.mkdtemp(prefix="ntxent-fleet-"))
-    workdir.mkdir(parents=True, exist_ok=True)
+    if attach:
+        workdir = Path(args.attach_workdir)
+        if not workdir.is_dir():
+            raise SystemExit(f"--attach-workdir {workdir} does not "
+                             "exist (start the primary fleet first)")
+    else:
+        workdir = Path(args.workdir) if args.workdir \
+            else Path(tempfile.mkdtemp(prefix="ntxent-fleet-"))
+        workdir.mkdir(parents=True, exist_ok=True)
 
     event_log = None
     if args.log_jsonl or args.run_id:
@@ -1748,6 +1808,12 @@ def fleet_main(argv=None) -> int:
                "--port-file", str(port_file),
                "--watch-poll", str(args.watch_poll),
                "--watch-delay", str(idx * args.worker_stagger)]
+        if args.adaptive_buckets:
+            cmd += ["--adaptive-buckets",
+                    "--ladder-max-buckets", str(args.ladder_max_buckets),
+                    "--ladder-min-requests",
+                    str(args.ladder_min_requests),
+                    "--ladder-interval", str(args.ladder_interval)]
         if args.max_batch is not None:
             cmd += ["--max-batch", str(args.max_batch)]
         if args.max_request_rows is not None:
@@ -1773,18 +1839,24 @@ def fleet_main(argv=None) -> int:
     if not args.no_cache:
         cache = EmbeddingCache(capacity_rows=args.cache_rows,
                                ttl_s=args.cache_ttl,
-                               buckets=bucket_list, registry=registry)
+                               buckets=bucket_list, registry=registry,
+                               # the hot store must hold at least what
+                               # a promote wants to replay, or
+                               # --cache-warm-rows is silently capped
+                               hot_rows=max(64, args.cache_warm_rows))
     fleet = ServingFleet(make_cmd, n_workers=args.workers,
                          workdir=workdir, pool=pool,
                          poll_s=args.health_poll,
                          eject_after=args.eject_after,
                          max_restarts=args.worker_max_restarts,
-                         injector=injector, registry=registry)
+                         injector=injector, registry=registry,
+                         attach=attach)
     router = FleetRouter(
         pool, cache=cache,
         example_shape=(args.image_size, args.image_size, 3),
         host=args.host, port=args.port, retries=args.retries,
-        forward_timeout_s=args.forward_timeout, registry=registry)
+        forward_timeout_s=args.forward_timeout, registry=registry,
+        warm_rows=args.cache_warm_rows)
 
     stop = threading.Event()
 

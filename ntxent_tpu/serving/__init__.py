@@ -5,9 +5,13 @@ static-shape rules of XLA and the failure model of the PR 1 resilience
 layer. The stack, bottom-up:
 
 * ``engine.InferenceEngine`` — shape-bucketed, AOT-compiled forward
-  (pad to a fixed ladder of batch sizes; compiled-executable cache
-  keyed by bucket/dtype/model-hash; ``warmup()`` bounds first-request
-  latency);
+  (pad to a ladder of batch sizes; compiled-executable cache keyed by
+  bucket/dtype/model-hash; ``warmup()`` bounds first-request latency;
+  ``adaptive=True`` learns the ladder online and swaps it atomically
+  after background re-AOT);
+* ``ladder.SizeHistogram`` / ``ladder.optimize_ladder`` — the pure
+  half of the traffic-adaptive ladder: decayed request-size histogram
+  + DP bucket-edge optimizer (stdlib-only, JAX-free);
 * ``batcher.MicroBatcher`` — dynamic micro-batching with a bounded
   queue: coalesce concurrent requests into one device call, split
   results per request, reject-with-retry-after on a full queue,
@@ -59,6 +63,9 @@ _EXPORTS = {
     "EmbeddingCache": "cache",
     "DEFAULT_BUCKETS": "engine",
     "InferenceEngine": "engine",
+    "SizeHistogram": "ladder",
+    "expected_padded_rows": "ladder",
+    "optimize_ladder": "ladder",
     "ServingFleet": "fleet",
     "ServingMetrics": "metrics",
     "FleetRouter": "router",
@@ -95,5 +102,8 @@ __all__ = [
     "QueueFullError",
     "ServingFleet",
     "ServingMetrics",
+    "SizeHistogram",
     "WorkerPool",
+    "expected_padded_rows",
+    "optimize_ladder",
 ]

@@ -65,7 +65,7 @@ class EmbeddingCache:
     def __init__(self, capacity_rows: int = 4096, ttl_s: float = 300.0,
                  buckets: Sequence[int] = (1, 4, 16, 64, 128),
                  registry: MetricsRegistry | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, hot_rows: int = 64):
         if capacity_rows < 1:
             raise ValueError(
                 f"capacity_rows must be >= 1, got {capacity_rows}")
@@ -73,6 +73,7 @@ class EmbeddingCache:
             raise ValueError(f"ttl_s must be > 0, got {ttl_s}")
         self.capacity_rows = int(capacity_rows)
         self.ttl_s = float(ttl_s)
+        self.hot_rows = int(hot_rows)
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.registry = registry if registry is not None \
             else MetricsRegistry()
@@ -85,6 +86,13 @@ class EmbeddingCache:
         # landed while its misses were in flight — merged entries from
         # two generations would mix embeddings of two models.
         self._generation = 0
+        # Hot-row side store (ROADMAP item 4 follow-up): the INPUT rows
+        # whose keys actually hit, bounded to the hot_rows most recent
+        # distinct ones. Inputs are model-independent, so clear() — a
+        # MODEL change — keeps them: they are exactly what a promote
+        # replays through the new model instead of booting cold
+        # (``hot_keys``). A row is copied in only on its FIRST hit.
+        self._hot: OrderedDict[bytes, np.ndarray] = OrderedDict()
         r = self.registry
         self._size = r.gauge("fleet_cache_rows",
                              "embedding rows currently cached")
@@ -147,6 +155,7 @@ class EmbeddingCache:
         # no shared state — holding the lock for it would serialize
         # every handler thread on one request's hashing.
         keys = [row_key(rows[i]) for i in range(rows.shape[0])]
+        fresh_hot: list[int] = []
         with self._lock:
             for i, key in enumerate(keys):
                 entry = self._entries.get(key)
@@ -161,7 +170,22 @@ class EmbeddingCache:
                     continue
                 self._entries.move_to_end(key)
                 hits[i] = value
+                if key in self._hot:
+                    self._hot.move_to_end(key)
+                else:
+                    fresh_hot.append(i)
             self._size.set(len(self._entries))
+        if fresh_hot and self.hot_rows > 0:
+            # Copy outside the lock (same rule as hashing), insert
+            # under it; first-hit keys only, so steady repeat traffic
+            # costs a move_to_end, not a memcpy.
+            copies = [(keys[i], np.array(rows[i])) for i in fresh_hot]
+            with self._lock:
+                for key, row in copies:
+                    self._hot[key] = row
+                    self._hot.move_to_end(key)
+                while len(self._hot) > self.hot_rows:
+                    self._hot.popitem(last=False)
         n = int(rows.shape[0])
         if hits:
             self._hits_total.inc(len(hits))
@@ -205,6 +229,22 @@ class EmbeddingCache:
             self._eviction_counter(reason).inc(n)
         return n
 
+    def hot_keys(self, n: int) -> list[np.ndarray]:
+        """The hottest cached INPUT rows, most-recently-hit first.
+
+        Returns up to ``n`` row arrays (private copies) from the
+        bounded hot store — the replay set for cache warming on a
+        canary promote: the router re-forwards them through the newly
+        trusted model right after the flush, so the hottest traffic
+        never sees a cold cache. Survives ``clear()`` by design
+        (inputs carry no model state).
+        """
+        if n < 1:
+            return []
+        with self._lock:
+            rows = list(self._hot.values())[-int(n):]
+        return list(reversed(rows))
+
     @property
     def generation(self) -> int:
         """Flush epoch: changes exactly when clear() runs. Capture it
@@ -235,10 +275,13 @@ class EmbeddingCache:
         with self._label_lock:
             evictions = {reason: int(c.value)
                          for reason, c in sorted(self._evictions.items())}
+        with self._lock:
+            hot = len(self._hot)
         return {
             "rows": len(self),
             "capacity_rows": self.capacity_rows,
             "ttl_s": self.ttl_s,
+            "hot_rows": hot,
             "hits": self.hits,
             "misses": self.misses,
             "hit_rate": round(self.hit_rate(), 4)

@@ -9,9 +9,20 @@ padding (the same static-shape discipline Ragged Paged Attention builds
 its whole kernel around, PAPERS.md arxiv 2604.15464). ``InferenceEngine``
 does exactly that for the SimCLR encoder+projection forward:
 
-* a fixed **bucket ladder** of batch sizes (default 1/4/16/64/128);
-  requests pad up to the nearest bucket, oversized requests split into
-  max-bucket chunks plus one tail bucket;
+* a **bucket ladder** of batch sizes (default 1/4/16/64/128); requests
+  pad up to the nearest bucket, oversized requests split into
+  max-bucket chunks plus one tail bucket. With ``adaptive=True`` the
+  ladder is LEARNED from live traffic (ISSUE 9 / ROADMAP item 1): an
+  online decayed request-size histogram feeds a DP optimizer
+  (serving/ladder.py) that picks rungs minimizing expected padded rows
+  under a ladder-size budget; a background worker AOT-compiles the new
+  ladder off the hot path and publishes it atomically the way
+  ``swap_variables`` publishes weight swaps — in-flight chunks keep
+  their (bucket, executable) snapshot, off-ladder executables are
+  evicted, and request-visible compile counters stay flat (background
+  compiles land in ``serving_ladder_compiles_total``). The configured
+  ladder is the cold-start prior and its largest rung never moves: it
+  is the chunking cap the batcher/row limits were provisioned against;
 * executables are **AOT-lowered per bucket** through the same
   typed-exception fallback path the trainer uses
   (``training.trainer.aot_compile_with_flops`` — PR 1): where the backend
@@ -41,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..obs import trace as _trace
+from .ladder import SizeHistogram, expected_padded_rows, optimize_ladder
 from .metrics import ServingMetrics
 
 logger = logging.getLogger(__name__)
@@ -94,11 +106,17 @@ class InferenceEngine:
         dtype=jnp.float32,
         metrics: ServingMetrics | None = None,
         retry_policy=None,
+        adaptive: bool = False,
+        ladder_max_buckets: int = 6,
+        ladder_min_requests: int = 200,
+        ladder_decay: float = 0.999,
+        ladder_interval_s: float = 0.0,
     ):
         buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not buckets or buckets[0] < 1:
             raise ValueError(f"buckets must be positive ints, got {buckets}")
         self.buckets = buckets
+        self.initial_buckets = buckets  # the adaptive ladder's prior
         self.max_bucket = buckets[-1]
         self.example_shape = tuple(int(d) for d in example_shape)
         self.dtype = jnp.dtype(dtype)
@@ -118,6 +136,35 @@ class InferenceEngine:
         # racy clear a concurrent embed could be mid-lookup through.
         self._cache: dict[tuple, Callable] = {}
         self._lock = threading.Lock()
+        # Traffic-adaptive ladder (ISSUE 9). The histogram records
+        # device-CHUNK row counts (an oversized request folds through
+        # max-bucket chunking first) — exactly the sizes that pad.
+        # ladder_interval_s > 0 runs the re-AOT worker as a daemon;
+        # 0 leaves refresh to explicit refresh_ladder() calls
+        # (tests/bench want deterministic swap points).
+        self.adaptive = bool(adaptive)
+        self.ladder_max_buckets = int(ladder_max_buckets)
+        self.ladder_min_requests = int(ladder_min_requests)
+        # Hysteresis: a proposal must beat the live ladder's expected
+        # padding by this relative margin or the swap is skipped —
+        # re-AOT churn on a flat improvement would pay compile time for
+        # nothing.
+        self.ladder_min_rel_improvement = 0.05
+        self.ladder_generation = 0
+        self.histogram = (SizeHistogram(decay=ladder_decay)
+                          if self.adaptive else None)
+        self._ladder_refresh_lock = threading.Lock()
+        self._ladder_stop = threading.Event()
+        self._ladder_thread: threading.Thread | None = None
+        self.metrics.set_ladder(self.buckets, 0)
+        if self.adaptive and ladder_max_buckets < 1:
+            raise ValueError(f"ladder_max_buckets must be >= 1, got "
+                             f"{ladder_max_buckets}")
+        if self.adaptive and ladder_interval_s > 0:
+            self._ladder_thread = threading.Thread(
+                target=self._ladder_loop, args=(float(ladder_interval_s),),
+                daemon=True, name="ntxent-ladder-reaot")
+            self._ladder_thread.start()
 
     # -- model lifecycle -------------------------------------------------
     def update_variables(self, variables) -> None:
@@ -162,8 +209,12 @@ class InferenceEngine:
             return "reused"
         version = self._version + 1
         new_hash = _model_hash(variables, version)
+        # Snapshot the ladder once: a concurrent adaptive-ladder swap
+        # must not change the set being warmed mid-loop (a rung it adds
+        # compiles lazily against the new hash on its own publish path).
+        buckets = self.buckets
         if warm:
-            for bucket in self.buckets:
+            for bucket in buckets:
                 exe = self._executable(bucket, new_hash, variables)
                 x = jnp.zeros((bucket,) + self.example_shape, self.dtype)
                 jax.block_until_ready(exe(variables, x))
@@ -191,6 +242,22 @@ class InferenceEngine:
         with self._lock:
             return self.variables, self._hash
 
+    def _chunk_snapshot(self, n: int) -> tuple:
+        """(variables, hash, bucket, exe-or-None) under ONE lock hold.
+
+        The chunk's bucket must come from the same ladder generation as
+        its executable lookup: resolving them in two lock acquisitions
+        would let a ladder swap land in between — the chunk picks an
+        old rung, the swap evicts that rung's executable, and the
+        request pays a hot-path recompile (exactly the cost the
+        background re-AOT exists to prevent). A ladder publishes only
+        after every rung is compiled, so a consistent snapshot always
+        finds its executable except on the cold no-warmup path."""
+        with self._lock:
+            bucket = next(b for b in self.buckets if b >= n)
+            exe = self._cache.get((bucket, self.dtype.name, self._hash))
+            return self.variables, self._hash, bucket, exe
+
     # -- bucket math -----------------------------------------------------
     def bucket_for(self, n: int) -> int:
         """Smallest ladder bucket >= n (n must fit the ladder)."""
@@ -206,14 +273,27 @@ class InferenceEngine:
         raise AssertionError("unreachable")  # pragma: no cover
 
     def _executable(self, bucket: int, model_hash: str | None = None,
-                    variables=None) -> Callable:
+                    variables=None, cached: Callable | None = None,
+                    background: bool = False) -> Callable:
+        """Resolve (compile if needed) the executable for ``bucket``.
+
+        ``cached`` short-circuits with an executable the caller already
+        resolved under the engine lock (``_chunk_snapshot``) — the
+        chunk path passes it so a ladder swap's eviction landing
+        between snapshot and resolution can never force a hot-path
+        recompile of a rung that was compiled moments ago.
+        """
+        if cached is not None:
+            self.metrics.compile_cache_hit()
+            return cached
         if model_hash is None or variables is None:
             variables, model_hash = self._snapshot()
         key = (bucket, self.dtype.name, model_hash)
         with self._lock:
             exe = self._cache.get(key)
         if exe is not None:
-            self.metrics.compile_cache_hit()
+            if not background:
+                self.metrics.compile_cache_hit()
             return exe
         # Compile outside the lock (seconds-long); a concurrent miss on
         # the same key costs one duplicate compile, never a wrong result.
@@ -228,12 +308,111 @@ class InferenceEngine:
             # the first real request still pays no compile.
             jax.block_until_ready(self._jit_fn(variables, x))
             compiled = self._jit_fn
-        logger.info("serving: compiled bucket %d (%s) in %.2fs", bucket,
-                    self.dtype.name, time.monotonic() - t0)
-        self.metrics.compiled()
+        logger.info("serving: compiled bucket %d (%s) in %.2fs%s", bucket,
+                    self.dtype.name, time.monotonic() - t0,
+                    " [background]" if background else "")
+        # Background (ladder re-AOT) compiles are accounted separately:
+        # serving_compiles_total is the REQUEST-visible compile bill,
+        # and the ragged smoke asserts it stays flat across a swap.
+        (self.metrics.ladder_compiled if background
+         else self.metrics.compiled)()
         with self._lock:
             exe = self._cache.setdefault(key, compiled)
         return exe
+
+    # -- adaptive ladder (ISSUE 9) ---------------------------------------
+    def refresh_ladder(self, force: bool = False) -> bool:
+        """One observe -> optimize -> re-AOT -> swap cycle.
+
+        Recomputes the optimal ladder from the decayed size histogram;
+        when it differs from the live one (past the hysteresis margin),
+        compiles every rung of the proposal OFF the request path, then
+        publishes ladder + executables atomically under the engine
+        lock. Returns True when a swap published. ``force=True`` skips
+        the min-requests gate and the hysteresis margin (tests/bench
+        want deterministic swap points) but still requires a non-empty
+        histogram and a genuinely different proposal.
+
+        Failure semantics: any compile error keeps the live ladder
+        serving untouched (counted in
+        ``serving_ladder_refresh_failures_total``); a weight swap that
+        lands mid-compile abandons the publish — the next cycle
+        re-optimizes against the new model hash.
+        """
+        if self.histogram is None:
+            return False
+        with self._ladder_refresh_lock:  # one re-AOT at a time
+            if (not force
+                    and self.histogram.observations
+                    < self.ladder_min_requests):
+                return False
+            weights = self.histogram.weights()
+            if not weights:
+                return False
+            proposal = optimize_ladder(weights, self.ladder_max_buckets,
+                                       self.max_bucket,
+                                       self.initial_buckets)
+            current = self.buckets
+            if proposal == current:
+                return False
+            if not force:
+                cur_cost = expected_padded_rows(weights, current)
+                new_cost = expected_padded_rows(weights, proposal)
+                if not (cur_cost > 0.0
+                        and new_cost <= cur_cost
+                        * (1.0 - self.ladder_min_rel_improvement)):
+                    return False
+            variables, model_hash = self._snapshot()
+            try:
+                for bucket in proposal:
+                    exe = self._executable(bucket, model_hash, variables,
+                                           background=True)
+                    x = jnp.zeros((bucket,) + self.example_shape,
+                                  self.dtype)
+                    jax.block_until_ready(exe(variables, x))
+            except Exception:  # noqa: BLE001 — a failed re-AOT must
+                # never take down serving: the old ladder keeps working.
+                logger.exception(
+                    "serving: ladder re-AOT failed — keeping ladder %s",
+                    list(current))
+                self.metrics.ladder_refresh_failed()
+                return False
+            with self._lock:
+                if self._hash != model_hash:
+                    # A weight swap landed mid-compile: these
+                    # executables belong to a retired model. Abandon;
+                    # the next cycle re-optimizes against the new hash.
+                    return False
+                self.buckets = proposal
+                self.ladder_generation += 1
+                generation = self.ladder_generation
+                keep = set(proposal)
+                # Evict off-ladder executables for the live model: each
+                # pins device allocations. In-flight chunks hold their
+                # own (bucket, exe) snapshot references, so eviction
+                # cannot yank an executable out from under them.
+                self._cache = {k: v for k, v in self._cache.items()
+                               if k[0] in keep or k[2] != model_hash}
+            self.metrics.ladder_swap(proposal, generation)
+            logger.info("serving: ladder swapped %s -> %s "
+                        "(generation %d)", list(current), list(proposal),
+                        generation)
+            return True
+
+    def _ladder_loop(self, interval_s: float) -> None:
+        while not self._ladder_stop.wait(interval_s):
+            try:
+                self.refresh_ladder()
+            except Exception:  # noqa: BLE001 — the re-AOT worker must
+                # outlive any one bad cycle; serving never depends on it.
+                logger.exception("serving: ladder refresh cycle failed")
+
+    def close(self) -> None:
+        """Stop the background re-AOT worker (no-op without one)."""
+        self._ladder_stop.set()
+        thread, self._ladder_thread = self._ladder_thread, None
+        if thread is not None:
+            thread.join(5.0)
 
     # -- public API ------------------------------------------------------
     def warmup(self) -> None:
@@ -249,16 +428,20 @@ class InferenceEngine:
 
     def _embed_chunk(self, x: np.ndarray) -> np.ndarray:
         n = x.shape[0]
-        bucket = self.bucket_for(n)
+        if n < 1 or n > self.max_bucket:
+            raise ValueError(f"chunk of {n} rows outside (0, "
+                             f"{self.max_bucket}] (chunking is embed()'s "
+                             "job)")
+        # One consistent (ladder rung, weights, executable) triple per
+        # chunk: a weight OR ladder swap landing mid-request flips the
+        # NEXT chunk, never mixes models (or pays a hot-path recompile
+        # for an evicted rung) inside one call.
+        variables, model_hash, bucket, cached = self._chunk_snapshot(n)
         pad = bucket - n
         if pad:
             x = np.concatenate(
                 [x, np.zeros((pad,) + self.example_shape, x.dtype)])
-        # One consistent (weights, executable) pair per chunk: a swap
-        # landing mid-request flips the NEXT chunk, never mixes models
-        # inside one call.
-        variables, model_hash = self._snapshot()
-        exe = self._executable(bucket, model_hash, variables)
+        exe = self._executable(bucket, model_hash, variables, cached)
         xd = jnp.asarray(x, self.dtype)
 
         def run_once():
@@ -294,9 +477,22 @@ class InferenceEngine:
         if x.shape[0] < 1:
             raise ValueError("need at least one row")
         self.metrics.dispatch(n_requests)
-        if x.shape[0] <= self.max_bucket:
+        n = int(x.shape[0])
+        # The size distribution is recorded per device CHUNK (the unit
+        # that pads): an oversized request contributes its max-bucket
+        # chunks plus the tail — the only part a better ladder can
+        # still help. Counters feed /metrics; the decayed histogram
+        # feeds the ladder optimizer.
+        sizes = ([n] if n <= self.max_bucket else
+                 [self.max_bucket] * (n // self.max_bucket)
+                 + ([n % self.max_bucket] if n % self.max_bucket else []))
+        for size in sizes:
+            self.metrics.observe_request_size(size)
+            if self.histogram is not None:
+                self.histogram.observe(size)
+        if n <= self.max_bucket:
             return self._embed_chunk(x)
         outs = []
-        for start in range(0, x.shape[0], self.max_bucket):
+        for start in range(0, n, self.max_bucket):
             outs.append(self._embed_chunk(x[start:start + self.max_bucket]))
         return np.concatenate(outs)

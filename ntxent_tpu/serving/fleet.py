@@ -98,7 +98,8 @@ class ServingFleet:
                  injector=None,
                  slowworker_s: float = 3.0,
                  env: dict | None = None,
-                 registry: MetricsRegistry | None = None):
+                 registry: MetricsRegistry | None = None,
+                 attach: bool = False):
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1, got {n_workers}")
         self.make_cmd = make_cmd
@@ -129,13 +130,36 @@ class ServingFleet:
         self._chaos_armed = False
         self._chaos_kills = 0
         self._chaos_slows = 0
-        self.workers = [
-            ManagedWorker(f"w{i}",
-                          cmd=None,  # built at spawn (port file fresh)
-                          port_file=self.workdir / f"w{i}.port",
-                          log_path=self.workdir / f"w{i}.log")
-            for i in range(int(n_workers))
-        ]
+        # Attach mode (router replication, ROADMAP item 4 follow-up):
+        # a REPLICA router observes the same worker pool a primary
+        # fleet owns. It discovers workers from the primary's port
+        # files and probes /readyz, but never spawns, kills, ejects, or
+        # restarts — process supervision stays with the one fleet that
+        # created the processes. Worker membership is fixed at attach
+        # time (the primary's w*.port files present then).
+        self.attach = bool(attach)
+        if self.attach:
+            found = sorted(self.workdir.glob("w*.port"))
+            self.workers = [
+                ManagedWorker(pf.stem, cmd=None, port_file=pf,
+                              log_path=self.workdir
+                              / f"{pf.stem}.attached.log")
+                for pf in found
+            ] or [
+                ManagedWorker(f"w{i}", cmd=None,
+                              port_file=self.workdir / f"w{i}.port",
+                              log_path=self.workdir
+                              / f"w{i}.attached.log")
+                for i in range(int(n_workers))
+            ]
+        else:
+            self.workers = [
+                ManagedWorker(f"w{i}",
+                              cmd=None,  # built at spawn (fresh file)
+                              port_file=self.workdir / f"w{i}.port",
+                              log_path=self.workdir / f"w{i}.log")
+                for i in range(int(n_workers))
+            ]
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -241,6 +265,11 @@ class ServingFleet:
                                  ready=False)
             self.pool.report_failure(worker.worker_id, repr(e),
                                      kind="probe")
+            if self.attach:
+                # The primary may have restarted this worker on a NEW
+                # port: forget the cached one so the next tick re-reads
+                # the port file the primary republished.
+                worker.port = None
 
     # -- chaos -------------------------------------------------------------
     def _apply_chaos(self) -> None:
@@ -286,6 +315,14 @@ class ServingFleet:
     # -- the supervision loop ----------------------------------------------
     def tick(self) -> None:
         """One supervision cycle (public: tests drive it directly)."""
+        if self.attach:
+            # Probe-only: a replica must never kill/eject/restart
+            # processes the primary owns — health observation is the
+            # whole job. (Its own forward failures still accumulate in
+            # the shared pool entry and gate ITS routing via ready.)
+            for worker in self.workers:
+                self._probe(worker)
+            return
         self._apply_chaos()
         now = time.monotonic()
         for worker in self.workers:
@@ -335,8 +372,9 @@ class ServingFleet:
     def start(self) -> "ServingFleet":
         if self._thread is not None:
             raise RuntimeError("fleet already started")
-        for worker in self.workers:
-            self._spawn(worker)
+        if not self.attach:
+            for worker in self.workers:
+                self._spawn(worker)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="ntxent-fleet-monitor")
         self._thread.start()

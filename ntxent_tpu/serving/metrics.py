@@ -137,10 +137,39 @@ class ServingMetrics:
         self._rollbacks = r.counter(
             "serving_rollbacks_total",
             "weight rollbacks after a canary breach")
-        # bucket -> (calls, rows_real, rows_padded) labeled counters;
-        # created on first use (the ladder is not known here).
+        # bucket -> (calls, rows_real, rows_padded, waste-gauge) labeled
+        # series; created on first use (the ladder is not known here).
+        # The per-bucket waste gauge is the padding bill ITEMIZED: the
+        # aggregate serving_padding_waste says mixed traffic pads, the
+        # breakdown says which rung to split (ISSUE 9).
         self._bucket_lock = threading.Lock()
         self._buckets: dict[int, tuple] = {}
+        # Request-size histogram: device-chunk row counts as labeled
+        # cumulative counters (cardinality bounded by the max bucket).
+        # This is the OBSERVABLE view; the decayed optimizer histogram
+        # lives in the engine (serving/ladder.py).
+        self._size_lock = threading.Lock()
+        self._sizes: dict[int, object] = {}
+        # Adaptive bucket ladder (ISSUE 9): generation 0 is the
+        # configured prior; every atomic re-AOT swap bumps it. Ladder
+        # membership renders as serving_ladder_bucket{bucket=...} 1|0
+        # gauges so a scraper sees rungs come and go.
+        self._ladder_lock = threading.Lock()
+        self._ladder_buckets: list[int] = []
+        self._ladder_rungs: dict[int, object] = {}
+        self._ladder_gen = r.gauge(
+            "serving_ladder_generation",
+            "adaptive bucket-ladder generation (0 = configured prior)")
+        self._ladder_swaps = r.counter(
+            "serving_ladder_swaps_total",
+            "atomic ladder swaps published by the re-AOT worker")
+        self._ladder_compiles = r.counter(
+            "serving_ladder_compiles_total",
+            "background bucket compiles for ladder re-AOT "
+            "(never on a request's hot path)")
+        self._ladder_failures = r.counter(
+            "serving_ladder_refresh_failures_total",
+            "ladder re-AOT attempts that failed (old ladder kept)")
         # Cross-process correlation (ISSUE 7): run identity, stamped by
         # set_run_id. None until a run id is known (tests, bare engines).
         self.run_id: str | None = None
@@ -260,6 +289,10 @@ class ServingMetrics:
                     self.registry.counter(
                         "serving_bucket_rows_padded_total",
                         "padded rows per ladder bucket", labels=labels),
+                    self.registry.gauge(
+                        "serving_bucket_padding_waste",
+                        "padded-row fraction of this bucket's device "
+                        "rows", labels=labels),
                 )
                 self._buckets[bucket] = counters
             return counters
@@ -269,14 +302,70 @@ class ServingMetrics:
         self._device_calls.inc()
         self._rows_real.inc(rows_real)
         self._rows_padded.inc(rows_padded)
-        calls, real, padded = self._bucket_counters(int(bucket))
+        calls, real, padded, waste = self._bucket_counters(int(bucket))
         calls.inc()
         real.inc(rows_real)
         padded.inc(rows_padded)
+        bucket_total = real.value + padded.value
+        if bucket_total:
+            waste.set(padded.value / bucket_total)
         self.latency["device"].observe(device_ms)
         total = self._rows_real.value + self._rows_padded.value
         if total:
             self._padding_waste.set(self._rows_padded.value / total)
+
+    def observe_request_size(self, rows: int) -> None:
+        """One device-chunk row count into the request-size histogram
+        (labeled cumulative counters — the Prometheus/JSON-visible view
+        of the distribution the adaptive ladder optimizes against)."""
+        rows = int(rows)
+        with self._size_lock:
+            counter = self._sizes.get(rows)
+            if counter is None:
+                counter = self._sizes[rows] = self.registry.counter(
+                    "serving_request_size_total",
+                    "device chunks by real row count",
+                    labels={"rows": str(rows)})
+        counter.inc()
+
+    # -- adaptive ladder (ISSUE 9) ---------------------------------------
+    def set_ladder(self, buckets, generation: int) -> None:
+        """Publish the live ladder: membership gauges (removed rungs go
+        to 0, never vanish mid-scrape) + the generation gauge."""
+        rungs = sorted(int(b) for b in buckets)
+        with self._ladder_lock:
+            self._ladder_buckets = rungs
+            for b in rungs:
+                if b not in self._ladder_rungs:
+                    self._ladder_rungs[b] = self.registry.gauge(
+                        "serving_ladder_bucket",
+                        "1 = rung currently in the live ladder",
+                        labels={"bucket": str(b)})
+            for b, gauge in self._ladder_rungs.items():
+                gauge.set(1 if b in rungs else 0)
+        self._ladder_gen.set(int(generation))
+
+    def ladder_swap(self, buckets, generation: int) -> None:
+        self._ladder_swaps.inc()
+        self.set_ladder(buckets, generation)
+
+    def ladder_compiled(self) -> None:
+        self._ladder_compiles.inc()
+
+    def ladder_refresh_failed(self) -> None:
+        self._ladder_failures.inc()
+
+    @property
+    def ladder_generation(self) -> int:
+        return int(self._ladder_gen.value)
+
+    @property
+    def ladder_swaps(self) -> int:
+        return int(self._ladder_swaps.value)
+
+    @property
+    def ladder_compiles(self) -> int:
+        return int(self._ladder_compiles.value)
 
     def queue_wait(self, ms: float) -> None:
         self.latency["queue_wait"].observe(ms)
@@ -323,6 +412,10 @@ class ServingMetrics:
         padded_total = rows_real + rows_padded
         with self._bucket_lock:
             bucket_items = sorted(self._buckets.items())
+        with self._size_lock:
+            size_items = sorted(self._sizes.items())
+        with self._ladder_lock:
+            ladder_buckets = list(self._ladder_buckets)
         return {
             "uptime_s": round(time.time() - self.started_at, 3),
             "run_id": self.run_id,
@@ -346,11 +439,24 @@ class ServingMetrics:
             },
             "checkpoint_step": self.checkpoint_step,
             "model_swaps": self.model_swaps,
+            "ladder": {
+                "buckets": ladder_buckets,
+                "generation": self.ladder_generation,
+                "swaps": self.ladder_swaps,
+                "compiles": self.ladder_compiles,
+                "refresh_failures": int(self._ladder_failures.value),
+            },
+            "request_sizes": {str(rows): int(c.value)
+                              for rows, c in size_items},
             "buckets": {
                 str(b): {"calls": int(calls.value),
                          "rows_real": int(real.value),
-                         "rows_padded": int(padded.value)}
-                for b, (calls, real, padded) in bucket_items
+                         "rows_padded": int(padded.value),
+                         "padding_waste": round(
+                             padded.value / (real.value + padded.value),
+                             4)
+                         if (real.value + padded.value) else None}
+                for b, (calls, real, padded, _waste) in bucket_items
             },
             "latency_ms": {name: win.snapshot_ms()
                            for name, win in self.latency.items()},

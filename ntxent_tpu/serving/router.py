@@ -426,7 +426,8 @@ class FleetRouter:
                  forward_timeout_s: float = 30.0,
                  control_timeout_s: float = 5.0,
                  max_body_bytes: int = MAX_BODY_BYTES,
-                 registry: MetricsRegistry | None = None):
+                 registry: MetricsRegistry | None = None,
+                 warm_rows: int = 32):
         self.pool = pool
         self.cache = cache
         if cache is not None:
@@ -443,6 +444,11 @@ class FleetRouter:
         self.forward_timeout_s = float(forward_timeout_s)
         self.control_timeout_s = float(control_timeout_s)
         self.max_body_bytes = int(max_body_bytes)
+        # Cache warming on promote (ROADMAP item 4 follow-up): how many
+        # hot rows to replay through the newly trusted model right
+        # after the promote flush (0 disables — the cache then boots
+        # cold exactly as before).
+        self.warm_rows = int(warm_rows)
         self.registry = registry if registry is not None \
             else pool.registry
         r = self.registry
@@ -453,6 +459,9 @@ class FleetRouter:
         self._cache_only = r.counter(
             "fleet_cache_only_responses_total",
             "requests answered entirely from the cache (no worker)")
+        self._cache_warmed = r.counter(
+            "fleet_cache_warmed_total",
+            "hot rows replayed through a newly promoted model")
         self._forwards = r.counter("fleet_forwards_total",
                                    "forward attempts to workers")
         self._retries_ctr = r.counter(
@@ -550,8 +559,82 @@ class FleetRouter:
             if self.cache is not None:
                 self.cache.clear(reason="rollback")
         elif action == "promote" and self.cache is not None:
-            # Embeddings from the previous model must not outlive it.
+            # Embeddings from the previous model must not outlive it —
+            # but the hot INPUTS are model-independent: capture them
+            # before the flush and replay them through the newly
+            # trusted model so the hottest traffic never boots cold.
+            hot = (self.cache.hot_keys(self.warm_rows)
+                   if self.warm_rows > 0 else [])
             self.cache.clear(reason="promote")
+            if hot:
+                # Off the deciding request's thread: the verdict fired
+                # inside whichever client handler tripped it, and a
+                # full re-forward of warm_rows rows must not stall that
+                # client's response.
+                threading.Thread(target=self._warm_cache, args=(hot,),
+                                 daemon=True,
+                                 name="fleet-cache-warm").start()
+
+    def _warm_cache(self, rows: list) -> int:
+        """Replay hot input rows through the (now trusted) fleet and
+        re-insert their fresh embeddings; returns rows warmed. Best
+        effort: any failure just leaves those rows cold, exactly the
+        pre-warming behavior.
+
+        The replay is CHUNKED: workers 413 a body over their byte cap
+        or a request over ``--max-request-rows``, and warm_rows hot
+        rows of a production-sized model serialize to far more JSON
+        than one request may carry. Chunks are sized from one row's
+        measured JSON footprint against half the router's own body cap
+        (the workers' default cap matches), and any 413 halves the
+        chunk and retries — which also adapts to a row cap the router
+        cannot see."""
+        x = np.stack(rows).astype(np.float32)
+        rid = _trace.new_request_id()
+        t0 = time.monotonic()
+        row_bytes = len(json.dumps(x[0].tolist())) + 2
+        per = max(1, min(x.shape[0],
+                         (self.max_body_bytes // 2) // row_bytes))
+        warmed, status = 0, 200
+        i = 0
+        while i < x.shape[0]:
+            chunk = x[i:i + per]
+            body = json.dumps({"inputs": chunk.tolist()}).encode()
+            code, payload, _, served_step = self.forward(body, rid)
+            if code == 413 and per > 1:
+                per = max(1, per // 2)  # cap tighter than estimated
+                continue  # same rows, smaller chunks
+            if code != 200:
+                status = code
+            elif isinstance(payload, dict):
+                try:
+                    emb = np.asarray(payload["embeddings"], np.float32)
+                    if emb.shape[0] != chunk.shape[0]:
+                        raise ValueError(f"{emb.shape[0]} rows for "
+                                         f"{chunk.shape[0]} inputs")
+                except (KeyError, TypeError, ValueError):
+                    emb = None
+                # The same trust gate as any insert: a rollback or a
+                # fresh canary racing the warm-up must not poison the
+                # cache.
+                if emb is not None and self.pool.allow_cache_insert(
+                        served_step):
+                    self.cache.insert(chunk, emb)
+                    warmed += int(chunk.shape[0])
+            i += chunk.shape[0]
+        if warmed:
+            self._cache_warmed.inc(warmed)
+        _trace.emit_span("fleet.cache_warm",
+                         (time.monotonic() - t0) * 1e3, request_id=rid,
+                         rows=int(x.shape[0]), warmed=warmed,
+                         status=status)
+        if warmed:
+            logger.info("cache warm after promote: replayed %d/%d hot "
+                        "row(s)", warmed, int(x.shape[0]))
+        else:
+            logger.warning("cache warm after promote: nothing warmed "
+                           "(status %s)", status)
+        return warmed
 
     def forward(self, body: bytes, rid: str) -> tuple[int, dict,
                                                       dict | None,
@@ -703,6 +786,7 @@ class FleetRouter:
             "requests": int(self._requests.value),
             "responses": int(self._responses.value),
             "cache_only_responses": int(self._cache_only.value),
+            "cache_warmed": int(self._cache_warmed.value),
             "forwards": int(self._forwards.value),
             "retries": int(self._retries_ctr.value),
             "latency_ms": {stage: h.snapshot_ms()

@@ -25,19 +25,24 @@ t_start=$SECONDS
 workdir="$(mktemp -d)"
 fleet_pid=""
 train_pid=""
+router2_pid=""
 cleanup() {
     rc=$?
     if [ "$rc" -ne 0 ]; then
         echo "--- fleet log tail (rc=$rc) ---" >&2
         tail -40 "$workdir/fleet.log" >&2 2>/dev/null || true
+        echo "--- router2 log tail ---" >&2
+        tail -20 "$workdir/router2.log" >&2 2>/dev/null || true
         for wlog in "$workdir"/fleet/w*.log; do
             [ -f "$wlog" ] || continue
             echo "--- $(basename "$wlog") tail ---" >&2
             tail -15 "$wlog" >&2
         done
     fi
+    [ -n "$router2_pid" ] && kill "$router2_pid" 2>/dev/null || true
     [ -n "$fleet_pid" ] && kill "$fleet_pid" 2>/dev/null || true
     [ -n "$train_pid" ] && kill "$train_pid" 2>/dev/null || true
+    [ -n "$router2_pid" ] && wait "$router2_pid" 2>/dev/null || true
     [ -n "$fleet_pid" ] && wait "$fleet_pid" 2>/dev/null || true
     rm -rf "$workdir"
 }
@@ -96,20 +101,41 @@ while time.monotonic() < deadline:
 sys.exit("workers never became ready")
 PY
 
+# Second router (ROADMAP item 4 follow-up, router replication): a
+# REPLICA ntxent-fleet attaches to the SAME worker pool (the primary's
+# port files) before the chaos window, so the SIGKILL and the rollout
+# below land under TWO routers. The router tier is stateless and
+# JAX-free, so this boots in moments.
+port_file2="$workdir/router2.port"
+JAX_PLATFORMS=cpu python -c \
+    'import sys; from ntxent_tpu.cli import fleet_main; sys.exit(fleet_main(sys.argv[1:]))' \
+    --attach-workdir "$workdir/fleet" --model tiny --image-size 8 \
+    --proj-hidden-dim 16 --proj-dim 8 --no-cache --port 0 \
+    --port-file "$port_file2" --health-poll 0.25 --canary-fraction 0.5 \
+    --canary-min-requests 4 >"$workdir/router2.log" 2>&1 &
+router2_pid=$!
+for _ in $(seq 60); do
+    [ -s "$port_file2" ] && break
+    kill -0 "$router2_pid" 2>/dev/null || { echo "router2 died:"; tail -20 "$workdir/router2.log"; exit 1; }
+    sleep 0.25
+done
+[ -s "$port_file2" ] || { echo "router2 never bound"; exit 1; }
+
 # Phase 2 — new checkpoint lands DURING the load: advance the same dir
 # to step 4 in a concurrent training process (restores step 2 first).
 JAX_PLATFORMS=cpu python -m ntxent_tpu.cli "${train_flags[@]}" \
     --steps 4 >"$workdir/train1.log" 2>&1 &
 train_pid=$!
 
-# Sustained mixed-size load through the router while the SIGKILL and the
-# rollout land; then the assertions.
-JAX_PLATFORMS=cpu python - "$port" "$workdir/fleet" <<'PY'
+# Sustained mixed-size load through BOTH routers while the SIGKILL and
+# the rollout land; then the assertions.
+JAX_PLATFORMS=cpu python - "$port" "$workdir/fleet" "$(cat "$port_file2")" <<'PY'
 import json, sys, threading, time, urllib.error, urllib.request
 from pathlib import Path
 
-port, fleet_dir = sys.argv[1], Path(sys.argv[2])
+port, fleet_dir, port2 = sys.argv[1], Path(sys.argv[2]), sys.argv[3]
 base = f"http://127.0.0.1:{port}"
+base2 = f"http://127.0.0.1:{port2}"
 
 
 def get(url):
@@ -152,11 +178,14 @@ def fresh(tid, i):
 
 
 def client(tid):
+    # One of the six clients drives the REPLICA router: the kill and
+    # the rollout must be survivable through both front doors.
+    front = base2 if tid == 5 else base
     i = 0
     while not stop.is_set():
         i += 1
         body = hot if i % 3 == 0 else fresh(tid, i)
-        req = urllib.request.Request(base + "/embed", data=body,
+        req = urllib.request.Request(front + "/embed", data=body,
                                      method="POST")
         try:
             with urllib.request.urlopen(req, timeout=25) as r:
@@ -278,6 +307,70 @@ print(f"fleet smoke: OK — {total} requests "
       f"cache_hit_rate={cache['hit_rate']}, "
       f"compile-flat workers={flat}/2")
 PY
+
+# Phase 3 — router replication verdict (ROADMAP item 4 follow-up): the
+# replica router (attached to the same worker pool since before the
+# chaos window, and serving client traffic through the SIGKILL and the
+# rollout above) must agree with the primary on the trusted step — a
+# convergent, not split-brain, canary verdict.
+JAX_PLATFORMS=cpu python - "$(cat "$port_file")" "$(cat "$port_file2")" <<'PY'
+import json, sys, time, urllib.error, urllib.request
+
+port1, port2 = sys.argv[1], sys.argv[2]
+
+
+def get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=15) as r:
+        return json.loads(r.read())
+
+
+def post(port, i):
+    body = json.dumps({"inputs": [[[[round(i * 1e-7, 7)] * 3] * 8] * 8],
+                       "timeout_ms": 20000}).encode()
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/embed",
+                                 data=body, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=25) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+
+
+# The replica discovers workers and reaches its own trusted verdict.
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline:
+    h = get(port2, "/healthz")
+    if h.get("workers_ready") == 2:
+        break
+    time.sleep(0.25)
+assert h.get("workers_ready") == 2, h
+
+i = 2 * 10**6
+codes = {}
+t1 = t2 = None
+deadline = time.monotonic() + 30  # the verdict gets its own window
+while time.monotonic() < deadline:
+    for port in (port1, port2):
+        i += 1
+        code = post(port, i)
+        codes[code] = codes.get(code, 0) + 1
+        assert code in (200, 429), f"router replication 5xx: {code}"
+    t1 = get(port1, "/healthz").get("trusted_step")
+    t2 = get(port2, "/healthz").get("trusted_step")
+    if t1 == t2 and (t1 or 0) >= 4:
+        break
+    time.sleep(0.25)
+assert t1 == t2 and (t1 or 0) >= 4, \
+    f"trusted step split-brain: router1={t1} router2={t2}"
+print(f"router replication: OK — both routers serve ({codes}), "
+      f"trusted step converged at {t1}")
+PY
+
+kill "$router2_pid"
+wait "$router2_pid" 2>/dev/null || true
+router2_pid=""
 
 kill "$fleet_pid"
 wait "$fleet_pid" 2>/dev/null || true

@@ -1,0 +1,236 @@
+#!/usr/bin/env bash
+# Ragged-serving smoke: the ISSUE 9 fixed-vs-adaptive ladder A/B in
+# <60 s on CPU, end-to-end through ntxent-serve. Phase A drives a
+# mixed-size load (3/5/7-row requests — between-rung sizes the default
+# ladder pads badly) at a FIXED 1/4/16/64 ladder and records its
+# padding waste and client-side p99. Phase B drives the same load at an
+# --adaptive-buckets server: the ladder swap fires MID-LOAD, and the
+# assertions pin the acceptance criteria:
+#   * padding waste over the post-swap window drops >2x vs fixed;
+#   * client p99 over the post-swap window is no worse than fixed;
+#   * the swap is invisible: every request answers 200 and the
+#     request-visible compile counter is FLAT from post-warmup to end
+#     (background re-AOT lands in serving_ladder_compiles_total);
+#   * the new observability surfaces are live in BOTH /metrics views
+#     (request-size histogram, per-bucket waste, ladder swap counters).
+# Any non-200, hang, or failed assertion exits nonzero.
+# Pairs with `pytest -m ragged` (the same machinery asserted in-process)
+# and `python bench.py --ragged` (the committed BENCH_ragged.json A/B).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+t_start=$SECONDS
+
+workdir="$(mktemp -d)"
+serve_pid=""
+cleanup() {
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "--- serve log tail (rc=$rc) ---" >&2
+        tail -40 "$workdir"/serve_*.log >&2 2>/dev/null || true
+    fi
+    [ -n "$serve_pid" ] && kill "$serve_pid" 2>/dev/null || true
+    [ -n "$serve_pid" ] && wait "$serve_pid" 2>/dev/null || true
+    rm -rf "$workdir"
+}
+trap cleanup EXIT
+
+start_server() {  # $1 = phase name, rest = extra flags
+    local phase="$1"; shift
+    rm -f "$workdir/serve.port"
+    JAX_PLATFORMS=cpu python -c \
+        'import sys; from ntxent_tpu.cli import serve_main; sys.exit(serve_main(sys.argv[1:]))' \
+        --platform cpu --model tiny --image-size 8 --proj-hidden-dim 16 \
+        --proj-dim 8 --buckets 1,4,16,64 --max-delay-ms 1 \
+        --queue-size 32 --port 0 --port-file "$workdir/serve.port" \
+        "$@" >"$workdir/serve_$phase.log" 2>&1 &
+    serve_pid=$!
+    for _ in $(seq 120); do
+        [ -s "$workdir/serve.port" ] && break
+        kill -0 "$serve_pid" 2>/dev/null || {
+            echo "$phase server died:"; tail -20 "$workdir/serve_$phase.log"; exit 1; }
+        sleep 0.5
+    done
+    [ -s "$workdir/serve.port" ] || { echo "$phase server never bound"; exit 1; }
+}
+
+stop_server() {
+    kill "$serve_pid" 2>/dev/null || true
+    wait "$serve_pid" 2>/dev/null || true
+    serve_pid=""
+}
+
+# Phase A — the fixed-ladder baseline.
+start_server fixed
+JAX_PLATFORMS=cpu python - "$(cat "$workdir/serve.port")" "$workdir/fixed.json" <<'PY'
+import json, sys, time, urllib.error, urllib.request
+
+port, out_path = sys.argv[1], sys.argv[2]
+base = f"http://127.0.0.1:{port}"
+
+
+def get(path):
+    with urllib.request.urlopen(base + path, timeout=15) as r:
+        return json.loads(r.read())
+
+
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    try:
+        get("/readyz")
+        break
+    except (urllib.error.HTTPError, OSError):
+        time.sleep(0.5)
+else:
+    sys.exit("fixed server never became ready")
+
+
+def body(rows, value):
+    return json.dumps(
+        {"inputs": [[[[value] * 3] * 8] * 8] * rows,
+         "timeout_ms": 20000}).encode()
+
+
+def post(b):
+    req = urllib.request.Request(base + "/embed", data=b, method="POST")
+    t0 = time.monotonic()
+    with urllib.request.urlopen(req, timeout=25) as r:
+        r.read()
+        assert r.status == 200
+    return (time.monotonic() - t0) * 1e3
+
+
+lat = []
+for i in range(120):
+    rows = (3, 5, 7)[i % 3]
+    lat.append(post(body(rows, round(i * 1e-4, 6))))
+
+m = get("/metrics")
+lat.sort()
+record = {
+    "padding_waste": m["padding_waste"],
+    "p99_ms": lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+    "responses": m["responses"],
+}
+assert record["padding_waste"] > 0.4, record  # the mix pads badly
+json.dump(record, open(out_path, "w"))
+print(f"fixed ladder: waste={record['padding_waste']} "
+      f"p99={record['p99_ms']:.1f}ms over {record['responses']} requests")
+PY
+stop_server
+
+# Phase B — the adaptive ladder, swap landing mid-load.
+start_server adaptive --adaptive-buckets --ladder-max-buckets 4 \
+    --ladder-min-requests 40 --ladder-interval 0.5
+JAX_PLATFORMS=cpu python - "$(cat "$workdir/serve.port")" "$workdir/fixed.json" <<'PY'
+import json, sys, time, urllib.error, urllib.request
+
+port, fixed_path = sys.argv[1], sys.argv[2]
+fixed = json.load(open(fixed_path))
+base = f"http://127.0.0.1:{port}"
+
+
+def get(path):
+    with urllib.request.urlopen(base + path, timeout=15) as r:
+        return json.loads(r.read())
+
+
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    try:
+        get("/readyz")
+        break
+    except (urllib.error.HTTPError, OSError):
+        time.sleep(0.5)
+else:
+    sys.exit("adaptive server never became ready")
+
+compiles_after_warmup = get("/metrics")["compile"]["compiles"]
+
+
+def body(rows, value):
+    return json.dumps(
+        {"inputs": [[[[value] * 3] * 8] * 8] * rows,
+         "timeout_ms": 20000}).encode()
+
+
+def post(b):
+    req = urllib.request.Request(base + "/embed", data=b, method="POST")
+    t0 = time.monotonic()
+    with urllib.request.urlopen(req, timeout=25) as r:
+        r.read()
+        assert r.status == 200
+    return (time.monotonic() - t0) * 1e3
+
+
+# Drive until the background worker swaps the ladder (mid-load), then
+# measure a post-swap window with the SAME mix as the fixed phase.
+i = 0
+deadline = time.monotonic() + 45
+while time.monotonic() < deadline:
+    post(body((3, 5, 7)[i % 3], round(i * 1e-4, 6)))
+    i += 1
+    if i % 10 == 0 and get("/metrics")["ladder"]["generation"] >= 1:
+        break
+m = get("/metrics")
+assert m["ladder"]["generation"] >= 1, \
+    f"ladder never swapped under load: {m['ladder']}"
+assert m["ladder"]["buckets"] == [3, 5, 7, 64], m["ladder"]
+
+base_real, base_padded = 0, 0
+for b, rec in m["buckets"].items():
+    base_real += rec["rows_real"]
+    base_padded += rec["rows_padded"]
+
+lat = []
+for j in range(120):
+    rows = (3, 5, 7)[j % 3]
+    lat.append(post(body(rows, round((10**6 + j) * 1e-7, 7))))
+
+m = get("/metrics")
+real, padded = 0, 0
+for b, rec in m["buckets"].items():
+    real += rec["rows_real"]
+    padded += rec["rows_padded"]
+waste = (padded - base_padded) / max(
+    (real - base_real) + (padded - base_padded), 1)
+lat.sort()
+p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+
+# 1) >2x padding-waste cut over the post-swap window.
+assert fixed["padding_waste"] / max(waste, 1e-9) > 2.0, \
+    (fixed["padding_waste"], waste)
+# 2) p99 no worse (jitter slack; smaller buckets do less device work).
+assert p99 <= fixed["p99_ms"] * 1.25, (p99, fixed["p99_ms"])
+# 3) the swap was invisible to requests: compile counter flat (the
+# re-AOT compiles live in the ladder counter), zero non-200 by
+# construction of post().
+assert m["compile"]["compiles"] == compiles_after_warmup, \
+    (m["compile"], compiles_after_warmup)
+assert m["ladder"]["compiles"] >= 3, m["ladder"]
+assert m["errors"] == 0, m["errors"]
+# 4) observability surfaces live in both views.
+assert m["request_sizes"]["3"] > 0 and m["request_sizes"]["7"] > 0
+assert m["buckets"]["16"]["padding_waste"] is not None
+with urllib.request.urlopen(base + "/metrics?format=prometheus",
+                            timeout=15) as r:
+    prom = r.read().decode()
+for needle in ("serving_request_size_total", "serving_ladder_swaps_total",
+               "serving_ladder_generation", "serving_bucket_padding_waste",
+               "serving_ladder_compiles_total"):
+    assert needle in prom, f"{needle} missing from the prometheus view"
+
+print(f"adaptive ladder: waste {fixed['padding_waste']} -> "
+      f"{round(waste, 4)} "
+      f"({round(fixed['padding_waste'] / max(waste, 1e-9), 1)}x cut), "
+      f"p99 {fixed['p99_ms']:.1f} -> {p99:.1f}ms, "
+      f"ladder={m['ladder']['buckets']} "
+      f"(gen {m['ladder']['generation']}, compiles flat at "
+      f"{compiles_after_warmup})")
+PY
+stop_server
+
+elapsed=$((SECONDS - t_start))
+echo "ragged smoke: OK (${elapsed}s)"
+if [ "$elapsed" -ge 60 ]; then
+    echo "ragged smoke: WARNING — exceeded the 60 s CPU budget" >&2
+fi

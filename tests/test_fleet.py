@@ -71,6 +71,10 @@ class FakeWorker:
         # scalar429 (the scalar modes answer with valid-JSON NON-OBJECT
         # bodies — what a recycled port's foreign service might say).
         self.mode = "ok"
+        # When set, /embed requests over this row count 413 — the real
+        # server's --max-request-rows cap (cache warming must chunk
+        # under it).
+        self.max_rows: int | None = None
         self.embed_calls: list[int] = []   # row count per /embed
         self.rollbacks: list[dict] = []
         self.request_ids: list[str] = []
@@ -116,6 +120,11 @@ class FakeWorker:
                 worker.embed_calls.append(rows)
                 if worker.on_embed is not None:
                     worker.on_embed(rows)
+                if worker.max_rows is not None \
+                        and rows > worker.max_rows:
+                    self._reply(413, {"error": f"{rows} rows exceed "
+                                               f"cap {worker.max_rows}"})
+                    return
                 if worker.mode == "err500":
                     self._reply(500, {"error": "injected worker error"})
                 elif worker.mode == "busy429":
@@ -369,10 +378,13 @@ class TestWorkerPool:
 
 class TestFleetRouter:
     def _router(self, pool, cache=None, example_shape=(2,), retries=2):
+        # warm_rows=0: these tests pin the FLUSH semantics (and count
+        # worker calls exactly) — the promote-time warm replay has its
+        # own suite (TestCacheWarming) and would race the counts here.
         router = FleetRouter(pool, cache=cache,
                              example_shape=example_shape, port=0,
                              retries=retries, forward_timeout_s=10.0,
-                             control_timeout_s=2.0)
+                             control_timeout_s=2.0, warm_rows=0)
         router.start()
         return router
 
@@ -1396,3 +1408,225 @@ class TestServingFleet:
                     fleet, lambda: worker.restarts >= 1, timeout_s=5.0)
         finally:
             fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# cache warming on promote (ROADMAP item 4 follow-up)
+
+
+class TestCacheWarming:
+    def test_hot_keys_tracks_hit_rows_most_recent_first(self):
+        cache = EmbeddingCache(capacity_rows=8, ttl_s=60, hot_rows=2)
+        rows = np.arange(8, dtype=np.float32).reshape(4, 2)
+        cache.insert(rows, np.zeros((4, 4), np.float32))
+        assert cache.hot_keys(4) == []  # inserts alone are not heat
+        cache.lookup(rows[:1])   # row 0 hits
+        cache.lookup(rows[1:3])  # rows 1, 2 hit -> row 0 falls off (cap 2)
+        hot = cache.hot_keys(4)
+        assert len(hot) == 2  # bounded by hot_rows
+        np.testing.assert_array_equal(hot[0], rows[2])
+        np.testing.assert_array_equal(hot[1], rows[1])
+        # A model flush keeps the hot INPUTS (they carry no model state).
+        cache.clear(reason="promote")
+        assert len(cache) == 0 and len(cache.hot_keys(4)) == 2
+        assert cache.snapshot()["hot_rows"] == 2
+
+    def test_promote_replays_hot_rows_through_the_new_model(self):
+        worker = FakeWorker(step=1)
+        pool = _pool_with({"w0": worker}, canary_fraction=1.0,
+                          canary_min_requests=2,
+                          canary_max_error_rate=0.5)
+        cache = EmbeddingCache(capacity_rows=16, ttl_s=60)
+        router = FleetRouter(pool, cache=cache, example_shape=(2,),
+                             port=0, retries=2, forward_timeout_s=10.0,
+                             warm_rows=8)
+        router.start()
+        try:
+            hot = {"inputs": _rows(1, value=77.0)}
+            _post_router(router, hot)
+            _post_router(router, hot)  # the hit marks the row hot
+            assert len(cache.hot_keys(8)) == 1
+            # The worker hot-swaps to step 2: it canaries (fraction 1.0
+            # routes everything to it) and promotes on clean outcomes.
+            worker.step = 2
+            pool.set_health("w0", alive=True, ready=True,
+                            checkpoint_step=2)
+            for i in range(6):
+                status, _, _ = _post_router(
+                    router, {"inputs": _rows(1, value=float(i))})
+                assert status == 200
+                if pool.trusted_step == 2:
+                    break
+            assert pool.trusted_step == 2
+            # Warming runs off the deciding request's thread.
+            deadline = time.monotonic() + 10.0
+            while int(router._cache_warmed.value) < 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert int(router._cache_warmed.value) == 1
+            assert router.metrics_dict()["cache_warmed"] == 1
+            # The hot payload answers from the cache — with the NEW
+            # model's embedding and no worker in the loop.
+            calls = len(worker.embed_calls)
+            status, resp, _ = _post_router(router, hot)
+            assert status == 200 and resp["cache_hits"] == 1
+            assert resp["embeddings"][0][0] == 2.0  # step-2 model
+            assert len(worker.embed_calls) == calls
+        finally:
+            router.close()
+            worker.close()
+
+    def test_warm_rows_zero_boots_the_cache_cold(self):
+        worker = FakeWorker(step=1)
+        pool = _pool_with({"w0": worker}, canary_fraction=1.0,
+                          canary_min_requests=2,
+                          canary_max_error_rate=0.5)
+        cache = EmbeddingCache(capacity_rows=16, ttl_s=60)
+        router = FleetRouter(pool, cache=cache, example_shape=(2,),
+                             port=0, retries=2, forward_timeout_s=10.0,
+                             warm_rows=0)
+        router.start()
+        try:
+            hot = {"inputs": _rows(1, value=77.0)}
+            _post_router(router, hot)
+            _post_router(router, hot)
+            worker.step = 2
+            pool.set_health("w0", alive=True, ready=True,
+                            checkpoint_step=2)
+            for i in range(6):
+                _post_router(router, {"inputs": _rows(1, value=float(i))})
+                if pool.trusted_step == 2:
+                    break
+            assert pool.trusted_step == 2
+            time.sleep(0.2)  # any (buggy) warm thread would land here
+            assert int(router._cache_warmed.value) == 0
+            # Cold as before: the hot payload re-dispatches.
+            status, resp, _ = _post_router(router, hot)
+            assert status == 200 and resp["cache_hits"] == 0
+        finally:
+            router.close()
+            worker.close()
+
+    def test_warm_replay_chunks_under_the_worker_row_cap(self):
+        # Production-sized hot sets exceed one request's body/row caps;
+        # the replay must chunk — a 413 halves the chunk and retries —
+        # so every hot row is still warmed, not silently dropped.
+        worker = FakeWorker(step=1)
+        worker.max_rows = 2
+        pool = _pool_with({"w0": worker})
+        cache = EmbeddingCache(capacity_rows=16, ttl_s=60)
+        router = FleetRouter(pool, cache=cache, example_shape=(2,),
+                             port=0, retries=2,
+                             forward_timeout_s=10.0, warm_rows=8)
+        try:
+            rows = [np.full(2, float(i), np.float32) for i in range(7)]
+            assert router._warm_cache(rows) == 7
+            assert int(router._cache_warmed.value) == 7
+            assert len(cache) == 7
+            # The tiny rows made the byte-budget estimate admit all 7
+            # at once; the worker's 413s walked the chunk size under
+            # its cap and every successful replay fit it.
+            assert worker.embed_calls[0] == 7
+            served = [r for r in worker.embed_calls if r <= 2]
+            assert sum(served) == 7
+        finally:
+            router.close()
+            worker.close()
+
+
+# ---------------------------------------------------------------------------
+# router replication (ROADMAP item 4 follow-up)
+
+
+class TestRouterReplication:
+    def test_two_routers_one_worker_pool_converge(self):
+        # The router is stateless by design; N of them over one worker
+        # set must serve correctly AND reach the same canary verdict
+        # independently (no split-brain on trusted_step).
+        w0, w1 = FakeWorker(step=1), FakeWorker(step=1)
+        pools = [_pool_with({"w0": w0, "w1": w1}, canary_fraction=1.0,
+                            canary_min_requests=2,
+                            canary_max_error_rate=0.5)
+                 for _ in range(2)]
+        routers = []
+        try:
+            for pool in pools:
+                router = FleetRouter(pool, example_shape=(2,), port=0,
+                                     retries=2, forward_timeout_s=10.0)
+                routers.append(router.start())
+            for router in routers:
+                status, _, _ = _post_router(router, {"inputs": _rows(1)})
+                assert status == 200
+            assert [p.trusted_step for p in pools] == [1, 1]
+            # A rollout lands: both routers observe w1 at step 2 and
+            # each runs its own canary to a promote.
+            w1.step = 2
+            for pool in pools:
+                pool.set_health("w1", alive=True, ready=True,
+                                checkpoint_step=2)
+            for router, pool in zip(routers, pools):
+                for i in range(8):
+                    status, _, _ = _post_router(
+                        router, {"inputs": _rows(1, value=float(i))})
+                    assert status == 200
+                    if pool.trusted_step == 2:
+                        break
+            assert [p.trusted_step for p in pools] == [2, 2]
+            # A worker dies under both routers: each fails over to the
+            # survivor with zero client-visible errors.
+            w0.close()
+            for router in routers:
+                status, resp, _ = _post_router(
+                    router, {"inputs": _rows(1, value=500.0)})
+                assert status == 200
+                assert resp["embeddings"][0][0] == 2.0  # the survivor
+        finally:
+            for router in routers:
+                router.close()
+            w1.close()
+
+
+class TestAttachMode:
+    def test_attach_probes_without_owning_processes(self, tmp_path):
+        import os
+
+        primary = _fast_fleet(tmp_path, n=1)
+        worker = primary.workers[0]
+        primary._spawn(worker)
+        try:
+            assert _tick_until(
+                primary, lambda: any(w.ready
+                                     for w in primary.pool.workers()))
+            replica = ServingFleet(_fake_worker_cmd, n_workers=1,
+                                   workdir=tmp_path / "fleet",
+                                   poll_s=0.1, attach=True)
+            # Discovered the primary's worker from its port file.
+            assert [w.worker_id for w in replica.workers] == ["w0"]
+            assert _tick_until(
+                replica, lambda: any(w.ready
+                                     for w in replica.pool.workers()))
+            assert int(replica._spawns.value) == 0
+            # SIGKILL: the replica goes not-ready but must neither kill
+            # nor restart — supervision belongs to the primary.
+            first_pid = worker.pid
+            os.kill(first_pid, signal.SIGKILL)
+            worker.proc.wait(5.0)
+            assert _tick_until(
+                replica, lambda: not any(w.ready for w in
+                                         replica.pool.workers()))
+            assert replica.workers[0].restarts == 0
+            assert replica.workers[0].proc is None
+            # The primary restarts it on a NEW port; the replica
+            # re-reads the republished port file and recovers.
+            assert _tick_until(
+                primary, lambda: any(w.ready
+                                     for w in primary.pool.workers()))
+            assert worker.pid != first_pid
+            assert _tick_until(
+                replica, lambda: any(w.ready
+                                     for w in replica.pool.workers()))
+            # Replica teardown leaves the primary's process alive.
+            replica.stop()
+            assert worker.alive()
+        finally:
+            primary.stop()

@@ -523,8 +523,11 @@ class TestServingMetricsMigration:
         assert d["batch_fill_ratio"] == 4.0
         assert d["padding_waste"] == 0.25
         assert d["compile"] == {"compiles": 1, "cache_hits": 0}
+        # ISSUE 9 extends the per-bucket entry with its itemized waste;
+        # the pre-existing keys keep their exact shape.
         assert d["buckets"]["4"] == {"calls": 1, "rows_real": 3,
-                                     "rows_padded": 1}
+                                     "rows_padded": 1,
+                                     "padding_waste": 0.25}
         lat = d["latency_ms"]["total"]
         assert {"count", "mean_ms", "p50_ms", "p95_ms", "p99_ms",
                 "max_ms", "window"} <= set(lat)

@@ -1,0 +1,411 @@
+"""Traffic-adaptive bucket ladder: histogram, DP optimizer, engine swap.
+
+The ladder-learning edge cases ISSUE 9 pins are all here: an empty
+histogram keeps the configured prior, single-size traffic collapses to
+one learned rung plus the fixed top, a failed re-AOT keeps serving on
+the old ladder, a swap racing an in-flight chunk never mixes
+(bucket, executable) snapshots, and oversized requests still chunk
+through the immovable max bucket after adaptation. Pure-math tests
+drive ``serving/ladder.py`` with plain dicts (the DP is exact — a brute
+force pins it); engine tests run a real ``InferenceEngine`` over a
+linear model so every rung compiles in milliseconds on CPU.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ntxent_tpu.serving import (
+    EmbeddingCache,
+    InferenceEngine,
+    SizeHistogram,
+    expected_padded_rows,
+    optimize_ladder,
+)
+
+pytestmark = pytest.mark.ragged
+
+
+def _linear_engine(buckets=(1, 4, 16, 64), dim=3, **kw):
+    """Real InferenceEngine over y = x @ W: every bucket compiles in
+    ms (the test_serving idiom, adaptive knobs passed through)."""
+    w = jnp.asarray(np.random.RandomState(0).rand(2, dim), jnp.float32)
+    return InferenceEngine(lambda v, x: x @ v, w, example_shape=(2,),
+                           buckets=buckets, **kw)
+
+
+def _feed(engine, sizes, reps=1):
+    rng = np.random.RandomState(7)
+    for _ in range(reps):
+        for n in sizes:
+            engine.embed(rng.rand(n, 2).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# size histogram
+
+
+class TestSizeHistogram:
+    def test_observe_and_weights(self):
+        h = SizeHistogram(decay=1.0)  # no decay: plain counts
+        for n in (3, 3, 5):
+            h.observe(n)
+        assert h.observations == 3
+        w = h.weights()
+        assert w[3] == pytest.approx(2.0) and w[5] == pytest.approx(1.0)
+
+    def test_decay_ages_out_old_traffic(self):
+        h = SizeHistogram(decay=0.9)
+        for _ in range(50):
+            h.observe(3)
+        for _ in range(100):
+            h.observe(7)
+        w = h.weights()
+        # 100 observations of pure size-7 traffic at decay 0.9 leave
+        # the size-3 era at < 0.9^100 of one fresh sample: gone.
+        assert w[7] / max(w.get(3, 0.0), 1e-12) > 1e3
+
+    def test_rescale_keeps_ratios(self):
+        import ntxent_tpu.serving.ladder as ladder_mod
+
+        h = SizeHistogram(decay=0.5)
+        old = ladder_mod._RESCALE_AT
+        ladder_mod._RESCALE_AT = 1e6  # force rescales within the test
+        try:
+            for i in range(60):
+                h.observe(3 if i % 2 else 5)
+        finally:
+            ladder_mod._RESCALE_AT = old
+        w = h.weights()
+        # The last observation dominates; ratios stay finite and sane.
+        assert set(w) <= {3, 5} and all(v > 0 for v in w.values())
+
+    def test_rejects_bad_rows(self):
+        with pytest.raises(ValueError):
+            SizeHistogram().observe(0)
+        with pytest.raises(ValueError):
+            SizeHistogram(decay=0.0)
+
+
+# ---------------------------------------------------------------------------
+# DP optimizer
+
+
+class TestOptimizeLadder:
+    def test_empty_histogram_keeps_the_prior(self):
+        prior = (1, 4, 16, 64)
+        assert optimize_ladder({}, 5, 64, prior) == prior
+        assert optimize_ladder({3: 0.0}, 5, 64, prior) == prior
+
+    def test_single_size_collapses_to_one_rung_plus_top(self):
+        assert optimize_ladder({5: 10.0}, 5, 64, (1, 4, 16, 64)) == \
+            (5, 64)
+
+    def test_every_size_gets_a_rung_when_budget_allows(self):
+        weights = {2: 1.0, 3: 1.0, 9: 1.0}
+        assert optimize_ladder(weights, 4, 64, (1, 64)) == (2, 3, 9, 64)
+
+    def test_budget_is_respected_and_top_rung_is_fixed(self):
+        weights = {s: 1.0 for s in range(1, 20)}
+        ladder = optimize_ladder(weights, 4, 64, (1, 64))
+        assert len(ladder) <= 4 and ladder[-1] == 64
+
+    def test_dp_matches_brute_force(self):
+        weights = {2: 5.0, 3: 1.0, 6: 4.0, 9: 2.0, 14: 3.0}
+        max_bucket, budget = 32, 3
+        ladder = optimize_ladder(weights, budget, max_bucket, (1, 32))
+        best = min(
+            (expected_padded_rows(weights, combo + (max_bucket,))
+             for r in range(budget)
+             for combo in itertools.combinations(sorted(weights), r)),
+        )
+        assert expected_padded_rows(weights, ladder) == pytest.approx(
+            best)
+
+    def test_weight_skew_moves_the_rungs(self):
+        # With one spare rung under {3, 5, 7}, the split must isolate
+        # the heaviest size so ITS padding is zero.
+        heavy3 = optimize_ladder({3: 100.0, 5: 1.0, 7: 1.0}, 3, 64,
+                                 (1, 64))
+        assert 3 in heavy3
+        heavy7 = optimize_ladder({3: 1.0, 5: 1.0, 7: 100.0}, 3, 64,
+                                 (1, 64))
+        assert 7 in heavy7
+        assert expected_padded_rows({3: 100.0, 5: 1.0, 7: 1.0}, heavy3) \
+            <= expected_padded_rows({3: 100.0, 5: 1.0, 7: 1.0}, heavy7)
+
+    def test_oversized_sizes_clamp_to_the_top_rung(self):
+        # Sizes past max_bucket cannot earn a rung above it (the engine
+        # chunks them; only the remainder pads).
+        ladder = optimize_ladder({300: 10.0, 3: 1.0}, 3, 64, (1, 64))
+        assert ladder[-1] == 64 and all(b <= 64 for b in ladder)
+
+    def test_expected_padded_rows_prices_a_ladder(self):
+        weights = {3: 2.0, 5: 1.0}
+        # 3 -> 4 pads 1 (x2), 5 -> 16 pads 11 (x1).
+        assert expected_padded_rows(weights, (1, 4, 16)) == \
+            pytest.approx(2 * 1 + 1 * 11)
+        assert expected_padded_rows(weights, (3, 5, 16)) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# engine: observe -> optimize -> re-AOT -> swap
+
+
+class TestAdaptiveEngine:
+    def test_swap_cuts_padding_and_requests_never_pay_a_compile(self):
+        eng = _linear_engine(adaptive=True, ladder_max_buckets=4,
+                             ladder_min_requests=10)
+        eng.warmup()
+        _feed(eng, (3, 5, 7), reps=10)
+        compiles = eng.metrics.compiles
+        assert eng.refresh_ladder() is True
+        assert eng.buckets == (3, 5, 7, 64)
+        assert eng.ladder_generation == 1
+        assert eng.metrics.ladder_swaps == 1
+        assert eng.metrics.ladder_compiles >= 3  # background re-AOT
+        pad_before = eng.metrics.rows_padded
+        rng = np.random.RandomState(3)
+        for n in (3, 5, 7, 3):
+            x = rng.rand(n, 2).astype(np.float32)
+            np.testing.assert_allclose(
+                eng.embed(x), x @ np.asarray(eng.variables), rtol=1e-6)
+        assert eng.metrics.rows_padded == pad_before  # zero new padding
+        # The swap is invisible to requests: the request-visible
+        # compile counter never moved (ragged_smoke's acceptance).
+        assert eng.metrics.compiles == compiles
+
+    def test_below_min_requests_keeps_the_prior(self):
+        eng = _linear_engine(adaptive=True, ladder_min_requests=50)
+        eng.warmup()
+        _feed(eng, (3, 5), reps=5)  # 10 < 50 observations
+        assert eng.refresh_ladder() is False
+        assert eng.buckets == eng.initial_buckets
+        assert eng.ladder_generation == 0
+
+    def test_empty_histogram_keeps_the_prior(self):
+        eng = _linear_engine(adaptive=True)
+        assert eng.refresh_ladder() is False
+        assert eng.refresh_ladder(force=True) is False
+        assert eng.buckets == eng.initial_buckets
+
+    def test_non_adaptive_engine_never_swaps(self):
+        eng = _linear_engine()
+        _feed(eng, (3, 5), reps=5)
+        assert eng.histogram is None
+        assert eng.refresh_ladder(force=True) is False
+        assert eng.buckets == eng.initial_buckets
+
+    def test_single_size_traffic_collapses_to_one_rung_plus_top(self):
+        eng = _linear_engine(adaptive=True, ladder_min_requests=5)
+        eng.warmup()
+        _feed(eng, (5,), reps=10)
+        assert eng.refresh_ladder() is True
+        assert eng.buckets == (5, 64)
+
+    def test_hysteresis_skips_marginal_proposals(self):
+        eng = _linear_engine(buckets=(3, 64), adaptive=True,
+                             ladder_min_requests=1)
+        eng.warmup()
+        _feed(eng, (3,), reps=10)  # live ladder already optimal-ish
+        # Proposal (3, 64) == current -> no swap, no churn.
+        assert eng.refresh_ladder() is False
+        assert eng.ladder_generation == 0
+
+    def test_reaot_failure_keeps_serving_on_the_old_ladder(self):
+        eng = _linear_engine(adaptive=True, ladder_min_requests=5)
+        eng.warmup()
+        _feed(eng, (3, 5, 7), reps=5)
+        orig = eng._executable
+
+        def exploding(bucket, *snap, **kw):
+            if kw.get("background"):
+                raise RuntimeError("compile backend down")
+            return orig(bucket, *snap, **kw)
+
+        eng._executable = exploding
+        before = eng.buckets
+        assert eng.refresh_ladder() is False
+        assert eng.buckets == before and eng.ladder_generation == 0
+        assert eng.metrics.to_dict()["ladder"]["refresh_failures"] == 1
+        # Serving continues on the old ladder, untouched.
+        eng._executable = orig
+        x = np.random.RandomState(1).rand(5, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            eng.embed(x), x @ np.asarray(eng.variables), rtol=1e-6)
+
+    def test_swap_racing_an_in_flight_chunk_keeps_its_snapshot(self):
+        # A chunk that resolved (bucket, exe) before the swap must run
+        # to completion on that snapshot even though the swap evicts
+        # its rung's executable mid-flight.
+        eng = _linear_engine(adaptive=True, ladder_min_requests=1)
+        eng.warmup()
+        _feed(eng, (3,), reps=3)
+        in_chunk = threading.Event()
+        release = threading.Event()
+        orig = eng._executable
+
+        def gated(bucket, *snap, **kw):
+            exe = orig(bucket, *snap, **kw)
+            if kw.get("background"):
+                return exe  # the re-AOT worker must not deadlock
+
+            def wrapper(v, xx):
+                in_chunk.set()
+                assert release.wait(10.0)
+                return exe(v, xx)
+
+            return wrapper
+
+        eng._executable = gated
+        x = np.random.RandomState(2).rand(3, 2).astype(np.float32)
+        result = {}
+        t = threading.Thread(
+            target=lambda: result.setdefault("out", eng.embed(x)))
+        t.start()
+        assert in_chunk.wait(10.0)  # chunk holds its (bucket 4, exe)
+        assert eng.refresh_ladder() is True  # evicts rung 4's exe
+        assert eng.buckets == (3, 64)
+        assert all(k[0] in (3, 64) for k in eng._cache)
+        release.set()
+        t.join(10.0)
+        np.testing.assert_allclose(result["out"],
+                                   x @ np.asarray(eng.variables),
+                                   rtol=1e-6)
+
+    def test_oversized_requests_still_chunk_through_the_max_bucket(self):
+        eng = _linear_engine(adaptive=True, ladder_min_requests=5,
+                             ladder_max_buckets=3)
+        eng.warmup()
+        _feed(eng, (3, 5), reps=5)
+        assert eng.refresh_ladder() is True
+        assert eng.buckets[-1] == eng.max_bucket == 64
+        calls = eng.metrics.device_calls
+        x = np.random.RandomState(4).rand(131, 2).astype(np.float32)
+        out = eng.embed(x)
+        np.testing.assert_allclose(out, x @ np.asarray(eng.variables),
+                                   rtol=1e-6)
+        # 131 -> 64 + 64 + 3-row tail (which now has its own rung).
+        assert eng.metrics.device_calls == calls + 3
+
+    def test_weight_swap_mid_compile_abandons_the_publish(self):
+        eng = _linear_engine(adaptive=True, ladder_min_requests=1)
+        eng.warmup()
+        _feed(eng, (3, 5), reps=3)
+        orig = eng._executable
+
+        def swap_weights_then_compile(bucket, *snap, **kw):
+            if kw.get("background") and not getattr(
+                    swap_weights_then_compile, "swapped", False):
+                swap_weights_then_compile.swapped = True
+                eng.update_variables(
+                    jnp.asarray(np.asarray(eng.variables) + 1.0))
+            return orig(bucket, *snap, **kw)
+
+        eng._executable = swap_weights_then_compile
+        before = eng.buckets
+        # The publish must be abandoned: these executables belong to a
+        # retired model hash.
+        assert eng.refresh_ladder() is False
+        assert eng.buckets == before and eng.ladder_generation == 0
+        eng._executable = orig
+        # The next cycle re-optimizes against the NEW model and lands.
+        assert eng.refresh_ladder() is True
+        x = np.random.RandomState(5).rand(3, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            eng.embed(x), x @ np.asarray(eng.variables), rtol=1e-6)
+
+    def test_background_worker_thread_swaps_and_close_stops_it(self):
+        eng = _linear_engine(adaptive=True, ladder_min_requests=5,
+                             ladder_interval_s=0.05)
+        try:
+            eng.warmup()
+            _feed(eng, (3, 5, 7), reps=5)
+            import time as _time
+
+            deadline = _time.monotonic() + 10.0
+            while eng.ladder_generation == 0 \
+                    and _time.monotonic() < deadline:
+                _time.sleep(0.02)
+            assert eng.ladder_generation >= 1
+            assert eng.buckets == (3, 5, 7, 64)
+        finally:
+            eng.close()
+        assert eng._ladder_thread is None
+
+
+# ---------------------------------------------------------------------------
+# metrics export (the observability satellite)
+
+
+class TestLadderMetrics:
+    def test_request_size_histogram_in_both_views(self):
+        eng = _linear_engine()
+        eng.warmup()
+        _feed(eng, (3, 5, 3))
+        m = eng.metrics.to_dict()
+        assert m["request_sizes"] == {"3": 2, "5": 1}
+        prom = eng.metrics.render_prometheus()
+        assert 'serving_request_size_total{rows="3"} 2' in prom
+        # An oversized request records its CHUNK sizes (64 + tail).
+        eng.embed(np.zeros((67, 2), np.float32))
+        m = eng.metrics.to_dict()
+        assert m["request_sizes"]["64"] == 1
+        assert m["request_sizes"]["3"] == 3
+
+    def test_per_bucket_padding_waste_breakdown(self):
+        eng = _linear_engine()
+        eng.warmup()
+        _feed(eng, (3, 5))  # 3->4 pads 1; 5->16 pads 11
+        m = eng.metrics.to_dict()
+        assert m["buckets"]["4"]["padding_waste"] == pytest.approx(0.25)
+        assert m["buckets"]["16"]["padding_waste"] == pytest.approx(
+            11 / 16)
+        prom = eng.metrics.render_prometheus()
+        assert 'serving_bucket_padding_waste{bucket="16"}' in prom
+
+    def test_ladder_block_and_membership_gauges_track_swaps(self):
+        eng = _linear_engine(adaptive=True, ladder_min_requests=1)
+        eng.warmup()
+        m = eng.metrics.to_dict()["ladder"]
+        assert m["buckets"] == [1, 4, 16, 64] and m["generation"] == 0
+        _feed(eng, (5,), reps=3)
+        assert eng.refresh_ladder() is True
+        m = eng.metrics.to_dict()["ladder"]
+        assert m["buckets"] == [5, 64]
+        assert m["generation"] == 1 and m["swaps"] == 1
+        prom = eng.metrics.render_prometheus()
+        assert 'serving_ladder_bucket{bucket="5"} 1' in prom
+        # Removed rungs read 0, they never vanish mid-scrape.
+        assert 'serving_ladder_bucket{bucket="4"} 0' in prom
+        assert "serving_ladder_swaps_total 1" in prom
+        assert "serving_ladder_generation 1" in prom
+
+
+# ---------------------------------------------------------------------------
+# fleet wiring: cache keys are ladder-independent
+
+
+class TestCacheLadderIndependence:
+    def test_row_keys_ignore_the_bucket_vocabulary(self):
+        # The router's cache hashes row CONTENT — per-worker adaptive
+        # ladders must never skew caching. Two caches with different
+        # bucket vocabularies are interchangeable stores.
+        rows = np.random.RandomState(0).rand(3, 2).astype(np.float32)
+        emb = np.ones((3, 4), np.float32)
+        a = EmbeddingCache(capacity_rows=8, ttl_s=60,
+                           buckets=(1, 4, 16, 64))
+        b = EmbeddingCache(capacity_rows=8, ttl_s=60, buckets=(3, 5, 7))
+        a.insert(rows, emb)
+        b.insert(rows, emb)
+        hits_a, miss_a = a.lookup(rows)
+        hits_b, miss_b = b.lookup(rows)
+        assert miss_a == miss_b == []
+        for i in range(3):
+            np.testing.assert_array_equal(hits_a[i], hits_b[i])
