@@ -1672,6 +1672,20 @@ def build_fleet_parser() -> argparse.ArgumentParser:
     rt.add_argument("--canary-max-error-rate", type=float, default=0.1,
                     help="canary error rate above which the step is "
                          "rolled back fleet-wide")
+    rt.add_argument("--shadow-fraction", type=float, default=0.0,
+                    help="shadow routing (ISSUE 10): mirror this "
+                         "fraction of trusted-cohort traffic to the "
+                         "undecided canary OFF the client's critical "
+                         "path and diff the embeddings per row "
+                         "(cosine distance); 0 disables")
+    rt.add_argument("--shadow-max-drift", type=float, default=0.05,
+                    help="drift bar: promote requires mirrored-traffic "
+                         "drift p99 at or under this cosine distance "
+                         "(in addition to the error-rate bar); a "
+                         "breach rolls the canary back")
+    rt.add_argument("--shadow-min-samples", type=int, default=8,
+                    help="mirrored rows diffed before the drift bar "
+                         "can judge (the verdict defers until then)")
 
     f = p.add_argument_group("fleet supervision")
     f.add_argument("--workdir", default=None,
@@ -1700,9 +1714,37 @@ def build_fleet_parser() -> argparse.ArgumentParser:
     o = p.add_argument_group("observability (ntxent_tpu/obs/)")
     o.add_argument("--log-jsonl", default=None, metavar="PATH",
                    help="router-side typed JSONL events (fleet.request/"
-                        "fleet.cache/fleet.forward spans; workers log "
-                        "to <workdir>/wN.jsonl with the same run id)")
+                        "fleet.cache/fleet.forward/fleet.shadow spans; "
+                        "workers log to <workdir>/wN.jsonl with the "
+                        "same run id — stitch them with "
+                        "`ntxent-trace --merge`)")
     o.add_argument("--run-id", default=None, metavar="ID")
+    o.add_argument("--fed-interval", type=float, default=2.0,
+                   metavar="SECONDS",
+                   help="metric-federation tick: how often the router "
+                        "scrapes every worker's /metrics?format=state "
+                        "into the merged /metrics/fleet view (and "
+                        "evaluates SLOs); 0 disables federation")
+    o.add_argument("--slo-availability", type=float, default=None,
+                   metavar="TARGET",
+                   help="availability SLO target (e.g. 0.99): alert "
+                        "when the router-edge failure rate burns the "
+                        "error budget faster than --slo-burn-factor "
+                        "over BOTH burn windows (obs/slo.py)")
+    o.add_argument("--slo-latency-ms", type=float, default=None,
+                   metavar="MS",
+                   help="p99 latency SLO bound on the router's "
+                        "fleet_latency_ms{stage=total}")
+    o.add_argument("--slo-drift", type=float, default=None,
+                   metavar="DIST",
+                   help="drift SLO bound on fleet_shadow_drift p99 "
+                        "(alerting view of the shadow bar)")
+    o.add_argument("--slo-fast-window", type=float, default=60.0,
+                   metavar="SECONDS")
+    o.add_argument("--slo-slow-window", type=float, default=300.0,
+                   metavar="SECONDS")
+    o.add_argument("--slo-burn-factor", type=float, default=2.0,
+                   help="error-budget burn multiple that pages")
 
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None, metavar="cpu|tpu")
@@ -1834,6 +1876,10 @@ def fleet_main(argv=None) -> int:
     pool = WorkerPool(canary_fraction=args.canary_fraction,
                       canary_min_requests=args.canary_min_requests,
                       canary_max_error_rate=args.canary_max_error_rate,
+                      shadow_max_drift=(args.shadow_max_drift
+                                        if args.shadow_fraction > 0
+                                        else None),
+                      shadow_min_samples=args.shadow_min_samples,
                       registry=registry)
     cache = None
     if not args.no_cache:
@@ -1857,6 +1903,64 @@ def fleet_main(argv=None) -> int:
         host=args.host, port=args.port, retries=args.retries,
         forward_timeout_s=args.forward_timeout, registry=registry,
         warm_rows=args.cache_warm_rows)
+    router.set_run_id(run_id)
+
+    # Fleet observability plane (ISSUE 10): shadow mirror, metric
+    # federation, SLO engine. All off-hot-path; all optional.
+    shadow = None
+    if args.shadow_fraction > 0:
+        from ntxent_tpu.serving import ShadowMirror
+
+        shadow = ShadowMirror(pool, fraction=args.shadow_fraction,
+                              forward_timeout_s=args.forward_timeout)
+        router.attach_shadow(shadow)
+
+    slo_flags = (args.slo_availability, args.slo_latency_ms,
+                 args.slo_drift)
+    if any(f is not None for f in slo_flags) and args.fed_interval <= 0:
+        # SLOs evaluate on federation ticks: accepting the flags while
+        # silently never arming them would look like paging that is on
+        # but is dead.
+        raise SystemExit("--slo-* objectives require federation "
+                         "(--fed-interval > 0)")
+    aggregator = None
+    if args.fed_interval > 0:
+        def _fed_targets() -> dict:
+            return {w.worker_id: w.url for w in pool.workers()
+                    if w.url}
+
+        aggregator = obs.FleetAggregator(
+            _fed_targets, local={"router": registry},
+            interval_s=args.fed_interval)
+        router.aggregator = aggregator
+        objectives = []
+        if args.slo_availability is not None:
+            objectives.append(obs.Objective(
+                name="availability", kind="availability",
+                target=args.slo_availability,
+                total_metric="fleet_requests_total",
+                bad_metric="fleet_rejected_total",
+                # Saturation is backpressure, not failure: the client
+                # was told to retry.
+                bad_exclude={"reason": "saturated"},
+                fast_window_s=args.slo_fast_window,
+                slow_window_s=args.slo_slow_window,
+                burn_factor=args.slo_burn_factor))
+        if args.slo_latency_ms is not None:
+            objectives.append(obs.Objective(
+                name="latency_p99", kind="quantile",
+                target=args.slo_latency_ms,
+                metric="fleet_latency_ms", labels={"stage": "total"},
+                q=0.99))
+        if args.slo_drift is not None:
+            objectives.append(obs.Objective(
+                name="shadow_drift_p99", kind="quantile",
+                target=args.slo_drift,
+                metric="fleet_shadow_drift", q=0.99,
+                min_samples=args.shadow_min_samples))
+        if objectives:
+            engine = obs.SLOEngine(objectives, store=router.alerts)
+            aggregator.on_merge.append(engine.evaluate)
 
     stop = threading.Event()
 
@@ -1869,6 +1973,10 @@ def fleet_main(argv=None) -> int:
 
     fleet.start()
     router.start()
+    if shadow is not None:
+        shadow.start()
+    if aggregator is not None:
+        aggregator.start()
     if args.port_file:
         tmp = args.port_file + ".tmp"
         with open(tmp, "w") as f:
@@ -1881,6 +1989,10 @@ def fleet_main(argv=None) -> int:
         while not stop.is_set():
             stop.wait(0.2)
     finally:
+        if aggregator is not None:
+            aggregator.stop()
+        if shadow is not None:
+            shadow.stop()
         router.close()
         fleet.stop()
         if event_log is not None:

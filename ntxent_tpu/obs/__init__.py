@@ -23,14 +23,28 @@ fact. The pieces:
   ``/metrics`` negotiates the same two formats over the same registry;
 * ``trace`` — span tracing over the same event stream (ISSUE 7):
   ``span``/``emit_span`` producers, the ``ntxent-trace`` exporter to
-  Perfetto/Chrome ``trace.json``, and the flight recorder
-  (``dump_flight``) that writes the event tail on stalls and signals.
+  Perfetto/Chrome ``trace.json`` (``--merge`` stitches router + worker
+  logs into one trace with a process lane per file), and the flight
+  recorder (``dump_flight``) that writes the event tail on stalls and
+  signals;
+* ``aggregate.FleetAggregator`` — metric federation (ISSUE 10): scrape
+  every worker's + the router's ``/metrics?format=state`` raw view
+  each tick and merge into ONE registry (counters summed, gauges
+  instance-labeled, histogram windows pooled so fleet percentiles use
+  the same exact-window quantile rule) — the router's
+  ``/metrics/fleet``;
+* ``slo.SLOEngine`` — declarative objectives (availability burn-rate
+  over fast/slow windows, latency/drift quantile bounds) evaluated on
+  every federation tick; breaches emit typed ``alert`` events, trip
+  the flight recorder, and land in the ``AlertStore`` the router's
+  ``/alerts`` serves.
 
 Everything here is stdlib except the profiler (lazy jax import), so
 the package is importable — and scrapeable — from processes that never
 initialize a backend (bench.py's parent).
 """
 
+from .aggregate import FleetAggregator, merge_states
 from .events import (
     EVENT_TYPES,
     EventLog,
@@ -52,19 +66,26 @@ from .registry import (
     prometheus_name,
     quantile,
 )
+from .slo import AlertStore, Objective, SLOEngine
 from .timeline import StepTimeline
 from .trace import (
     current_span_id,
     emit_span,
     export_chrome_trace,
+    export_merged_chrome_trace,
     new_request_id,
     span,
     validate_chrome_trace,
 )
 
 __all__ = [
+    "AlertStore",
     "EVENT_TYPES",
     "EventLog",
+    "FleetAggregator",
+    "Objective",
+    "SLOEngine",
+    "merge_states",
     "dump_flight",
     "emit",
     "get_event_log",
@@ -86,6 +107,7 @@ __all__ = [
     "current_span_id",
     "emit_span",
     "export_chrome_trace",
+    "export_merged_chrome_trace",
     "new_request_id",
     "span",
     "validate_chrome_trace",

@@ -60,8 +60,13 @@ __all__ = ["EVENT_TYPES", "EventLog", "install", "get_event_log", "emit",
 # fallback/delete. compile: an AOT step compile. trace: a profiler
 # capture artifact. span: one timed causal interval (obs/trace.py —
 # serving request stages, or any `with trace.span(...)` block).
+# rollout: a serving worker's checkpoint swap/rollback (serving/
+# worker.py). fleet: a supervision lifecycle action (spawn/death/eject/
+# restart — serving/fleet.py). alert: an SLO or canary-verdict breach/
+# resolution (obs/slo.py, router rollback) — the typed record the
+# flight recorder and /alerts surface.
 EVENT_TYPES = ("step", "retry", "divergence", "restart", "checkpoint",
-               "compile", "trace", "span")
+               "compile", "trace", "span", "rollout", "fleet", "alert")
 
 
 class EventLog:
@@ -70,10 +75,13 @@ class EventLog:
     ``path=None`` keeps records in a bounded in-memory tail only (tests;
     metrics-only runs) — ``emit`` stays cheap either way.
 
-    ``async_io=True`` moves the file write off the emitting thread: one
-    daemon writer drains a bounded queue onto the same line-buffered
-    handle (records still never interleave — single consumer — and the
-    file stays tail-able with ~one-queue-drain latency). This is the
+    ``async_io=True`` moves the file write — and since ISSUE 10 the
+    JSON serialization too — off the emitting thread: one daemon
+    writer drains a bounded queue of record dicts, serializes them,
+    and writes onto the same line-buffered handle (records still never
+    interleave — single consumer — and the file stays tail-able within
+    the writer's ~0.2 s poll; bursts past 64 queued records wake it
+    immediately). This is the
     mode for emitters on latency-critical paths: the serving stack's
     span emits ride the micro-batcher's dispatch loop, where a
     per-record flush syscall measurably backs up the bounded request
@@ -137,25 +145,37 @@ class EventLog:
             "attempt": self._attempt,
             **fields,
         }
-        # Serialize only when a sink will consume the bytes: the
-        # path=None metrics-only mode promises emit stays cheap.
+        # Serialize only when a sink will consume the bytes AND the
+        # serialization must happen HERE: the path=None metrics-only
+        # mode promises emit stays cheap, and async mode defers even
+        # the json.dumps to the writer thread (ISSUE 10: the obs
+        # overhead gate measured per-emit serialization as the
+        # dominant telemetry cost on serving's span-per-hop paths —
+        # the record dict is freshly built and never mutated after
+        # emit, so handing it over is safe).
         line = (json.dumps(_sanitize(record), sort_keys=False,
                            default=_jsonable)
-                if self._fh is not None else None)
+                if self._fh is not None and self._write_queue is None
+                else None)
         with self._lock:
             self._counts[record["event"]] = \
                 self._counts.get(record["event"], 0) + 1
             self._tail.append(record)
-            if self._fh is not None and line is not None:
+            if self._fh is not None:
                 if self._write_queue is not None:
-                    # Async mode: hand the line to the writer thread;
-                    # the emitter never waits on the filesystem.
+                    # Async mode: hand the RECORD to the writer thread;
+                    # the emitter pays neither serialization nor
+                    # filesystem. The wake is batched: the writer polls
+                    # every 0.2 s anyway, so emits only signal it when
+                    # a burst is piling up — a per-emit futex wake
+                    # measurably taxes a 2-core host (the obs bench).
                     if len(self._write_queue) >= self._write_queue_max:
                         self._write_queue.popleft()
                         self.dropped_writes += 1
-                    self._write_queue.append(line)
-                    self._writer_wake.set()
-                else:
+                    self._write_queue.append(record)
+                    if len(self._write_queue) >= 64:
+                        self._writer_wake.set()
+                elif line is not None:
                     try:
                         self._fh.write(line + "\n")
                     except OSError as e:  # a full disk must not kill
@@ -246,13 +266,34 @@ class EventLog:
         while True:
             self._writer_wake.wait(0.2)
             self._writer_wake.clear()
-            lines: list[str] = []
+            raw: list[dict] = []
             with self._lock:
                 while self._write_queue:
-                    lines.append(self._write_queue.popleft())
-                self._inflight = len(lines)
+                    raw.append(self._write_queue.popleft())
+                self._inflight = len(raw)
                 fh = self._fh
                 closing = self._closing
+            # Serialization happens HERE, off every emitting thread and
+            # outside the lock (ISSUE 10: per-emit json.dumps was the
+            # measured hot-path cost the async mode exists to remove).
+            # Guarded per record: one unserializable field must cost
+            # ONE record (dropped and counted), never the writer
+            # thread — a dead writer silently ends the whole stream.
+            lines = []
+            ok_raw = []  # what a failed WRITE may requeue: never the
+            #              record that already failed to serialize
+            for rec in raw:
+                try:
+                    line = json.dumps(_sanitize(rec), sort_keys=False,
+                                      default=_jsonable)
+                except Exception as e:  # noqa: BLE001
+                    with self._lock:
+                        self.dropped_writes += 1
+                    logger.error("event log record unserializable "
+                                 "(%s); dropped", e)
+                    continue
+                lines.append(line)
+                ok_raw.append(rec)
             failed = False
             if lines and fh is not None:
                 try:
@@ -264,8 +305,8 @@ class EventLog:
                         if closing or self._write_queue is None:
                             self.dropped_writes += len(lines)
                         else:
-                            for line in reversed(lines):
-                                self._write_queue.appendleft(line)
+                            for rec in reversed(ok_raw):
+                                self._write_queue.appendleft(rec)
                             while (len(self._write_queue)
                                    > self._write_queue_max):
                                 self._write_queue.popleft()

@@ -35,17 +35,20 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 def choose_format(path: str, accept: str | None,
                   default: str = "json") -> str:
-    """'json' or 'prometheus' for a /metrics request.
+    """'json', 'prometheus', or 'state' for a /metrics request.
 
-    Priority: explicit ``?format=prometheus|json`` query, then the
-    Accept header (``application/json`` vs ``text/plain`` /
+    Priority: explicit ``?format=prometheus|json|state`` query, then
+    the Accept header (``application/json`` vs ``text/plain`` /
     ``openmetrics``), then ``default``. Unknown values fall back to the
     default rather than erroring — a scrape endpoint should never 400
-    over a header.
+    over a header. ``state`` (the raw ``dump_state`` federation view,
+    obs/aggregate.py's scrape format) is reachable ONLY by explicit
+    query: no Accept header should ever switch a dashboard onto the
+    internal shape.
     """
     query = parse_qs(urlparse(path).query)
     explicit = (query.get("format") or [None])[0]
-    if explicit in ("prometheus", "json"):
+    if explicit in ("prometheus", "json", "state"):
         return explicit
     accept = (accept or "").lower()
     if "application/json" in accept:
@@ -122,6 +125,10 @@ def _make_handler(registry: MetricsRegistry):
                 if fmt == "json":
                     self._reply(200, "application/json",
                                 json.dumps(registry.collect()).encode())
+                elif fmt == "state":
+                    self._reply(200, "application/json",
+                                json.dumps(
+                                    registry.dump_state()).encode())
                 else:
                     self._reply(200, PROMETHEUS_CONTENT_TYPE,
                                 registry.render_prometheus().encode())

@@ -275,6 +275,39 @@ class MetricsRegistry:
                 out[m.name] = value
         return out
 
+    def dump_state(self) -> dict:
+        """Raw-state view for cross-process federation (ISSUE 10).
+
+        ``collect``/``render_prometheus`` are presentation views; a
+        FEDERATOR (obs/aggregate.py) needs the underlying state —
+        histogram windows included — because the fleet-level percentile
+        must come from the one exact-window quantile rule applied to
+        the POOLED samples, not from averaging per-worker percentiles
+        (the p99 of a fleet is not the mean of its workers' p99s).
+        Shape::
+
+            {"metrics": [{"name", "kind", "labels", ...state...}]}
+
+        where counters/gauges carry ``value`` and histograms carry
+        ``count``/``sum``/``window`` (the bounded recent-sample list)
+        + ``quantiles``. Served over HTTP as
+        ``/metrics?format=state``.
+        """
+        out: list[dict] = []
+        for m in self._sorted_metrics():
+            entry = {"name": m.name, "kind": m.kind,
+                     "labels": dict(m.labels)}
+            if isinstance(m, Histogram):
+                with m._lock:
+                    entry["count"] = m.count
+                    entry["sum"] = m.total
+                    entry["window"] = list(m._window)
+                entry["quantiles"] = list(m.quantiles)
+            else:
+                entry["value"] = m.value
+            out.append(entry)
+        return {"metrics": out}
+
     def render_prometheus(self) -> str:
         """Exposition-format text (version 0.0.4). Histograms render as
         summaries with their exact-window quantiles plus _sum/_count."""

@@ -53,7 +53,8 @@ import zlib
 from . import events
 
 __all__ = ["span", "emit_span", "current_span_id", "new_request_id",
-           "export_chrome_trace", "validate_chrome_trace", "main"]
+           "export_chrome_trace", "export_merged_chrome_trace",
+           "validate_chrome_trace", "main"]
 
 _local = threading.local()
 
@@ -160,7 +161,6 @@ def emit_span(name: str, dur_ms: float, span_id: str | None = None,
 # bench, ... and any future type — the stream is extensible, and an
 # exporter that drops what it does not recognize hides exactly the novel
 # thing being debugged) renders as an instant on its source track.
-_PID = 1
 
 # A serving log mints one request_id per request — unbounded over a real
 # run, and Perfetto draws one track per tid, so a lane per id makes an
@@ -173,20 +173,44 @@ REQUEST_LANES_MAX = 64
 
 class _Lanes:
     """name -> stable tid assignment plus the thread_name metadata
-    records Perfetto uses to label tracks."""
+    records Perfetto uses to label tracks — one instance per PROCESS
+    lane (``pid``).
 
-    def __init__(self):
+    Timebase: a single file's records carry ``t`` (monotonic offset
+    since that log opened), which is the right axis for one process but
+    meaningless ACROSS processes — each log opened at a different
+    moment. The merged exporter therefore passes ``ts0_wall`` (the
+    earliest wall clock over all files) and slices align on ``wall``
+    instead; single-file export keeps the monotonic axis (wall-clock
+    jumps must not reorder a one-process timeline).
+    """
+
+    def __init__(self, pid: int = 1, ts0_wall: float | None = None,
+                 process_name: str | None = None):
+        self.pid = pid
+        self.ts0_wall = ts0_wall
         self._tids: dict[str, int] = {}
         self.meta: list[dict] = []
         self._req_pool: list[int] = []
         self._req_map: dict[str, int] = {}
+        if process_name is not None:
+            self.meta.append({
+                "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+                "args": {"name": process_name},
+            })
+
+    def ts_us(self, rec: dict) -> float:
+        if self.ts0_wall is not None and "wall" in rec:
+            return (float(rec["wall"]) - self.ts0_wall) * 1e6
+        return float(rec["t"]) * 1e6
 
     def tid(self, label: str) -> int:
         tid = self._tids.get(label)
         if tid is None:
             tid = self._tids[label] = len(self._tids) + 1
             self.meta.append({
-                "ph": "M", "pid": _PID, "tid": tid, "name": "thread_name",
+                "ph": "M", "pid": self.pid, "tid": tid,
+                "name": "thread_name",
                 "args": {"name": label},
             })
         return tid
@@ -207,14 +231,14 @@ class _Lanes:
 
 def _span_events(rec: dict, lanes: _Lanes) -> list[dict]:
     dur_ms = float(rec.get("dur_ms", 0.0))
-    end_us = float(rec["t"]) * 1e6
+    end_us = lanes.ts_us(rec)
     tid = (lanes.request_tid(str(rec["request_id"]))
            if rec.get("request_id")
            else lanes.tid(str(rec.get("thread", "main"))))
     args = {k: v for k, v in rec.items()
             if k not in ("event", "t", "wall", "name", "dur_ms", "thread")}
     return [{
-        "ph": "X", "pid": _PID, "tid": tid, "cat": "span",
+        "ph": "X", "pid": lanes.pid, "tid": tid, "cat": "span",
         "name": str(rec.get("name", "span")),
         "ts": round(end_us - dur_ms * 1e3, 3),
         "dur": round(max(dur_ms * 1e3, 0.001), 3),
@@ -232,14 +256,14 @@ def _step_events(rec: dict, lanes: _Lanes) -> list[dict]:
              ("device", float(rec.get("device_ms", 0.0))),
              ("checkpoint", float(rec.get("checkpoint_ms", 0.0)))]
     total_ms = sum(d for _, d in parts)
-    end_us = float(rec["t"]) * 1e6
+    end_us = lanes.ts_us(rec)
     start_us = end_us - total_ms * 1e3
     args = {k: rec[k] for k in ("step", "loss", "steps_per_sec", "mfu",
                                 "grad_norm", "ok", "attempt",
                                 "comms_bytes", "host_fetch_ms",
                                 "transfer_ms") if k in rec}
     out = [{
-        "ph": "X", "pid": _PID, "tid": tid, "cat": "step",
+        "ph": "X", "pid": lanes.pid, "tid": tid, "cat": "step",
         "name": f"step {rec.get('step', '?')}",
         "ts": round(start_us, 3), "dur": round(max(total_ms * 1e3, 1), 3),
         "args": args,
@@ -249,7 +273,7 @@ def _step_events(rec: dict, lanes: _Lanes) -> list[dict]:
         if dur <= 0:
             continue
         out.append({
-            "ph": "X", "pid": _PID, "tid": tid, "cat": "step_phase",
+            "ph": "X", "pid": lanes.pid, "tid": tid, "cat": "step_phase",
             "name": name, "ts": round(cursor, 3),
             "dur": round(dur * 1e3, 3), "args": {},
         })
@@ -264,21 +288,16 @@ def _instant_event(rec: dict, lanes: _Lanes) -> dict:
     if rec.get("action"):
         name = f"{name}:{rec['action']}"
     return {
-        "ph": "i", "pid": _PID, "tid": lanes.tid(label), "s": "t",
+        "ph": "i", "pid": lanes.pid, "tid": lanes.tid(label), "s": "t",
         "cat": rec["event"], "name": name,
-        "ts": round(float(rec["t"]) * 1e6, 3), "args": args,
+        "ts": round(lanes.ts_us(rec), 3), "args": args,
     }
 
 
-def export_chrome_trace(jsonl_path: str, run_id: str | None = None) -> dict:
-    """Convert an EventLog JSONL file into a Chrome-trace dict
-    (``{"traceEvents": [...]}``) that Perfetto / chrome://tracing loads
-    directly. ``run_id`` filters a file that several processes appended
-    to (training + serving sharing one path keep distinct run ids)."""
-    records = events.read_events(jsonl_path)
-    lanes = _Lanes()
-    trace_events: list[dict] = []
-    run_ids: set[str] = set()
+def _render_records(records: list[dict], lanes: _Lanes,
+                    run_id: str | None,
+                    run_ids: set[str]) -> list[dict]:
+    out: list[dict] = []
     for rec in records:
         if "t" not in rec or "event" not in rec:
             continue
@@ -288,11 +307,23 @@ def export_chrome_trace(jsonl_path: str, run_id: str | None = None) -> dict:
             run_ids.add(rec["run_id"])
         kind = rec["event"]
         if kind == "span":
-            trace_events.extend(_span_events(rec, lanes))
+            out.extend(_span_events(rec, lanes))
         elif kind == "step":
-            trace_events.extend(_step_events(rec, lanes))
+            out.extend(_step_events(rec, lanes))
         else:
-            trace_events.append(_instant_event(rec, lanes))
+            out.append(_instant_event(rec, lanes))
+    return out
+
+
+def export_chrome_trace(jsonl_path: str, run_id: str | None = None) -> dict:
+    """Convert an EventLog JSONL file into a Chrome-trace dict
+    (``{"traceEvents": [...]}``) that Perfetto / chrome://tracing loads
+    directly. ``run_id`` filters a file that several processes appended
+    to (training + serving sharing one path keep distinct run ids)."""
+    records = events.read_events(jsonl_path)
+    lanes = _Lanes()
+    run_ids: set[str] = set()
+    trace_events = _render_records(records, lanes, run_id, run_ids)
     trace_events.sort(key=lambda e: e.get("ts", 0.0))
     return {
         "traceEvents": lanes.meta + trace_events,
@@ -301,6 +332,69 @@ def export_chrome_trace(jsonl_path: str, run_id: str | None = None) -> dict:
             "source": jsonl_path,
             "run_ids": sorted(run_ids),
             "exporter": "ntxent-trace",
+        },
+    }
+
+
+def _process_label(path: str, taken: set[str]) -> str:
+    """A human lane label from a JSONL filename (``w0.jsonl`` -> ``w0``),
+    deduplicated — two files named alike must not merge lanes."""
+    import os
+
+    base = os.path.basename(str(path))
+    label = base[:-len(".jsonl")] if base.endswith(".jsonl") else base
+    label = label or "events"
+    candidate, n = label, 1
+    while candidate in taken:
+        n += 1
+        candidate = f"{label}#{n}"
+    taken.add(candidate)
+    return candidate
+
+
+def export_merged_chrome_trace(paths: list[str],
+                               run_id: str | None = None) -> dict:
+    """Stitch SEVERAL processes' JSONL logs into ONE Chrome trace
+    (``ntxent-trace --merge``): each file becomes its own process lane
+    (pid + ``process_name`` metadata — router, w0, w1, ...), and all
+    lanes share one wall-clock timebase so a request's router hop,
+    worker queue wait, and device chunk line up as the causal sequence
+    they were.
+
+    Per-file ``t`` is a monotonic offset since THAT log opened —
+    meaningless across processes — so merged slices align on the
+    ``wall`` field every record carries (zeroed at the earliest wall
+    time over all files). Cross-process request joins need no flow
+    plumbing: the router forwards ``X-Request-Id``, both sides stamp
+    it on their spans, and the id rides every slice's ``args`` — in
+    Perfetto, selecting a request's router slice and searching the id
+    lights up its worker-side tree.
+    """
+    per_file = [(str(p), events.read_events(str(p))) for p in paths]
+    walls = [float(rec["wall"])
+             for _, records in per_file for rec in records
+             if "wall" in rec and "t" in rec and "event" in rec]
+    ts0_wall = min(walls) if walls else None
+    trace_events: list[dict] = []
+    meta: list[dict] = []
+    run_ids: set[str] = set()
+    sources: dict[str, str] = {}
+    taken: set[str] = set()
+    for pid, (path, records) in enumerate(per_file, start=1):
+        label = _process_label(path, taken)
+        sources[label] = path
+        lanes = _Lanes(pid=pid, ts0_wall=ts0_wall, process_name=label)
+        trace_events.extend(
+            _render_records(records, lanes, run_id, run_ids))
+        meta.extend(lanes.meta)
+    trace_events.sort(key=lambda e: e.get("ts", 0.0))
+    return {
+        "traceEvents": meta + trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "sources": sources,
+            "run_ids": sorted(run_ids),
+            "exporter": "ntxent-trace --merge",
         },
     }
 
@@ -356,32 +450,51 @@ def main(argv=None) -> int:
         description="Convert a run's typed JSONL event log (ntxent-train "
                     "--log-jsonl / ntxent-serve --log-jsonl) into a "
                     "Chrome-trace file; open it at https://ui.perfetto.dev "
-                    "or chrome://tracing")
-    p.add_argument("jsonl", help="path to the run's JSONL event log")
+                    "or chrome://tracing. Several files (or --merge) "
+                    "stitch into ONE trace with a process lane per file "
+                    "— router + worker logs join on the forwarded "
+                    "X-Request-Id.")
+    p.add_argument("jsonl", nargs="+",
+                   help="path(s) to JSONL event logs; more than one "
+                        "implies --merge")
+    p.add_argument("--merge", action="store_true",
+                   help="force cross-process stitching (process lanes "
+                        "+ shared wall-clock timebase) even for one "
+                        "file")
     p.add_argument("-o", "--output", default="trace.json",
                    help="output trace file (default: trace.json)")
     p.add_argument("--run-id", default=None,
                    help="keep only records from this run_id (a shared "
                         "log file carries one id per process)")
     args = p.parse_args(argv)
+    merge = args.merge or len(args.jsonl) > 1
     try:
-        trace = export_chrome_trace(args.jsonl, run_id=args.run_id)
+        if merge:
+            trace = export_merged_chrome_trace(args.jsonl,
+                                               run_id=args.run_id)
+        else:
+            trace = export_chrome_trace(args.jsonl[0],
+                                        run_id=args.run_id)
     except OSError as e:
-        print(f"ntxent-trace: cannot read {args.jsonl}: {e}",
+        print(f"ntxent-trace: cannot read {' '.join(args.jsonl)}: {e}",
               file=sys.stderr)
         return 1
     n = validate_chrome_trace(trace)
     if n == 0:
-        print(f"ntxent-trace: {args.jsonl} contained no exportable "
-              "events" + (f" for run_id {args.run_id}" if args.run_id
-                          else ""), file=sys.stderr)
+        print(f"ntxent-trace: {' '.join(args.jsonl)} contained no "
+              "exportable events"
+              + (f" for run_id {args.run_id}" if args.run_id else ""),
+              file=sys.stderr)
         return 1
     with open(args.output, "w") as f:
         json.dump(trace, f)
     spans = sum(1 for e in trace["traceEvents"] if e.get("cat") == "span")
     steps = sum(1 for e in trace["traceEvents"] if e.get("cat") == "step")
+    lanes = len({e["pid"] for e in trace["traceEvents"]
+                 if e.get("ph") != "M"})
+    extra = f", {lanes} process lanes" if merge else ""
     print(f"ntxent-trace: wrote {args.output} ({n} events: {spans} spans, "
-          f"{steps} steps; load in https://ui.perfetto.dev)")
+          f"{steps} steps{extra}; load in https://ui.perfetto.dev)")
     return 0
 
 

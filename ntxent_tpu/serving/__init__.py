@@ -34,6 +34,10 @@ sits in front of N of the above:
   under load yields zero client-visible 5xx), 429 load-shedding when
   all workers saturate, canary fractions + automatic rollback across
   checkpoint rollouts;
+* ``shadow.ShadowMirror`` — shadow routing (ISSUE 10): mirror a
+  fraction of trusted traffic to the undecided canary off the client's
+  critical path, diff the embedding sets per row (cosine drift), and
+  gate promotion on drift-p99 in addition to error rate;
 * ``worker.CheckpointWatcher`` — worker-side zero-downtime rollout:
   watch the crash-safe checkpoint dir, warm the ladder, swap
   atomically, roll back on router command;
@@ -70,6 +74,8 @@ _EXPORTS = {
     "ServingMetrics": "metrics",
     "FleetRouter": "router",
     "WorkerPool": "router",
+    "ShadowMirror": "shadow",
+    "cosine_drift": "shadow",
     "EmbeddingServer": "server",
     "CheckpointWatcher": "worker",
 }
@@ -102,8 +108,10 @@ __all__ = [
     "QueueFullError",
     "ServingFleet",
     "ServingMetrics",
+    "ShadowMirror",
     "SizeHistogram",
     "WorkerPool",
+    "cosine_drift",
     "expected_padded_rows",
     "optimize_ladder",
 ]

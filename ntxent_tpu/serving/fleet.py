@@ -44,6 +44,7 @@ import urllib.error
 import urllib.request
 from pathlib import Path
 
+from ..obs import events as obs_events
 from ..obs.registry import MetricsRegistry
 from ..resilience.retry import RetryPolicy
 from .router import WorkerPool
@@ -190,6 +191,9 @@ class ServingFleet:
             self._schedule_restart(worker, f"spawn failed: {e}")
             return
         self._spawns.inc()
+        obs_events.emit("fleet", action="spawn",
+                        worker=worker.worker_id, pid=worker.proc.pid,
+                        restarts=worker.restarts)
         logger.info("fleet: spawned %s (pid %d)", worker.worker_id,
                     worker.proc.pid)
 
@@ -218,6 +222,11 @@ class ServingFleet:
                                            self.backoff.max_attempts))
         worker.restart_at = time.monotonic() + delay
         self._worker_restarts.inc()
+        obs_events.emit("fleet", action="restart_scheduled",
+                        worker=worker.worker_id, reason=reason,
+                        restart=worker.restarts,
+                        max_restarts=self.max_restarts,
+                        delay_s=round(delay, 3))
         logger.warning("fleet: %s down (%s) — restart %d/%d in %.2fs",
                        worker.worker_id, reason, worker.restarts,
                        self.max_restarts, delay)
@@ -337,8 +346,18 @@ class ServingFleet:
                     rc = worker.proc.poll()
                     self.pool.set_health(worker.worker_id, alive=False,
                                          ready=False)
+                    obs_events.emit("fleet", action="death",
+                                    worker=worker.worker_id, rc=rc)
                     self._schedule_restart(worker, f"exited rc={rc}")
                     worker.proc = None
+                    # Flight dump AT the death (ISSUE 10): the event
+                    # tail — health probes, the death, the scheduled
+                    # restart — is the postmortem, captured now rather
+                    # than reconstructed. No-op without an installed
+                    # event log.
+                    obs_events.dump_flight(
+                        reason=f"worker_death:{worker.worker_id}:"
+                               f"rc={rc}")
                 if worker.restart_at is not None \
                         and now >= worker.restart_at:
                     self._spawn(worker)
@@ -353,11 +372,17 @@ class ServingFleet:
                     "fleet: ejecting %s after %d consecutive failures "
                     "(last: %s)", worker.worker_id,
                     entry.consecutive_failures, entry.last_error)
+                obs_events.emit("fleet", action="eject",
+                                worker=worker.worker_id,
+                                failures=entry.consecutive_failures,
+                                last_error=entry.last_error)
                 self.pool.set_health(worker.worker_id, alive=False,
                                      ready=False)
                 self._kill(worker)
                 self._schedule_restart(worker, "ejected")
                 worker.proc = None
+                obs_events.dump_flight(
+                    reason=f"worker_eject:{worker.worker_id}")
 
     def _run(self) -> None:
         while not self._stop.wait(self.poll_s):

@@ -317,15 +317,26 @@ class ServingMetrics:
     def observe_request_size(self, rows: int) -> None:
         """One device-chunk row count into the request-size histogram
         (labeled cumulative counters — the Prometheus/JSON-visible view
-        of the distribution the adaptive ladder optimizes against)."""
-        rows = int(rows)
+        of the distribution the adaptive ladder optimizes against).
+
+        The ``rows`` label is the POWER-OF-TWO CEILING of the real
+        count, not the count itself (ISSUE 10 satellite): raw counts
+        mint one series per distinct size, so an adversarial sweep of
+        1..max_request_rows would bloat every scrape for the lifetime
+        of the process. Pow2 bucketing caps cardinality at
+        log2(max-rows) series while keeping the shape the ladder story
+        needs; the optimizer's own decayed histogram (serving/
+        ladder.py) still sees exact sizes — only the export buckets.
+        """
+        bucket = 1 << max(0, int(rows) - 1).bit_length()
         with self._size_lock:
-            counter = self._sizes.get(rows)
+            counter = self._sizes.get(bucket)
             if counter is None:
-                counter = self._sizes[rows] = self.registry.counter(
+                counter = self._sizes[bucket] = self.registry.counter(
                     "serving_request_size_total",
-                    "device chunks by real row count",
-                    labels={"rows": str(rows)})
+                    "device chunks by real row count "
+                    "(pow2-ceiling buckets)",
+                    labels={"rows": str(bucket)})
         counter.inc()
 
     # -- adaptive ladder (ISSUE 9) ---------------------------------------

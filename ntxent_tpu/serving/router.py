@@ -65,9 +65,11 @@ from urllib.parse import urlparse
 
 import numpy as np
 
+from ..obs import events as _events
 from ..obs import trace as _trace
 from ..obs.exporters import PROMETHEUS_CONTENT_TYPE, choose_format
 from ..obs.registry import MetricsRegistry
+from ..obs.slo import AlertStore
 from .cache import EmbeddingCache
 from .limits import MAX_BODY_BYTES
 
@@ -135,6 +137,8 @@ class WorkerPool:
     def __init__(self, canary_fraction: float = 0.25,
                  canary_min_requests: int = 20,
                  canary_max_error_rate: float = 0.1,
+                 shadow_max_drift: float | None = None,
+                 shadow_min_samples: int = 8,
                  registry: MetricsRegistry | None = None):
         if not 0.0 < canary_fraction <= 1.0:
             raise ValueError(f"canary_fraction must be in (0, 1], got "
@@ -142,6 +146,14 @@ class WorkerPool:
         self.canary_fraction = float(canary_fraction)
         self.canary_min_requests = int(canary_min_requests)
         self.canary_max_error_rate = float(canary_max_error_rate)
+        # Shadow drift gate (ISSUE 10): when set, a canary may only
+        # promote once its mirrored-traffic drift p99 is at or under
+        # this bound (see serving/shadow.py); a breach rolls back even
+        # with a clean error rate.
+        self.shadow_max_drift = (float(shadow_max_drift)
+                                 if shadow_max_drift is not None
+                                 else None)
+        self.shadow_min_samples = int(shadow_min_samples)
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self._lock = threading.Lock()
@@ -156,6 +168,12 @@ class WorkerPool:
         self._canary_step: int | None = None
         self._canary_ok = 0
         self._canary_err = 0
+        self._canary_drift: list[float] = []
+        # What the last promote/rollback verdict was based on — the
+        # router's alert path reads this right after observe()/
+        # observe_drift() returns a decision (the decision tuple
+        # itself stays (action, step): existing consumers unpack it).
+        self.last_verdict: dict = {}
         self._rr = 0  # request counter driving the canary fraction
         r = self.registry
         self._ready_gauge = r.gauge("fleet_workers_ready",
@@ -179,6 +197,10 @@ class WorkerPool:
         self._rollbacks = r.counter(
             "fleet_rollbacks_total",
             "canary steps rolled back on error-rate breach")
+        self._shadow_breaches = r.counter(
+            "fleet_shadow_breaches_total",
+            "canary rollbacks forced by the drift bar "
+            "(error rate alone would have promoted)")
 
     # -- membership / health (the fleet supervisor's surface) -------------
     def upsert(self, worker_id: str, url: str) -> WorkerEntry:
@@ -303,6 +325,7 @@ class WorkerPool:
                 if self._canary_step != newest:
                     self._canary_step = newest
                     self._canary_ok = self._canary_err = 0
+                    self._canary_drift = []
             else:
                 self._canary_step = None
             canaries = [w for w in ready
@@ -333,6 +356,26 @@ class WorkerPool:
             if entry is not None and entry.inflight > 0:
                 entry.inflight -= 1
 
+    def canary_step(self) -> int | None:
+        """The undecided canary step, if any (the shadow mirror's
+        arming check)."""
+        with self._lock:
+            return self._canary_step
+
+    def pick_step(self, step: int) -> WorkerEntry | None:
+        """Least-in-flight ready worker AT a specific checkpoint step
+        (the shadow mirror's canary target selection); None when no
+        such worker is ready. Increments inflight (caller must
+        ``done``)."""
+        with self._lock:
+            cohort = [w for w in self._workers.values()
+                      if w.ready and w.checkpoint_step == step]
+            if not cohort:
+                return None
+            entry = min(cohort, key=lambda w: (w.inflight, w.worker_id))
+            entry.inflight += 1
+            return entry
+
     def allow_cache_insert(self, served_step: int | None) -> bool:
         """Only embeddings from the TRUSTED model may enter the cache:
         no inserts while a canary is undecided (a canary model's
@@ -347,11 +390,49 @@ class WorkerPool:
             return served_step == self.trusted_step
 
     # -- canary accounting -------------------------------------------------
+    def _drift_p99_locked(self) -> float | None:
+        if not self._canary_drift:
+            return None
+        from ..obs.registry import quantile
+
+        return quantile(sorted(self._canary_drift), 0.99)
+
+    def _decide_locked(self, promote: bool,
+                       verdict: dict) -> tuple[str, int]:
+        """Finalize the pending canary (lock held): reset the verdict
+        state and apply the decision. ``verdict`` lands in
+        ``last_verdict`` for the router's alert path."""
+        decided = self._canary_step
+        self._canary_step = None
+        self._canary_ok = self._canary_err = 0
+        self._canary_drift = []
+        self.last_verdict = {"step": decided, **verdict}
+        if promote:
+            self.trusted_step = decided
+            self._trusted_gauge.set(decided)
+            self._promotions.inc()
+            logger.info("canary: promoted step %d (%s)", decided,
+                        verdict)
+            return ("promote", decided)
+        self.bad_steps.add(decided)
+        self._rollbacks.inc()
+        logger.warning("canary: BREACH on step %d (%s) — rolling back",
+                       decided, verdict)
+        return ("rollback", decided)
+
     def observe(self, worker_id: str, step: int | None,
                 ok: bool) -> tuple[str, int] | None:
-        """Record one forwarded outcome. Returns ``("promote", step)``,
+        """Record one forwarded outcome (live canary traffic and
+        shadow mirrors alike). Returns ``("promote", step)``,
         ``("rollback", step)``, or None. 429s must NOT be reported here
-        (saturation is not model quality)."""
+        (saturation is not model quality).
+
+        With a drift bar configured (``shadow_max_drift``), the
+        error-rate bar alone cannot promote: the verdict DEFERS until
+        ``shadow_min_samples`` mirrored rows have been diffed (up to a
+        cap — a fleet whose mirror produces nothing, e.g. shadow
+        disabled or the canary shedding every mirror, must not pin an
+        undecided canary forever)."""
         with self._lock:
             if (self._canary_step is None or step is None
                     or step != self._canary_step):
@@ -366,24 +447,78 @@ class WorkerPool:
             if total < self.canary_min_requests:
                 return None
             rate = self._canary_err / total
-            decided = self._canary_step
-            self._canary_step = None
-            self._canary_ok = self._canary_err = 0
-            if rate <= self.canary_max_error_rate:
-                self.trusted_step = decided
-                self._trusted_gauge.set(decided)
-                self._promotions.inc()
-                logger.info("canary: promoted step %d (error rate "
-                            "%.3f over %d requests)", decided, rate,
-                            total)
-                return ("promote", decided)
-            self.bad_steps.add(decided)
-            self._rollbacks.inc()
-            logger.warning("canary: BREACH on step %d (error rate %.3f "
-                           "> %.3f over %d requests) — rolling back",
-                           decided, rate, self.canary_max_error_rate,
-                           total)
-            return ("rollback", decided)
+            if rate > self.canary_max_error_rate:
+                return self._decide_locked(False, {
+                    "reason": "error_rate", "error_rate": round(rate, 4),
+                    "bar": self.canary_max_error_rate,
+                    "requests": total})
+            if self.shadow_max_drift is not None:
+                n = len(self._canary_drift)
+                # Floor of 1: a percentile needs at least one sample —
+                # min_samples=0 must mean "judge as soon as anything
+                # arrives", never "judge an empty distribution".
+                if n < max(1, self.shadow_min_samples):
+                    if total < self.canary_min_requests * 4:
+                        return None  # defer: wait for mirrored rows
+                    logger.warning(
+                        "canary: promoting step %d on error rate alone "
+                        "— only %d/%d drift samples arrived after %d "
+                        "outcomes (is the shadow mirror running?)",
+                        self._canary_step, n, self.shadow_min_samples,
+                        total)
+                    return self._decide_locked(True, {
+                        "reason": "error_rate_only",
+                        "error_rate": round(rate, 4),
+                        "drift_samples": n, "requests": total})
+                p99 = self._drift_p99_locked()
+                if p99 > self.shadow_max_drift:
+                    self._shadow_breaches.inc()
+                    return self._decide_locked(False, {
+                        "reason": "shadow_drift",
+                        "drift_p99": round(p99, 6),
+                        "bar": self.shadow_max_drift,
+                        "drift_samples": n, "requests": total})
+                return self._decide_locked(True, {
+                    "reason": "error_rate+drift",
+                    "error_rate": round(rate, 4),
+                    "drift_p99": round(p99, 6),
+                    "drift_samples": n, "requests": total})
+            return self._decide_locked(True, {
+                "reason": "error_rate", "error_rate": round(rate, 4),
+                "requests": total})
+
+    def observe_drift(self, step: int | None,
+                      samples: list[float]) -> tuple[str, int] | None:
+        """Record mirrored-row drift samples for the undecided canary
+        (serving/shadow.py). An already-over-the-bar p99 rolls back
+        IMMEDIATELY — a drifted model must not keep taking canary
+        traffic while the error-rate count ambles toward its minimum.
+        Returns a decision tuple or None."""
+        if not samples:
+            return None
+        with self._lock:
+            if (self._canary_step is None or step is None
+                    or step != self._canary_step):
+                return None
+            self._canary_drift.extend(float(s) for s in samples)
+            # Bounded: the verdict needs a recent distribution, not
+            # an unbounded history.
+            if len(self._canary_drift) > 4096:
+                self._canary_drift = self._canary_drift[-4096:]
+            if self.shadow_max_drift is None:
+                return None
+            n = len(self._canary_drift)
+            if n < max(1, self.shadow_min_samples):
+                return None
+            p99 = self._drift_p99_locked()
+            if p99 > self.shadow_max_drift:
+                self._shadow_breaches.inc()
+                return self._decide_locked(False, {
+                    "reason": "shadow_drift",
+                    "drift_p99": round(p99, 6),
+                    "bar": self.shadow_max_drift,
+                    "drift_samples": n})
+            return None
 
     # -- readers -----------------------------------------------------------
     def workers(self) -> list[WorkerEntry]:
@@ -405,6 +540,9 @@ class WorkerPool:
                 "bad_steps": sorted(self.bad_steps),
                 "canary_step": self._canary_step,
                 "canary_fraction": self.canary_fraction,
+                "shadow_max_drift": self.shadow_max_drift,
+                "canary_drift_samples": len(self._canary_drift),
+                "last_verdict": dict(self.last_verdict),
             }
 
 
@@ -475,9 +613,36 @@ class FleetRouter:
                                labels={"stage": stage})
             for stage in ("total", "forward")
         }
+        # Fleet observability plane (ISSUE 10): all optional — a bare
+        # router (tests, bench) behaves exactly as before.
+        self.run_id: str | None = None
+        self.shadow = None          # ShadowMirror (attach_shadow)
+        self.aggregator = None      # obs.FleetAggregator -> /metrics/fleet
+        self.alerts = AlertStore(registry=self.registry)  # -> /alerts
         self._httpd: ThreadingHTTPServer | None = None
         self._http_thread: threading.Thread | None = None
         self._shutdown = threading.Event()
+
+    def set_run_id(self, run_id: str | None) -> None:
+        """Stamp the router's own run identity (ISSUE 10 satellite):
+        the same ``serving_run_info`` info-metric pattern the workers
+        publish, so a federated scrape or a merged trace correlates
+        the router with its run — workers were labeled, the router
+        was anonymous."""
+        if not run_id:
+            return
+        self.run_id = str(run_id)
+        self.registry.gauge(
+            "serving_run_info",
+            "router process identity (join key for cross-process "
+            "correlation)", labels={"run_id": self.run_id}).set(1)
+
+    def attach_shadow(self, mirror) -> None:
+        """Wire a ShadowMirror: the router offers every successful
+        trusted forward to it, and its verdicts take effect through
+        the same decision path a live canary outcome uses."""
+        self.shadow = mirror
+        mirror.on_decision = self._handle_decision
 
     def _reject(self, reason: str) -> None:
         with self._reject_lock:
@@ -545,7 +710,36 @@ class FleetRouter:
         if decision is None:
             return
         action, step = decision
+        verdict = dict(self.pool.last_verdict)
+        if action == "promote":
+            # A promote is the all-clear for any standing rollback
+            # alert: the fleet accepted a successor model.
+            if self.alerts.resolve("canary_rollback",
+                                   reason=f"step {step} promoted"):
+                _events.emit("alert", slo="canary_rollback",
+                             state="resolved", kind="canary",
+                             step=step)
         if action == "rollback":
+            # A rollback IS an alert (ISSUE 10): typed event on the
+            # JSONL stream, an /alerts entry, and a flight dump so the
+            # postmortem tail (canary outcomes, shadow spans, the
+            # breach itself) is captured AT the verdict. ONE fixed
+            # alert name — the step rides the record's fields; a
+            # per-step name would mint unbounded slo_alerts_total
+            # label cardinality and an ever-growing firing set (the
+            # same cardinality bug this PR fixes for request sizes).
+            reason = verdict.get("reason", "canary_breach")
+            self.alerts.fire("canary_rollback",
+                             reason=reason,
+                             value=verdict.get("drift_p99",
+                                               verdict.get("error_rate")),
+                             threshold=verdict.get("bar"), step=step)
+            _events.emit("alert", slo="canary_rollback",
+                         state="firing", kind="canary", step=step,
+                         **{k: v for k, v in verdict.items()
+                            if k != "step"})
+            _events.dump_flight(reason=f"canary_rollback:step{step}:"
+                                       f"{reason}")
             # Broadcast off the request thread: the verdict fires
             # inside the handler of whichever client request tripped
             # the breach, and serial /rollback POSTs (up to
@@ -755,6 +949,14 @@ class FleetRouter:
             self.pool.report_success(entry.worker_id)
             self._handle_decision(
                 self.pool.observe(entry.worker_id, step, ok=True))
+            if (self.shadow is not None and status == 200
+                    and "embeddings" in result):
+                # Off the critical path by construction: offer() only
+                # enqueues (the mirror thread does the canary POST and
+                # the diff). The embeddings ride as the parsed list —
+                # the mirror converts once, on its own thread.
+                self.shadow.offer(body, rid, step,
+                                  result["embeddings"])
             return status, result, None, step
         if last_5xx is not None:
             worker_id, code, detail = last_5xx
@@ -783,6 +985,7 @@ class FleetRouter:
     # -- metrics -----------------------------------------------------------
     def metrics_dict(self) -> dict:
         out = {
+            "run_id": self.run_id,
             "requests": int(self._requests.value),
             "responses": int(self._responses.value),
             "cache_only_responses": int(self._cache_only.value),
@@ -795,6 +998,13 @@ class FleetRouter:
         }
         if self.cache is not None:
             out["cache"] = self.cache.snapshot()
+        if self.shadow is not None:
+            out["shadow"] = self.shadow.snapshot()
+        if self.aggregator is not None:
+            out["federation"] = self.aggregator.snapshot()
+        firing = self.alerts.active()
+        if firing:
+            out["alerts_firing"] = [a["name"] for a in firing]
         return out
 
 
@@ -832,17 +1042,50 @@ def _make_router_handler(router: FleetRouter):
                                     self.headers.get("Accept"),
                                     default="json")
                 if fmt == "prometheus":
-                    body = router.registry.render_prometheus().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     PROMETHEUS_CONTENT_TYPE)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._reply_prometheus(
+                        router.registry.render_prometheus())
+                elif fmt == "state":
+                    # The federation scrape view (obs/aggregate.py):
+                    # raw registry state, histogram windows included,
+                    # so a federating replica router can merge THIS
+                    # router like any worker.
+                    self._reply(200, router.registry.dump_state())
                 else:
                     self._reply(200, router.metrics_dict())
+            elif route == "/metrics/fleet":
+                # The federated view (ISSUE 10): one merged scrape for
+                # the whole fleet — workers + this router. Default is
+                # Prometheus text (this endpoint exists FOR scrapers);
+                # ?format=json returns the same merged registry's
+                # collect() dict.
+                if router.aggregator is None:
+                    self._reply(503, {"error": "no federation "
+                                               "aggregator attached"})
+                    return
+                merged = router.aggregator.merged(max_age_s=30.0)
+                fmt = choose_format(self.path,
+                                    self.headers.get("Accept"),
+                                    default="prometheus")
+                if fmt == "json":
+                    self._reply(200, merged.collect())
+                elif fmt == "state":
+                    self._reply(200, merged.dump_state())
+                else:
+                    self._reply_prometheus(merged.render_prometheus())
+            elif route == "/alerts":
+                # SLO + canary-verdict breaches (obs/slo.py): active
+                # alerts and the recent history ring.
+                self._reply(200, router.alerts.snapshot())
             else:
                 self._reply(404, {"error": f"no route {self.path!r}"})
+
+        def _reply_prometheus(self, text: str) -> None:
+            body = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def do_POST(self):  # noqa: N802
             rid = (self.headers.get("X-Request-Id")

@@ -315,6 +315,13 @@ def _make_handler(server: EmbeddingServer):
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif fmt == "state":
+                    # Raw-registry federation view (ISSUE 10): what the
+                    # router's FleetAggregator scrapes — histogram
+                    # windows included, so fleet percentiles pool the
+                    # exact samples instead of averaging percentiles.
+                    self._reply(200,
+                                server.metrics.registry.dump_state())
                 else:
                     self._reply(200, server.metrics.to_dict())
             else:

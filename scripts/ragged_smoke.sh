@@ -209,7 +209,9 @@ assert m["compile"]["compiles"] == compiles_after_warmup, \
 assert m["ladder"]["compiles"] >= 3, m["ladder"]
 assert m["errors"] == 0, m["errors"]
 # 4) observability surfaces live in both views.
-assert m["request_sizes"]["3"] > 0 and m["request_sizes"]["7"] > 0
+# Export labels are pow2-ceiling buckets (ISSUE 10): sizes 3 -> "4",
+# 7 -> "8".
+assert m["request_sizes"]["4"] > 0 and m["request_sizes"]["8"] > 0
 assert m["buckets"]["16"]["padding_waste"] is not None
 with urllib.request.urlopen(base + "/metrics?format=prometheus",
                             timeout=15) as r:

@@ -1,0 +1,344 @@
+#!/usr/bin/env bash
+# Shadow smoke: the ISSUE 10 drift drill in <60 s on CPU. Boots a
+# 2-worker ntxent-fleet with shadow routing + SLO engine + federation
+# on a real 2-step checkpoint, then drives BOTH canary verdicts
+# end-to-end over HTTP:
+#   * identical weights  — the seed checkpoint re-saved as step 3: the
+#     canary's mirrored-traffic drift is ~0, the verdict PROMOTES;
+#   * perturbed weights  — the same params + gaussian noise saved as
+#     step 4: every mirrored row diffs hard, fleet_shadow_drift p99
+#     blows the --shadow-max-drift bar, and the verdict ROLLS BACK
+#     with a typed alert event and a flight-recorder dump (the canary
+#     answers 200 throughout — the error-rate bar alone would have
+#     promoted this model).
+# Then the observability-plane assertions: /metrics/fleet federated
+# counters equal the sum of per-worker scrapes, /alerts carries the
+# breach, and `ntxent-trace --merge` stitches router + worker JSONLs
+# into ONE validated Perfetto trace with a process lane per file and
+# at least one request whose router and worker spans share an id.
+# Any 5xx, hang, or failed assertion exits nonzero.
+# Pairs with `pytest -m shadow` / `pytest -m slo` (the same tier
+# asserted in-process).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+t_start=$SECONDS
+
+workdir="$(mktemp -d)"
+fleet_pid=""
+cleanup() {
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "--- fleet log tail (rc=$rc) ---" >&2
+        tail -40 "$workdir/fleet.log" >&2 2>/dev/null || true
+        for wlog in "$workdir"/fleet/w*.log; do
+            [ -f "$wlog" ] || continue
+            echo "--- $(basename "$wlog") tail ---" >&2
+            tail -15 "$wlog" >&2
+        done
+    fi
+    [ -n "$fleet_pid" ] && kill "$fleet_pid" 2>/dev/null || true
+    [ -n "$fleet_pid" ] && wait "$fleet_pid" 2>/dev/null || true
+    rm -rf "$workdir"
+}
+trap cleanup EXIT
+
+ckpt="$workdir/ckpt"
+
+# Phase 0 — a real checkpoint (step 2) for the workers to restore.
+JAX_PLATFORMS=cpu python -m ntxent_tpu.cli --platform cpu \
+    --dataset synthetic --synthetic-samples 64 --image-size 8 \
+    --model tiny --proj-hidden-dim 16 --proj-dim 8 --batch 8 \
+    --warmup-steps 1 --seed 0 --ckpt-dir "$ckpt" --ckpt-every 1 \
+    --log-every 1 --steps 2 >"$workdir/train0.log" 2>&1 \
+    || { echo "seed training failed:"; tail -20 "$workdir/train0.log"; exit 1; }
+
+# Phase 1 — the fleet: 2 workers, shadow fraction 1 (every trusted
+# request mirrors), tight drift bar, SLO engine + federation on, JSONL
+# everywhere (the merge-trace input). canary-min-requests is set high
+# enough that the ERROR-RATE bar alone can never decide before the
+# drift gate has its samples — the drift verdict is the one under test.
+port_file="$workdir/router.port"
+JAX_PLATFORMS=cpu python -c \
+    'import sys; from ntxent_tpu.cli import fleet_main; sys.exit(fleet_main(sys.argv[1:]))' \
+    --platform cpu --model tiny --image-size 8 --proj-hidden-dim 16 \
+    --proj-dim 8 --ckpt-dir "$ckpt" --workers 2 --buckets 1,4 \
+    --max-delay-ms 10 --queue-size 32 --watch-poll 0.25 \
+    --worker-stagger 1 --health-poll 0.25 --canary-fraction 0.5 \
+    --canary-min-requests 6 --shadow-fraction 1.0 \
+    --shadow-max-drift 0.05 --shadow-min-samples 4 \
+    --slo-drift 0.05 --slo-fast-window 2 --slo-slow-window 6 \
+    --fed-interval 0.5 --no-cache --port 0 --port-file "$port_file" \
+    --workdir "$workdir/fleet" --run-id shadowsmoke \
+    --log-jsonl "$workdir/router.jsonl" \
+    >"$workdir/fleet.log" 2>&1 &
+fleet_pid=$!
+
+for _ in $(seq 120); do
+    [ -s "$port_file" ] && break
+    kill -0 "$fleet_pid" 2>/dev/null || { echo "fleet died:"; tail -20 "$workdir/fleet.log"; exit 1; }
+    sleep 0.5
+done
+[ -s "$port_file" ] || { echo "router never bound:"; tail -20 "$workdir/fleet.log"; exit 1; }
+port="$(cat "$port_file")"
+
+# Wait for both workers to restore the seed step and pass /readyz.
+JAX_PLATFORMS=cpu python - "$port" <<'PY'
+import json, sys, time, urllib.request
+port = sys.argv[1]
+deadline = time.monotonic() + 90
+while time.monotonic() < deadline:
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+            h = json.loads(r.read())
+        if h.get("workers_ready") == 2 and h.get("trusted_step") == 2:
+            sys.exit(0)
+    except OSError:
+        pass
+    time.sleep(0.5)
+sys.exit("workers never became ready on the seed step")
+PY
+
+# Phase 2 — craft the two canaries straight into the checkpoint dir:
+# step 3 = the seed weights VERBATIM (drift ~0 -> promote), later
+# step 4 = the same weights + noise (drift >> bar -> rollback).
+save_step() {  # $1 = step to save, $2 = "clean" | "perturbed"
+    JAX_PLATFORMS=cpu python - "$ckpt" "$1" "$2" <<'PY'
+import sys
+import jax, jax.numpy as jnp
+import numpy as np
+from ntxent_tpu.cli import _make_encoder
+from ntxent_tpu.models import SimCLRModel
+from ntxent_tpu.training import TrainerConfig, create_train_state
+from ntxent_tpu.training.checkpoint import CheckpointManager
+
+ckpt_dir, step, mode = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+encoder = _make_encoder("tiny", 8)
+model = SimCLRModel(encoder=encoder, proj_hidden_dim=16, proj_dim=8)
+template = create_train_state(model, jax.random.PRNGKey(0),
+                              (1, 8, 8, 3), TrainerConfig())
+manager = CheckpointManager(ckpt_dir, max_to_keep=None)
+try:
+    state = manager.restore(template, step=2)
+    if mode == "perturbed":
+        # Gaussian noise at half each leaf's own scale: a model that
+        # still answers 200 but embeds SOMEWHERE ELSE — exactly the
+        # regression the error-rate canary cannot see.
+        leaves, treedef = jax.tree_util.tree_flatten(state.params)
+        rng = np.random.RandomState(7)
+        noised = []
+        for leaf in leaves:
+            arr = np.asarray(leaf)
+            scale = 0.5 * (np.abs(arr).mean() + 0.1)
+            noised.append(jnp.asarray(
+                arr + rng.normal(0.0, scale, arr.shape)
+                .astype(arr.dtype)))
+        state = state.replace(
+            params=jax.tree_util.tree_unflatten(treedef, noised))
+    state = state.replace(step=step)
+    manager.save(step, state, force=True)
+    manager.wait_until_finished()
+finally:
+    manager.close()
+print(f"saved {mode} checkpoint as step {step}")
+PY
+}
+
+save_step 3 clean
+
+# Phase 3 — load + promote verdict: unique-row traffic mirrors to the
+# step-3 canary; identical weights => drift ~0 => promote.
+JAX_PLATFORMS=cpu python - "$port" <<'PY'
+import json, sys, time, urllib.error, urllib.request
+port = sys.argv[1]
+base = f"http://127.0.0.1:{port}"
+
+
+def get(path):
+    with urllib.request.urlopen(base + path, timeout=15) as r:
+        return json.loads(r.read())
+
+
+def post(i, rows=2):
+    v = round(i * 1e-6, 6)
+    body = json.dumps({"inputs": [[[[v] * 3] * 8] * 8] * rows,
+                       "timeout_ms": 20000}).encode()
+    req = urllib.request.Request(base + "/embed", data=body,
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=25) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+
+
+codes = {}
+deadline = time.monotonic() + 60
+i = 0
+while time.monotonic() < deadline:
+    i += 1
+    code = post(i)
+    codes[code] = codes.get(code, 0) + 1
+    assert code in (200, 429), f"client-visible failure: {code}"
+    h = get("/healthz")
+    if h.get("trusted_step") == 3:
+        break
+    time.sleep(0.05)
+assert get("/healthz")["trusted_step"] == 3, \
+    f"clean canary never promoted: {get('/metrics')}"
+m = get("/metrics")
+verdict = m["last_verdict"]
+assert verdict["step"] == 3 and "drift" in verdict["reason"], verdict
+assert verdict["drift_p99"] <= 0.05, verdict
+shadow = m["shadow"]
+assert shadow["mirrored"] > 0, shadow
+print(f"promote: OK — step 3 trusted after {i} requests "
+      f"({codes}), drift_p99={verdict['drift_p99']}, "
+      f"mirrored={shadow['mirrored']}")
+PY
+
+save_step 4 perturbed
+
+# Phase 4 — drift breach: the step-4 canary answers 200 but embeds
+# elsewhere; mirrored rows blow the bar; rollback + alert + flight.
+JAX_PLATFORMS=cpu python - "$port" "$workdir" <<'PY'
+import json, sys, time, urllib.error, urllib.request
+from pathlib import Path
+port, workdir = sys.argv[1], Path(sys.argv[2])
+base = f"http://127.0.0.1:{port}"
+
+
+def get(path):
+    with urllib.request.urlopen(base + path, timeout=15) as r:
+        return json.loads(r.read())
+
+
+def post(i, rows=2):
+    v = round(500000 + i * 1e-6, 6)
+    body = json.dumps({"inputs": [[[[v] * 3] * 8] * 8] * rows,
+                       "timeout_ms": 20000}).encode()
+    req = urllib.request.Request(base + "/embed", data=body,
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=25) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+
+
+codes = {}
+deadline = time.monotonic() + 90
+i = 0
+rolled = False
+while time.monotonic() < deadline:
+    i += 1
+    code = post(i)
+    codes[code] = codes.get(code, 0) + 1
+    assert code in (200, 429), f"client-visible failure: {code}"
+    m = get("/metrics")
+    if 4 in (m.get("bad_steps") or []):
+        rolled = True
+        break
+    time.sleep(0.05)
+assert rolled, f"perturbed canary never rolled back: {get('/metrics')}"
+m = get("/metrics")
+assert m["trusted_step"] == 3, m["trusted_step"]
+verdict = m["last_verdict"]
+assert verdict["reason"] == "shadow_drift", verdict
+assert verdict["drift_p99"] > 0.05, verdict
+
+# The alert surfaced on /alerts (fixed name; the step rides the
+# record)...
+alerts = get("/alerts")
+assert "canary_rollback" in alerts["firing"], alerts
+assert any(a.get("step") == 4 for a in alerts["active"]), alerts
+# ...and the flight recorder dumped the breach tail.
+deadline = time.monotonic() + 10
+while time.monotonic() < deadline and \
+        not list(workdir.glob("flight_*.jsonl")):
+    time.sleep(0.25)
+flights = list(workdir.glob("flight_*.jsonl"))
+assert flights, "no flight dump on the drift rollback"
+header = json.loads(flights[0].read_text().splitlines()[0])
+assert header["reason"].startswith("canary_rollback:step4"), header
+
+# Federated scrape: fleet counter totals == sum of worker scrapes.
+# Traffic has stopped (rollback ended the loop; no canary = no
+# mirrors); two federation ticks settle the merged view first.
+time.sleep(1.5)
+with urllib.request.urlopen(base + "/metrics/fleet", timeout=15) as r:
+    fed = {}
+    for line in r.read().decode().splitlines():
+        if line and not line.startswith("#"):
+            key, _, val = line.rpartition(" ")
+            fed[key] = float(val)
+worker_sum = 0
+for pf in sorted((workdir / "fleet").glob("w*.port")):
+    wport = int(pf.read_text().strip())
+    wm = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{wport}/metrics", timeout=15).read())
+    worker_sum += wm["requests"]
+assert fed.get("serving_requests_total") == worker_sum, \
+    (fed.get("serving_requests_total"), worker_sum)
+assert fed.get("fleet_shadow_drift_count", 0) > 0, sorted(fed)[:20]
+# The router's own run identity federates like any worker's (gauges
+# re-label with instance=...).
+assert any(k.startswith("serving_run_info")
+           and 'run_id="shadowsmoke"' in k
+           and 'instance="router"' in k for k in fed), \
+    "router run_info missing from the federated scrape"
+print(f"rollback: OK — step 4 blocklisted after {i} requests "
+      f"({codes}), drift_p99={verdict['drift_p99']:.3f}, "
+      f"alert firing, flight={flights[0].name}, "
+      f"federated requests={int(worker_sum)}")
+PY
+
+kill "$fleet_pid"
+wait "$fleet_pid" 2>/dev/null || true
+fleet_pid=""
+
+# Phase 5 — cross-process trace stitching: router + worker JSONLs of
+# the run above merge into ONE validated Chrome trace with a process
+# lane per file and at least one request whose router-side and
+# worker-side spans share an id.
+JAX_PLATFORMS=cpu python - "$workdir" <<'PY'
+import json, subprocess, sys
+from pathlib import Path
+from ntxent_tpu.obs.trace import validate_chrome_trace
+
+workdir = Path(sys.argv[1])
+logs = [workdir / "router.jsonl"] + \
+    sorted((workdir / "fleet").glob("w*.jsonl"))
+assert len(logs) >= 3, logs
+out = workdir / "fleet_trace.json"
+proc = subprocess.run(
+    [sys.executable, "-m", "ntxent_tpu.obs.trace", "--merge",
+     *[str(p) for p in logs], "-o", str(out)],
+    capture_output=True, text=True, timeout=120)
+assert proc.returncode == 0, proc.stderr + proc.stdout
+trace = json.loads(out.read_text())
+n = validate_chrome_trace(trace)
+events = trace["traceEvents"]
+lanes = {e["pid"] for e in events if e.get("ph") != "M"}
+assert len(lanes) >= 2, f"expected >=2 process lanes, got {lanes}"
+by_rid = {}
+for e in events:
+    rid = e.get("args", {}).get("request_id")
+    if rid:
+        by_rid.setdefault(rid, set()).add(e["pid"])
+stitched = [rid for rid, pids in by_rid.items() if len(pids) >= 2]
+assert stitched, "no request with spans in two processes"
+names = {e["args"]["name"] for e in events
+         if e.get("ph") == "M" and e["name"] == "process_name"}
+assert "router" in names, names
+print(f"trace merge: OK — {n} events, {len(lanes)} process lanes "
+      f"({sorted(names)}), {len(stitched)} cross-process requests")
+PY
+
+elapsed=$((SECONDS - t_start))
+echo "shadow smoke: OK (${elapsed}s)"
+if [ "$elapsed" -ge 60 ]; then
+    echo "shadow smoke: WARNING — exceeded the 60 s CPU budget" >&2
+fi

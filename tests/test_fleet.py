@@ -1630,3 +1630,186 @@ class TestAttachMode:
             assert worker.alive()
         finally:
             primary.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet observability plane (ISSUE 10): federation endpoint, run_info,
+# flight dumps on worker death/ejection
+
+
+from ntxent_tpu import obs as _obs
+from ntxent_tpu.obs.aggregate import FleetAggregator
+from ntxent_tpu.obs.registry import MetricsRegistry
+
+
+def _get_router(router, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}{path}", timeout=15) as r:
+        ctype = r.headers.get("Content-Type", "")
+        body = r.read()
+    return ctype, body
+
+
+class TestFleetMetricsEndpoint:
+    def _worker_registry(self, n):
+        r = MetricsRegistry()
+        r.counter("serving_requests_total").inc(n)
+        return r
+
+    def test_metrics_fleet_equals_sum_of_worker_scrapes(self):
+        # The acceptance equality: the federated counter total IS the
+        # sum of the per-worker scrapes, served over the router's
+        # /metrics/fleet without any worker in the serving path.
+        regs = [self._worker_registry(n) for n in (11, 31)]
+        servers = [_obs.MetricsServer(r).start() for r in regs]
+        pool = WorkerPool()
+        router = FleetRouter(pool, example_shape=(2,), port=0)
+        router.aggregator = FleetAggregator(
+            lambda: {f"w{i}": f"http://127.0.0.1:{s.port}"
+                     for i, s in enumerate(servers)},
+            local={"router": router.registry})
+        router.start()
+        try:
+            ctype, body = _get_router(router, "/metrics/fleet")
+            assert "text/plain" in ctype  # a scrape endpoint
+            text = body.decode()
+            assert "serving_requests_total 42" in text
+            assert 'fleet_fed_instance_up{instance="w0"} 1' in text
+            # The router's own registry federates alongside workers.
+            assert "fleet_requests_total" in text
+            # JSON view of the same merged registry.
+            ctype, body = _get_router(router,
+                                      "/metrics/fleet?format=json")
+            assert json.loads(body)["serving_requests_total"] == 42
+            # A worker dying mid-scrape yields partial-but-valid (the
+            # satellite's not-a-500 clause) — stale marked, 200 served.
+            # (In production the background tick refreshes the view;
+            # here the test drives the tick itself.)
+            servers[1].close()
+            router.aggregator.scrape_once()
+            ctype, body = _get_router(router, "/metrics/fleet")
+            text = body.decode()
+            assert "serving_requests_total 42" in text  # last-good
+            assert 'fleet_fed_instance_up{instance="w1"} 0' in text
+        finally:
+            router.close()
+            for s in servers:
+                s.close()
+
+    def test_metrics_fleet_without_aggregator_is_503(self):
+        router = FleetRouter(WorkerPool(), example_shape=(2,), port=0)
+        router.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{router.port}/metrics/fleet")
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 503
+        finally:
+            router.close()
+
+    def test_router_state_format_and_run_info(self):
+        # ISSUE 10 satellite: the router serves the same raw-state
+        # federation view as workers and stamps its own run identity.
+        router = FleetRouter(WorkerPool(), example_shape=(2,), port=0)
+        router.set_run_id("cafe1234")
+        router.start()
+        try:
+            _, body = _get_router(router, "/metrics?format=state")
+            state = json.loads(body)
+            names = {m["name"] for m in state["metrics"]}
+            assert "fleet_requests_total" in names
+            info = [m for m in state["metrics"]
+                    if m["name"] == "serving_run_info"]
+            assert info and info[0]["labels"] == {"run_id": "cafe1234"}
+            _, body = _get_router(router, "/metrics?format=prometheus")
+            assert 'serving_run_info{run_id="cafe1234"} 1' \
+                in body.decode()
+            _, body = _get_router(router, "/metrics")
+            assert json.loads(body)["run_id"] == "cafe1234"
+        finally:
+            router.close()
+
+    def test_alerts_endpoint_serves_the_store(self):
+        router = FleetRouter(WorkerPool(), example_shape=(2,), port=0)
+        router.alerts.fire("availability", reason="burn", value=3.0)
+        router.start()
+        try:
+            _, body = _get_router(router, "/alerts")
+            snap = json.loads(body)
+            assert snap["firing"] == ["availability"]
+            assert snap["active"][0]["reason"] == "burn"
+        finally:
+            router.close()
+
+
+class TestFleetFlightRecorder:
+    def test_killworker_chaos_dumps_flight_with_restart_tail(
+            self, tmp_path):
+        # The ISSUE 10 satellite: a killworker@T round must leave a
+        # flight-recorder file whose tail shows the death and the
+        # scheduled restart — the postmortem captured AT the event.
+        log = _obs.EventLog(str(tmp_path / "fleet.jsonl"))
+        previous = _obs.install(log)
+        injector = FaultInjector(FaultPlan.parse("killworker@1"))
+        fleet = _fast_fleet(tmp_path, n=1, injector=injector)
+        worker = fleet.workers[0]
+        fleet._spawn(worker)
+        try:
+            assert _tick_until(
+                fleet, lambda: any(w.ready
+                                   for w in fleet.pool.workers()))
+            first_pid = worker.pid
+            # Next ticks: chaos arms (all ready), kills, death detected.
+            assert _tick_until(
+                fleet, lambda: worker.restarts >= 1, timeout_s=20.0)
+            assert injector.fired == ["killworker@1"]
+            flights = sorted(tmp_path.glob("flight_*.jsonl"))
+            assert flights, "no flight dump on worker death"
+            records = [json.loads(line) for f in flights
+                       for line in f.read_text().splitlines()]
+            assert records[0]["reason"].startswith("worker_death:w0")
+            fleet_recs = [r for r in records if r.get("event") == "fleet"]
+            actions = [r["action"] for r in fleet_recs]
+            assert "spawn" in actions
+            assert "death" in actions
+            assert "restart_scheduled" in actions
+            # The replacement actually comes back.
+            assert _tick_until(
+                fleet, lambda: any(w.ready
+                                   for w in fleet.pool.workers()),
+                timeout_s=20.0)
+            assert worker.pid != first_pid
+        finally:
+            _obs.install(previous)
+            log.close()
+            fleet.stop()
+
+    def test_ejection_dumps_flight(self, tmp_path):
+        log = _obs.EventLog(str(tmp_path / "fleet.jsonl"))
+        previous = _obs.install(log)
+        fleet = _fast_fleet(tmp_path, n=1, eject_after=2)
+        worker = fleet.workers[0]
+        fleet._spawn(worker)
+        try:
+            assert _tick_until(
+                fleet, lambda: any(w.ready
+                                   for w in fleet.pool.workers()))
+            # Router-reported forward failures push the worker over the
+            # eject threshold on the next tick.
+            fleet.pool.report_failure("w0", "http 500")
+            fleet.pool.report_failure("w0", "http 500")
+            assert _tick_until(fleet, lambda: worker.restarts >= 1)
+            flights = sorted(tmp_path.glob("flight_*.jsonl"))
+            assert flights
+            records = [json.loads(line) for f in flights
+                       for line in f.read_text().splitlines()]
+            assert any(r.get("reason", "").startswith("worker_eject:w0")
+                       for r in records)
+            eject = [r for r in records if r.get("event") == "fleet"
+                     and r.get("action") == "eject"]
+            assert eject and eject[0]["failures"] >= 2
+        finally:
+            _obs.install(previous)
+            log.close()
+            fleet.stop()

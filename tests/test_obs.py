@@ -607,3 +607,53 @@ class TestLoggingUtils:
                                    {"step": 3, "loss": 0.25}, (), None)
         out = KeyValueFormatter().format(record)
         assert "step=3" in out and "loss=0.25" in out
+
+
+# ---------------------------------------------------------------------------
+# federation raw-state export (ISSUE 10)
+
+
+class TestDumpState:
+    def test_state_carries_kind_labels_and_windows(self):
+        r = obs.MetricsRegistry()
+        r.counter("reqs", "help").inc(7)
+        r.gauge("depth", labels={"q": "main"}).set(3)
+        h = r.histogram("lat", labels={"stage": "total"}, window=4)
+        for v in (1.0, 2.0, 3.0, 4.0, 5.0):
+            h.observe(v)
+        state = r.dump_state()
+        by_name = {(m["name"], tuple(sorted(m["labels"].items()))): m
+                   for m in state["metrics"]}
+        c = by_name[("reqs", ())]
+        assert c["kind"] == "counter" and c["value"] == 7
+        g = by_name[("depth", (("q", "main"),))]
+        assert g["kind"] == "gauge" and g["value"] == 3
+        hist = by_name[("lat", (("stage", "total"),))]
+        assert hist["kind"] == "summary"
+        assert hist["count"] == 5 and hist["sum"] == 15.0
+        # The WINDOW (bounded, newest-last) rides along — the part a
+        # federator needs that collect()/prometheus drop.
+        assert hist["window"] == [2.0, 3.0, 4.0, 5.0]
+        assert hist["quantiles"] == [0.5, 0.95, 0.99]
+        # The state is JSON-serializable as-is (it crosses HTTP).
+        json.loads(json.dumps(state))
+
+    def test_choose_format_state_is_explicit_only(self):
+        # No Accept header may switch a dashboard onto the internal
+        # shape; only ?format=state reaches it.
+        assert obs.choose_format("/metrics?format=state", None) \
+            == "state"
+        assert obs.choose_format("/metrics", "application/state",
+                                 default="json") == "json"
+
+    def test_metrics_server_serves_state(self):
+        registry = obs.MetricsRegistry()
+        registry.counter("train_steps_total").inc(12)
+        with obs.MetricsServer(registry=registry, port=0) as server:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/metrics"
+                    "?format=state", timeout=10) as resp:
+                state = json.loads(resp.read())
+        assert state["metrics"][0] == {
+            "name": "train_steps_total", "kind": "counter",
+            "labels": {}, "value": 12}

@@ -350,14 +350,16 @@ class TestLadderMetrics:
         eng.warmup()
         _feed(eng, (3, 5, 3))
         m = eng.metrics.to_dict()
-        assert m["request_sizes"] == {"3": 2, "5": 1}
+        # Export labels are pow2-ceiling buckets (cardinality bound,
+        # ISSUE 10): 3 -> 4, 5 -> 8.
+        assert m["request_sizes"] == {"4": 2, "8": 1}
         prom = eng.metrics.render_prometheus()
-        assert 'serving_request_size_total{rows="3"} 2' in prom
+        assert 'serving_request_size_total{rows="4"} 2' in prom
         # An oversized request records its CHUNK sizes (64 + tail).
         eng.embed(np.zeros((67, 2), np.float32))
         m = eng.metrics.to_dict()
         assert m["request_sizes"]["64"] == 1
-        assert m["request_sizes"]["3"] == 3
+        assert m["request_sizes"]["4"] == 3
 
     def test_per_bucket_padding_waste_breakdown(self):
         eng = _linear_engine()

@@ -1,0 +1,463 @@
+"""Shadow routing: mirror trusted traffic to the canary, gate on drift.
+
+The ISSUE 10 contract: while a canary is undecided, a fraction of
+trusted-cohort requests mirrors to a canary-step worker OFF the
+client's critical path; the two embedding sets diff per row (cosine
+distance); promote requires drift-p99 at or under the bar IN ADDITION
+to the error-rate bar, and a drift breach rolls the fleet back exactly
+like an error breach — alert event, flight dump, /rollback broadcast.
+
+All tests run against scriptable fake HTTP workers whose embedding
+DIRECTION is controllable per worker (constant-vector fakes would
+always show zero cosine drift), so identical-weights and
+perturbed-weights canaries are both constructible without JAX.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from ntxent_tpu import obs
+from ntxent_tpu.serving import FleetRouter, ShadowMirror, WorkerPool
+from ntxent_tpu.serving.shadow import cosine_drift
+
+pytestmark = [pytest.mark.fleet, pytest.mark.shadow]
+
+
+class DirectionalWorker:
+    """Fake /embed worker answering a FIXED embedding direction per
+    row — two workers with different ``vec`` show real cosine drift,
+    same ``vec`` shows exactly zero."""
+
+    def __init__(self, step: int, vec):
+        self.step = step
+        self.vec = list(float(v) for v in vec)
+        self.mode = "ok"          # ok | err500
+        self.embed_calls: list[int] = []
+        self.shadow_of: list[str | None] = []
+        self.rollbacks: list[dict] = []
+        worker = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: N802
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("X-Checkpoint-Step", str(worker.step))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if self.path == "/rollback":
+                    worker.rollbacks.append(req)
+                    self._reply(200, {"rolled_back": True})
+                    return
+                rows = len(req.get("inputs", []))
+                worker.embed_calls.append(rows)
+                worker.shadow_of.append(
+                    self.headers.get("X-Shadow-Of"))
+                if worker.mode == "err500":
+                    self._reply(500, {"error": "injected"})
+                    return
+                self._reply(200, {"embeddings": [worker.vec] * rows,
+                                  "dim": len(worker.vec),
+                                  "rows": rows})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _pool(workers: dict, **kw) -> WorkerPool:
+    pool = WorkerPool(**kw)
+    for wid, w in workers.items():
+        pool.upsert(wid, w.url)
+        pool.set_health(wid, alive=True, ready=True,
+                        checkpoint_step=w.step)
+    return pool
+
+
+def _post(router, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}/embed",
+        data=json.dumps(payload).encode(), method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _rows(n, value=0.5):
+    return [[value, value] for _ in range(n)]
+
+
+def _wait(predicate, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the row diff
+
+
+class TestCosineDrift:
+    def test_identical_rows_have_zero_drift(self):
+        a = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+        assert cosine_drift(a, a.copy()).max() == pytest.approx(0.0,
+                                                                abs=1e-6)
+
+    def test_orthogonal_rows_drift_at_one(self):
+        a = np.array([[1.0, 0.0]], np.float32)
+        b = np.array([[0.0, 1.0]], np.float32)
+        assert cosine_drift(a, b)[0] == pytest.approx(1.0)
+
+    def test_opposite_rows_drift_at_two(self):
+        a = np.array([[1.0, 0.0]], np.float32)
+        assert cosine_drift(a, -a)[0] == pytest.approx(2.0)
+
+    def test_scale_is_invisible(self):
+        # Cosine, not euclidean: a canary that rescales embeddings
+        # without rotating them shows zero drift.
+        a = np.array([[1.0, 2.0, 3.0]], np.float32)
+        assert cosine_drift(a, 10.0 * a)[0] == pytest.approx(0.0,
+                                                             abs=1e-6)
+
+    def test_zero_norm_row_is_maximal_not_nan(self):
+        # A collapsed canary output must look maximally drifted.
+        a = np.array([[1.0, 0.0]], np.float32)
+        b = np.zeros((1, 2), np.float32)
+        d = cosine_drift(a, b)
+        assert np.isfinite(d).all() and d[0] == pytest.approx(2.0)
+
+    def test_shape_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            cosine_drift(np.zeros((2, 3)), np.zeros((3, 3)))
+
+
+# ---------------------------------------------------------------------------
+# pool-level drift verdict
+
+
+class TestDriftVerdict:
+    def _armed_pool(self, **kw) -> WorkerPool:
+        kw.setdefault("canary_fraction", 0.5)
+        kw.setdefault("canary_min_requests", 2)
+        kw.setdefault("shadow_max_drift", 0.1)
+        kw.setdefault("shadow_min_samples", 4)
+        pool = WorkerPool(**kw)
+        pool.upsert("old", "http://127.0.0.1:1")
+        pool.set_health("old", alive=True, ready=True,
+                        checkpoint_step=1)
+        pool.upsert("new", "http://127.0.0.1:2")
+        pool.set_health("new", alive=True, ready=True,
+                        checkpoint_step=2)
+        entry = pool.pick()          # arming happens at selection time
+        pool.done(entry.worker_id)
+        assert pool.canary_step() == 2
+        return pool
+
+    def test_promotion_defers_until_drift_samples_arrive(self):
+        pool = self._armed_pool()
+        # Error bar met at 2 outcomes — but the drift gate has no
+        # samples yet, so the verdict must WAIT, not promote blind.
+        for _ in range(4):
+            assert pool.observe("new", 2, ok=True) is None
+        assert pool.canary_step() == 2
+        # Clean mirrored rows land: the next outcome promotes.
+        assert pool.observe_drift(2, [0.0, 0.0, 0.001, 0.002]) is None
+        assert pool.observe("new", 2, ok=True) == ("promote", 2)
+        assert pool.trusted_step == 2
+        assert pool.last_verdict["reason"] == "error_rate+drift"
+
+    def test_drift_breach_rolls_back_immediately(self):
+        pool = self._armed_pool()
+        decision = pool.observe_drift(2, [0.9, 0.95, 1.0, 0.85])
+        assert decision == ("rollback", 2)
+        assert 2 in pool.bad_steps
+        assert pool.canary_step() is None
+        assert pool.last_verdict["reason"] == "shadow_drift"
+        assert pool.last_verdict["drift_p99"] > 0.1
+        prom = pool.registry.render_prometheus()
+        assert "fleet_shadow_breaches_total 1" in prom
+        assert "fleet_rollbacks_total 1" in prom
+
+    def test_error_rate_breach_still_wins_over_clean_drift(self):
+        pool = self._armed_pool(canary_max_error_rate=0.1)
+        assert pool.observe_drift(2, [0.0] * 8) is None
+        assert pool.observe("new", 2, ok=False) is None
+        assert pool.observe("new", 2, ok=False) == ("rollback", 2)
+        assert pool.last_verdict["reason"] == "error_rate"
+
+    def test_deferral_cap_promotes_on_error_rate_alone(self):
+        # A configured drift bar whose mirror never produces samples
+        # (canary shedding every mirror) must not pin the canary
+        # undecided forever.
+        pool = self._armed_pool(canary_min_requests=2)
+        decision = None
+        for _ in range(2 * 4):
+            decision = pool.observe("new", 2, ok=True)
+            if decision is not None:
+                break
+        assert decision == ("promote", 2)
+        assert pool.last_verdict["reason"] == "error_rate_only"
+
+    def test_drift_for_a_different_step_is_ignored(self):
+        pool = self._armed_pool()
+        assert pool.observe_drift(7, [1.0] * 8) is None
+        assert pool.canary_step() == 2
+
+    def test_zero_min_samples_never_judges_an_empty_distribution(self):
+        # min_samples=0 is the natural spelling of "no minimum"; it
+        # must mean "judge as soon as anything arrives", never a
+        # None-vs-float comparison on an empty sample set.
+        pool = self._armed_pool(shadow_min_samples=0,
+                                canary_min_requests=2)
+        for _ in range(4):
+            assert pool.observe("new", 2, ok=True) is None  # defer
+        assert pool.canary_step() == 2
+        assert pool.observe_drift(2, [0.0]) is None  # first sample ok
+        assert pool.observe("new", 2, ok=True) == ("promote", 2)
+
+    def test_no_drift_bar_keeps_the_old_contract(self):
+        # shadow_max_drift=None (the default): promotion at exactly
+        # canary_min_requests clean outcomes, as before ISSUE 10.
+        pool = self._armed_pool(shadow_max_drift=None)
+        assert pool.observe("new", 2, ok=True) is None
+        assert pool.observe("new", 2, ok=True) == ("promote", 2)
+
+
+# ---------------------------------------------------------------------------
+# the mirror itself (real sockets)
+
+
+class TestShadowMirror:
+    def test_offer_gates_on_canary_and_trusted_cohort(self):
+        old = DirectionalWorker(1, [1.0, 0.0])
+        try:
+            pool = _pool({"old": old}, canary_min_requests=2)
+            mirror = ShadowMirror(pool, fraction=1.0)
+            # No canary armed: nothing to mirror against.
+            assert not mirror.offer(b"{}", "r1", 1, [[1.0, 0.0]])
+            new = DirectionalWorker(2, [1.0, 0.0])
+            try:
+                pool.upsert("new", new.url)
+                pool.set_health("new", alive=True, ready=True,
+                                checkpoint_step=2)
+                entry = pool.pick()
+                pool.done(entry.worker_id)
+                assert pool.canary_step() == 2
+                # A canary-served response has nothing trusted to diff.
+                assert not mirror.offer(b"{}", "r2", 2, [[1.0, 0.0]])
+                assert mirror.offer(b"{}", "r3", 1, [[1.0, 0.0]])
+            finally:
+                new.close()
+        finally:
+            old.close()
+
+    def test_fraction_elects_every_nth_offer(self):
+        old = DirectionalWorker(1, [1.0, 0.0])
+        new = DirectionalWorker(2, [1.0, 0.0])
+        try:
+            pool = _pool({"old": old, "new": new})
+            entry = pool.pick()
+            pool.done(entry.worker_id)
+            mirror = ShadowMirror(pool, fraction=0.25)
+            taken = sum(mirror.offer(b"{}", f"r{i}", 1, [[1.0, 0.0]])
+                        for i in range(8))
+            assert taken == 2
+        finally:
+            old.close()
+            new.close()
+
+    def test_mirror_posts_with_shadow_header_and_diffs(self):
+        old = DirectionalWorker(1, [1.0, 0.0])
+        new = DirectionalWorker(2, [1.0, 0.0])    # identical direction
+        try:
+            pool = _pool({"old": old, "new": new},
+                         shadow_max_drift=0.1, shadow_min_samples=2)
+            entry = pool.pick()
+            pool.done(entry.worker_id)
+            mirror = ShadowMirror(pool, fraction=1.0).start()
+            body = json.dumps({"inputs": _rows(3)}).encode()
+            assert mirror.offer(body, "rid-1", 1, [[1.0, 0.0]] * 3)
+            assert _wait(lambda: mirror.snapshot()["mirrored"] == 1)
+            mirror.stop()
+            # The mirror reached the CANARY worker, flagged as shadow.
+            assert new.embed_calls == [3]
+            assert new.shadow_of == ["rid-1"]
+            assert old.shadow_of == []
+            snap = mirror.snapshot()
+            assert snap["drift"]["count"] == 3
+            assert snap["drift"]["max"] == pytest.approx(0.0, abs=1e-6)
+            prom = pool.registry.render_prometheus()
+            assert "fleet_shadow_mirrored_total 1" in prom
+            assert "fleet_shadow_drift_count 3" in prom
+        finally:
+            old.close()
+            new.close()
+
+    def test_canary_error_on_mirror_feeds_error_rate(self):
+        old = DirectionalWorker(1, [1.0, 0.0])
+        new = DirectionalWorker(2, [1.0, 0.0])
+        new.mode = "err500"
+        try:
+            pool = _pool({"old": old, "new": new},
+                         canary_min_requests=2,
+                         canary_max_error_rate=0.1)
+            entry = pool.pick()
+            pool.done(entry.worker_id)
+            decisions = []
+            mirror = ShadowMirror(pool, fraction=1.0,
+                                  on_decision=decisions.append)
+            mirror.start()
+            body = json.dumps({"inputs": _rows(1)}).encode()
+            for i in range(2):
+                assert mirror.offer(body, f"r{i}", 1, _rows(1, 1.0))
+                assert _wait(lambda: mirror.snapshot()["mirrored"]
+                             == i + 1)
+            mirror.stop()
+            # Two failed mirrors = two canary errors = rollback.
+            assert decisions and decisions[-1] == ("rollback", 2)
+            assert mirror.snapshot()["errors"] == 2
+        finally:
+            old.close()
+            new.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the router (HTTP in, verdict out)
+
+
+def _router_with_shadow(old, new, tmp_path=None, **pool_kw):
+    pool_kw.setdefault("canary_fraction", 0.5)
+    pool_kw.setdefault("canary_min_requests", 2)
+    pool_kw.setdefault("shadow_max_drift", 0.1)
+    pool_kw.setdefault("shadow_min_samples", 2)
+    pool = _pool({"old": old, "new": new}, **pool_kw)
+    router = FleetRouter(pool, example_shape=(2,), port=0, retries=2,
+                         forward_timeout_s=10.0)
+    mirror = ShadowMirror(pool, fraction=1.0, forward_timeout_s=10.0)
+    router.attach_shadow(mirror)
+    router.start()
+    mirror.start()
+    return pool, router, mirror
+
+
+class TestShadowEndToEnd:
+    def test_identical_weights_promote_with_near_zero_drift(self):
+        old = DirectionalWorker(1, [0.6, 0.8])
+        new = DirectionalWorker(2, [0.6, 0.8])
+        pool, router, mirror = _router_with_shadow(old, new)
+        try:
+            for i in range(24):
+                status, _ = _post(router,
+                                  {"inputs": _rows(2, float(i + 1))})
+                assert status == 200
+                if pool.trusted_step == 2:
+                    break
+            assert _wait(lambda: pool.trusted_step == 2), \
+                pool.snapshot()
+            assert pool.last_verdict["reason"] == "error_rate+drift"
+            assert pool.last_verdict["drift_p99"] == pytest.approx(
+                0.0, abs=1e-6)
+            assert any(new.shadow_of), "no mirrored request reached " \
+                                       "the canary"
+        finally:
+            mirror.stop()
+            router.close()
+            old.close()
+            new.close()
+
+    def test_perturbed_weights_roll_back_with_alert_and_flight(
+            self, tmp_path):
+        # The canary answers 200 every time — the error-rate bar alone
+        # would PROMOTE this model. Only the drift gate catches it.
+        old = DirectionalWorker(1, [1.0, 0.0])
+        new = DirectionalWorker(2, [0.0, 1.0])   # orthogonal: drift 1.0
+        log = obs.EventLog(str(tmp_path / "router.jsonl"))
+        previous = obs.install(log)
+        pool, router, mirror = _router_with_shadow(
+            old, new, canary_min_requests=50)  # error bar can't decide
+        try:
+            for i in range(16):
+                status, _ = _post(router,
+                                  {"inputs": _rows(2, float(i + 1))})
+                assert status == 200
+                if pool.canary_step() is None:
+                    break
+            assert _wait(lambda: 2 in pool.bad_steps), pool.snapshot()
+            assert pool.trusted_step == 1
+            assert pool.last_verdict["reason"] == "shadow_drift"
+            # The canary worker was told to roll back.
+            assert _wait(lambda: len(new.rollbacks) == 1)
+            assert new.rollbacks[0]["step"] == 2
+            # Alert surfaced on /alerts (ONE fixed name — the step
+            # rides the record, not the label)...
+            snap = router.alerts.snapshot()
+            assert snap["firing"] == ["canary_rollback"]
+            assert snap["active"][0]["reason"] == "shadow_drift"
+            assert snap["active"][0]["step"] == 2
+            # ...as a typed alert event...
+            log.flush()
+            alerts = obs.read_events(str(tmp_path / "router.jsonl"),
+                                     event="alert")
+            assert alerts and alerts[0]["state"] == "firing"
+            assert alerts[0]["drift_p99"] > 0.5
+            # ...and the flight recorder dumped the breach tail.
+            flights = list(tmp_path.glob("flight_*.jsonl"))
+            assert flights, "no flight dump on drift rollback"
+            tail = [json.loads(line)
+                    for line in flights[0].read_text().splitlines()]
+            assert tail[0]["reason"].startswith("canary_rollback:step2")
+        finally:
+            obs.install(previous)
+            log.close()
+            mirror.stop()
+            router.close()
+            old.close()
+            new.close()
+
+    def test_shadow_off_critical_path_client_sees_trusted_answer(self):
+        # Even with a WEDGED canary the client's trusted response is
+        # untouched: the mirror queue absorbs the offer and the answer
+        # comes back from the trusted cohort at once.
+        old = DirectionalWorker(1, [1.0, 0.0])
+        new = DirectionalWorker(2, [0.0, 1.0])
+        pool, router, mirror = _router_with_shadow(
+            old, new, canary_fraction=0.01)
+        try:
+            status, resp = _post(router, {"inputs": _rows(1, 3.0)})
+            assert status == 200
+            assert resp["embeddings"][0] == [1.0, 0.0]  # trusted vec
+        finally:
+            mirror.stop()
+            router.close()
+            old.close()
+            new.close()

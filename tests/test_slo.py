@@ -1,0 +1,414 @@
+"""SLO engine + metric federation: burn rates, alerts, merged scrapes.
+
+The ISSUE 10 observability-plane invariants that need no serving stack:
+objective validation, the two-window burn-rate rule (fast catches
+onset, slow confirms it is sustained), quantile objectives with
+hysteresis, alert lifecycle (fire once per incident, resolve with
+hysteresis, typed ``alert`` events + flight dump on firing), and the
+federation merge rules (counters sum, gauges instance-label,
+histogram windows pool through the exact quantile rule; a mid-scrape
+worker death yields a partial-but-valid view, never a 500).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from ntxent_tpu import obs
+from ntxent_tpu.obs.aggregate import FleetAggregator, merge_states
+from ntxent_tpu.obs.registry import MetricsRegistry
+from ntxent_tpu.obs.slo import (
+    AlertStore,
+    Objective,
+    SLOEngine,
+    counter_total,
+    histogram_quantile,
+)
+
+pytestmark = [pytest.mark.obs, pytest.mark.slo]
+
+
+# ---------------------------------------------------------------------------
+# objective declaration
+
+
+class TestObjective:
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            Objective(name="x", kind="latency", target=1.0)
+
+    def test_availability_needs_counters_and_sane_target(self):
+        with pytest.raises(ValueError):
+            Objective(name="a", kind="availability", target=0.99)
+        with pytest.raises(ValueError):
+            Objective(name="a", kind="availability", target=1.5,
+                      total_metric="t", bad_metric="b")
+
+    def test_quantile_needs_a_metric(self):
+        with pytest.raises(ValueError):
+            Objective(name="q", kind="quantile", target=1.0)
+
+    def test_duplicate_names_rejected(self):
+        o = Objective(name="q", kind="quantile", target=1.0, metric="m")
+        with pytest.raises(ValueError):
+            SLOEngine([o, o])
+
+
+# ---------------------------------------------------------------------------
+# federated-registry readers
+
+
+class TestReaders:
+    def test_counter_total_sums_label_sets_with_exclusion(self):
+        r = MetricsRegistry()
+        r.counter("rej", labels={"reason": "worker_error"}).inc(3)
+        r.counter("rej", labels={"reason": "saturated"}).inc(10)
+        r.counter("rej", labels={"reason": "unreachable"}).inc(2)
+        assert counter_total(r, "rej") == 15
+        assert counter_total(
+            r, "rej", exclude={"reason": "saturated"}) == 5
+        assert counter_total(r, "absent") == 0
+
+    def test_histogram_quantile_pools_matching_label_sets(self):
+        r = MetricsRegistry()
+        a = r.histogram("lat", labels={"stage": "total"})
+        b = r.histogram("lat", labels={"stage": "forward"})
+        for v in range(10):
+            a.observe(float(v))
+            b.observe(1000.0)
+        value, n = histogram_quantile(r, "lat", 0.5,
+                                      labels={"stage": "total"})
+        assert n == 10 and value == 5.0
+        # No filter pools BOTH stages.
+        _, n_all = histogram_quantile(r, "lat", 0.5)
+        assert n_all == 20
+        assert histogram_quantile(r, "lat", 0.5,
+                                  labels={"stage": "x"}) == (None, 0)
+
+
+# ---------------------------------------------------------------------------
+# alert store
+
+
+class TestAlertStore:
+    def test_fire_once_per_incident_then_resolve(self):
+        r = MetricsRegistry()
+        store = AlertStore(registry=r)
+        first = store.fire("lat", reason="p99 over bound", value=3.0,
+                           threshold=2.0)
+        refreshed = store.fire("lat", reason="still over", value=4.0)
+        assert refreshed["since"] == first["since"]
+        snap = store.snapshot()
+        assert snap["firing"] == ["lat"]
+        assert len(snap["history"]) == 1  # ONE incident, not two
+        assert 'slo_alerts_total{slo="lat"} 1' \
+            in r.render_prometheus()
+        resolved = store.resolve("lat")
+        assert resolved["state"] == "resolved"
+        assert store.snapshot()["firing"] == []
+        assert [h["state"] for h in store.snapshot()["history"]] \
+            == ["firing", "resolved"]
+
+    def test_resolving_nothing_is_a_noop(self):
+        assert AlertStore().resolve("ghost") is None
+
+
+# ---------------------------------------------------------------------------
+# burn-rate availability objective
+
+
+def _avail_engine(**kw):
+    clock = {"t": 0.0}
+    obj = Objective(name="avail", kind="availability", target=0.9,
+                    total_metric="req", bad_metric="bad",
+                    fast_window_s=10.0, slow_window_s=40.0,
+                    burn_factor=2.0, breach_ticks=1, clear_ticks=1,
+                    **kw)
+    engine = SLOEngine([obj], clock=lambda: clock["t"])
+    return engine, clock
+
+
+def _reg(total: float, bad: float) -> MetricsRegistry:
+    r = MetricsRegistry()
+    r.counter("req").inc(total)
+    r.counter("bad").inc(bad)
+    return r
+
+
+class TestBurnRate:
+    def test_sustained_burn_fires_and_recovery_resolves(self):
+        engine, clock = _avail_engine()
+        # Budget = 0.1; burn_factor 2 -> page at windowed error rate
+        # >= 0.2. Feed 50% errors for 45 s: both windows burn hot.
+        total = bad = 0.0
+        fired = []
+        for _ in range(9):
+            clock["t"] += 5.0
+            total += 10
+            bad += 5
+            fired += engine.evaluate(_reg(total, bad))
+        assert any(t["state"] == "firing" for t in fired), fired
+        assert engine.store.snapshot()["firing"] == ["avail"]
+        # Clean traffic long enough to flush both windows: resolves.
+        resolved = []
+        for _ in range(12):
+            clock["t"] += 5.0
+            total += 10
+            resolved += engine.evaluate(_reg(total, bad))
+        assert any(t["state"] == "resolved" for t in resolved)
+        assert engine.store.snapshot()["firing"] == []
+
+    def test_short_blip_does_not_page(self):
+        # The slow window is the blip filter: one bad tick inside an
+        # otherwise clean run must not fire.
+        engine, clock = _avail_engine()
+        total = bad = 0.0
+        fired = []
+        for i in range(12):
+            clock["t"] += 5.0
+            total += 10
+            if i == 6:
+                bad += 5  # one 50%-error tick
+            fired += engine.evaluate(_reg(total, bad))
+        assert not fired, fired
+
+    def test_no_traffic_is_not_an_outage(self):
+        engine, clock = _avail_engine()
+        fired = []
+        for _ in range(10):
+            clock["t"] += 5.0
+            fired += engine.evaluate(_reg(0.0, 0.0))
+        assert not fired
+
+
+# ---------------------------------------------------------------------------
+# quantile objective: hysteresis + side effects
+
+
+def _lat_reg(*values: float) -> MetricsRegistry:
+    r = MetricsRegistry()
+    h = r.histogram("lat", labels={"stage": "total"})
+    for v in values:
+        h.observe(v)
+    return r
+
+
+class TestQuantileObjective:
+    def _engine(self, **kw):
+        kw.setdefault("breach_ticks", 2)
+        kw.setdefault("clear_ticks", 2)
+        obj = Objective(name="lat_p99", kind="quantile", target=100.0,
+                        metric="lat", labels={"stage": "total"},
+                        q=0.99, **kw)
+        return SLOEngine([obj])
+
+    def test_breach_ticks_filter_single_bad_scrapes(self):
+        engine = self._engine()
+        bad = _lat_reg(*([50.0] * 5 + [500.0] * 5))
+        good = _lat_reg(*([50.0] * 10))
+        assert engine.evaluate(bad) == []      # 1st breach: held
+        assert engine.evaluate(good) == []     # streak reset
+        assert engine.evaluate(bad) == []
+        fired = engine.evaluate(bad)           # 2nd consecutive: fires
+        assert fired and fired[0]["state"] == "firing"
+        assert fired[0]["value"] == 500.0
+        # Still breaching: no duplicate incident.
+        assert engine.evaluate(bad) == []
+        # Two clean ticks resolve.
+        assert engine.evaluate(good) == []
+        resolved = engine.evaluate(good)
+        assert resolved and resolved[0]["state"] == "resolved"
+
+    def test_min_samples_gates_judgement(self):
+        engine = self._engine(min_samples=8, breach_ticks=1)
+        assert engine.evaluate(_lat_reg(500.0, 600.0)) == []
+        fired = engine.evaluate(_lat_reg(*([500.0] * 8)))
+        assert fired and fired[0]["state"] == "firing"
+
+    def test_firing_emits_alert_event_and_flight_dump(self, tmp_path):
+        log = obs.EventLog(str(tmp_path / "events.jsonl"))
+        previous = obs.install(log)
+        try:
+            log.emit("span", name="context")  # something for the tail
+            engine = self._engine(breach_ticks=1)
+            fired = engine.evaluate(_lat_reg(*([500.0] * 4)))
+            assert fired
+            log.flush()
+            alerts = obs.read_events(str(tmp_path / "events.jsonl"),
+                                     event="alert")
+            assert len(alerts) == 1
+            assert alerts[0]["slo"] == "lat_p99"
+            assert alerts[0]["state"] == "firing"
+            assert alerts[0]["value"] == 500.0
+            flights = list(tmp_path.glob("flight_*.jsonl"))
+            assert len(flights) == 1
+            header = json.loads(
+                flights[0].read_text().splitlines()[0])
+            assert header["reason"] == "slo_breach:lat_p99"
+        finally:
+            obs.install(previous)
+            log.close()
+
+
+# ---------------------------------------------------------------------------
+# federation merge rules (no HTTP)
+
+
+def _worker_registry(requests: float, depth: float,
+                     latencies: list[float]) -> MetricsRegistry:
+    r = MetricsRegistry()
+    r.counter("serving_requests_total").inc(requests)
+    r.gauge("serving_queue_depth").set(depth)
+    h = r.histogram("serving_latency_ms", labels={"stage": "total"})
+    for v in latencies:
+        h.observe(v)
+    return r
+
+
+class TestMergeStates:
+    def test_counters_sum_gauges_label_histograms_pool(self):
+        w0 = _worker_registry(10, 3, [1.0, 2.0, 3.0])
+        w1 = _worker_registry(32, 7, [100.0, 200.0])
+        merged = merge_states({"w0": w0.dump_state(),
+                               "w1": w1.dump_state()})
+        c = merged.collect()
+        # Counters: the fleet total IS the sum of the per-worker
+        # scrapes (the acceptance equality).
+        assert c["serving_requests_total"] == 42
+        # Gauges: per-instance series, never summed.
+        assert c["serving_queue_depth"]['{instance="w0"}'] == 3
+        assert c["serving_queue_depth"]['{instance="w1"}'] == 7
+        # Histograms: windows pooled, exact quantile over the union.
+        h = merged.histogram("serving_latency_ms",
+                             labels={"stage": "total"})
+        assert h.count == 5
+        assert sorted(h._window) == [1.0, 2.0, 3.0, 100.0, 200.0]
+        value, n = histogram_quantile(merged, "serving_latency_ms",
+                                      0.99, labels={"stage": "total"})
+        assert n == 5 and value == 200.0
+        # Both views stay renderable.
+        prom = merged.render_prometheus()
+        assert "serving_requests_total 42" in prom
+        assert 'fleet_fed_instance_up{instance="w0"} 1' in prom
+
+    def test_stale_instance_marked_down_but_included(self):
+        w0 = _worker_registry(10, 3, [1.0])
+        merged = merge_states({"w0": w0.dump_state(),
+                               "w1": w0.dump_state()},
+                              stale={"w1"})
+        prom = merged.render_prometheus()
+        assert 'fleet_fed_instance_up{instance="w0"} 1' in prom
+        assert 'fleet_fed_instance_up{instance="w1"} 0' in prom
+        assert merged.collect()["serving_requests_total"] == 20
+
+    def test_malformed_state_skipped_not_fatal(self):
+        w0 = _worker_registry(5, 1, [])
+        merged = merge_states({
+            "good": w0.dump_state(),
+            "mid_restart": {"metrics": [{"name": "x"},  # no kind
+                                        {"kind": "counter"},  # no name
+                                        "not even a dict"]},
+            "garbage": {"oops": True},
+        })
+        assert merged.collect()["serving_requests_total"] == 5
+
+
+# ---------------------------------------------------------------------------
+# the aggregator over real scrape endpoints (MetricsServer workers)
+
+
+class TestFleetAggregator:
+    def test_scrape_merge_and_partial_on_death(self):
+        r0 = _worker_registry(11, 1, [5.0])
+        r1 = _worker_registry(31, 2, [7.0])
+        s0 = obs.MetricsServer(r0).start()
+        s1 = obs.MetricsServer(r1).start()
+        local = MetricsRegistry()
+        local.counter("fleet_requests_total").inc(40)
+        targets = {"w0": f"http://127.0.0.1:{s0.port}",
+                   "w1": f"http://127.0.0.1:{s1.port}"}
+        agg = FleetAggregator(lambda: targets,
+                              local={"router": local},
+                              timeout_s=2.0, stale_after=3)
+        try:
+            merged = agg.scrape_once()
+            c = merged.collect()
+            assert c["serving_requests_total"] == 42
+            assert c["fleet_requests_total"] == 40
+            assert c["fleet_fed_instances"] == 3
+            # w1 dies MID-SCRAPE: the next tick is partial but valid —
+            # last-good state retained, instance marked down, no
+            # exception, the router's local view still merged.
+            s1.close()
+            merged = agg.scrape_once()
+            c = merged.collect()
+            assert c["serving_requests_total"] == 42  # last-good kept
+            assert c["fleet_fed_instance_up"]['{instance="w0"}'] == 1
+            assert c["fleet_fed_instance_up"]['{instance="w1"}'] == 0
+            assert agg.failures == 1
+            assert agg.snapshot()["stale"] == ["w1"]
+            # Past stale_after consecutive failures the dead
+            # incarnation's counters drop (a restarted worker must not
+            # be double-counted against its ghost).
+            agg.scrape_once()
+            merged = agg.scrape_once()
+            c = merged.collect()
+            assert c["serving_requests_total"] == 11
+        finally:
+            s0.close()
+            s1.close()
+
+    def test_merged_scrapes_on_demand_when_cold(self):
+        r0 = _worker_registry(3, 0, [])
+        s0 = obs.MetricsServer(r0).start()
+        try:
+            agg = FleetAggregator(
+                lambda: {"w0": f"http://127.0.0.1:{s0.port}"})
+            merged = agg.merged()  # never ticked: must scrape now
+            assert merged.collect()["serving_requests_total"] == 3
+        finally:
+            s0.close()
+
+    def test_on_merge_hooks_run_per_tick_and_survive_errors(self):
+        r0 = _worker_registry(3, 0, [])
+        s0 = obs.MetricsServer(r0).start()
+        try:
+            agg = FleetAggregator(
+                lambda: {"w0": f"http://127.0.0.1:{s0.port}"})
+            seen = []
+
+            def bad_hook(_reg):
+                raise RuntimeError("boom")
+
+            agg.on_merge.append(bad_hook)
+            agg.on_merge.append(
+                lambda reg:
+                seen.append(reg.collect()["serving_requests_total"]))
+            agg.scrape_once()
+            agg.scrape_once()
+            assert seen == [3, 3]
+        finally:
+            s0.close()
+
+    def test_slo_engine_rides_federation_ticks(self):
+        r0 = _worker_registry(0, 0, [500.0] * 8)
+        s0 = obs.MetricsServer(r0).start()
+        try:
+            agg = FleetAggregator(
+                lambda: {"w0": f"http://127.0.0.1:{s0.port}"})
+            store = AlertStore()
+            engine = SLOEngine(
+                [Objective(name="lat", kind="quantile", target=100.0,
+                           metric="serving_latency_ms",
+                           labels={"stage": "total"}, q=0.99,
+                           breach_ticks=2, clear_ticks=1)],
+                store=store)
+            agg.on_merge.append(engine.evaluate)
+            agg.scrape_once()
+            assert store.snapshot()["firing"] == []
+            agg.scrape_once()
+            assert store.snapshot()["firing"] == ["lat"]
+        finally:
+            s0.close()

@@ -277,7 +277,9 @@ class TestRequestIdRoundTrip:
             # still be a beat behind the HTTP response: poll briefly.
             deadline = time.monotonic() + 5.0
             spans = {}
-            while ("serve.queue_wait" not in spans
+            want = {"serve.queue_wait", "serve.request", "serve.batch",
+                    "serve.device_chunk"}
+            while (not want <= set(spans)
                    and time.monotonic() < deadline):
                 spans = {r["name"]: r for r in _spans(event_log)}
                 time.sleep(0.01)
@@ -898,3 +900,151 @@ class TestBenchGate:
                     for n, p in committed.items())
         assert total >= 4, {n: list(bench.gate_metrics(n, p))
                             for n, p in committed.items()}
+
+
+# ---------------------------------------------------------------------------
+# cross-process trace stitching (ntxent-trace --merge, ISSUE 10)
+
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def _merge_fixture(tmp_path):
+    """Two processes' logs around one request id: the router's hop
+    (wall 100.0->100.1) containing the worker's queue wait + device
+    chunk (wall ~100.05). Each file's own `t` axis starts near zero —
+    only `wall` can align them."""
+    rid = "feedc0de00000001"
+    router = tmp_path / "router.jsonl"
+    worker = tmp_path / "w0.jsonl"
+    _write_jsonl(router, [
+        {"event": "span", "t": 5.1, "wall": 100.10, "run_id": "r1",
+         "attempt": 0, "name": "fleet.request", "span_id": "a1",
+         "dur_ms": 100.0, "request_id": rid, "thread": "router"},
+    ])
+    _write_jsonl(worker, [
+        {"event": "span", "t": 0.04, "wall": 100.04, "run_id": "w1",
+         "attempt": 0, "name": "serve.queue_wait", "span_id": "b1",
+         "dur_ms": 20.0, "request_id": rid, "thread": "bat"},
+        {"event": "span", "t": 0.08, "wall": 100.08, "run_id": "w1",
+         "attempt": 0, "name": "serve.device_chunk", "span_id": "b2",
+         "dur_ms": 30.0, "request_id": rid, "thread": "bat"},
+        {"event": "rollout", "t": 0.09, "wall": 100.09, "run_id": "w1",
+         "attempt": 0, "action": "swap", "step": 4},
+    ])
+    return router, worker, rid
+
+
+class TestMergedExport:
+    def test_process_lanes_and_request_join(self, tmp_path):
+        router, worker, rid = _merge_fixture(tmp_path)
+        trace = trace_mod.export_merged_chrome_trace([str(router),
+                                                      str(worker)])
+        n = trace_mod.validate_chrome_trace(trace)
+        assert n == 4
+        events_ = trace["traceEvents"]
+        # One process lane per file, labeled from the filename.
+        pids = {e["pid"] for e in events_ if e.get("ph") != "M"}
+        assert len(pids) == 2
+        names = {e["args"]["name"] for e in events_
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert names == {"router", "w0"}
+        # The request join: router-hop and worker spans carry ONE id
+        # across different pids — the causal tree's thread.
+        carrying = [e for e in events_
+                    if e.get("args", {}).get("request_id") == rid]
+        assert len(carrying) == 3
+        assert len({e["pid"] for e in carrying}) == 2
+        # Wall-clock alignment: the worker's device chunk NESTS inside
+        # the router hop's [start, end] window even though the two
+        # files' `t` axes disagree by ~5 s.
+        by_name = {e["name"]: e for e in events_
+                   if e.get("ph") == "X"}
+        hop = by_name["fleet.request"]
+        chunk = by_name["serve.device_chunk"]
+        assert hop["ts"] <= chunk["ts"]
+        assert chunk["ts"] + chunk["dur"] \
+            <= hop["ts"] + hop["dur"] + 1e-6
+        # Non-span events still export, on their file's lane.
+        assert any(e.get("cat") == "rollout" for e in events_)
+        assert trace["otherData"]["exporter"] == "ntxent-trace --merge"
+
+    def test_run_id_filter_applies_per_record(self, tmp_path):
+        router, worker, _ = _merge_fixture(tmp_path)
+        trace = trace_mod.export_merged_chrome_trace(
+            [str(router), str(worker)], run_id="w1")
+        assert trace_mod.validate_chrome_trace(trace) == 3
+        assert trace["otherData"]["run_ids"] == ["w1"]
+
+    def test_duplicate_filenames_get_distinct_lanes(self, tmp_path):
+        a = tmp_path / "a"
+        b = tmp_path / "b"
+        a.mkdir()
+        b.mkdir()
+        _write_jsonl(a / "w0.jsonl", [
+            {"event": "span", "t": 0.1, "wall": 10.1, "name": "x",
+             "span_id": "s1", "dur_ms": 1.0, "thread": "t"}])
+        _write_jsonl(b / "w0.jsonl", [
+            {"event": "span", "t": 0.1, "wall": 10.2, "name": "y",
+             "span_id": "s2", "dur_ms": 1.0, "thread": "t"}])
+        trace = trace_mod.export_merged_chrome_trace(
+            [str(a / "w0.jsonl"), str(b / "w0.jsonl")])
+        trace_mod.validate_chrome_trace(trace)
+        names = {e["args"]["name"] for e in trace["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert names == {"w0", "w0#2"}
+
+    def test_cli_merges_multiple_files(self, tmp_path, capsys):
+        router, worker, _ = _merge_fixture(tmp_path)
+        out = tmp_path / "merged.json"
+        rc = trace_mod.main([str(router), str(worker),
+                             "-o", str(out)])
+        assert rc == 0
+        trace = json.loads(out.read_text())
+        assert trace_mod.validate_chrome_trace(trace) == 4
+        assert "2 process lanes" in capsys.readouterr().out
+
+    def test_cli_single_file_unchanged_without_merge_flag(
+            self, tmp_path, capsys):
+        router, _, _ = _merge_fixture(tmp_path)
+        out = tmp_path / "single.json"
+        assert trace_mod.main([str(router), "-o", str(out)]) == 0
+        trace = json.loads(out.read_text())
+        # Single-file export keeps the monotonic `t` axis (no merge
+        # retiming) and the classic single-pid layout.
+        assert {e["pid"] for e in trace["traceEvents"]} == {1}
+        ev = [e for e in trace["traceEvents"] if e["ph"] == "X"][0]
+        assert ev["ts"] == pytest.approx(5.1e6 - 100e3)
+        assert "process lanes" not in capsys.readouterr().out
+
+
+class TestAsyncWriterResilience:
+    def test_unserializable_record_costs_one_record_not_the_stream(
+            self, tmp_path):
+        # Serialization now runs on the writer thread (ISSUE 10); one
+        # hostile record must be dropped and counted, never kill the
+        # writer — a dead writer silently ends the whole JSONL stream.
+        path = tmp_path / "events.jsonl"
+        log = obs.EventLog(str(path), async_io=True)
+        try:
+            bomb = {}
+            bomb["self"] = bomb  # RecursionError inside _sanitize
+            log.emit("span", name="before")
+            log.emit("span", name="bomb", payload=bomb)
+            log.emit("span", name="after")
+            assert log.flush(timeout_s=5.0)
+            assert log.dropped_writes == 1
+            names = [r.get("name")
+                     for r in obs.read_events(str(path), event="span")]
+            assert names == ["before", "after"]
+            # The writer is still alive: later emits keep landing.
+            log.emit("span", name="later")
+            assert log.flush(timeout_s=5.0)
+            assert "later" in [
+                r.get("name")
+                for r in obs.read_events(str(path), event="span")]
+        finally:
+            log.close()
