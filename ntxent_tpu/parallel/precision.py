@@ -1,0 +1,144 @@
+"""Collective precision policy: what dtype rides the wire (ISSUE 12).
+
+The NT-Xent distributed loss is communication-bound — every step
+all-gathers full-precision embeddings and all-reduces full-precision
+gradients through the mesh shims, and PR 7's comms accounting measured
+exactly how many bytes that moves. EQuARX (PAPERS.md) shows quantized
+AllReduce inside XLA at ~2x collective speedup with negligible quality
+loss; this repo owns every hand-written collective call site, so the
+same move lands HERE, one layer up from XLA: payloads are quantized
+before the wire and dequantized after, inside the traced program.
+
+This module is the pure half (no mesh state, no accounting): the
+thread-local policy context and the int8 quantize/dequantize math.
+``parallel/mesh.py`` owns the collective implementations that consume
+it (the shims check :func:`collective_dtype` at trace time) and the
+wire-byte accounting.
+
+Policy semantics (``collective_precision(dtype)``):
+
+* ``"float32"`` — the default: payloads ride as traced.
+* ``"bf16"`` — float payloads are cast to bfloat16 before the
+  collective and cast back after (2x fewer wire bytes; reductions
+  accumulate in bf16 on the wire).
+* ``"int8"`` — eligible payloads are quantized with a per-chunk
+  symmetric scale computed in-graph (``quantize_int8``: the scale is
+  ``amax(|x|)/127`` over each slice of the last axis, so one f32 scale
+  rides per chunk), moved as int8 + scales, and dequantized after
+  (~4x fewer wire bytes). Reductions use the two-phase
+  quantize -> all_to_all -> local-sum -> re-quantize -> all_gather
+  schedule (mesh.py), which keeps the ring-wire volume at exactly the
+  int8 fraction of a float all-reduce at every mesh size.
+
+Eligibility (``quantizable``): int8 applies only to float payloads with
+at least :data:`MIN_QUANT_ELEMS` elements — scalars (the psum'd loss),
+small vectors (logsumexp merges, biases) and integer payloads pass
+through in full precision. That keeps the scalar loss psum exactly
+differentiable and spends the compression where the bytes are.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "COLLECTIVE_DTYPES",
+    "MIN_QUANT_ELEMS",
+    "collective_precision",
+    "collective_dtype",
+    "quantizable",
+    "quantize_int8",
+    "dequantize_int8",
+]
+
+# The closed set of policy names (bounded label cardinality for the
+# dtype-labeled collective counters rides on this).
+COLLECTIVE_DTYPES = ("float32", "bf16", "int8")
+
+# int8 floor: payloads below this many elements ride in full precision
+# (scalars/small vectors cost more in scales + graph ops than they save
+# in wire bytes, and the scalar loss psum must stay exactly
+# differentiable). Env-overridable for tests that want tiny payloads
+# quantized.
+MIN_QUANT_ELEMS = int(os.environ.get("NTXENT_QUANT_MIN_ELEMS", "1024"))
+
+_policy = threading.local()
+
+
+def collective_dtype() -> str:
+    """The wire dtype the ambient ``collective_precision`` context set
+    (``"float32"`` outside any context)."""
+    return getattr(_policy, "dtype", "float32")
+
+
+class collective_precision:
+    """Context manager: collectives traced inside quantize to ``dtype``.
+
+    The policy is a TRACE-time, thread-local property — enter it around
+    the code that builds the traced program (e.g. inside the shard_map
+    body of a train step), not around the compiled call. Nests; the
+    inner context wins. ``"bfloat16"`` is accepted as an alias for
+    ``"bf16"``.
+    """
+
+    def __init__(self, dtype: str = "float32"):
+        dtype = {"bfloat16": "bf16"}.get(str(dtype), str(dtype))
+        if dtype not in COLLECTIVE_DTYPES:
+            raise ValueError(
+                f"collective dtype must be one of {COLLECTIVE_DTYPES}, "
+                f"got {dtype!r}")
+        self.dtype = dtype
+        self._saved = "float32"
+
+    def __enter__(self) -> "collective_precision":
+        self._saved = collective_dtype()
+        _policy.dtype = self.dtype
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _policy.dtype = self._saved
+        return None
+
+
+def quantizable(x, min_elems: int | None = None) -> bool:
+    """Is this leaf worth putting on the wire as int8? Float payloads of
+    at least ``min_elems`` elements (default :data:`MIN_QUANT_ELEMS`)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        return False
+    if not jnp.issubdtype(dtype, jnp.floating):
+        return False
+    size = 1
+    for d in shape:
+        size *= int(d)
+    floor = MIN_QUANT_ELEMS if min_elems is None else int(min_elems)
+    return size >= floor and len(shape) >= 1
+
+
+def quantize_int8(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-chunk symmetric int8 quantization, computed in-graph.
+
+    A chunk is one slice along the LAST axis: ``scale`` has shape
+    ``x.shape[:-1] + (1,)`` with ``scale = amax(|chunk|) / 127``
+    (clamped away from zero so all-zero chunks quantize to zeros, not
+    NaNs). Returns ``(q, scale)`` with ``q`` int8 in [-127, 127] —
+    symmetric, so -128 is never minted and dequantization is a pure
+    multiply. The wire cost is 1 byte/element + 4 bytes/chunk.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array,
+                    dtype=jnp.float32) -> jax.Array:
+    """Inverse of :func:`quantize_int8` (up to rounding): ``q * scale``
+    in f32, cast to ``dtype``."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)

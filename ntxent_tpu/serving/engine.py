@@ -30,7 +30,12 @@ does exactly that for the SimCLR encoder+projection forward:
   instead of dying;
 * the compiled cache is keyed by ``(bucket, dtype, model_hash)`` so a
   weight reload (``update_variables``) can never serve a stale
-  executable closed over old constants;
+  executable closed over old constants — and a QUANTIZED rung
+  (``dtype="int8"``, ISSUE 12) is just another key: the executable
+  takes an int8 payload + per-example scales (quantized host-side,
+  dequantized in-graph), compresses the host->device transfer ~4x,
+  and composes unchanged with the adaptive ladder and the fleet's
+  shadow-drift gate (``--serve-dtype int8``);
 * ``warmup()`` compiles the whole ladder up front, bounding
   first-request latency to one device call.
 
@@ -128,7 +133,21 @@ class InferenceEngine:
         self.variables = variables
         self._version = 0
         self._hash = _model_hash(variables, self._version)
-        self._jit_fn = jax.jit(apply_fn)
+        # int8 rung (ISSUE 12): executables take a QUANTIZED chunk —
+        # int8 payload + per-example f32 scales, quantized host-side in
+        # _embed_chunk and dequantized in-graph before the forward. A
+        # quantized executable is just another (bucket, "int8",
+        # model_hash) cache entry, so the whole ladder machinery
+        # (adaptive re-AOT, atomic swap, weight swaps) applies
+        # unchanged; the host->device transfer moves ~4x fewer bytes.
+        self.quantized = self.dtype == jnp.dtype(jnp.int8)
+        if self.quantized:
+            def _apply_dequant(v, q, scale):
+                return apply_fn(v, q.astype(jnp.float32) * scale)
+
+            self._jit_fn = jax.jit(_apply_dequant)
+        else:
+            self._jit_fn = jax.jit(apply_fn)
         self._apply_fn = apply_fn
         # (bucket, dtype_name, model_hash) -> executable. The dtype and
         # hash components look redundant for a single-model engine — they
@@ -216,8 +235,8 @@ class InferenceEngine:
         if warm:
             for bucket in buckets:
                 exe = self._executable(bucket, new_hash, variables)
-                x = jnp.zeros((bucket,) + self.example_shape, self.dtype)
-                jax.block_until_ready(exe(variables, x))
+                jax.block_until_ready(
+                    exe(variables, *self._dummy_args(bucket)))
         with self._lock:
             self.variables = variables
             self._version = version
@@ -258,6 +277,34 @@ class InferenceEngine:
             exe = self._cache.get((bucket, self.dtype.name, self._hash))
             return self.variables, self._hash, bucket, exe
 
+    # -- executable argument marshalling ---------------------------------
+    def _dummy_args(self, bucket: int) -> tuple:
+        """Zero-filled executable arguments (after ``variables``) for
+        one bucket — the AOT-lowering and warmup shapes."""
+        if self.quantized:
+            return (jnp.zeros((bucket,) + self.example_shape, jnp.int8),
+                    jnp.ones((bucket,) + (1,) * len(self.example_shape),
+                             jnp.float32))
+        return (jnp.zeros((bucket,) + self.example_shape, self.dtype),)
+
+    def _quantize_host(self, x: np.ndarray) -> tuple:
+        """Per-example symmetric int8 quantization of a padded chunk,
+        on the host (the device sees int8 + scales — the transfer is
+        the wire this rung compresses). Symmetric [-127, 127], scale =
+        amax(|example|)/127, all-zero (padding) rows quantize to zeros.
+        """
+        amax = np.abs(x.reshape(x.shape[0], -1)).max(axis=1)
+        scale = (np.maximum(amax, 1e-30) / 127.0).reshape(
+            (-1,) + (1,) * len(self.example_shape)).astype(np.float32)
+        q = np.clip(np.rint(x / scale), -127, 127).astype(np.int8)
+        return q, scale
+
+    def _chunk_args(self, x: np.ndarray) -> tuple:
+        if self.quantized:
+            q, scale = self._quantize_host(np.asarray(x, np.float32))
+            return (jnp.asarray(q), jnp.asarray(scale))
+        return (jnp.asarray(x, self.dtype),)
+
     # -- bucket math -----------------------------------------------------
     def bucket_for(self, n: int) -> int:
         """Smallest ladder bucket >= n (n must fit the ladder)."""
@@ -297,16 +344,17 @@ class InferenceEngine:
             return exe
         # Compile outside the lock (seconds-long); a concurrent miss on
         # the same key costs one duplicate compile, never a wrong result.
-        x = jnp.zeros((bucket,) + self.example_shape, self.dtype)
+        args = self._dummy_args(bucket)
         from ..training.trainer import aot_compile_with_flops
 
         t0 = time.monotonic()
-        _, compiled = aot_compile_with_flops(self._jit_fn, variables, x)
+        _, compiled = aot_compile_with_flops(self._jit_fn, variables,
+                                             *args)
         if compiled is None:
             # Typed-exception fallback already logged by the helper:
             # degrade to the jit wrapper. Prime its dispatch cache now so
             # the first real request still pays no compile.
-            jax.block_until_ready(self._jit_fn(variables, x))
+            jax.block_until_ready(self._jit_fn(variables, *args))
             compiled = self._jit_fn
         logger.info("serving: compiled bucket %d (%s) in %.2fs%s", bucket,
                     self.dtype.name, time.monotonic() - t0,
@@ -367,9 +415,8 @@ class InferenceEngine:
                 for bucket in proposal:
                     exe = self._executable(bucket, model_hash, variables,
                                            background=True)
-                    x = jnp.zeros((bucket,) + self.example_shape,
-                                  self.dtype)
-                    jax.block_until_ready(exe(variables, x))
+                    jax.block_until_ready(
+                        exe(variables, *self._dummy_args(bucket)))
             except Exception:  # noqa: BLE001 — a failed re-AOT must
                 # never take down serving: the old ladder keeps working.
                 logger.exception(
@@ -421,8 +468,8 @@ class InferenceEngine:
         variables, model_hash = self._snapshot()
         for bucket in self.buckets:
             exe = self._executable(bucket, model_hash, variables)
-            x = jnp.zeros((bucket,) + self.example_shape, self.dtype)
-            jax.block_until_ready(exe(variables, x))
+            jax.block_until_ready(
+                exe(variables, *self._dummy_args(bucket)))
         logger.info("serving: warmup complete (%d buckets: %s)",
                     len(self.buckets), list(self.buckets))
 
@@ -442,10 +489,10 @@ class InferenceEngine:
             x = np.concatenate(
                 [x, np.zeros((pad,) + self.example_shape, x.dtype)])
         exe = self._executable(bucket, model_hash, variables, cached)
-        xd = jnp.asarray(x, self.dtype)
+        args = self._chunk_args(x)
 
         def run_once():
-            return jax.block_until_ready(exe(variables, xd))
+            return jax.block_until_ready(exe(variables, *args))
 
         t0 = time.monotonic()
         # The chunk span nests under the batcher's serve.batch span

@@ -37,6 +37,7 @@ from ntxent_tpu.training.trainer import (
     create_train_state,
     estimate_mfu,
     fit,
+    init_error_feedback,
     make_clip_train_step,
     make_sharded_clip_train_step,
     make_sharded_train_step,
@@ -78,6 +79,7 @@ __all__ = [
     "TrainerConfig",
     "TrainState",
     "create_train_state",
+    "init_error_feedback",
     "estimate_mfu",
     "make_clip_train_step",
     "make_sharded_clip_train_step",

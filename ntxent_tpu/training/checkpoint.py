@@ -468,6 +468,73 @@ def _place_like(template: Any, restored: Any) -> Any:
     return jax.tree.map(place, template, restored)
 
 
+def _from_bytes_tolerant(template: Any, blob: bytes) -> Any:
+    """``flax.serialization.from_bytes`` that survives FIELD drift
+    between the template and the checkpoint (ISSUE 12).
+
+    The quantized-collective error-feedback residual added a TrainState
+    field (``ef_residual``) that pre-quantization checkpoints do not
+    carry — and a strict ``from_state_dict`` refuses the structural
+    mismatch, turning every old checkpoint into a crash for exactly the
+    runs the feature targets (resume an existing run with
+    ``--collective-dtype int8``). ``ef_residual`` is the ONLY
+    reconciled field — it is carry-over compression noise, reset to
+    zeros on topology changes anyway, so it is never worth failing a
+    restore over. Every OTHER structural mismatch (a missing param,
+    opt_state, step — top-level or nested) stays a loud
+    ``from_state_dict`` failure: that is corruption, not drift.
+
+    * the template has the field, the checkpoint lacks it entirely or
+      saved it as None (a pre-quantization / float32-era save): the
+      template's fresh zeros are used, with a warning;
+    * the checkpoint carries residual state the template has no field
+      or ``None`` for (resuming an int8 run at float32): dropped, with
+      a warning;
+    * leaf shapes disagree (a topology change resized the per-device
+      stack): reset to the template's zeros, with a warning.
+    """
+    state_dict = flax_ser.msgpack_restore(blob)
+    template_sd = flax_ser.to_state_dict(template)
+    if isinstance(state_dict, dict) and isinstance(template_sd, dict):
+        if "ef_residual" in template_sd \
+                and "ef_residual" not in state_dict:
+            logger.warning(
+                "checkpoint carries no error-feedback residual state "
+                "(saved before the field existed); starting at zero "
+                "residual")
+            state_dict["ef_residual"] = template_sd["ef_residual"]
+        elif "ef_residual" in state_dict \
+                and "ef_residual" not in template_sd:
+            logger.warning(
+                "checkpoint carries error-feedback residual state the "
+                "current run's state has no field for; dropping it")
+            state_dict.pop("ef_residual")
+        saved_ef = state_dict.get("ef_residual")
+        template_ef = template_sd.get("ef_residual")
+        if (saved_ef is None) != (template_ef is None):
+            # A float32-era save (field None) restored into an
+            # error-feedback run, or the reverse: the residual is
+            # carry-over compression noise, never worth failing a
+            # restore over.
+            logger.warning(
+                "checkpoint %s error-feedback residual state; starting "
+                "at zero residual",
+                "carries no" if saved_ef is None else "carries")
+            state_dict["ef_residual"] = template_ef
+        elif saved_ef is not None and template_ef is not None:
+            t_leaves = jax.tree_util.tree_leaves(template_ef)
+            s_leaves = jax.tree_util.tree_leaves(saved_ef)
+            shapes_differ = len(t_leaves) != len(s_leaves) or any(
+                getattr(t, "shape", None) != getattr(s, "shape", None)
+                for t, s in zip(t_leaves, s_leaves))
+            if shapes_differ:
+                logger.warning(
+                    "checkpoint's error-feedback residual does not match "
+                    "the current topology; resetting to zero residual")
+                state_dict["ef_residual"] = template_ef
+    return flax_ser.from_state_dict(template, state_dict)
+
+
 def _template_mesh(template: Any):
     """The mesh the template's committed leaves live on (None when no
     leaf carries a NamedSharding — a fresh single-device template)."""
@@ -939,7 +1006,7 @@ class CheckpointManager:
             try:
                 blob, data_state = self._call(_read_step_payload,
                                               step_dir)
-                restored_host = flax_ser.from_bytes(state_template, blob)
+                restored_host = _from_bytes_tolerant(state_template, blob)
             except (OSError, ValueError, KeyError, TypeError) as e:
                 verified_but_unreadable = True
                 logger.error(
@@ -1069,7 +1136,7 @@ class CheckpointManager:
                         f"{self.directory}")
                 blob, data_state = self._call(_read_step_payload,
                                               step_dir)
-                chosen = (flax_ser.from_bytes(state_template, blob),
+                chosen = (_from_bytes_tolerant(state_template, blob),
                           data_state, source)
             else:
                 chosen = self._load_step(step, state_template)

@@ -34,16 +34,17 @@ from ..parallel.dist_loss import (
 )
 from ..parallel.moe import moe_aux_from
 from .lars import cosine_warmup_schedule, create_lars, simclr_learning_rate
-from ..parallel.mesh import comms_accounting
+from ..parallel.mesh import collective_precision, comms_accounting
 from ..parallel.mesh import pmean as _pmean_acct
+from ..parallel.mesh import quantized_grad_reduce
 from ..parallel.mesh import shard_map as _shard_map_compat
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["TrainState", "create_train_state", "make_train_step",
            "make_clip_train_step", "make_sharded_train_step",
-           "make_sharded_clip_train_step", "train_loop", "fit",
-           "TrainerConfig", "StepOutcome"]
+           "make_sharded_clip_train_step", "init_error_feedback",
+           "train_loop", "fit", "TrainerConfig", "StepOutcome"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,6 +106,19 @@ def _guarded_update(state: TrainState, grads, loss, new_stats=None):
 
 class TrainState(train_state.TrainState):
     batch_stats: Any = None
+    # Error-feedback residual for quantized gradient collectives
+    # (ISSUE 12): a pytree shaped like ``params`` with one extra leading
+    # axis of size P (the mesh's data-axis group), each device's slice
+    # holding ITS local compression error — so the state stays
+    # replicated (out_spec P()) while the residual stays per-device
+    # (spec P(axis) on the stacked dim). None (the default) on
+    # full-precision runs: no structural change anywhere.
+    # ``init_error_feedback`` builds it; the sharded step threads it
+    # through shard_map as its own operand (like the guard's grad-scale)
+    # and it rides checkpoints like any other state field (old
+    # checkpoints restore to zero residual with a warning —
+    # checkpoint._from_bytes_tolerant).
+    ef_residual: Any = None
 
 
 @flax.struct.dataclass
@@ -151,6 +165,37 @@ def create_train_state(
         apply_fn=model.apply, params=params, tx=tx,
         batch_stats=variables.get("batch_stats", flax.core.freeze({})),
     )
+
+
+def init_error_feedback(state: TrainState, mesh: Mesh,
+                        axis: str = "data") -> TrainState:
+    """Attach a zero error-feedback residual for quantized gradient
+    collectives (``make_sharded_train_step(collective_dtype="int8")``).
+
+    Builds one float32 zeros leaf of shape ``(P,) + param.shape`` per
+    parameter (P = the mesh's ``axis`` group size), committed to the
+    mesh sharded over the leading axis — the global array is the stack
+    of every device's residual, each device holding only its own slice.
+    Call after ``replicate_state`` (placement order does not matter,
+    but the residual must exist before the first int8 step; a step
+    without it falls back to quantization WITHOUT error feedback).
+
+    COST (documented tradeoff): the residual rides checkpoints like any
+    state field, and the host-gathered save pays P x the f32 param
+    payload for what is, on a topology change, reconstructible
+    carry-over noise (restore resets it to zeros). Persisting only the
+    local slice — or skipping it entirely behind a flag — is a noted
+    follow-up (ROADMAP item 1); at the tiny-model/P=8 scale this repo
+    measures, the save-size cost is dwarfed by the wire win."""
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    p = 1
+    for a in axes:
+        p *= mesh.shape[a]
+    zeros = jax.tree.map(
+        lambda g: jnp.zeros((p,) + jnp.shape(g), jnp.float32),
+        state.params)
+    placed = jax.device_put(zeros, NamedSharding(mesh, P(axes)))
+    return state.replace(ef_residual=placed)
 
 
 def _apply_two_views(state: TrainState, params, v1, v2, train: bool = True,
@@ -333,6 +378,7 @@ def make_sharded_train_step(
     loss_impl: str = "strip",
     moe_aux_weight: float = 0.0,
     guard: bool = False,
+    collective_dtype: str = "float32",
 ) -> Callable:
     """Distributed train step over the mesh's data axis.
 
@@ -355,10 +401,29 @@ def make_sharded_train_step(
     ``grad_norm``/``step_ok`` metrics). The finite check runs AFTER the
     gradient pmean, so a NaN on any one shard skips the update uniformly
     on every device — the replicated state stays bitwise identical.
+
+    ``collective_dtype`` (ISSUE 12): wire precision for the step's
+    hand-written collectives. ``"bf16"`` casts payloads to bfloat16
+    around the wire (2x fewer bytes); ``"int8"`` quantizes eligible
+    payloads with in-graph per-chunk symmetric scales (~4x fewer bytes
+    — embedding gathers ride a straight-through-estimator custom_vjp,
+    and gradient reductions use ERROR FEEDBACK when the state carries a
+    residual (``init_error_feedback``): each device's compression error
+    carries into its next step's payload, so quantization noise is
+    absorbed instead of biasing SGD. On a guarded step, a skipped
+    (non-finite) step keeps the pre-step residual too). BatchNorm
+    statistics always reduce in full precision (running stats, a
+    negligible byte share). The comms accounting records the quantized
+    WIRE bytes, so ``collective_bytes_total`` / the per-step
+    ``train_step_comms_bytes`` series show the drop directly.
     """
     num_devices = mesh.shape[axis]
     loss_body = resolve_local_ntxent(loss_impl)
     collect = moe_aux_weight > 0.0
+    # Validates the name (and normalizes the bfloat16 alias) eagerly —
+    # a typo'd dtype must fail at build, not first trace.
+    qdt = collective_precision(collective_dtype).dtype
+    use_ef = qdt == "int8"
 
     def local_loss(z1, z2):
         return loss_body(z1, z2, temperature, axis, num_devices, interpret)
@@ -370,7 +435,20 @@ def make_sharded_train_step(
             loss = local_loss(z1, z2) + moe_aux_weight * aux
             return loss, (new_stats, aux)
 
-        return jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        # The precision context is trace-time thread-local state: enter
+        # it around the grad TRACE so both the forward's embedding
+        # gathers and their AD duals build under the policy.
+        with collective_precision(qdt):
+            return jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+
+    def _reduce_grads(grads, ef):
+        """(reduced grads, new residual-or-None) under the wire policy."""
+        if use_ef and ef is not None:
+            return quantized_grad_reduce(grads, ef, axis)
+        if qdt != "float32":
+            with collective_precision(qdt):
+                return _pmean_acct(grads, axis), None
+        return _pmean_acct(grads, axis), None
 
     def _metrics(loss, aux):
         # The aux term varies per shard (each device routes its own
@@ -383,10 +461,26 @@ def make_sharded_train_step(
             metrics["moe_aux"] = _pmean_acct(aux, axis)
         return metrics
 
+    def _split_ef(state):
+        """(state without residual, residual) — the residual crosses
+        shard_map as its own P(axis)-sharded operand; the rest of the
+        state stays replicated (P())."""
+        ef = state.ef_residual
+        has_ef = use_ef and ef is not None \
+            and bool(jax.tree_util.tree_leaves(ef))
+        return state.replace(ef_residual=None), (ef if has_ef else None), \
+            has_ef
+
+    def _ef_in(stacked):
+        return jax.tree.map(lambda t: t[0], stacked)
+
+    def _ef_out(local):
+        return jax.tree.map(lambda t: t[None], local)
+
     if guard:
-        def per_device_guarded(state: TrainState, v1, v2, scale):
+        def per_device_guarded(state: TrainState, v1, v2, scale, ef=None):
             (loss, (new_stats, aux)), grads = _loss_and_grads(state, v1, v2)
-            grads = _pmean_acct(grads, axis)
+            grads, new_ef = _reduce_grads(grads, ef)
             new_stats = _pmean_acct(new_stats, axis)
             grads = jax.tree.map(lambda g: g * scale, grads)
             # A non-finite local loss whose NaN died in a masked reduction
@@ -395,7 +489,15 @@ def make_sharded_train_step(
             loss_all = _pmean_acct(loss, axis)
             state, gmetrics = _guarded_update(state, grads, loss_all,
                                               new_stats)
-            return state, {**_metrics(loss, aux), **gmetrics}
+            metrics = {**_metrics(loss, aux), **gmetrics}
+            if new_ef is None:
+                return state, metrics
+            # A skipped step applied no update, so its compression error
+            # must not carry either — keep the pre-step residual.
+            ok = gmetrics["step_ok"]
+            new_ef = jax.tree.map(lambda n, o: jnp.where(ok, n, o),
+                                  new_ef, ef)
+            return state, metrics, new_ef
 
         sharded_guarded = _shard_map_compat(
             per_device_guarded,
@@ -405,22 +507,43 @@ def make_sharded_train_step(
             check_vma=False,
         )
 
+        def _guarded_ef_body(state, v1, v2, scale, ef_stacked):
+            state, metrics, new_ef = per_device_guarded(
+                state, v1, v2, scale, _ef_in(ef_stacked))
+            return state, metrics, _ef_out(new_ef)
+
+        sharded_guarded_ef = _shard_map_compat(
+            _guarded_ef_body,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis), P(), P(axis)),
+            out_specs=(P(), P(), P(axis)),
+            check_vma=False,
+        )
+
         # Undonated for the same XLA aliasing reason as the single-device
         # guarded step (see make_train_step).
         @jax.jit
         def guarded_step(state: TrainState, v1, v2, scale=1.0):
-            return sharded_guarded(state, v1, v2,
-                                   jnp.asarray(scale, jnp.float32))
+            scale = jnp.asarray(scale, jnp.float32)
+            bare, ef, has_ef = _split_ef(state)
+            if not has_ef:
+                out, metrics = sharded_guarded(bare, v1, v2, scale)
+                return out.replace(ef_residual=state.ef_residual), metrics
+            out, metrics, new_ef = sharded_guarded_ef(bare, v1, v2,
+                                                      scale, ef)
+            return out.replace(ef_residual=new_ef), metrics
 
         return guarded_step
 
-    def per_device_step(state: TrainState, v1, v2):
+    def per_device_step(state: TrainState, v1, v2, ef=None):
         (loss, (new_stats, aux)), grads = _loss_and_grads(state, v1, v2)
-        grads = _pmean_acct(grads, axis)
+        grads, new_ef = _reduce_grads(grads, ef)
         new_stats = _pmean_acct(new_stats, axis)
         state = state.apply_gradients(grads=grads)
         state = state.replace(batch_stats=new_stats)
-        return state, _metrics(loss, aux)
+        if new_ef is None:
+            return state, _metrics(loss, aux)
+        return state, _metrics(loss, aux), new_ef
 
     sharded = _shard_map_compat(
         per_device_step,
@@ -429,7 +552,30 @@ def make_sharded_train_step(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+
+    def _plain_ef_body(state, v1, v2, ef_stacked):
+        state, metrics, new_ef = per_device_step(state, v1, v2,
+                                                 _ef_in(ef_stacked))
+        return state, metrics, _ef_out(new_ef)
+
+    sharded_ef = _shard_map_compat(
+        _plain_ef_body,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis)),
+        out_specs=(P(), P(), P(axis)),
+        check_vma=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, v1, v2):
+        bare, ef, has_ef = _split_ef(state)
+        if not has_ef:
+            out, metrics = sharded(bare, v1, v2)
+            return out.replace(ef_residual=state.ef_residual), metrics
+        out, metrics, new_ef = sharded_ef(bare, v1, v2, ef)
+        return out.replace(ef_residual=new_ef), metrics
+
+    return train_step
 
 
 def make_sharded_clip_train_step(
@@ -439,6 +585,7 @@ def make_sharded_clip_train_step(
     remat: bool = False,
     loss_impl: str = "dual",
     moe_aux_weight: float = 0.0,
+    collective_dtype: str = "float32",
 ) -> Callable:
     """Distributed CLIP train step over the mesh's data axis (shard_map).
 
@@ -454,9 +601,15 @@ def make_sharded_clip_train_step(
     the towers themselves need sharding (GSPMD tensor parallelism).
     ``moe_aux_weight``: as in ``make_sharded_train_step`` (aux pmean'd —
     the dp=ep estimator over per-shard routing).
+
+    ``collective_dtype``: wire precision for the modality gathers and
+    the gradient pmean, as in ``make_sharded_train_step`` (without
+    error feedback — the CLIP step carries no residual operand yet;
+    prefer ``"bf16"`` here, or accept plain int8 quantization noise).
     """
     local_loss = resolve_local_infonce(loss_impl)
     collect = moe_aux_weight > 0.0
+    qdt = collective_precision(collective_dtype).dtype
 
     def per_device_step(state, images, tokens):
         towers = _clip_towers(state, remat, collect_moe_aux=collect)
@@ -466,9 +619,10 @@ def make_sharded_clip_train_step(
             return local_loss(zi, zt, scale, axis, interpret) \
                 + moe_aux_weight * aux, aux
 
-        (loss, aux), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
-        grads = _pmean_acct(grads, axis)
+        with collective_precision(qdt):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
+            grads = _pmean_acct(grads, axis)
         # Same rationale as make_sharded_train_step: the per-shard aux
         # makes loss shard-varying; report the pmean (== the objective).
         metrics = {"loss": _pmean_acct(loss, axis) if collect else loss}

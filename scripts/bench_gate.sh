@@ -35,6 +35,9 @@ assert rec["ok"] is True, f"gate failed on committed records: {rec}"
 assert rec["metrics"], "gate compared nothing (no metrics extracted)"
 gated = [k for k, v in rec["metrics"].items() if "degradation" in v]
 assert any(k.startswith("pipeline/") for k in gated), gated
+# BENCH_quant.json is enrolled (ISSUE 12): the byte-ratio claims of the
+# quantized collectives must be among the gated metrics.
+assert any(k.startswith("quant/bytes_ratio") for k in gated), gated
 print(f"bench gate: PASS on committed records ({len(gated)} metrics, "
       f"skipped: {list(rec['skipped']) or 'none'})")
 PY

@@ -15,6 +15,12 @@ each (kernel precision, oracle precision) pair in
 dual-InfoNCE, and flash-attention paths. The committed JSON is the evidence
 for whatever tolerance/precision policy the tier adopts.
 
+Since ISSUE 12 this module is also the shared accuracy-delta reporter:
+``error_report(a, b)`` is loaded by file path from ``bench.py --quant``
+(the quantized-collectives bench, gate-enrolled via BENCH_quant.json), so
+the quantized-vs-float32 gradient and embedding deltas are measured with
+exactly the error ladder the TPU precision policy was pinned with.
+
 Usage (chip-alive host, AFTER the capture queue is idle):
     python scripts/precision_probe.py [--out benchmark_results/tpu/precision_probe.json]
 """
@@ -27,9 +33,6 @@ import sys
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-
 
 def _finite(x: float):
     # json.dumps would emit bare NaN/Infinity tokens (invalid JSON) for
@@ -38,7 +41,10 @@ def _finite(x: float):
     return float(x) if np.isfinite(x) else repr(float(x))
 
 
-def _err(a, b):
+def error_report(a, b) -> dict:
+    """max-abs / max-rel / mean-abs error ladder between two arrays
+    (``b`` is the reference). JSON-safe even for non-finite errors.
+    The shared vocabulary of this probe and ``bench.py --quant``."""
     a = np.asarray(a, np.float64)
     b = np.asarray(b, np.float64)
     abs_err = np.abs(a - b)
@@ -50,8 +56,13 @@ def _err(a, b):
     }
 
 
+_err = error_report  # the probe grid's internal spelling
+
+
 def _grad_pair(fn_a, fn_b, args, prec_a, prec_b):
     """value_and_grad both sides, each traced under its own precision."""
+    import jax
+
     with jax.default_matmul_precision(prec_a):
         la, ga = jax.jit(jax.value_and_grad(fn_a))(*args)
         jax.block_until_ready(ga)
@@ -67,6 +78,12 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="benchmark_results/tpu/precision_probe.json")
     args = ap.parse_args()
+
+    # JAX imports live inside the entry points, not at module scope:
+    # bench.py loads this file for error_report in processes whose
+    # backend policy the probe must not preempt.
+    import jax
+    import jax.numpy as jnp
 
     backend = jax.default_backend()
     import os

@@ -1,0 +1,193 @@
+#!/usr/bin/env bash
+# Quantized-serving smoke (ISSUE 12): prove the int8 rung end-to-end in
+# <60 s on CPU. Two tiny ntxent-serve processes over the SAME random
+# weights — float32 and --serve-dtype int8 (adaptive ladder) — then:
+#   1. ACCURACY: identical mixed-size payloads to both servers; the
+#      per-row cosine drift between int8 and float32 embeddings must
+#      sit under the fleet's default 0.05 shadow-drift bar.
+#   2. LADDER: the int8 server's adaptive ladder swap fires MID-LOAD
+#      (quantized rungs re-AOT in the background) and the
+#      request-visible compile counter stays FLAT across it — a
+#      quantized executable is just another (bucket, dtype) rung.
+#   3. SHADOW: an in-process FleetRouter + ShadowMirror treats the
+#      float32 server as the trusted cohort and the int8 server as the
+#      undecided canary; mirrored traffic is diffed per row, and the
+#      canary must PROMOTE through the drift-p99 gate — int8 embeddings
+#      staying inside the drift bar under real routed traffic.
+# Any non-200, hang, or failed assertion exits nonzero.
+# Pairs with `pytest -m quant` (the same machinery in-process) and
+# `python bench.py --quant` (the committed BENCH_quant.json record).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+t_start=$SECONDS
+
+workdir="$(mktemp -d)"
+pids=()
+cleanup() {
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "--- serve log tails (rc=$rc) ---" >&2
+        tail -40 "$workdir"/serve_*.log >&2 2>/dev/null || true
+    fi
+    for pid in "${pids[@]:-}"; do
+        [ -n "$pid" ] && kill "$pid" 2>/dev/null || true
+    done
+    for pid in "${pids[@]:-}"; do
+        [ -n "$pid" ] && wait "$pid" 2>/dev/null || true
+    done
+    rm -rf "$workdir"
+}
+trap cleanup EXIT
+
+start_server() {  # $1 = name, rest = extra flags; port -> $workdir/$1.port
+    local name="$1"; shift
+    rm -f "$workdir/$name.port"
+    JAX_PLATFORMS=cpu python -c \
+        'import sys; from ntxent_tpu.cli import serve_main; sys.exit(serve_main(sys.argv[1:]))' \
+        --platform cpu --model tiny --image-size 8 --proj-hidden-dim 16 \
+        --proj-dim 8 --buckets 1,4,16 --max-delay-ms 1 --queue-size 32 \
+        --seed 0 --port 0 --port-file "$workdir/$name.port" \
+        "$@" >"$workdir/serve_$name.log" 2>&1 &
+    pids+=($!)
+    local pid=$!
+    for _ in $(seq 120); do
+        [ -s "$workdir/$name.port" ] && break
+        kill -0 "$pid" 2>/dev/null || {
+            echo "$name server died:"; tail -20 "$workdir/serve_$name.log"; exit 1; }
+        sleep 0.5
+    done
+    [ -s "$workdir/$name.port" ] || { echo "$name server never bound"; exit 1; }
+}
+
+# Identical weights on both: same --seed, no checkpoint.
+start_server f32
+start_server int8 --serve-dtype int8 --adaptive-buckets \
+    --ladder-max-buckets 4 --ladder-min-requests 40 --ladder-interval 0.5
+
+JAX_PLATFORMS=cpu python - "$(cat "$workdir/f32.port")" "$(cat "$workdir/int8.port")" <<'PY'
+import json, sys, time, urllib.error, urllib.request
+
+import numpy as np
+
+f32_port, int8_port = sys.argv[1], sys.argv[2]
+DRIFT_BAR = 0.05  # the fleet's default --shadow-max-drift
+
+
+def get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=15) as r:
+        return json.loads(r.read())
+
+
+def wait_ready(port, name):
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            get(port, "/readyz")
+            return
+        except (urllib.error.HTTPError, OSError):
+            time.sleep(0.5)
+    sys.exit(f"{name} server never became ready")
+
+
+wait_ready(f32_port, "f32")
+wait_ready(int8_port, "int8")
+
+rng = np.random.RandomState(0)
+
+
+def body(rows):
+    x = rng.rand(rows, 8, 8, 3).astype(np.float32)
+    return json.dumps({"inputs": x.tolist(), "timeout_ms": 20000}).encode()
+
+
+def post(port, b):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/embed",
+                                 data=b, method="POST")
+    with urllib.request.urlopen(req, timeout=25) as r:
+        out = json.loads(r.read())
+        assert r.status == 200
+    return np.asarray(out["embeddings"], np.float32)
+
+
+# --- 1. accuracy: identical payloads, per-row cosine drift ------------
+drifts = []
+for i in range(24):
+    b = body((3, 5, 7)[i % 3])
+    a = post(f32_port, b)
+    q = post(int8_port, b)
+    num = (a * q).sum(axis=1)
+    den = np.maximum(np.linalg.norm(a, axis=1)
+                     * np.linalg.norm(q, axis=1), 1e-12)
+    drifts.extend((1.0 - num / den).tolist())
+drifts.sort()
+p99 = drifts[min(len(drifts) - 1, int(len(drifts) * 0.99))]
+assert p99 < DRIFT_BAR, (p99, DRIFT_BAR)
+print(f"int8 vs f32 accuracy: cosine drift p99={p99:.2e} max="
+      f"{max(drifts):.2e} (bar {DRIFT_BAR})")
+
+# --- 2. adaptive ladder swap of int8 rungs, compile counter flat ------
+compiles_after_warmup = get(int8_port, "/metrics")["compile"]["compiles"]
+deadline = time.monotonic() + 45
+i = 0
+while time.monotonic() < deadline:
+    post(int8_port, body((3, 5, 7)[i % 3]))
+    i += 1
+    if i % 10 == 0 and get(int8_port, "/metrics")["ladder"]["generation"] >= 1:
+        break
+m = get(int8_port, "/metrics")
+assert m["ladder"]["generation"] >= 1, \
+    f"int8 ladder never swapped under load: {m['ladder']}"
+for j in range(24):
+    post(int8_port, body((3, 5, 7)[j % 3]))
+m = get(int8_port, "/metrics")
+assert m["compile"]["compiles"] == compiles_after_warmup, \
+    (m["compile"], compiles_after_warmup)
+assert m["ladder"]["compiles"] >= 1, m["ladder"]
+assert m["errors"] == 0, m["errors"]
+print(f"int8 adaptive ladder: {m['ladder']['buckets']} "
+      f"(gen {m['ladder']['generation']}), request-visible compiles "
+      f"flat at {compiles_after_warmup}")
+
+# --- 3. shadow routing: int8 canary must promote through the drift bar
+from ntxent_tpu.serving import FleetRouter, ShadowMirror, WorkerPool
+
+pool = WorkerPool(canary_fraction=0.25, canary_min_requests=10,
+                  shadow_max_drift=DRIFT_BAR, shadow_min_samples=8)
+pool.upsert("w-f32", f"http://127.0.0.1:{f32_port}")
+pool.set_health("w-f32", alive=True, ready=True, checkpoint_step=1)
+pool.upsert("w-int8", f"http://127.0.0.1:{int8_port}")
+pool.set_health("w-int8", alive=True, ready=True, checkpoint_step=2)
+shadow = ShadowMirror(pool, fraction=1.0)
+router = FleetRouter(pool, example_shape=(8, 8, 3), port=0)
+router.attach_shadow(shadow)
+shadow.start()
+router.start()
+try:
+    snap = None
+    deadline = time.monotonic() + 45
+    k = 0
+    while time.monotonic() < deadline:
+        post(router.port, body((3, 5, 7)[k % 3]))
+        k += 1
+        time.sleep(0.02)  # let mirrored diffs land off the hot path
+        snap = pool.snapshot()
+        if snap["trusted_step"] == 2:
+            break
+    snap = pool.snapshot()
+    assert snap["trusted_step"] == 2, \
+        f"int8 canary never promoted: {snap}"
+    assert not snap["bad_steps"], snap["bad_steps"]
+    status = shadow.snapshot()
+    print(f"shadow gate: int8 canary PROMOTED through drift bar after "
+          f"{k} routed requests (mirrored={status['mirrored']})")
+finally:
+    shadow.stop()
+    router.close()
+PY
+
+elapsed=$((SECONDS - t_start))
+echo "quant smoke: OK (${elapsed}s)"
+if [ "$elapsed" -ge 90 ]; then
+    echo "quant smoke: WARNING — exceeded the 90 s CPU budget" >&2
+fi

@@ -473,6 +473,54 @@ class TestCommsAccounting:
         calls, _ = delta[("ppermute", "data")]
         assert calls >= 2 * (p - 1), delta  # 2 tensors x P-1 hops
 
+    def test_payload_bytes_read_the_on_wire_dtype(self):
+        """ISSUE 12 satellite: the byte model must price the payload at
+        its ACTUAL wire dtype (bf16 casts, int8 quantized payloads),
+        and python scalars at jax's traced widths — previously scalars
+        were silently skipped (0 bytes)."""
+        from ntxent_tpu.parallel.mesh import _tree_payload_bytes
+
+        assert _tree_payload_bytes(jnp.zeros((4, 8), jnp.float32)) == 128
+        assert _tree_payload_bytes(jnp.zeros((4, 8), jnp.bfloat16)) == 64
+        assert _tree_payload_bytes(jnp.zeros((4, 8), jnp.int8)) == 32
+        # python scalars trace at f32/i32 (x64 off), not numpy's 64-bit
+        assert _tree_payload_bytes(1.0) == 4
+        assert _tree_payload_bytes(3) == 4
+        assert _tree_payload_bytes(
+            {"a": jnp.zeros((2,), jnp.float32), "b": 1.0}) == 12
+
+    def test_byte_model_prices_cast_payloads_by_ring_formulas(self):
+        """The exact ring-model formulas this class already pins, at
+        non-f32 itemsizes: a bf16 payload halves every term, a python
+        scalar psum records 4 wire bytes (previously 0)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ntxent_tpu.parallel import mesh as pm
+
+        m = pm.create_mesh(axis_names=("data",))
+        p = jax.device_count()
+        acct = pm.comms_accounting()
+        mark = acct.totals()
+
+        def body(x):
+            xh = x.astype(jnp.bfloat16)
+            g = pm.all_gather(xh, "data", tiled=True)
+            y = pm.ppermute(xh, "data",
+                            [(i, (i + 1) % p) for i in range(p)])
+            s = pm.psum(1.0, "data")
+            return jnp.sum(g.astype(jnp.float32)) \
+                + jnp.sum(y.astype(jnp.float32)) + s
+
+        f = jax.jit(pm.shard_map(body, mesh=m, in_specs=P("data"),
+                                 out_specs=P(), check_vma=False))
+        float(f(jnp.ones((p * 2, 4), jnp.float32)))
+        delta = acct.delta(mark)
+        shard_b = 2 * 4 * 2  # bf16: itemsize 2
+        assert delta[("all_gather", "data")] == (1, (p - 1) * shard_b)
+        assert delta[("ppermute", "data")] == (1, float(shard_b))
+        assert delta[("psum", "data")][1] == pytest.approx(
+            2 * (p - 1) / p * 4)
+
     def test_counters_land_in_default_registry(self):
         from ntxent_tpu.obs.registry import default_registry
         from ntxent_tpu.parallel import mesh as pm
