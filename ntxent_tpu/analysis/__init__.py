@@ -1,0 +1,54 @@
+"""ntxent-lint: project-native static analysis (ISSUE 13).
+
+Three consecutive review passes kept re-finding the same mechanical
+defect classes by hand; each checker here encodes one of them as a
+machine check so the invariant is a standing guarantee instead of
+reviewer vigilance:
+
+* ``collective-shim`` — a ``jax.lax`` collective outside
+  ``parallel/mesh.py`` bypasses comms accounting AND the quantized
+  precision policy (PR 7 found ``all_to_all``/``pmax`` holes that
+  silently under-counted the very baseline ROADMAP item 2 claims wins
+  against).
+* ``host-sync`` — per-step host syncs on step state (``int(s.step)``
+  every step, PR 5) stall the device pipeline from inside the hot loop.
+* ``lock-discipline`` — blocking work lexically under a serving/obs
+  lock (SHA-1 under the cache lock, serial rollback POSTs on the
+  deciding thread, PR 8) and lock acquisition inside signal handlers
+  (the PR 3 self-deadlock hazard).
+* ``import-boundary`` — the router tier must never import JAX
+  (PR 8 pass 3); the static graph here agrees by test with the runtime
+  subprocess tripwire so the two cannot drift.
+* ``telemetry-schema`` — event types outside ``EVENT_TYPES``, illegal
+  exposition metric names, and metric label keys outside the bounded
+  vocabulary (the pow2-cardinality rule) are silent typos at runtime.
+
+Everything in this package is pure stdlib (``ast``-based): linting the
+repo must never pay a JAX import (``scripts/lint_gate.sh`` asserts it).
+Inline suppression: ``# ntxent: lint-ok[rule] reason`` on the finding's
+line or the line above. Accepted pre-existing findings live in the
+committed ``lint_baseline.json``; ``ntxent-lint`` exits nonzero only on
+NEW findings.
+"""
+
+from .framework import (
+    Finding,
+    LintConfig,
+    LintResult,
+    compare_with_baseline,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from .imports import reachable_modules
+
+__all__ = [
+    "Finding",
+    "LintConfig",
+    "LintResult",
+    "compare_with_baseline",
+    "load_baseline",
+    "reachable_modules",
+    "run_lint",
+    "write_baseline",
+]

@@ -1,0 +1,104 @@
+"""collective-shim: every jax.lax collective must ride parallel/mesh.py.
+
+The mesh shims are where comms accounting (PR 7) and the quantized
+precision policy (PR 11) live. A raw ``jax.lax.psum`` elsewhere still
+COMPUTES correctly — which is exactly why PR 7's hand audit was needed:
+it silently under-counts ``collective_bytes_total`` and skips the wire
+dtype policy, invalidating every measured byte claim downstream. This
+checker turns that audit into a standing guarantee: any spelling of a
+collective (``jax.lax.psum(...)``, ``lax.psum(...)``, or a
+``from jax.lax import psum`` making bare ``psum(...)`` calls) outside
+the shim file is an error.
+
+``axis_index`` is in the set deliberately: besides accounting symmetry,
+the shim owns the old-jax custom_vjp-under-shard_map lowering fix —
+a raw ``lax.axis_index`` in that position is the seed-era UNIMPLEMENTED
+partition-id failure waiting to recur.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .framework import Checker, LintContext, SourceFile
+
+__all__ = ["CollectiveShimChecker", "COLLECTIVES"]
+
+COLLECTIVES = frozenset({
+    "psum", "pmean", "all_gather", "ppermute", "psum_scatter",
+    "all_to_all", "pmax", "pcast", "axis_index",
+})
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """'jax.lax.psum' for an Attribute/Name chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class CollectiveShimChecker(Checker):
+    rule = "collective-shim"
+    describe = ("jax.lax collective call outside parallel/mesh.py "
+                "(bypasses comms accounting + the wire precision policy)")
+    incident = ("PR 7: unshimmed all_to_all/pmax under-counted "
+                "collective_bytes_total, the measured baseline ROADMAP "
+                "item 2 claims wins against")
+
+    def check(self, src: SourceFile, ctx: LintContext):
+        if src.rel in ctx.config.shim_paths:
+            return
+        # Every spelling that can reach a lax collective, aliases
+        # included — `import jax.lax as foo; foo.psum(...)` must not
+        # defeat the rule:
+        #   bare:      from jax.lax import psum [as p]
+        #   lax_names: lax / import jax.lax as foo / from jax import
+        #              lax as jl  ->  <name>.psum(...)
+        #   jax_names: jax / import jax as j  ->  <name>.lax.psum(...)
+        bare: set[str] = set()
+        lax_names = {"lax"}
+        jax_names = {"jax"}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "jax.lax" and alias.asname:
+                        lax_names.add(alias.asname)
+                    elif alias.name == "jax" and alias.asname:
+                        jax_names.add(alias.asname)
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                if node.module == "jax.lax":
+                    for alias in node.names:
+                        if alias.name in COLLECTIVES:
+                            bare.add(alias.asname or alias.name)
+                            yield src.finding(
+                                self.rule, node,
+                                f"`from jax.lax import {alias.name}` — "
+                                f"use the parallel/mesh.py shim instead")
+                elif node.module == "jax":
+                    for alias in node.names:
+                        if alias.name == "lax":
+                            lax_names.add(alias.asname or "lax")
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name is None:
+                continue
+            op = name.rsplit(".", 1)[-1]
+            if op not in COLLECTIVES:
+                continue
+            head = name[:-(len(op) + 1)]
+            is_lax = head in lax_names or (
+                head.endswith(".lax")
+                and head[:-4] in jax_names)
+            if is_lax or (name == op and op in bare):
+                yield src.finding(
+                    self.rule, node,
+                    f"raw `{name}` bypasses the mesh shim — call "
+                    f"`parallel.mesh.{op}` so comms accounting and the "
+                    f"collective_precision policy see it")

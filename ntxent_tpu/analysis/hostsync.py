@@ -1,0 +1,139 @@
+"""host-sync: no per-step device→host syncs on step state in hot loops.
+
+The PR 5 incident: ``int(s.step)`` executed EVERY step inside the train
+loop forces a device round-trip that serializes the host against the
+pipelined device queue — the async-dispatch win the pipeline PR measured
+evaporates one scalar at a time. The repaired loop pays one ``int()``
+at restore and tracks the step host-side; the per-step metrics reads
+ride the lag-1 drain (already-on-host values).
+
+Rule shape (deliberately narrow — this is an incident encoder, not a
+general performance lint): inside a HOT function (``train_loop``/
+``fit``/step hooks, and the serving dispatch bodies), inside a
+``for``/``while`` loop, a sync call — ``int()``, ``float()``,
+``.item()``, ``np.array()``/``np.asarray()``, ``jax.device_get()``,
+``block_until_ready`` — whose operand involves STEP STATE (an
+expression mentioning ``state`` or an attribute named ``.step``).
+Values already drained to host (``metrics`` dicts after
+``block_until_ready`` of the lag-1 slot) are not step state and stay
+legal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .framework import Checker, LintContext, SourceFile
+
+__all__ = ["HostSyncChecker"]
+
+_SYNC_BUILTINS = {"int", "float"}
+_SYNC_NP = {("np", "array"), ("np", "asarray"),
+            ("numpy", "array"), ("numpy", "asarray")}
+_STATE_NAMES = {"state", "train_state", "new_state"}
+
+
+def _mentions_step_state(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "step":
+            return True
+        if isinstance(sub, ast.Name) and sub.id in _STATE_NAMES:
+            return True
+    return False
+
+
+def _sync_operand(call: ast.Call) -> ast.AST | None:
+    """The operand being synced, when ``call`` is a sync spelling."""
+    func = call.func
+    if isinstance(func, ast.Name) and func.id in _SYNC_BUILTINS \
+            and len(call.args) == 1:
+        return call.args[0]
+    if isinstance(func, ast.Attribute):
+        recv = func.value
+        if func.attr == "item" and not call.args:
+            return recv
+        if func.attr == "block_until_ready":
+            # x.block_until_ready() syncs x; jax.block_until_ready(x)
+            # syncs its argument.
+            if isinstance(recv, ast.Name) and recv.id == "jax":
+                return call.args[0] if call.args else None
+            return recv
+        if func.attr == "device_get" and isinstance(recv, ast.Name) \
+                and recv.id == "jax" and call.args:
+            return call.args[0]
+        if isinstance(recv, ast.Name) \
+                and (recv.id, func.attr) in _SYNC_NP and call.args:
+            return call.args[0]
+    return None
+
+
+class _HotLoopVisitor(ast.NodeVisitor):
+    """Collect sync-on-step-state calls inside loops of one hot body."""
+
+    def __init__(self):
+        self.loop_depth = 0
+        self.hits: list[ast.Call] = []
+
+    def visit_For(self, node: ast.For) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_AsyncFor = visit_For
+
+    def visit_While(self, node: ast.While) -> None:
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_FunctionDef(self, node) -> None:
+        # A nested def is a new (cold-until-called) scope: a sync in a
+        # callback defined inside the loop is the CALLER's problem at
+        # its own call site, not a per-iteration sync here.
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.loop_depth > 0:
+            operand = _sync_operand(node)
+            if operand is not None and _mentions_step_state(operand):
+                self.hits.append(node)
+        self.generic_visit(node)
+
+
+class HostSyncChecker(Checker):
+    rule = "host-sync"
+    describe = ("device→host sync on step state inside a hot loop "
+                "(train_loop / step hooks / serving dispatch)")
+    incident = ("PR 5: per-step `int(s.step)` serialized the host "
+                "against the async device queue every step")
+
+    def _is_hot(self, name: str, rel: str, cfg) -> bool:
+        if name in cfg.hot_functions:
+            return True
+        if any(name.endswith(sfx) for sfx in cfg.hot_suffixes):
+            return True
+        if rel.startswith("ntxent_tpu/serving/") \
+                and name in cfg.hot_serving:
+            return True
+        return False
+
+    def check(self, src: SourceFile, ctx: LintContext):
+        cfg = ctx.config
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not self._is_hot(node.name, src.rel, cfg):
+                continue
+            visitor = _HotLoopVisitor()
+            for stmt in node.body:
+                visitor.visit(stmt)
+            for call in visitor.hits:
+                yield src.finding(
+                    self.rule, call,
+                    f"host sync on step state inside `{node.name}`'s "
+                    f"loop — hoist it out of the per-step path (track "
+                    f"the step host-side; read metrics off the lag-1 "
+                    f"drain)")

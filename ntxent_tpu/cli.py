@@ -189,6 +189,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "land, and a crash mid-replay resumes the replay, "
                         "not the abandoned future. A supervised run "
                         "applies this to its FIRST attempt only")
+    t.add_argument("--ckpt-save-ef", action="store_true",
+                   help="persist the quantized-collective error-feedback "
+                        "residual in checkpoints (P-stacked f32 copy of "
+                        "every param — P x the param payload per save). "
+                        "Off by default: restore falls back to a zero "
+                        "residual, which a topology change forces anyway")
     t.add_argument("--ckpt-mirror", default=None, metavar="DIR",
                    help="replicate every checkpoint to DIR (atomic copy "
                         "after each save); restore falls back to the "
@@ -975,7 +981,8 @@ def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
         checkpoint_keep_every=getattr(args, "ckpt_keep_every", None),
         checkpoint_mirror=getattr(args, "ckpt_mirror", None),
         checkpoint_fault_hook=(injector.on_checkpoint_write
-                               if injector is not None else None))
+                               if injector is not None else None),
+        checkpoint_save_ef=getattr(args, "ckpt_save_ef", False))
     max_restarts = getattr(args, "max_restarts", 0)
     try:
         if max_restarts <= 0 and injector is None:

@@ -64,9 +64,16 @@ __all__ = ["EVENT_TYPES", "EventLog", "install", "get_event_log", "emit",
 # worker.py). fleet: a supervision lifecycle action (spawn/death/eject/
 # restart — serving/fleet.py). alert: an SLO or canary-verdict breach/
 # resolution (obs/slo.py, router rollback) — the typed record the
-# flight recorder and /alerts surface.
+# flight recorder and /alerts surface. comms_profile: a compiled step's
+# static per-collective traffic profile (obs/timeline.py). bench: one
+# bench.py measurement record riding the run's stream. Both were
+# emitted-but-undeclared until the telemetry-schema lint (ISSUE 13)
+# made every literal emit type check against this tuple; runtime still
+# accepts unknown types (extensibility), the LINTER is now the typo
+# guard.
 EVENT_TYPES = ("step", "retry", "divergence", "restart", "checkpoint",
-               "compile", "trace", "span", "rollout", "fleet", "alert")
+               "compile", "trace", "span", "rollout", "fleet", "alert",
+               "comms_profile", "bench")
 
 
 class EventLog:

@@ -32,6 +32,7 @@ from ..ops.infonce_pallas import (
 )
 from ..ops.ntxent_pallas import ntxent_partial_fused
 from .mesh import all_gather as _all_gather_acct
+from .mesh import axis_index as _axis_index_compat
 from .mesh import local_row_gids
 from .mesh import psum as _psum_acct
 from .mesh import shard_map as _shard_map_compat
@@ -160,7 +161,7 @@ def local_infonce_allgather(za_local, zb_local, scale, axis,
     za_g = _all_gather_acct(za_local, axis, tiled=True)    # (N, D)
     zb_g = _all_gather_acct(zb_local, axis, tiled=True)
     n = za_g.shape[0]
-    d = jax.lax.axis_index(axis)
+    d = _axis_index_compat(axis)
     gid = d * n_local + jnp.arange(n_local, dtype=jnp.int32)
     loss_a = info_nce_partial_fused(za_local, zb_g, gid, scale=scale,
                                     interpret=interpret)
@@ -184,7 +185,7 @@ def local_infonce_dual(za_local, zb_local, scale, axis, interpret=None):
     n_local = za_local.shape[0]
     zb_g = _all_gather_acct(zb_local, axis, tiled=True)     # (N, D)
     n = zb_g.shape[0]
-    d = jax.lax.axis_index(axis)
+    d = _axis_index_compat(axis)
     gid = d * n_local + jnp.arange(n_local, dtype=jnp.int32)
     part = info_nce_dual_partial(za_local, zb_g, gid, axis, scale=scale,
                                  interpret=interpret)

@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from .mesh import all_to_all as _all_to_all_acct
+from .mesh import axis_index as _axis_index_compat
 from .mesh import axis_size as _axis_size_compat
 from .mesh import pmean as _pmean_acct
 from .mesh import shard_map as _shard_map_compat
@@ -166,7 +167,7 @@ def switch_moe(params: MoEParams, x: jax.Array, *,
         # device keeps only its experts' rows, from every device.
         xin = _all_to_all_acct(xin, axis, split_axis=0, concat_axis=1,
                                  tiled=True)
-        i = jax.lax.axis_index(axis)
+        i = _axis_index_compat(axis)
         sl = e // p
         w_up = jax.lax.dynamic_slice_in_dim(w_up, i * sl, sl, 0)
         b_up = jax.lax.dynamic_slice_in_dim(b_up, i * sl, sl, 0)

@@ -43,6 +43,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.ntxent_pallas import block_grads_dual, block_lse_dual
 from .mesh import all_gather as _all_gather_acct
+from .mesh import axis_index as _axis_index_compat
 from .mesh import local_row_gids
 from .mesh import pmax as _pmax_acct
 from .mesh import psum as _psum_acct
@@ -108,7 +109,7 @@ def _make_pair_lse_sum(temperature: float, axis: str, num_devices: int,
     def _lse_all(z_local, my_gid):
         two_n_local = z_local.shape[0]
         two_n = two_n_local * num_devices
-        d = jax.lax.axis_index(axis)
+        d = _axis_index_compat(axis)
         z_g = _all_gather_acct(z_local, axis, tiled=True)
         lse_part = jnp.full((two_n,), _NEG_INF, jnp.float32)
         for k, w, ze, gid_e in _tiles(z_g, d, two_n_local):
@@ -139,7 +140,7 @@ def _make_pair_lse_sum(temperature: float, axis: str, num_devices: int,
         z_local, my_gid, z_g, lse_all = res
         two_n_local, dim = z_local.shape
         two_n = two_n_local * num_devices
-        d = jax.lax.axis_index(axis)
+        d = _axis_index_compat(axis)
         buf = jnp.zeros((two_n, dim), jnp.float32)
         for k, w, ze, gid_e in _tiles(z_g, d, two_n_local):
             gr, gc = block_grads_dual(

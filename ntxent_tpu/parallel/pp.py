@@ -35,6 +35,7 @@ from typing import Any, Callable, Sequence
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from .mesh import axis_index as _axis_index_compat
 from .mesh import comms_scaled as _comms_scaled
 from .mesh import ppermute as _ppermute_acct
 from .mesh import psum as _psum_acct
@@ -116,7 +117,7 @@ def make_gpipe(
     def body(stage_params, x):
         # Inside shard_map: params leaves are (1, ...) — this device's stage.
         local = jax.tree.map(lambda a: a[0], stage_params)
-        s = jax.lax.axis_index(axis)
+        s = _axis_index_compat(axis)
         batch = x.shape[0]
         if batch % m:
             raise ValueError(

@@ -233,8 +233,25 @@ class _Snapshot:
     topology: dict | None = None
 
 
-def snapshot_state(state: Any) -> _Snapshot:
+def snapshot_state(state: Any, *,
+                   keep_ef_residual: bool = False) -> _Snapshot:
     """Copy a (possibly device-resident) state pytree to host numpy.
+
+    Slim by DEFAULT (ISSUE 13 satellite, the ROADMAP item 1 follow-up):
+    a populated ``ef_residual`` field is dropped from the snapshot
+    BEFORE the device→host copy — the error-feedback residual is a
+    P-stacked float32 copy of every parameter — P× the f32 param
+    payload per save — holding carry-over compression noise that
+    restore resets to zeros on any topology change anyway. Dropping it
+    saves both the transfer and the disk; the tolerant restore path
+    (``_from_bytes_tolerant``) already fills the missing field with the
+    template's zeros. The default lives HERE, not only on the manager,
+    so the pre-snapshot donation pattern (``snap = snapshot_state(s)``
+    then ``manager.save(step, snap)`` — save's ``_Snapshot``
+    early-return never re-applies the manager's flag) gets the same
+    slim behavior. ``keep_ef_residual=True`` — what
+    ``CheckpointManager(save_ef_residual=True)`` passes — is the opt-in
+    for runs that want exact same-topology resume of the residual too.
 
     This is the only part of an async save that runs on the caller's
     thread: one device→host COPY, after which the training loop may
@@ -255,6 +272,12 @@ def snapshot_state(state: Any) -> _Snapshot:
     if isinstance(state, _Snapshot):
         return state
     state_dict = flax_ser.to_state_dict(state)
+    if not keep_ef_residual and isinstance(state_dict, dict) \
+            and state_dict.get("ef_residual") is not None:
+        # Pop only a POPULATED residual: a float32-era None field must
+        # keep round-tripping exactly as it always has.
+        state_dict = dict(state_dict)
+        state_dict.pop("ef_residual")
     topology = tree_partition_specs(state_dict)
 
     def to_host_copy(leaf):
@@ -500,8 +523,8 @@ def _from_bytes_tolerant(template: Any, blob: bytes) -> Any:
                 and "ef_residual" not in state_dict:
             logger.warning(
                 "checkpoint carries no error-feedback residual state "
-                "(saved before the field existed); starting at zero "
-                "residual")
+                "(slim save — the default — or saved before the field "
+                "existed); starting at zero residual")
             state_dict["ef_residual"] = template_sd["ef_residual"]
         elif "ef_residual" in state_dict \
                 and "ef_residual" not in template_sd:
@@ -616,10 +639,15 @@ class CheckpointManager:
                  verify_writes: bool = True,
                  keep_every: int | None = None,
                  mirror_dir: str | Path | None = None,
-                 fault_hook: Callable | None = None):
+                 fault_hook: Callable | None = None,
+                 save_ef_residual: bool = False):
         self.directory = Path(directory).absolute()
         self.retry_policy = retry_policy
         self.verify_writes = verify_writes
+        # Opt-in persistence of the P-stacked error-feedback residual
+        # (ISSUE 13 satellite): droppable carry-over noise by default —
+        # see snapshot_state.
+        self.save_ef_residual = save_ef_residual
         self.save_interval_steps = max(1, int(save_interval_steps))
         self.retention = RetentionPolicy(keep_last=max_to_keep,
                                          keep_every=keep_every)
@@ -930,7 +958,8 @@ class CheckpointManager:
             return False
         t0 = time.perf_counter()
         try:
-            snapshot = snapshot_state(state)
+            snapshot = snapshot_state(
+                state, keep_ef_residual=self.save_ef_residual)
             saved = self._call(self.manager.save, step, snapshot,
                                data_state=data_state, force=force)
         except (OSError, RetryBudgetExceeded) as e:
@@ -1322,7 +1351,8 @@ class AsyncCheckpointer:
             t0 = time.perf_counter()
             self._queue.join()
             _BLOCKED_MS.observe((time.perf_counter() - t0) * 1e3)
-        snapshot = snapshot_state(state)
+        snapshot = snapshot_state(
+            state, keep_ef_residual=self.manager.save_ef_residual)
         self._queue.put((step, snapshot, data_state, force,
                          time.perf_counter()))
         _QUEUE_DEPTH.set(self._queue.qsize())

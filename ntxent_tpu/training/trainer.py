@@ -114,10 +114,10 @@ class TrainState(train_state.TrainState):
     # (spec P(axis) on the stacked dim). None (the default) on
     # full-precision runs: no structural change anywhere.
     # ``init_error_feedback`` builds it; the sharded step threads it
-    # through shard_map as its own operand (like the guard's grad-scale)
-    # and it rides checkpoints like any other state field (old
-    # checkpoints restore to zero residual with a warning —
-    # checkpoint._from_bytes_tolerant).
+    # through shard_map as its own operand (like the guard's grad-scale).
+    # Checkpoints DROP it by default (slim saves, ISSUE 13 — restore
+    # falls back to zero residual via checkpoint._from_bytes_tolerant);
+    # CheckpointManager(save_ef_residual=True) opts back in.
     ef_residual: Any = None
 
 
@@ -180,13 +180,13 @@ def init_error_feedback(state: TrainState, mesh: Mesh,
     but the residual must exist before the first int8 step; a step
     without it falls back to quantization WITHOUT error feedback).
 
-    COST (documented tradeoff): the residual rides checkpoints like any
-    state field, and the host-gathered save pays P x the f32 param
-    payload for what is, on a topology change, reconstructible
-    carry-over noise (restore resets it to zeros). Persisting only the
-    local slice — or skipping it entirely behind a flag — is a noted
-    follow-up (ROADMAP item 1); at the tiny-model/P=8 scale this repo
-    measures, the save-size cost is dwarfed by the wire win."""
+    PERSISTENCE (ISSUE 13): checkpoints DROP the residual by default —
+    it is P x the f32 param payload of carry-over compression noise
+    that restore resets to zeros on any topology change anyway; the
+    tolerant restore path fills the missing field with this function's
+    zeros. Runs that want exact same-topology residual resume opt in
+    with ``CheckpointManager(save_ef_residual=True)`` /
+    ``--ckpt-save-ef``."""
     axes = (axis,) if isinstance(axis, str) else tuple(axis)
     p = 1
     for a in axes:
@@ -958,6 +958,7 @@ def fit(
     checkpoint_mirror: str | None = None,
     checkpoint_fault_hook: Callable | None = None,
     restore_step: int | None = None,
+    checkpoint_save_ef: bool = False,
 ):
     """Checkpoint-aware training: restore the latest checkpoint if one
     exists, train to ``num_steps`` total, save every ``checkpoint_every``
@@ -1049,7 +1050,8 @@ def fit(
                 max_to_keep=checkpoint_keep_last,
                 keep_every=checkpoint_keep_every,
                 mirror_dir=checkpoint_mirror,
-                fault_hook=checkpoint_fault_hook)
+                fault_hook=checkpoint_fault_hook,
+                save_ef_residual=checkpoint_save_ef)
             if async_checkpointing:
                 manager = AsyncCheckpointer(manager)
             if restore_step is not None or manager.latest_step() is not None:

@@ -1,0 +1,114 @@
+#!/usr/bin/env bash
+# Static-analysis gate (ISSUE 13): ntxent-lint over the whole repo must
+# report ZERO new findings against the committed lint_baseline.json —
+# the standing version of the PR 7 hand-audit (collective-shim
+# coverage) plus the host-sync / lock-discipline / import-boundary /
+# telemetry-schema invariants. Three phases, all fast (<20 s total, no
+# JAX import anywhere):
+#   1. Gate the real repo: rc 0, and the linting process must finish
+#      with `jax` absent from sys.modules (the analysis layer is pure
+#      stdlib by contract — a JAX import sneaking into it would drag
+#      backend init into every CI lint).
+#   2. Self-test the failure path: a doctored tree containing one
+#      violation per rule must exit rc 1 naming all five rules — a gate
+#      that cannot fail is not a gate.
+#   3. Self-test suppression: the same violations with `lint-ok`
+#      annotations must pass — the escape hatch must actually work.
+# Wired alongside bench_gate.sh as the CI static-analysis step.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+workdir="$(mktemp -d)"
+trap 'rm -rf "$workdir"' EXIT
+
+# Phase 1 — the real repo, under the committed baseline, JAX-free.
+start=$(date +%s)
+python - <<'PY'
+import sys
+
+from ntxent_tpu.analysis.cli import main
+
+rc = main([])
+assert rc == 0, f"ntxent-lint found NEW findings (rc={rc})"
+assert "jax" not in sys.modules, \
+    "the lint run imported jax — the analysis layer must be pure stdlib"
+print("lint gate: PASS on the repo (0 new findings, no jax import)")
+PY
+elapsed=$(( $(date +%s) - start ))
+[ "$elapsed" -lt 20 ] || { echo "lint gate exceeded 20 s ($elapsed s)"; exit 1; }
+
+# Phase 2 — one violation per rule must fail, naming all five rules.
+mkdir -p "$workdir/bad/ntxent_tpu/serving" "$workdir/bad/ntxent_tpu/obs"
+cat > "$workdir/bad/ntxent_tpu/serving/__init__.py" <<'EOF'
+EOF
+cat > "$workdir/bad/ntxent_tpu/__init__.py" <<'EOF'
+EOF
+cat > "$workdir/bad/ntxent_tpu/serving/router.py" <<'EOF'
+import time
+
+import jax  # import-boundary: the router tier must stay jax-free
+
+
+def psum_everywhere(x, axis):
+    return jax.lax.psum(x, axis)  # collective-shim
+
+
+def train_loop(state, batches):
+    for batch in batches:
+        state = step(state, batch)
+        log(int(state.step))  # host-sync
+
+
+class Cache:
+    def get(self):
+        with self._lock:
+            time.sleep(0.1)  # lock-discipline
+
+
+def publish(registry):
+    registry.counter("x_total", labels={"user_id": "per-request"})
+EOF
+rc=0
+python -m ntxent_tpu.analysis.cli --root "$workdir/bad" --no-baseline \
+    --format json >"$workdir/bad.json" || rc=$?
+[ "$rc" -eq 1 ] || { echo "lint gate did NOT fail on the doctored tree (rc=$rc)"; cat "$workdir/bad.json"; exit 1; }
+python - "$workdir/bad.json" <<'PY'
+import json
+import sys
+
+rec = json.load(open(sys.argv[1]))
+rules = {f["rule"] for f in rec["new"]}
+want = {"collective-shim", "host-sync", "lock-discipline",
+        "import-boundary", "telemetry-schema"}
+assert rules == want, f"rules fired: {sorted(rules)}, want {sorted(want)}"
+print(f"lint gate: FAIL path OK ({len(rec['new'])} findings, "
+      f"all 5 rules fired)")
+PY
+
+# Phase 3 — the same tree, suppressed line by line, must pass.
+python - "$workdir/bad/ntxent_tpu/serving/router.py" <<'PY'
+import sys
+
+path = sys.argv[1]
+marks = {
+    "import jax": "import-boundary",
+    "jax.lax.psum(x, axis)": "collective-shim",
+    "log(int(state.step))": "host-sync",
+    "time.sleep(0.1)": "lock-discipline",
+    '"user_id"': "telemetry-schema",
+}
+out = []
+for line in open(path):
+    for needle, rule in marks.items():
+        if needle in line:
+            line = (line.rstrip().split("  #")[0]
+                    + f"  # ntxent: lint-ok[{rule}] gate self-test\n")
+            break
+    out.append(line)
+open(path, "w").writelines(out)
+PY
+python -m ntxent_tpu.analysis.cli --root "$workdir/bad" --no-baseline \
+    >/dev/null || { echo "lint gate: suppressed tree still failed"; exit 1; }
+echo "lint gate: suppression path OK"
+
+echo "lint gate: OK"

@@ -1,0 +1,1 @@
+EVENT_TYPES = ("step", "checkpoint")

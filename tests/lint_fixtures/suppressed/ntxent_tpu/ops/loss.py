@@ -1,0 +1,7 @@
+import jax
+
+
+def reshard_heads(x, axis):
+    # ntxent: lint-ok[collective-shim] fixture: suppression must work
+    return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=True)

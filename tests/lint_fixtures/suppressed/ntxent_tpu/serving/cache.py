@@ -1,0 +1,14 @@
+import threading
+import time
+
+
+class EmbeddingCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def lookup(self, key):
+        with self._lock:
+            time.sleep(0.01)  # ntxent: lint-ok[lock-discipline] fixture
+            # ntxent: lint-ok[lock-discipline] fixture (line above form)
+            with open("/tmp/rows") as f:
+                return f.read()

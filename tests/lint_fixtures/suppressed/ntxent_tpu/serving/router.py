@@ -1,0 +1,3 @@
+import jax  # noqa: F401  # ntxent: lint-ok[import-boundary] fixture
+
+from . import cache  # noqa: F401

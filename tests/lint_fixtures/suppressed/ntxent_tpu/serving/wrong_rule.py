@@ -1,0 +1,13 @@
+"""A lint-ok naming the WRONG rule must not suppress (tests pin it)."""
+
+import threading
+import time
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def f(self):
+        with self._lock:
+            time.sleep(0.1)  # ntxent: lint-ok[host-sync] wrong rule

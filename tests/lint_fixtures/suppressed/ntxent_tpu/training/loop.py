@@ -1,0 +1,5 @@
+def train_loop(state, batches, step, log):
+    for batch in batches:
+        state = step(state, batch)
+        log(int(state.step))  # ntxent: lint-ok[host-sync] fixture
+    return state

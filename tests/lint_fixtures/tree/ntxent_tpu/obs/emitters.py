@@ -1,0 +1,10 @@
+"""telemetry-schema incident fixture: the typo'd event type is silent
+at runtime by design — only the linter can catch it."""
+
+from . import events
+
+
+def publish(registry):
+    events.emit("stepp", loss=0.0)          # typo'd event type
+    registry.counter("loss-total")          # exposition-illegal name
+    registry.gauge("queue_depth", labels={"tenant_id": "t0"})  # new key

@@ -1,0 +1,3 @@
+"""Fixture vocabulary the telemetry-schema checker extracts."""
+
+EVENT_TYPES = ("step", "checkpoint")

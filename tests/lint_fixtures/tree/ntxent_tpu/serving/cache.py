@@ -1,0 +1,16 @@
+"""lock-discipline incident fixture (PR 8): blocking work rode inside
+the cache lock, convoying every concurrent request."""
+
+import threading
+import time
+
+
+class EmbeddingCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def lookup(self, key):
+        with self._lock:
+            time.sleep(0.01)
+            with open("/tmp/rows") as f:
+                return f.read()

@@ -1,0 +1,6 @@
+"""import-boundary incident fixture (PR 8 pass 3): jax creeping into
+the deliberately JAX-free router tier."""
+
+import jax  # noqa: F401  — the leak
+
+from . import cache  # noqa: F401

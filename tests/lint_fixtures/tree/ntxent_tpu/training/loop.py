@@ -1,0 +1,10 @@
+"""host-sync incident fixture (PR 5): per-step int(state.step) forces
+a device round-trip inside the hot loop."""
+
+
+def train_loop(state, batches, step, log):
+    step_base = int(state.step)  # one sync at restore: legal
+    for batch in batches:
+        state = step(state, batch)
+        log(int(state.step))  # per-step sync
+    return state, step_base

@@ -14,6 +14,7 @@ against a real (but JAX-free) worker subprocess.
 from __future__ import annotations
 
 import json
+import os
 import signal
 import sys
 import textwrap
@@ -1370,8 +1371,15 @@ class TestServingFleet:
         # The ntxent-fleet router process must restart in milliseconds:
         # its entire import surface (cli + cache/router/fleet + obs +
         # faults) must not drag in JAX. Lazy package inits (PEP 562)
-        # keep this true — this test is the tripwire for an eager
-        # import sneaking back in anywhere on the chain.
+        # keep this true — this test is the END-TO-END proof, and since
+        # ISSUE 13 no longer the only one: the static import-boundary
+        # checker (ntxent_tpu/analysis) walks the same graph at lint
+        # time and names the culprit file:line when it trips. The
+        # agreement assertion below is what keeps the two from
+        # drifting: every module the runtime actually loads must be in
+        # the checker's statically reachable set, so a module that
+        # sneaks onto the runtime chain without static coverage fails
+        # HERE even while both proofs individually pass.
         import subprocess
         r = subprocess.run(
             [sys.executable, "-c",
@@ -1382,9 +1390,24 @@ class TestServingFleet:
              "from ntxent_tpu import obs\n"
              "from ntxent_tpu.resilience import FaultInjector, "
              "FaultPlan\n"
-             "assert 'jax' not in sys.modules, 'jax leaked'\n"],
+             "assert 'jax' not in sys.modules, 'jax leaked'\n"
+             "print('\\n'.join(sorted(m for m in sys.modules\n"
+             "                        if m.startswith('ntxent_tpu'))))\n"],
             capture_output=True, text=True, timeout=120)
         assert r.returncode == 0, r.stderr
+        from ntxent_tpu.analysis import reachable_modules
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        static = set(reachable_modules(root=repo_root))
+        loaded = {m for m in r.stdout.split() if m}
+        assert loaded, "tripwire subprocess printed no module list"
+        missing = loaded - static
+        assert not missing, (
+            "runtime router tier loaded modules the static "
+            f"import-boundary checker does not reach: {sorted(missing)}"
+            " — add them to LintConfig.boundary_roots (or fix the "
+            "import that pulled them in)")
 
     def test_chaos_killworker_fires_on_the_named_tick(self, tmp_path):
         inj = FaultInjector(FaultPlan.parse("killworker@3"))

@@ -1,0 +1,355 @@
+"""ntxent-lint: the five incident-derived checkers, the suppression +
+baseline mechanics, and the repo-wide standing guarantees (ISSUE 13).
+
+Fixture trees under tests/lint_fixtures/ mirror the real package
+layout so the DEFAULT LintConfig runs against them unchanged:
+
+* ``tree/`` — one violation per rule, each reproducing its originating
+  incident (unshimmed all_to_all, per-step int(state.step), sleep/open
+  under a serving lock, ``import jax`` on the router chain, a typo'd
+  event type + illegal metric name + unreviewed label key);
+* ``suppressed/`` — the same violations with ``lint-ok`` annotations,
+  plus one annotated with the WRONG rule (must still fire).
+
+The repo-wide tests are the PR's contract: zero new findings against
+the committed baseline, and the collective-shim rule specifically at
+ZERO findings total — the PR 7 hand-audit as a machine invariant.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from ntxent_tpu.analysis import (
+    LintConfig,
+    compare_with_baseline,
+    load_baseline,
+    reachable_modules,
+    run_lint,
+    write_baseline,
+)
+from ntxent_tpu.analysis.cli import BASELINE_NAME, main as lint_main
+
+pytestmark = pytest.mark.lint
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+ALL_RULES = {"collective-shim", "host-sync", "lock-discipline",
+             "import-boundary", "telemetry-schema"}
+
+
+def _fixture_result(tree: str, rules=None):
+    return run_lint(LintConfig(root=str(FIXTURES / tree)), rules=rules)
+
+
+# ---------------------------------------------------------------------------
+# each rule fires on its originating incident
+
+
+class TestRulesFire:
+    def test_every_rule_fires_on_the_fixture_tree(self):
+        result = _fixture_result("tree")
+        assert not result.parse_errors
+        assert {f.rule for f in result.findings} == ALL_RULES
+        assert not result.suppressed
+
+    def test_collective_shim_names_the_unshimmed_op(self):
+        [f] = _fixture_result("tree", rules=("collective-shim",)).findings
+        assert f.path == "ntxent_tpu/ops/loss.py"
+        assert "all_to_all" in f.message and "mesh" in f.message
+
+    def test_host_sync_flags_only_the_in_loop_sync(self):
+        [f] = _fixture_result("tree", rules=("host-sync",)).findings
+        # Line 6 (`int(state.step)` BEFORE the loop — the legal
+        # restore-time sync) must not fire; line 9 (per-step) must.
+        assert f.path == "ntxent_tpu/training/loop.py" and f.line == 9
+
+    def test_lock_discipline_flags_sleep_and_open_under_lock(self):
+        fs = _fixture_result("tree", rules=("lock-discipline",)).findings
+        assert [f.path for f in fs] == ["ntxent_tpu/serving/cache.py"] * 2
+        assert {m for f in fs for m in (f.message.split("`")[1],)} == \
+            {"time.sleep()", "open()"}
+
+    def test_import_boundary_names_module_and_chain(self):
+        [f] = _fixture_result("tree", rules=("import-boundary",)).findings
+        assert f.path == "ntxent_tpu/serving/router.py"
+        assert "`jax`" in f.message
+        assert "ntxent_tpu.serving.router" in f.message
+        # The unreachable ops/loss.py also imports jax at module level:
+        # reachability, not mere presence, is what the rule checks.
+        reach = reachable_modules(root=str(FIXTURES / "tree"))
+        assert "ntxent_tpu.ops.loss" not in reach
+        assert "ntxent_tpu.serving.cache" in reach  # via router
+
+    def test_collective_shim_sees_through_aliases(self, tmp_path):
+        # Review-hardening: `import jax.lax as foo; foo.psum(...)` must
+        # not defeat the rule, or the repo-wide zero-findings test
+        # proves less than it claims.
+        pkg = tmp_path / "ntxent_tpu"
+        pkg.mkdir()
+        (pkg / "aliased.py").write_text(
+            "import jax.lax as foo\n"
+            "from jax import lax as jl\n"
+            "import jax as j\n\n\n"
+            "def f(x, axis):\n"
+            "    a = foo.psum(x, axis)\n"
+            "    b = jl.pmax(x, axis)\n"
+            "    c = j.lax.all_gather(x, axis)\n"
+            "    return a, b, c\n")
+        result = run_lint(LintConfig(root=str(tmp_path)),
+                          rules=("collective-shim",))
+        assert sorted(f.message.split("`")[1] for f in result.findings) \
+            == ["foo.psum", "j.lax.all_gather", "jl.pmax"]
+
+    def test_telemetry_schema_sees_registry_aliases(self, tmp_path):
+        # Review-hardening: the repo's dominant spelling is
+        # `r = self.registry; r.counter(...)` — the receiver heuristic
+        # must see through the one-assignment hop.
+        pkg = tmp_path / "ntxent_tpu"
+        pkg.mkdir()
+        (pkg / "metrics_like.py").write_text(
+            "class M:\n"
+            "    def setup(self):\n"
+            "        r = self.registry\n"
+            "        r.gauge('bad-name!', labels={'tenant_id': 't'})\n"
+            "        merged = MetricsRegistry()\n"
+            "        merged.counter('x_total', labels={'user_id': 'u'})\n")
+        result = run_lint(LintConfig(root=str(tmp_path)),
+                          rules=("telemetry-schema",))
+        msgs = " | ".join(f.message for f in result.findings)
+        assert "'bad-name!'" in msgs
+        assert "'tenant_id'" in msgs and "'user_id'" in msgs
+
+    def test_import_boundary_sees_module_level_loop_bodies(
+            self, tmp_path):
+        # Review-hardening: module-level for/while bodies run at import
+        # time — an `import jax` hidden in one must still fire.
+        pkg = tmp_path / "ntxent_tpu" / "serving"
+        pkg.mkdir(parents=True)
+        (tmp_path / "ntxent_tpu" / "__init__.py").write_text("")
+        (pkg / "__init__.py").write_text("")
+        (pkg / "router.py").write_text(
+            "for _ in range(1):\n    import jax\n")
+        result = run_lint(LintConfig(root=str(tmp_path)),
+                          rules=("import-boundary",))
+        assert [f.path for f in result.findings] \
+            == ["ntxent_tpu/serving/router.py"]
+
+    def test_lock_discipline_requires_a_word_boundary(self, tmp_path):
+        # Review-hardening: `clock`/`blocked`/`blocklist` are not locks;
+        # `_lock`/`label_lock`/`rlock` are.
+        pkg = tmp_path / "ntxent_tpu" / "serving"
+        pkg.mkdir(parents=True)
+        (pkg / "timers.py").write_text(
+            "import time\n\n\n"
+            "class C:\n"
+            "    def tick(self):\n"
+            "        with self.clock:\n"
+            "            time.sleep(0.1)\n"
+            "        with self.blocked_queue:\n"
+            "            time.sleep(0.1)\n"
+            "        with self.rlock:\n"
+            "            time.sleep(0.1)\n")
+        result = run_lint(LintConfig(root=str(tmp_path)),
+                          rules=("lock-discipline",))
+        assert len(result.findings) == 1  # only the rlock body
+
+    def test_telemetry_schema_flags_type_name_and_label(self):
+        fs = _fixture_result("tree", rules=("telemetry-schema",)).findings
+        msgs = " | ".join(f.message for f in fs)
+        assert "'stepp'" in msgs            # typo'd event type
+        assert "'loss-total'" in msgs       # exposition-illegal name
+        assert "'tenant_id'" in msgs        # unreviewed label key
+
+
+# ---------------------------------------------------------------------------
+# suppression mechanics
+
+
+class TestSuppression:
+    def test_lint_ok_suppresses_every_rule(self):
+        result = _fixture_result("suppressed")
+        assert {f.rule for f in result.suppressed} == ALL_RULES
+        # Only the deliberately wrong-rule annotation stays active.
+        assert [f.path for f in result.findings] == \
+            ["ntxent_tpu/serving/wrong_rule.py"]
+
+    def test_lint_ok_on_the_wrong_rule_still_fails(self):
+        [f] = _fixture_result(
+            "suppressed", rules=("lock-discipline",)).findings
+        assert f.path == "ntxent_tpu/serving/wrong_rule.py"
+        # The annotation names host-sync; the finding is lock-discipline.
+        assert f.rule == "lock-discipline"
+        assert "lint-ok[host-sync]" in f.snippet
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+
+
+class TestBaseline:
+    def test_baselined_finding_passes_new_finding_fails(self, tmp_path):
+        findings = _fixture_result("tree").findings
+        path = tmp_path / "baseline.json"
+        write_baseline(str(path), findings)
+        baseline = load_baseline(str(path))
+        new, accepted, stale = compare_with_baseline(findings, baseline)
+        assert not new and not stale and len(accepted) == len(findings)
+        # One MORE finding of an already-baselined kind is still new:
+        # the baseline is count-keyed, not kind-keyed.
+        extra = findings + [findings[0]]
+        new, accepted, stale = compare_with_baseline(extra, baseline)
+        assert new == [findings[0]] and not stale
+
+    def test_write_baseline_preserves_written_reasons(self, tmp_path):
+        # Review-hardening: regenerating the baseline to accept a new
+        # finding must not clobber justifications already written for
+        # the existing entries.
+        findings = _fixture_result("tree").findings
+        path = tmp_path / "baseline.json"
+        write_baseline(str(path), findings[:1])
+        data = json.loads(path.read_text())
+        data["findings"][0]["reason"] = "kept: measured, accepted"
+        path.write_text(json.dumps(data))
+        write_baseline(str(path), findings[:2])
+        entries = {(e["rule"], e["path"], e["snippet"]): e["reason"]
+                   for e in json.loads(path.read_text())["findings"]}
+        assert entries[findings[0].key()] == "kept: measured, accepted"
+        assert entries[findings[1].key()].startswith("TODO")
+
+    def test_stale_baseline_entries_are_reported(self, tmp_path):
+        findings = _fixture_result("tree").findings
+        path = tmp_path / "baseline.json"
+        write_baseline(str(path), findings)
+        fixed = findings[1:]  # first finding's fix landed
+        new, _accepted, stale = compare_with_baseline(
+            fixed, load_baseline(str(path)))
+        assert not new
+        assert stale == [findings[0].key()]
+
+    def test_cli_gate_end_to_end(self, tmp_path, capsys):
+        root = tmp_path / "repo"
+        shutil.copytree(FIXTURES / "tree", root)
+        baseline = root / BASELINE_NAME
+        # No baseline: everything is new -> rc 1.
+        assert lint_main(["--root", str(root)]) == 1
+        # Accept the debt, rerun: rc 0.
+        assert lint_main(["--root", str(root), "--write-baseline"]) == 0
+        assert lint_main(["--root", str(root)]) == 0
+        entries = json.loads(baseline.read_text())["findings"]
+        assert all("reason" in e for e in entries)
+        # A new violation on top of the baseline: rc 1, names only it.
+        bad = root / "ntxent_tpu" / "ops" / "fresh.py"
+        bad.write_text("import jax\n\n\ndef f(x, axis):\n"
+                       "    return jax.lax.pmax(x, axis)\n")
+        capsys.readouterr()
+        assert lint_main(["--root", str(root)]) == 1
+        out = capsys.readouterr().out
+        assert "fresh.py" in out and "pmax" in out
+        # Fix a baselined finding: rc 0, stale entry reported.
+        (root / "ntxent_tpu" / "ops" / "fresh.py").unlink()
+        (root / "ntxent_tpu" / "training" / "loop.py").write_text(
+            "def train_loop(state, batches, step):\n"
+            "    for batch in batches:\n"
+            "        state = step(state, batch)\n"
+            "    return state\n")
+        capsys.readouterr()
+        assert lint_main(["--root", str(root)]) == 0
+        assert "stale baseline entry" in capsys.readouterr().err
+
+
+    def test_rules_subset_does_not_clobber_or_stale_other_debt(
+            self, tmp_path, capsys):
+        # Review-hardening: a --rules-scoped run only re-decides the
+        # selected rules — it must neither drop other rules' baseline
+        # entries on --write-baseline nor report them as stale.
+        root = tmp_path / "repo"
+        shutil.copytree(FIXTURES / "tree", root)
+        assert lint_main(["--root", str(root), "--write-baseline"]) == 0
+        baseline = root / BASELINE_NAME
+        full = json.loads(baseline.read_text())["findings"]
+        assert len({e["rule"] for e in full}) == len(ALL_RULES)
+        # Read-only scoped run: rc 0, no stale chatter about the
+        # unselected rules' live entries.
+        capsys.readouterr()
+        assert lint_main(["--root", str(root),
+                          "--rules", "collective-shim"]) == 0
+        assert "stale baseline entry" not in capsys.readouterr().err
+        # Scoped rewrite: every other rule's entry survives.
+        assert lint_main(["--root", str(root),
+                          "--rules", "collective-shim",
+                          "--write-baseline"]) == 0
+        after = json.loads(baseline.read_text())["findings"]
+        assert {(e["rule"], e["path"], e["snippet"]) for e in after} \
+            == {(e["rule"], e["path"], e["snippet"]) for e in full}
+        assert lint_main(["--root", str(root)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# repo-wide standing guarantees (the gate tier-1 actually enforces)
+
+
+class TestRepoClean:
+    def test_repo_has_no_new_findings_against_committed_baseline(self):
+        result = run_lint(LintConfig(root=str(REPO)))
+        assert not result.parse_errors, result.parse_errors
+        baseline_path = REPO / BASELINE_NAME
+        assert baseline_path.is_file(), \
+            "lint_baseline.json must be committed at the repo root"
+        new, _accepted, stale = compare_with_baseline(
+            result.findings, load_baseline(str(baseline_path)))
+        assert not new, "NEW lint findings:\n" + "\n".join(
+            f.format() for f in new)
+        assert not stale, f"stale baseline entries (remove them): {stale}"
+
+    def test_zero_unshimmed_collectives_repo_wide(self):
+        # The PR 7 hand-audit as a standing machine guarantee: not one
+        # raw lax collective outside parallel/mesh.py — not even a
+        # suppressed or baselined one.
+        result = run_lint(LintConfig(root=str(REPO)),
+                          rules=("collective-shim",))
+        assert not result.findings, "\n".join(
+            f.format() for f in result.findings)
+        assert not result.suppressed, "\n".join(
+            f.format() for f in result.suppressed)
+
+    def test_static_event_types_match_runtime(self):
+        from ntxent_tpu.analysis.telemetry import _extract_event_types
+        from ntxent_tpu.obs.events import EVENT_TYPES
+
+        cfg = LintConfig(root=str(REPO))
+        from ntxent_tpu.analysis.framework import SourceFile
+
+        path = REPO / cfg.events_path
+        src = SourceFile(str(path), cfg.events_path, path.read_text())
+        assert _extract_event_types(src) == EVENT_TYPES
+
+    def test_metric_name_rule_matches_registry(self):
+        # telemetry.py keeps a literal mirror of the registry's
+        # exposition-legality regex (importing the package from the
+        # linter would defeat its stdlib-only contract); this is the
+        # promised sync pin.
+        from ntxent_tpu.analysis.telemetry import _NAME_OK
+        from ntxent_tpu.obs.registry import _NAME_OK as _RUNTIME_OK
+
+        assert _NAME_OK.pattern == _RUNTIME_OK.pattern
+
+    def test_lint_process_never_imports_jax(self):
+        # The analysis layer is pure stdlib BY CONTRACT (lint_gate.sh
+        # runs it in CI processes that must not pay backend init).
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import sys\n"
+             "from ntxent_tpu.analysis.cli import main\n"
+             "rc = main(['--root', sys.argv[1]])\n"
+             "assert rc == 0, rc\n"
+             "assert 'jax' not in sys.modules, 'jax leaked into lint'\n",
+             str(REPO)],
+            capture_output=True, text=True, timeout=120,
+            cwd=str(REPO), env={**os.environ})
+        assert r.returncode == 0, r.stderr

@@ -388,6 +388,83 @@ class TestErrorFeedback:
         finally:
             mgr.close()
 
+    def test_slim_ef_persistence_is_the_default(self, tmp_path):
+        # ISSUE 13 satellite (ROADMAP item 1 follow-up): checkpoints no
+        # longer carry the P-stacked f32 residual unless opted in — the
+        # save-size drop must be real (~the P x param payload) and the
+        # slim save must restore to a zero residual with params intact.
+        from ntxent_tpu.models import ResNet, SimCLRModel
+        from ntxent_tpu.training import (
+            TrainerConfig,
+            create_train_state,
+            init_error_feedback,
+        )
+        from ntxent_tpu.training.checkpoint import CheckpointManager
+
+        def dir_bytes(root):
+            return sum(p.stat().st_size for p in root.rglob("*")
+                       if p.is_file())
+
+        m = _mesh()
+        p = jax.device_count()
+        enc = functools.partial(ResNet, stage_sizes=(1,),
+                                small_images=True)
+        model = SimCLRModel(encoder=enc, proj_hidden_dim=16, proj_dim=8)
+        cfg = TrainerConfig(batch_size=8, total_steps=4, warmup_steps=1)
+        state = init_error_feedback(pm.replicate_state(
+            create_train_state(model, jax.random.PRNGKey(0),
+                               (1, 8, 8, 3), cfg), m), m)
+        # Make the residual nonzero so "restores to zeros" is a real
+        # statement about the slim save, not about fresh zeros.
+        state = state.replace(ef_residual=jax.tree.map(
+            lambda t: t + 1.0, state.ef_residual))
+        param_bytes = sum(
+            leaf.size * 4 for leaf in
+            jax.tree_util.tree_leaves(jax.tree.map(np.asarray,
+                                                   state.params)))
+
+        # The pre-snapshot donation pattern (snap = snapshot_state(s);
+        # manager.save(step, snap)) must get the same slim default —
+        # save's _Snapshot early-return never re-applies the manager
+        # flag, so the default lives on snapshot_state itself.
+        from ntxent_tpu.training.checkpoint import snapshot_state
+
+        assert "ef_residual" not in snapshot_state(state).state_dict
+        assert snapshot_state(
+            state, keep_ef_residual=True
+        ).state_dict.get("ef_residual") is not None
+
+        slim_dir, full_dir = tmp_path / "slim", tmp_path / "full"
+        slim = CheckpointManager(str(slim_dir))  # default: slim
+        full = CheckpointManager(str(full_dir), save_ef_residual=True)
+        try:
+            assert slim.save(1, state, force=True)
+            assert full.save(1, state, force=True)
+            slim_sz, full_sz = dir_bytes(slim_dir), dir_bytes(full_dir)
+            # The drop is the stacked residual: P x f32 param payload.
+            assert full_sz - slim_sz > 0.8 * p * param_bytes, \
+                (slim_sz, full_sz, p * param_bytes)
+
+            template = init_error_feedback(pm.replicate_state(
+                create_train_state(model, jax.random.PRNGKey(1),
+                                   (1, 8, 8, 3), cfg), m), m)
+            restored = slim.restore(template)
+            assert all(not np.any(np.asarray(leaf)) for leaf in
+                       jax.tree_util.tree_leaves(restored.ef_residual))
+            p0 = jax.tree_util.tree_leaves(state.params)[0]
+            pr = jax.tree_util.tree_leaves(restored.params)[0]
+            np.testing.assert_allclose(np.asarray(pr), np.asarray(p0))
+            # The opt-in save round-trips the residual exactly.
+            kept = full.restore(template)
+            for a, b in zip(
+                    jax.tree_util.tree_leaves(state.ef_residual),
+                    jax.tree_util.tree_leaves(kept.ef_residual)):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+        finally:
+            slim.close()
+            full.close()
+
 
 # ---------------------------------------------------------------------------
 # serving: the int8 rung
