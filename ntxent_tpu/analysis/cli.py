@@ -71,8 +71,11 @@ def _parse_args(argv):
     parser.add_argument("--write-baseline", action="store_true",
                         help="accept the current findings into the "
                              "baseline file and exit 0")
-    parser.add_argument("--format", choices=("text", "json"),
-                        default="text")
+    parser.add_argument("--format", choices=("text", "json", "github"),
+                        default="text",
+                        help="github = workflow-command annotations "
+                             "(::error file=...) via the reporter "
+                             "shared with ntxent-audit")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule table and exit")
     parser.add_argument("--boundary-modules", action="store_true",
@@ -148,6 +151,14 @@ def main(argv=None) -> int:
             "parse_errors": [list(p) for p in result.parse_errors],
             "elapsed_s": round(elapsed, 3),
         }, indent=2))
+    elif args.format == "github":
+        from .reporting import print_github
+
+        print_github(new, "ntxent-lint", stale=stale,
+                     parse_errors=result.parse_errors)
+        print(f"ntxent-lint: {len(new)} new, {len(accepted)} baselined,"
+              f" {len(result.suppressed)} suppressed ({elapsed:.2f}s)",
+              file=sys.stderr)
     else:
         for f in new:
             print(f.format())

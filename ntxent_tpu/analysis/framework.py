@@ -120,6 +120,9 @@ class LintConfig:
     label_vocab: tuple[str, ...] = (
         "op", "axis", "dtype", "stage", "run_id", "reason", "instance",
         "bucket", "slo", "rows", "mode", "worker",
+        # ISSUE 14: collective_graph_bytes_total{source=ad|gspmd} — a
+        # two-value closed set naming who inserted the traffic.
+        "source",
     )
 
 

@@ -1,0 +1,29 @@
+"""ntxent-audit: graph-level program audit (ISSUE 14).
+
+Where ``ntxent-lint`` (the sibling package) guards the *source*, this
+package audits the *traced program*: the jaxpr and compiled-HLO truth
+the source-level rules cannot see. Four analyzers, sharing the lint
+framework's finding/baseline machinery and output formats:
+
+* ``collective-census`` (census.py) — every collective in the graph,
+  with scan trip counts, priced by the same ring byte model as the
+  mesh shims; cross-checked against the shim-declared sites, with the
+  AD-dual / GSPMD remainders published to /metrics.
+* ``wire-dtype`` (wiredtype.py) — under an int8/bf16 policy, no
+  eligible-sized collective may carry f32 on the wire.
+* ``donation`` (donation.py) — declared donations that XLA can never
+  alias, and donated buffers returned as outputs (the PR 1 / PR 5
+  incident class).
+* ``recompile-cause`` (recompile.py) — lowering-signature diffs so
+  serving ``compile`` events carry a cause; the analyzer flags
+  cause-less serving compiles and same-signature churn in an event
+  stream.
+
+IMPORT DISCIPLINE: this ``__init__`` stays empty of imports — the
+parent ``ntxent_tpu.analysis`` package is on the JAX-free
+import-boundary roots, and the census/donation modules here import jax
+at module level. Import submodules explicitly
+(``from ntxent_tpu.analysis.graph import census``); ``recompile`` is
+itself pure stdlib (the serving engine and the event-log analyzer both
+use it without paying for the rest).
+"""

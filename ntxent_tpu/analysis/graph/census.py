@@ -1,0 +1,440 @@
+"""Collective census: every collective in the TRACED program, not just
+the shim-declared call sites.
+
+The PR 7 comms accounting records collectives where the *python* call
+site runs through a ``parallel/mesh.py`` shim — which is exactly once
+per trace, and only for the forward-traced sites. Two whole classes of
+real wire traffic are invisible to it:
+
+* **AD duals** — the reduce-scatter behind an ``all_gather``'s
+  gradient, the broadcast behind a ``psum``'s, and (on the old-jax
+  shard_map transpose) the residual recompute inside the transposed
+  shard_map. These are built by JAX's transpose rules from the jaxpr,
+  never by re-running the python body, so no shim fires.
+* **GSPMD-inserted collectives** — the TP/FSDP parameter gathers and
+  gradient reductions the XLA partitioner materializes from sharding
+  constraints. They exist only in the compiled module.
+
+This module counts both. ``jaxpr_census`` walks a ``ClosedJaxpr``
+(recursing into scan/cond/while/pjit/custom_vjp/shard_map sub-jaxprs,
+multiplying scanned bodies by their trip count — the graph-level
+counterpart of ``mesh.comms_scaled``) and prices every collective eqn
+with the SAME ring-algorithm byte model the shims use, at the operand's
+actual on-wire dtype. ``hlo_census`` does the regex half over compiled
+StableHLO/HLO text, which is where GSPMD collectives live (EQuARX does
+this verification *inside* XLA; the detection half is doable from the
+lowered text). ``census_of_callable`` brackets a trace with
+``CommsAccounting`` so the census can be cross-checked against the
+declared sites — equality for forward float32 graphs, and a published
+remainder (``collective_graph_bytes_total{source="ad"|"gspmd"}``) for
+everything the shims cannot see.
+
+Everything here is TRACE-ONLY (``jax.make_jaxpr``): no device math, so
+the census runs under ``JAX_PLATFORMS=cpu`` and rides tier-1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import re
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "CensusEntry",
+    "RING_FACTORS",
+    "jaxpr_census",
+    "hlo_census",
+    "census_totals",
+    "census_bytes",
+    "census_of_callable",
+    "graph_remainder",
+    "publish_graph_census",
+]
+
+# Ring-algorithm per-device byte factors, keyed by the CANONICAL op
+# name (the shims' spelling). Payload B is the eqn's summed operand
+# bytes; P the axis group size. MUST stay equal to the lambdas in
+# parallel/mesh.py — tests/test_graph_audit.py pins census totals
+# against the declared accounting, which is how the two models are
+# held together.
+RING_FACTORS = {
+    "all_gather": lambda b, p: (p - 1) * b,
+    "psum": lambda b, p: 2.0 * (p - 1) / p * b,
+    "pmax": lambda b, p: 2.0 * (p - 1) / p * b,
+    "pmin": lambda b, p: 2.0 * (p - 1) / p * b,
+    "psum_scatter": lambda b, p: (p - 1) / p * b,
+    "all_to_all": lambda b, p: (p - 1) / p * b,
+    "ppermute": lambda b, p: float(b),
+}
+
+# jaxpr primitive name -> canonical op name. psum2 is the
+# check_rep-rewrite spelling of psum; reduce_scatter is what
+# lax.psum_scatter binds. Annotation-only primitives (pbroadcast /
+# pvary / pcast / axis_index) move no data and are skipped entirely —
+# the shims record pcast at 0 bytes for the same reason, and the
+# cross-check compares byte-moving ops only.
+_PRIM_TO_OP = {
+    "psum": "psum",
+    "psum2": "psum",
+    "pmax": "pmax",
+    "pmin": "pmin",
+    "all_gather": "all_gather",
+    "reduce_scatter": "psum_scatter",
+    "all_to_all": "all_to_all",
+    "ppermute": "ppermute",
+}
+
+# HLO instruction name -> canonical op. all-reduce covers psum/pmax
+# (the reduction computation is opaque at this granularity — the byte
+# model is identical anyway).
+_HLO_TO_OP = {
+    "all-reduce": "psum",
+    "all-gather": "all_gather",
+    "reduce-scatter": "psum_scatter",
+    "all-to-all": "all_to_all",
+    "collective-permute": "ppermute",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class CensusEntry:
+    """One collective in the graph: op, axis label, payload identity,
+    modeled per-device wire bytes, and how many times it EXECUTES
+    (trip-count multipliers folded in). ``source`` is "jaxpr" or
+    "hlo"; ``unbounded`` marks entries under a ``while`` whose trip
+    count the census cannot know (counted once, flagged)."""
+
+    op: str
+    axis: str
+    shape: tuple[int, ...]
+    dtype: str
+    calls: int
+    bytes_per_call: float
+    source: str = "jaxpr"
+    unbounded: bool = False
+
+    @property
+    def nelems(self) -> int:
+        n = 1
+        for d in self.shape:
+            n *= int(d)
+        return n
+
+    @property
+    def total_bytes(self) -> float:
+        return self.bytes_per_call * self.calls
+
+
+def _as_jaxpr(x):
+    """Jaxpr from Jaxpr-or-ClosedJaxpr (None otherwise)."""
+    inner = getattr(x, "jaxpr", x)
+    return inner if hasattr(inner, "eqns") else None
+
+
+def _eqn_axes(params) -> tuple[str, ...]:
+    axes = params.get("axes", params.get("axis_name", ()))
+    if isinstance(axes, str):
+        return (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+def _eqn_payload(eqn) -> tuple[float, tuple[int, ...], str]:
+    """(bytes, shape, dtype name) summed over the eqn's array operands
+    — the operand side is the payload in every ring formula (the local
+    shard for all_gather, the full pre-scatter buffer for
+    reduce-scatter)."""
+    total = 0.0
+    shape: tuple[int, ...] = ()
+    dtypes = set()
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        dt = getattr(aval, "dtype", None)
+        if aval is None or dt is None:
+            continue
+        n = 1
+        for d in getattr(aval, "shape", ()):
+            n *= int(d)
+        total += float(n) * dt.itemsize
+        if not shape:
+            shape = tuple(int(d) for d in getattr(aval, "shape", ()))
+        dtypes.add(dt.name)
+    if not dtypes:
+        dtype = "none"
+    elif len(dtypes) == 1:
+        dtype = dtypes.pop()
+    else:
+        dtype = "mixed"
+    return total, shape, dtype
+
+
+def _group_size(params, axes, axis_sizes) -> int | None:
+    """Axis group size for a collective eqn: the explicit
+    ``axis_size`` param where the primitive carries one (all_gather /
+    reduce_scatter), else the product of the ambient mesh's sizes for
+    the named axes (threaded down from the enclosing shard_map)."""
+    if params.get("axis_size") is not None:
+        return int(params["axis_size"])
+    p = 1
+    for a in axes:
+        if a not in axis_sizes:
+            return None
+        p *= int(axis_sizes[a])
+    return p if axes else None
+
+
+def jaxpr_census(closed_jaxpr, axis_sizes: dict | None = None,
+                 _mult: int = 1, _unbounded: bool = False) -> list:
+    """Every collective the traced program executes, with trip counts.
+
+    Recurses into sub-jaxprs wherever eqn params carry them: ``scan``
+    bodies multiply by ``length``, ``while`` bodies count once and flag
+    ``unbounded``, ``cond`` contributes its most expensive branch (a
+    census is a budget, not an average), ``shard_map`` pushes its mesh's
+    axis sizes for the psum-family eqns that don't carry an explicit
+    ``axis_size``. Entries whose axis size cannot be resolved are
+    DROPPED with a debug log — a collective over an unbound axis will
+    fail in jax with its own, better error.
+    """
+    jaxpr = _as_jaxpr(closed_jaxpr)
+    if jaxpr is None:
+        return []
+    axis_sizes = dict(axis_sizes or {})
+    out: list[CensusEntry] = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        op = _PRIM_TO_OP.get(name)
+        if op is not None:
+            axes = _eqn_axes(eqn.params)
+            p = _group_size(eqn.params, axes, axis_sizes)
+            if p is None:
+                logger.debug("census: dropped %s over unresolvable axes %r",
+                             name, axes)
+            else:
+                nbytes, shape, dtype = _eqn_payload(eqn)
+                out.append(CensusEntry(
+                    op=op, axis="|".join(axes) if axes else "",
+                    shape=shape, dtype=dtype, calls=_mult,
+                    bytes_per_call=RING_FACTORS[op](nbytes, p),
+                    unbounded=_unbounded))
+            continue
+        if name == "scan":
+            out.extend(jaxpr_census(
+                eqn.params["jaxpr"], axis_sizes,
+                _mult * int(eqn.params.get("length", 1)), _unbounded))
+            continue
+        if name == "while":
+            # cond_jaxpr runs per iteration too, but collectives in a
+            # while COND would be exotic; both bodies count once,
+            # flagged unbounded.
+            for key in ("cond_jaxpr", "body_jaxpr"):
+                if key in eqn.params:
+                    out.extend(jaxpr_census(eqn.params[key], axis_sizes,
+                                            _mult, True))
+            continue
+        if name == "cond":
+            branches = [jaxpr_census(b, axis_sizes, _mult, _unbounded)
+                        for b in eqn.params.get("branches", ())]
+            if branches:
+                out.extend(max(
+                    branches,
+                    key=lambda es: sum(e.total_bytes for e in es)))
+            continue
+        if name == "shard_map":
+            mesh = eqn.params.get("mesh")
+            inner_sizes = dict(axis_sizes)
+            shape_map = getattr(mesh, "shape", None)
+            if shape_map:
+                inner_sizes.update(
+                    {str(k): int(v) for k, v in dict(shape_map).items()})
+            out.extend(jaxpr_census(eqn.params.get("jaxpr"), inner_sizes,
+                                    _mult, _unbounded))
+            continue
+        # Generic: any params value that is (or contains) a jaxpr —
+        # pjit, custom_vjp/jvp calls, remat, pallas grids.
+        for value in eqn.params.values():
+            items = value if isinstance(value, (list, tuple)) else (value,)
+            for item in items:
+                sub = _as_jaxpr(item)
+                if sub is not None:
+                    out.extend(jaxpr_census(sub, axis_sizes, _mult,
+                                            _unbounded))
+    return out
+
+
+# -- compiled-module census (the GSPMD half) --------------------------------
+
+# `%x = f32[4,8]{1,0} all-reduce(f32[4,8]{1,0} %dot), replica_groups=...`
+# The operand types are printed inline; the first operand is the
+# payload. `-start` variants are the async halves of the same op
+# (`-done` carries no payload and is skipped).
+_HLO_INSTR_RE = re.compile(
+    r"=\s*(?:\([^)]*\)|[a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})?)\s+"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?:-start)?\("
+    r"\s*([a-z0-9]+)\[([0-9,]*)\]")
+_REPLICA_ITOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+_REPLICA_LIST_RE = re.compile(r"replica_groups=\{\{([0-9, ]+)\}")
+
+_HLO_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+_HLO_DTYPE_NAME = {
+    "f32": "float32", "bf16": "bfloat16", "f16": "float16", "s8": "int8",
+    "u8": "uint8", "s32": "int32", "u32": "uint32", "f64": "float64",
+    "s64": "int64", "pred": "bool",
+}
+
+
+def _hlo_group_size(line: str, default: int) -> int:
+    m = _REPLICA_ITOTA_RE.search(line)
+    if m:  # [ngroups, group_size]<=[n]
+        return max(int(m.group(2)), 1)
+    m = _REPLICA_LIST_RE.search(line)
+    if m:
+        return max(len([x for x in m.group(1).split(",") if x.strip()]), 1)
+    # `replica_groups={}` (the all-replicas form) and any future
+    # printing the regexes miss fall back to the caller's default —
+    # which callers MUST therefore set to the world size, or a P=1
+    # fallback prices every unrecognized collective at (P-1)·B = 0 and
+    # the gspmd series silently under-reports.
+    return default
+
+
+def hlo_census(hlo_text: str, default_group_size: int = 1) -> list:
+    """Collectives in compiled StableHLO/HLO text — where
+    GSPMD-inserted ops (TP/FSDP parameter gathers, sharding-propagated
+    reductions) become visible.
+
+    Granularity caveat (documented, deliberate): HLO loops print their
+    body once, so scanned collectives appear with ``calls=1`` here —
+    the jaxpr census is authoritative for trip counts; this census
+    exists to SEE what the partitioner inserted, which the jaxpr never
+    contains. Payload is the first operand's type at its printed
+    shape; group size from ``replica_groups`` (iota or literal form),
+    falling back to ``default_group_size``.
+    """
+    out: list[CensusEntry] = []
+    for line in hlo_text.splitlines():
+        m = _HLO_INSTR_RE.search(line)
+        if m is None:
+            continue
+        hlo_op, dt, dims = m.groups()
+        op = _HLO_TO_OP[hlo_op]
+        shape = tuple(int(d) for d in dims.split(",") if d)
+        n = 1
+        for d in shape:
+            n *= d
+        nbytes = float(n) * _HLO_DTYPE_BYTES.get(dt, 4)
+        p = _hlo_group_size(line, default_group_size)
+        out.append(CensusEntry(
+            op=op, axis="", shape=shape,
+            dtype=_HLO_DTYPE_NAME.get(dt, dt), calls=1,
+            bytes_per_call=RING_FACTORS[op](nbytes, p), source="hlo"))
+    return out
+
+
+# -- totals, cross-check, publication ---------------------------------------
+
+
+def census_totals(entries) -> dict:
+    """``{(op, axis): (calls, bytes)}`` — the shape
+    ``CommsAccounting.delta`` produces, so the two compare directly."""
+    out: dict[tuple[str, str], list] = {}
+    for e in entries:
+        slot = out.setdefault((e.op, e.axis), [0, 0.0])
+        slot[0] += e.calls
+        slot[1] += e.total_bytes
+    return {k: (int(c), float(b)) for k, (c, b) in out.items()}
+
+
+def census_bytes(entries) -> float:
+    return float(sum(e.total_bytes for e in entries))
+
+
+def _declared_byte_totals(declared: dict) -> dict:
+    """Normalize a CommsAccounting delta for comparison with a census:
+    pmean folds into psum (it traces as psum + div — identical wire
+    bytes) and zero-byte entries (pcast annotations) are dropped."""
+    out: dict[tuple[str, str], list] = {}
+    for (op, axis), (calls, nbytes) in declared.items():
+        if not nbytes:
+            continue
+        op = "psum" if op == "pmean" else op
+        slot = out.setdefault((op, axis), [0, 0.0])
+        slot[0] += calls
+        slot[1] += nbytes
+    return {k: (int(c), float(b)) for k, (c, b) in out.items()}
+
+
+def census_of_callable(fn, *args, suppress_accounting: bool = False):
+    """(entries, declared_totals) for one callable: trace it once,
+    bracketing the process-wide ``CommsAccounting`` so the shim-declared
+    traffic of exactly this trace comes back alongside the graph's.
+
+    ``suppress_accounting=True`` zeroes the shims' recording for the
+    duration (``comms_scaled(0)``) — the mode for RE-tracing a program
+    whose first trace already counted (train_loop's census bracket must
+    not double-bump ``collective_bytes_total``); declared totals are
+    then empty by construction.
+    """
+    import contextlib
+
+    import jax
+
+    from ...parallel.mesh import comms_accounting, comms_scaled
+
+    acct = comms_accounting()
+    mark = acct.totals()
+    scope = comms_scaled(0) if suppress_accounting \
+        else contextlib.nullcontext()
+    with scope:
+        closed = jax.make_jaxpr(fn)(*args)
+    declared = {} if suppress_accounting else acct.delta(mark)
+    return jaxpr_census(closed), declared
+
+
+def graph_remainder(entries, declared: dict) -> dict:
+    """The census-vs-declared summary published to /metrics.
+
+    ``ad_bytes`` is the graph traffic the shims never saw (AD duals,
+    transpose-time residual recompute) — census minus declared, floored
+    at zero per (op, axis) so an over-declared site cannot cancel an
+    under-declared one. For pure-HLO entries (GSPMD), callers pass them
+    as ``entries`` with no declared counterpart and read the same field
+    as gspmd bytes.
+    """
+    cen = census_totals(e for e in entries if e.total_bytes)
+    dec = _declared_byte_totals(declared)
+    remainder = 0.0
+    for key, (_, b) in cen.items():
+        remainder += max(b - dec.get(key, (0, 0.0))[1], 0.0)
+    return {
+        "graph_bytes": round(sum(b for _, b in cen.values()), 3),
+        "declared_bytes": round(sum(b for _, b in dec.values()), 3),
+        "ad_bytes": round(remainder, 3),
+        "graph_calls": int(sum(c for c, _ in cen.values())),
+    }
+
+
+def publish_graph_census(ad_bytes: float = 0.0, gspmd_bytes: float = 0.0,
+                         registry=None) -> None:
+    """Bump ``collective_graph_bytes_total{source=ad|gspmd}`` — the
+    previously-invisible remainder, itemized by who inserted it. The
+    unlabeled ``collective_bytes_total`` stays the shim-declared series
+    (its docstring and the README row point here for the rest)."""
+    if registry is None:
+        from ...obs.registry import default_registry
+
+        registry = default_registry()
+    for source, nbytes in (("ad", ad_bytes), ("gspmd", gspmd_bytes)):
+        if nbytes and math.isfinite(nbytes):
+            registry.counter(
+                "collective_graph_bytes_total",
+                "graph-level collective bytes beyond the shim-declared "
+                "sites (AD duals / GSPMD-inserted), per compiled program",
+                labels={"source": source}).inc(float(nbytes))

@@ -1,0 +1,406 @@
+"""``ntxent-audit``: run the graph-level analyzers, gate on NEW
+findings.
+
+The trace-level sibling of ``ntxent-lint``: same exit-code contract
+(0 = clean or baselined, 1 = new findings, 2 = usage error), same
+count-keyed baseline file semantics (``audit_baseline.json``), same
+output formats (text / json / github via the shared reporter). The
+difference is what gets audited: not source lines but the traced
+jaxprs and compiled modules of the registered entry points
+(``targets.py``) — so findings carry pseudo-paths
+(``graph://dist_loss/grad``, ``events://compile``) whose baseline
+identity is the finding's stable snippet, not a source line.
+
+Runs TRACE-ONLY on CPU: the process pins ``JAX_PLATFORMS=cpu`` and an
+8-virtual-device host platform BEFORE importing jax (matching the
+test environment the golden formulas are pinned under), so the audit
+needs no accelerator and rides CI next to the lint gate.
+
+Typical invocations::
+
+    ntxent-audit                       # full suite, text output
+    ntxent-audit --analyzers wire-dtype,donation
+    ntxent-audit --format json         # per-target census report too
+    ntxent-audit --format github       # CI annotations
+    ntxent-audit --events run.jsonl    # recompile-cause over a log
+    ntxent-audit --write-baseline      # accept current findings
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+BASELINE_NAME = "audit_baseline.json"
+
+ANALYZERS = ("collective-census", "wire-dtype", "donation",
+             "recompile-cause")
+
+_DESCRIBE = {
+    "collective-census": (
+        "graph census of every collective (jaxpr + compiled HLO) "
+        "cross-checked against the shim-declared ring formulas",
+        "PR 7: accounting scope excluded AD duals and GSPMD-inserted "
+        "collectives — /metrics under-reported real wire traffic"),
+    "wire-dtype": (
+        "no eligible-sized collective may carry f32 on the wire under "
+        "an int8/bf16 precision policy (verified in the graph)",
+        "PR 11: the quant claim was only measured by the same host "
+        "shims that performed the compression"),
+    "donation": (
+        "declared donations must be aliasable and never returned as "
+        "outputs",
+        "PR 1: donated guarded step miscompiled; PR 5: zero-copy "
+        "snapshot of a donated buffer was overwritten mid-save"),
+    "recompile-cause": (
+        "serving compile events must carry a cause; identical "
+        "signatures must not churn",
+        "PR 9: 'compiles stay flat' was a bare count — a miss could "
+        "not say WHY it compiled"),
+}
+
+__all__ = ["main", "ANALYZERS", "BASELINE_NAME", "run_analyzers"]
+
+
+def _ensure_cpu_trace_env() -> None:
+    """Pin the trace-only environment BEFORE jax import: CPU platform,
+    8 virtual devices (the pinned-formula world). Respects explicit
+    caller settings — the test suite's conftest already did both."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _parse_args(argv):
+    parser = argparse.ArgumentParser(
+        prog="ntxent-audit",
+        description="graph-level program audit (ISSUE 14)")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: auto-detect upward "
+                             "from the cwd)")
+    parser.add_argument("--analyzers", default=None,
+                        help="comma-separated subset of analyzers")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: <root>/"
+                             f"{BASELINE_NAME} when present)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline: every finding is new")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="accept the current findings and exit 0")
+    parser.add_argument("--format", choices=("text", "json", "github"),
+                        default="text")
+    parser.add_argument("--list-analyzers", action="store_true",
+                        help="print the analyzer table and exit")
+    parser.add_argument("--events", default=None,
+                        help="JSONL event log for the recompile-cause "
+                             "analyzer (compile events)")
+    parser.add_argument("--churn-threshold", type=int, default=3,
+                        help="same-signature compiles that count as "
+                             "churn (default 3)")
+    parser.add_argument("--fixture-module", default=None,
+                        help="python file whose targets(mesh) extends "
+                             "the audit suite (gate self-tests)")
+    parser.add_argument("--devices", type=int, default=None,
+                        help="mesh size for the audit targets "
+                             "(default: all local devices)")
+    parser.add_argument("--no-publish", action="store_true",
+                        help="skip bumping collective_graph_bytes_total "
+                             "(metrics publication is for wired-in "
+                             "callers; the CLI publishes by default so "
+                             "a scrape of the audit process shows the "
+                             "remainder)")
+    return parser.parse_args(argv)
+
+
+def _load_fixture_targets(path: str, mesh):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_audit_fixture", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return list(module.targets(mesh))
+
+
+def _census_analyzer(targets, report):
+    """collective-census over the census-* targets; returns findings
+    and fills ``report`` with per-target totals + remainders."""
+    from ..framework import Finding
+    from .census import (
+        census_of_callable,
+        census_totals,
+        graph_remainder,
+        hlo_census,
+        jaxpr_census,
+    )
+
+    findings = []
+    ad_bytes = 0.0
+    gspmd_bytes = 0.0
+    for t in targets:
+        if not t.kind.startswith("census-"):
+            continue
+        built = t.build()
+        entries, declared = census_of_callable(built["fn"], *built["args"])
+        summary = graph_remainder(entries, declared)
+        summary["totals"] = {f"{op}|{ax}": [c, b] for (op, ax), (c, b)
+                             in sorted(census_totals(entries).items())}
+        report[t.name] = summary
+        if t.kind == "census-fwd":
+            # Forward graphs: census must equal the declared ring
+            # formulas EXACTLY (per op and axis) — this is the pinned
+            # cross-check; any drift is a shim bypass or a byte-model
+            # fork.
+            from .census import _declared_byte_totals
+
+            cen = {k: v for k, v in census_totals(
+                e for e in entries if e.total_bytes).items()}
+            dec = _declared_byte_totals(declared)
+            for key in sorted(set(cen) | set(dec)):
+                c = cen.get(key, (0, 0.0))
+                d = dec.get(key, (0, 0.0))
+                if c[0] != d[0] or abs(c[1] - d[1]) > 1e-6:
+                    op, ax = key
+                    findings.append(Finding(
+                        rule="collective-census",
+                        path=f"graph://{t.name}", line=0,
+                        message=(
+                            f"census/declared mismatch for {op} over "
+                            f"{ax or '?'}: graph says {c[0]} calls / "
+                            f"{c[1]:.1f} B, shims declared {d[0]} / "
+                            f"{d[1]:.1f} B — a collective bypassed the "
+                            f"mesh shims or the byte model drifted"),
+                        snippet=f"mismatch|{op}|{ax}"))
+        elif t.kind == "census-grad":
+            if summary["ad_bytes"] <= 0.0:
+                findings.append(Finding(
+                    rule="collective-census",
+                    path=f"graph://{t.name}", line=0,
+                    message=(
+                        "grad graph census found NO traffic beyond the "
+                        "forward-declared sites — the AD duals are "
+                        "invisible again (census recursion broke)"),
+                    snippet="ad-remainder-zero"))
+            ad_bytes += summary["ad_bytes"]
+        elif t.kind == "census-gspmd":
+            hlo_entries = []
+            try:
+                import jax
+
+                compiled = built["fn"].lower(*built["args"]).compile()
+                # World size as the group-size fallback: an HLO form
+                # the replica_groups regexes miss must price at the
+                # full group, never P=1 (which zeroes the ring model).
+                hlo_entries = hlo_census(
+                    compiled.as_text(),
+                    default_group_size=jax.device_count())
+            except Exception as e:  # noqa: BLE001 — report, don't crash
+                findings.append(Finding(
+                    rule="collective-census",
+                    path=f"graph://{t.name}", line=0,
+                    message=f"GSPMD target failed to compile for the "
+                            f"HLO census: {type(e).__name__}: {e}",
+                    snippet="gspmd-compile-failed"))
+                continue
+            jax_bytes = summary["graph_bytes"]
+            hlo_bytes = sum(e.total_bytes for e in hlo_entries)
+            summary["hlo_bytes"] = round(hlo_bytes, 3)
+            summary["hlo_ops"] = sorted({e.op for e in hlo_entries})
+            if jax_bytes == 0.0 and hlo_bytes <= 0.0:
+                findings.append(Finding(
+                    rule="collective-census",
+                    path=f"graph://{t.name}", line=0,
+                    message=(
+                        "GSPMD target produced no collectives in either "
+                        "census — the detection half (EQuARX-style HLO "
+                        "walk) sees nothing"),
+                    snippet="gspmd-detection-blind"))
+            if jax_bytes == 0.0:
+                gspmd_bytes += hlo_bytes
+    report["_remainder"] = {"ad_bytes": round(ad_bytes, 3),
+                            "gspmd_bytes": round(gspmd_bytes, 3)}
+    return findings
+
+
+def run_analyzers(targets, analyzers, events_path=None,
+                  churn_threshold: int = 3, publish: bool = True):
+    """(findings, census_report) over the selected analyzers."""
+    findings = []
+    report: dict = {}
+    if "collective-census" in analyzers:
+        findings.extend(_census_analyzer(targets, report))
+        if publish:
+            from .census import publish_graph_census
+
+            rem = report.get("_remainder", {})
+            publish_graph_census(rem.get("ad_bytes", 0.0),
+                                 rem.get("gspmd_bytes", 0.0))
+    if "wire-dtype" in analyzers:
+        from .census import census_of_callable
+        from .wiredtype import wire_dtype_findings
+
+        for t in targets:
+            if t.kind != "wire-dtype":
+                continue
+            built = t.build()
+            entries, _ = census_of_callable(built["fn"], *built["args"])
+            findings.extend(
+                wire_dtype_findings(entries, t.policy, t.name))
+    if "donation" in analyzers:
+        from .donation import donation_findings
+
+        for t in targets:
+            if t.kind != "donation":
+                continue
+            built = t.build()
+            fn = built["fn"]
+            findings.extend(donation_findings(
+                getattr(fn, "__wrapped__", fn), built["args"],
+                t.donate, t.name))
+    if "recompile-cause" in analyzers and events_path:
+        from .recompile import churn_findings
+
+        events = []
+        with open(events_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        events.append(json.loads(line))
+                    except ValueError:
+                        continue
+        findings.extend(churn_findings(events, churn_threshold))
+    findings.sort(key=lambda f: (f.path, f.rule, f.snippet))
+    return findings, report
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    if args.list_analyzers:
+        for name in ANALYZERS:
+            describe, incident = _DESCRIBE[name]
+            print(f"{name}\n    {describe}\n    incident: {incident}")
+        return 0
+    analyzers = tuple(a.strip() for a in args.analyzers.split(",")) \
+        if args.analyzers else ANALYZERS
+    unknown = set(analyzers) - set(ANALYZERS)
+    if unknown:
+        print(f"ntxent-audit: unknown analyzer(s): {sorted(unknown)}",
+              file=sys.stderr)
+        return 2
+    # Misconfiguration must be loud, not a green no-op: an EXPLICITLY
+    # selected recompile-cause run with no event log audits nothing,
+    # and an --events file nobody reads is the converse typo. (The
+    # default full run without --events stays legal — the other three
+    # analyzers are the suite there.)
+    if args.analyzers and "recompile-cause" in analyzers \
+            and not args.events:
+        print("ntxent-audit: --analyzers recompile-cause needs "
+              "--events FILE (there is nothing else for it to audit)",
+              file=sys.stderr)
+        return 2
+    if args.events and "recompile-cause" not in analyzers:
+        print("ntxent-audit: --events given but the recompile-cause "
+              "analyzer is not selected — the file would be ignored",
+              file=sys.stderr)
+        return 2
+
+    _ensure_cpu_trace_env()
+    from ..cli import find_root
+    from ..framework import (
+        compare_with_baseline,
+        load_baseline,
+        write_baseline,
+    )
+    from .targets import audit_mesh, default_targets
+
+    root = os.path.abspath(args.root) if args.root else find_root()
+    t0 = time.perf_counter()
+    needs_targets = set(analyzers) - {"recompile-cause"} \
+        or args.fixture_module
+    targets = []
+    if needs_targets:
+        mesh = audit_mesh(args.devices)
+        targets = default_targets(mesh)
+        if args.fixture_module:
+            targets = targets + _load_fixture_targets(
+                args.fixture_module, mesh)
+    findings, report = run_analyzers(
+        targets, analyzers, events_path=args.events,
+        churn_threshold=args.churn_threshold,
+        publish=not args.no_publish)
+
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    if args.write_baseline:
+        to_write = list(findings)
+        if args.analyzers and os.path.isfile(baseline_path):
+            # A scoped run only re-decides the SELECTED analyzers:
+            # entries for every other analyzer are carried over
+            # untouched, not silently dropped from the rewritten file
+            # (same rule as ntxent-lint's scoped --write-baseline).
+            from ..framework import Finding
+
+            for (rule, rel, snippet), n in \
+                    load_baseline(baseline_path).items():
+                if rule not in analyzers:
+                    to_write.extend(
+                        Finding(rule=rule, path=rel, line=0,
+                                message="(carried baseline entry)",
+                                snippet=snippet)
+                        for _ in range(n))
+        write_baseline(baseline_path, to_write)
+        print(f"ntxent-audit: baseline with {len(to_write)} finding(s) "
+              f"written to {baseline_path}")
+        return 0
+    baseline = None
+    if not args.no_baseline and os.path.isfile(baseline_path):
+        baseline = load_baseline(baseline_path)
+        if args.analyzers:
+            baseline = type(baseline)(
+                {k: v for k, v in baseline.items() if k[0] in analyzers})
+    if baseline:
+        new, accepted, stale = compare_with_baseline(findings, baseline)
+    else:
+        new, accepted, stale = list(findings), [], []
+    elapsed = time.perf_counter() - t0
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [vars(f) for f in new],
+            "baselined": [vars(f) for f in accepted],
+            "stale_baseline": [list(k) for k in stale],
+            "census": report,
+            "elapsed_s": round(elapsed, 3),
+        }, indent=2))
+    elif args.format == "github":
+        from ..reporting import print_github
+
+        print_github(new, "ntxent-audit", stale=stale)
+        print(f"ntxent-audit: {len(new)} new, {len(accepted)} baselined "
+              f"({elapsed:.1f}s)", file=sys.stderr)
+    else:
+        for f in new:
+            print(f.format())
+        for key in stale:
+            print(f"stale baseline entry (fix landed — remove it): "
+                  f"{key[0]} @ {key[1]}: {key[2]}", file=sys.stderr)
+        rem = report.get("_remainder", {})
+        if rem:
+            print(f"ntxent-audit: graph remainder beyond declared sites: "
+                  f"ad={rem.get('ad_bytes', 0.0):.1f} B, "
+                  f"gspmd={rem.get('gspmd_bytes', 0.0):.1f} B "
+                  f"(collective_graph_bytes_total{{source=...}})",
+                  file=sys.stderr)
+        print(f"ntxent-audit: {len(new)} new, {len(accepted)} baselined, "
+              f"{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} ({elapsed:.1f}s)",
+              file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,159 @@
+"""Donation/aliasing auditor: the PR 1 / PR 5 incident class, caught
+statically.
+
+Two shipped incidents were donation bugs the type system cannot see:
+
+* **PR 1**: ``donate_argnums`` on the guarded train step — whose every
+  output is a where-select against the PRE-step state — hit an XLA:CPU
+  aliasing miscompile (the int32 step came back holding a float's bit
+  pattern). The step shipped UNDONATED with a comment; nothing guards
+  the next entry point.
+* **PR 5**: ``device_get`` on CPU returns zero-copy VIEWS, so a host
+  snapshot of state N was silently overwritten when the donated step
+  N+1 reused the buffer — caught only by the crash audit's CRC compare.
+
+This auditor checks every registered jitted entry point at the JAXPR
+level (trace-only — CPU backends don't implement donation, so the
+executable's alias table proves nothing under tier-1):
+
+* **broken-promise**: a donated leaf whose (shape, dtype) class has
+  fewer outputs than donated inputs can never be reused by XLA — the
+  caller gave the buffer up and got nothing for it; worse, callers now
+  ASSUME the input is dead and may skip defensive copies that were
+  load-bearing.
+* **returned-donated-view**: a donated leaf returned UNCHANGED (the
+  output var IS the input var). The caller ends the call holding two
+  handles to one buffer it believes it donated; the next donating call
+  through either handle invalidates the other — exactly how a
+  zero-copy snapshot of "old" state ends up aliasing freshly-donated
+  memory (the PR 5 corruption, as a graph shape).
+
+Where the backend DOES establish aliasing at lowering (jax marks
+donated StableHLO args with ``tf.aliasing_output``), ``lowered_alias
+_report`` reads it back as corroborating evidence; absence is not a
+finding on its own (dead donated args are legitimately elided).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+from ..framework import Finding
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["donation_findings", "lowered_alias_report"]
+
+
+def _flat_donated_indices(args, donate_argnums) -> tuple[set, int]:
+    """(flattened invar indices that are donated, total leaf count) for
+    a concrete example-argument tuple — the positional map from
+    ``donate_argnums`` (a pytree-argument property) onto jaxpr invars
+    (flattened leaves)."""
+    import jax
+
+    donated: set[int] = set()
+    offset = 0
+    donate = set(donate_argnums)
+    for i, arg in enumerate(args):
+        n = len(jax.tree_util.tree_leaves(arg))
+        if i in donate:
+            donated.update(range(offset, offset + n))
+        offset += n
+    return donated, offset
+
+
+def donation_findings(fn, args, donate_argnums, target: str) -> list:
+    """Audit one entry point: trace ``fn`` (the UNDERLYING function or
+    a jit wrapper — donation is taken from ``donate_argnums``, not the
+    wrapper) on ``args`` and flag broken-promise / returned-view
+    donated leaves. ``target`` names the entry point for the finding's
+    pseudo-path."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    jaxpr = closed.jaxpr
+    donated, n_leaves = _flat_donated_indices(args, donate_argnums)
+    if len(jaxpr.invars) != n_leaves:
+        # const-hoisting or a signature mismatch broke the positional
+        # map; a wrong audit is worse than none.
+        logger.warning(
+            "donation audit of %s skipped: %d jaxpr invars vs %d "
+            "flattened arg leaves", target, len(jaxpr.invars), n_leaves)
+        return []
+    out: list[Finding] = []
+    invars = list(jaxpr.invars)
+    outvar_ids = {id(v) for v in jaxpr.outvars}
+
+    def classes(vs):
+        by: dict[tuple, int] = {}
+        for v in vs:
+            aval = getattr(v, "aval", None)
+            key = (tuple(getattr(aval, "shape", ())),
+                   getattr(getattr(aval, "dtype", None), "name", "?"))
+            by[key] = by.get(key, 0) + 1
+        return by
+
+    donated_vars = [invars[i] for i in sorted(donated)]
+    out_classes = classes(jaxpr.outvars)
+
+    # returned-donated-view: output var IS a donated input var.
+    passthrough: list = []
+    for i in sorted(donated):
+        v = invars[i]
+        if id(v) in outvar_ids:
+            passthrough.append((i, v))
+            aval = getattr(v, "aval", None)
+            shape = "x".join(str(d) for d in getattr(aval, "shape", ()))
+            dtype = getattr(getattr(aval, "dtype", None), "name", "?")
+            out.append(Finding(
+                rule="donation",
+                path=f"graph://{target}",
+                line=0,
+                message=(
+                    f"donated operand (flat arg {i}, {dtype}[{shape}]) is "
+                    f"returned UNCHANGED — the caller now holds two "
+                    f"handles to one donated buffer, and any zero-copy "
+                    f"snapshot of the 'old' value aliases memory the next "
+                    f"donating call overwrites (the PR 5 incident class)"),
+                snippet=f"returned-view|arg{i}|{dtype}|{shape}"))
+
+    # broken-promise: per (shape, dtype) class, more donated inputs
+    # than outputs that could reuse them. Passthrough donations already
+    # reported above are excluded — their buffer IS reused, just
+    # dangerously.
+    reported_pass = {id(v) for _, v in passthrough}
+    promise_vars = [v for v in donated_vars if id(v) not in reported_pass]
+    donated_classes = classes(promise_vars)
+    for key, n_don in sorted(donated_classes.items()):
+        n_out = out_classes.get(key, 0)
+        excess = n_don - n_out
+        if excess > 0:
+            shape, dtype = key
+            out.append(Finding(
+                rule="donation",
+                path=f"graph://{target}",
+                line=0,
+                message=(
+                    f"{excess} donated operand(s) of shape "
+                    f"{dtype}[{'x'.join(map(str, shape))}] have no "
+                    f"same-shaped output to alias onto — the donation "
+                    f"is a broken memory promise (XLA matches donated "
+                    f"buffers to identically-sized outputs; none exists)"),
+                snippet=f"broken-promise|{dtype}|"
+                        f"{'x'.join(map(str, shape))}|x{excess}"))
+    return out
+
+
+_ALIAS_ARG_RE = re.compile(
+    r"%arg(\d+):[^)]*?\{[^}]*tf\.aliasing_output\s*=\s*(\d+)")
+
+
+def lowered_alias_report(stablehlo_text: str) -> dict:
+    """``{arg_index: output_index}`` of the input-output aliases jax
+    established at lowering (the ``tf.aliasing_output`` annotations) —
+    corroborating evidence where the backend supports donation; an
+    empty dict on CPU-style backends means nothing by itself."""
+    return {int(a): int(o)
+            for a, o in _ALIAS_ARG_RE.findall(stablehlo_text)}

@@ -1,0 +1,231 @@
+"""The audited entry points: small, real instances of every graph
+class the suite must see.
+
+Each target is a *builder* (construction deferred so ``--list`` and
+argument parsing never pay a trace) returning the callable + example
+args for one audit. The suite covers:
+
+* **census-fwd** — forward losses whose graph census must EXACTLY
+  match the shim-declared ring formulas (``dist_loss`` strip and the
+  ``ring`` scan path at the ambient device count): any drift means a
+  collective bypassed the shims or the byte model diverged.
+* **census-grad** — ``jax.grad`` through the same losses: the census
+  sees the AD duals (and the old-jax transpose's residual recompute)
+  the shims never fire for; the remainder over the declared sites is
+  the previously-invisible traffic published as
+  ``collective_graph_bytes_total{source="ad"}``.
+* **census-gspmd** — a jit-with-shardings program whose jaxpr holds NO
+  collective eqns at all: everything the compiled module moves was
+  GSPMD-inserted (the TP/FSDP class ROADMAP item 1 left open; detected
+  from the optimized HLO text, EQuARX-style).
+* **wire-dtype** — the gradient-reduce graphs under
+  ``collective_precision("int8"|"bf16")``: every eligible-sized
+  collective must carry a compressed payload (verified in the graph,
+  not by the shims that did the compressing).
+* **donation** — the real (donated) train step over a tiny model:
+  broken-promise / returned-view donated leaves (the PR 1 / PR 5
+  incident class).
+
+Sizes are deliberately tiny (trace-only, CPU, seconds): the graph
+STRUCTURE is what's audited, and it is size-independent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Callable
+
+__all__ = ["AuditTarget", "audit_mesh", "default_targets"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditTarget:
+    """One audited entry point. ``build()`` -> dict with at least
+    ``fn`` and ``args``; wire-dtype targets set ``policy``; donation
+    targets set ``donate`` (argnums into ``args``)."""
+
+    name: str
+    kind: str  # census-fwd | census-grad | census-gspmd | wire-dtype | donation
+    build: Callable[[], dict]
+    policy: str | None = None
+    donate: tuple[int, ...] = ()
+
+
+def audit_mesh(p: int | None = None):
+    """The audit's data mesh over the first ``p`` local devices
+    (default: all — 8 under the test/CLI environment, matching the
+    pinned formulas)."""
+    import jax
+
+    from ...parallel.mesh import create_mesh
+
+    devices = jax.devices()
+    p = len(devices) if p is None else min(int(p), len(devices))
+    return create_mesh((p,), ("data",), devices=devices[:p])
+
+
+def _loss_args(mesh, dim: int = 8, n_local: int = 2):
+    import jax.numpy as jnp
+    import numpy as np
+
+    p = mesh.shape["data"]
+    rng = np.random.default_rng(0)
+    z1 = jnp.asarray(rng.standard_normal((p * n_local, dim)), jnp.float32)
+    z2 = jnp.asarray(rng.standard_normal((p * n_local, dim)), jnp.float32)
+    return z1, z2
+
+
+def _dist_loss(mesh, grad: bool):
+    def build():
+        import jax
+
+        from ...parallel.dist_loss import make_sharded_ntxent
+
+        loss = make_sharded_ntxent(mesh, temperature=0.1, impl="strip")
+        fn = jax.grad(lambda a, b: loss(a, b)) if grad else loss
+        return {"fn": fn, "args": _loss_args(mesh)}
+
+    return build
+
+
+def _ring_loss(mesh, grad: bool):
+    def build():
+        import jax
+
+        from ...parallel.ring import make_ring_ntxent
+
+        loss = make_ring_ntxent(mesh, temperature=0.1, impl="jnp")
+        fn = jax.grad(lambda a, b: loss(a, b)) if grad else loss
+        return {"fn": fn, "args": _loss_args(mesh)}
+
+    return build
+
+
+def _grad_reduce(mesh, policy: str):
+    def build():
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ...parallel import mesh as pm
+
+        tree = {"w": jnp.ones((4096,), jnp.float32),
+                "b": jnp.ones((4,), jnp.float32)}
+        if policy == "int8":
+            residual = {"w": jnp.zeros((4096,), jnp.float32),
+                        "b": jnp.zeros((4,), jnp.float32)}
+
+            def body(t, r):
+                reduced, _ = pm.quantized_grad_reduce(t, r, "data")
+                return reduced
+
+            fn = pm.shard_map(body, mesh, in_specs=(P(), P()),
+                              out_specs=P(), check_vma=False)
+            return {"fn": fn, "args": (tree, residual)}
+
+        def body(t):
+            with pm.collective_precision(policy):
+                return pm.pmean(t, "data")
+
+        fn = pm.shard_map(body, mesh, in_specs=(P(),), out_specs=P(),
+                          check_vma=False)
+        return {"fn": fn, "args": (tree,)}
+
+    return build
+
+
+def _gspmd_matmul(mesh):
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        w = jax.device_put(jnp.ones((16, 8), jnp.float32),
+                           NamedSharding(mesh, P("data", None)))
+        x = jax.device_put(jnp.ones((4, 16), jnp.float32),
+                           NamedSharding(mesh, P()))
+        fn = jax.jit(lambda a, b: a @ b,
+                     out_shardings=NamedSharding(mesh, P()))
+        return {"fn": fn, "args": (x, w)}
+
+    return build
+
+
+def _tiny_state():
+    """A real TrainState over the smallest honest model (one Dense +
+    normalize): the donated-step graphs under audit are the package's
+    own factories, only the encoder is shrunk."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from ...training.trainer import TrainerConfig, create_train_state
+
+    class _TinyProj(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            z = nn.Dense(8, dtype=jnp.float32)(
+                x.reshape((x.shape[0], -1)))
+            return z / (jnp.linalg.norm(z, axis=-1, keepdims=True)
+                        + 1e-6)
+
+    cfg = TrainerConfig(batch_size=4, total_steps=10, warmup_steps=2)
+    state = create_train_state(_TinyProj(), jax.random.PRNGKey(0),
+                               (2, 4, 4, 3), cfg)
+    return state
+
+
+def _serving_rung_int8():
+    """The engine's quantized rung forward, exactly as compiled (the
+    in-graph dequant over an int8 payload + per-example scales): its
+    census must be EMPTY — a serving forward that grew a collective
+    would be paying ICI on every request."""
+
+    def build():
+        import jax.numpy as jnp
+
+        from ...serving.engine import InferenceEngine
+
+        w = jnp.ones((4, 8), jnp.float32)
+        eng = InferenceEngine(lambda v, x: x @ v, w, example_shape=(4,),
+                              buckets=(4,), dtype="int8")
+        return {"fn": eng._jit_fn, "args": (w,) + eng._dummy_args(4)}
+
+    return build
+
+
+def _donated_train_step():
+    def build():
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ...training.trainer import make_train_step
+
+        state = _tiny_state()
+        step = make_train_step(temperature=0.1, use_fused=False)
+        rng = np.random.default_rng(1)
+        v1 = jnp.asarray(rng.standard_normal((4, 4, 4, 3)), jnp.float32)
+        v2 = jnp.asarray(rng.standard_normal((4, 4, 4, 3)), jnp.float32)
+        return {"fn": step, "args": (state, v1, v2)}
+
+    return build
+
+
+def default_targets(mesh=None) -> list[AuditTarget]:
+    """The standing audit suite (tests and ``ntxent-audit`` share it)."""
+    if mesh is None:
+        mesh = audit_mesh()
+    return [
+        AuditTarget("dist_loss/fwd", "census-fwd", _dist_loss(mesh, False)),
+        AuditTarget("dist_loss/grad", "census-grad", _dist_loss(mesh, True)),
+        AuditTarget("ring/fwd", "census-fwd", _ring_loss(mesh, False)),
+        AuditTarget("ring/grad", "census-grad", _ring_loss(mesh, True)),
+        AuditTarget("gspmd/matmul", "census-gspmd", _gspmd_matmul(mesh)),
+        AuditTarget("serving/rung_int8", "census-fwd",
+                    _serving_rung_int8()),
+        AuditTarget("grad_reduce/int8", "wire-dtype",
+                    _grad_reduce(mesh, "int8"), policy="int8"),
+        AuditTarget("grad_reduce/bf16", "wire-dtype",
+                    _grad_reduce(mesh, "bf16"), policy="bf16"),
+        AuditTarget("train_step/donated", "donation",
+                    _donated_train_step(), donate=(0,)),
+    ]

@@ -1,0 +1,78 @@
+"""Wire-dtype verifier: the quantization claim checked in the GRAPH.
+
+ISSUE 12's int8/bf16 collectives were verified by the host-side shims'
+own accounting — the same code that performs the compression reports
+the wire bytes, so a bug that silently left a payload in float32 would
+also report it quantized. This verifier closes the loop from the other
+side: census the traced gradient-reduce graph and FAIL if any
+eligible-sized collective still carries a float32 payload under an
+int8/bf16 policy. The graph cannot lie about its own dtypes.
+
+Eligibility mirrors ``parallel/precision.py``: payloads under
+``MIN_QUANT_ELEMS`` elements ride in full precision by design (scales
+cost more than they save — the per-chunk f32 scale columns of the int8
+schedule itself are the canonical example), and ``ppermute``/``pmax``
+never quantize (the ring losses own their schedule; a max over
+quantized values loses the extremes it exists to find). What remains —
+psum / all_gather / psum_scatter / all_to_all payloads at or above the
+floor — must be on the wire at the policy dtype.
+"""
+
+from __future__ import annotations
+
+from ..framework import Finding
+
+__all__ = ["ELIGIBLE_OPS", "ALLOWED_WIRE_DTYPES", "wire_dtype_findings"]
+
+# Ops the precision policy compresses (ppermute/pmax are exempt by
+# policy, annotation ops never appear in a census).
+ELIGIBLE_OPS = ("psum", "all_gather", "psum_scatter", "all_to_all")
+
+# Per policy: the dtypes a payload may legally occupy on the wire.
+# float32 stays legal for int8's scale columns — but scales sit far
+# below the eligibility floor, which is what actually admits them.
+ALLOWED_WIRE_DTYPES = {
+    "int8": {"int8", "uint8", "bfloat16", "float16", "int32", "uint32",
+             "bool"},
+    "bf16": {"int8", "uint8", "bfloat16", "float16", "int32", "uint32",
+             "bool"},
+}
+
+
+def wire_dtype_findings(entries, policy: str, target: str,
+                        min_elems: int | None = None) -> list:
+    """Findings for every census entry that should be compressed but
+    is not. ``entries`` is a ``jaxpr_census`` result of a graph traced
+    UNDER ``collective_precision(policy)``; ``target`` names the audited
+    entry point (it becomes the finding's pseudo-path, so the baseline
+    key stays stable across line churn the way lint findings do)."""
+    if policy not in ALLOWED_WIRE_DTYPES:
+        raise ValueError(f"policy must be one of "
+                         f"{sorted(ALLOWED_WIRE_DTYPES)}, got {policy!r}")
+    if min_elems is None:
+        from ...parallel.precision import MIN_QUANT_ELEMS
+
+        min_elems = MIN_QUANT_ELEMS
+    allowed = ALLOWED_WIRE_DTYPES[policy]
+    out = []
+    for e in entries:
+        if e.op not in ELIGIBLE_OPS:
+            continue
+        if e.nelems < min_elems:
+            continue
+        if e.dtype in allowed:
+            continue
+        out.append(Finding(
+            rule="wire-dtype",
+            path=f"graph://{target}",
+            line=0,
+            message=(
+                f"{e.op} over axis {e.axis or '?'} carries "
+                f"{e.dtype}[{','.join(map(str, e.shape))}] "
+                f"({e.nelems} elems >= the {min_elems}-elem quantization "
+                f"floor) on the wire under collective_precision"
+                f"({policy!r}) — an uncompressed leak the host-side "
+                f"accounting cannot see"),
+            snippet=f"{e.op}|{e.axis}|{e.dtype}|"
+                    f"{'x'.join(map(str, e.shape))}"))
+    return out

@@ -149,26 +149,56 @@ class StepTimeline:
         events.emit("compile", duration_ms=round(duration_ms, 3),
                     flops=flops)
 
-    def set_comms_per_step(self, profile: dict) -> None:
+    def set_comms_per_step(self, profile: dict,
+                           graph: dict | None = None) -> None:
         """Publish one compiled step's static collective profile.
 
         ``profile`` is a comms-accounting delta (``{(op, axis): (calls,
         bytes)}`` — parallel/mesh.CommsAccounting.delta) captured around
         the step's trace; an empty delta (single-device runs, steps with
-        no hand-written collectives) leaves the series untouched.
+        no hand-written collectives) leaves the series untouched —
+        unless ``graph`` reports GSPMD traffic (a TP/FSDP step's
+        collectives are ALL compiler-inserted, so the declared delta is
+        legitimately empty while the graph is not).
+
+        ``graph`` (ISSUE 14) is a graph-census summary
+        (``analysis.graph.census.graph_remainder``: ``graph_bytes`` /
+        ``declared_bytes`` / ``ad_bytes``, plus ``gspmd_bytes`` when an
+        HLO census ran): the traffic the shims cannot see (AD duals,
+        GSPMD-inserted collectives) lands on
+        ``collective_graph_bytes_total{source="ad"|"gspmd"}`` and rides
+        the ``comms_profile`` event, so /metrics stops under-reporting.
+        The dict is plain floats — obs stays importable without JAX;
+        the census itself lives in ``analysis/graph/``.
         """
         calls = sum(c for c, _ in profile.values())
         nbytes = sum(b for _, b in profile.values())
-        if not calls:
+        graph = dict(graph) if graph else {}
+        if graph:
+            # One declaration of the counter family, shared with the
+            # ntxent-audit CLI. census.py imports jax only inside the
+            # census functions, so this lazy import keeps obs JAX-free.
+            from ..analysis.graph.census import publish_graph_census
+
+            publish_graph_census(
+                float(graph.get("ad_bytes") or 0.0),
+                float(graph.get("gspmd_bytes") or 0.0),
+                registry=self.registry)
+        if not calls and not graph.get("gspmd_bytes"):
             return
         self._comms_bytes_per_step = float(nbytes)
         self._comms_bytes.set(nbytes)
         self._comms_calls.set(calls)
+        fields = {}
+        for key in ("graph_bytes", "ad_bytes", "gspmd_bytes"):
+            if graph.get(key) is not None:
+                fields[key] = float(graph[key])
         events.emit("comms_profile", calls=int(calls),
                     bytes=float(nbytes),
                     by_op={f"{op}|{ax}": {"calls": int(c),
                                           "bytes": float(b)}
-                           for (op, ax), (c, b) in sorted(profile.items())})
+                           for (op, ax), (c, b) in sorted(profile.items())},
+                    **fields)
 
     # -- per step --------------------------------------------------------
     def record_step(self, step: int, loss: float,

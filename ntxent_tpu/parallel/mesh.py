@@ -177,12 +177,17 @@ def axis_index(axis: str):
 # trainer.train_loop captures the step's static profile that way and the
 # StepTimeline publishes it as the per-step comms series.
 #
-# Scope (documented, deliberate): forward-traced call sites only. The
-# AD-derived duals (the reduce-scatter behind an all_gather's gradient,
-# the psum transpose) are inserted by JAX's transpose rules, not these
-# shims, and are NOT counted; GSPMD-inserted collectives (FSDP parameter
-# gathers) live in the compiler and are likewise out of scope. The counted
-# set is exactly the traffic the quantization/overlap PRs will rewrite.
+# Scope: these shims record the forward-traced call sites — the traffic
+# the quantization/overlap PRs rewrite. The AD-derived duals (the
+# reduce-scatter behind an all_gather's gradient, the psum transpose)
+# and GSPMD-inserted collectives (FSDP parameter gathers) are inserted
+# by JAX's transpose rules / the XLA partitioner, never by these shims —
+# since ISSUE 14 they are counted by the GRAPH census
+# (analysis/graph/census.py: the jaxpr walk + compiled-HLO walk behind
+# `ntxent-audit`), published as
+# `collective_graph_bytes_total{source=ad|gspmd}` next to the declared
+# series here, and cross-checked against these shims' byte model —
+# census == declared, exactly, for every forward graph (test-pinned).
 #
 # Byte model (per device, ring algorithms — the TPU lowering): for payload
 # bytes B over an axis group of size P:
@@ -415,8 +420,10 @@ def _account(op: str, axis, x, factor) -> None:
 # each quantized collective is a ``custom_vjp`` whose backward is the
 # exact transpose of the UNQUANTIZED collective — a straight-through
 # estimator for the compression, the identity the f32 path's AD derives
-# (and, per the documented accounting scope, backward duals stay
-# uncounted). Gradient reductions should prefer
+# (backward duals are not declared by these shims — the ISSUE 14 graph
+# census counts them under
+# ``collective_graph_bytes_total{source="ad"}``). Gradient reductions
+# should prefer
 # ``quantized_grad_reduce`` (error feedback: the compression residual
 # carries into the next step's payload, so the noise is absorbed
 # instead of biasing SGD).

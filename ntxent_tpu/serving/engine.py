@@ -56,6 +56,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.graph.recompile import RecompileDiffer
+from ..obs import events as _events
 from ..obs import trace as _trace
 from .ladder import SizeHistogram, expected_padded_rows, optimize_ladder
 from .metrics import ServingMetrics
@@ -155,6 +157,13 @@ class InferenceEngine:
         # racy clear a concurrent embed could be mid-lookup through.
         self._cache: dict[tuple, Callable] = {}
         self._lock = threading.Lock()
+        # Recompile-cause differ (ISSUE 14): each compile's lowering
+        # signature is recorded per cache key; a miss diffs against the
+        # nearest prior so the `compile` event and the
+        # serving_compiles_by_cause_total{reason} counter say WHY
+        # (new_shape vs dtype vs weights_reload vs structure vs churn)
+        # instead of bumping a bare count.
+        self._recompile = RecompileDiffer()
         # Traffic-adaptive ladder (ISSUE 9). The histogram records
         # device-CHUNK row counts (an oversized request folds through
         # max-bucket chunking first) — exactly the sizes that pad.
@@ -356,14 +365,29 @@ class InferenceEngine:
             # the first real request still pays no compile.
             jax.block_until_ready(self._jit_fn(variables, *args))
             compiled = self._jit_fn
-        logger.info("serving: compiled bucket %d (%s) in %.2fs%s", bucket,
-                    self.dtype.name, time.monotonic() - t0,
-                    " [background]" if background else "")
+        duration_ms = (time.monotonic() - t0) * 1e3
+        # The lowering signature this key stands for; diffing against
+        # the nearest prior one names the compile's cause.
+        structure = _structure_hash(variables)
+        cause = self._recompile.observe(key, {
+            "structure": structure,
+            "dtype": self.dtype.name,
+            "version": model_hash,
+            "shape": (bucket,) + self.example_shape,
+        })
+        logger.info("serving: compiled bucket %d (%s) in %.2fs%s "
+                    "[cause=%s]", bucket, self.dtype.name,
+                    duration_ms / 1e3,
+                    " [background]" if background else "", cause)
         # Background (ladder re-AOT) compiles are accounted separately:
         # serving_compiles_total is the REQUEST-visible compile bill,
         # and the ragged smoke asserts it stays flat across a swap.
         (self.metrics.ladder_compiled if background
-         else self.metrics.compiled)()
+         else self.metrics.compiled)(cause=cause)
+        _events.emit("compile", bucket=int(bucket), dtype=self.dtype.name,
+                     structure=structure[:8], cause=cause,
+                     background=bool(background),
+                     duration_ms=round(duration_ms, 3))
         with self._lock:
             exe = self._cache.setdefault(key, compiled)
         return exe

@@ -170,6 +170,10 @@ class ServingMetrics:
         self._ladder_failures = r.counter(
             "serving_ladder_refresh_failures_total",
             "ladder re-AOT attempts that failed (old ladder kept)")
+        # Per-cause compile counters (ISSUE 14), created lazily like
+        # the per-mode swap counters below.
+        self._compile_cause_lock = threading.Lock()
+        self._compile_causes: dict[str, object] = {}
         # Cross-process correlation (ISSUE 7): run identity, stamped by
         # set_run_id. None until a run id is known (tests, bare engines).
         self.run_id: str | None = None
@@ -360,8 +364,10 @@ class ServingMetrics:
         self._ladder_swaps.inc()
         self.set_ladder(buckets, generation)
 
-    def ladder_compiled(self) -> None:
+    def ladder_compiled(self, cause: str | None = None) -> None:
         self._ladder_compiles.inc()
+        if cause:
+            self.compile_cause(cause)
 
     def ladder_refresh_failed(self) -> None:
         self._ladder_failures.inc()
@@ -381,8 +387,28 @@ class ServingMetrics:
     def queue_wait(self, ms: float) -> None:
         self.latency["queue_wait"].observe(ms)
 
-    def compiled(self) -> None:
+    def compiled(self, cause: str | None = None) -> None:
         self._compiles.inc()
+        if cause:
+            self.compile_cause(cause)
+
+    def compile_cause(self, cause: str) -> None:
+        """Itemize one compile by WHY it happened (ISSUE 14: the
+        recompile-cause differ's vocabulary — first_compile/new_shape/
+        dtype/weights_reload/structure/recompile, a closed set, so the
+        `reason` label's cardinality is bounded by construction). The
+        bare `serving_compiles_total` / `serving_ladder_compiles_total`
+        stay the request-visible vs background split; this series is
+        the causal breakdown across both."""
+        with self._compile_cause_lock:
+            counter = self._compile_causes.get(cause)
+            if counter is None:
+                counter = self._compile_causes[cause] = \
+                    self.registry.counter(
+                        "serving_compiles_by_cause_total",
+                        "executable compiles by recompile-differ cause",
+                        labels={"reason": str(cause)})
+        counter.inc()
 
     def compile_cache_hit(self) -> None:
         self._compile_cache_hits.inc()

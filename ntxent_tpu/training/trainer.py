@@ -688,6 +688,43 @@ def compiled_step_flops(train_step, *args) -> float | None:
     return aot_compile_with_flops(train_step, *args)[0]
 
 
+def _graph_census(step_fn, args, declared, compiled):
+    """Graph-census summary of one traced train step (ISSUE 14): the
+    jaxpr census re-traced with accounting SUPPRESSED (the first trace
+    already counted — counters must not double-bump), diffed against
+    the step's declared comms delta; the AD-dual remainder (and, for
+    pure-GSPMD steps whose jaxpr holds no collective eqns at all, the
+    compiled module's HLO census) is what
+    ``timeline.set_comms_per_step(graph=...)`` publishes as
+    ``collective_graph_bytes_total{source=ad|gspmd}``. Never raises —
+    telemetry must not break training."""
+    try:
+        from ..analysis.graph.census import (
+            census_bytes,
+            census_of_callable,
+            graph_remainder,
+            hlo_census,
+        )
+
+        entries, _ = census_of_callable(step_fn, *args,
+                                        suppress_accounting=True)
+        summary = graph_remainder(entries, declared)
+        if not entries and compiled is not None:
+            # No collectives in the jaxpr: everything the compiled
+            # module moves was GSPMD-inserted (the TP/FSDP class).
+            try:
+                summary["gspmd_bytes"] = round(
+                    census_bytes(hlo_census(
+                        compiled.as_text(),
+                        default_group_size=jax.device_count())), 3)
+            except Exception:  # noqa: BLE001 — an executable without
+                pass           # readable HLO text just skips the half
+        return summary
+    except Exception:  # noqa: BLE001 — strictly best-effort telemetry
+        logger.debug("graph census skipped", exc_info=True)
+        return None
+
+
 def train_loop(
     state: TrainState,
     data_iter,
@@ -702,6 +739,7 @@ def train_loop(
     step_guard: Callable | None = None,
     timeline=None,
     metrics_lag: int = 0,
+    graph_census: bool | None = None,
 ):
     """Simple host loop: step, log loss / steps-per-sec / MFU.
 
@@ -764,9 +802,26 @@ def train_loop(
     * ``timeline`` records device time as dispatch-to-ready latency
       (the sync bracket would reintroduce the stall being removed) and
       ``hook(state, entry)`` observes the newest dispatched state.
+
+    ``graph_census`` (ISSUE 14; default ``None`` = on whenever
+    ``timeline`` is set): after the step-1 comms bracket, re-trace the
+    step (accounting suppressed) and publish the graph-level traffic
+    the shims cannot declare — AD duals and, for pure-GSPMD steps,
+    compiler-inserted collectives — as
+    ``collective_graph_bytes_total{source=ad|gspmd}`` plus
+    ``graph_bytes``/``ad_bytes`` fields on the ``comms_profile``
+    event. Costs one extra abstract trace on step 1 (no compile);
+    pass ``False`` to skip it. An explicit ``True`` without a
+    ``timeline`` raises — the census publishes through the timeline's
+    comms bracket, so there would be nowhere to put the result.
     """
     if metrics_lag not in (0, 1):
         raise ValueError(f"metrics_lag must be 0 or 1, got {metrics_lag}")
+    if graph_census and timeline is None:
+        # The census publishes THROUGH the timeline's comms bracket; an
+        # explicit True with nowhere to publish would be a silent no-op.
+        raise ValueError("graph_census=True requires timeline= (the "
+                         "census publishes through its comms bracket)")
     history = []
     use_scale = step_guard is not None and hasattr(step_guard,
                                                    "scale_value")
@@ -785,6 +840,12 @@ def train_loop(
     # telemetry-enabled runs.
     step_base = 0
     comms_mark = None
+    # The census must trace the JIT WRAPPER (the auto-AOT path swaps
+    # train_step for the bare executable, which cannot be re-traced).
+    census_step = train_step
+    compiled_obj = None
+    do_census = graph_census if graph_census is not None \
+        else timeline is not None
     if timeline is not None:
         step_base = int(state.step)
         timeline.new_attempt()  # restart gaps are not step time
@@ -869,6 +930,7 @@ def train_loop(
                 train_step, *aot_args)
             if compiled is not None:
                 train_step = compiled  # reuse the executable we just built
+                compiled_obj = compiled
             if flops_per_step is not None:
                 logger.info("compiled step cost: %.3e FLOPs/chip",
                             flops_per_step)
@@ -885,8 +947,14 @@ def train_loop(
         if step == 1 and comms_mark is not None:
             # Dispatch returned, so the step is traced: the delta is its
             # per-compiled-step comms profile (empty on single-device).
-            timeline.set_comms_per_step(
-                comms_accounting().delta(comms_mark))
+            delta = comms_accounting().delta(comms_mark)
+            graph = None
+            if do_census:
+                census_args = (state, v1, v2) + (
+                    (step_guard.scale_value(),) if use_scale else ())
+                graph = _graph_census(census_step, census_args, delta,
+                                      compiled_obj)
+            timeline.set_comms_per_step(delta, graph=graph)
             comms_mark = None
         if metrics_lag:
             # Step N is in flight; NOW read step N-1 (overlapped drain).

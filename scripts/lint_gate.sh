@@ -1,19 +1,27 @@
 #!/usr/bin/env bash
-# Static-analysis gate (ISSUE 13): ntxent-lint over the whole repo must
-# report ZERO new findings against the committed lint_baseline.json —
-# the standing version of the PR 7 hand-audit (collective-shim
-# coverage) plus the host-sync / lock-discipline / import-boundary /
-# telemetry-schema invariants. Three phases, all fast (<20 s total, no
-# JAX import anywhere):
-#   1. Gate the real repo: rc 0, and the linting process must finish
-#      with `jax` absent from sys.modules (the analysis layer is pure
-#      stdlib by contract — a JAX import sneaking into it would drag
-#      backend init into every CI lint).
+# Static-analysis gate (ISSUE 13 + 14): ntxent-lint over the whole repo
+# must report ZERO new findings against the committed
+# lint_baseline.json — the standing version of the PR 7 hand-audit
+# (collective-shim coverage) plus the host-sync / lock-discipline /
+# import-boundary / telemetry-schema invariants — and ntxent-audit
+# over the traced graphs must report ZERO new findings against
+# audit_baseline.json. Phases:
+#   1. Gate the real repo with ntxent-lint: rc 0, and the linting
+#      process must finish with `jax` absent from sys.modules (the
+#      lint layer is pure stdlib by contract — a JAX import sneaking
+#      into it would drag backend init into every CI lint).
 #   2. Self-test the failure path: a doctored tree containing one
 #      violation per rule must exit rc 1 naming all five rules — a gate
 #      that cannot fail is not a gate.
 #   3. Self-test suppression: the same violations with `lint-ok`
 #      annotations must pass — the escape hatch must actually work.
+#   4. Gate the real repo with ntxent-audit (graph-level, ISSUE 14):
+#      census == pinned ring formulas, no f32 wire leaks, donated
+#      steps alias cleanly — rc 0 against the committed baseline.
+#   5. Self-test the audit's failure path: doctored graphs (a shim
+#      bypass, an f32 leak under int8, a returned donated buffer) plus
+#      a doctored event log (cause-less + churning compiles) must exit
+#      rc 1 with all FOUR analyzers firing.
 # Wired alongside bench_gate.sh as the CI static-analysis step.
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -110,5 +118,92 @@ PY
 python -m ntxent_tpu.analysis.cli --root "$workdir/bad" --no-baseline \
     >/dev/null || { echo "lint gate: suppressed tree still failed"; exit 1; }
 echo "lint gate: suppression path OK"
+
+# Phase 4 — the graph audit on the real repo (ISSUE 14): trace-only on
+# CPU, gated against the committed audit_baseline.json. This leg DOES
+# import jax (it walks jaxprs) — that is its job, unlike the lint's.
+start=$(date +%s)
+python -m ntxent_tpu.analysis.graph.cli \
+    || { echo "lint gate: ntxent-audit found NEW graph findings"; exit 1; }
+elapsed=$(( $(date +%s) - start ))
+[ "$elapsed" -lt 120 ] || { echo "audit leg exceeded 120 s ($elapsed s)"; exit 1; }
+echo "lint gate: graph audit PASS on the repo (0 new findings)"
+
+# Phase 5 — doctored graphs + a doctored event log must fire all four
+# analyzers and exit rc 1.
+cat > "$workdir/audit_fixture.py" <<'EOF'
+"""Doctored audit targets: one violation per graph analyzer."""
+from ntxent_tpu.analysis.graph.targets import AuditTarget
+
+
+def targets(mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ntxent_tpu.parallel import mesh as pm
+
+    def shim_bypass():
+        def body(x):
+            # a raw lax collective: traced, never declared
+            return jax.lax.psum(jnp.sum(x), "data")
+
+        fn = pm.shard_map(body, mesh, in_specs=(P("data"),),
+                          out_specs=P(), check_vma=False)
+        return {"fn": fn, "args": (jnp.ones((16, 4), jnp.float32),)}
+
+    def f32_leak():
+        def body(t):
+            with pm.collective_precision("int8"):
+                # smuggled past the policy: full-precision all-reduce
+                return jax.lax.psum(t, "data")
+
+        fn = pm.shard_map(body, mesh, in_specs=(P(),), out_specs=P(),
+                          check_vma=False)
+        return {"fn": fn, "args": (jnp.ones((4096,), jnp.float32),)}
+
+    def returned_view():
+        def step(state, x):
+            return state, state["w"] * x.sum()
+
+        return {"fn": step,
+                "args": ({"w": jnp.ones((64,), jnp.float32)},
+                         jnp.ones((4,), jnp.float32))}
+
+    return [
+        AuditTarget("doctored/shim_bypass", "census-fwd", shim_bypass),
+        AuditTarget("doctored/f32_leak", "wire-dtype", f32_leak,
+                    policy="int8"),
+        AuditTarget("doctored/returned_view", "donation", returned_view,
+                    donate=(0,)),
+    ]
+EOF
+cat > "$workdir/bad_events.jsonl" <<'EOF'
+{"event": "compile", "bucket": 16, "dtype": "float32", "structure": "aaaa1111"}
+{"event": "compile", "bucket": 16, "dtype": "float32", "structure": "aaaa1111", "cause": "recompile"}
+{"event": "compile", "bucket": 16, "dtype": "float32", "structure": "aaaa1111", "cause": "recompile"}
+EOF
+rc=0
+python -m ntxent_tpu.analysis.graph.cli --no-baseline \
+    --fixture-module "$workdir/audit_fixture.py" \
+    --events "$workdir/bad_events.jsonl" \
+    --format json >"$workdir/audit_bad.json" || rc=$?
+[ "$rc" -eq 1 ] || { echo "audit gate did NOT fail on doctored graphs (rc=$rc)"; cat "$workdir/audit_bad.json"; exit 1; }
+python - "$workdir/audit_bad.json" <<'PY'
+import json
+import sys
+
+rec = json.load(open(sys.argv[1]))
+rules = {f["rule"] for f in rec["new"]}
+want = {"collective-census", "wire-dtype", "donation", "recompile-cause"}
+assert rules == want, f"analyzers fired: {sorted(rules)}, want {sorted(want)}"
+# The doctored suite must not drown out the real one: the built-in
+# targets still audit clean alongside the fixtures.
+bad = [f for f in rec["new"] if "doctored" not in f["path"]
+       and not f["path"].startswith("events://")]
+assert not bad, f"real targets fired: {bad}"
+print(f"lint gate: audit FAIL path OK ({len(rec['new'])} findings, "
+      f"all 4 analyzers fired)")
+PY
 
 echo "lint gate: OK"

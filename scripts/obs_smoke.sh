@@ -15,7 +15,11 @@
 #     JSONL restore event a `reshard="gather_replace"` field;
 #   * surface the COMMS baseline (ISSUE 7): the sharded step's traced
 #     collectives must put nonzero `collective_bytes_total{op,axis}`
-#     and `train_step_comms_bytes` on the same scrape;
+#     and `train_step_comms_bytes` on the same scrape — plus, since
+#     ISSUE 14, a nonzero AD-dual remainder on
+#     `collective_graph_bytes_total{source="ad"}` (the step's graph
+#     census sees the backward-pass collectives the shims never
+#     declared);
 #   * export to a Perfetto-loadable trace (ISSUE 7): `ntxent-trace`
 #     over the run's JSONL must produce a schema-valid trace.json with
 #     step slices;
@@ -74,6 +78,7 @@ for _ in $(seq 200); do
             && grep -q '^train_divergence_total [1-9]' "$scrape.tmp" \
             && grep -q '^retries_total [1-9]' "$scrape.tmp" \
             && grep -Eq '^collective_bytes_total\{[^}]*\} [1-9]' "$scrape.tmp" \
+            && grep -Eq '^collective_graph_bytes_total\{source="ad"\} [1-9]' "$scrape.tmp" \
             && grep -q '^checkpoint_reshard_total [1-9]' "$scrape.tmp"; then
             mv "$scrape.tmp" "$scrape"
             curl -fsS "http://127.0.0.1:$port/metrics?format=json" -o "$scrape_json"

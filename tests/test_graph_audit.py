@@ -1,0 +1,705 @@
+"""Graph-level program audit (ISSUE 14): the jaxpr/HLO collective
+census against the pinned ring formulas, the wire-dtype verifier, the
+donation/aliasing auditor, and the recompile-cause differ.
+
+The census golden values are the SAME exact byte formulas
+tests/test_trace.py pins for the shim accounting — all_gather
+(P-1)·B, psum 2·(P-1)/P·B, ppermute B per hop — asserted here from the
+GRAPH side, plus the part the shims can never see: a nonzero AD-dual
+remainder for grad-through-``dist_loss`` and GSPMD-inserted
+collectives read from compiled HLO. Doctored-graph fixtures prove each
+analyzer can fail (a gate that cannot fail is not a gate).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ntxent_tpu.analysis.graph import census as gc
+from ntxent_tpu.analysis.graph import donation as gdon
+from ntxent_tpu.analysis.graph import recompile as grc
+from ntxent_tpu.analysis.graph import targets as gt
+from ntxent_tpu.analysis.graph import wiredtype as gwd
+from ntxent_tpu.analysis.graph.cli import main as audit_main
+from ntxent_tpu.parallel import mesh as pm
+
+pytestmark = pytest.mark.graphaudit
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return gt.audit_mesh()
+
+
+def _target(targets, name):
+    [t] = [t for t in targets if t.name == name]
+    return t
+
+
+# ---------------------------------------------------------------------------
+# collective census: golden values at P=8 (the pinned ring formulas)
+
+
+class TestCensusGolden:
+    def test_dist_loss_forward_matches_ring_formulas_exactly(self, mesh):
+        p = mesh.shape["data"]
+        t = _target(gt.default_targets(mesh), "dist_loss/fwd")
+        built = t.build()
+        entries, declared = gc.census_of_callable(built["fn"],
+                                                  *built["args"])
+        totals = gc.census_totals(entries)
+        shard_b = 2 * 8 * 4  # n_local=2 rows x dim=8 x f32
+        # Two embedding gathers + the scalar loss psum — nothing else.
+        assert totals[("all_gather", "data")] == (2, 2 * (p - 1) * shard_b)
+        assert totals[("psum", "data")] == \
+            (1, pytest.approx(2 * (p - 1) / p * 4))
+        assert set(totals) == {("all_gather", "data"), ("psum", "data")}
+        # And the graph agrees with the shims EXACTLY (the cross-check
+        # ntxent-audit gates on).
+        assert totals == gc._declared_byte_totals(declared)
+
+    def test_ring_forward_counts_scanned_hops_per_iteration(self, mesh):
+        p = mesh.shape["data"]
+        t = _target(gt.default_targets(mesh), "ring/fwd")
+        built = t.build()
+        entries, declared = gc.census_of_callable(built["fn"],
+                                                  *built["args"])
+        totals = gc.census_totals(entries)
+        block_b = 4 * 8 * 4   # z_local (2*n_local, dim) f32
+        gid_b = 4 * 4         # int32[4] row ids ride the ring too
+        # Two ppermutes per scan body, length P-1: counted per
+        # EXECUTION (the scan multiplier), not per trace.
+        assert totals[("ppermute", "data")] == \
+            (2 * (p - 1), (p - 1) * (block_b + gid_b))
+        assert totals[("psum", "data")] == \
+            (1, pytest.approx(2 * (p - 1) / p * 4))
+        assert totals == gc._declared_byte_totals(declared)
+
+    def test_grad_through_dist_loss_has_nonzero_ad_remainder(self, mesh):
+        # THE acceptance pin: the backward pass moves real bytes (the
+        # reduce-scatter dual of the embedding gather) that no shim
+        # ever declared — previously invisible to /metrics.
+        t = _target(gt.default_targets(mesh), "dist_loss/grad")
+        built = t.build()
+        entries, declared = gc.census_of_callable(built["fn"],
+                                                  *built["args"])
+        summary = gc.graph_remainder(entries, declared)
+        assert summary["ad_bytes"] > 0
+        assert summary["graph_bytes"] >= summary["declared_bytes"]
+        # The dual is a reduce-scatter: it must appear in the graph.
+        totals = gc.census_totals(entries)
+        assert ("psum_scatter", "data") in totals
+
+    def test_quantized_reduce_census_totals_match_wire_accounting(
+            self, mesh):
+        # int8 graphs: the census sees the two-phase schedule's
+        # all_to_all/all_gather wire ops while the shims declare them
+        # under the LOGICAL op — total bytes must still agree exactly.
+        t = _target(gt.default_targets(mesh), "grad_reduce/int8")
+        built = t.build()
+        entries, declared = gc.census_of_callable(built["fn"],
+                                                  *built["args"])
+        declared_bytes = sum(b for _, b in declared.values())
+        assert gc.census_bytes(entries) == pytest.approx(declared_bytes)
+        # And the wire payloads really are int8 in the graph.
+        assert any(e.dtype == "int8" and e.op == "all_to_all"
+                   for e in entries)
+
+    def test_cond_counts_most_expensive_branch(self, mesh):
+        def body(x):
+            return jax.lax.cond(
+                x.sum() > 0,
+                lambda v: pm.psum(v, "data"),
+                lambda v: pm.psum(jnp.sum(v), "data") + v,
+                x)
+
+        fn = pm.shard_map(body, mesh, in_specs=(P(),), out_specs=P(),
+                          check_vma=False)
+        entries, _ = gc.census_of_callable(
+            fn, jnp.ones((128,), jnp.float32), suppress_accounting=True)
+        totals = gc.census_totals(entries)
+        p = mesh.shape["data"]
+        # A census is a budget: the full-vector branch wins over the
+        # scalar one, never their sum.
+        assert totals[("psum", "data")] == \
+            (1, pytest.approx(2 * (p - 1) / p * 128 * 4))
+
+    def test_while_bodies_flagged_unbounded(self, mesh):
+        def body(x):
+            def cond(carry):
+                return carry.sum() < 100.0
+
+            def step(carry):
+                return carry + pm.psum(carry, "data")
+
+            return jax.lax.while_loop(cond, step, x)
+
+        fn = pm.shard_map(body, mesh, in_specs=(P(),), out_specs=P(),
+                          check_vma=False)
+        entries, _ = gc.census_of_callable(
+            fn, jnp.ones((4,), jnp.float32), suppress_accounting=True)
+        psums = [e for e in entries if e.op == "psum"]
+        assert psums and all(e.unbounded for e in psums)
+
+    def test_serving_rung_census_is_collective_free(self, mesh):
+        # A serving forward that grew a collective would pay ICI per
+        # request; the int8 rung's dequant+forward graph must be empty.
+        t = _target(gt.default_targets(mesh), "serving/rung_int8")
+        built = t.build()
+        entries, declared = gc.census_of_callable(built["fn"],
+                                                  *built["args"])
+        assert entries == []
+        assert gc._declared_byte_totals(declared) == {}
+
+    def test_suppressed_trace_declares_nothing(self, mesh):
+        # The train_loop census bracket re-traces a step that was
+        # already counted; comms_scaled(0) must keep the second trace
+        # out of the declared series entirely.
+        t = _target(gt.default_targets(mesh), "dist_loss/fwd")
+        built = t.build()
+        acct = pm.comms_accounting()
+        mark = acct.totals()
+        entries, declared = gc.census_of_callable(
+            built["fn"], *built["args"], suppress_accounting=True)
+        assert declared == {}
+        assert acct.delta(mark) == {}
+        assert entries  # the census itself still sees the graph
+
+
+class TestHloCensus:
+    def test_gspmd_collectives_visible_only_in_hlo(self, mesh):
+        t = _target(gt.default_targets(mesh), "gspmd/matmul")
+        built = t.build()
+        entries, _ = gc.census_of_callable(built["fn"], *built["args"])
+        assert entries == []  # the jaxpr holds no collective eqns
+        compiled = built["fn"].lower(*built["args"]).compile()
+        hlo_entries = gc.hlo_census(compiled.as_text())
+        assert hlo_entries, "GSPMD inserted nothing the census can see"
+        assert {e.op for e in hlo_entries} <= set(gc.RING_FACTORS)
+        assert gc.census_bytes(hlo_entries) > 0
+
+    def test_unrecognized_replica_groups_price_at_world_size(self):
+        # Review-hardening: `replica_groups={}` (the all-replicas
+        # form) matches neither regex; with the caller-provided world
+        # size it must price at the full group, never P=1 (= 0 bytes).
+        line = ("%ar = f32[64]{0} all-reduce(f32[64]{0} %x), "
+                "replica_groups={}\n")
+        [entry] = gc.hlo_census(line, default_group_size=8)
+        assert entry.total_bytes == pytest.approx(2 * 7 / 8 * 256)
+        # And the P=1 default really is the zero-bytes hazard.
+        [entry1] = gc.hlo_census(line)
+        assert entry1.total_bytes == 0.0
+
+    def test_hlo_parser_on_pinned_lines(self):
+        text = (
+            "ROOT %ar = f32[4,8]{1,0} all-reduce(f32[4,8]{1,0} %dot), "
+            "channel_id=1, replica_groups=[1,8]<=[8]\n"
+            "%ag = f32[16,4]{1,0} all-gather(f32[2,4]{1,0} %p), "
+            "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}\n")
+        entries = gc.hlo_census(text)
+        assert [(e.op, e.dtype) for e in entries] == \
+            [("psum", "float32"), ("all_gather", "float32")]
+        # all-reduce: 2*(7/8)*128; all-gather: operand shard (2,4) f32.
+        assert entries[0].total_bytes == pytest.approx(2 * 7 / 8 * 128)
+        assert entries[1].total_bytes == pytest.approx(7 * 32)
+
+
+# ---------------------------------------------------------------------------
+# wire-dtype verifier
+
+
+class TestWireDtype:
+    def test_real_int8_grad_reduce_graph_is_clean(self, mesh):
+        t = _target(gt.default_targets(mesh), "grad_reduce/int8")
+        built = t.build()
+        entries, _ = gc.census_of_callable(built["fn"], *built["args"])
+        assert gwd.wire_dtype_findings(entries, "int8", t.name) == []
+
+    def test_real_bf16_grad_reduce_graph_is_clean(self, mesh):
+        t = _target(gt.default_targets(mesh), "grad_reduce/bf16")
+        built = t.build()
+        entries, _ = gc.census_of_callable(built["fn"], *built["args"])
+        assert gwd.wire_dtype_findings(entries, "bf16", t.name) == []
+
+    def test_doctored_f32_leak_fails(self, mesh):
+        # The incident shape: a raw lax collective smuggled past the
+        # precision policy — the shims' own accounting would never see
+        # it, the graph cannot miss it.
+        def body(t):
+            with pm.collective_precision("int8"):
+                return jax.lax.psum(t, "data")
+
+        fn = pm.shard_map(body, mesh, in_specs=(P(),), out_specs=P(),
+                          check_vma=False)
+        entries, _ = gc.census_of_callable(
+            fn, jnp.ones((4096,), jnp.float32), suppress_accounting=True)
+        findings = gwd.wire_dtype_findings(entries, "int8", "leak")
+        assert len(findings) == 1
+        assert "float32[4096]" in findings[0].message
+        assert findings[0].path == "graph://leak"
+
+    def test_small_payloads_ride_full_precision_legally(self, mesh):
+        # Below MIN_QUANT_ELEMS the policy deliberately keeps f32
+        # (scales would cost more than they save) — not a finding.
+        def body(t):
+            with pm.collective_precision("int8"):
+                return jax.lax.psum(t, "data")
+
+        fn = pm.shard_map(body, mesh, in_specs=(P(),), out_specs=P(),
+                          check_vma=False)
+        entries, _ = gc.census_of_callable(
+            fn, jnp.ones((8,), jnp.float32), suppress_accounting=True)
+        assert gwd.wire_dtype_findings(entries, "int8", "small") == []
+
+
+# ---------------------------------------------------------------------------
+# donation/aliasing auditor
+
+
+class TestDonation:
+    def test_returned_donated_view_fires(self):
+        # The PR 5 incident class as a graph shape: a donated buffer
+        # passed through to the outputs.
+        def step(state, x):
+            return state, state["w"] * x.sum()
+
+        findings = gdon.donation_findings(
+            step, ({"w": jnp.ones((64,), jnp.float32)},
+                   jnp.ones((4,), jnp.float32)), (0,), "fixture")
+        assert len(findings) == 1
+        assert "returned UNCHANGED" in findings[0].message
+        assert findings[0].snippet.startswith("returned-view")
+
+    def test_broken_promise_fires(self):
+        # A donated operand with no same-shaped output: XLA can never
+        # alias it — the memory promise is a lie.
+        def step(big, y):
+            return y * 2.0
+
+        findings = gdon.donation_findings(
+            step, (jnp.ones((128,), jnp.float32),
+                   jnp.ones((8,), jnp.float32)), (0,), "fixture")
+        assert len(findings) == 1
+        assert "broken memory promise" in findings[0].message
+
+    def test_healthy_update_is_clean(self):
+        def step(state, x):
+            return {"w": state["w"] - 0.1 * x.sum()}
+
+        assert gdon.donation_findings(
+            step, ({"w": jnp.ones((64,), jnp.float32)},
+                   jnp.ones((4,), jnp.float32)), (0,), "ok") == []
+
+    def test_real_donated_train_step_is_clean(self, mesh):
+        # The PR 1 incident class on the real factory: the package's
+        # donated train step must audit clean (acceptance criterion).
+        t = _target(gt.default_targets(mesh), "train_step/donated")
+        built = t.build()
+        fn = built["fn"]
+        findings = gdon.donation_findings(
+            getattr(fn, "__wrapped__", fn), built["args"], t.donate,
+            t.name)
+        assert findings == []
+
+    def test_alias_report_reads_stablehlo_annotations(self):
+        f = jax.jit(lambda s, x: {"w": s["w"] - x.sum()},
+                    donate_argnums=(0,))
+        txt = f.lower({"w": jnp.ones((64,), jnp.float32)},
+                      jnp.ones((4,), jnp.float32)).as_text()
+        report = gdon.lowered_alias_report(txt)
+        assert report == {0: 0}
+
+
+# ---------------------------------------------------------------------------
+# recompile-cause differ
+
+
+class TestRecompileDiffer:
+    def test_cause_priorities(self):
+        base = {"structure": "s1", "dtype": "float32", "version": "v1",
+                "shape": (16, 2)}
+        assert grc.diff_signatures(dict(base), dict(base)) == "recompile"
+        assert grc.diff_signatures(
+            {**base, "structure": "s2"}, base) == "structure"
+        assert grc.diff_signatures(
+            {**base, "dtype": "int8"}, base) == "dtype"
+        assert grc.diff_signatures(
+            {**base, "version": "v2"}, base) == "weights_reload"
+        assert grc.diff_signatures(
+            {**base, "shape": (32, 2)}, base) == "new_shape"
+        # Priority: structure beats everything else when both differ.
+        assert grc.diff_signatures(
+            {**base, "structure": "s2", "dtype": "int8"}, base) \
+            == "structure"
+
+    def test_differ_walks_nearest_prior(self):
+        d = grc.RecompileDiffer()
+        sig = {"structure": "s1", "dtype": "float32", "version": "v1",
+               "shape": (16,)}
+        assert d.observe(("k1",), sig) == "first_compile"
+        assert d.observe(("k2",), {**sig, "shape": (32,)}) == "new_shape"
+        assert d.observe(("k3",), {**sig, "dtype": "int8"}) == "dtype"
+        # Same key, same signature again: churn.
+        assert d.observe(("k1",), sig) == "recompile"
+
+    def test_churn_findings(self):
+        ev = [{"event": "compile", "bucket": 16, "dtype": "float32",
+               "structure": "aa"}]
+        ev += [{"event": "compile", "bucket": 16, "dtype": "float32",
+                "structure": "aa", "cause": "recompile"}] * 3
+        # training compiles (no bucket) are exempt from the cause rule
+        ev.append({"event": "compile", "duration_ms": 5.0})
+        findings = grc.churn_findings(ev, churn_threshold=3)
+        kinds = sorted(f.snippet.split("|")[0] for f in findings)
+        assert kinds == ["causeless", "churn"]
+
+    def test_history_is_bounded(self):
+        # Review-hardening: a long-lived worker mints a fresh cache key
+        # per rollout; the differ's history must not be the slow leak.
+        d = grc.RecompileDiffer(max_history=4)
+        sig = {"structure": "s", "dtype": "float32", "version": "v",
+               "shape": (1,)}
+        for i in range(100):
+            d.observe(("k", i), {**sig, "version": f"v{i}"})
+        assert len(d._by_key) == 4
+        # And the newest entries survive: the next reload still diffs
+        # against a recent neighbor, not a pruned ancient one.
+        assert d.observe(("k", 100), {**sig, "version": "v100"}) \
+            == "weights_reload"
+
+    def test_weight_reloads_are_not_churn(self):
+        # Review-hardening: a rollout recompiles every bucket with
+        # cause="weights_reload" — the (bucket, dtype, structure)
+        # triple cannot see the version change, so reload compiles are
+        # exempt from the churn signature (a healthy rollout must not
+        # fail the gate as cache thrash).
+        ev = [{"event": "compile", "bucket": 16, "dtype": "float32",
+               "structure": "aa", "cause": "weights_reload"}] * 5
+        assert grc.churn_findings(ev, churn_threshold=3) == []
+
+    def test_engine_compiles_carry_causes(self, tmp_path):
+        from ntxent_tpu import obs
+        from ntxent_tpu.obs.registry import MetricsRegistry
+        from ntxent_tpu.serving.engine import InferenceEngine
+        from ntxent_tpu.serving.metrics import ServingMetrics
+
+        log_path = str(tmp_path / "ev.jsonl")
+        log = obs.EventLog(log_path)
+        previous = obs.install(log)
+        try:
+            reg = MetricsRegistry()
+            w = jnp.asarray(np.random.RandomState(0).rand(2, 3),
+                            jnp.float32)
+            eng = InferenceEngine(lambda v, x: x @ v, w,
+                                  example_shape=(2,), buckets=(1, 2),
+                                  metrics=ServingMetrics(registry=reg))
+            eng.warmup()
+            # Same-structure weight reload, then a fresh compile.
+            eng.update_variables(w * 2.0)
+            eng.embed(np.ones((1, 2), np.float32))
+            log.flush()
+        finally:
+            obs.install(previous)
+        events = [json.loads(line) for line in open(log_path)]
+        compiles = [e for e in events if e["event"] == "compile"]
+        assert [e["cause"] for e in compiles] == \
+            ["first_compile", "new_shape", "weights_reload"]
+        assert all("bucket" in e and "structure" in e for e in compiles)
+        # The causal breakdown lands on the registry too.
+        scrape = reg.render_prometheus()
+        assert 'serving_compiles_by_cause_total{reason="first_compile"} 1' \
+            in scrape
+        assert 'serving_compiles_by_cause_total{reason="weights_reload"} 1' \
+            in scrape
+        # No cause-less serving compiles, no churn: the differ wiring
+        # itself passes its own analyzer.
+        assert grc.churn_findings(compiles) == []
+
+
+# ---------------------------------------------------------------------------
+# publication: timeline + train_loop wiring
+
+
+class TestPublication:
+    def test_set_comms_per_step_publishes_graph_remainder(self, tmp_path):
+        from ntxent_tpu import obs
+        from ntxent_tpu.obs.registry import MetricsRegistry
+        from ntxent_tpu.obs.timeline import StepTimeline
+
+        log_path = str(tmp_path / "ev.jsonl")
+        log = obs.EventLog(log_path)
+        previous = obs.install(log)
+        try:
+            reg = MetricsRegistry()
+            tl = StepTimeline(registry=reg)
+            tl.set_comms_per_step(
+                {("all_gather", "data"): (2, 896.0)},
+                graph={"graph_bytes": 1351.0, "declared_bytes": 903.0,
+                       "ad_bytes": 448.0, "gspmd_bytes": 224.0})
+            log.flush()
+        finally:
+            obs.install(previous)
+        scrape = reg.render_prometheus()
+        assert 'collective_graph_bytes_total{source="ad"} 448' in scrape
+        assert 'collective_graph_bytes_total{source="gspmd"} 224' in scrape
+        [profile] = [json.loads(line) for line in open(log_path)
+                     if '"comms_profile"' in line]
+        assert profile["ad_bytes"] == 448.0
+        assert profile["graph_bytes"] == 1351.0
+
+    def test_graph_census_true_without_timeline_raises(self):
+        # Review-hardening: an explicit graph_census=True with no
+        # timeline to publish through must fail loudly, not no-op.
+        from ntxent_tpu.training.trainer import train_loop
+
+        with pytest.raises(ValueError, match="graph_census"):
+            train_loop(None, iter(()), lambda s, a, b: (s, {}), 1,
+                       graph_census=True)
+
+    def test_set_comms_per_step_positional_call_unchanged(self):
+        # The pre-ISSUE-14 call shape (test_trace pins it too) must
+        # keep working with no graph summary.
+        from ntxent_tpu.obs.registry import MetricsRegistry
+        from ntxent_tpu.obs.timeline import StepTimeline
+
+        reg = MetricsRegistry()
+        tl = StepTimeline(registry=reg)
+        tl.set_comms_per_step({("psum", "data"): (1, 7.0)})
+        assert reg.gauge("train_step_comms_bytes").value == 7.0
+        assert "collective_graph_bytes_total" \
+            not in reg.render_prometheus()
+
+    def test_train_loop_census_lands_on_registry(self, mesh):
+        import flax.linen as nn
+
+        from ntxent_tpu.obs.registry import MetricsRegistry
+        from ntxent_tpu.obs.timeline import StepTimeline
+        from ntxent_tpu.parallel.mesh import replicate_state
+        from ntxent_tpu.training.trainer import (
+            TrainerConfig,
+            create_train_state,
+            make_sharded_train_step,
+            shard_batch,
+            train_loop,
+        )
+
+        class M(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=True):
+                z = nn.Dense(8)(x.reshape((x.shape[0], -1)))
+                return z / (jnp.linalg.norm(z, axis=-1,
+                                            keepdims=True) + 1e-6)
+
+        cfg = TrainerConfig(batch_size=8, total_steps=4, warmup_steps=1)
+        state = create_train_state(M(), jax.random.PRNGKey(0),
+                                   (2, 4, 4, 3), cfg)
+        state = replicate_state(state, mesh)
+        step = make_sharded_train_step(mesh, temperature=0.1)
+        reg = MetricsRegistry()
+        tl = StepTimeline(registry=reg)
+        rng = np.random.default_rng(0)
+
+        def it():
+            while True:
+                v1 = jnp.asarray(rng.standard_normal((8, 4, 4, 3)),
+                                 jnp.float32)
+                v2 = jnp.asarray(rng.standard_normal((8, 4, 4, 3)),
+                                 jnp.float32)
+                yield shard_batch((v1, v2), mesh)
+
+        train_loop(state, it(), step, 2, log_every=10, timeline=tl,
+                   flops_per_step=None)
+        scrape = reg.render_prometheus()
+        # The step's AD-dual traffic is published automatically.
+        assert 'collective_graph_bytes_total{source="ad"}' in scrape
+        [val] = [float(line.split()[-1])
+                 for line in scrape.splitlines()
+                 if line.startswith(
+                     'collective_graph_bytes_total{source="ad"}')]
+        assert val > 0
+
+
+# ---------------------------------------------------------------------------
+# ntxent-audit CLI end-to-end
+
+
+class TestAuditCli:
+    def test_full_suite_clean_on_the_real_tree(self, capsys):
+        rc = audit_main(["--no-baseline", "--format", "json",
+                         "--no-publish"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["new"] == []
+        # Acceptance pins, end to end: exact forward match + nonzero
+        # AD remainder + nonzero gspmd detection.
+        for name in ("dist_loss/fwd", "ring/fwd"):
+            c = out["census"][name]
+            assert c["graph_bytes"] == c["declared_bytes"] > 0
+            assert c["ad_bytes"] == 0.0
+        assert out["census"]["dist_loss/grad"]["ad_bytes"] > 0
+        assert out["census"]["gspmd/matmul"]["hlo_bytes"] > 0
+        assert out["census"]["_remainder"]["ad_bytes"] > 0
+        assert out["census"]["_remainder"]["gspmd_bytes"] > 0
+
+    def test_doctored_fixture_fails_with_all_four_analyzers(
+            self, tmp_path, capsys):
+        fixture = tmp_path / "fixture.py"
+        fixture.write_text(
+            "from ntxent_tpu.analysis.graph.targets import AuditTarget\n"
+            "\n\ndef targets(mesh):\n"
+            "    import jax\n"
+            "    import jax.numpy as jnp\n"
+            "    from jax.sharding import PartitionSpec as P\n"
+            "    from ntxent_tpu.parallel import mesh as pm\n"
+            "\n"
+            "    def bypass():\n"
+            "        def body(x):\n"
+            "            return jax.lax.psum(jnp.sum(x), 'data')\n"
+            "        fn = pm.shard_map(body, mesh,\n"
+            "                          in_specs=(P('data'),),\n"
+            "                          out_specs=P(), check_vma=False)\n"
+            "        return {'fn': fn,\n"
+            "                'args': (jnp.ones((16, 4), jnp.float32),)}\n"
+            "\n"
+            "    def leak():\n"
+            "        def body(t):\n"
+            "            with pm.collective_precision('int8'):\n"
+            "                return jax.lax.psum(t, 'data')\n"
+            "        fn = pm.shard_map(body, mesh, in_specs=(P(),),\n"
+            "                          out_specs=P(), check_vma=False)\n"
+            "        return {'fn': fn,\n"
+            "                'args': (jnp.ones((4096,), jnp.float32),)}\n"
+            "\n"
+            "    def view():\n"
+            "        def step(s, x):\n"
+            "            return s, s['w'] * x.sum()\n"
+            "        return {'fn': step,\n"
+            "                'args': ({'w': jnp.ones((64,), jnp.float32)},\n"
+            "                         jnp.ones((4,), jnp.float32))}\n"
+            "\n"
+            "    return [\n"
+            "        AuditTarget('doc/bypass', 'census-fwd', bypass),\n"
+            "        AuditTarget('doc/leak', 'wire-dtype', leak,\n"
+            "                    policy='int8'),\n"
+            "        AuditTarget('doc/view', 'donation', view,\n"
+            "                    donate=(0,)),\n"
+            "    ]\n")
+        events = tmp_path / "ev.jsonl"
+        events.write_text(
+            '{"event": "compile", "bucket": 4, "dtype": "float32", '
+            '"structure": "x"}\n' * 3)
+        rc = audit_main(["--no-baseline", "--format", "json",
+                         "--no-publish",
+                         "--fixture-module", str(fixture),
+                         "--events", str(events)])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert {f["rule"] for f in out["new"]} == {
+            "collective-census", "wire-dtype", "donation",
+            "recompile-cause"}
+        # The real targets stay clean alongside the doctored ones.
+        assert all("doc/" in f["path"] or f["path"].startswith("events:")
+                   for f in out["new"])
+
+    def test_baseline_accepts_and_goes_stale(self, tmp_path, capsys):
+        # Shared baseline semantics (lint's machinery): accepted
+        # findings pass, a fixed finding reports the entry stale. The
+        # recompile-only run keeps this test trace-free (fast).
+        events = tmp_path / "ev.jsonl"
+        events.write_text(
+            '{"event": "compile", "bucket": 4, "dtype": "float32", '
+            '"structure": "x"}\n')
+        baseline = tmp_path / "audit_baseline.json"
+        args = ["--analyzers", "recompile-cause", "--events",
+                str(events), "--baseline", str(baseline)]
+        assert audit_main(args + ["--write-baseline"]) == 0
+        capsys.readouterr()
+        assert audit_main(args) == 0  # baselined -> clean
+        capsys.readouterr()
+        events.write_text(
+            '{"event": "compile", "bucket": 4, "dtype": "float32", '
+            '"structure": "x", "cause": "first_compile"}\n')
+        assert audit_main(args) == 0  # fixed: clean, entry now stale
+        assert "stale" in capsys.readouterr().err
+
+    def test_scoped_write_baseline_carries_other_analyzers(
+            self, tmp_path, capsys):
+        # Review-hardening (the lint CLI's PR 12 fix, replicated): a
+        # --analyzers-scoped --write-baseline must not drop the other
+        # analyzers' accepted entries from the rewritten file.
+        baseline = tmp_path / "audit_baseline.json"
+        baseline.write_text(json.dumps({"version": 1, "findings": [
+            {"rule": "donation", "path": "graph://t", "snippet": "s",
+             "count": 1, "reason": "accepted"}]}))
+        events = tmp_path / "ev.jsonl"
+        events.write_text(
+            '{"event": "compile", "bucket": 4, "dtype": "float32", '
+            '"structure": "x"}\n')
+        rc = audit_main(["--analyzers", "recompile-cause", "--events",
+                         str(events), "--baseline", str(baseline),
+                         "--write-baseline"])
+        capsys.readouterr()
+        assert rc == 0
+        entries = json.loads(baseline.read_text())["findings"]
+        rules = sorted(e["rule"] for e in entries)
+        assert rules == ["donation", "recompile-cause"]
+        [don] = [e for e in entries if e["rule"] == "donation"]
+        assert don["reason"] == "accepted"  # hand-written reason kept
+
+    def test_recompile_scoped_without_events_is_a_usage_error(
+            self, capsys, tmp_path):
+        # Review-hardening: an explicitly-scoped recompile-cause run
+        # with nothing to read must be rc 2, not a green no-op — and
+        # the converse (--events with the analyzer deselected) too.
+        assert audit_main(["--analyzers", "recompile-cause"]) == 2
+        assert "--events" in capsys.readouterr().err
+        events = tmp_path / "ev.jsonl"
+        events.write_text("")
+        assert audit_main(["--analyzers", "donation", "--events",
+                           str(events)]) == 2
+        assert "ignored" in capsys.readouterr().err
+
+    def test_list_analyzers(self, capsys):
+        assert audit_main(["--list-analyzers"]) == 0
+        out = capsys.readouterr().out
+        for name in ("collective-census", "wire-dtype", "donation",
+                     "recompile-cause"):
+            assert name in out
+
+
+# ---------------------------------------------------------------------------
+# shared github reporter (ISSUE 14 satellite)
+
+
+class TestGithubFormat:
+    def test_annotation_lines_and_escaping(self):
+        from ntxent_tpu.analysis.framework import Finding
+        from ntxent_tpu.analysis.reporting import github_annotations
+
+        f = Finding(rule="wire-dtype", path="graph://t", line=0,
+                    message="a,b\nc: 100%", snippet="s")
+        [line] = github_annotations([f], "ntxent-audit")
+        assert line.startswith("::error file=graph%3A//t,")
+        assert "title=ntxent-audit[wire-dtype]" in line
+        assert line.endswith("::a,b%0Ac: 100%25")
+        # line=0 (graph findings) omits the line property entirely
+        assert ",line=" not in line
+
+    def test_lint_cli_github_format(self, capsys):
+        from pathlib import Path
+
+        from ntxent_tpu.analysis.cli import main as lint_main
+
+        fixtures = Path(__file__).parent / "lint_fixtures" / "tree"
+        rc = lint_main(["--root", str(fixtures), "--no-baseline",
+                        "--format", "github"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        lines = [ln for ln in out.splitlines() if ln.startswith("::error")]
+        assert len(lines) >= 5
+        assert any("ntxent-lint[collective-shim]" in ln for ln in lines)
+        assert all("file=" in ln and "line=" in ln for ln in lines)
