@@ -96,6 +96,11 @@ class LintConfig:
         "ntxent_tpu.resilience.faults",
         "ntxent_tpu.resilience.crashsim",
         "ntxent_tpu.analysis",
+        # ISSUE 15: the retrieval tier (ANN index + /search router
+        # surface) rides the router process — backend-init latency or
+        # an accelerator hold in a search path would be a regression
+        # the tripwire test also pins end-to-end.
+        "ntxent_tpu.retrieval",
     )
     boundary_forbidden: tuple[str, ...] = (
         # jax plus everything that eagerly imports it: any of these at
@@ -123,6 +128,11 @@ class LintConfig:
         # ISSUE 14: collective_graph_bytes_total{source=ad|gspmd} — a
         # two-value closed set naming who inserted the traffic.
         "source",
+        # ISSUE 15: retrieval_ops_total{kind=build|seal|compact|
+        # promote|rollback|stale|rebuild} — the index lifecycle, a
+        # closed set (retrieval_latency_ms rides the existing `stage`
+        # key).
+        "kind",
     )
 
 

@@ -1278,20 +1278,25 @@ def _train_clip(args, info, per_process_batch: int, injector=None) -> int:
             # meshes carry ('dcn', 'data')).
             sharding = NamedSharding(mesh, P(tuple(mesh.axis_names)))
         else:
+            from ntxent_tpu.training import init_error_feedback
             from ntxent_tpu.training.trainer import (
                 make_sharded_clip_train_step)
 
             mesh = _data_mesh(args)
-            # int8 here quantizes the modality gathers + gradient pmean
-            # WITHOUT error feedback (the CLIP step carries no residual
-            # operand yet — trainer.make_sharded_clip_train_step).
             step = make_sharded_clip_train_step(
                 mesh, remat=args.remat, moe_aux_weight=moe_aux,
                 collective_dtype=args.collective_dtype)
             # Same rationale as the SimCLR mesh path: restore must land
-            # replicated on the mesh, not committed to one device.
+            # replicated on the mesh, not committed to one device —
+            # and int8 runs carry the error-feedback residual in the
+            # state (ISSUE 15 satellite: the CLIP step threads
+            # ef_residual exactly like the SimCLR one).
             from ntxent_tpu.parallel.mesh import replicate_state
-            prepare_state = lambda s: replicate_state(s, mesh)  # noqa: E731
+            if args.collective_dtype == "int8":
+                prepare_state = lambda s: init_error_feedback(  # noqa: E731
+                    replicate_state(s, mesh), mesh)
+            else:
+                prepare_state = lambda s: replicate_state(s, mesh)  # noqa: E731,E501
             state = prepare_state(state)
             logger.info("CLIP shard_map data-parallel over %d devices "
                         "(fused partial InfoNCE)", n_dev)
@@ -1758,6 +1763,37 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                     help="mirrored rows diffed before the drift bar "
                          "can judge (the verdict defers until then)")
 
+    ix = p.add_argument_group("retrieval (ntxent_tpu/retrieval/: "
+                              "checkpoint-step-versioned ANN index "
+                              "over served embeddings — POST /search)")
+    ix.add_argument("--index-dir", default=None, metavar="DIR",
+                    help="enable the retrieval tier with segment "
+                         "persistence under DIR (per-step subdirs; "
+                         "stage-fsync-rename sealing). POST /search, "
+                         "/index/insert and /embed?store=true go live")
+    ix.add_argument("--index-mem", action="store_true",
+                    help="enable the retrieval tier fully in memory "
+                         "(no segment persistence — smoke/load tests)")
+    ix.add_argument("--index-train-rows", type=int, default=2048,
+                    metavar="N",
+                    help="rows before IVF centroids train; below this "
+                         "search is exact brute force (perfect recall "
+                         "while small)")
+    ix.add_argument("--index-centroids", type=int, default=64,
+                    help="IVF list count once trained")
+    ix.add_argument("--index-nprobe", type=int, default=16,
+                    help="IVF lists scanned per query")
+    ix.add_argument("--index-seal-rows", type=int, default=4096,
+                    help="mutable-segment rows before a seal to disk")
+    ix.add_argument("--index-docstore-rows", type=int, default=65536,
+                    help="input rows retained for background "
+                         "re-embedding rebuilds (promote/stale); past "
+                         "the bound the oldest are evicted")
+    ix.add_argument("--index-maintain-interval", type=float,
+                    default=2.0, metavar="SECONDS",
+                    help="background maintenance tick (train/seal/"
+                         "compact/recall probe)")
+
     f = p.add_argument_group("fleet supervision")
     f.add_argument("--workdir", default=None,
                    help="port files + per-worker logs (default: a "
@@ -1976,6 +2012,28 @@ def fleet_main(argv=None) -> int:
         warm_rows=args.cache_warm_rows)
     router.set_run_id(run_id)
 
+    # Retrieval tier (ISSUE 15): the versioned ANN index rides the
+    # router process — JAX-free like everything else here, its rebuild
+    # re-embeds through the router's own forward path.
+    index_mgr = None
+    if args.index_dir or args.index_mem:
+        from ntxent_tpu.retrieval import IndexManager
+
+        index_mgr = IndexManager(
+            root=args.index_dir, registry=registry,
+            docstore_rows=args.index_docstore_rows,
+            maintain_interval_s=args.index_maintain_interval,
+            train_rows=args.index_train_rows,
+            n_centroids=args.index_centroids,
+            nprobe=args.index_nprobe,
+            seal_rows=args.index_seal_rows)
+        router.attach_index(index_mgr)
+        logger.info("retrieval tier: POST /search live (%s, "
+                    "train_rows=%d, nprobe=%d/%d)",
+                    args.index_dir or "in-memory",
+                    args.index_train_rows, args.index_nprobe,
+                    args.index_centroids)
+
     # Fleet observability plane (ISSUE 10): shadow mirror, metric
     # federation, SLO engine. All off-hot-path; all optional.
     shadow = None
@@ -2044,6 +2102,8 @@ def fleet_main(argv=None) -> int:
 
     fleet.start()
     router.start()
+    if index_mgr is not None:
+        index_mgr.start()
     if shadow is not None:
         shadow.start()
     if aggregator is not None:
@@ -2064,6 +2124,8 @@ def fleet_main(argv=None) -> int:
             aggregator.stop()
         if shadow is not None:
             shadow.stop()
+        if index_mgr is not None:
+            index_mgr.stop()
         router.close()
         fleet.stop()
         if event_log is not None:

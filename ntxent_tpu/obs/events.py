@@ -70,10 +70,12 @@ __all__ = ["EVENT_TYPES", "EventLog", "install", "get_event_log", "emit",
 # emitted-but-undeclared until the telemetry-schema lint (ISSUE 13)
 # made every literal emit type check against this tuple; runtime still
 # accepts unknown types (extensibility), the LINTER is now the typo
-# guard.
+# guard. index: a retrieval-tier index lifecycle action (ISSUE 15,
+# ntxent_tpu/retrieval/ — build/seal/compact/activate/promote/rollback/
+# drop/stale/rebuild).
 EVENT_TYPES = ("step", "retry", "divergence", "restart", "checkpoint",
                "compile", "trace", "span", "rollout", "fleet", "alert",
-               "comms_profile", "bench")
+               "comms_profile", "bench", "index")
 
 
 class EventLog:

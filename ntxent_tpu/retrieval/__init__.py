@@ -1,0 +1,33 @@
+"""Retrieval tier: a versioned ANN index over served embeddings.
+
+The fleet computes embeddings at high QPS and, until ISSUE 15, threw
+every one of them away. This package keeps them searchable: IVF-flat
+ANN over memory-mapped append-only segments (``index``/``segments``/
+``ivf``), with index VERSIONS keyed to checkpoint steps and driven by
+the router's rollout state machine (``versioned`` — promote cuts
+searches to the new step's index, rollback restores the prior one, a
+shadow-drift breach marks it stale and forces rebuild). The router
+surfaces it as ``POST /search`` (serving/router.py).
+
+JAX-free at import by construction: numpy + stdlib only. The
+import-boundary lint (``LintConfig.boundary_roots``) and the runtime
+tripwire (tests/test_fleet.py) both enforce it — search must never pay
+backend-init latency or hold an accelerator.
+"""
+
+from .index import RetrievalMetrics, VectorIndex
+from .ivf import IVFIndex, brute_force_topk, kmeans
+from .segments import MutableSegment, SealedSegment, SegmentStore
+from .versioned import IndexManager
+
+__all__ = [
+    "IndexManager",
+    "IVFIndex",
+    "MutableSegment",
+    "RetrievalMetrics",
+    "SealedSegment",
+    "SegmentStore",
+    "VectorIndex",
+    "brute_force_topk",
+    "kmeans",
+]

@@ -1,0 +1,366 @@
+"""One searchable vector index: segments + IVF + telemetry.
+
+``VectorIndex`` ties the durable substrate (segments.py) to the search
+structure (ivf.py) behind a single lock:
+
+* inserts append to the mutable segment AND to the live search
+  structure, so a row is searchable the moment ``insert`` returns;
+* below ``train_rows`` total rows the search is exact brute force —
+  recall is perfect while the index is small, and there is nothing to
+  train centroids on yet ("exact brute-force fallback below the
+  training threshold");
+* at ``train_rows`` the next maintenance pass trains k-means centroids
+  on everything inserted so far and switches to IVF-``nprobe`` search
+  (an ``index`` event with ``action="build"`` marks the cut);
+* ``maintain()`` also runs the segment lifecycle — seal the mutable
+  tail past ``seal_rows``, compact past ``compact_at`` sealed segments
+  — and refreshes the recall-probe gauge, so one periodic call (the
+  manager's maintenance thread, or a test) drives everything
+  background about the index.
+
+Telemetry rides a shared ``RetrievalMetrics`` (one per manager — the
+counters are fleet-lifetime totals across index versions, the gauges
+describe the ACTIVE version) and typed ``index`` events through the
+process-wide obs hub.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import numpy as np
+
+from ..obs import events as _events
+from ..obs.registry import MetricsRegistry
+from .ivf import IVFIndex, brute_force_topk, kmeans
+from .segments import SegmentStore
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["RetrievalMetrics", "VectorIndex"]
+
+
+class RetrievalMetrics:
+    """The retrieval tier's metric family on a shared registry.
+
+    One instance serves every index version a manager retains:
+    counters accumulate across versions (a promote must not zero the
+    fleet's insert history), gauges are overwritten to describe the
+    active version (``IndexManager.publish``).
+    """
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        r = self.registry
+        self.rows = r.gauge("retrieval_index_rows",
+                            "vectors in the active index version")
+        self.segments = r.gauge("retrieval_index_segments",
+                                "segments (sealed + mutable tail) in "
+                                "the active index version")
+        self.version = r.gauge("retrieval_index_version",
+                               "checkpoint step the active index was "
+                               "built under (-1 = none)")
+        self.version.set(-1)
+        self.stale = r.gauge("retrieval_index_stale",
+                             "1 while the active index is marked stale "
+                             "(embedding-space drift) pending rebuild")
+        self.versions = r.gauge("retrieval_index_versions",
+                                "index versions currently retained")
+        self.docstore_rows = r.gauge("retrieval_docstore_rows",
+                                     "input rows retained for rebuild")
+        self.recall = r.gauge("retrieval_recall_probe",
+                              "last probed recall@k of ANN search vs "
+                              "brute force on sampled stored rows")
+        self.inserts = r.counter("retrieval_inserts_total",
+                                 "vector rows inserted")
+        self.searches = r.counter("retrieval_searches_total",
+                                  "query rows searched")
+        self.docstore_evictions = r.counter(
+            "retrieval_docstore_evictions_total",
+            "input rows evicted from the rebuild store (bound hit)")
+        self.rebuilt_rows = r.counter(
+            "retrieval_rebuilt_rows_total",
+            "rows re-embedded into a rebuilt index version")
+        self._ops: dict[str, object] = {}
+        self._ops_lock = threading.Lock()
+        # search/insert are the index-internal scans; search_request is
+        # the router's end-to-end /search (embed forward + scan).
+        self.latency = {
+            stage: r.histogram("retrieval_latency_ms",
+                               "retrieval op latency by stage",
+                               labels={"stage": stage})
+            for stage in ("search", "insert", "search_request")
+        }
+
+    def op(self, kind: str) -> None:
+        """Bump ``retrieval_ops_total{kind=...}`` (build/seal/compact/
+        promote/rollback/stale/rebuild — the index lifecycle)."""
+        with self._ops_lock:
+            counter = self._ops.get(kind)
+            if counter is None:
+                counter = self._ops[kind] = self.registry.counter(
+                    "retrieval_ops_total",
+                    "index lifecycle actions by kind",
+                    labels={"kind": kind})
+        counter.inc()
+
+
+class VectorIndex:
+    """Thread-safe searchable index over one embedding space.
+
+    ``step`` is the checkpoint step whose model produced the vectors —
+    purely a label here; the version semantics live in
+    ``IndexManager``.
+    """
+
+    def __init__(self, dim: int, step: int | None = None,
+                 root=None, train_rows: int = 2048,
+                 n_centroids: int = 64, nprobe: int = 16,
+                 seal_rows: int = 4096, compact_at: int = 4,
+                 seed: int = 0,
+                 metrics: RetrievalMetrics | None = None):
+        self.dim = int(dim)
+        self.step = step
+        self.train_rows = max(1, int(train_rows))
+        self.n_centroids = max(1, int(n_centroids))
+        self.nprobe = max(1, int(nprobe))
+        self.seed = int(seed)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        # Serializes maintainers (the manager's thread, a test, an
+        # eager caller) — heavy maintenance work runs OUTSIDE
+        # ``_lock`` so searches never stall behind an fsync, a
+        # compaction merge, or a k-means pass.
+        self._maint_lock = threading.Lock()
+        self.store = SegmentStore(self.dim, root=root,
+                                  seal_rows=seal_rows,
+                                  compact_at=compact_at)
+        # Set by the manager when this instance is replaced/dropped:
+        # maintenance becomes a no-op, so a deleter can barrier on
+        # ``_maint_lock`` and then remove the segment directory
+        # without an in-flight seal recreating it underneath.
+        self.retired = False
+        self._ivf: IVFIndex | None = None
+        if self.store.rows >= self.train_rows:
+            # Reopened with enough durable rows: train immediately so
+            # a restart serves ANN search from the first query.
+            self.maintain()
+
+    # -- writes ------------------------------------------------------------
+    def insert(self, ids, vectors, count_metrics: bool = True) -> int:
+        """Append rows (searchable immediately); returns rows added.
+        ``count_metrics=False`` is the rebuild path's spelling: a
+        background re-embed replay must not inflate the client-facing
+        insert counters/latency (it has its own
+        ``retrieval_rebuilt_rows_total``)."""
+        vecs = np.asarray(vectors, np.float32)
+        if vecs.ndim == 1:
+            vecs = vecs[None]
+        if vecs.shape[1] != self.dim:
+            raise ValueError(f"expected dim {self.dim}, got "
+                             f"{vecs.shape[1]}")
+        ids = np.asarray(ids, np.int64)
+        t0 = time.monotonic()
+        with self._lock:
+            self.store.append(ids, vecs)
+            if self._ivf is not None:
+                self._ivf.add(ids, vecs)
+        if self.metrics is not None and count_metrics:
+            self.metrics.inserts.inc(int(vecs.shape[0]))
+            self.metrics.latency["insert"].observe(
+                (time.monotonic() - t0) * 1e3)
+        return int(vecs.shape[0])
+
+    # -- reads (all LOCK-FREE — see ``search`` for the argument) -----------
+    @property
+    def rows(self) -> int:
+        return self.store.rows
+
+    @property
+    def trained(self) -> bool:
+        return self._ivf is not None
+
+    def search(self, queries, k: int = 10,
+               nprobe: int | None = None) -> tuple[np.ndarray,
+                                                   np.ndarray]:
+        """Top-k ``(ids [Q,k], scores [Q,k])``; brute force until
+        trained, IVF after. Missing slots carry id -1.
+
+        LOCK-FREE: searches take no lock at all — under concurrent
+        insert+query a shared lock convoys with the GIL and measured
+        as a ~50 ms search p99 (vs a sub-ms p50). Safety comes from
+        the single-writer discipline (``_lock`` serializes all
+        mutation) plus write ordering: every append writes row data
+        BEFORE bumping the visible count, and buffer growth copies the
+        committed prefix before the pointer swap — so any interleaving
+        of attribute reads yields a valid prefix of committed rows,
+        never torn data. A search may simply miss rows committed after
+        it started, which is the semantics a concurrent reader expects
+        anyway."""
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None]
+        t0 = time.monotonic()
+        ivf = self._ivf
+        if ivf is None:
+            ids, vecs = self.store.all_rows()
+            out = brute_force_topk(q, ids, vecs, k)
+        else:
+            out = ivf.search(q, k,
+                             self.nprobe if nprobe is None else nprobe)
+        if self.metrics is not None:
+            self.metrics.searches.inc(int(q.shape[0]))
+            self.metrics.latency["search"].observe(
+                (time.monotonic() - t0) * 1e3)
+        return out
+
+    def search_exact(self, queries, k: int = 10) -> tuple[np.ndarray,
+                                                          np.ndarray]:
+        """Brute-force top-k regardless of training state (the recall
+        probe's ground truth). Lock-free like ``search``."""
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None]
+        ids, vecs = self.store.all_rows()
+        return brute_force_topk(q, ids, vecs, k)
+
+    def recall_probe(self, k: int = 10, sample: int = 32,
+                     seed: int = 1) -> float | None:
+        """recall@k of ANN search vs brute force on ``sample`` stored
+        rows; None below 2k rows (nothing meaningful to probe). Updates
+        the gauge."""
+        ids, vecs = self.store.all_rows()
+        n = vecs.shape[0]
+        if n < 2 * k:
+            return None
+        rng = np.random.RandomState(seed)
+        pick = rng.choice(n, size=min(int(sample), n), replace=False)
+        q = np.asarray(vecs[pick], np.float32)
+        # Bypass ``search``'s metrics: synthetic probe queries must
+        # not inflate retrieval_searches_total or the stage=search
+        # latency series a dashboard reads as client traffic.
+        ivf = self._ivf
+        if ivf is None:
+            ann_ids, _ = brute_force_topk(q, ids, vecs, k)
+        else:
+            ann_ids, _ = ivf.search(q, k, self.nprobe)
+        exact_ids, _ = brute_force_topk(q, ids, vecs, k)
+        hit = sum(len(set(a.tolist()) & set(e.tolist()))
+                  for a, e in zip(ann_ids, exact_ids))
+        recall = hit / float(exact_ids.shape[0] * k)
+        if self.metrics is not None:
+            self.metrics.recall.set(recall)
+        return recall
+
+    # -- maintenance -------------------------------------------------------
+    def maintain(self) -> bool:
+        """One maintenance pass: train at threshold, seal past
+        ``seal_rows``, compact past ``compact_at``. Returns True when
+        anything happened (the manager's thread backs off when idle).
+
+        TWO-PHASE under ``_maint_lock``: every copy/IO-heavy step
+        (k-means, the freeze's fsyncs, the compaction merge) runs
+        OUTSIDE the index lock, which is held only for pointer swaps —
+        the cost of background upkeep must never appear as a search
+        p99 spike. Searches keep answering throughout: brute force
+        while centroids train, the pending tail stays visible while a
+        seal's bytes hit disk, old segments serve until the merged one
+        swaps in."""
+        did = False
+        with self._maint_lock:
+            if self.retired:
+                # Replaced by a rebuild/rollback: no further segment
+                # writes — the manager may be deleting our directory.
+                return False
+            # 1) training cut: k-means AND the full list build run
+            #    outside the index lock over a bounded snapshot
+            #    (sealed + pending + the mutable tail's first n0
+            #    rows — all stable here: only this _maint_lock-
+            #    serialized pass seals/compacts, and lock-free reads
+            #    of committed prefixes are safe by the view
+            #    discipline). Under the lock only the DELTA rows that
+            #    arrived mid-training are added before the publish —
+            #    a full in-lock build at a large train_rows was
+            #    exactly the search-stall this two-phase contract
+            #    forbids.
+            if self._ivf is None:
+                mut0 = self.store.mutable
+                n0 = mut0.rows
+                parts = [s.view() if hasattr(s, "view")
+                         else (s.ids, s.vectors)
+                         for s in list(self.store.sealed)]
+                pending = self.store.pending
+                if pending is not None and pending.rows:
+                    parts.append(pending.view())
+                mids0, mvecs0 = mut0.view()
+                parts.append((mids0[:n0], mvecs0[:n0]))
+                ids1 = np.concatenate([np.asarray(i)
+                                       for i, _ in parts])
+                vecs1 = np.concatenate([np.asarray(v)
+                                        for _, v in parts])
+                if ids1.shape[0] >= self.train_rows:
+                    k = min(self.n_centroids, max(1, vecs1.shape[0]))
+                    centroids = kmeans(vecs1, k, seed=self.seed)
+                    ivf = IVFIndex(centroids)
+                    ivf.add(ids1, vecs1)
+                    with self._lock:
+                        # Only maintain swaps the mutable tail, and we
+                        # ARE maintain — the identity check is a
+                        # safety net, not an expected path.
+                        if self.store.mutable is mut0:
+                            mids, mvecs = mut0.view()
+                            if mids.shape[0] > n0:
+                                ivf.add(mids[n0:], mvecs[n0:])
+                            self._ivf = ivf
+                            trained_rows = int(
+                                ids1.shape[0]
+                                + max(0, mids.shape[0] - n0))
+                        else:  # pragma: no cover — retry next pass
+                            trained_rows = None
+                    if trained_rows is not None:
+                        did = True
+                        _events.emit("index", action="build",
+                                     step=self.step,
+                                     rows=trained_rows,
+                                     centroids=int(k),
+                                     nprobe=self.nprobe)
+                        if self.metrics is not None:
+                            self.metrics.op("build")
+                        logger.info("retrieval: trained %d centroids "
+                                    "over %d rows (step %s)", k,
+                                    trained_rows, self.step)
+            # 2) seal: pointer-take under the lock, freeze (disk or
+            #    in-memory trim) outside, publish under the lock.
+            frozen = None
+            with self._lock:
+                if self.store.should_seal():
+                    frozen = self.store.take_mutable()
+            if frozen is not None and frozen.rows:
+                seg = self.store.freeze(frozen)
+                with self._lock:
+                    self.store.publish(seg)
+                did = True
+                _events.emit("index", action="seal", step=self.step,
+                             segment=seg.name, rows=seg.rows)
+                if self.metrics is not None:
+                    self.metrics.op("seal")
+            # 3) compact: merge outside the lock, swap in, delete the
+            #    inputs after no reader can pick them up.
+            olds = None
+            with self._lock:
+                if self.store.should_compact():
+                    olds = list(self.store.sealed)
+            if olds:
+                merged = self.store.merge(olds)
+                with self._lock:
+                    self.store.swap_sealed(olds, merged)
+                self.store.delete_segments(olds)
+                did = True
+                _events.emit("index", action="compact", step=self.step,
+                             segment=merged.name, rows=merged.rows)
+                if self.metrics is not None:
+                    self.metrics.op("compact")
+        return did

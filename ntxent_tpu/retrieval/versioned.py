@@ -1,0 +1,684 @@
+"""Checkpoint-step-versioned index manager: the rollout coupling.
+
+The shadow-drift machinery (ISSUE 10) exists because two checkpoints'
+embedding SPACES diverge — which means an ANN index built over one
+model's embeddings silently answers wrong under another. So index
+versions here are keyed to checkpoint steps, and the router's rollout
+state machine drives the version lifecycle (ISSUE 15):
+
+* **adopt** — the first trusted step gets the first (empty) version;
+* **promote** — searches CUT OVER atomically to a fresh version keyed
+  to the newly trusted step; the prior version is retained (that is
+  what a rollback restores) and the new one is rebuilt in the
+  background by re-embedding the retained input rows through the now-
+  trusted fleet (``set_reembed`` installs the router's forward path);
+* **rollback** — the fleet reverted to an older checkpoint: the prior
+  step's version is restored ATOMICALLY (same dict-pointer swap as the
+  promote cut) with its vectors intact, so post-rollback searches
+  answer from the space the workers actually serve again;
+* **stale** — a shadow-drift breach is direct evidence the spaces
+  moved; the active version is flagged stale (gauge + typed event) and
+  a rebuild is forced. Until the rebuild lands, searches still answer
+  (an old answer beats a 503) but carry ``stale: true`` so callers can
+  tell.
+
+Inputs, not embeddings, are what survive a model change (the cache-
+warming lesson from ISSUE 9) — the manager retains up to
+``docstore_rows`` inserted INPUT rows keyed by their assigned ids, and
+that docstore is the rebuild source. Past the bound the oldest rows
+are evicted (counted; a rebuild then covers the retained tail only —
+logged, never silent).
+
+JAX-free like everything under ``retrieval/``: the lint boundary and
+the fleet tripwire both pin it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from ..obs import events as _events
+from ..obs.registry import MetricsRegistry
+from .index import RetrievalMetrics, VectorIndex
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["IndexManager"]
+
+
+class IndexManager:
+    """Versioned retrieval tier: one ``VectorIndex`` per trusted
+    checkpoint step, one active at a time.
+
+    ``root`` (optional) gives each version a ``step-<N>/`` segment
+    directory; None keeps every version in memory. ``index_kw`` passes
+    through to ``VectorIndex`` (train_rows/n_centroids/nprobe/
+    seal_rows/compact_at).
+    """
+
+    def __init__(self, dim: int | None = None, root=None,
+                 registry: MetricsRegistry | None = None,
+                 docstore_rows: int = 65536,
+                 keep_versions: int = 2,
+                 maintain_interval_s: float = 2.0,
+                 **index_kw):
+        # ``dim=None`` defers to the first inserted embedding's width —
+        # the router tier is JAX-free and cannot ask the model; until
+        # then versions are registered as placeholders (searches answer
+        # empty) and materialize on first insert.
+        self.dim = int(dim) if dim is not None else None
+        self.root = root
+        self.docstore_rows = max(1, int(docstore_rows))
+        self.keep_versions = max(1, int(keep_versions))
+        self.maintain_interval_s = float(maintain_interval_s)
+        self.index_kw = dict(index_kw)
+        self.metrics = RetrievalMetrics(registry)
+        self._lock = threading.Lock()
+        self._versions: OrderedDict[int, VectorIndex] = OrderedDict()
+        self._active_step: int | None = None
+        self._prior_step: int | None = None
+        self._stale_reason: str | None = None
+        self._next_id = 0
+        # id -> input row (np.float32), insertion-ordered for eviction.
+        self._docstore: OrderedDict[int, np.ndarray] = OrderedDict()
+        # Installed by the router: fn(inputs [N, ...]) -> embeddings
+        # [N, dim] or None on failure. Called on the rebuild thread.
+        self.reembed = None
+        self._rebuild_thread: threading.Thread | None = None
+        self._maint_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        # Replaced/retired index instances awaiting directory cleanup.
+        self._orphans: list = []
+        # Row count at the last recall probe: the probe materializes
+        # every vector, so an idle index must not pay it per tick.
+        self._last_probe_rows = -1
+        if self.root is not None:
+            self._reopen()
+
+    def _reopen(self) -> None:
+        """Adopt prior runs' persisted segments (``--index-dir`` must
+        not be write-only): per step, the newest non-empty ``g-*``
+        instance directory reopens as that step's version (dim read
+        from its segment metadata, ids resumed past the persisted
+        maximum so new inserts can never collide); every other
+        ``g-*`` dir is a crash/replacement orphan and is deleted —
+        without this, restarts leaked every prior instance's segments
+        forever. The docstore does not persist (ROADMAP follow-up), so
+        a post-restart rebuild covers newly inserted rows only."""
+        import json as _json
+        import os
+        import shutil
+
+        def _gen_dim(gen_path: str):
+            """``("empty", None)`` for a segment-less dir (per-run
+            debris — every instance mkdir's its root), ``("ok", dim)``
+            when every segment's metadata agrees, ``("unreadable",
+            None)`` on any read/parse failure — which must NEVER be
+            grounds for deletion (a transient IO error or one corrupt
+            meta must not amplify into losing the generation's healthy
+            segments; SegmentStore skips bad segments the same way)."""
+            try:
+                segs = [s for s in os.listdir(gen_path)
+                        if s.startswith("seg-")]
+            except OSError:
+                return "unreadable", None
+            if not segs:
+                return "empty", None
+            try:
+                dims = {
+                    int(_json.load(open(
+                        os.path.join(gen_path, seg, "meta.json")))
+                        ["dim"])
+                    for seg in segs
+                }
+            except (OSError, ValueError, KeyError, TypeError):
+                return "unreadable", None
+            if len(dims) != 1:
+                return "unreadable", None
+            return "ok", dims.pop()
+
+        root = str(self.root)
+        try:
+            listing = os.listdir(root)
+        except OSError:
+            return
+        steps: list[tuple[int, str]] = []
+        for d in listing:
+            if not d.startswith("step-"):
+                continue
+            try:
+                steps.append((int(d.split("-", 1)[1]), d))
+            except ValueError:
+                continue
+        max_id = -1
+        adoptions: list[tuple[int, VectorIndex]] = []
+        # NEWEST step first: the manager's dim comes from the newest
+        # persisted space, so after an embedding-width change across
+        # runs the obsolete OLD-dim steps are what gets dropped —
+        # oldest-first resolution would pin the stale dim and delete
+        # the newest, correct-space data as a "mismatch".
+        for step, d in sorted(steps, reverse=True):
+            step_path = os.path.join(root, d)
+            try:
+                gens = sorted((g for g in os.listdir(step_path)
+                               if g.startswith("g-")),
+                              key=lambda g: os.path.getmtime(
+                                  os.path.join(step_path, g)),
+                              reverse=True)  # newest first
+            except OSError:
+                continue
+            adopted = False
+            for g in gens:
+                gen_path = os.path.join(step_path, g)
+                verdict, dim = _gen_dim(gen_path)
+                if verdict == "unreadable":
+                    # Skip, never delete: not adoptable today, but a
+                    # single bad meta.json must not destroy the
+                    # generation's healthy segments.
+                    logger.warning("retrieval: unreadable segment "
+                                   "metadata under %s — left on disk, "
+                                   "not adopted", gen_path)
+                    continue
+                if not adopted and verdict == "ok" \
+                        and self.dim in (None, dim):
+                    self.dim = dim
+                    idx = VectorIndex(dim, step=step, root=gen_path,
+                                      metrics=self.metrics,
+                                      **self.index_kw)
+                    if idx.rows:
+                        adoptions.append((step, idx))
+                        adopted = True
+                        for ids_arr, _ in idx.store.blocks():
+                            if len(ids_arr):
+                                max_id = max(max_id,
+                                             int(np.max(ids_arr)))
+                        logger.info("retrieval: reopened step-%d "
+                                    "index (%d rows) from %s", step,
+                                    idx.rows, gen_path)
+                        continue
+                # Superseded generation, per-run empty debris, or an
+                # obsolete-dim space (dim resolved newest-first, so
+                # this can never be the newest data): delete, or every
+                # restart leaks it.
+                shutil.rmtree(gen_path, ignore_errors=True)
+        # Register ASCENDING: the OrderedDict's insertion order is what
+        # retention evicts from (oldest first) — newest-first
+        # registration would make retention destroy the newest version.
+        for step, idx in sorted(adoptions, key=lambda si: si[0]):
+            self._versions[step] = idx
+        self._next_id = max_id + 1
+
+    # -- version plumbing --------------------------------------------------
+    def _index_root(self, step: int):
+        """A FRESH directory per index instance (``step-N/g-<nonce>``):
+        a rebuild of step N must never reopen the old instance's sealed
+        segments — those hold the stale-space vectors the rebuild
+        exists to replace, and two instances sharing one directory
+        would collide on segment names. The docstore is the rebuild
+        source of truth; orphaned instance dirs are deleted by
+        ``_drop_index`` once no version points at them."""
+        if self.root is None:
+            return None
+        import os
+        import uuid
+
+        return os.path.join(str(self.root), f"step-{int(step)}",
+                            f"g-{uuid.uuid4().hex[:8]}")
+
+    def _new_index(self, step: int) -> VectorIndex:
+        assert self.dim is not None
+        return VectorIndex(self.dim, step=step,
+                           root=self._index_root(step),
+                           metrics=self.metrics, **self.index_kw)
+
+    @staticmethod
+    def _drop_index(idx: VectorIndex | None) -> None:
+        """Delete a replaced/retired instance's segment directory.
+        In-flight searches on the old instance keep answering — their
+        np.memmaps hold the inodes (POSIX unlink semantics). The
+        retire-then-barrier handshake closes the seal race: without
+        it, a maintenance pass mid-seal on the old instance would
+        mkdir+rename the deleted directory BACK into existence, and a
+        restart's ``_reopen`` would adopt that resurrected stale-space
+        segment as the step's newest generation."""
+        if idx is None:
+            return
+        idx.retired = True
+        if idx.store.root is None:
+            return
+        import shutil
+
+        with idx._maint_lock:
+            # Barrier: any in-flight maintain() finishes its writes;
+            # retired blocks all future ones.
+            pass
+        shutil.rmtree(idx.store.root, ignore_errors=True)
+
+    def _ensure_locked(self, step: int) -> VectorIndex | None:
+        """Register (and, once ``dim`` is known, materialize) the
+        version for ``step``; None while the dim is still unknown.
+        Retention-dropped instances land in ``_orphans`` — the caller
+        deletes their directories OUTSIDE the lock."""
+        idx = self._versions.get(step)
+        if idx is None:
+            if self.dim is not None:
+                idx = self._new_index(step)
+            self._versions[step] = idx
+            self._versions.move_to_end(step)
+            while len(self._versions) > self.keep_versions + 1:
+                old_step, old = self._versions.popitem(last=False)
+                self._orphans.append(old)
+                logger.info("retrieval: dropped index version for "
+                            "step %d (retention)", old_step)
+        return idx
+
+    def _drain_orphans(self) -> None:
+        """Delete retired instances' segment dirs (never under the
+        lock — an rmtree must not stall version resolution)."""
+        while self._orphans:
+            self._drop_index(self._orphans.pop())
+
+    @property
+    def active_step(self) -> int | None:
+        return self._active_step
+
+    @property
+    def stale(self) -> bool:
+        return self._stale_reason is not None
+
+    def version(self, step: int) -> VectorIndex | None:
+        with self._lock:
+            return self._versions.get(step)
+
+    def active(self) -> VectorIndex | None:
+        with self._lock:
+            if self._active_step is None:
+                return None
+            return self._versions.get(self._active_step)
+
+    # -- rollout hooks (the router's WorkerPool decisions) -----------------
+    def activate(self, step: int) -> None:
+        """First trusted adoption: version ``step`` becomes active."""
+        step = int(step)
+        with self._lock:
+            if self._active_step == step:
+                return
+            self._ensure_locked(step)
+            self._prior_step = self._active_step
+            self._active_step = step
+        self._drain_orphans()
+        _events.emit("index", action="activate", step=step)
+        self.publish()
+
+    def promote(self, step: int) -> None:
+        """Canary promote: cut searches to ``step``'s version (created
+        empty if absent) and kick a background rebuild from the
+        docstore. The prior version is RETAINED for rollback."""
+        step = int(step)
+        with self._lock:
+            prior = self._active_step
+            self._ensure_locked(step)
+            self._prior_step = prior
+            self._active_step = step
+            self._stale_reason = None
+        self._drain_orphans()
+        self.metrics.op("promote")
+        _events.emit("index", action="promote", step=step,
+                     prior_step=prior)
+        logger.info("retrieval: index cut over to step %d (prior %s "
+                    "retained for rollback)", step, prior)
+        self.publish()
+        self.rebuild_async(reason="promote")
+
+    def rollback_to(self, step: int) -> bool:
+        """The fleet reverted: restore ``step``'s retained version
+        atomically. Returns False when that version was not retained
+        (a fresh empty one is activated instead — still the correct
+        space, just cold)."""
+        step = int(step)
+        with self._lock:
+            had = self._versions.get(step) is not None
+            self._ensure_locked(step)
+            self._prior_step = self._active_step
+            self._active_step = step
+            self._stale_reason = None
+        self._drain_orphans()
+        self.metrics.op("rollback")
+        _events.emit("index", action="rollback", step=step,
+                     retained=had)
+        logger.warning("retrieval: index rolled back to step %d "
+                       "(%s)", step,
+                       "retained version restored" if had
+                       else "version not retained — rebuilt cold")
+        self.publish()
+        if not had:
+            self.rebuild_async(reason="rollback_cold")
+        return had
+
+    def on_canary_rollback(self, bad_step: int, reason: str) -> None:
+        """A canary breached before promotion: its candidate version
+        (if one was warmed) is dropped; a DRIFT-reason breach is direct
+        evidence the embedding spaces moved, so the live index is
+        marked stale and a rebuild is forced."""
+        with self._lock:
+            dropped = self._versions.pop(int(bad_step), None) \
+                if int(bad_step) != self._active_step else None
+        if dropped is not None:
+            self._drop_index(dropped)
+            _events.emit("index", action="drop", step=int(bad_step),
+                         reason=reason)
+        if reason == "shadow_drift":
+            self.mark_stale(f"canary drift breach (step {bad_step})")
+
+    def mark_stale(self, reason: str) -> None:
+        """Flag the active index stale and force a rebuild."""
+        with self._lock:
+            if self._active_step is None:
+                return
+            self._stale_reason = reason
+        self.metrics.op("stale")
+        _events.emit("index", action="stale",
+                     step=self._active_step, reason=reason)
+        logger.warning("retrieval: active index (step %s) marked "
+                       "STALE: %s — forcing rebuild",
+                       self._active_step, reason)
+        self.publish()
+        self.rebuild_async(reason="stale")
+
+    # -- data path ---------------------------------------------------------
+    def insert(self, inputs, vectors,
+               step: int | None = None) -> list[int]:
+        """Store input rows + their embeddings under the active
+        version; returns assigned ids. ``step`` is the checkpoint step
+        that PRODUCED the vectors — a mismatch with the active version
+        rejects the insert (empty list): wrong-space vectors must
+        never enter the index."""
+        x = np.asarray(inputs, np.float32)
+        if x.ndim == 1:
+            x = x[None]
+        vecs = np.asarray(vectors, np.float32)
+        if vecs.ndim == 1:
+            vecs = vecs[None]
+        with self._lock:
+            if self.dim is None:
+                self.dim = int(vecs.shape[1])
+            elif int(vecs.shape[1]) != self.dim:
+                # Wrong-width vectors (a worker serving a changed
+                # --proj-dim, a foreign payload): rejected BEFORE any
+                # state mutates — same graceful empty answer as a
+                # wrong-step insert, never a ValueError escaping into
+                # the router's handler thread.
+                logger.warning("retrieval: insert rejected — dim %d "
+                               "!= index dim %d", vecs.shape[1],
+                               self.dim)
+                return []
+            if self._active_step is None and step is not None:
+                # Inserts arriving before any rollout decision adopt
+                # the producing step — an index must exist to be
+                # versioned.
+                self._ensure_locked(int(step))
+                self._active_step = int(step)
+            if self._active_step is None:
+                if step is None:
+                    # No versioning signal anywhere (stepless smoke
+                    # fleets): a single unversioned index under step -1.
+                    self._ensure_locked(-1)
+                    self._active_step = -1
+            elif step is not None and int(step) != self._active_step:
+                return []
+            idx = self._versions.get(self._active_step)
+            if idx is None:
+                # The version was registered before the dim was known
+                # (activate/promote ahead of the first insert) —
+                # materialize it now.
+                idx = self._versions[self._active_step] = \
+                    self._new_index(self._active_step)
+            ids = list(range(self._next_id,
+                             self._next_id + x.shape[0]))
+            self._next_id += x.shape[0]
+            for i, row in zip(ids, x):
+                self._docstore[i] = np.array(row, np.float32)
+            evicted = 0
+            while len(self._docstore) > self.docstore_rows:
+                self._docstore.popitem(last=False)
+                evicted += 1
+            # Under the lock: a rebuild's version swap racing this
+            # insert would otherwise receive the rows into the
+            # about-to-be-orphaned instance — 200 with ids that never
+            # answer a search. The hold is the append cost (ms), and
+            # searches only touch this lock for version resolution,
+            # never for the scan.
+            idx.insert(np.asarray(ids, np.int64), vecs)
+        self._drain_orphans()
+        if evicted:
+            self.metrics.docstore_evictions.inc(evicted)
+        self.publish()
+        return ids
+
+    def search(self, queries, k: int = 10,
+               prefer_step: int | None = None) -> dict:
+        """Search the version matching ``prefer_step`` (the step that
+        embedded the queries) when retained, else the active version —
+        query and index must share an embedding space, and during a
+        rollout window a laggard worker's embeddings legitimately
+        belong to the PRIOR version. Returns ``{ids, scores, step,
+        stale, rows}``; ids/scores are lists (JSON-ready)."""
+        with self._lock:
+            step = self._active_step
+            if prefer_step is not None \
+                    and self._versions.get(int(prefer_step)) is not None:
+                step = int(prefer_step)
+            idx = self._versions.get(step) if step is not None else None
+            stale = self._stale_reason is not None \
+                and step == self._active_step
+        if idx is None:
+            return {"ids": [], "scores": [], "step": None,
+                    "stale": False, "rows": 0}
+        ids, scores = idx.search(queries, k)
+        return {"ids": ids.tolist(),
+                "scores": [[float(s) if np.isfinite(s) else None
+                            for s in row] for row in scores],
+                "step": step, "stale": stale, "rows": idx.rows}
+
+    def docstore_inputs(self) -> tuple[list[int], np.ndarray | None]:
+        """(ids, stacked input rows) currently retained for rebuild."""
+        with self._lock:
+            if not self._docstore:
+                return [], None
+            ids = list(self._docstore.keys())
+            rows = np.stack([self._docstore[i] for i in ids])
+        return ids, rows
+
+    # -- rebuild -----------------------------------------------------------
+    def rebuild_async(self, reason: str) -> bool:
+        """Re-embed the docstore through ``reembed`` into a FRESH index
+        for the active step on a background thread, then swap it in
+        atomically. One rebuild at a time; returns False when skipped
+        (no reembed fn, nothing stored, or one already running)."""
+        if self.reembed is None:
+            return False
+        with self._lock:
+            if not self._docstore or self._active_step is None:
+                return False
+            if self._rebuild_thread is not None \
+                    and self._rebuild_thread.is_alive():
+                return False
+            self._rebuild_thread = threading.Thread(
+                target=self._rebuild, args=(reason,), daemon=True,
+                name="retrieval-rebuild")
+            self._rebuild_thread.start()
+        return True
+
+    def _rebuild(self, reason: str) -> None:
+        """One rebuild incarnation. Runs in passes: rows inserted
+        while a pass was re-embedding land in the THEN-active instance
+        (which the swap replaces) — but they are in the docstore, so
+        the next pass replays them; the loop converges the moment a
+        pass completes with no concurrent inserts (``_next_id``
+        unmoved). Bounded: a pathological sustained-insert storm gets
+        a loud warning instead of an unbounded loop."""
+        t0 = time.monotonic()
+        total_rows = 0
+        for attempt in range(4):
+            target_step = self._active_step
+            with self._lock:
+                next_id0 = self._next_id
+            ids, rows = self.docstore_inputs()
+            if rows is None or target_step is None:
+                return
+            vecs = None
+            try:
+                vecs = self.reembed(rows)
+            except Exception:  # noqa: BLE001 — a rebuild failure
+                # leaves the old (possibly stale) index serving; it
+                # must never take down the router thread pool.
+                logger.exception("retrieval: rebuild re-embedding "
+                                 "failed")
+            if vecs is None:
+                logger.warning("retrieval: rebuild(%s) aborted — "
+                               "re-embed returned nothing (old index "
+                               "keeps serving)", reason)
+                return
+            vecs = np.asarray(vecs, np.float32)
+            if vecs.ndim != 2 or int(vecs.shape[1]) != self.dim:
+                # A changed embedding width mid-rebuild must abort
+                # loudly, not kill the rebuild thread with a
+                # ValueError out of fresh.insert.
+                logger.warning("retrieval: rebuild(%s) aborted — "
+                               "re-embedded width %s != index dim %d",
+                               reason, getattr(vecs, "shape", "?"),
+                               self.dim)
+                return
+            fresh = self._new_index(int(target_step))
+            fresh.insert(np.asarray(ids, np.int64),
+                         np.asarray(vecs, np.float32),
+                         count_metrics=False)
+            fresh.maintain()
+            with self._lock:
+                if self._active_step != target_step:
+                    # A promote/rollback raced the rebuild: this
+                    # result is for a version nobody serves — drop it.
+                    logger.warning("retrieval: rebuild(%s) for step "
+                                   "%d discarded (active moved to %s)",
+                                   reason, target_step,
+                                   self._active_step)
+                    replaced, settled = fresh, True
+                else:
+                    replaced = self._versions.get(target_step)
+                    self._versions[target_step] = fresh
+                    self._stale_reason = None
+                    total_rows = len(ids)
+                    # Converged only if nothing was inserted while
+                    # this pass re-embedded (those rows went to the
+                    # instance just replaced).
+                    settled = self._next_id == next_id0
+            self._drop_index(replaced)
+            if replaced is fresh:
+                return
+            if settled:
+                break
+        else:
+            logger.warning("retrieval: rebuild(%s) still catching up "
+                           "after %d passes (sustained inserts) — "
+                           "rows inserted in the last pass arrive on "
+                           "the next rebuild", reason, attempt + 1)
+        self.metrics.op("rebuild")
+        self.metrics.rebuilt_rows.inc(total_rows)
+        _events.emit("index", action="rebuild",
+                     step=int(self._active_step
+                              if self._active_step is not None else -1),
+                     rows=total_rows, reason=reason,
+                     duration_ms=round((time.monotonic() - t0) * 1e3, 3))
+        logger.info("retrieval: rebuilt step index from %d stored "
+                    "row(s) (%s)", total_rows, reason)
+        self.publish()
+
+    def wait_rebuild(self, timeout_s: float = 30.0) -> bool:
+        """Block until any in-flight rebuild finishes (tests/smokes)."""
+        t = self._rebuild_thread
+        if t is None:
+            return True
+        t.join(timeout_s)
+        return not t.is_alive()
+
+    # -- maintenance / publishing -----------------------------------------
+    def maintain(self) -> bool:
+        idx = self.active()
+        did = idx.maintain() if idx is not None else False
+        if idx is not None and idx.trained:
+            # The probe materializes every stored vector for its
+            # brute-force ground truth — neither an idle index nor a
+            # steady insert stream may pay that per tick. Probe on the
+            # first trained pass, then only when rows moved >= 10 %
+            # (or shrank — a rebuild swapped the instance).
+            rows = idx.rows
+            last = self._last_probe_rows
+            if last < 0 or rows < last \
+                    or rows - last >= max(1, last // 10):
+                idx.recall_probe()
+                self._last_probe_rows = rows
+        self.publish()
+        return did
+
+    def publish(self) -> None:
+        """Refresh the active-version gauges."""
+        with self._lock:
+            step = self._active_step
+            idx = self._versions.get(step) if step is not None else None
+            n_versions = len(self._versions)
+            stale = self._stale_reason is not None
+            doc = len(self._docstore)
+        m = self.metrics
+        m.version.set(step if step is not None else -1)
+        m.stale.set(1 if stale else 0)
+        m.versions.set(n_versions)
+        m.docstore_rows.set(doc)
+        if idx is not None:
+            m.rows.set(idx.rows)
+            m.segments.set(idx.store.segment_count)
+
+    def _maint_loop(self) -> None:
+        while not self._stop.wait(self.maintain_interval_s):
+            try:
+                self.maintain()
+            except Exception:  # noqa: BLE001 — background upkeep must
+                # survive any single bad pass.
+                logger.exception("retrieval: maintenance pass failed")
+
+    def start(self) -> "IndexManager":
+        if self._maint_thread is not None:
+            raise RuntimeError("index manager already started")
+        self._stop.clear()
+        self._maint_thread = threading.Thread(
+            target=self._maint_loop, daemon=True,
+            name="retrieval-maintain")
+        self._maint_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._maint_thread is not None:
+            self._maint_thread.join(self.maintain_interval_s * 4 + 5.0)
+            self._maint_thread = None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            versions = {
+                str(step): ({"rows": idx.rows, "trained": idx.trained,
+                             "segments": idx.store.segment_count}
+                            if idx is not None
+                            else {"rows": 0, "trained": False,
+                                  "segments": 0})
+                for step, idx in self._versions.items()
+            }
+            return {"active_step": self._active_step,
+                    "prior_step": self._prior_step,
+                    "stale": self._stale_reason,
+                    "docstore_rows": len(self._docstore),
+                    "next_id": self._next_id,
+                    "versions": versions}

@@ -50,6 +50,20 @@ forwards it, so one id threads cache -> route -> worker queue ->
 device chunk in the exported trace; a cache hit emits a ``fleet.cache``
 slice under the same id — a cached answer explains itself instead of
 looking like a mysteriously fast worker.
+
+Retrieval surface (ISSUE 15, ``attach_index``): ``POST /search``
+embeds the query rows through the fleet and answers top-k ids+scores
+from the checkpoint-step-versioned ANN index (``ntxent_tpu/retrieval``)
+— the version MATCHING the step that embedded the query, so a rollout
+window's laggard-served queries search the space they were embedded
+in. ``POST /embed?store=true`` and ``POST /index/insert`` feed the
+index, trust-gated exactly like cache inserts (a canary model's
+vectors must not survive its own rollback). The rollout state machine
+drives index versions: promote cuts searches to the new step's index
+and rebuilds it by background re-embedding, a fleet-wide rollback
+(every ready worker reverting below the trusted step) demotes the
+trusted step AND restores the prior index version, and a drift-reason
+canary breach marks the live index stale, forcing a rebuild.
 """
 
 from __future__ import annotations
@@ -61,7 +75,7 @@ import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
@@ -164,6 +178,15 @@ class WorkerPool:
         # decide, so this is the router's only signal to flush
         # random-init-weight embeddings out of its cache.
         self.on_trusted_adopt = None
+        # Fired (outside the lock, as (new_step, old_step)) when the
+        # trusted step DEMOTES: every ready worker reports a step older
+        # than the trusted one — the fleet was force-rolled-back
+        # beneath the router (operator /rollback broadcast, checkpoint
+        # dir rewound). Without demotion the router would gate cache/
+        # index inserts against a step nobody serves forever; with it
+        # the cache flushes and the retrieval tier restores the prior
+        # index version (ISSUE 15).
+        self.on_trusted_rollback = None
         self.bad_steps: set[int] = set()
         self._canary_step: int | None = None
         self._canary_ok = 0
@@ -201,13 +224,27 @@ class WorkerPool:
             "fleet_shadow_breaches_total",
             "canary rollbacks forced by the drift bar "
             "(error rate alone would have promoted)")
+        self._demotions = r.counter(
+            "fleet_trusted_demotions_total",
+            "trusted-step demotions (every ready worker reverted "
+            "below the trusted step — a fleet-wide rollback)")
 
     # -- membership / health (the fleet supervisor's surface) -------------
     def upsert(self, worker_id: str, url: str) -> WorkerEntry:
         with self._lock:
             entry = self._workers.get(worker_id)
             if entry is None or entry.url != url.rstrip("/"):
+                prior = entry
                 entry = WorkerEntry(worker_id, url)
+                if prior is not None:
+                    # A restarted incarnation (new port) inherits the
+                    # dead one's last-reported step until its first
+                    # probe overwrites it: the entry keeps pinning the
+                    # trusted step through the restart window, so a
+                    # lone crash can never read as a fleet-wide
+                    # rollback (_maybe_demote_locked). Routing is
+                    # unaffected — the entry starts not-ready.
+                    entry.checkpoint_step = prior.checkpoint_step
                 self._workers[worker_id] = entry
             self._update_gauges()
             return entry
@@ -243,7 +280,18 @@ class WorkerPool:
                 # there is nothing to canary against before it.
                 self.trusted_step = adopted = entry.checkpoint_step
                 self._trusted_gauge.set(self.trusted_step)
+            demoted = self._maybe_demote_locked()
             self._update_gauges()
+        if demoted is not None and self.on_trusted_rollback is not None:
+            # Outside the lock for the same reason as the adopt hook:
+            # the router flushes its cache and rolls the retrieval
+            # index back to the restored step's version.
+            new_step, old_step = demoted
+            try:
+                self.on_trusted_rollback(new_step, old_step)
+            except Exception:  # noqa: BLE001 — a hook failure must not
+                # poison health reporting.
+                logger.exception("on_trusted_rollback hook failed")
         if adopted is not None and self.on_trusted_adopt is not None:
             # Outside the lock: the hook flushes the router's cache
             # (which takes its own lock) — any embeddings cached while
@@ -254,6 +302,47 @@ class WorkerPool:
             except Exception:  # noqa: BLE001 — a hook failure must not
                 # poison health reporting.
                 logger.exception("on_trusted_adopt hook failed")
+
+    def _maybe_demote_locked(self) -> tuple[int, int] | None:
+        """Detect a fleet-wide rollback (lock held): every KNOWN
+        worker step is strictly older than the trusted one (with at
+        least one worker ready), and no canary verdict is pending (an
+        armed canary IS a worker at a newer step, so the two states
+        cannot overlap). Demotes trusted to the newest step actually
+        served and returns ``(new_step, old_step)``; None when nothing
+        changed.
+
+        Judging every entry's LAST-REPORTED step — not just live
+        workers' — is what makes both failure windows safe: a
+        warming/draining trusted-step worker still reports its step
+        and pins trusted (the stagger window), and so does the ENTRY
+        of a crashed trusted-step worker mid-restart (its step
+        survives the death; a lone crash during a rollout must not
+        read as an operator rollback). A genuine fleet-wide rollback
+        updates every entry's reported step as the reverted workers
+        answer /readyz. The cost is the conservative direction: a
+        trusted-step worker that dies FOREVER (restart budget
+        exhausted) pins trusted until its entry is removed — searches
+        still answer (version-matched) and inserts stay gated, which
+        beats spuriously flushing the cache and rolling the index
+        back on a crash."""
+        if self.trusted_step is None or self._canary_step is not None:
+            return None
+        known_steps = [w.checkpoint_step for w in self._workers.values()
+                       if w.checkpoint_step is not None]
+        ready_steps = [w.checkpoint_step for w in self._workers.values()
+                       if w.ready and w.checkpoint_step is not None]
+        if not ready_steps or not known_steps \
+                or any(s >= self.trusted_step for s in known_steps):
+            return None
+        old = self.trusted_step
+        self.trusted_step = max(ready_steps)
+        self._trusted_gauge.set(self.trusted_step)
+        self._demotions.inc()
+        logger.warning("fleet rolled back beneath the router: trusted "
+                       "step %d -> %d (every live worker reverted)",
+                       old, self.trusted_step)
+        return (self.trusted_step, old)
 
     def report_failure(self, worker_id: str, error: str = "",
                        kind: str = "forward") -> int:
@@ -568,13 +657,13 @@ class FleetRouter:
                  warm_rows: int = 32):
         self.pool = pool
         self.cache = cache
-        if cache is not None:
-            # First-checkpoint adoption (None -> step) is a model change
-            # with no canary verdict to hang the flush on: embeddings
-            # from pre-checkpoint (random-init) weights must not
-            # survive it.
-            pool.on_trusted_adopt = \
-                lambda step: cache.clear(reason="adopt")
+        # First-checkpoint adoption (None -> step) is a model change
+        # with no canary verdict to hang the flush on: embeddings from
+        # pre-checkpoint (random-init) weights must not survive it.
+        # Demotion (a fleet-wide forced rollback) is equally a model
+        # change — and additionally restores the prior index version.
+        pool.on_trusted_adopt = self._on_trusted_adopt
+        pool.on_trusted_rollback = self._on_trusted_rollback
         self.example_shape = (tuple(int(d) for d in example_shape)
                               if example_shape is not None else None)
         self.host, self.port = host, int(port)
@@ -616,6 +705,7 @@ class FleetRouter:
         # Fleet observability plane (ISSUE 10): all optional — a bare
         # router (tests, bench) behaves exactly as before.
         self.run_id: str | None = None
+        self.index = None           # retrieval.IndexManager (attach_index)
         self.shadow = None          # ShadowMirror (attach_shadow)
         self.aggregator = None      # obs.FleetAggregator -> /metrics/fleet
         self.alerts = AlertStore(registry=self.registry)  # -> /alerts
@@ -636,6 +726,73 @@ class FleetRouter:
             "serving_run_info",
             "router process identity (join key for cross-process "
             "correlation)", labels={"run_id": self.run_id}).set(1)
+
+    def attach_index(self, manager) -> None:
+        """Wire a ``retrieval.IndexManager`` (ISSUE 15): ``POST
+        /search`` / ``/index/insert`` / ``/embed?store=true`` go live,
+        rollout decisions drive index versions, and the manager's
+        background rebuilds re-embed through this router's forward
+        path."""
+        self.index = manager
+        manager.reembed = self._reembed
+        if self.pool.trusted_step is not None:
+            # Attached after the fleet already adopted: the index must
+            # version against the step actually serving.
+            manager.activate(self.pool.trusted_step)
+
+    def _on_trusted_adopt(self, step: int) -> None:
+        if self.cache is not None:
+            self.cache.clear(reason="adopt")
+        if self.index is not None:
+            self.index.activate(step)
+
+    def _on_trusted_rollback(self, new_step: int, old_step: int) -> None:
+        """The fleet reverted beneath the router (WorkerPool demotion):
+        embeddings of the demoted model must not outlive it, and the
+        retrieval tier atomically restores the prior step's retained
+        index version."""
+        if self.cache is not None:
+            self.cache.clear(reason="rollback")
+        if self.index is not None:
+            self.index.rollback_to(new_step)
+        _events.emit("rollout", action="trusted_demoted",
+                     step=new_step, from_step=old_step)
+
+    def _reembed(self, rows: np.ndarray) -> np.ndarray | None:
+        """Embed input rows through the fleet for an index rebuild
+        (runs on the manager's rebuild thread). Chunked under the body
+        cap exactly like ``_warm_cache``; returns the stacked
+        embeddings, or None when any chunk fails — a partial rebuild
+        would silently shrink the index, so all-or-nothing."""
+        x = np.asarray(rows, np.float32)
+        rid = _trace.new_request_id()
+        row_bytes = len(json.dumps(x[0].tolist())) + 2
+        per = max(1, min(x.shape[0],
+                         (self.max_body_bytes // 2) // row_bytes))
+        out: list[np.ndarray] = []
+        i = 0
+        while i < x.shape[0]:
+            chunk = x[i:i + per]
+            body = json.dumps({"inputs": chunk.tolist()}).encode()
+            code, payload, _, _served = self.forward(body, rid)
+            if code == 413 and per > 1:
+                per = max(1, per // 2)
+                continue
+            if code != 200 or not isinstance(payload, dict):
+                logger.warning("retrieval rebuild: re-embed chunk "
+                               "failed (%s)", code)
+                return None
+            try:
+                emb = np.asarray(payload["embeddings"], np.float32)
+                if emb.shape[0] != chunk.shape[0]:
+                    raise ValueError("row-count mismatch")
+            except (KeyError, TypeError, ValueError) as e:
+                logger.warning("retrieval rebuild: malformed re-embed "
+                               "response (%s)", e)
+                return None
+            out.append(emb)
+            i += chunk.shape[0]
+        return np.concatenate(out) if out else None
 
     def attach_shadow(self, mirror) -> None:
         """Wire a ShadowMirror: the router offers every successful
@@ -752,22 +909,37 @@ class FleetRouter:
                 daemon=True, name="fleet-rollback").start()
             if self.cache is not None:
                 self.cache.clear(reason="rollback")
-        elif action == "promote" and self.cache is not None:
-            # Embeddings from the previous model must not outlive it —
-            # but the hot INPUTS are model-independent: capture them
-            # before the flush and replay them through the newly
-            # trusted model so the hottest traffic never boots cold.
-            hot = (self.cache.hot_keys(self.warm_rows)
-                   if self.warm_rows > 0 else [])
-            self.cache.clear(reason="promote")
-            if hot:
-                # Off the deciding request's thread: the verdict fired
-                # inside whichever client handler tripped it, and a
-                # full re-forward of warm_rows rows must not stall that
-                # client's response.
-                threading.Thread(target=self._warm_cache, args=(hot,),
-                                 daemon=True,
-                                 name="fleet-cache-warm").start()
+            if self.index is not None:
+                # Drop any candidate version warmed for the breached
+                # step; a DRIFT-reason breach additionally marks the
+                # live index stale (the spaces demonstrably moved) and
+                # forces a rebuild (ISSUE 15).
+                self.index.on_canary_rollback(
+                    step, verdict.get("reason", "canary_breach"))
+        elif action == "promote":
+            if self.cache is not None:
+                # Embeddings from the previous model must not outlive
+                # it — but the hot INPUTS are model-independent:
+                # capture them before the flush and replay them through
+                # the newly trusted model so the hottest traffic never
+                # boots cold.
+                hot = (self.cache.hot_keys(self.warm_rows)
+                       if self.warm_rows > 0 else [])
+                self.cache.clear(reason="promote")
+                if hot:
+                    # Off the deciding request's thread: the verdict
+                    # fired inside whichever client handler tripped it,
+                    # and a full re-forward of warm_rows rows must not
+                    # stall that client's response.
+                    threading.Thread(target=self._warm_cache,
+                                     args=(hot,), daemon=True,
+                                     name="fleet-cache-warm").start()
+            if self.index is not None:
+                # Cut searches over to the new step's version (created
+                # empty, rebuilt in the background by re-embedding the
+                # retained inputs through the now-trusted fleet); the
+                # prior version stays retained for rollback.
+                self.index.promote(step)
 
     def _warm_cache(self, rows: list) -> int:
         """Replay hot input rows through the (now trusted) fleet and
@@ -998,6 +1170,8 @@ class FleetRouter:
         }
         if self.cache is not None:
             out["cache"] = self.cache.snapshot()
+        if self.index is not None:
+            out["index"] = self.index.snapshot()
         if self.shadow is not None:
             out["shadow"] = self.shadow.snapshot()
         if self.aggregator is not None:
@@ -1076,6 +1250,14 @@ def _make_router_handler(router: FleetRouter):
                 # SLO + canary-verdict breaches (obs/slo.py): active
                 # alerts and the recent history ring.
                 self._reply(200, router.alerts.snapshot())
+            elif route == "/index":
+                # Retrieval-tier state: versions, active step,
+                # staleness, docstore depth (ISSUE 15).
+                if router.index is None:
+                    self._reply(503, {"error": "no retrieval index "
+                                               "attached"})
+                else:
+                    self._reply(200, router.index.snapshot())
             else:
                 self._reply(404, {"error": f"no route {self.path!r}"})
 
@@ -1091,7 +1273,10 @@ def _make_router_handler(router: FleetRouter):
             rid = (self.headers.get("X-Request-Id")
                    or _trace.new_request_id())
             t0 = time.monotonic()
-            status = {"code": None, "rows": None}
+            url = urlparse(self.path)
+            route = url.path
+            query = parse_qs(url.query)
+            status = {"code": None, "rows": None, "k": None}
 
             def reply(code: int, payload: dict,
                       headers: dict | None = None) -> None:
@@ -1104,17 +1289,35 @@ def _make_router_handler(router: FleetRouter):
                     router._responses.inc()
 
             try:
-                self._do_post(reply, rid, status)
+                self._do_post(reply, rid, status, route, query)
             finally:
-                if self.path == "/embed" and status["code"] is not None:
+                if status["code"] is not None:
                     dur_ms = (time.monotonic() - t0) * 1e3
-                    router.latency["total"].observe(dur_ms)
-                    _trace.emit_span("fleet.request", dur_ms,
-                                     request_id=rid,
-                                     status=status["code"],
-                                     rows=status["rows"])
+                    if route == "/embed":
+                        router.latency["total"].observe(dur_ms)
+                        _trace.emit_span("fleet.request", dur_ms,
+                                         request_id=rid,
+                                         status=status["code"],
+                                         rows=status["rows"])
+                    elif route == "/search":
+                        # The search request's end-to-end span (embed
+                        # forward + index scan) under the same id the
+                        # worker chunks trace under.
+                        _trace.emit_span("fleet.search", dur_ms,
+                                         request_id=rid,
+                                         status=status["code"],
+                                         rows=status["rows"],
+                                         k=status["k"])
+                        if router.index is not None:
+                            router.index.metrics.latency[
+                                "search_request"].observe(dur_ms)
+                    elif route == "/index/insert":
+                        _trace.emit_span("fleet.insert", dur_ms,
+                                         request_id=rid,
+                                         status=status["code"],
+                                         rows=status["rows"])
 
-        def _do_post(self, reply, rid, status) -> None:
+        def _do_post(self, reply, rid, status, route, query) -> None:
             try:
                 length = int(self.headers.get("Content-Length", 0))
             except ValueError:
@@ -1127,22 +1330,153 @@ def _make_router_handler(router: FleetRouter):
                       {"Connection": "close"})
                 return
             body = self.rfile.read(length) if length > 0 else b""
-            if self.path != "/embed":
+            if route == "/search":
+                router._requests.inc()
+                self._do_search(reply, rid, body, status)
+                return
+            if route == "/index/insert":
+                router._requests.inc()
+                self._do_insert(reply, rid, body, status)
+                return
+            if route != "/embed":
                 reply(404, {"error": f"no route {self.path!r}"})
                 return
             router._requests.inc()
+            store = (query.get("store", ["0"])[0].lower()
+                     in ("1", "true", "yes"))
             parsed = self._parse_rows(body)
-            if parsed is None or router.cache is None:
-                # Unparseable here (the worker owns the 400) or no
-                # cache: pure pass-through.
+            if parsed is None or (router.cache is None and not store):
+                # Unparseable here (the worker owns the 400) or neither
+                # cache nor store needs the rows: pure pass-through.
                 code, payload, headers, _ = router.forward(body, rid)
                 if isinstance(payload, dict) and "rows" in payload:
                     status["rows"] = payload.get("rows")
+                if store and code == 200 and isinstance(payload, dict):
+                    # store=true on rows the router could not parse for
+                    # keying: the embed succeeded but nothing entered
+                    # the index — say so instead of silently dropping.
+                    payload["stored"] = 0
                 reply(code, payload, headers)
                 return
             x, timeout_ms = parsed
             status["rows"] = int(x.shape[0])
-            self._do_cached_embed(reply, rid, x, timeout_ms)
+            code, payload, headers, served_step, emb = \
+                self._embed_full(rid, x, timeout_ms)
+            if store and code == 200 and emb is not None \
+                    and isinstance(payload, dict):
+                ids = self._index_store(x, emb, served_step)
+                payload["stored"] = len(ids)
+                payload["ids"] = ids
+                if router.index is not None:
+                    payload["index_step"] = router.index.active_step
+            reply(code, payload, headers)
+
+        def _do_search(self, reply, rid, body, status) -> None:
+            """POST /search {"inputs": ..., "k": N}: embed through the
+            fleet, answer top-k from the step-matched index version."""
+            if router.index is None:
+                reply(503, {"error": "no retrieval index attached "
+                                     "(start the fleet with "
+                                     "--index-dir)"})
+                return
+            try:
+                req = json.loads(body or b"{}")
+                if not isinstance(req, dict):
+                    # A top-level array/scalar body must be a 400, not
+                    # an AttributeError that drops the connection.
+                    raise ValueError("body is not a JSON object")
+                k = int(req.get("k", 10))
+                if not 1 <= k <= 1024:
+                    raise ValueError(f"k={k} out of [1, 1024]")
+            except (TypeError, ValueError) as e:
+                reply(400, {"error": f"unparseable /search body: {e}"})
+                return
+            status["k"] = k
+            # One parse for the whole request: k above, rows here.
+            parsed = self._parse_rows_obj(req)
+            if parsed is None:
+                reply(400, {"error": "inputs not parseable as rows of "
+                                     "the fleet's example shape"})
+                return
+            x, timeout_ms = parsed
+            status["rows"] = int(x.shape[0])
+            code, payload, headers, served_step, emb = \
+                self._embed_full(rid, x, timeout_ms)
+            if code != 200 or emb is None:
+                reply(code, payload, headers)
+                return
+            index_dim = router.index.dim
+            if index_dim is not None and emb.shape[-1] != index_dim:
+                # Fleet/index width skew (a changed --proj-dim rolled
+                # out over a persisted index): a config conflict the
+                # client can see, never a ValueError that drops the
+                # connection.
+                reply(409, {"error": f"embedding width "
+                                     f"{emb.shape[-1]} != index dim "
+                                     f"{index_dim} (the fleet's model "
+                                     "changed width; rebuild or "
+                                     "re-create the index)"})
+                return
+            # prefer_step: query vectors must search the index version
+            # of the SPACE they were embedded in — during a rollout
+            # window a laggard-served query legitimately belongs to the
+            # retained prior version.
+            res = router.index.search(emb, k=k, prefer_step=served_step)
+            reply(200, {"ids": res["ids"], "scores": res["scores"],
+                        "k": k, "rows": int(x.shape[0]),
+                        "index_step": res["step"],
+                        "index_stale": res["stale"],
+                        "index_rows": res["rows"],
+                        "served_step": served_step})
+
+        def _do_insert(self, reply, rid, body, status) -> None:
+            """POST /index/insert {"inputs": ...}: embed + store. The
+            insert is trust-gated (same rule as cache inserts); a gated
+            request still answers 200 with stored=0 — rollout windows
+            are normal operation, not client errors."""
+            if router.index is None:
+                reply(503, {"error": "no retrieval index attached "
+                                     "(start the fleet with "
+                                     "--index-dir)"})
+                return
+            parsed = self._parse_rows(body)
+            if parsed is None:
+                reply(400, {"error": "inputs not parseable as rows of "
+                                     "the fleet's example shape"})
+                return
+            x, timeout_ms = parsed
+            status["rows"] = int(x.shape[0])
+            code, payload, headers, served_step, emb = \
+                self._embed_full(rid, x, timeout_ms)
+            if code != 200 or emb is None:
+                reply(code, payload, headers)
+                return
+            ids = self._index_store(x, emb, served_step)
+            out = {"stored": len(ids), "ids": ids,
+                   "rows": int(x.shape[0]),
+                   "index_step": router.index.active_step,
+                   "served_step": served_step}
+            if not ids:
+                out["reason"] = "not_trusted"
+            reply(200, out)
+
+        def _index_store(self, x, emb, served_step) -> list:
+            """Trust-gated index insert; [] when gated, unattached, or
+            rejected (wrong step/dim). Never raises — a bad payload
+            must degrade to stored:0, not drop the connection."""
+            if router.index is None:
+                return []
+            if not pool.allow_cache_insert(served_step):
+                return []
+            step = served_step if served_step is not None \
+                else pool.trusted_step
+            try:
+                return router.index.insert(x, emb, step=step)
+            except Exception:  # noqa: BLE001 — the embed already
+                # succeeded; an index-side failure must not turn a
+                # 200 into a dropped connection.
+                logger.exception("index insert failed")
+                return []
 
         def _parse_rows(self, body: bytes):
             """Best-effort parse for cache keying; None = pass through
@@ -1151,9 +1485,22 @@ def _make_router_handler(router: FleetRouter):
             example is indistinguishable from a batch of smaller rows,
             and a wrong split would poison the cache)."""
             if router.example_shape is None:
+                # Before the parse: a shape-less router passes bodies
+                # through untouched and must not pay a full json.loads
+                # per request just to discard the result.
                 return None
             try:
                 req = json.loads(body or b"{}")
+            except ValueError:
+                return None
+            return self._parse_rows_obj(req)
+
+        def _parse_rows_obj(self, req):
+            """``_parse_rows`` on an already-parsed body (callers that
+            needed other fields must not pay a second json.loads)."""
+            if router.example_shape is None or not isinstance(req, dict):
+                return None
+            try:
                 x = np.asarray(req["inputs"], dtype=np.float32)
                 if x.shape == router.example_shape:
                     x = x[None]
@@ -1164,8 +1511,33 @@ def _make_router_handler(router: FleetRouter):
             except (KeyError, TypeError, ValueError):
                 return None
 
-        def _do_cached_embed(self, reply, rid, x, timeout_ms) -> None:
+        def _embed_full(self, rid, x, timeout_ms):
+            """Embed parsed rows through cache+fleet; returns ``(code,
+            payload, headers, served_step, embeddings-or-None)`` — the
+            shared engine behind /embed (cached path), /search query
+            embedding, and the index insert surfaces. ``served_step``
+            is None when every row came from the cache (the embeddings
+            are then trusted-model by construction)."""
             cache = router.cache
+            if cache is None:
+                body = {"inputs": x.tolist()}
+                if timeout_ms is not None:
+                    body["timeout_ms"] = timeout_ms
+                code, payload, headers, served_step = router.forward(
+                    json.dumps(body).encode(), rid)
+                if code != 200 or not isinstance(payload, dict):
+                    return code, payload, headers, served_step, None
+                try:
+                    emb = np.asarray(payload["embeddings"], np.float32)
+                    if emb.shape[0] != x.shape[0]:
+                        raise ValueError(f"{emb.shape[0]} rows for "
+                                         f"{x.shape[0]} inputs")
+                except (KeyError, TypeError, ValueError) as e:
+                    router._reject("bad_worker_payload")
+                    return 502, {"error": f"malformed worker response: "
+                                          f"{e}"}, None, served_step, \
+                        None
+                return code, payload, headers, served_step, emb
             t0 = time.monotonic()
             generation = cache.generation
             hits, miss_idx = cache.lookup(x)
@@ -1179,11 +1551,11 @@ def _make_router_handler(router: FleetRouter):
                 # lands right now — no mixing possible, serve it.
                 out = np.stack([hits[i] for i in range(x.shape[0])])
                 router._cache_only.inc()
-                reply(200, {"embeddings": out.tolist(),
-                            "dim": int(out.shape[-1]),
-                            "rows": int(out.shape[0]),
-                            "cache_hits": int(out.shape[0])})
-                return
+                return 200, {"embeddings": out.tolist(),
+                             "dim": int(out.shape[-1]),
+                             "rows": int(out.shape[0]),
+                             "cache_hits": int(out.shape[0])}, \
+                    None, None, out
             sub = {"inputs": x[miss_idx].tolist()}
             if timeout_ms is not None:
                 sub["timeout_ms"] = timeout_ms
@@ -1212,8 +1584,7 @@ def _make_router_handler(router: FleetRouter):
                 code, payload, headers, served_step = router.forward(
                     json.dumps(full).encode(), rid)
             if code != 200:
-                reply(code, payload, headers)
-                return
+                return code, payload, headers, served_step, None
             try:
                 fetched = np.asarray(payload["embeddings"],
                                      dtype=np.float32)
@@ -1223,8 +1594,8 @@ def _make_router_handler(router: FleetRouter):
                                      f"{len(miss_idx)} misses")
             except (KeyError, TypeError, ValueError) as e:
                 router._reject("bad_worker_payload")
-                reply(502, {"error": f"malformed worker response: {e}"})
-                return
+                return 502, {"error": f"malformed worker response: "
+                                      f"{e}"}, None, served_step, None
             if pool.allow_cache_insert(served_step):
                 cache.insert(x[miss_idx], fetched)
             merged = np.empty((x.shape[0], fetched.shape[-1]),
@@ -1233,9 +1604,10 @@ def _make_router_handler(router: FleetRouter):
                 merged[i] = fetched[j]
             for i, vec in hits.items():
                 merged[i] = vec
-            reply(200, {"embeddings": merged.tolist(),
-                        "dim": int(merged.shape[-1]),
-                        "rows": int(merged.shape[0]),
-                        "cache_hits": len(hits)})
+            return 200, {"embeddings": merged.tolist(),
+                         "dim": int(merged.shape[-1]),
+                         "rows": int(merged.shape[0]),
+                         "cache_hits": len(hits)}, None, served_step, \
+                merged
 
     return Handler

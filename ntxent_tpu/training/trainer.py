@@ -369,6 +369,54 @@ def make_clip_train_step(use_fused: bool | None = None,
     return train_step
 
 
+# -- shared error-feedback plumbing (ISSUE 12/15) ---------------------------
+# ONE implementation of the residual split/reduce/stack rules for every
+# sharded step factory (SimCLR and CLIP): this is the subtlest wiring in
+# the trainer — a per-factory copy would silently drift.
+
+def _ef_reduce_rule(qdt: str, axis):
+    """``(reduced grads, new residual-or-None)`` under the wire policy:
+    int8 with a residual rides error feedback, any other non-f32 dtype
+    quantizes the pmean without feedback."""
+    use_ef = qdt == "int8"
+
+    def reduce_grads(grads, ef):
+        if use_ef and ef is not None:
+            return quantized_grad_reduce(grads, ef, axis)
+        if qdt != "float32":
+            with collective_precision(qdt):
+                return _pmean_acct(grads, axis), None
+        return _pmean_acct(grads, axis), None
+
+    return reduce_grads
+
+
+def _ef_split_rule(qdt: str):
+    """``(state without residual, residual-or-None, has_ef)`` — the
+    residual crosses shard_map as its own P(axis)-sharded operand; the
+    rest of the state stays replicated (P())."""
+    use_ef = qdt == "int8"
+
+    def split_ef(state):
+        ef = state.ef_residual
+        has_ef = use_ef and ef is not None \
+            and bool(jax.tree_util.tree_leaves(ef))
+        return state.replace(ef_residual=None), \
+            (ef if has_ef else None), has_ef
+
+    return split_ef
+
+
+def _ef_unstack(stacked):
+    """The per-device slice of the P(axis)-stacked residual operand."""
+    return jax.tree.map(lambda t: t[0], stacked)
+
+
+def _ef_stack(local):
+    """Re-stack a per-device residual for the P(axis) out_spec."""
+    return jax.tree.map(lambda t: t[None], local)
+
+
 def make_sharded_train_step(
     mesh: Mesh,
     temperature: float = 0.1,
@@ -423,7 +471,9 @@ def make_sharded_train_step(
     # Validates the name (and normalizes the bfloat16 alias) eagerly —
     # a typo'd dtype must fail at build, not first trace.
     qdt = collective_precision(collective_dtype).dtype
-    use_ef = qdt == "int8"
+    _reduce_grads = _ef_reduce_rule(qdt, axis)
+    _split_ef = _ef_split_rule(qdt)
+    _ef_in, _ef_out = _ef_unstack, _ef_stack
 
     def local_loss(z1, z2):
         return loss_body(z1, z2, temperature, axis, num_devices, interpret)
@@ -441,15 +491,6 @@ def make_sharded_train_step(
         with collective_precision(qdt):
             return jax.value_and_grad(loss_fn, has_aux=True)(state.params)
 
-    def _reduce_grads(grads, ef):
-        """(reduced grads, new residual-or-None) under the wire policy."""
-        if use_ef and ef is not None:
-            return quantized_grad_reduce(grads, ef, axis)
-        if qdt != "float32":
-            with collective_precision(qdt):
-                return _pmean_acct(grads, axis), None
-        return _pmean_acct(grads, axis), None
-
     def _metrics(loss, aux):
         # The aux term varies per shard (each device routes its own
         # batch); pmean the REPORTED loss so it equals the optimized
@@ -460,22 +501,6 @@ def make_sharded_train_step(
         if collect:
             metrics["moe_aux"] = _pmean_acct(aux, axis)
         return metrics
-
-    def _split_ef(state):
-        """(state without residual, residual) — the residual crosses
-        shard_map as its own P(axis)-sharded operand; the rest of the
-        state stays replicated (P())."""
-        ef = state.ef_residual
-        has_ef = use_ef and ef is not None \
-            and bool(jax.tree_util.tree_leaves(ef))
-        return state.replace(ef_residual=None), (ef if has_ef else None), \
-            has_ef
-
-    def _ef_in(stacked):
-        return jax.tree.map(lambda t: t[0], stacked)
-
-    def _ef_out(local):
-        return jax.tree.map(lambda t: t[None], local)
 
     if guard:
         def per_device_guarded(state: TrainState, v1, v2, scale, ef=None):
@@ -603,15 +628,23 @@ def make_sharded_clip_train_step(
     the dp=ep estimator over per-shard routing).
 
     ``collective_dtype``: wire precision for the modality gathers and
-    the gradient pmean, as in ``make_sharded_train_step`` (without
-    error feedback — the CLIP step carries no residual operand yet;
-    prefer ``"bf16"`` here, or accept plain int8 quantization noise).
+    the gradient pmean, as in ``make_sharded_train_step`` — int8
+    gradient reductions carry ERROR FEEDBACK exactly like the SimCLR
+    step (ISSUE 15 satellite, closing the ROADMAP item 1 follow-up):
+    the residual rides ``TrainState.ef_residual`` as its own
+    P(axis)-sharded shard_map operand (``init_error_feedback`` builds
+    it; a state without one falls back to plain int8 quantization),
+    checkpoints drop it by default and restore tolerantly to zeros.
     """
     local_loss = resolve_local_infonce(loss_impl)
     collect = moe_aux_weight > 0.0
     qdt = collective_precision(collective_dtype).dtype
+    # The SimCLR step's EF rules, shared (one implementation — see the
+    # module-level helpers).
+    _reduce_grads = _ef_reduce_rule(qdt, axis)
+    _split_ef = _ef_split_rule(qdt)
 
-    def per_device_step(state, images, tokens):
+    def per_device_step(state, images, tokens, ef=None):
         towers = _clip_towers(state, remat, collect_moe_aux=collect)
 
         def loss_fn(params):
@@ -619,16 +652,22 @@ def make_sharded_clip_train_step(
             return local_loss(zi, zt, scale, axis, interpret) \
                 + moe_aux_weight * aux, aux
 
+        # The precision context wraps the grad TRACE so the modality
+        # gathers and their AD duals build under the policy; the
+        # gradient reduction applies it (or the EF schedule) itself.
         with collective_precision(qdt):
             (loss, aux), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params)
-            grads = _pmean_acct(grads, axis)
+        grads, new_ef = _reduce_grads(grads, ef)
         # Same rationale as make_sharded_train_step: the per-shard aux
         # makes loss shard-varying; report the pmean (== the objective).
         metrics = {"loss": _pmean_acct(loss, axis) if collect else loss}
         if collect:
             metrics["moe_aux"] = _pmean_acct(aux, axis)
-        return state.apply_gradients(grads=grads), metrics
+        state = state.apply_gradients(grads=grads)
+        if new_ef is None:
+            return state, metrics
+        return state, metrics, new_ef
 
     sharded = _shard_map_compat(
         per_device_step,
@@ -637,7 +676,30 @@ def make_sharded_clip_train_step(
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+
+    def _ef_body(state, images, tokens, ef_stacked):
+        state, metrics, new_ef = per_device_step(
+            state, images, tokens, _ef_unstack(ef_stacked))
+        return state, metrics, _ef_stack(new_ef)
+
+    sharded_ef = _shard_map_compat(
+        _ef_body,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(axis)),
+        out_specs=(P(), P(), P(axis)),
+        check_vma=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, images, tokens):
+        bare, ef, has_ef = _split_ef(state)
+        if not has_ef:
+            out, metrics = sharded(bare, images, tokens)
+            return out.replace(ef_residual=state.ef_residual), metrics
+        out, metrics, new_ef = sharded_ef(bare, images, tokens, ef)
+        return out.replace(ef_residual=new_ef), metrics
+
+    return train_step
 
 
 def shard_batch(batch, mesh: Mesh, axis: str = "data"):

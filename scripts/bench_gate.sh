@@ -38,6 +38,9 @@ assert any(k.startswith("pipeline/") for k in gated), gated
 # BENCH_quant.json is enrolled (ISSUE 12): the byte-ratio claims of the
 # quantized collectives must be among the gated metrics.
 assert any(k.startswith("quant/bytes_ratio") for k in gated), gated
+# BENCH_retrieval.json is enrolled (ISSUE 15): the recall@10 claim of
+# the ANN index must be among the gated metrics.
+assert "retrieval/recall_at_10" in gated, gated
 print(f"bench gate: PASS on committed records ({len(gated)} metrics, "
       f"skipped: {list(rec['skipped']) or 'none'})")
 PY
@@ -75,6 +78,12 @@ for key in ("speedup_prefetch_vs_baseline",
 with open(f"{out}/BENCH_pipeline.json", "w") as f:
     json.dump(rec, f, indent=2, sort_keys=True)
 shutil.copy("BENCH_serving.json", f"{out}/BENCH_serving.json")
+# Doctored retrieval record: an inflated recall@10 claim must read as a
+# regression against the honest measurement (ISSUE 15).
+ret = json.load(open("BENCH_retrieval.json"))
+ret["recall_at_10"] = round(min(1.25, ret["recall_at_10"] * 1.25), 4)
+with open(f"{out}/BENCH_retrieval.json", "w") as f:
+    json.dump(ret, f, indent=2, sort_keys=True)
 PY
 
 rc=0
@@ -90,6 +99,7 @@ rec = json.load(open(sys.argv[1]))
 assert rec["ok"] is False, rec
 assert any(k.startswith("pipeline/") for k in rec["failures"]), \
     rec["failures"]
+assert "retrieval/recall_at_10" in rec["failures"], rec["failures"]
 print(f"bench gate: FAIL on injected 20% regression "
       f"({len(rec['failures'])} metric(s): {rec['failures'][:3]} ...)")
 PY

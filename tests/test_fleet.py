@@ -1390,6 +1390,11 @@ class TestServingFleet:
              "from ntxent_tpu import obs\n"
              "from ntxent_tpu.resilience import FaultInjector, "
              "FaultPlan\n"
+             # ISSUE 15: the retrieval tier rides the router process —
+             # the whole index surface (manager, index, segments, IVF)
+             # must stay importable without paying backend init.
+             "from ntxent_tpu.retrieval import (IndexManager, "
+             "VectorIndex, SegmentStore, IVFIndex)\n"
              "assert 'jax' not in sys.modules, 'jax leaked'\n"
              "print('\\n'.join(sorted(m for m in sys.modules\n"
              "                        if m.startswith('ntxent_tpu'))))\n"],

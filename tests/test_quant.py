@@ -348,6 +348,69 @@ class TestErrorFeedback:
                         jax.tree_util.tree_leaves(state.ef_residual)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    def test_clip_sharded_step_threads_and_updates_the_residual(self):
+        """ISSUE 15 satellite (ROADMAP item 1 follow-up): the CLIP
+        sharded step threads ``ef_residual`` under int8 exactly like
+        the SimCLR step — residual carried as its own P(axis) operand,
+        updated by the step, dropped from default checkpoints, and a
+        residual-less state falls back to plain quantization."""
+        import optax
+
+        from ntxent_tpu.models import (
+            CLIPModel,
+            TextTransformer,
+            VisionTransformer,
+        )
+        from ntxent_tpu.training import init_error_feedback
+        from ntxent_tpu.training.checkpoint import snapshot_state
+        from ntxent_tpu.training.trainer import (
+            TrainState,
+            make_sharded_clip_train_step,
+            shard_batch,
+        )
+
+        m = _mesh()
+        p = jax.device_count()
+        model = CLIPModel(
+            image_encoder=functools.partial(
+                VisionTransformer, hidden_dim=16, depth=1, num_heads=2,
+                mlp_dim=32, patch_size=8, dtype=jnp.float32),
+            text_encoder=functools.partial(
+                TextTransformer, vocab_size=32, max_len=8,
+                hidden_dim=16, depth=1, num_heads=2,
+                dtype=jnp.float32),
+            embed_dim=8,
+        )
+        images = jax.random.uniform(jax.random.PRNGKey(1),
+                                    (2 * p, 16, 16, 3))
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (2 * p, 8),
+                                    1, 32)
+        variables = model.init(jax.random.PRNGKey(0), images[:1],
+                               tokens[:1], train=False)
+        state = TrainState.create(apply_fn=model.apply,
+                                  params=variables["params"],
+                                  tx=optax.sgd(0.05))
+        state = init_error_feedback(pm.replicate_state(state, m), m)
+        leaves = jax.tree_util.tree_leaves(state.ef_residual)
+        assert leaves and all(leaf.shape[0] == p for leaf in leaves)
+        step = make_sharded_clip_train_step(m, collective_dtype="int8")
+        imgs_s, toks_s = shard_batch((images, tokens), m)
+        state, metrics = step(state, imgs_s, toks_s)
+        assert np.isfinite(float(metrics["loss"]))
+        # The residual actually carries (the tiny towers still hold
+        # leaves over MIN_QUANT_ELEMS — the patch embedding alone).
+        moved = max(float(jnp.max(jnp.abs(leaf))) for leaf in
+                    jax.tree_util.tree_leaves(state.ef_residual))
+        assert moved > 0.0
+        # Default checkpoints drop the residual (the slim-EF rule the
+        # SimCLR state already rides) and a residual-less state takes
+        # the plain-int8 path without a residual output.
+        assert "ef_residual" not in snapshot_state(state).state_dict
+        bare = state.replace(ef_residual=None)
+        bare, metrics2 = step(bare, imgs_s, toks_s)
+        assert bare.ef_residual is None
+        assert np.isfinite(float(metrics2["loss"]))
+
     def test_old_checkpoint_restores_to_zero_residual_with_warning(
             self, tmp_path, caplog):
         from ntxent_tpu.models import ResNet, SimCLRModel

@@ -1,0 +1,829 @@
+"""Retrieval tier (ISSUE 15): versioned ANN index + the /search surface.
+
+JAX-free by construction — nothing in this file may import jax (the
+subprocess tripwire in test_fleet pins the import surface; here the
+index math, segment durability, version lifecycle, router coupling,
+and federation pooling are exercised directly).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from ntxent_tpu.obs import events as obs_events
+from ntxent_tpu.obs.aggregate import merge_states
+from ntxent_tpu.obs.events import EVENT_TYPES, EventLog
+from ntxent_tpu.obs.registry import MetricsRegistry, quantile
+from ntxent_tpu.retrieval import (
+    IndexManager,
+    IVFIndex,
+    RetrievalMetrics,
+    SegmentStore,
+    VectorIndex,
+    brute_force_topk,
+    kmeans,
+)
+from ntxent_tpu.serving import FleetRouter, WorkerPool
+
+pytestmark = pytest.mark.retrieval
+
+
+def clustered(n, dim=16, k=8, noise=0.15, seed=0):
+    """Mixture-of-gaussians rows, L2-normalized — what embedding
+    spaces actually look like (and what IVF recall depends on)."""
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, dim).astype(np.float32)
+    x = centers[rng.randint(k, size=n)] \
+        + noise * rng.randn(n, dim).astype(np.float32)
+    return x / np.linalg.norm(x, axis=1, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# segments
+
+
+class TestSegments:
+    def test_seal_reopen_and_debris_purge(self, tmp_path):
+        store = SegmentStore(4, root=tmp_path, seal_rows=8)
+        x = np.arange(32, dtype=np.float32).reshape(8, 4)
+        store.append(np.arange(8), x)
+        assert store.should_seal()
+        seg = store.seal()
+        assert seg is not None and seg.rows == 8
+        assert store.mutable.rows == 0
+        # Sealed data comes back byte-identical through the mmap...
+        np.testing.assert_array_equal(np.asarray(seg.vectors), x)
+        # ...and a fresh open finds it (plus purges staging debris).
+        (tmp_path / ".tmp-seg-dead").mkdir()
+        reopened = SegmentStore(4, root=tmp_path)
+        assert reopened.rows == 8
+        assert not list(tmp_path.glob(".tmp-*"))
+        ids, vecs = reopened.all_rows()
+        np.testing.assert_array_equal(vecs, x)
+
+    def test_compaction_merges_and_deletes_without_losing_rows(
+            self, tmp_path):
+        store = SegmentStore(2, root=tmp_path, seal_rows=4,
+                             compact_at=2)
+        n = 0
+        for _ in range(4):
+            store.append(np.arange(n, n + 4),
+                         np.full((4, 2), float(n), np.float32))
+            n += 4
+            store.seal()
+        assert len(store.sealed) == 4 and store.should_compact()
+        before_ids, before_vecs = store.all_rows()
+        merged = store.compact()
+        assert merged is not None and len(store.sealed) == 1
+        after_ids, after_vecs = store.all_rows()
+        np.testing.assert_array_equal(np.sort(before_ids),
+                                      np.sort(after_ids))
+        np.testing.assert_array_equal(before_vecs[np.argsort(before_ids)],
+                                      after_vecs[np.argsort(after_ids)])
+        # The merged directory is the only segment left on disk.
+        assert [p.name for p in sorted(tmp_path.glob("seg-*"))] \
+            == [merged.name]
+
+    def test_memory_only_store_freezes_to_bound_the_tail(self):
+        # Without a root the store still seals — into in-memory frozen
+        # segments — so the mutable tail (and its geometric-growth
+        # copy) stays bounded by seal_rows no matter how large the
+        # index grows.
+        store = SegmentStore(2, root=None, seal_rows=4)
+        store.append(np.arange(6), np.ones((6, 2), np.float32))
+        assert store.should_seal()
+        seg = store.seal()
+        assert seg is not None and seg.rows == 6
+        assert store.mutable.rows == 0 and store.rows == 6
+        # Frozen segments compact in memory too (metadata bound).
+        store.append(np.arange(6, 10),
+                     np.full((4, 2), 2.0, np.float32))
+        store.seal()
+        merged = store.compact()
+        assert merged is not None and merged.rows == 10
+        assert len(store.sealed) == 1
+        ids, vecs = store.all_rows()
+        assert ids.tolist() == list(range(10))
+
+    def test_pending_tail_stays_visible_during_two_phase_seal(self):
+        store = SegmentStore(2, root=None, seal_rows=2)
+        store.append(np.arange(4), np.ones((4, 2), np.float32))
+        taken = store.take_mutable()
+        # Mid-freeze: the taken rows must still be in every read view.
+        assert store.rows == 4 and store.segment_count == 1
+        ids, _ = store.all_rows()
+        assert ids.tolist() == [0, 1, 2, 3]
+        store.publish(store.freeze(taken))
+        assert store.pending is None and store.rows == 4
+
+
+# ---------------------------------------------------------------------------
+# ivf
+
+
+class TestIVF:
+    def test_brute_force_matches_argsort_and_pads_short_sets(self):
+        rng = np.random.RandomState(3)
+        vecs = rng.randn(50, 8).astype(np.float32)
+        ids = np.arange(100, 150, dtype=np.int64)
+        q = rng.randn(4, 8).astype(np.float32)
+        got_ids, got_scores = brute_force_topk(q, ids, vecs, k=5)
+        want = np.argsort(q @ vecs.T, axis=1)[:, ::-1][:, :5]
+        np.testing.assert_array_equal(got_ids, ids[want])
+        assert np.all(np.diff(got_scores, axis=1) <= 1e-6)
+        # Fewer rows than k: padded with -1 / -inf, never an error.
+        pad_ids, pad_scores = brute_force_topk(q, ids[:2], vecs[:2], k=5)
+        assert np.all(pad_ids[:, 2:] == -1)
+        assert np.all(np.isneginf(pad_scores[:, 2:]))
+
+    def test_kmeans_deterministic_and_ivf_recall_on_clusters(self):
+        x = clustered(3000, dim=16, k=8, seed=1)
+        c1 = kmeans(x, 16, seed=7)
+        c2 = kmeans(x, 16, seed=7)
+        np.testing.assert_array_equal(c1, c2)
+        ivf = IVFIndex(c1)
+        ivf.add(np.arange(x.shape[0]), x)
+        q = x[:64]
+        ann_ids, _ = ivf.search(q, k=10, nprobe=4)
+        exact_ids, _ = brute_force_topk(q, np.arange(x.shape[0]), x, 10)
+        recall = np.mean([len(set(a) & set(e)) / 10.0
+                          for a, e in zip(ann_ids.tolist(),
+                                          exact_ids.tolist())])
+        assert recall >= 0.95, recall
+
+    def test_search_widens_when_probed_lists_run_short(self):
+        # 64 rows over 16 lists, nprobe=1: a single list cannot fill
+        # k=32, so the search must widen instead of padding with -1.
+        x = clustered(64, dim=8, k=16, seed=2)
+        ivf = IVFIndex(kmeans(x, 16, seed=0))
+        ivf.add(np.arange(64), x)
+        ids, _ = ivf.search(x[:2], k=32, nprobe=1)
+        assert np.all(ids >= 0)
+
+
+# ---------------------------------------------------------------------------
+# vector index
+
+
+class TestVectorIndex:
+    def test_brute_force_below_threshold_is_exact(self):
+        idx = VectorIndex(8, train_rows=10_000)
+        x = clustered(500, dim=8, seed=4)
+        idx.insert(np.arange(500), x)
+        assert not idx.trained
+        got = idx.search(x[:8], k=5)
+        want = idx.search_exact(x[:8], k=5)
+        np.testing.assert_array_equal(got[0], want[0])
+
+    def test_trains_at_threshold_and_keeps_recall(self):
+        reg = MetricsRegistry()
+        metrics = RetrievalMetrics(reg)
+        idx = VectorIndex(16, train_rows=512, n_centroids=16, nprobe=8,
+                          metrics=metrics)
+        x = clustered(2000, dim=16, seed=5)
+        idx.insert(np.arange(2000), x)
+        assert idx.maintain() and idx.trained
+        # Rows inserted AFTER training land in the lists incrementally.
+        extra = clustered(50, dim=16, seed=6)
+        idx.insert(np.arange(2000, 2050), extra)
+        ids, _ = idx.search(extra[:1], k=1)
+        assert ids[0][0] == 2000
+        recall = idx.recall_probe(k=10, sample=64)
+        assert recall is not None and recall >= 0.95
+        assert float(metrics.recall.value) == pytest.approx(recall)
+        assert float(metrics.inserts.value) == 2050
+        # Exactly the ONE client search above: the probe's synthetic
+        # queries stay out of the search telemetry.
+        assert float(metrics.searches.value) == 1
+        text = reg.render_prometheus()
+        assert 'retrieval_latency_ms_count{stage="search"}' in text \
+            or 'retrieval_latency_ms' in text
+
+    def test_lifecycle_counters_and_events(self, tmp_path):
+        log = EventLog()
+        prev = obs_events.install(log)
+        try:
+            reg = MetricsRegistry()
+            idx = VectorIndex(4, root=tmp_path, train_rows=16,
+                              n_centroids=4, seal_rows=8, compact_at=2,
+                              metrics=RetrievalMetrics(reg))
+            n = 0
+            for _ in range(4):
+                idx.insert(np.arange(n, n + 8),
+                           clustered(8, dim=4, seed=n))
+                n += 8
+                idx.maintain()
+            actions = [e.get("action") for e in log.tail(100)
+                       if e.get("event") == "index"]
+            assert "build" in actions and "seal" in actions
+            text = reg.render_prometheus()
+            assert 'retrieval_ops_total{kind="build"}' in text
+            assert 'retrieval_ops_total{kind="seal"}' in text
+        finally:
+            obs_events.install(prev)
+
+    def test_index_event_type_is_core_vocabulary(self):
+        assert "index" in EVENT_TYPES
+
+
+# ---------------------------------------------------------------------------
+# versioned manager
+
+
+class TestIndexManager:
+    def test_ids_monotonic_and_docstore_bound_evicts_oldest(self):
+        m = IndexManager(4, docstore_rows=8)
+        a = m.insert(clustered(6, dim=4, seed=0),
+                     clustered(6, dim=4, seed=0), step=1)
+        b = m.insert(clustered(6, dim=4, seed=1),
+                     clustered(6, dim=4, seed=1), step=1)
+        assert a == list(range(6)) and b == list(range(6, 12))
+        ids, rows = m.docstore_inputs()
+        assert len(ids) == 8 and ids == list(range(4, 12))
+        assert float(m.metrics.docstore_evictions.value) == 4
+
+    def test_manager_reopens_persisted_segments_and_resumes_ids(
+            self, tmp_path):
+        # Regression: --index-dir was write-only — a restarted manager
+        # never reopened prior segments (searches answered empty) and
+        # every run leaked its predecessors' g-* instance dirs.
+        m = IndexManager(4, root=tmp_path, train_rows=10_000,
+                         seal_rows=4)
+        x = clustered(10, dim=4, seed=0)
+        ids = m.insert(x, x, step=3)
+        m.maintain()  # seal to disk
+        sealed = m.active().store.rows - m.active().store.mutable.rows
+        assert sealed >= 8
+        again = IndexManager(4, root=tmp_path, train_rows=10_000,
+                             seal_rows=4)
+        again.activate(3)
+        got = again.search(x[:1], k=1)
+        assert got["step"] == 3 and got["ids"][0][0] == 0
+        assert got["rows"] == sealed  # the durable rows came back
+        # New inserts never collide with persisted ids.
+        new_ids = again.insert(clustered(2, dim=4, seed=1),
+                               clustered(2, dim=4, seed=1), step=3)
+        assert min(new_ids) > max(ids[:sealed])
+        # A third open adopts ONE generation per step and deletes the
+        # rest (the restart leak).
+        again.maintain()
+        third = IndexManager(4, root=tmp_path, train_rows=10_000,
+                             seal_rows=4)
+        del third
+        gens = [p for p in (tmp_path / "step-3").iterdir()
+                if p.name.startswith("g-")]
+        assert len(gens) == 1, gens
+
+    def test_reopen_orders_steps_numerically(self, tmp_path):
+        # Regression: lexicographic dir order ("step-10" < "step-2")
+        # registered the NEWER step first, so retention evicted it
+        # while keeping the stale one.
+        m = IndexManager(4, root=tmp_path, train_rows=10_000,
+                         seal_rows=2)
+        x = clustered(4, dim=4, seed=0)
+        m.insert(x, x, step=2)
+        m.maintain()
+        m.promote(10)
+        m.insert(x, x, step=10)
+        m.maintain()
+        again = IndexManager(4, root=tmp_path, train_rows=10_000)
+        order = [int(s) for s in again.snapshot()["versions"]]
+        assert order == sorted(order) == [2, 10]
+
+    def test_reopen_resolves_dim_from_the_newest_step(self, tmp_path):
+        # Regression: oldest-first dim resolution pinned an obsolete
+        # width and deleted the NEWEST step's correct-space segments
+        # as a "mismatch".
+        m1 = IndexManager(root=tmp_path, train_rows=10_000,
+                          seal_rows=2)
+        x4 = clustered(4, dim=4, seed=0)
+        m1.insert(x4, x4, step=1)
+        m1.maintain()
+        # A later run changed the embedding width: step 5 at dim 8.
+        v8 = VectorIndex(8, root=tmp_path / "step-5" / "g-new",
+                         seal_rows=2)
+        v8.insert(np.arange(100, 104), clustered(4, dim=8, seed=1))
+        v8.maintain()
+        again = IndexManager(root=tmp_path, train_rows=10_000)
+        assert again.dim == 8
+        assert list(again.snapshot()["versions"]) == ["5"]
+        # The obsolete dim-4 generation was dropped, not the dim-8 one.
+        assert not any((tmp_path / "step-1").glob("g-*"))
+
+    def test_reopen_never_deletes_unreadable_generations(self, tmp_path):
+        # Regression: one corrupt meta.json made the whole generation
+        # read as an orphan and rmtree'd its healthy segments.
+        m1 = IndexManager(root=tmp_path, train_rows=10_000,
+                          seal_rows=2)
+        x = clustered(4, dim=4, seed=0)
+        m1.insert(x, x, step=1)
+        m1.maintain()
+        gen = next((tmp_path / "step-1").glob("g-*"))
+        seg = next(p for p in gen.iterdir()
+                   if p.name.startswith("seg-"))
+        (seg / "meta.json").write_text("{corrupt")
+        again = IndexManager(root=tmp_path)
+        assert gen.exists()  # not adopted, but NOT destroyed either
+        assert again.snapshot()["versions"] == {}
+
+    def test_insert_rejects_wrong_dim_vectors_gracefully(self):
+        # Regression: a wrong-width vector raised ValueError out of
+        # the router handler (dropped connection) after the docstore
+        # had already been mutated.
+        m = IndexManager(4)
+        assert m.insert(clustered(2, dim=4), clustered(2, dim=4),
+                        step=1)
+        before = m.snapshot()
+        assert m.insert(clustered(2, dim=8), clustered(2, dim=8),
+                        step=1) == []
+        after = m.snapshot()
+        assert after["next_id"] == before["next_id"]
+        assert after["docstore_rows"] == before["docstore_rows"]
+
+    def test_recall_probe_does_not_count_as_search_traffic(self):
+        reg = MetricsRegistry()
+        idx = VectorIndex(8, train_rows=64, n_centroids=8,
+                          metrics=RetrievalMetrics(reg))
+        idx.insert(np.arange(200), clustered(200, dim=8, seed=3))
+        idx.maintain()
+        searches0 = float(idx.metrics.searches.value)
+        assert idx.recall_probe(k=5, sample=16) is not None
+        assert float(idx.metrics.searches.value) == searches0
+
+    def test_insert_rejects_wrong_step_vectors(self):
+        m = IndexManager(4)
+        assert m.insert(clustered(2, dim=4), clustered(2, dim=4),
+                        step=3)
+        assert m.active_step == 3
+        assert m.insert(clustered(2, dim=4), clustered(2, dim=4),
+                        step=9) == []
+        assert m.active().rows == 2
+
+    def test_promote_retains_prior_and_rollback_restores_it(self):
+        m = IndexManager(4)
+        x = clustered(10, dim=4, seed=0)
+        m.insert(x, x, step=1)
+        got = m.search(x[:1], k=1)
+        assert got["step"] == 1 and got["ids"][0][0] == 0
+        m.promote(2)
+        assert m.active_step == 2
+        # The prior version still serves prior-space queries...
+        assert m.search(x[:1], k=1, prefer_step=1)["step"] == 1
+        # ...and a rollback restores it with vectors intact.
+        assert m.rollback_to(1) is True
+        after = m.search(x[:1], k=1)
+        assert after["step"] == 1 and after["rows"] == 10 \
+            and after["ids"][0][0] == 0
+
+    def test_rebuild_reembeds_docstore_and_clears_stale(self):
+        m = IndexManager(4, train_rows=10_000)
+        x = clustered(12, dim=4, seed=0)
+        m.insert(x, x, step=1)
+
+        calls = []
+
+        def reembed(rows):
+            calls.append(rows.shape)
+            return np.asarray(rows, np.float32)  # identity "model"
+
+        m.reembed = reembed
+        m.mark_stale("test drift")
+        assert m.wait_rebuild()
+        assert not m.stale and calls == [(12, 4)]
+        assert float(m.metrics.rebuilt_rows.value) == 12
+        assert m.search(x[:1], k=1)["ids"][0][0] == 0
+
+    def test_rebuild_raced_by_promote_is_discarded(self):
+        m = IndexManager(4, train_rows=10_000)
+        x = clustered(8, dim=4, seed=0)
+        m.insert(x, x, step=1)
+        gate = threading.Event()
+
+        def reembed(rows):
+            gate.wait(5.0)
+            return np.asarray(rows, np.float32)
+
+        m.reembed = reembed
+        assert m.rebuild_async("stale")
+        m.promote(2)  # the world moves while the rebuild is in flight
+        gate.set()
+        assert m.wait_rebuild()
+        # Step-1's rebuild result must not clobber the active step-2
+        # version (promote's own rebuild may add rows later; the
+        # step-1 result lands nowhere).
+        assert m.active_step == 2
+
+    def test_disk_rooted_rebuild_never_resurrects_stale_segments(
+            self, tmp_path):
+        # Regression: the rebuilt index reused the active step's
+        # segment directory, re-reading the OLD instance's sealed
+        # segments — the stale-space vectors the rebuild exists to
+        # replace — and appending the re-embedded rows as duplicate
+        # ids on top.
+        m = IndexManager(4, root=tmp_path, train_rows=10_000,
+                         seal_rows=4)
+        x = clustered(12, dim=4, seed=0)
+        m.insert(x, x, step=1)
+        m.maintain()  # seals old-space segments to disk
+        assert any((tmp_path / "step-1").rglob("seg-*"))
+        m.reembed = lambda rows: np.asarray(rows, np.float32) * -1.0
+        m.mark_stale("drift")
+        assert m.wait_rebuild()
+        idx = m.active()
+        assert idx.rows == 12  # NOT 24: stale segments stayed dead
+        got = m.search(-x[:1], k=1)  # new space answers
+        assert got["ids"][0][0] == 0
+        # The replaced instance's directory was deleted; exactly the
+        # fresh instance's remains.
+        m.maintain()  # let the fresh instance seal
+        gens = [p for p in (tmp_path / "step-1").iterdir()
+                if p.name.startswith("g-")]
+        assert len(gens) == 1, gens
+
+    def test_insert_during_rebuild_lands_in_the_swapped_index(self):
+        # Regression: a row inserted between the rebuild's docstore
+        # snapshot and its version swap went into the about-to-be-
+        # orphaned instance — 200 with ids that never answered a
+        # search. The rebuild now loops until a pass sees no
+        # concurrent inserts.
+        m = IndexManager(4, train_rows=10_000)
+        x = clustered(8, dim=4, seed=0)
+        m.insert(x, x, step=1)
+        gate = threading.Event()
+        passes = []
+
+        def reembed(rows):
+            passes.append(rows.shape[0])
+            if len(passes) == 1:
+                gate.wait(5.0)  # hold pass 1 open while a row lands
+            return np.asarray(rows, np.float32)
+
+        m.reembed = reembed
+        assert m.rebuild_async("stale")
+        late = clustered(1, dim=4, seed=9)
+        ids = m.insert(late, late, step=1)  # mid-rebuild insert
+        gate.set()
+        assert m.wait_rebuild()
+        assert len(passes) >= 2 and passes[-1] == 9
+        got = m.search(late, k=1)
+        assert got["ids"][0][0] == ids[0] and got["rows"] == 9
+
+    def test_stale_flag_rides_search_and_gauge(self):
+        m = IndexManager(4)
+        x = clustered(4, dim=4)
+        m.insert(x, x, step=1)
+        m.mark_stale("drift")  # no reembed fn: stays stale
+        assert m.stale
+        assert m.search(x[:1], k=1)["stale"] is True
+        assert float(m.metrics.stale.value) == 1
+        # A prior-version search is NOT stale-flagged (only the ACTIVE
+        # version carries the drift evidence): make step 2 active and
+        # stale, then search the retained step-1 version.
+        m.promote(2)
+        m.insert(x, x, step=2)
+        m.mark_stale("drift2")
+        assert m.search(x[:1], k=1)["stale"] is True
+        prior = m.search(x[:1], k=1, prefer_step=1)
+        assert prior["step"] == 1 and prior["stale"] is False
+
+
+# ---------------------------------------------------------------------------
+# router surface (stub workers — the jax-free half of the fleet)
+
+
+class StubWorker:
+    """Stdlib /embed worker whose embedding space depends on its step:
+    emb = normalize(flatten(row)[:dim] + step*10)."""
+
+    def __init__(self, step=1, dim=4):
+        self.step = step
+        self.dim = dim
+        self.fail = False
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/rollback":
+                    body = json.dumps({"rolled_back": True}).encode()
+                    code = 200
+                elif stub.fail:
+                    body = json.dumps({"error": "injected"}).encode()
+                    code = 500
+                else:
+                    emb = []
+                    for r in req.get("inputs", []):
+                        v = np.asarray(r, np.float32).ravel()[:stub.dim]
+                        v = v + stub.step * 10.0
+                        emb.append((v / np.linalg.norm(v)).tolist())
+                    body = json.dumps({"embeddings": emb,
+                                       "dim": stub.dim,
+                                       "rows": len(emb)}).encode()
+                    code = 200
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("X-Checkpoint-Step", str(stub.step))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _post(router, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router.port}{path}",
+        data=json.dumps(payload).encode(), method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=15) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+@pytest.fixture()
+def rig():
+    worker = StubWorker(step=1)
+    pool = WorkerPool(canary_min_requests=4, canary_fraction=1.0)
+    pool.upsert("w0", worker.url)
+    pool.set_health("w0", alive=True, ready=True, checkpoint_step=1)
+    manager = IndexManager(train_rows=100_000)
+    router = FleetRouter(pool, cache=None, example_shape=(2, 2),
+                         port=0)
+    router.attach_index(manager)
+    router.start()
+    try:
+        yield worker, pool, manager, router
+    finally:
+        router.close()
+        worker.close()
+
+
+class TestRouterSearchSurface:
+    def test_insert_then_search_roundtrip_with_request_id(self, rig):
+        worker, pool, manager, router = rig
+        rows = np.random.RandomState(0).rand(6, 2, 2).astype(
+            np.float32).tolist()
+        code, res, hdrs = _post(router, "/index/insert",
+                                {"inputs": rows})
+        assert code == 200 and res["stored"] == 6
+        assert res["ids"] == list(range(6))
+        assert "X-Request-Id" in hdrs
+        code, res, hdrs = _post(router, "/search",
+                                {"inputs": [rows[2]], "k": 3})
+        assert code == 200 and res["ids"][0][0] == 2
+        assert res["index_step"] == 1 and res["index_stale"] is False
+        assert len(res["scores"][0]) == 3 and "X-Request-Id" in hdrs
+
+    def test_embed_store_true_stores_and_returns_ids(self, rig):
+        worker, pool, manager, router = rig
+        rows = np.random.RandomState(1).rand(3, 2, 2).astype(
+            np.float32).tolist()
+        code, res, _ = _post(router, "/embed?store=true",
+                             {"inputs": rows})
+        assert code == 200 and res["stored"] == 3
+        assert "embeddings" in res and res["ids"] == [0, 1, 2]
+        # Plain /embed unchanged: no store keys.
+        code, res, _ = _post(router, "/embed", {"inputs": rows})
+        assert code == 200 and "stored" not in res
+
+    def test_search_input_validation(self, rig):
+        worker, pool, manager, router = rig
+        code, res, _ = _post(router, "/search",
+                             {"inputs": [[[0.1, 0.2], [0.3, 0.4]]],
+                              "k": 0})
+        assert code == 400
+        code, res, _ = _post(router, "/search", {"k": 3})
+        assert code == 400
+        # A non-object JSON body must be a 400, not an AttributeError
+        # that drops the connection.
+        code, res, _ = _post(router, "/search", [[0.1, 0.2]])
+        assert code == 400 and "object" in res["error"]
+
+    def test_search_without_index_is_503(self):
+        worker = StubWorker(step=1)
+        pool = WorkerPool()
+        pool.upsert("w0", worker.url)
+        pool.set_health("w0", alive=True, ready=True,
+                        checkpoint_step=1)
+        router = FleetRouter(pool, cache=None, example_shape=(2, 2),
+                             port=0).start()
+        try:
+            code, res, _ = _post(router, "/search",
+                                 {"inputs": [[[0.1, 0.2],
+                                              [0.3, 0.4]]]})
+            assert code == 503 and "index" in res["error"]
+        finally:
+            router.close()
+            worker.close()
+
+    def test_insert_gated_while_canary_undecided(self, rig):
+        worker, pool, manager, router = rig
+        rows = np.random.RandomState(2).rand(2, 2, 2).astype(
+            np.float32).tolist()
+        _post(router, "/index/insert", {"inputs": rows})
+        # A canary arms (new step on a second worker): inserts gate.
+        w2 = StubWorker(step=2)
+        try:
+            pool.upsert("w1", w2.url)
+            pool.set_health("w1", alive=True, ready=True,
+                            checkpoint_step=2)
+            picked = pool.pick()  # arms the canary state machine
+            pool.done(picked.worker_id)
+            assert pool.canary_step() == 2
+            code, res, _ = _post(router, "/index/insert",
+                                 {"inputs": rows})
+            assert code == 200 and res["stored"] == 0 \
+                and res["reason"] == "not_trusted"
+        finally:
+            w2.close()
+
+    def test_promote_cuts_version_and_rebuilds_from_docstore(self, rig):
+        worker, pool, manager, router = rig
+        rows = np.random.RandomState(3).rand(8, 2, 2).astype(
+            np.float32).tolist()
+        _post(router, "/index/insert", {"inputs": rows})
+        worker.step = 2  # the staggered watcher swapped the worker
+        pool.set_health("w0", alive=True, ready=True,
+                        checkpoint_step=2)
+        for _ in range(6):  # canary outcomes -> promote
+            _post(router, "/embed", {"inputs": rows[:1]})
+        assert pool.trusted_step == 2 and manager.active_step == 2
+        assert manager.wait_rebuild()
+        code, res, _ = _post(router, "/search",
+                             {"inputs": [rows[0]], "k": 3})
+        # The new version answers in the NEW space with the SAME ids.
+        assert res["index_step"] == 2 and res["index_rows"] == 8
+        assert res["ids"][0][0] == 0
+
+    def test_forced_fleet_rollback_restores_prior_version(self, rig):
+        worker, pool, manager, router = rig
+        rows = np.random.RandomState(4).rand(8, 2, 2).astype(
+            np.float32).tolist()
+        _post(router, "/index/insert", {"inputs": rows})
+        worker.step = 2
+        pool.set_health("w0", alive=True, ready=True,
+                        checkpoint_step=2)
+        for _ in range(6):
+            _post(router, "/embed", {"inputs": rows[:1]})
+        assert manager.active_step == 2
+        manager.wait_rebuild()
+        # Operators force the fleet back: the worker reverts, the pool
+        # demotes, the index restores the retained step-1 version.
+        worker.step = 1
+        pool.set_health("w0", alive=True, ready=True,
+                        checkpoint_step=1)
+        assert pool.trusted_step == 1 and manager.active_step == 1
+        code, res, _ = _post(router, "/search",
+                             {"inputs": [rows[0]], "k": 3})
+        assert res["index_step"] == 1 and res["index_rows"] == 8
+        assert res["ids"][0][0] == 0
+
+    def test_drift_breach_marks_live_index_stale(self, rig):
+        worker, pool, manager, router = rig
+        rows = np.random.RandomState(5).rand(4, 2, 2).astype(
+            np.float32).tolist()
+        _post(router, "/index/insert", {"inputs": rows})
+        manager.reembed = None  # block the forced rebuild: staleness
+        # must be observable, not instantly healed
+        manager.on_canary_rollback(7, "shadow_drift")
+        assert manager.stale
+        code, res, _ = _post(router, "/search",
+                             {"inputs": [rows[0]], "k": 2})
+        assert code == 200 and res["index_stale"] is True
+
+    def test_index_snapshot_route(self, rig):
+        worker, pool, manager, router = rig
+        rows = np.random.RandomState(6).rand(2, 2, 2).astype(
+            np.float32).tolist()
+        _post(router, "/index/insert", {"inputs": rows})
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{router.port}/index",
+                timeout=10) as r:
+            snap = json.loads(r.read())
+        assert snap["active_step"] == 1
+        assert snap["versions"]["1"]["rows"] == 2
+
+
+class TestPoolDemotion:
+    def test_all_live_workers_reverting_demotes_trusted(self):
+        pool = WorkerPool()
+        fired = []
+        pool.on_trusted_rollback = lambda new, old: fired.append(
+            (new, old))
+        pool.upsert("w0", "http://127.0.0.1:1")
+        pool.upsert("w1", "http://127.0.0.1:2")
+        pool.set_health("w0", alive=True, ready=True, checkpoint_step=5)
+        pool.set_health("w1", alive=True, ready=True, checkpoint_step=5)
+        assert pool.trusted_step == 5
+        pool.set_health("w0", alive=True, ready=True, checkpoint_step=3)
+        # One sibling still at the trusted step: pinned.
+        assert pool.trusted_step == 5 and fired == []
+        pool.set_health("w1", alive=True, ready=True, checkpoint_step=3)
+        assert pool.trusted_step == 3 and fired == [(3, 5)]
+
+    def test_crash_of_only_trusted_worker_does_not_demote(self):
+        # Regression: demotion judged only ALIVE workers' steps — the
+        # lone trusted-step worker crashing (entry alive=False, or
+        # replaced on a new port with step=None) while a laggard still
+        # served read as a fleet-wide operator rollback: spurious
+        # demotion, cache flush, index rollback, and a full re-canary
+        # when the worker came back. Entries' last-reported steps pin
+        # trusted through the restart window.
+        pool = WorkerPool()
+        pool.upsert("w0", "http://127.0.0.1:1")
+        pool.upsert("w1", "http://127.0.0.1:2")
+        pool.set_health("w0", alive=True, ready=True, checkpoint_step=5)
+        pool.set_health("w1", alive=True, ready=True, checkpoint_step=3)
+        assert pool.trusted_step == 5
+        pool.set_health("w0", alive=False, ready=False)  # SIGKILL
+        assert pool.trusted_step == 5
+        # The fleet restarts it on a NEW port: the replacement entry
+        # inherits the dead incarnation's step until its first probe.
+        pool.upsert("w0", "http://127.0.0.1:9")
+        pool.set_health("w1", alive=True, ready=True, checkpoint_step=3)
+        assert pool.trusted_step == 5
+
+    def test_transiently_unready_trusted_worker_pins_trusted(self):
+        # The stagger window: the trusted-step worker is warming
+        # (alive, not ready) while a laggard serves — NOT a rollback.
+        pool = WorkerPool()
+        pool.upsert("w0", "http://127.0.0.1:1")
+        pool.upsert("w1", "http://127.0.0.1:2")
+        pool.set_health("w0", alive=True, ready=True, checkpoint_step=5)
+        pool.set_health("w1", alive=True, ready=True, checkpoint_step=3)
+        pool.set_health("w0", alive=True, ready=False)
+        assert pool.trusted_step == 5
+
+
+# ---------------------------------------------------------------------------
+# federation: pooled retrieval histograms
+
+
+class TestRetrievalFederation:
+    def test_latency_windows_pool_to_exact_quantiles(self):
+        # Two "routers" (replica deployment) each observe retrieval
+        # latencies; the federated merge must answer the quantile of
+        # the UNION, exactly — same rule every fleet histogram rides.
+        regs = {name: MetricsRegistry() for name in ("r1", "r2")}
+        samples = {"r1": [1.0, 2.0, 3.0, 10.0],
+                   "r2": [4.0, 5.0, 6.0, 50.0]}
+        for name, reg in regs.items():
+            metrics = RetrievalMetrics(reg)
+            for v in samples[name]:
+                metrics.latency["search"].observe(v)
+            metrics.inserts.inc(7)
+        merged = merge_states({n: r.dump_state()
+                               for n, r in regs.items()})
+        hist = merged.histogram("retrieval_latency_ms",
+                                labels={"stage": "search"})
+        union = sorted(samples["r1"] + samples["r2"])
+        snap = hist.snapshot_ms()
+        assert snap["count"] == len(union)
+        assert snap["p50_ms"] == pytest.approx(quantile(union, 0.5))
+        assert snap["p99_ms"] == pytest.approx(quantile(union, 0.99))
+        counter = merged.counter("retrieval_inserts_total")
+        assert float(counter.value) == 14
+
+
+# ---------------------------------------------------------------------------
+# durability: reopen across "restarts"
+
+
+class TestDurability:
+    def test_sealed_segments_survive_reopen_and_retrain(self, tmp_path):
+        x = clustered(600, dim=8, seed=9)
+        idx = VectorIndex(8, root=tmp_path, train_rows=512,
+                          n_centroids=8, seal_rows=256)
+        idx.insert(np.arange(600), x)
+        idx.maintain()
+        assert idx.trained
+        sealed_rows = sum(s.rows for s in idx.store.sealed)
+        assert sealed_rows >= 256
+        # A fresh process re-opens the durable rows and — past the
+        # train threshold — serves ANN search immediately.
+        again = VectorIndex(8, root=tmp_path, train_rows=512,
+                            n_centroids=8)
+        assert again.trained and again.rows == sealed_rows
+        ids, _ = again.search(x[:1], k=1)
+        assert ids[0][0] == 0
