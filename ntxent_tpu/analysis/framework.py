@@ -133,6 +133,11 @@ class LintConfig:
         # closed set (retrieval_latency_ms rides the existing `stage`
         # key).
         "kind",
+        # ISSUE 16: tenant_admitted/rejected_total{tenant=...} — open
+        # set at the wire (clients pick their own X-Tenant), but the
+        # router bounds cardinality itself: at most max_tenants tracked
+        # label values, everything past the cap melts into "other".
+        "tenant",
     )
 
 

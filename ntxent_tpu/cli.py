@@ -1815,8 +1815,64 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                    help="per-worker restart budget")
     f.add_argument("--chaos", default=None, metavar="PLAN",
                    help="fleet fault plan, e.g. 'killworker@10,"
-                        "slowworker@30' (ordinals are supervision "
-                        "ticks; resilience/faults.py grammar)")
+                        "slowworker@30,spike@20,drainworker@40' "
+                        "(ordinals are supervision ticks; "
+                        "resilience/faults.py grammar; spike/"
+                        "drainworker exercise the autoscaler and "
+                        "need --autoscale)")
+
+    a = p.add_argument_group("autoscaling (ISSUE 16: closed-loop pool "
+                             "sizing over the federated signals — "
+                             "serving/autoscale.py; scale-down drains "
+                             "to zero in-flight before SIGTERM)")
+    a.add_argument("--autoscale", action="store_true",
+                   help="size the pool between --min-workers and "
+                        "--max-workers from queue depth / in-flight / "
+                        "p99 / burn rate (requires federation, "
+                        "--fed-interval > 0; --workers is then the "
+                        "STARTING size)")
+    a.add_argument("--min-workers", type=int, default=None,
+                   help="pool floor (default: 1)")
+    a.add_argument("--max-workers", type=int, default=None,
+                   help="pool ceiling (default: max(--workers, 4))")
+    a.add_argument("--scale-up-queue", type=float, default=8.0,
+                   help="federated queue depth per routable worker "
+                        "that counts as scale-up pressure")
+    a.add_argument("--scale-up-inflight", type=float, default=4.0,
+                   help="router in-flight per routable worker that "
+                        "counts as scale-up pressure")
+    a.add_argument("--scale-up-p99-ms", type=float, default=None,
+                   help="fleet p99 (ms) that counts as scale-up "
+                        "pressure (default: off)")
+    a.add_argument("--scale-up-burn", type=float, default=1.0,
+                   help="availability burn rate (vs --scale-slo-target "
+                        "budget) that counts as scale-up pressure")
+    a.add_argument("--scale-slo-target", type=float, default=0.999,
+                   help="availability target whose error budget the "
+                        "scale-up burn signal is measured against")
+    a.add_argument("--scale-up-ticks", type=int, default=2,
+                   help="consecutive pressure ticks before adding a "
+                        "worker (hysteresis)")
+    a.add_argument("--scale-idle-ticks", type=int, default=6,
+                   help="consecutive idle ticks before draining one "
+                        "(hysteresis)")
+    a.add_argument("--scale-up-cooldown", type=float, default=15.0,
+                   metavar="SECONDS")
+    a.add_argument("--scale-down-cooldown", type=float, default=30.0,
+                   metavar="SECONDS")
+    a.add_argument("--drain-deadline", type=float, default=30.0,
+                   metavar="SECONDS",
+                   help="max drain wait before a still-busy victim is "
+                        "retired anyway")
+    a.add_argument("--tenant-quota", default=None,
+                   metavar="NAME=RATE[:BURST],...",
+                   help="arm per-tenant admission control (X-Tenant "
+                        "header; rows/s token buckets; 429 + "
+                        "Retry-After on exhaustion). The tenant named "
+                        "'default' sets the quota every unlisted "
+                        "tenant gets, e.g. "
+                        "'default=100,big=1000:2000'. Works with or "
+                        "without --autoscale")
 
     o = p.add_argument_group("observability (ntxent_tpu/obs/)")
     o.add_argument("--log-jsonl", default=None, metavar="PATH",
@@ -1903,12 +1959,14 @@ def fleet_main(argv=None) -> int:
                            "worker processes")
         else:
             plan = FaultPlan.parse(args.chaos, seed=args.seed)
-            if plan.killworker_ticks or plan.slowworker_ticks:
+            if (plan.killworker_ticks or plan.slowworker_ticks
+                    or plan.spike_ticks or plan.drainworker_ticks):
                 injector = FaultInjector(plan)
             else:
                 logger.warning("--chaos %r has no fleet actions "
-                               "(killworker@T/slowworker@T) — ignored "
-                               "here", args.chaos)
+                               "(killworker@T/slowworker@T/spike@T/"
+                               "drainworker@T) — ignored here",
+                               args.chaos)
 
     if attach:
         workdir = Path(args.attach_workdir)
@@ -2090,6 +2148,82 @@ def fleet_main(argv=None) -> int:
         if objectives:
             engine = obs.SLOEngine(objectives, store=router.alerts)
             aggregator.on_merge.append(engine.evaluate)
+
+    # Per-tenant admission control (ISSUE 16): independent of
+    # --autoscale — quotas make sense on a fixed fleet too.
+    if args.tenant_quota:
+        from ntxent_tpu.serving import TenantAdmission, parse_tenant_quotas
+
+        try:
+            quotas = parse_tenant_quotas(args.tenant_quota)
+        except ValueError as e:
+            raise SystemExit(f"--tenant-quota: {e}")
+        default_rate, default_burst = quotas.pop("default", (100.0, None))
+        router.admission = TenantAdmission(
+            default_rate=default_rate, default_burst=default_burst,
+            quotas=quotas, registry=registry)
+        logger.info("admission control: %d named tenant quota(s), "
+                    "default %.1f rows/s", len(quotas), default_rate)
+
+    # Closed-loop autoscaling (ISSUE 16): the controller observes the
+    # same federated registry the SLO engine does, so it MUST ride a
+    # federation tick — accepting --autoscale without --fed-interval
+    # would be a controller that never observes.
+    controller = None
+    if args.autoscale:
+        if attach:
+            raise SystemExit("--autoscale is not available in "
+                             "--attach-workdir mode: a replica router "
+                             "does not own the worker processes")
+        if args.fed_interval <= 0:
+            raise SystemExit("--autoscale requires federation "
+                             "(--fed-interval > 0): sizing decisions "
+                             "consume the federated signals")
+        from ntxent_tpu.serving import AutoscaleController, flash_crowd
+
+        min_w = args.min_workers if args.min_workers is not None else 1
+        max_w = args.max_workers if args.max_workers is not None \
+            else max(args.workers, 4)
+        if not 1 <= min_w <= max_w:
+            raise SystemExit(f"need 1 <= --min-workers <= "
+                             f"--max-workers, got {min_w}..{max_w}")
+        controller = AutoscaleController(
+            fleet, pool, registry=registry,
+            min_workers=min_w, max_workers=max_w,
+            up_queue_depth=args.scale_up_queue,
+            up_inflight=args.scale_up_inflight,
+            up_p99_ms=args.scale_up_p99_ms,
+            up_burn=args.scale_up_burn,
+            up_ticks=args.scale_up_ticks,
+            idle_ticks=args.scale_idle_ticks,
+            up_cooldown_s=args.scale_up_cooldown,
+            down_cooldown_s=args.scale_down_cooldown,
+            drain_deadline_s=args.drain_deadline,
+            slo_target=args.scale_slo_target)
+        aggregator.on_merge.append(controller.observe)
+        fleet.autoscaler = controller
+
+        def _on_spike(action: str) -> None:
+            # chaos 'spike@T': a closed-loop flash crowd against our
+            # own router, off the supervision thread. 3 rows/request
+            # keeps each forward cheap while the concurrency drives
+            # queueing — what the controller must react to.
+            import json as _json
+            s = args.image_size
+            row = [[[0.5, 0.5, 0.5]] * s] * s
+            body = _json.dumps({"inputs": [row] * 3}).encode()
+            url = f"http://{args.host}:{router.port}"
+            threading.Thread(
+                target=flash_crowd, args=(url, body),
+                kwargs={"duration_s": 3.0, "concurrency": 8,
+                        "tenant": "chaos-spike"},
+                daemon=True, name="chaos-spike").start()
+
+        fleet.on_spike = _on_spike
+        logger.info("autoscale: pool %d..%d (start %d), up after %d "
+                    "pressure tick(s), drain after %d idle tick(s)",
+                    min_w, max_w, args.workers, args.scale_up_ticks,
+                    args.scale_idle_ticks)
 
     stop = threading.Event()
 

@@ -72,10 +72,12 @@ __all__ = ["EVENT_TYPES", "EventLog", "install", "get_event_log", "emit",
 # accepts unknown types (extensibility), the LINTER is now the typo
 # guard. index: a retrieval-tier index lifecycle action (ISSUE 15,
 # ntxent_tpu/retrieval/ — build/seal/compact/activate/promote/rollback/
-# drop/stale/rebuild).
+# drop/stale/rebuild). autoscale: a fleet-sizing control action
+# (ISSUE 16, serving/autoscale.py — scale_up/drain_start/drain_done/
+# hold decisions with the signal snapshot that drove them).
 EVENT_TYPES = ("step", "retry", "divergence", "restart", "checkpoint",
                "compile", "trace", "span", "rollout", "fleet", "alert",
-               "comms_profile", "bench", "index")
+               "comms_profile", "bench", "index", "autoscale")
 
 
 class EventLog:

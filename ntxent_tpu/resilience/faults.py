@@ -57,7 +57,17 @@ schedule randomization):
                    seconds (then SIGCONT): the gray failure — a process
                    that is alive but answers nothing → exercises
                    health-probe failure counting and ejection, without
-                   the clean signal a death gives.
+                   the clean signal a death gives;
+* ``spike@t``    — fire the fleet's flash-crowd hook on the t-th fleet
+                   tick (``ntxent-fleet --autoscale`` wires it to a
+                   loadgen burst against the router's own /embed) →
+                   exercises the autoscale controller's scale-up path
+                   under a deliberately rude arrival burst (ISSUE 16);
+* ``drainworker@t`` — force an autoscaler drain-down on the t-th fleet
+                   tick, mid-load: the victim stops receiving routes,
+                   in-flight completes, SIGTERM only after → exercises
+                   the zero-5xx scale-down contract and the
+                   below-min-repair path (serving/autoscale.py).
 
 ``FaultPlan`` is the parsed, immutable spec; ``FaultInjector`` carries the
 runtime counters and the wrapping hooks call sites use. Batch-path
@@ -83,7 +93,8 @@ __all__ = ["ChaosError", "TopologyChange", "FaultPlan", "FaultInjector",
            "truncate_checkpoint_file"]
 
 _KINDS = ("nan", "sigterm", "kill", "crash", "fetch", "diskfull",
-          "shrink", "grow", "truncate", "killworker", "slowworker")
+          "shrink", "grow", "truncate", "killworker", "slowworker",
+          "spike", "drainworker")
 
 
 class ChaosError(RuntimeError):
@@ -117,6 +128,8 @@ class FaultPlan:
     truncate_attempts: tuple[int, ...] = ()
     killworker_ticks: tuple[int, ...] = ()
     slowworker_ticks: tuple[int, ...] = ()
+    spike_ticks: tuple[int, ...] = ()
+    drainworker_ticks: tuple[int, ...] = ()
     seed: int = 0
 
     @classmethod
@@ -155,6 +168,8 @@ class FaultPlan:
                    truncate_attempts=tuple(buckets["truncate"]),
                    killworker_ticks=tuple(buckets["killworker"]),
                    slowworker_ticks=tuple(buckets["slowworker"]),
+                   spike_ticks=tuple(buckets["spike"]),
+                   drainworker_ticks=tuple(buckets["drainworker"]),
                    seed=seed)
 
     def empty(self) -> bool:
@@ -163,7 +178,8 @@ class FaultPlan:
                     or self.fetch_calls or self.diskfull_writes
                     or self.shrink_batches or self.grow_batches
                     or self.truncate_attempts or self.killworker_ticks
-                    or self.slowworker_ticks)
+                    or self.slowworker_ticks or self.spike_ticks
+                    or self.drainworker_ticks)
 
 
 def _poison_leaf(x):
@@ -316,6 +332,10 @@ class FaultInjector:
             due.append(f"killworker@{t}")
         if t in self.plan.slowworker_ticks:
             due.append(f"slowworker@{t}")
+        if t in self.plan.spike_ticks:
+            due.append(f"spike@{t}")
+        if t in self.plan.drainworker_ticks:
+            due.append(f"drainworker@{t}")
         self.fired.extend(due)
         return due
 

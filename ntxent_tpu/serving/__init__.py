@@ -43,7 +43,11 @@ sits in front of N of the above:
   atomically, roll back on router command;
 * ``fleet.ServingFleet`` — spawn/supervise the worker subprocesses
   (health-checked, ejected on consecutive failures, restarted with
-  backoff; ``killworker@K``/``slowworker@K`` chaos).
+  backoff; ``killworker@K``/``slowworker@K`` chaos);
+* ``autoscale.AutoscaleController`` — closed-loop pool sizing over
+  the federated signals (ISSUE 16): hysteresis/cooldown scale-up
+  through the supervision path, zero-5xx drain-down, and
+  ``router.TenantAdmission`` per-tenant token-bucket quotas.
 
 Launch with ``ntxent-serve`` (one worker) or ``ntxent-fleet`` (router
 + N workers); load-test with ``scripts/serving_smoke.sh`` /
@@ -60,6 +64,9 @@ import importlib
 
 # name -> defining submodule; resolved on first attribute access.
 _EXPORTS = {
+    "AutoscaleController": "autoscale",
+    "flash_crowd": "autoscale",
+    "parse_tenant_quotas": "autoscale",
     "BatcherClosed": "batcher",
     "DeadlineExceededError": "batcher",
     "MicroBatcher": "batcher",
@@ -74,6 +81,8 @@ _EXPORTS = {
     "ServingMetrics": "metrics",
     "FleetRouter": "router",
     "WorkerPool": "router",
+    "TokenBucket": "router",
+    "TenantAdmission": "router",
     "ShadowMirror": "shadow",
     "cosine_drift": "shadow",
     "EmbeddingServer": "server",
@@ -96,6 +105,7 @@ def __dir__():
 
 
 __all__ = [
+    "AutoscaleController",
     "BatcherClosed",
     "CheckpointWatcher",
     "DEFAULT_BUCKETS",
@@ -110,8 +120,12 @@ __all__ = [
     "ServingMetrics",
     "ShadowMirror",
     "SizeHistogram",
+    "TenantAdmission",
+    "TokenBucket",
     "WorkerPool",
     "cosine_drift",
     "expected_padded_rows",
+    "flash_crowd",
     "optimize_ladder",
+    "parse_tenant_quotas",
 ]

@@ -161,8 +161,24 @@ class ServingFleet:
                               log_path=self.workdir / f"w{i}.log")
                 for i in range(int(n_workers))
             ]
+        # Dynamic membership (ISSUE 16): the autoscaler adds/retires
+        # workers from the aggregator thread while the monitor thread
+        # iterates — membership mutations and iteration both go
+        # through this lock (iteration via workers_snapshot()).
+        self._workers_lock = threading.Lock()
+        self._next_ordinal = int(n_workers)
+        # Wired by the CLI when --autoscale is on: the controller the
+        # drainworker@T chaos action targets, and the flash-crowd hook
+        # spike@T fires (serving/autoscale.py / scripts/loadgen.py).
+        self.autoscaler = None
+        self.on_spike = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def workers_snapshot(self) -> list[ManagedWorker]:
+        """Stable view of the (now mutable) membership list."""
+        with self._workers_lock:
+            return list(self.workers)
 
     # -- process control ---------------------------------------------------
     def _spawn(self, worker: ManagedWorker) -> None:
@@ -231,6 +247,72 @@ class ServingFleet:
                        worker.worker_id, reason, worker.restarts,
                        self.max_restarts, delay)
 
+    # -- dynamic membership (the autoscaler's surface, ISSUE 16) -----------
+    def add_worker(self) -> ManagedWorker | None:
+        """Spawn one NEW worker through the normal supervision path
+        (fresh ordinal, port file, /readyz probing, restart budget).
+        The caller gates pool-size bounds; this only creates. Returns
+        None in attach mode — a replica router must never spawn
+        processes the primary owns."""
+        if self.attach:
+            logger.warning("fleet: add_worker ignored in attach mode")
+            return None
+        with self._workers_lock:
+            worker_id = f"w{self._next_ordinal}"
+            self._next_ordinal += 1
+            worker = ManagedWorker(
+                worker_id, cmd=None,
+                port_file=self.workdir / f"{worker_id}.port",
+                log_path=self.workdir / f"{worker_id}.log")
+            self.workers.append(worker)
+        self._spawn(worker)
+        return worker
+
+    def retire_worker(self, worker_id: str,
+                      grace_s: float = 5.0) -> bool:
+        """Permanently remove one worker: membership first (so the
+        monitor never reads its death as a crash and restarts it), then
+        the pool entry (no more routes), then SIGTERM with a background
+        SIGKILL fallback after ``grace_s``. The CALLER owns the zero-
+        5xx part — this must only run once the victim is drained (no
+        in-flight requests), which is the autoscale controller's drain
+        state machine's job."""
+        with self._workers_lock:
+            worker = next((w for w in self.workers
+                           if w.worker_id == worker_id), None)
+            if worker is None:
+                return False
+            self.workers.remove(worker)
+        self.pool.remove(worker_id)
+        obs_events.emit("fleet", action="retire", worker=worker_id,
+                        pid=worker.pid)
+        logger.info("fleet: retiring %s (pid %s)", worker_id, worker.pid)
+        proc = worker.proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+
+            def _reap() -> None:
+                try:
+                    proc.wait(timeout=grace_s)
+                except subprocess.TimeoutExpired:
+                    try:
+                        proc.kill()
+                        proc.wait(timeout=5.0)
+                    except (OSError, subprocess.TimeoutExpired):
+                        pass
+
+            # The reap must not stall the calling thread (the federation
+            # tick the controller rides): TERM now, KILL later if the
+            # worker ignores it.
+            threading.Thread(target=_reap, daemon=True,
+                             name=f"ntxent-fleet-reap-{worker_id}"
+                             ).start()
+        worker.port_file.unlink(missing_ok=True)
+        return True
+
     # -- health ------------------------------------------------------------
     def _probe(self, worker: ManagedWorker) -> None:
         """One /readyz probe; updates the pool and the failure count."""
@@ -290,11 +372,39 @@ class ServingFleet:
             # serving fleet at a deterministic point, not a booting one
             # at whatever tick JAX init happened to finish on.
             if sum(1 for w in self.pool.workers()
-                   if w.ready) < len(self.workers):
+                   if w.ready) < len(self.workers_snapshot()):
                 return
             self._chaos_armed = True
         for action in self.injector.on_fleet_tick():
-            live = [w for w in self.workers if w.alive()]
+            if action.startswith("spike"):
+                # Flash crowd (ISSUE 16): no process to signal — the
+                # CLI wires on_spike to a loadgen burst against the
+                # router so the AUTOSCALER is what gets exercised.
+                hook = self.on_spike
+                if hook is None:
+                    logger.warning("fleet chaos: %s due but no spike "
+                                   "hook wired (--autoscale off?)",
+                                   action)
+                    continue
+                logger.warning("fleet chaos: firing flash-crowd hook "
+                               "(%s)", action)
+                try:
+                    hook(action)
+                except Exception:  # noqa: BLE001 — chaos must not take
+                    # down supervision.
+                    logger.exception("fleet chaos: spike hook failed")
+                continue
+            if action.startswith("drainworker"):
+                ctl = self.autoscaler
+                if ctl is None:
+                    logger.warning("fleet chaos: %s due but no "
+                                   "autoscaler attached", action)
+                    continue
+                logger.warning("fleet chaos: forcing a drain-down (%s)",
+                               action)
+                ctl.force_drain(reason="chaos")
+                continue
+            live = [w for w in self.workers_snapshot() if w.alive()]
             if not live:
                 logger.warning("fleet chaos: %s due but no live worker",
                                action)
@@ -329,12 +439,12 @@ class ServingFleet:
             # processes the primary owns — health observation is the
             # whole job. (Its own forward failures still accumulate in
             # the shared pool entry and gate ITS routing via ready.)
-            for worker in self.workers:
+            for worker in self.workers_snapshot():
                 self._probe(worker)
             return
         self._apply_chaos()
         now = time.monotonic()
-        for worker in self.workers:
+        for worker in self.workers_snapshot():
             if worker.slow_until is not None and now >= worker.slow_until:
                 try:
                     os.kill(worker.pid, signal.SIGCONT)
@@ -408,7 +518,7 @@ class ServingFleet:
     def wait_ready(self, n: int | None = None,
                    timeout_s: float = 120.0) -> bool:
         """Block until ``n`` workers (default: all) pass /readyz."""
-        want = len(self.workers) if n is None else int(n)
+        want = len(self.workers_snapshot()) if n is None else int(n)
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             if sum(1 for w in self.pool.workers() if w.ready) >= want:
@@ -421,11 +531,12 @@ class ServingFleet:
         if self._thread is not None:
             self._thread.join(self.poll_s * 4 + 5.0)
             self._thread = None
-        for worker in self.workers:
+        workers = self.workers_snapshot()
+        for worker in workers:
             if worker.proc is not None and worker.proc.poll() is None:
                 worker.proc.terminate()
         deadline = time.monotonic() + 5.0
-        for worker in self.workers:
+        for worker in workers:
             if worker.proc is None:
                 continue
             try:

@@ -64,6 +64,15 @@ and rebuilds it by background re-embedding, a fleet-wide rollback
 (every ready worker reverting below the trusted step) demotes the
 trusted step AND restores the prior index version, and a drift-reason
 canary breach marks the live index stale, forcing a rebuild.
+
+Admission control (ISSUE 16, ``TenantAdmission``): per-tenant token
+buckets keyed on the ``X-Tenant`` request header (bare requests share
+the default tenant) meter ``/embed`` AND ``/search`` by row count, so
+saturation degrades per tenant (the over-quota tenant 429s, everyone
+else keeps their rate) instead of FIFO. Exhaustion answers 429 +
+``Retry-After`` — the same shed contract the worker queue uses — and
+the ``tenant`` label is cardinality-bounded router-side: at most
+``max_tenants`` tracked values, the rest melt into ``other``.
 """
 
 from __future__ import annotations
@@ -89,7 +98,8 @@ from .limits import MAX_BODY_BYTES
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["WorkerEntry", "WorkerPool", "FleetRouter"]
+__all__ = ["WorkerEntry", "WorkerPool", "FleetRouter", "TokenBucket",
+           "TenantAdmission"]
 
 
 def _step_header(headers) -> int | None:
@@ -107,6 +117,132 @@ def _step_header(headers) -> int | None:
         return None
 
 
+class TokenBucket:
+    """One tenant's admission budget: ``rate`` tokens/s refill toward a
+    ``burst`` cap (monotonic clock; float tokens so fractional rates
+    work). ``try_take`` is the whole API — atomic under the owner's
+    lock (``TenantAdmission`` serializes callers; a bare bucket in
+    tests is single-threaded)."""
+
+    def __init__(self, rate: float, burst: float | None = None):
+        if rate <= 0.0:
+            raise ValueError(f"rate must be > 0, got {rate}")
+        self.rate = float(rate)
+        # Default burst = 1 second of rate (and never below one token,
+        # or a sub-1/s quota could not admit ANY request).
+        self.burst = max(1.0, float(burst if burst is not None else rate))
+        self.tokens = self.burst
+        self._stamp = time.monotonic()
+
+    def try_take(self, cost: float = 1.0,
+                 now: float | None = None) -> tuple[bool, float]:
+        """Spend ``cost`` tokens if available. Returns ``(admitted,
+        retry_after_s)`` — the wait is 0.0 on admit, else the refill
+        time until ``cost`` tokens would exist. (A cost past the burst
+        cap can never be admitted by waiting; the uncapped hint is
+        still monotone and nonzero, which beats advertising an instant
+        retry that will 429 forever.)"""
+        now = time.monotonic() if now is None else now
+        elapsed = max(0.0, now - self._stamp)
+        self.tokens = min(self.burst, self.tokens + elapsed * self.rate)
+        self._stamp = now
+        if self.tokens >= cost:
+            self.tokens -= cost
+            return True, 0.0
+        return False, (cost - self.tokens) / self.rate
+
+
+class TenantAdmission:
+    """Per-tenant token-bucket quotas over the router's request paths.
+
+    ``quotas`` pins named tenants to explicit ``(rate, burst)``; any
+    other tenant gets the default quota, lazily. Cardinality is
+    bounded HERE, not at the scrape: clients pick their own
+    ``X-Tenant`` values, so past ``max_tenants`` distinct names every
+    new tenant shares one ``"other"`` bucket and label value — an
+    adversarial header can neither explode the registry nor mint
+    itself a fresh budget per request.
+    """
+
+    OTHER = "other"
+
+    def __init__(self, default_rate: float = 100.0,
+                 default_burst: float | None = None,
+                 quotas: dict[str, tuple[float, float | None]]
+                 | None = None,
+                 registry: MetricsRegistry | None = None,
+                 max_tenants: int = 32,
+                 default_tenant: str = "default"):
+        self.default_rate = float(default_rate)
+        self.default_burst = default_burst
+        self.default_tenant = str(default_tenant)
+        self.max_tenants = int(max_tenants)
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self._lock = threading.Lock()
+        self._buckets: dict[str, TokenBucket] = {}
+        self._counters: dict[tuple[str, str], object] = {}
+        self._pinned = set()
+        for name, (rate, burst) in sorted((quotas or {}).items()):
+            self._buckets[str(name)] = TokenBucket(rate, burst)
+            self._pinned.add(str(name))
+
+    def _normalize(self, tenant: str | None) -> str:
+        tenant = (tenant or "").strip()
+        if not tenant:
+            return self.default_tenant
+        # Exposition-legal label value, bounded length: the header is
+        # attacker-controlled wire input.
+        tenant = "".join(c if c.isalnum() or c in "-_.:" else "_"
+                         for c in tenant[:64])
+        return tenant or self.default_tenant
+
+    def _bucket_locked(self, tenant: str) -> tuple[str, TokenBucket]:
+        bucket = self._buckets.get(tenant)
+        if bucket is not None:
+            return tenant, bucket
+        if len(self._buckets) >= self.max_tenants:
+            bucket = self._buckets.get(self.OTHER)
+            if bucket is None:
+                bucket = self._buckets[self.OTHER] = TokenBucket(
+                    self.default_rate, self.default_burst)
+            return self.OTHER, bucket
+        bucket = self._buckets[tenant] = TokenBucket(
+            self.default_rate, self.default_burst)
+        return tenant, bucket
+
+    def _count_locked(self, outcome: str, tenant: str) -> None:
+        counter = self._counters.get((outcome, tenant))
+        if counter is None:
+            name = f"tenant_{outcome}_total"
+            counter = self._counters[(outcome, tenant)] = \
+                self.registry.counter(
+                    name, f"requests {outcome} by the per-tenant "
+                          "admission buckets",
+                    labels={"tenant": tenant})
+        counter.inc()
+
+    def admit(self, tenant: str | None,
+              cost: float = 1.0,
+              now: float | None = None) -> tuple[bool, float]:
+        """Meter one request of ``cost`` rows for ``tenant``. Returns
+        ``(admitted, retry_after_s)`` and counts the outcome under the
+        (bounded) tenant label."""
+        name = self._normalize(tenant)
+        with self._lock:
+            name, bucket = self._bucket_locked(name)
+            ok, retry_after = bucket.try_take(cost, now=now)
+            self._count_locked("admitted" if ok else "rejected", name)
+        return ok, retry_after
+
+    def snapshot(self) -> dict:
+        """Tenant -> remaining tokens (observability surface; the
+        authoritative counters live in the registry)."""
+        with self._lock:
+            return {name: round(b.tokens, 3)
+                    for name, b in sorted(self._buckets.items())}
+
+
 class WorkerEntry:
     """One worker replica as the router sees it (mutated under the
     pool's lock; plain attributes — this is a record, not an actor)."""
@@ -116,6 +252,11 @@ class WorkerEntry:
         self.url = url.rstrip("/")
         self.alive = False
         self.ready = False
+        # Draining (ISSUE 16): still alive and probing healthy, but the
+        # autoscaler has marked it for retirement — selection skips it,
+        # its in-flight requests complete, and the controller SIGTERMs
+        # only once inflight hits zero (or the drain deadline passes).
+        self.draining = False
         self.checkpoint_step: int | None = None
         self.inflight = 0
         self.consecutive_failures = 0
@@ -129,6 +270,7 @@ class WorkerEntry:
 
     def snapshot(self) -> dict:
         return {"url": self.url, "alive": self.alive, "ready": self.ready,
+                "draining": self.draining,
                 "checkpoint_step": self.checkpoint_step,
                 "inflight": self.inflight,
                 "consecutive_failures": self.consecutive_failures,
@@ -384,6 +526,31 @@ class WorkerPool:
         self._alive_gauge.set(sum(1 for w in self._workers.values()
                                   if w.alive))
 
+    # -- drain-down (the autoscaler's surface, ISSUE 16) -------------------
+    def set_draining(self, worker_id: str, draining: bool = True) -> bool:
+        """Mark/unmark a worker draining (no new routes; in-flight
+        completes). Returns False when the worker is unknown."""
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            if entry is None:
+                return False
+            entry.draining = bool(draining)
+            return True
+
+    def inflight_of(self, worker_id: str) -> int:
+        """In-flight request count for one worker (0 when unknown) —
+        the drain state machine's completion signal."""
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            return entry.inflight if entry is not None else 0
+
+    def routable_count(self) -> int:
+        """Ready, non-draining workers — the pool size the autoscaler
+        reasons about (a draining victim no longer carries load)."""
+        with self._lock:
+            return sum(1 for w in self._workers.values()
+                       if w.ready and not w.draining)
+
     # -- selection ---------------------------------------------------------
     def _is_canary(self, entry: WorkerEntry) -> bool:
         return (self.trusted_step is not None
@@ -398,7 +565,13 @@ class WorkerPool:
         """
         exclude = exclude or set()
         with self._lock:
-            all_ready = [w for w in self._workers.values() if w.ready]
+            # A draining worker is invisible to selection AND to canary
+            # arming: it keeps probing ready (so the fleet does not
+            # eject it mid-drain) while its in-flight requests finish,
+            # but it must receive zero NEW routes — that is the whole
+            # zero-5xx scale-down contract (serving/autoscale.py).
+            all_ready = [w for w in self._workers.values()
+                         if w.ready and not w.draining]
             ready = [w for w in all_ready
                      if w.worker_id not in exclude]
             if not ready:
@@ -458,7 +631,8 @@ class WorkerPool:
         ``done``)."""
         with self._lock:
             cohort = [w for w in self._workers.values()
-                      if w.ready and w.checkpoint_step == step]
+                      if w.ready and not w.draining
+                      and w.checkpoint_step == step]
             if not cohort:
                 return None
             entry = min(cohort, key=lambda w: (w.inflight, w.worker_id))
@@ -707,6 +881,7 @@ class FleetRouter:
         self.run_id: str | None = None
         self.index = None           # retrieval.IndexManager (attach_index)
         self.shadow = None          # ShadowMirror (attach_shadow)
+        self.admission = None       # TenantAdmission (ISSUE 16)
         self.aggregator = None      # obs.FleetAggregator -> /metrics/fleet
         self.alerts = AlertStore(registry=self.registry)  # -> /alerts
         self._httpd: ThreadingHTTPServer | None = None
@@ -1174,6 +1349,8 @@ class FleetRouter:
             out["index"] = self.index.snapshot()
         if self.shadow is not None:
             out["shadow"] = self.shadow.snapshot()
+        if self.admission is not None:
+            out["tenants"] = self.admission.snapshot()
         if self.aggregator is not None:
             out["federation"] = self.aggregator.snapshot()
         firing = self.alerts.active()
@@ -1345,6 +1522,13 @@ def _make_router_handler(router: FleetRouter):
             store = (query.get("store", ["0"])[0].lower()
                      in ("1", "true", "yes"))
             parsed = self._parse_rows(body)
+            # Admission meters by row count when the router can parse
+            # the body (cost scales with the work a tenant asks for);
+            # an unparseable pass-through body costs one token — the
+            # worker owns its 400, but the forward is still work.
+            cost = int(parsed[0].shape[0]) if parsed is not None else 1
+            if not self._admit(reply, cost):
+                return
             if parsed is None or (router.cache is None and not store):
                 # Unparseable here (the worker owns the 400) or neither
                 # cache nor store needs the rows: pure pass-through.
@@ -1400,6 +1584,11 @@ def _make_router_handler(router: FleetRouter):
                 return
             x, timeout_ms = parsed
             status["rows"] = int(x.shape[0])
+            # /search rides the same per-tenant buckets as /embed
+            # (ISSUE 16): the retrieval path embeds through the fleet
+            # too, so an unmetered /search would be a quota bypass.
+            if not self._admit(reply, int(x.shape[0])):
+                return
             code, payload, headers, served_step, emb = \
                 self._embed_full(rid, x, timeout_ms)
             if code != 200 or emb is None:
@@ -1477,6 +1666,25 @@ def _make_router_handler(router: FleetRouter):
                 # 200 into a dropped connection.
                 logger.exception("index insert failed")
                 return []
+
+        def _admit(self, reply, cost: int) -> bool:
+            """Per-tenant admission check (no-op without a configured
+            ``TenantAdmission``). On exhaustion answers the same 429 +
+            Retry-After contract the saturation path uses, so clients
+            need one backoff implementation, not two."""
+            adm = router.admission
+            if adm is None:
+                return True
+            tenant = self.headers.get("X-Tenant")
+            ok, retry_after = adm.admit(tenant, cost=max(1, cost))
+            if ok:
+                return True
+            router._reject("tenant_quota")
+            reply(429, {"error": "tenant over admission quota",
+                        "tenant": adm._normalize(tenant),
+                        "retry_after_s": round(retry_after, 3)},
+                  {"Retry-After": str(max(1, int(retry_after + 0.999)))})
+            return False
 
         def _parse_rows(self, body: bytes):
             """Best-effort parse for cache keying; None = pass through

@@ -1,0 +1,217 @@
+#!/usr/bin/env bash
+# Autoscale smoke (ISSUE 16): the closed loop end to end through the
+# REAL ntxent-fleet in <60 s CPU. One tiny-model worker boots under
+# `--autoscale` (min 1, max 2) with per-tenant admission armed; then:
+#
+#   1. `--chaos spike@6` fires the flash-crowd hook — a closed-loop
+#      burst against the router's own /embed. In-flight pressure
+#      crosses the scale-up bound for the configured streak and the
+#      controller grows the pool through the supervision path
+#      (fleet_scale_up_total >= 1, a second worker passes /readyz);
+#   2. the burst ends, the idle policy drains the elastic worker back
+#      to min — and the steady background replay (scripts/loadgen.py,
+#      open-loop Poisson) must observe ZERO 5xx / connection resets
+#      across the whole grow-and-drain arc (fleet_scale_down_total
+#      >= 1, workers_ready back to 1);
+#   3. per-tenant admission: a starved tenant (2 rows/s quota) gets
+#      429 + Retry-After while the default tenant keeps flowing;
+#   4. the Prometheus scrape shows the new families (fleet_pool_size,
+#      fleet_scale_up_total/fleet_scale_down_total, fleet_drain_ms,
+#      tenant_admitted_total/tenant_rejected_total) with the tenant
+#      label bounded to the names actually seen.
+# Any 5xx, hang, or failed assertion exits nonzero.
+# Pairs with `pytest -m autoscale` (the same tier asserted in-process)
+# and `python bench.py --autoscale` (the committed three-leg A/B).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+t_start=$SECONDS
+
+workdir="$(mktemp -d)"
+fleet_pid=""
+load_pid=""
+cleanup() {
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "--- fleet log tail (rc=$rc) ---" >&2
+        tail -40 "$workdir/fleet.log" >&2 2>/dev/null || true
+        for wlog in "$workdir/fleet"/w*.log; do
+            [ -f "$wlog" ] || continue
+            echo "--- $(basename "$wlog") tail ---" >&2
+            tail -10 "$wlog" >&2
+        done
+    fi
+    [ -n "$load_pid" ] && kill "$load_pid" 2>/dev/null || true
+    [ -n "$fleet_pid" ] && kill "$fleet_pid" 2>/dev/null || true
+    [ -n "$fleet_pid" ] && wait "$fleet_pid" 2>/dev/null || true
+    rm -rf "$workdir"
+}
+trap cleanup EXIT
+
+echo "=== autoscale smoke: workdir $workdir"
+
+# Phase 0 — the fleet: ONE worker, the controller armed 1..2, admission
+# quotas on, the spike chaos action six supervision ticks after
+# readiness. Aggressive streaks/cooldowns so the whole arc fits the
+# smoke budget; cache off so load actually reaches workers.
+JAX_PLATFORMS=cpu python -c "
+import sys
+from ntxent_tpu.cli import fleet_main
+sys.exit(fleet_main(sys.argv[1:]))
+" --platform cpu --model tiny --image-size 8 --proj-hidden-dim 16 \
+  --proj-dim 8 --workers 1 --buckets 1,4 --no-cache \
+  --workdir "$workdir/fleet" --health-poll 0.3 --fed-interval 0.3 \
+  --autoscale --min-workers 1 --max-workers 2 \
+  --scale-up-queue 2 --scale-up-inflight 2 --scale-up-ticks 2 \
+  --scale-up-cooldown 1 --scale-idle-ticks 4 --scale-down-cooldown 2 \
+  --drain-deadline 10 \
+  --tenant-quota "default=10000,starved=2:2" \
+  --chaos "spike@6" --seed 0 \
+  --port 0 --port-file "$workdir/router.port" \
+  >"$workdir/fleet.log" 2>&1 &
+fleet_pid=$!
+
+for _ in $(seq 200); do [ -s "$workdir/router.port" ] && break; sleep 0.1; done
+[ -s "$workdir/router.port" ] || { echo "router never bound"; exit 1; }
+PORT="$(cat "$workdir/router.port")"
+echo "=== router on :$PORT"
+
+# Wait for the seed worker (cold JAX + ladder warmup).
+python - "$PORT" <<'PY'
+import json, sys, time, urllib.request
+port = int(sys.argv[1])
+for _ in range(300):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+            if json.loads(r.read()).get("workers_ready", 0) >= 1:
+                sys.exit(0)
+    except Exception:
+        pass
+    time.sleep(0.2)
+sys.exit("seed worker never became ready")
+PY
+echo "=== seed worker ready (t=$((SECONDS - t_start))s)"
+
+# Phase 1 — steady open-loop replay in the background: the client whose
+# zero-5xx experience the grow-and-drain arc is judged by.
+python scripts/loadgen.py --url "http://127.0.0.1:$PORT" \
+    --rate 8 --duration 30 --shape 8,8,3 --rows 2 --keys 16 \
+    --tenants "app:1" --max-outstanding 64 --timeout 20 --seed 1 \
+    >"$workdir/load.json" 2>"$workdir/load.log" &
+load_pid=$!
+
+# Phase 2 — watch the arc: spike fires ~2 s in, the pool must reach 2,
+# then drain back to 1 after the burst.
+python - "$PORT" <<'PY'
+import json, sys, time, urllib.request
+port = int(sys.argv[1])
+base = f"http://127.0.0.1:{port}"
+
+
+def counters():
+    with urllib.request.urlopen(base + "/metrics?format=state",
+                                timeout=5) as r:
+        state = json.loads(r.read())
+    out = {}
+    for m in state["metrics"]:
+        out[m["name"]] = out.get(m["name"], 0.0) + m.get("value", 0.0)
+    return out
+
+
+def ready():
+    with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+        return json.loads(r.read()).get("workers_ready", 0)
+
+
+deadline = time.monotonic() + 30.0
+grew = False
+while time.monotonic() < deadline:
+    c = counters()
+    if c.get("fleet_scale_up_total", 0) >= 1 and ready() >= 2:
+        grew = True
+        break
+    time.sleep(0.5)
+assert grew, f"pool never grew: {counters()}"
+print(f"smoke: scale-up OK (workers_ready={ready()})")
+
+deadline = time.monotonic() + 45.0
+drained = False
+while time.monotonic() < deadline:
+    c = counters()
+    if c.get("fleet_scale_down_total", 0) >= 1 and ready() == 1:
+        drained = True
+        break
+    time.sleep(0.5)
+assert drained, f"pool never drained back: {counters()}"
+c = counters()
+assert c.get("fleet_pool_size") == 1.0, c
+print(f"smoke: drain-down OK (scale_ups="
+      f"{int(c['fleet_scale_up_total'])}, scale_downs="
+      f"{int(c['fleet_scale_down_total'])})")
+PY
+
+# Phase 3 — per-tenant admission: the starved tenant exhausts its
+# 2-row/s burst immediately (each request costs 2 rows) and must see
+# 429 + Retry-After while the default tenant keeps flowing.
+python - "$PORT" <<'PY'
+import json, sys, urllib.error, urllib.request
+port = int(sys.argv[1])
+base = f"http://127.0.0.1:{port}"
+body = json.dumps({"inputs": [[[[0.5] * 3] * 8] * 8] * 2}).encode()
+
+
+def post(tenant):
+    req = urllib.request.Request(
+        base + "/embed", data=body, method="POST",
+        headers={"Content-Type": "application/json",
+                 "X-Tenant": tenant})
+    try:
+        with urllib.request.urlopen(req, timeout=20) as r:
+            return r.status, dict(r.headers)
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code, dict(e.headers)
+
+
+codes = [post("starved") for _ in range(4)]
+rejected = [(c, h) for c, h in codes if c == 429]
+assert rejected, f"starved tenant never throttled: {codes}"
+assert all(int(h.get("Retry-After", 0)) >= 1 for _, h in rejected), codes
+assert all(c in (200, 429) for c, _ in codes), codes
+ok, _ = post("app")
+assert ok == 200, f"default-quota tenant throttled: {ok}"
+print(f"smoke: admission OK ({len(rejected)}/4 starved requests 429)")
+PY
+
+# Phase 4 — the replay's verdict: zero 5xx across the whole arc.
+wait "$load_pid"; load_pid=""
+python - "$workdir/load.json" <<'PY'
+import json, sys
+out = json.load(open(sys.argv[1]))
+assert out["completed"] > 100, out
+assert out["n_5xx"] == 0, out
+assert out["n_unreachable"] == 0, out
+print(f"smoke: replay OK ({out['completed']} requests, "
+      f"p99={out['latency_ms']['p99']:.0f}ms, zero 5xx)")
+PY
+
+# Phase 5 — the exposition surface: new families present, tenant label
+# bounded to names actually seen.
+python - "$PORT" <<'PY'
+import sys, urllib.request
+port = int(sys.argv[1])
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics?format=prometheus",
+        timeout=5) as r:
+    text = r.read().decode()
+for family in ("fleet_pool_size", "fleet_scale_up_total",
+               "fleet_scale_down_total", "fleet_drain_ms",
+               "tenant_admitted_total", "tenant_rejected_total"):
+    assert family in text, f"{family} missing from /metrics"
+tenants = {line.split('tenant="', 1)[1].split('"', 1)[0]
+           for line in text.splitlines() if 'tenant="' in line}
+assert tenants <= {"app", "starved", "chaos-spike", "default"}, tenants
+print(f"smoke: exposition OK (tenants={sorted(tenants)})")
+PY
+
+echo "=== autoscale smoke PASSED in $((SECONDS - t_start))s"

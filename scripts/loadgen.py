@@ -1,0 +1,446 @@
+#!/usr/bin/env python
+"""Open-loop arrival-process load generator for the serving fleet.
+
+The closed-loop smoke clients (serving_smoke.sh, fleet_smoke.sh) send
+request N+1 only after request N answers — so when the fleet slows
+down, the offered load slows down WITH it, and the measured p99 is a
+portrait of the client's politeness, not the fleet's capacity. Real
+traffic does not wait: arrivals are a (time-varying) Poisson process
+that keeps coming while the fleet drowns. This harness replays that
+regime (ISSUE 16):
+
+* **Poisson arrivals** at a driven rate via Lewis-Shedler thinning
+  (exact for any bounded time-varying intensity — no per-second
+  discretization artifacts);
+* **diurnal ramp + flash-crowd spikes**: ``RateSchedule`` composes a
+  base rate, a linear warm ramp, an optional sinusoidal "day", and
+  ``start:duration:mult`` spike segments (the 10x flash crowd the
+  autoscale bench drives);
+* **hot-key skew**: request payloads reuse a Zipf-distributed key set,
+  exercising the router's embedding cache and the retrieval docstore
+  the way a head-heavy real corpus would;
+* **multi-tenant mix**: weighted ``X-Tenant`` assignment, so per-tenant
+  admission control (429 + Retry-After) is observable per tenant;
+* **open loop, bounded**: each arrival fires on its own thread up to
+  ``--max-outstanding``; past the cap an arrival is counted as ``shed``
+  and DROPPED, never queued — queueing arrivals client-side would
+  quietly turn the harness back into a closed loop.
+
+Stdlib-only and JAX-free: importable (``load_module`` in tests and
+bench.py) and runnable against any live router::
+
+    python scripts/loadgen.py --url http://127.0.0.1:8080 \
+        --rate 30 --duration 20 --spike 8:4:10 \
+        --tenants default:8,burst:2 --rows 4 --dim 32
+
+Exit code is 0 whenever the run completed; judging SLOs is the
+caller's job (the summary JSON on stdout has everything needed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+__all__ = ["RateSchedule", "ZipfKeys", "TenantMix", "arrival_times",
+           "run_load", "main"]
+
+
+class RateSchedule:
+    """Time-varying request rate (requests/second) over a finite run.
+
+    ``rate(t)`` composes, for 0 <= t < duration:
+
+    * a linear warm ramp from ``ramp_from * base`` to ``base`` over the
+      first ``ramp_s`` seconds (0 disables);
+    * an optional diurnal sinusoid: base modulated by ``1 +
+      diurnal_amp * sin(2*pi*t/diurnal_period_s)`` — a whole "day" can
+      be compressed into a bench run by shrinking the period;
+    * multiplicative spike segments ``(start_s, duration_s, mult)``:
+      the flash crowd (overlapping spikes multiply).
+    """
+
+    def __init__(self, base: float, duration_s: float,
+                 ramp_s: float = 0.0, ramp_from: float = 0.1,
+                 diurnal_amp: float = 0.0,
+                 diurnal_period_s: float = 60.0,
+                 spikes: list[tuple[float, float, float]] | None = None):
+        if base <= 0:
+            raise ValueError(f"base rate must be > 0, got {base}")
+        if not 0.0 <= diurnal_amp < 1.0:
+            raise ValueError("diurnal_amp must be in [0, 1)")
+        self.base = float(base)
+        self.duration_s = float(duration_s)
+        self.ramp_s = float(ramp_s)
+        self.ramp_from = float(ramp_from)
+        self.diurnal_amp = float(diurnal_amp)
+        self.diurnal_period_s = float(diurnal_period_s)
+        self.spikes = [(float(s), float(d), float(m))
+                       for s, d, m in (spikes or [])]
+
+    @classmethod
+    def parse_spike(cls, spec: str) -> tuple[float, float, float]:
+        """``start:duration:mult`` (seconds, seconds, factor)."""
+        parts = spec.split(":")
+        if len(parts) != 3:
+            raise ValueError(f"bad spike {spec!r} "
+                             "(want start:duration:mult)")
+        start, duration, mult = (float(p) for p in parts)
+        if duration <= 0 or mult <= 0:
+            raise ValueError(f"bad spike {spec!r}: duration and mult "
+                             "must be > 0")
+        return start, duration, mult
+
+    def rate(self, t: float) -> float:
+        if t < 0 or t >= self.duration_s:
+            return 0.0
+        r = self.base
+        if self.ramp_s > 0 and t < self.ramp_s:
+            frac = t / self.ramp_s
+            r *= self.ramp_from + (1.0 - self.ramp_from) * frac
+        if self.diurnal_amp > 0:
+            r *= 1.0 + self.diurnal_amp * math.sin(
+                2.0 * math.pi * t / self.diurnal_period_s)
+        for start, duration, mult in self.spikes:
+            if start <= t < start + duration:
+                r *= mult
+        return r
+
+    def peak(self) -> float:
+        """An upper bound on rate(t) — the thinning majorant. Exact
+        for this schedule's closed form (ramp <= 1, diurnal <= 1+amp,
+        overlapping spikes multiply)."""
+        mult_bound = 1.0
+        events = [(s, +1, m) for s, d, m in self.spikes] \
+            + [(s + d, -1, m) for s, d, m in self.spikes]
+        running = 1.0
+        for _, kind, m in sorted(events, key=lambda e: (e[0], -e[1])):
+            if kind > 0:
+                running *= m
+            else:
+                running /= m
+            mult_bound = max(mult_bound, running)
+        return self.base * (1.0 + self.diurnal_amp) * mult_bound
+
+
+def arrival_times(schedule: RateSchedule,
+                  rng: random.Random) -> list[float]:
+    """Nonhomogeneous-Poisson arrival offsets via Lewis-Shedler
+    thinning: draw homogeneous candidates at the peak rate, keep each
+    with probability rate(t)/peak. Exact and discretization-free."""
+    peak = schedule.peak()
+    out: list[float] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(peak)
+        if t >= schedule.duration_s:
+            return out
+        if rng.random() * peak < schedule.rate(t):
+            out.append(t)
+
+
+class ZipfKeys:
+    """A Zipf(s)-skewed key universe with deterministic per-key
+    payloads: key k always yields the same rows, so a popular key is a
+    cache hit by construction — the skew exercises the router cache
+    and the retrieval docstore the way head-heavy traffic would."""
+
+    def __init__(self, n_keys: int, s: float, rows: int,
+                 shape: int | tuple[int, ...], rng: random.Random):
+        if n_keys < 1:
+            raise ValueError(f"n_keys must be >= 1, got {n_keys}")
+        self.n_keys = int(n_keys)
+        self.s = float(s)
+        self.rows = int(rows)
+        # One example row's shape — (dim,) for the flat stub workers,
+        # (H, W, C) for a real image fleet.
+        self.shape = ((int(shape),) if isinstance(shape, int)
+                      else tuple(int(d) for d in shape))
+        self.rng = rng
+        weights = [1.0 / (k + 1) ** self.s for k in range(self.n_keys)]
+        total = sum(weights)
+        self._cum = []
+        acc = 0.0
+        for w in weights:
+            acc += w / total
+            self._cum.append(acc)
+
+    def pick(self) -> int:
+        u = self.rng.random()
+        lo, hi = 0, self.n_keys - 1
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._cum[mid] < u:
+                lo = mid + 1
+            else:
+                hi = mid
+        return lo
+
+    def payload(self, key: int) -> bytes:
+        """The key's fixed /embed body (seeded by the key alone)."""
+        key_rng = random.Random(0xC0FFEE ^ key)
+
+        def fill(shape: tuple[int, ...]):
+            if not shape:
+                return round(key_rng.uniform(-1.0, 1.0), 6)
+            return [fill(shape[1:]) for _ in range(shape[0])]
+
+        inputs = [fill(self.shape) for _ in range(self.rows)]
+        return json.dumps({"inputs": inputs}).encode()
+
+
+class TenantMix:
+    """Weighted tenant assignment (``name:weight,name:weight``)."""
+
+    def __init__(self, weights: dict[str, float], rng: random.Random):
+        if not weights:
+            weights = {"default": 1.0}
+        self.names = sorted(weights)
+        total = sum(weights[n] for n in self.names)
+        if total <= 0:
+            raise ValueError("tenant weights must sum > 0")
+        self._cum = []
+        acc = 0.0
+        for name in self.names:
+            acc += weights[name] / total
+            self._cum.append(acc)
+        self.rng = rng
+
+    @classmethod
+    def parse(cls, spec: str, rng: random.Random) -> "TenantMix":
+        weights: dict[str, float] = {}
+        for part in filter(None, (s.strip() for s in
+                                  (spec or "").split(","))):
+            name, sep, w = part.partition(":")
+            weights[name.strip()] = float(w) if sep else 1.0
+        return cls(weights, rng)
+
+    def pick(self) -> str:
+        u = self.rng.random()
+        for name, edge in zip(self.names, self._cum):
+            if u <= edge:
+                return name
+        return self.names[-1]
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, math.ceil(q * len(sorted_vals)) - 1))
+    return sorted_vals[idx]
+
+
+def run_load(url: str, schedule: RateSchedule, keys: ZipfKeys,
+             tenants: TenantMix, rng: random.Random,
+             max_outstanding: int = 64,
+             timeout_s: float = 30.0,
+             route: str = "/embed",
+             search_k: int = 10) -> dict:
+    """Drive one open-loop replay; blocks until the last in-flight
+    request lands. Returns the summary dict (see ``summarize``)."""
+    arrivals = arrival_times(schedule, rng)
+    sem = threading.Semaphore(int(max_outstanding))
+    lock = threading.Lock()
+    results: list[tuple[float, str, str, float]] = []  # (t, status,
+    #                                                     tenant, ms)
+    shed = 0
+    threads: list[threading.Thread] = []
+    target = url.rstrip("/") + route
+
+    def _fire(offset: float, tenant: str, body: bytes) -> None:
+        nonlocal shed
+        t0 = time.monotonic()
+        req = urllib.request.Request(
+            target, data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-Tenant": tenant})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                status = str(resp.status)
+                resp.read()
+        except urllib.error.HTTPError as e:
+            status = str(e.code)
+            try:
+                e.read()
+            except OSError:
+                pass
+        except (urllib.error.URLError, OSError):
+            status = "unreachable"
+        ms = (time.monotonic() - t0) * 1e3
+        with lock:
+            results.append((offset, status, tenant, ms))
+        sem.release()
+
+    start = time.monotonic()
+    for offset in arrivals:
+        delay = start + offset - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        tenant = tenants.pick()
+        key = keys.pick()
+        if route == "/search":
+            obj = json.loads(keys.payload(key))
+            obj["k"] = search_k
+            body = json.dumps(obj).encode()
+        else:
+            body = keys.payload(key)
+        if not sem.acquire(blocking=False):
+            # Open loop: past the outstanding cap the arrival is shed
+            # CLIENT-side and counted — blocking here would make later
+            # arrivals wait on earlier completions (a closed loop).
+            with lock:
+                shed += 1
+            continue
+        t = threading.Thread(target=_fire, args=(offset, tenant, body),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=timeout_s + 5.0)
+    wall_s = time.monotonic() - start
+    return summarize(results, shed, len(arrivals), wall_s, schedule)
+
+
+def summarize(results: list[tuple[float, str, str, float]], shed: int,
+              offered: int, wall_s: float,
+              schedule: RateSchedule) -> dict:
+    """Aggregate one run: status counts, per-tenant outcomes, latency
+    percentiles, empirical-vs-driven rate, and a per-second timeline
+    (offered arrivals and worst latency per one-second bucket)."""
+    status_counts: dict[str, int] = {}
+    tenant_counts: dict[str, dict[str, int]] = {}
+    latencies: list[float] = []
+    ok_latencies: list[float] = []
+    timeline: dict[int, dict] = {}
+    for offset, status, tenant, ms in results:
+        status_counts[status] = status_counts.get(status, 0) + 1
+        bucket = tenant_counts.setdefault(tenant, {})
+        bucket[status] = bucket.get(status, 0) + 1
+        latencies.append(ms)
+        if status == "200":
+            ok_latencies.append(ms)
+        sec = timeline.setdefault(int(offset), {"offered": 0,
+                                                "errors": 0,
+                                                "max_ms": 0.0})
+        sec["offered"] += 1
+        sec["max_ms"] = max(sec["max_ms"], round(ms, 1))
+        if status not in ("200", "429"):
+            sec["errors"] += 1
+    latencies.sort()
+    ok_latencies.sort()
+    n_5xx = sum(c for s, c in status_counts.items()
+                if s.isdigit() and s.startswith("5"))
+    n_unreachable = status_counts.get("unreachable", 0)
+    completed = len(results)
+    expected = sum(schedule.rate(t * 0.5) * 0.5
+                   for t in range(int(schedule.duration_s * 2)))
+    return {
+        "offered": offered,
+        "completed": completed,
+        "shed_client": shed,
+        "wall_s": round(wall_s, 3),
+        "driven_rate": round(offered / max(1e-9, schedule.duration_s),
+                             3),
+        "expected_rate": round(expected
+                               / max(1e-9, schedule.duration_s), 3),
+        "status": dict(sorted(status_counts.items())),
+        "tenants": {t: dict(sorted(c.items()))
+                    for t, c in sorted(tenant_counts.items())},
+        "n_5xx": n_5xx,
+        "n_unreachable": n_unreachable,
+        "error_rate": round((n_5xx + n_unreachable)
+                            / max(1, completed), 5),
+        "latency_ms": {
+            "p50": _percentile(latencies, 0.50),
+            "p99": _percentile(latencies, 0.99),
+            "ok_p50": _percentile(ok_latencies, 0.50),
+            "ok_p99": _percentile(ok_latencies, 0.99),
+        },
+        "timeline": [
+            {"t": sec, **vals} for sec, vals in sorted(timeline.items())
+        ],
+    }
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="open-loop Poisson traffic replay against a "
+                    "serving fleet router")
+    p.add_argument("--url", default="http://127.0.0.1:8080")
+    p.add_argument("--route", default="/embed",
+                   choices=("/embed", "/search"))
+    p.add_argument("--rate", type=float, default=20.0,
+                   help="base arrival rate (requests/s)")
+    p.add_argument("--duration", type=float, default=10.0,
+                   help="run length (s)")
+    p.add_argument("--ramp", type=float, default=0.0,
+                   help="linear warm-ramp length (s; 0 = off)")
+    p.add_argument("--diurnal-amp", type=float, default=0.0,
+                   help="sinusoidal modulation amplitude [0, 1)")
+    p.add_argument("--diurnal-period", type=float, default=60.0,
+                   help="sinusoid period (s)")
+    p.add_argument("--spike", action="append", default=[],
+                   metavar="START:DUR:MULT",
+                   help="flash-crowd segment (repeatable)")
+    p.add_argument("--keys", type=int, default=64,
+                   help="Zipf key-universe size")
+    p.add_argument("--zipf-s", type=float, default=1.1,
+                   help="Zipf skew exponent (0 = uniform)")
+    p.add_argument("--rows", type=int, default=4,
+                   help="rows per request payload")
+    p.add_argument("--dim", type=int, default=32,
+                   help="flat feature width per row (shorthand for "
+                        "--shape DIM)")
+    p.add_argument("--shape", default=None, metavar="D0,D1,...",
+                   help="one example row's shape (must match the "
+                        "fleet's example shape, e.g. 32,32,3 for an "
+                        "image fleet); overrides --dim")
+    p.add_argument("--tenants", default="default:1",
+                   metavar="NAME:WEIGHT,...",
+                   help="weighted tenant mix for X-Tenant")
+    p.add_argument("--max-outstanding", type=int, default=64,
+                   help="in-flight cap; arrivals past it are shed "
+                        "client-side (kept open-loop, never queued)")
+    p.add_argument("--timeout", type=float, default=30.0,
+                   help="per-request timeout (s)")
+    p.add_argument("--search-k", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--timeline", action="store_true",
+                   help="include the per-second timeline in the "
+                        "summary (omitted by default: it is long)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    rng = random.Random(args.seed)
+    schedule = RateSchedule(
+        base=args.rate, duration_s=args.duration, ramp_s=args.ramp,
+        diurnal_amp=args.diurnal_amp,
+        diurnal_period_s=args.diurnal_period,
+        spikes=[RateSchedule.parse_spike(s) for s in args.spike])
+    shape = (tuple(int(d) for d in args.shape.split(","))
+             if args.shape else args.dim)
+    keys = ZipfKeys(args.keys, args.zipf_s, args.rows, shape,
+                    random.Random(args.seed + 1))
+    tenants = TenantMix.parse(args.tenants, random.Random(args.seed + 2))
+    summary = run_load(args.url, schedule, keys, tenants, rng,
+                       max_outstanding=args.max_outstanding,
+                       timeout_s=args.timeout, route=args.route,
+                       search_k=args.search_k)
+    if not args.timeline:
+        summary.pop("timeline", None)
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

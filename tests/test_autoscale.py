@@ -1,0 +1,547 @@
+"""SLO-driven autoscaling + admission control (ISSUE 16).
+
+The decision core is tested as the pure state machine it is
+(``step_signals`` over synthetic snapshots on a fake clock pins every
+hysteresis/cooldown boundary), the drain path as a state machine over
+a real ``WorkerPool`` and a fake fleet (zero new routes to a draining
+worker, retire at in-flight zero, deadline kill), and admission as
+arithmetic (token-bucket refill/exhaustion/Retry-After, per-tenant
+isolation, the bounded-cardinality "other" overflow). The loadgen
+harness is checked statistically — empirical Poisson rate against the
+schedule's integral — because its open-loop discipline is what makes
+the bench's breach leg meaningful. Everything here is JAX-free.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import random
+import sys
+
+import pytest
+
+from ntxent_tpu.obs.registry import MetricsRegistry
+from ntxent_tpu.resilience import FaultInjector, FaultPlan
+from ntxent_tpu.serving import TenantAdmission, TokenBucket, WorkerPool
+from ntxent_tpu.serving.autoscale import (
+    AutoscaleController,
+    gauge_total,
+    parse_tenant_quotas,
+)
+
+pytestmark = pytest.mark.autoscale
+
+
+def _load_loadgen():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "ntxent_loadgen", os.path.join(repo, "scripts", "loadgen.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeWorkerRec:
+    def __init__(self, worker_id: str):
+        self.worker_id = worker_id
+
+
+class FakeFleet:
+    """Membership + spawn/retire bookkeeping, no processes."""
+
+    def __init__(self, ids):
+        self.members = list(ids)
+        self.retired: list[str] = []
+        self.autoscaler = None
+        self.on_spike = None
+
+    def workers_snapshot(self):
+        return [FakeWorkerRec(i) for i in self.members]
+
+    def add_worker(self):
+        wid = f"w{len(self.members)}"
+        self.members.append(wid)
+        return FakeWorkerRec(wid)
+
+    def retire_worker(self, worker_id, grace_s: float = 5.0) -> bool:
+        if worker_id not in self.members:
+            return False
+        self.members.remove(worker_id)
+        self.retired.append(worker_id)
+        return True
+
+
+def make_controller(n=1, clock=None, **kw):
+    fleet = FakeFleet([f"w{i}" for i in range(n)])
+    pool = WorkerPool()
+    for i in range(n):
+        pool.upsert(f"w{i}", f"http://127.0.0.1:{9000 + i}")
+        pool.set_health(f"w{i}", alive=True, ready=True,
+                        checkpoint_step=0)
+    kw.setdefault("min_workers", 1)
+    kw.setdefault("max_workers", 4)
+    kw.setdefault("up_ticks", 2)
+    kw.setdefault("idle_ticks", 3)
+    kw.setdefault("up_cooldown_s", 10.0)
+    kw.setdefault("down_cooldown_s", 20.0)
+    ctl = AutoscaleController(fleet, pool,
+                              clock=clock or FakeClock(), **kw)
+    return ctl, fleet, pool
+
+
+def sig(ctl, *, queue=0.0, inflight=0.0, p99=None, burn=None):
+    routable = sum(1 for w in ctl.pool.workers() if w.ready
+                   and w.worker_id not in ctl._draining)
+    return {"queue_depth": queue, "inflight": inflight,
+            "routable": routable, "size": ctl.pool_size(),
+            "p99_ms": p99, "burn": burn}
+
+
+# ---------------------------------------------------------------------------
+# TokenBucket arithmetic
+
+
+class TestTokenBucket:
+    def test_burst_defaults_to_one_second_of_rate(self):
+        assert TokenBucket(8.0).burst == 8.0
+        # ... but never under one token, or a sub-1/s quota could
+        # not admit any request at all.
+        assert TokenBucket(0.25).burst == 1.0
+
+    def test_exhaustion_and_retry_after_math(self):
+        b = TokenBucket(2.0, burst=4.0)
+        t = 100.0
+        for _ in range(4):
+            ok, wait = b.try_take(1.0, now=t)
+            assert ok and wait == 0.0
+        ok, wait = b.try_take(1.0, now=t)
+        assert not ok
+        # Empty bucket at 2 tokens/s: one token exists in 0.5 s.
+        assert wait == pytest.approx(0.5)
+
+    def test_refill_is_rate_times_elapsed_capped_at_burst(self):
+        b = TokenBucket(2.0, burst=4.0)
+        b.try_take(4.0, now=100.0)          # drain to zero
+        ok, _ = b.try_take(1.0, now=100.2)  # only 0.4 refilled
+        assert not ok
+        ok, _ = b.try_take(1.0, now=100.5)  # 0.4 + 0.6 = 1.0
+        assert ok
+        # A long quiet period must not bank more than burst.
+        b2 = TokenBucket(2.0, burst=4.0)
+        b2.try_take(4.0, now=0.0)
+        ok, _ = b2.try_take(4.0, now=1e6)
+        assert ok
+        assert not b2.try_take(0.5, now=1e6)[0]
+
+    def test_over_burst_cost_rejects_with_nonzero_hint(self):
+        # A full bucket rejecting an over-burst cost must NOT advertise
+        # an instant retry (retry_after 0 would 429 forever).
+        b = TokenBucket(2.0, burst=2.0)
+        ok, wait = b.try_take(5.0, now=50.0)
+        assert not ok
+        assert wait > 0.0
+
+    def test_rate_must_be_positive(self):
+        with pytest.raises(ValueError):
+            TokenBucket(0.0)
+
+
+# ---------------------------------------------------------------------------
+# TenantAdmission
+
+
+class TestTenantAdmission:
+    def test_tenants_are_isolated(self):
+        # One tenant exhausting its bucket must not spend another's.
+        ta = TenantAdmission(default_rate=2.0, default_burst=2.0)
+        assert ta.admit("a", 2.0, now=10.0)[0]
+        assert not ta.admit("a", 1.0, now=10.0)[0]
+        assert ta.admit("b", 2.0, now=10.0)[0]
+
+    def test_named_quota_overrides_default(self):
+        ta = TenantAdmission(default_rate=1.0,
+                             quotas={"big": (100.0, 200.0)})
+        assert ta.admit("big", 150.0, now=5.0)[0]
+        assert not ta.admit("small", 150.0, now=5.0)[0]
+
+    def test_bare_requests_use_the_default_tenant(self):
+        ta = TenantAdmission(default_rate=1.0, default_burst=1.0)
+        assert ta.admit(None, 1.0, now=1.0)[0]
+        # Same bucket: an empty header and the literal name collide.
+        assert not ta.admit("default", 1.0, now=1.0)[0]
+
+    def test_header_is_sanitized_and_bounded(self):
+        ta = TenantAdmission()
+        assert ta._normalize("team a!") == "team_a_"
+        assert ta._normalize("  ") == "default"
+        assert len(ta._normalize("x" * 500)) <= 64
+
+    def test_cardinality_overflow_shares_the_other_bucket(self):
+        ta = TenantAdmission(default_rate=1.0, default_burst=1.0,
+                             max_tenants=2)
+        ta.admit("t0", 1.0, now=0.0)
+        ta.admit("t1", 1.0, now=0.0)
+        # Past max_tenants, fresh names share ONE bucket + label: the
+        # first overflow tenant spends it, the second is rejected.
+        assert ta.admit("t2", 1.0, now=0.0)[0]
+        assert not ta.admit("t3", 1.0, now=0.0)[0]
+        assert set(ta.snapshot()) == {"t0", "t1", TenantAdmission.OTHER}
+
+    def test_outcomes_counted_under_bounded_tenant_label(self):
+        reg = MetricsRegistry()
+        ta = TenantAdmission(default_rate=1.0, default_burst=1.0,
+                             registry=reg)
+        ta.admit("a", 1.0, now=0.0)
+        ta.admit("a", 1.0, now=0.0)
+        metrics = {(m["name"], m["labels"].get("tenant")): m["value"]
+                   for m in reg.dump_state()["metrics"]}
+        assert metrics[("tenant_admitted_total", "a")] == 1.0
+        assert metrics[("tenant_rejected_total", "a")] == 1.0
+
+
+class TestParseTenantQuotas:
+    def test_grammar(self):
+        assert parse_tenant_quotas("default=100,big=1000:2000") == {
+            "default": (100.0, None), "big": (1000.0, 2000.0)}
+        assert parse_tenant_quotas("") == {}
+
+    @pytest.mark.parametrize("bad", ["big", "big=", "big=abc",
+                                     "big=0", "big=10:0"])
+    def test_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            parse_tenant_quotas(bad)
+
+
+# ---------------------------------------------------------------------------
+# the decision core: hysteresis and cooldown boundaries
+
+
+class TestStepSignals:
+    def test_up_requires_consecutive_pressure_ticks(self):
+        clock = FakeClock()
+        ctl, _, _ = make_controller(1, clock=clock, up_ticks=2)
+        assert ctl.step_signals(sig(ctl, queue=100.0)) \
+            == ("hold", "queue_depth:streak")
+        # An intervening calm tick resets the streak.
+        assert ctl.step_signals(sig(ctl))[0] == "hold"
+        assert ctl.step_signals(sig(ctl, queue=100.0)) \
+            == ("hold", "queue_depth:streak")
+        assert ctl.step_signals(sig(ctl, queue=100.0)) \
+            == ("up", "queue_depth")
+
+    def test_up_cooldown_blocks_then_expires(self):
+        clock = FakeClock()
+        ctl, fleet, _ = make_controller(1, clock=clock, up_ticks=1,
+                                        up_cooldown_s=10.0)
+        assert ctl.step_signals(sig(ctl, queue=100.0))[0] == "up"
+        fleet.add_worker()
+        clock.advance(5.0)
+        assert ctl.step_signals(sig(ctl, queue=100.0)) \
+            == ("hold", "queue_depth:cooldown")
+        clock.advance(6.0)
+        assert ctl.step_signals(sig(ctl, queue=100.0))[0] == "up"
+
+    def test_at_max_holds_under_pressure(self):
+        ctl, _, _ = make_controller(2, max_workers=2, up_ticks=1)
+        assert ctl.step_signals(sig(ctl, queue=100.0)) \
+            == ("hold", "queue_depth:at_max")
+
+    def test_below_min_repairs_immediately(self):
+        # A pool under the floor skips streaks AND cooldowns.
+        ctl, _, _ = make_controller(1, min_workers=2, up_ticks=5)
+        assert ctl.step_signals(sig(ctl)) == ("up", "below_min")
+
+    def test_pressure_priority_and_sources(self):
+        clock = FakeClock()
+        ctl, _, _ = make_controller(2, clock=clock, up_ticks=1,
+                                    up_p99_ms=500.0)
+        assert ctl.step_signals(sig(ctl, inflight=8.0)) \
+            == ("up", "inflight")
+        clock.advance(100.0)
+        assert ctl.step_signals(sig(ctl, p99=600.0)) == ("up", "p99")
+        clock.advance(100.0)
+        assert ctl.step_signals(sig(ctl, burn=2.0)) == ("up", "burn")
+
+    def test_down_needs_idle_streak_and_cooldowns(self):
+        clock = FakeClock()
+        ctl, _, _ = make_controller(3, idle_ticks=3,
+                                    down_cooldown_s=20.0, clock=clock)
+        assert ctl.step_signals(sig(ctl)) == ("hold", "idle:streak")
+        assert ctl.step_signals(sig(ctl)) == ("hold", "idle:streak")
+        assert ctl.step_signals(sig(ctl)) == ("down", "idle")
+        # Immediately after: streak restarts AND the down cooldown
+        # gates the next victim.
+        assert ctl.step_signals(sig(ctl)) == ("hold", "idle:streak")
+        assert ctl.step_signals(sig(ctl)) == ("hold", "idle:streak")
+        assert ctl.step_signals(sig(ctl)) == ("hold", "idle:cooldown")
+        clock.advance(21.0)
+        assert ctl.step_signals(sig(ctl)) == ("down", "idle")
+
+    def test_recent_up_blocks_down(self):
+        # A freshly added worker gets a full window before the calm it
+        # bought reads as over-provisioning.
+        clock = FakeClock()
+        ctl, fleet, pool = make_controller(1, up_ticks=1, idle_ticks=1,
+                                           down_cooldown_s=20.0,
+                                           clock=clock)
+        assert ctl.step_signals(sig(ctl, queue=100.0))[0] == "up"
+        w = fleet.add_worker()
+        pool.upsert(w.worker_id, "http://127.0.0.1:9999")
+        pool.set_health(w.worker_id, alive=True, ready=True,
+                        checkpoint_step=0)
+        clock.advance(5.0)
+        assert ctl.step_signals(sig(ctl)) == ("hold", "idle:recent_up")
+        clock.advance(21.0)
+        assert ctl.step_signals(sig(ctl)) == ("down", "idle")
+
+    def test_never_drains_to_zero_or_below_min(self):
+        ctl, _, _ = make_controller(1, idle_ticks=1)
+        for _ in range(5):
+            action, _reason = ctl.step_signals(sig(ctl))
+            assert action == "hold"
+
+    def test_constructor_validates_bounds(self):
+        with pytest.raises(ValueError):
+            make_controller(1, min_workers=0)
+        with pytest.raises(ValueError):
+            make_controller(1, min_workers=3, max_workers=2)
+
+
+# ---------------------------------------------------------------------------
+# burn signal extraction (ring over the merged registry)
+
+
+class TestBurnSignal:
+    def _merged(self, total, bad, tenant_quota=0.0):
+        reg = MetricsRegistry()
+        reg.counter("fleet_requests_total").inc(total)
+        reg.counter("fleet_rejected_total",
+                    labels={"reason": "saturated"}).inc(bad)
+        if tenant_quota:
+            reg.counter("fleet_rejected_total",
+                        labels={"reason": "tenant_quota"}) \
+               .inc(tenant_quota)
+        return reg
+
+    def test_burn_is_windowed_bad_fraction_over_budget(self):
+        clock = FakeClock()
+        ctl, _, _ = make_controller(1, clock=clock, burn_window_s=8.0,
+                                    slo_target=0.999)
+        ctl.signals(self._merged(0, 0))
+        clock.advance(4.0)
+        s = ctl.signals(self._merged(1000, 2))
+        # 2/1000 bad over a 0.001 budget = burn 2.
+        assert s["burn"] == pytest.approx(2.0)
+
+    def test_tenant_quota_rejects_do_not_buy_capacity(self):
+        clock = FakeClock()
+        ctl, _, _ = make_controller(1, clock=clock, burn_window_s=8.0,
+                                    slo_target=0.999)
+        ctl.signals(self._merged(0, 0))
+        clock.advance(4.0)
+        s = ctl.signals(self._merged(1000, 0, tenant_quota=500.0))
+        assert s["burn"] == pytest.approx(0.0)
+
+    def test_burn_needs_a_quarter_window_of_samples(self):
+        clock = FakeClock()
+        ctl, _, _ = make_controller(1, clock=clock, burn_window_s=8.0)
+        assert ctl.signals(self._merged(10, 5))["burn"] is None
+        clock.advance(0.5)  # span 0.5 < 2.0 = window/4
+        assert ctl.signals(self._merged(20, 10))["burn"] is None
+
+    def test_gauge_total_sums_label_sets(self):
+        reg = MetricsRegistry()
+        reg.gauge("serving_queue_depth",
+                  labels={"instance": "w0"}).set(3.0)
+        reg.gauge("serving_queue_depth",
+                  labels={"instance": "w1"}).set(4.0)
+        reg.counter("serving_queue_depth_unrelated").inc(99)
+        assert gauge_total(reg, "serving_queue_depth") == 7.0
+
+
+# ---------------------------------------------------------------------------
+# the drain state machine (real WorkerPool, fake fleet)
+
+
+class TestDrainStateMachine:
+    def _controller(self, n=2, **kw):
+        clock = FakeClock()
+        kw.setdefault("idle_ticks", 1)
+        kw.setdefault("down_cooldown_s", 0.0)
+        kw.setdefault("drain_deadline_s", 10.0)
+        ctl, fleet, pool = make_controller(n, clock=clock, **kw)
+        return ctl, fleet, pool, clock
+
+    def test_draining_worker_gets_no_new_routes(self):
+        ctl, _, pool, _ = self._controller(2)
+        assert pool.set_draining("w1", True)
+        picked = {pool.pick().worker_id for _ in range(20)}
+        assert picked == {"w0"}
+        assert pool.routable_count() == 1
+        assert not pool.set_draining("nope", True)
+
+    def test_victim_is_highest_ordinal(self):
+        ctl, _, pool, clock = self._controller(3)
+        assert ctl._pick_victim() == "w2"
+        pool.set_draining("w2", True)
+        ctl._draining["w2"] = {"since": 0, "deadline": 1,
+                               "reason": "idle"}
+        assert ctl._pick_victim() == "w1"
+
+    def test_drain_completes_at_inflight_zero(self):
+        ctl, fleet, pool, clock = self._controller(2)
+        with pool._lock:
+            pool._workers["w1"].inflight = 2
+        started = ctl._start_drain("idle", sig(ctl), clock())
+        assert started and "w1" in ctl._draining
+        # In-flight work pins the worker: membership intact.
+        ctl._advance_drains(clock())
+        assert fleet.retired == [] and "w1" in fleet.members
+        with pool._lock:
+            pool._workers["w1"].inflight = 0
+        ctl._advance_drains(clock())
+        assert fleet.retired == ["w1"]
+        assert ctl._draining == {}
+        assert ctl.pool_size() == 1
+
+    def test_drain_deadline_retires_a_wedged_worker(self):
+        ctl, fleet, pool, clock = self._controller(
+            2, drain_deadline_s=5.0)
+        with pool._lock:
+            pool._workers["w1"].inflight = 1
+        ctl._start_drain("idle", sig(ctl), clock())
+        clock.advance(4.0)
+        ctl._advance_drains(clock())
+        assert fleet.retired == []
+        clock.advance(1.5)
+        ctl._advance_drains(clock())
+        assert fleet.retired == ["w1"]
+
+    def test_force_drain_skips_policy_but_keeps_one_routable(self):
+        ctl, fleet, pool, clock = self._controller(2)
+        assert ctl.force_drain(reason="chaos") == "w1"
+        # The survivor is never drained from under the fleet.
+        assert ctl.force_drain(reason="chaos") is None
+
+    def test_observe_never_raises(self):
+        ctl, _, _ = make_controller(1)
+        assert ctl.observe(object()) == {}  # not a registry: swallowed
+
+    def test_observe_full_tick_scales_up(self):
+        clock = FakeClock()
+        ctl, fleet, pool = make_controller(1, clock=clock, up_ticks=1)
+        reg = MetricsRegistry()
+        reg.gauge("serving_queue_depth").set(100.0)
+        ctl.observe(reg)
+        assert len(fleet.members) == 2
+        snap = ctl.snapshot()
+        assert snap["size"] == 2 and snap["ticks"] == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos grammar: spike@T / drainworker@T
+
+
+class TestFaultPlanAutoscaleActions:
+    def test_parse_and_fire_ticks(self):
+        plan = FaultPlan.parse("spike@3,drainworker@5,killworker@2")
+        assert plan.spike_ticks == (3,)
+        assert plan.drainworker_ticks == (5,)
+        assert not plan.empty()
+        injector = FaultInjector(plan)
+        fired = [injector.on_fleet_tick() for _ in range(5)]
+        assert fired[2] == ["spike@3"]
+        assert fired[4] == ["drainworker@5"]
+        assert fired[3] == []
+        assert fired[1] == ["killworker@2"]
+
+    def test_autoscale_only_plan_is_not_empty(self):
+        assert not FaultPlan.parse("spike@1").empty()
+        assert not FaultPlan.parse("drainworker@1").empty()
+
+
+# ---------------------------------------------------------------------------
+# loadgen: the replay harness's statistics
+
+
+class TestLoadgen:
+    lg = _load_loadgen()
+
+    def test_schedule_composes_ramp_diurnal_spike(self):
+        s = self.lg.RateSchedule(100.0, 60.0, ramp_s=10.0,
+                                 ramp_from=0.1, diurnal_amp=0.5,
+                                 diurnal_period_s=40.0,
+                                 spikes=[(20.0, 5.0, 10.0)])
+        assert s.rate(0.0) == pytest.approx(10.0)   # ramp floor
+        assert s.rate(-1.0) == 0.0 and s.rate(60.0) == 0.0
+        assert s.rate(21.0) > 500.0                  # spike x diurnal
+        peak = s.peak()
+        for t in range(0, 600):
+            assert s.rate(t / 10.0) <= peak + 1e-9
+
+    def test_spike_spec_parsing(self):
+        assert self.lg.RateSchedule.parse_spike("5:2:10") \
+            == (5.0, 2.0, 10.0)
+        with pytest.raises(ValueError):
+            self.lg.RateSchedule.parse_spike("5:2")
+        with pytest.raises(ValueError):
+            self.lg.RateSchedule.parse_spike("5:0:10")
+
+    def test_poisson_arrivals_match_the_schedule_integral(self):
+        # Open-loop correctness is statistical: the thinned process
+        # must drive the schedule's integral, not the peak majorant.
+        s = self.lg.RateSchedule(200.0, 4.0, ramp_s=2.0, ramp_from=0.5)
+        arrivals = self.lg.arrival_times(s, random.Random(7))
+        expected = 200.0 * 2.0 + 200.0 * 0.75 * 2.0  # hold + ramp area
+        assert len(arrivals) == pytest.approx(expected, rel=0.15)
+        assert arrivals == sorted(arrivals)
+        assert all(0.0 <= t < 4.0 for t in arrivals)
+
+    def test_zipf_keys_are_skewed_and_deterministic(self):
+        keys = self.lg.ZipfKeys(n_keys=50, s=1.2, rows=2, shape=(4,),
+                                rng=random.Random(3))
+        picks = [keys.pick() for _ in range(2000)]
+        assert picks.count(0) > picks.count(49) * 5
+        # Key k always yields byte-identical rows: hot keys are cache
+        # hits by construction.
+        assert keys.payload(7) == keys.payload(7)
+        assert keys.payload(7) != keys.payload(8)
+
+    def test_tenant_mix_parse_and_distribution(self):
+        mix = self.lg.TenantMix.parse("a:3,b:1", random.Random(11))
+        picks = [mix.pick() for _ in range(4000)]
+        ratio = picks.count("a") / max(1, picks.count("b"))
+        assert 2.0 < ratio < 4.5
+
+    def test_summarize_counts_5xx_and_ok_percentiles(self):
+        results = [(0.1, "200", "a", 10.0), (0.2, "200", "a", 20.0),
+                   (0.5, "429", "b", 1.0), (1.1, "502", "b", 5.0),
+                   (1.2, "unreachable", "a", 9.0)]
+        s = self.lg.RateSchedule(5.0, 2.0)
+        out = self.lg.summarize(results, shed=1, offered=6, wall_s=2.0,
+                                schedule=s)
+        assert out["n_5xx"] == 1 and out["n_unreachable"] == 1
+        assert out["shed_client"] == 1
+        assert out["status"]["429"] == 1
+        assert out["latency_ms"]["ok_p99"] == 20.0
+        assert out["tenants"]["b"] == {"429": 1, "502": 1}
+
+    def test_cli_parses_the_full_surface(self):
+        argv = ["--url", "http://x", "--rate", "10", "--duration", "1",
+                "--spike", "0.5:0.2:4", "--tenants", "a:3,b:1",
+                "--shape", "8,8,3", "--seed", "3"]
+        parser = self.lg.build_parser()
+        args = parser.parse_args(argv)
+        assert args.shape == "8,8,3" and len(args.spike) == 1
