@@ -1087,20 +1087,33 @@ def _fleet_main() -> None:
 
 
 def _retrieval_child() -> None:
-    """--retrieval measurement: the ANN index tier (ISSUE 15).
+    """--retrieval measurement: the ANN index tier (ISSUE 15/17).
 
-    JAX-free by design (the index rides the router process): builds an
-    IVF-flat ``VectorIndex`` over clustered unit vectors — the
-    structure real embedding spaces have, and the structure IVF recall
-    depends on — then measures the two committed claims:
+    JAX-free by design (the index rides the router process): builds a
+    PQ-coded IVF ``VectorIndex`` over unit vectors on a low-rank
+    manifold (rank 16 in 64-d plus small full-rank noise) — the shape
+    contrastive embeddings actually have (dimensional collapse:
+    NT-Xent spreads mass over far fewer directions than the ambient
+    dim, and both IVF pruning and PQ distortion live or die on that
+    structure) — then measures the committed claims:
 
     * **recall@10 vs brute force** at the committed index size
-      (in-child hard bar: >= 0.95 — the ANN answer must be the right
-      answer);
+      (in-child hard bar: >= 0.95 — ADC candidates + exact re-rank
+      must still return the right answer);
+    * **bytes/row actually scanned** (in-child hard bar: <= 1/8 of the
+      raw float32 row — the PQ memory cut IS the headline);
     * **search p50/p99 under concurrent insert+query** (4 searcher
       threads against a live writer), plus the quiet baseline and the
       brute-force p50 the IVF speedup is measured against (in-child
       hard bar: concurrent p99 bounded).
+
+    The corpus is 10x the PR 14 record (4.1M rows vs 404k): the size
+    where the raw index stops fitting comfortably next to the serving
+    process and the coded scan becomes the difference between serving
+    search and shedding it. Training rides a small prefix (k-means
+    over the full corpus would dominate the build); the remaining rows
+    stream through the trained incremental path — the path production
+    inserts take.
     """
     import threading
 
@@ -1110,33 +1123,39 @@ def _retrieval_child() -> None:
 
     assert "jax" not in sys.modules, "retrieval bench must stay jax-free"
 
-    # 400k rows is where list pruning beats one BLAS scan on CPU: a
-    # brute matmul over 400k x 64 costs ~1.5 ms while 16 probed lists
-    # cost ~0.5 ms including the python dispatch floor. Below ~100k
-    # the dispatch floor wins and brute force IS the right algorithm —
-    # which is exactly why VectorIndex serves brute force until
-    # train_rows.
-    dim, n_base, n_live = 64, 400_000, 4_000
+    dim, rank, n_base, n_live = 64, 16, 4_100_000, 4_000
     n_queries, k = 128, 10
-    rng = np.random.RandomState(0)
-    centers = rng.randn(64, dim).astype(np.float32)
+    n_train = 32_768  # training prefix: 2x train_rows, 64 rows/centroid
+    proj = np.random.RandomState(0).randn(rank, dim).astype(np.float32)
 
     def make(n, seed):
         r = np.random.RandomState(seed)
-        x = centers[r.randint(centers.shape[0], size=n)] \
-            + 0.15 * r.randn(n, dim).astype(np.float32)
+        x = r.randn(n, rank).astype(np.float32) @ proj \
+            + 0.05 * r.randn(n, dim).astype(np.float32)
         return x / np.linalg.norm(x, axis=1, keepdims=True)
 
     base = make(n_base, 1)
-    idx = VectorIndex(dim, train_rows=16_384, n_centroids=256,
-                      nprobe=8)
+    # seal_rows bounds the raw (264 B/row) mutable tail — 65_536 of
+    # 4.1M keeps the steady-state tail under 2% so the blended
+    # bytes/row stays inside the 1/8 budget with margin.
+    idx = VectorIndex(dim, train_rows=16_384, n_centroids=512,
+                      nprobe=48, pq_m=8, pq_rerank=4096,
+                      seal_rows=65_536, compact_at=16)
     t0 = time.perf_counter()
-    for i in range(0, n_base, 4096):
-        idx.insert(np.arange(i, min(i + 4096, n_base)),
-                   base[i:i + 4096])
-    build_s = time.perf_counter() - t0
-    idx.maintain()
+    idx.insert(np.arange(n_train), base[:n_train])
+    idx.maintain()  # train on the prefix: centroids + PQ codebooks
     assert idx.trained
+    for i in range(n_train, n_base, 8192):
+        idx.insert(np.arange(i, min(i + 8192, n_base)),
+                   base[i:i + 8192])
+        if (i - n_train) % 65_536 == 0:
+            idx.maintain()  # seal cadence: encode + freeze the tail
+    while idx.maintain():
+        pass
+    build_s = time.perf_counter() - t0
+    bytes_per_row = idx.scan_bytes_per_row()
+    assert bytes_per_row <= dim * 4 / 8.0, \
+        f"scan bytes/row {bytes_per_row:.1f} over the 1/8 budget"
 
     # Recall@10 vs brute force, exact, on held-out queries.
     queries = make(n_queries, 2)
@@ -1196,7 +1215,10 @@ def _retrieval_child() -> None:
     concurrent = [v for s in series for v in s]
     conc = _latency_stats(concurrent)
     dur_s = sum(concurrent) / 1e3
-    assert conc["p99_ms"] < 250.0, \
+    # Availability bound, not a speed claim (the gate pins the actual
+    # committed p99): at 4.1M rows a probe scans ~385k coded rows and
+    # this box serializes 4 searchers + the writer on one core.
+    assert conc["p99_ms"] < 1500.0, \
         f"concurrent search p99 {conc['p99_ms']} ms unbounded"
 
     payload = {
@@ -1204,8 +1226,13 @@ def _retrieval_child() -> None:
         "platform": "cpu",  # numpy-only: no accelerator in this path
         "rows": int(idx.rows),
         "dim": dim,
-        "nprobe": 8,
-        "n_centroids": 256,
+        "nprobe": 48,
+        "n_centroids": 512,
+        "pq_m": 8,
+        "pq_rerank": 4096,
+        "bytes_per_row": round(float(bytes_per_row), 2),
+        "raw_bytes_per_row": dim * 4,
+        "resident_mb": round(idx.resident_bytes() / 2**20, 1),
         "build_rows_per_sec": round(n_base / build_s, 1),
         "recall_at_10": round(recall, 4),
         "brute_force": _latency_stats(brute),
@@ -2204,8 +2231,11 @@ def _gate_spec(name: str) -> tuple[str, dict]:
         # on the forced 8-device virtual mesh.
         return "--quant-child", dict(_QUANT_ENV)
     if name == "retrieval":
-        # No trimming: the child is numpy-only and runs in seconds.
-        # It re-asserts the >= 0.95 recall@10 bar and the bounded
+        # No trimming: the committed record is the 4.1M-row coded
+        # index and the gated numbers (recall, search throughput) only
+        # compare at the committed size. Numpy-only, a few minutes of
+        # single-core build. The child re-asserts the >= 0.95
+        # recall@10 bar, the <= 1/8 bytes/row budget and the bounded
         # concurrent-search p99 itself on every gate run.
         return "--retrieval-child", {}
     if name == "autoscale":
@@ -2337,16 +2367,31 @@ def gate_metrics(name: str, payload: dict | None,
             out["retrieval/recall_at_10"] = {
                 "value": float(v), "higher_is_better": True,
                 "tol": GATE_TOL}
+        # The PQ memory economy is structural (codes + locators per
+        # row), not wall clock: any gate-visible growth is a real
+        # format change, so the standard tolerance is pure headroom.
+        v = payload.get("bytes_per_row")
+        if keep(v):
+            out["retrieval/bytes_per_row"] = {
+                "value": float(v), "higher_is_better": False,
+                "tol": GATE_TOL}
         v = (payload.get("concurrent") or {}).get("searches_per_sec")
         if keep(v):
             out["retrieval/concurrent/searches_per_sec"] = {
                 "value": float(v), "higher_is_better": True,
                 "tol": GATE_SERVING_TOL}
+        # p50, not p99: at the 4.1M-row record a probe scans ~385k
+        # coded rows (~tens of ms), so the p99 of a 200-sample series
+        # on a single-core box is the 2nd-worst scheduler slice —
+        # back-to-back identical runs move it ±40%. The median and
+        # the throughput aggregate are the stable series that still
+        # catch any real scan regression; the in-child availability
+        # assert keeps the tail BOUNDED.
         for mode in ("quiet", "concurrent"):
-            lat = (payload.get(mode) or {}).get("p99_ms")
+            lat = (payload.get(mode) or {}).get("p50_ms")
             if keep(lat) and (not reference
                               or float(lat) >= GATE_LATENCY_FLOOR_MS):
-                out[f"retrieval/{mode}/p99_ms"] = {
+                out[f"retrieval/{mode}/p50_ms"] = {
                     "value": float(lat), "higher_is_better": False,
                     "tol": GATE_SERVING_TOL}
     elif name == "autoscale":

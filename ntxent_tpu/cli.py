@@ -1793,6 +1793,16 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                     default=2.0, metavar="SECONDS",
                     help="background maintenance tick (train/seal/"
                          "compact/recall probe)")
+    ix.add_argument("--index-pq-m", type=int, default=8, metavar="M",
+                    help="PQ code bytes per row (0 = raw IVF-flat, "
+                         "PR 14 behavior); sealed segments carry "
+                         "codes, searches ADC-scan + exact re-rank")
+    ix.add_argument("--search-shards", type=int, default=0,
+                    metavar="N",
+                    help="start N in-process shard servers and fan "
+                         "/search out across them (IVF lists "
+                         "partitioned list%%N; a dead shard degrades "
+                         "recall, never availability)")
 
     f = p.add_argument_group("fleet supervision")
     f.add_argument("--workdir", default=None,
@@ -2084,13 +2094,36 @@ def fleet_main(argv=None) -> int:
             train_rows=args.index_train_rows,
             n_centroids=args.index_centroids,
             nprobe=args.index_nprobe,
-            seal_rows=args.index_seal_rows)
+            seal_rows=args.index_seal_rows,
+            pq_m=args.index_pq_m)
         router.attach_index(index_mgr)
         logger.info("retrieval tier: POST /search live (%s, "
-                    "train_rows=%d, nprobe=%d/%d)",
+                    "train_rows=%d, nprobe=%d/%d, pq_m=%d)",
                     args.index_dir or "in-memory",
                     args.index_train_rows, args.index_nprobe,
-                    args.index_centroids)
+                    args.index_centroids, args.index_pq_m)
+
+    # Sharded index plane (ISSUE 17): N in-process shard servers, the
+    # router fans /search out and merges — the single-process capacity
+    # ceiling becomes a fleet-shaped one. In production the servers
+    # run on separate hosts (python -m ntxent_tpu.retrieval.shard).
+    shard_servers = []
+    if args.search_shards > 0:
+        from ntxent_tpu.retrieval import ShardFanout, ShardServer
+
+        dim = args.proj_dim
+        shard_servers = [ShardServer(dim).start()
+                         for _ in range(args.search_shards)]
+        fanout = ShardFanout(
+            [s.url for s in shard_servers], dim=dim,
+            train_rows=args.index_train_rows,
+            n_centroids=args.index_centroids,
+            nprobe=args.index_nprobe, pq_m=max(1, args.index_pq_m),
+            registry=registry)
+        router.attach_shards(fanout)
+        logger.info("retrieval: shard plane live — %d shard(s), "
+                    "lists partitioned list%%%d",
+                    args.search_shards, args.search_shards)
 
     # Fleet observability plane (ISSUE 10): shadow mirror, metric
     # federation, SLO engine. All off-hot-path; all optional.
@@ -2220,6 +2253,16 @@ def fleet_main(argv=None) -> int:
                 daemon=True, name="chaos-spike").start()
 
         fleet.on_spike = _on_spike
+        if index_mgr is not None:
+            # ISSUE 17 satellite: heavy retrieval maintenance (segment
+            # compaction, docstore log compaction) defers to the
+            # autoscaler's idle detector instead of running blind
+            # against a loaded fleet; the manager bounds the deferral
+            # so a permanently busy fleet still compacts.
+            index_mgr.heavy_gate = controller.maintenance_ok
+            logger.info("retrieval: heavy maintenance gated on fleet "
+                        "idleness (forced through after %d deferred "
+                        "tick(s))", index_mgr.heavy_defer_ticks)
         logger.info("autoscale: pool %d..%d (start %d), up after %d "
                     "pressure tick(s), drain after %d idle tick(s)",
                     min_w, max_w, args.workers, args.scale_up_ticks,
@@ -2260,6 +2303,10 @@ def fleet_main(argv=None) -> int:
             shadow.stop()
         if index_mgr is not None:
             index_mgr.stop()
+        for srv in shard_servers:
+            srv.stop()
+        if router.shards is not None:
+            router.shards.close()
         router.close()
         fleet.stop()
         if event_log is not None:

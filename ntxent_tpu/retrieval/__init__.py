@@ -9,6 +9,15 @@ searches to the new step's index, rollback restores the prior one, a
 shadow-drift breach marks it stale and forces rebuild). The router
 surfaces it as ``POST /search`` (serving/router.py).
 
+ISSUE 17 adds the memory-bound scale plane: product quantization
+(``pq`` — 8-byte codes + ADC tables + exact re-rank), the fused
+batched-gather scan over code lists (``scan`` — probe inversion, one
+list pass per batch), and a sharded index plane (``shard`` — IVF lists
+partitioned across HTTP shard workers; the router fans /search out and
+merges; a dead shard degrades recall, never availability). Durable
+state (docstore log + centroid/codebook snapshots) lives in
+``versioned``/``index``/``pq`` so a restart reopens trained.
+
 JAX-free at import by construction: numpy + stdlib only. The
 import-boundary lint (``LintConfig.boundary_roots``) and the runtime
 tripwire (tests/test_fleet.py) both enforce it — search must never pay
@@ -17,17 +26,28 @@ backend-init latency or hold an accelerator.
 
 from .index import RetrievalMetrics, VectorIndex
 from .ivf import IVFIndex, brute_force_topk, kmeans
+from .pq import PQCodec
+from .scan import CodedLists, ScanBatcher, batched_scan
 from .segments import MutableSegment, SealedSegment, SegmentStore
+from .shard import IndexShard, ShardClient, ShardFanout, ShardServer
 from .versioned import IndexManager
 
 __all__ = [
+    "CodedLists",
     "IndexManager",
+    "IndexShard",
     "IVFIndex",
     "MutableSegment",
+    "PQCodec",
     "RetrievalMetrics",
+    "ScanBatcher",
     "SealedSegment",
     "SegmentStore",
+    "ShardClient",
+    "ShardFanout",
+    "ShardServer",
     "VectorIndex",
+    "batched_scan",
     "brute_force_topk",
     "kmeans",
 ]

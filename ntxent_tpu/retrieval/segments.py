@@ -41,6 +41,8 @@ __all__ = ["MutableSegment", "SealedSegment", "SegmentStore"]
 _META = "meta.json"
 _VECS = "vectors.f32"
 _IDS = "ids.i64"
+_CODES = "codes.u8"
+_ASSIGN = "assign.i32"
 
 
 def _fsync_path(path: Path) -> None:
@@ -123,6 +125,25 @@ class SealedSegment:
                                  mode="r", shape=(self.rows, self.dim))
         self.ids = np.memmap(self.path / _IDS, dtype=np.int64,
                              mode="r", shape=(self.rows,))
+        # PQ sidecars (encode-on-seal, ISSUE 17): compact codes + IVF
+        # assignments stamped with the codec generation that produced
+        # them. Optional — pre-PQ segments stay readable, and a
+        # missing/mismatched sidecar means "recompute", never "fail".
+        self.codec_gen = meta.get("codec_gen")
+        self.codes = self.assign = None
+        if self.codec_gen is not None:
+            m = int(meta.get("pq_m", 0))
+            try:
+                if m > 0:
+                    self.codes = np.memmap(
+                        self.path / _CODES, dtype=np.uint8, mode="r",
+                        shape=(self.rows, m))
+                self.assign = np.memmap(
+                    self.path / _ASSIGN, dtype=np.int32, mode="r",
+                    shape=(self.rows,))
+            except (OSError, ValueError):
+                self.codes = self.assign = None
+                self.codec_gen = None
 
     @property
     def name(self) -> str:
@@ -137,27 +158,49 @@ class FrozenSegment:
     unbounded tail's reallocation measured as a multi-10-ms search
     stall under the index lock)."""
 
-    def __init__(self, name: str, ids: np.ndarray, vecs: np.ndarray):
+    def __init__(self, name: str, ids: np.ndarray, vecs: np.ndarray,
+                 codes: np.ndarray | None = None,
+                 assign: np.ndarray | None = None,
+                 codec_gen: int | None = None):
         self.name = name
         self.ids = np.ascontiguousarray(ids, np.int64)
         self.vectors = np.ascontiguousarray(vecs, np.float32)
         self.rows = int(self.vectors.shape[0])
         self.dim = int(self.vectors.shape[1])
+        self.codes = codes
+        self.assign = assign
+        self.codec_gen = codec_gen
 
 
 def _write_segment(parent: Path, name: str, ids: np.ndarray,
-                   vecs: np.ndarray) -> Path:
-    """Stage + fsync + rename one complete segment directory."""
+                   vecs: np.ndarray,
+                   codes: np.ndarray | None = None,
+                   assign: np.ndarray | None = None,
+                   codec_gen: int | None = None) -> Path:
+    """Stage + fsync + rename one complete segment directory.
+    ``codes``/``assign`` (with their ``codec_gen`` stamp) ride the
+    same atomic commit — a segment either carries a complete PQ
+    sidecar or none."""
     tmp = parent / f".tmp-{name}-{uuid.uuid4().hex[:8]}"
     tmp.mkdir(parents=True)
     vecs = np.ascontiguousarray(vecs, np.float32)
     ids = np.ascontiguousarray(ids, np.int64)
-    for fname, arr in ((_VECS, vecs), (_IDS, ids)):
+    blobs = [(_VECS, vecs), (_IDS, ids)]
+    meta = {"rows": int(vecs.shape[0]), "dim": int(vecs.shape[1])}
+    if assign is not None and codec_gen is not None:
+        blobs.append((_ASSIGN,
+                      np.ascontiguousarray(assign, np.int32)))
+        meta["codec_gen"] = int(codec_gen)
+        meta["pq_m"] = 0
+        if codes is not None:
+            codes = np.ascontiguousarray(codes, np.uint8)
+            blobs.append((_CODES, codes))
+            meta["pq_m"] = int(codes.shape[1])
+    for fname, arr in blobs:
         with open(tmp / fname, "wb") as f:
             f.write(arr.tobytes())
             f.flush()
             os.fsync(f.fileno())
-    meta = {"rows": int(vecs.shape[0]), "dim": int(vecs.shape[1])}
     with open(tmp / _META, "w") as f:
         json.dump(meta, f)
         f.flush()
@@ -185,6 +228,12 @@ class SegmentStore:
         self.root = Path(root) if root is not None else None
         self.mutable = MutableSegment(self.dim)
         self.sealed: list = []
+        # Optional PQ coder (set by the owning index once trained):
+        # an object with encode(vecs)->uint8 codes, assign(vecs)->
+        # int32 IVF lists, and a ``gen`` stamp. When present, freeze
+        # and merge write the sidecars — encode-on-seal is what makes
+        # the trained state rebuildable without touching raw floats.
+        self.coder = None
         # A taken-but-not-yet-published tail (mid-freeze): still part
         # of every read view — a brute-force search during the freeze
         # window must not miss its rows.
@@ -234,16 +283,28 @@ class SegmentStore:
         self.mutable = MutableSegment(self.dim)
         return taken
 
+    def _code(self, vecs: np.ndarray):
+        """``(codes, assign, gen)`` for rows about to seal — or
+        ``(None, None, None)`` without a trained coder."""
+        coder = self.coder
+        if coder is None or vecs.shape[0] == 0:
+            return None, None, None
+        return coder.encode(vecs), coder.assign(vecs), coder.gen
+
     def freeze(self, mutable: MutableSegment):
         """Materialize a taken tail as a sealed segment (disk when
         rooted, in-memory otherwise). Copy/IO only — no store state
         is touched; ``publish`` it afterwards."""
         name = f"seg-{self._seq:06d}"
         self._seq += 1
+        ids, vecs = mutable.view()
+        codes, assign, gen = self._code(vecs)
         if self.root is None:
-            return FrozenSegment(name, mutable.ids, mutable.vectors)
-        path = _write_segment(self.root, name, mutable.ids,
-                              mutable.vectors)
+            return FrozenSegment(name, ids, vecs, codes=codes,
+                                 assign=assign, codec_gen=gen)
+        path = _write_segment(self.root, name, ids, vecs,
+                              codes=codes, assign=assign,
+                              codec_gen=gen)
         return SealedSegment(path)
 
     def publish(self, segment) -> None:
@@ -263,15 +324,39 @@ class SegmentStore:
 
     def merge(self, segments: list):
         """Merge sealed segments into one new segment (copy/IO only;
-        ``swap_sealed`` it in afterwards)."""
+        ``swap_sealed`` it in afterwards). Input sidecars of the
+        current codec generation are CONCATENATED, never recomputed —
+        a compaction is an IO pass, not an encode pass; any stale or
+        missing sidecar re-encodes that segment only."""
         ids = np.concatenate([np.asarray(s.ids) for s in segments])
         vecs = np.concatenate([np.asarray(s.vectors)
                                for s in segments])
+        codes = assign = gen = None
+        coder = self.coder
+        if coder is not None and vecs.shape[0]:
+            gen = coder.gen
+            code_parts, assign_parts = [], []
+            for s in segments:
+                if getattr(s, "codec_gen", None) == gen \
+                        and s.assign is not None:
+                    assign_parts.append(np.asarray(s.assign))
+                    code_parts.append(
+                        np.asarray(s.codes) if s.codes is not None
+                        else coder.encode(np.asarray(s.vectors)))
+                else:
+                    sv = np.asarray(s.vectors)
+                    code_parts.append(coder.encode(sv))
+                    assign_parts.append(coder.assign(sv))
+            codes = np.concatenate(code_parts)
+            assign = np.concatenate(assign_parts)
         name = f"seg-{self._seq:06d}"
         self._seq += 1
         if self.root is None:
-            return FrozenSegment(name, ids, vecs)
-        return SealedSegment(_write_segment(self.root, name, ids, vecs))
+            return FrozenSegment(name, ids, vecs, codes=codes,
+                                 assign=assign, codec_gen=gen)
+        return SealedSegment(_write_segment(
+            self.root, name, ids, vecs, codes=codes, assign=assign,
+            codec_gen=gen))
 
     def swap_sealed(self, olds: list, merged) -> None:
         """Replace ``olds`` (a prefix snapshot of ``sealed``) with

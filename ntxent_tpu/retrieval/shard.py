@@ -1,0 +1,569 @@
+"""Sharded index plane: IVF lists partitioned across worker processes.
+
+At millions of rows a single process's scan is bounded by one memory
+bus. The shard plane splits the COATED structure, not the query: IVF
+list ``c`` lives on shard ``c % n_shards``, every shard keeps the full
+centroid table, and a query probes the same global top-``nprobe``
+lists on EVERY shard — shard ``s`` contributes exactly the probed
+lists it owns, so the union across shards equals the unsharded probe
+set row-for-row. Scores are exact re-ranked inner products (scan.py),
+hence directly comparable, and the router-side merge is a plain
+per-query top-k. Two consequences fall out for free:
+
+* recall is IDENTICAL to the unsharded index when every shard answers
+  (same candidate rows, same exact scores);
+* a dead shard subtracts only the rows of the lists it owns — the
+  merge runs over whoever answered, the response carries
+  ``shards: {ok, total, degraded}``, and availability never depends
+  on any single shard. Degraded recall, never a 503.
+
+Training stays CENTRAL: the coordinator (``ShardFanout``) buffers the
+first ``train_rows`` inserts, fits IVF centroids + the PQ codec once,
+pushes both to every shard (``POST /shard/init``), then flushes the
+buffered rows to their owners. Until that point searches brute-force
+the coordinator's buffer — cold behavior matches ``VectorIndex``.
+Shards are UNVERSIONED (one plane, no per-step cutover) — wiring the
+rollout state machine through the fan-out is a ROADMAP follow-up.
+
+Wire format: vectors ride as base64 float32 blobs (``_pack``), ~3x
+denser than JSON float lists and loss-free. Everything here is numpy
++ stdlib (http.server / urllib) — the retrieval import boundary and
+the fleet tripwire both pin that no jax sneaks in.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .ivf import brute_force_topk, kmeans
+from .pq import PQCodec
+from .scan import CodedLists, batched_scan
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["IndexShard", "ShardClient", "ShardFanout", "ShardServer",
+           "shard_owner"]
+
+_MAX_BODY = 64 * 1024 * 1024  # b64 f32 rows are bulky; cap, don't trust
+
+
+def shard_owner(lists: np.ndarray, n_shards: int) -> np.ndarray:
+    """IVF list -> owning shard. Static modulo placement: no lookup
+    table to replicate, and a list's owner is derivable anywhere."""
+    return np.asarray(lists) % int(n_shards)
+
+
+def _pack(arr: np.ndarray) -> dict:
+    a = np.ascontiguousarray(arr, np.float32)
+    return {"shape": list(a.shape),
+            "f32": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def _unpack(obj: dict) -> np.ndarray:
+    shape = tuple(int(s) for s in obj["shape"])
+    raw = base64.b64decode(obj["f32"])
+    return np.frombuffer(raw, np.float32).reshape(shape).copy()
+
+
+class IndexShard:
+    """One worker's slice of the plane: the coded lists it owns plus a
+    raw grow-buffer source for exact re-rank.
+
+    Single-writer per shard (the HTTP handler serializes under
+    ``_lock``); searches ride the lock-free coded-list views. Rows for
+    lists this shard does NOT own are rejected loudly — a misrouted
+    insert means the coordinator's plan and this shard disagree, and
+    silently indexing it would double rows under another shard.
+    """
+
+    def __init__(self, dim: int, shard_id: int = 0, n_shards: int = 1):
+        self.dim = int(dim)
+        self.shard_id = int(shard_id)
+        self.n_shards = max(1, int(n_shards))
+        self._lock = threading.Lock()
+        self._coded: CodedLists | None = None
+        # Raw rows backing the coded locators: grown copy-on-publish
+        # (committed prefix copied before the pointer swap, same
+        # discipline as scan._ListBuf).
+        self._raw = np.empty((0, self.dim), np.float32)
+        self._raw_rows = 0
+        self.nprobe = 8
+        self.misrouted = 0
+
+    @property
+    def trained(self) -> bool:
+        return self._coded is not None
+
+    @property
+    def rows(self) -> int:
+        coded = self._coded
+        return coded.rows if coded is not None else 0
+
+    def init_plane(self, centroids: np.ndarray, codec: PQCodec,
+                   shard_id: int, n_shards: int,
+                   nprobe: int = 8) -> None:
+        """Install the centrally trained structure. Re-init replaces
+        the coded lists wholesale (a retrain invalidates old codes);
+        in-flight searches keep the old arrays alive and stay
+        consistent."""
+        with self._lock:
+            self.shard_id = int(shard_id)
+            self.n_shards = max(1, int(n_shards))
+            self.nprobe = max(1, int(nprobe))
+            coded = CodedLists(centroids, codec)
+            # Fresh lists drop any previous generation's rows (the
+            # coordinator re-flushes on retrain — ROADMAP follow-up);
+            # source 0 is this shard's raw grow buffer.
+            self._raw_rows = 0
+            self._raw = np.empty((0, self.dim), np.float32)
+            coded.add_source(self._raw)
+            self._coded = coded
+
+    def insert(self, ids: np.ndarray, vectors: np.ndarray) -> int:
+        """Index owned rows; returns how many were accepted."""
+        vecs = np.asarray(vectors, np.float32)
+        if vecs.ndim == 1:
+            vecs = vecs[None]
+        ids = np.asarray(ids, np.int64)
+        with self._lock:
+            coded = self._coded
+            if coded is None:
+                raise RuntimeError("shard not initialized")
+            assign = coded.assign(vecs)
+            owned = shard_owner(assign, self.n_shards) == self.shard_id
+            if not bool(np.all(owned)):
+                self.misrouted += int((~owned).sum())
+                logger.warning("shard %d: %d misrouted row(s) rejected",
+                               self.shard_id, int((~owned).sum()))
+                vecs, ids = vecs[owned], ids[owned]
+                assign = assign[owned]
+            n = vecs.shape[0]
+            if not n:
+                return 0
+            need = self._raw_rows + n
+            if need > self._raw.shape[0]:
+                grow = max(need, int(self._raw.shape[0] * 1.5),
+                           self._raw.shape[0] + 1024)
+                nb = np.empty((grow, self.dim), np.float32)
+                nb[: self._raw_rows] = self._raw[: self._raw_rows]
+                self._raw = nb
+                # Locators live in the coded lists; rebase them onto
+                # the grown array BEFORE the new rows publish.
+                coded.replace_source(0, self._raw)
+            start = self._raw_rows
+            self._raw[start: need] = vecs
+            self._raw_rows = need
+            coded.append_assigned(
+                assign, ids, coded.codec.encode(vecs), 0,
+                np.arange(start, need, dtype=np.int32))
+            return n
+
+    def search(self, queries: np.ndarray, k: int,
+               nprobe: int | None = None) -> tuple[np.ndarray,
+                                                   np.ndarray]:
+        coded = self._coded
+        if coded is None or coded.rows == 0:
+            q = np.asarray(queries, np.float32)
+            nq = q.shape[0] if q.ndim > 1 else 1
+            return (np.full((nq, k), -1, np.int64),
+                    np.full((nq, k), -np.inf, np.float32))
+        return batched_scan(coded, queries, int(k),
+                            int(nprobe or self.nprobe),
+                            rerank=max(512, 4 * int(k)))
+
+
+class ShardServer:
+    """Stdlib HTTP front end over one ``IndexShard``.
+
+    ``POST /shard/init`` installs centroids+codec, ``POST
+    /shard/insert`` indexes owned rows, ``POST /shard/search`` answers
+    ``{ids, scores}``, ``GET /healthz`` reports liveness+rows. One
+    process per shard in production; tests run several in-process."""
+
+    def __init__(self, dim: int, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.shard = IndexShard(dim)
+        self.host, self.port = host, int(port)
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "ShardServer":
+        shard = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802 — stdlib name
+                pass
+
+            def _reply(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    s = shard.shard
+                    self._reply(200, {"ok": True, "rows": s.rows,
+                                      "trained": s.trained,
+                                      "shard": s.shard_id,
+                                      "misrouted": s.misrouted})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):  # noqa: N802
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    if n > _MAX_BODY:
+                        self._reply(413, {"error": "body too large"})
+                        return
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    if self.path == "/shard/init":
+                        shard.shard.init_plane(
+                            _unpack(req["centroids"]),
+                            PQCodec.from_wire(req["codec"]),
+                            int(req["shard_id"]),
+                            int(req["n_shards"]),
+                            int(req.get("nprobe", 8)))
+                        self._reply(200, {"ok": True})
+                    elif self.path == "/shard/insert":
+                        took = shard.shard.insert(
+                            np.asarray(req["ids"], np.int64),
+                            _unpack(req["vectors"]))
+                        self._reply(200, {"stored": took})
+                    elif self.path == "/shard/search":
+                        ids, scores = shard.shard.search(
+                            _unpack(req["queries"]),
+                            int(req.get("k", 10)),
+                            req.get("nprobe"))
+                        self._reply(200, {
+                            "ids": ids.tolist(),
+                            "scores": [[float(s) if np.isfinite(s)
+                                        else None for s in row]
+                                       for row in scores]})
+                    else:
+                        self._reply(404, {"error": "not found"})
+                except Exception as e:  # noqa: BLE001 — a bad payload
+                    # must answer 400, never drop the connection.
+                    self._reply(400, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"shard-{self.port}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+
+class ShardClient:
+    """One shard endpoint with failure memory: a refused/timed-out
+    call marks the shard dead for ``cooldown_s`` so a fan-out isn't
+    taxed a connect timeout per query per dead shard; after the
+    cooldown the next call retries it (a restarted shard rejoins by
+    answering)."""
+
+    def __init__(self, url: str, timeout_s: float = 5.0,
+                 cooldown_s: float = 2.0):
+        self.url = url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        self.cooldown_s = float(cooldown_s)
+        self._dead_until = 0.0
+        self.failures = 0
+
+    @property
+    def available(self) -> bool:
+        return time.monotonic() >= self._dead_until
+
+    def call(self, path: str, payload: dict | None = None,
+             timeout_s: float | None = None) -> dict | None:
+        """POST (or GET when ``payload`` is None); None on any
+        transport/HTTP failure — the caller degrades, never raises."""
+        if not self.available:
+            return None
+        try:
+            if payload is None:
+                req = urllib.request.Request(self.url + path)
+            else:
+                req = urllib.request.Request(
+                    self.url + path,
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(
+                    req, timeout=timeout_s or self.timeout_s) as resp:
+                out = json.loads(resp.read())
+            self._dead_until = 0.0
+            return out
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            self.failures += 1
+            self._dead_until = time.monotonic() + self.cooldown_s
+            logger.warning("shard %s unreachable (%s) — cooling down "
+                           "%.1fs", self.url, e, self.cooldown_s)
+            return None
+
+
+class ShardFanout:
+    """Coordinator: central training, owner-routed inserts, merged
+    fan-out searches.
+
+    ``registry`` (optional MetricsRegistry) exports the plane's
+    health: per-shard row gauges, degraded-search and dropped-insert
+    counters — the difference between "recall quietly sagged" and a
+    page."""
+
+    def __init__(self, urls, dim: int | None = None,
+                 train_rows: int = 4096, n_centroids: int = 64,
+                 nprobe: int = 8, pq_m: int = 8,
+                 registry=None, seed: int = 0,
+                 timeout_s: float = 5.0):
+        self.clients = [ShardClient(u, timeout_s=timeout_s)
+                        for u in urls]
+        self.dim = int(dim) if dim is not None else None
+        self.train_rows = max(1, int(train_rows))
+        self.n_centroids = max(1, int(n_centroids))
+        self.nprobe = max(1, int(nprobe))
+        self.pq_m = max(1, int(pq_m))
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(2, len(self.clients)),
+            thread_name_prefix="shard-fanout")
+        self.centroids: np.ndarray | None = None
+        self.codec: PQCodec | None = None
+        # Pre-training buffer: (ids, rows) pairs, brute-forced by
+        # searches until the plane trains.
+        self._buf_ids: list[np.ndarray] = []
+        self._buf_rows: list[np.ndarray] = []
+        self._buf_n = 0
+        self.inserted = 0
+        self.dropped = 0
+        self.degraded_searches = 0
+        # Standalone id allocator (no IndexManager in front): plane-
+        # local monotonic ids. NOT durable — a bare shard plane is a
+        # cache of the fleet's embeddings, not a system of record.
+        self._next_id = 0
+        self._m = None
+        if registry is not None:
+            self._m = {
+                "alive": registry.gauge(
+                    "retrieval_shards_alive",
+                    "shard endpoints answering"),
+                "total": registry.gauge(
+                    "retrieval_shards_total",
+                    "shard endpoints configured"),
+                "degraded": registry.counter(
+                    "retrieval_shard_degraded_searches_total",
+                    "searches answered with >=1 shard missing"),
+                "dropped": registry.counter(
+                    "retrieval_shard_dropped_rows_total",
+                    "insert rows lost to dead shards"),
+            }
+            self._m["total"].set(len(self.clients))
+
+    @property
+    def trained(self) -> bool:
+        return self.centroids is not None
+
+    # -- training ------------------------------------------------------------
+    def _train_and_flush_locked(self) -> None:
+        rows = np.concatenate(self._buf_rows)
+        ids = np.concatenate(self._buf_ids)
+        self.centroids = kmeans(rows, self.n_centroids, seed=self.seed)
+        self.codec = PQCodec(self.dim, m=self.pq_m,
+                             seed=self.seed).train(rows)
+        wire = {"centroids": _pack(self.centroids),
+                "codec": self.codec.to_wire(),
+                "n_shards": len(self.clients),
+                "nprobe": self.nprobe}
+        inited = []
+        for sid, cl in enumerate(self.clients):
+            got = cl.call("/shard/init", dict(wire, shard_id=sid))
+            if got is not None and got.get("ok"):
+                inited.append(sid)
+        logger.info("shard plane trained: %d centroids, pq m=%d, "
+                    "%d/%d shard(s) initialized",
+                    self.centroids.shape[0], self.codec.m,
+                    len(inited), len(self.clients))
+        self._buf_ids, self._buf_rows, self._buf_n = [], [], 0
+        self._route_locked(ids, rows)
+
+    def _route_locked(self, ids: np.ndarray, vecs: np.ndarray) -> None:
+        """Owner-routed insert push: rows grouped per shard, one
+        ``/shard/insert`` each (parallel). A dead owner's rows are
+        DROPPED and counted — the plane stays available and the loss
+        is visible, the recall contract (degraded, never down) over
+        durability for rows in flight."""
+        assign = np.argmax(vecs @ self.centroids.T, axis=1)
+        owner = shard_owner(assign, len(self.clients))
+        futs = []
+        for sid in np.unique(owner):
+            mask = owner == sid
+            cl = self.clients[int(sid)]
+            payload = {"ids": ids[mask].tolist(),
+                       "vectors": _pack(vecs[mask])}
+            futs.append((int(mask.sum()), self._pool.submit(
+                cl.call, "/shard/insert", payload)))
+        for n, fut in futs:
+            got = fut.result()
+            if got is None:
+                self.dropped += n
+                if self._m:
+                    self._m["dropped"].inc(n)
+            else:
+                self.inserted += int(got.get("stored", 0))
+
+    # -- data path -----------------------------------------------------------
+    def insert(self, ids, vectors) -> int:
+        vecs = np.asarray(vectors, np.float32)
+        if vecs.ndim == 1:
+            vecs = vecs[None]
+        ids = np.asarray(ids, np.int64)
+        with self._lock:
+            if self.dim is None:
+                self.dim = int(vecs.shape[1])
+            elif int(vecs.shape[1]) != self.dim:
+                logger.warning("shard fanout: insert rejected — dim %d "
+                               "!= plane dim %d", vecs.shape[1],
+                               self.dim)
+                return 0
+            if self.centroids is None:
+                self._buf_ids.append(ids)
+                self._buf_rows.append(vecs)
+                self._buf_n += vecs.shape[0]
+                if self._buf_n >= self.train_rows:
+                    self._train_and_flush_locked()
+                return int(vecs.shape[0])
+            self._route_locked(ids, vecs)
+        return int(vecs.shape[0])
+
+    def insert_auto(self, vectors) -> list[int]:
+        """Insert with plane-allocated ids (routers without a local
+        ``IndexManager``); returns the assigned ids."""
+        vecs = np.asarray(vectors, np.float32)
+        if vecs.ndim == 1:
+            vecs = vecs[None]
+        with self._lock:
+            ids = list(range(self._next_id,
+                             self._next_id + vecs.shape[0]))
+            self._next_id += vecs.shape[0]
+        got = self.insert(np.asarray(ids, np.int64), vecs)
+        return ids if got else []
+
+    def search(self, queries, k: int = 10) -> dict:
+        """Fan out + merge. ``{ids, scores, shards: {ok, total,
+        degraded}, rows}`` — ids/scores numpy ``[Q, k]`` padded with
+        -1/-inf like every scan in this package."""
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None]
+        nq = q.shape[0]
+        with self._lock:
+            trained = self.centroids is not None
+            if not trained and self._buf_n:
+                ids_cat = np.concatenate(self._buf_ids)
+                rows_cat = np.concatenate(self._buf_rows)
+            else:
+                ids_cat = rows_cat = None
+        total = len(self.clients)
+        if not trained:
+            if rows_cat is None:
+                return {"ids": np.full((nq, k), -1, np.int64),
+                        "scores": np.full((nq, k), -np.inf,
+                                          np.float32),
+                        "shards": {"ok": total, "total": total,
+                                   "degraded": False},
+                        "rows": 0}
+            ids_out, scores_out = brute_force_topk(
+                q, ids_cat, rows_cat, k)
+            return {"ids": ids_out, "scores": scores_out,
+                    "shards": {"ok": total, "total": total,
+                               "degraded": False},
+                    "rows": int(rows_cat.shape[0])}
+        payload = {"queries": _pack(q), "k": int(k),
+                   "nprobe": self.nprobe}
+        futs = [self._pool.submit(cl.call, "/shard/search", payload)
+                for cl in self.clients]
+        per_shard = [f.result() for f in futs]
+        ok = sum(1 for r in per_shard if r is not None)
+        degraded = ok < total
+        out_ids = np.full((nq, k), -1, np.int64)
+        out_scores = np.full((nq, k), -np.inf, np.float32)
+        cand_ids: list[list] = [[] for _ in range(nq)]
+        cand_scores: list[list] = [[] for _ in range(nq)]
+        for r in per_shard:
+            if r is None:
+                continue
+            for i, (row_ids, row_scores) in enumerate(
+                    zip(r["ids"], r["scores"])):
+                for rid, rs in zip(row_ids, row_scores):
+                    if rid >= 0 and rs is not None:
+                        cand_ids[i].append(rid)
+                        cand_scores[i].append(rs)
+        for i in range(nq):
+            if not cand_ids[i]:
+                continue
+            ids_arr = np.asarray(cand_ids[i], np.int64)
+            sc_arr = np.asarray(cand_scores[i], np.float32)
+            kk = min(k, ids_arr.shape[0])
+            top = np.argpartition(sc_arr, -kk)[-kk:]
+            top = top[np.argsort(sc_arr[top])[::-1]]
+            out_ids[i, :kk] = ids_arr[top]
+            out_scores[i, :kk] = sc_arr[top]
+        if degraded:
+            self.degraded_searches += 1
+            if self._m:
+                self._m["degraded"].inc()
+        if self._m:
+            self._m["alive"].set(ok)
+        return {"ids": out_ids, "scores": out_scores,
+                "shards": {"ok": ok, "total": total,
+                           "degraded": degraded},
+                "rows": self.inserted}
+
+    def snapshot(self) -> dict:
+        health = []
+        for cl in self.clients:
+            got = cl.call("/healthz")
+            health.append({"url": cl.url,
+                           "alive": got is not None,
+                           **({k: got[k] for k in
+                               ("rows", "trained", "shard")}
+                              if got else {})})
+        return {"trained": self.trained,
+                "n_shards": len(self.clients),
+                "inserted": self.inserted,
+                "dropped": self.dropped,
+                "degraded_searches": self.degraded_searches,
+                "buffered": self._buf_n,
+                "shards": health}
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)

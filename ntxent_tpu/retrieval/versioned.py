@@ -29,26 +29,46 @@ that docstore is the rebuild source. Past the bound the oldest rows
 are evicted (counted; a rebuild then covers the retained tail only —
 logged, never silent).
 
+The docstore is DURABLE when the manager is rooted (ISSUE 17): rows
+append to ``docstore.log`` (flushed per insert, fsync'd on maintenance
+ticks and ``stop``), evictions advance a watermark in
+``docstore.json``, and the log compacts by the same stage-fsync-rename
+idiom as the segments once dead records outgrow live ones. Together
+with the per-version codec/centroid snapshots (index.py) a restarted
+router reopens a TRAINED index with its rebuild source intact —
+zero re-clustering, zero re-embedding.
+
 JAX-free like everything under ``retrieval/``: the lint boundary and
 the fleet tripwire both pin it.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
+import struct
 import threading
 import time
+import uuid
 from collections import OrderedDict
+from pathlib import Path
 
 import numpy as np
 
 from ..obs import events as _events
 from ..obs.registry import MetricsRegistry
 from .index import RetrievalMetrics, VectorIndex
+from .segments import _fsync_path
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["IndexManager"]
+
+_DOC_LOG = "docstore.log"
+_DOC_META = "docstore.json"
+# One log record: id, ndim, ndim int32 dims, then the f32 payload.
+_REC_HEAD = struct.Struct("<qB")
 
 
 class IndexManager:
@@ -86,9 +106,29 @@ class IndexManager:
         self._next_id = 0
         # id -> input row (np.float32), insertion-ordered for eviction.
         self._docstore: OrderedDict[int, np.ndarray] = OrderedDict()
+        # Durable docstore state (rooted managers only): the open
+        # append handle, the eviction watermark (smallest retained
+        # id), and the dead-record count that triggers log compaction.
+        self._doc_f = None
+        self._doc_watermark = 0
+        self._doc_dead = 0
+        # Compaction only pays off past a floor of dead records — a
+        # tiny store must not rewrite its log every few evictions.
+        self._doc_compact_floor = 1024
         # Installed by the router: fn(inputs [N, ...]) -> embeddings
         # [N, dim] or None on failure. Called on the rebuild thread.
         self.reembed = None
+        # Installed by the fleet plane (ISSUE 17 satellite): a
+        # callable -> bool consulted per maintenance tick. False
+        # defers the DEFERRABLE work (compaction, docstore log
+        # compaction) to an idle window — the autoscaler's idle
+        # detector is the intended source. Bounded: after
+        # ``heavy_defer_ticks`` consecutive deferrals the work runs
+        # anyway (a permanently busy fleet must not grow segments
+        # forever).
+        self.heavy_gate = None
+        self.heavy_defer_ticks = 30
+        self._heavy_deferred = 0
         self._rebuild_thread: threading.Thread | None = None
         self._maint_thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -99,6 +139,7 @@ class IndexManager:
         self._last_probe_rows = -1
         if self.root is not None:
             self._reopen()
+            self._reopen_docstore()
 
     def _reopen(self) -> None:
         """Adopt prior runs' persisted segments (``--index-dir`` must
@@ -108,8 +149,9 @@ class IndexManager:
         maximum so new inserts can never collide); every other
         ``g-*`` dir is a crash/replacement orphan and is deleted —
         without this, restarts leaked every prior instance's segments
-        forever. The docstore does not persist (ROADMAP follow-up), so
-        a post-restart rebuild covers newly inserted rows only."""
+        forever. The docstore replays separately
+        (``_reopen_docstore``), so a post-restart rebuild covers the
+        retained input rows, not just newly inserted ones."""
         import json as _json
         import os
         import shutil
@@ -212,6 +254,177 @@ class IndexManager:
         for step, idx in sorted(adoptions, key=lambda si: si[0]):
             self._versions[step] = idx
         self._next_id = max_id + 1
+
+    # -- durable docstore --------------------------------------------------
+    def _reopen_docstore(self) -> None:
+        """Replay ``docstore.log`` into the in-memory docstore and open
+        it for append. Records below the persisted watermark (already
+        evicted) are skipped; a truncated tail (crash mid-append) is
+        dropped AND truncated off the file — appending past garbage
+        would poison every future replay at the same offset. Ids resume
+        past the persisted maximum so restarts never re-issue one."""
+        root = Path(str(self.root))
+        try:
+            root.mkdir(parents=True, exist_ok=True)
+        except OSError:
+            return
+        watermark = 0
+        try:
+            watermark = int(json.loads(
+                (root / _DOC_META).read_text()).get("watermark", 0))
+        except (OSError, ValueError, TypeError, AttributeError):
+            watermark = 0
+        log_p = root / _DOC_LOG
+        try:
+            blob = log_p.read_bytes()
+        except OSError:
+            blob = b""
+        off, n = 0, len(blob)
+        replayed = dead = 0
+        while off + _REC_HEAD.size <= n:
+            rid, ndim = _REC_HEAD.unpack_from(blob, off)
+            dims_end = off + _REC_HEAD.size + 4 * ndim
+            if ndim == 0 or dims_end > n:
+                break
+            dims = np.frombuffer(blob, np.int32, ndim,
+                                 off + _REC_HEAD.size)
+            count = int(np.prod(dims))
+            rec_end = dims_end + 4 * count
+            if count <= 0 or rec_end > n:
+                break
+            if rid >= watermark:
+                # Ids are monotonic and the log is append-ordered, so
+                # plain assignment preserves eviction order.
+                self._docstore[rid] = np.frombuffer(
+                    blob, np.float32, count, dims_end).reshape(
+                        tuple(int(d) for d in dims)).copy()
+                replayed += 1
+            else:
+                dead += 1
+            off = rec_end
+        if off < n:
+            logger.warning("retrieval: docstore.log truncated tail "
+                           "dropped (%d byte(s))", n - off)
+            try:
+                with open(log_p, "r+b") as f:
+                    f.truncate(off)
+                    f.flush()
+                    os.fsync(f.fileno())
+            except OSError:
+                pass
+        while len(self._docstore) > self.docstore_rows:
+            self._docstore.popitem(last=False)
+            dead += 1
+        self._doc_watermark = next(iter(self._docstore)) \
+            if self._docstore else watermark
+        self._doc_dead = dead
+        if self._docstore:
+            self._next_id = max(self._next_id,
+                                max(self._docstore) + 1)
+        try:
+            self._doc_f = open(log_p, "ab")
+        except OSError:
+            self._doc_f = None
+        if replayed:
+            self.metrics.op("docstore_replay")
+            _events.emit("index", action="docstore_replay",
+                         rows=replayed, dead=dead)
+            logger.info("retrieval: docstore replayed %d row(s) "
+                        "(%d dead) from %s", replayed, dead, log_p)
+
+    def _doc_append(self, ids, rows) -> None:
+        """Append rows to the log (flushed, not fsync'd — maintenance
+        ticks and ``stop`` pay the fsync). Callers hold ``_lock``, so
+        appends serialize and stay id-ordered."""
+        if self._doc_f is None:
+            return
+        try:
+            buf = bytearray()
+            for i, row in zip(ids, rows):
+                r = np.ascontiguousarray(row, np.float32)
+                buf += _REC_HEAD.pack(int(i), r.ndim)
+                buf += np.asarray(r.shape, np.int32).tobytes()
+                buf += r.tobytes()
+            self._doc_f.write(bytes(buf))
+            self._doc_f.flush()
+        except (OSError, ValueError):
+            logger.exception("retrieval: docstore append failed — "
+                             "rows stay in memory only")
+
+    def _doc_sync(self) -> None:
+        f = self._doc_f
+        if f is None:
+            return
+        try:
+            f.flush()
+            os.fsync(f.fileno())
+        except (OSError, ValueError):
+            pass
+
+    def _write_doc_meta(self, watermark: int) -> None:
+        root = Path(str(self.root))
+        tmp = root / f".{_DOC_META}.tmp-{uuid.uuid4().hex[:8]}"
+        try:
+            tmp.write_text(json.dumps({"watermark": int(watermark)}))
+            with open(tmp, "rb") as f:
+                os.fsync(f.fileno())
+            os.replace(tmp, root / _DOC_META)
+            _fsync_path(root)
+        except OSError:
+            logger.exception("retrieval: docstore watermark write "
+                             "failed")
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+
+    def _doc_compact(self) -> None:
+        """Rewrite the log with only the live rows (stage-fsync-rename,
+        same idiom as the segments) and persist the watermark. Holds
+        ``_lock`` for the rewrite so no insert can append to the handle
+        being swapped out — the hold is bounded by ``docstore_rows``
+        worth of sequential writes."""
+        if self.root is None:
+            return
+        root = Path(str(self.root))
+        tmp = root / f".{_DOC_LOG}.tmp-{uuid.uuid4().hex[:8]}"
+        with self._lock:
+            try:
+                with open(tmp, "wb") as f:
+                    for i, row in self._docstore.items():
+                        r = np.ascontiguousarray(row, np.float32)
+                        f.write(_REC_HEAD.pack(int(i), r.ndim))
+                        f.write(np.asarray(r.shape,
+                                           np.int32).tobytes())
+                        f.write(r.tobytes())
+                    f.flush()
+                    os.fsync(f.fileno())
+                if self._doc_f is not None:
+                    try:
+                        self._doc_f.close()
+                    except OSError:
+                        pass
+                os.replace(tmp, root / _DOC_LOG)
+                _fsync_path(root)
+                self._doc_f = open(root / _DOC_LOG, "ab")
+                self._doc_dead = 0
+                watermark = next(iter(self._docstore)) \
+                    if self._docstore else self._next_id
+                self._doc_watermark = watermark
+                rows = len(self._docstore)
+            except OSError:
+                logger.exception("retrieval: docstore compaction "
+                                 "failed")
+                try:
+                    tmp.unlink()
+                except OSError:
+                    pass
+                return
+        self._write_doc_meta(watermark)
+        self.metrics.op("docstore_compact")
+        _events.emit("index", action="docstore_compact", rows=rows)
+        logger.info("retrieval: docstore log compacted to %d live "
+                    "row(s)", rows)
 
     # -- version plumbing --------------------------------------------------
     def _index_root(self, step: int):
@@ -443,10 +656,18 @@ class IndexManager:
             self._next_id += x.shape[0]
             for i, row in zip(ids, x):
                 self._docstore[i] = np.array(row, np.float32)
+            self._doc_append(ids, x)
             evicted = 0
             while len(self._docstore) > self.docstore_rows:
                 self._docstore.popitem(last=False)
                 evicted += 1
+            if evicted:
+                # Evicted rows become dead log records; the watermark
+                # (smallest retained id) filters them out of a replay
+                # even before the next compaction rewrites the log.
+                self._doc_dead += evicted
+                self._doc_watermark = next(iter(self._docstore)) \
+                    if self._docstore else self._next_id
             # Under the lock: a rebuild's version swap racing this
             # insert would otherwise receive the rows into the
             # about-to-be-orphaned instance — 200 with ids that never
@@ -608,8 +829,33 @@ class IndexManager:
 
     # -- maintenance / publishing -----------------------------------------
     def maintain(self) -> bool:
+        # Heavy work (segment compaction, docstore log compaction) is
+        # deferrable: when the fleet plane installed ``heavy_gate`` and
+        # it reports busy, defer — bounded by ``heavy_defer_ticks``, so
+        # a permanently busy fleet still compacts eventually. Seals and
+        # training are NOT gated: they bound the exact-scan tail and
+        # must track the insert rate.
+        heavy = True
+        if self.heavy_gate is not None:
+            try:
+                idle = bool(self.heavy_gate())
+            except Exception:  # noqa: BLE001 — a broken gate must not
+                # stall maintenance forever.
+                idle = True
+            if idle:
+                self._heavy_deferred = 0
+            elif self._heavy_deferred < self.heavy_defer_ticks:
+                self._heavy_deferred += 1
+                heavy = False
+                self.metrics.op("heavy_defer")
+            else:
+                logger.info("retrieval: heavy maintenance forced "
+                            "through after %d deferred tick(s)",
+                            self._heavy_deferred)
+                self.metrics.op("heavy_forced")
+                self._heavy_deferred = 0
         idx = self.active()
-        did = idx.maintain() if idx is not None else False
+        did = idx.maintain(heavy=heavy) if idx is not None else False
         if idx is not None and idx.trained:
             # The probe materializes every stored vector for its
             # brute-force ground truth — neither an idle index nor a
@@ -622,6 +868,13 @@ class IndexManager:
                     or rows - last >= max(1, last // 10):
                 idx.recall_probe()
                 self._last_probe_rows = rows
+        if heavy and self._doc_f is not None:
+            self._doc_sync()
+            with self._lock:
+                live = len(self._docstore)
+                dead = self._doc_dead
+            if dead > max(live, self._doc_compact_floor):
+                self._doc_compact()
         self.publish()
         return did
 
@@ -641,6 +894,8 @@ class IndexManager:
         if idx is not None:
             m.rows.set(idx.rows)
             m.segments.set(idx.store.segment_count)
+            m.index_bytes.set(idx.resident_bytes())
+            m.bytes_per_row.set(idx.scan_bytes_per_row())
 
     def _maint_loop(self) -> None:
         while not self._stop.wait(self.maintain_interval_s):
@@ -665,12 +920,30 @@ class IndexManager:
         if self._maint_thread is not None:
             self._maint_thread.join(self.maintain_interval_s * 4 + 5.0)
             self._maint_thread = None
+        # Close out the docstore log: fsync what the last flush left
+        # in the page cache and persist the eviction watermark so the
+        # next replay skips the dead prefix.
+        if self._doc_f is not None:
+            self._doc_sync()
+            try:
+                self._doc_f.close()
+            except OSError:
+                pass
+            self._doc_f = None
+            self._write_doc_meta(self._doc_watermark)
 
     def snapshot(self) -> dict:
         with self._lock:
             versions = {
                 str(step): ({"rows": idx.rows, "trained": idx.trained,
-                             "segments": idx.store.segment_count}
+                             "segments": idx.store.segment_count,
+                             "bytes": int(idx.resident_bytes()),
+                             "bytes_per_row":
+                                 round(idx.scan_bytes_per_row(), 2),
+                             "pq_m": (idx._codec.m
+                                      if idx._codec is not None else 0),
+                             "from_snapshot":
+                                 bool(idx.trained_from_snapshot)}
                             if idx is not None
                             else {"rows": 0, "trained": False,
                                   "segments": 0})
@@ -680,5 +953,7 @@ class IndexManager:
                     "prior_step": self._prior_step,
                     "stale": self._stale_reason,
                     "docstore_rows": len(self._docstore),
+                    "docstore_durable": self._doc_f is not None,
+                    "docstore_watermark": self._doc_watermark,
                     "next_id": self._next_id,
                     "versions": versions}

@@ -880,6 +880,7 @@ class FleetRouter:
         # router (tests, bench) behaves exactly as before.
         self.run_id: str | None = None
         self.index = None           # retrieval.IndexManager (attach_index)
+        self.shards = None          # retrieval.ShardFanout (attach_shards)
         self.shadow = None          # ShadowMirror (attach_shadow)
         self.admission = None       # TenantAdmission (ISSUE 16)
         self.aggregator = None      # obs.FleetAggregator -> /metrics/fleet
@@ -914,6 +915,17 @@ class FleetRouter:
             # Attached after the fleet already adopted: the index must
             # version against the step actually serving.
             manager.activate(self.pool.trusted_step)
+
+    def attach_shards(self, fanout) -> None:
+        """Wire a ``retrieval.ShardFanout`` (ISSUE 17): ``POST
+        /search`` fans out to the shard plane and merges top-k; a dead
+        shard degrades recall (``shards.degraded`` in the payload),
+        never availability. The plane is unversioned — when an
+        ``IndexManager`` is ALSO attached it stays the id/docstore
+        authority and the shards mirror its inserts; wiring the
+        rollout state machine through the fan-out is a ROADMAP
+        follow-up."""
+        self.shards = fanout
 
     def _on_trusted_adopt(self, step: int) -> None:
         if self.cache is not None:
@@ -1429,12 +1441,17 @@ def _make_router_handler(router: FleetRouter):
                 self._reply(200, router.alerts.snapshot())
             elif route == "/index":
                 # Retrieval-tier state: versions, active step,
-                # staleness, docstore depth (ISSUE 15).
-                if router.index is None:
+                # staleness, docstore depth (ISSUE 15); with a shard
+                # plane attached, its per-shard health rides along.
+                if router.index is None and router.shards is None:
                     self._reply(503, {"error": "no retrieval index "
                                                "attached"})
                 else:
-                    self._reply(200, router.index.snapshot())
+                    snap = router.index.snapshot() \
+                        if router.index is not None else {}
+                    if router.shards is not None:
+                        snap["shard_plane"] = router.shards.snapshot()
+                    self._reply(200, snap)
             else:
                 self._reply(404, {"error": f"no route {self.path!r}"})
 
@@ -1557,8 +1574,11 @@ def _make_router_handler(router: FleetRouter):
 
         def _do_search(self, reply, rid, body, status) -> None:
             """POST /search {"inputs": ..., "k": N}: embed through the
-            fleet, answer top-k from the step-matched index version."""
-            if router.index is None:
+            fleet, answer top-k from the step-matched index version —
+            or, when a shard plane is attached, fan out and merge
+            (degraded beats down: a dead shard drops its lists' rows,
+            the response says so, and the status stays 200)."""
+            if router.index is None and router.shards is None:
                 reply(503, {"error": "no retrieval index attached "
                                      "(start the fleet with "
                                      "--index-dir)"})
@@ -1594,6 +1614,23 @@ def _make_router_handler(router: FleetRouter):
             if code != 200 or emb is None:
                 reply(code, payload, headers)
                 return
+            if router.shards is not None:
+                # Shard plane: every shard probes the same global
+                # top-nprobe lists and contributes the ones it owns,
+                # so the merged answer equals the unsharded scan when
+                # all shards report — and shrinks by exactly the dead
+                # shards' lists when they don't.
+                res = router.shards.search(emb, k=k)
+                reply(200, {
+                    "ids": res["ids"].tolist(),
+                    "scores": [[float(s) if np.isfinite(s) else None
+                                for s in row]
+                               for row in res["scores"]],
+                    "k": k, "rows": int(x.shape[0]),
+                    "index_rows": res["rows"],
+                    "shards": res["shards"],
+                    "served_step": served_step})
+                return
             index_dim = router.index.dim
             if index_dim is not None and emb.shape[-1] != index_dim:
                 # Fleet/index width skew (a changed --proj-dim rolled
@@ -1623,7 +1660,7 @@ def _make_router_handler(router: FleetRouter):
             insert is trust-gated (same rule as cache inserts); a gated
             request still answers 200 with stored=0 — rollout windows
             are normal operation, not client errors."""
-            if router.index is None:
+            if router.index is None and router.shards is None:
                 reply(503, {"error": "no retrieval index attached "
                                      "(start the fleet with "
                                      "--index-dir)"})
@@ -1643,7 +1680,8 @@ def _make_router_handler(router: FleetRouter):
             ids = self._index_store(x, emb, served_step)
             out = {"stored": len(ids), "ids": ids,
                    "rows": int(x.shape[0]),
-                   "index_step": router.index.active_step,
+                   "index_step": (router.index.active_step
+                                  if router.index is not None else None),
                    "served_step": served_step}
             if not ids:
                 out["reason"] = "not_trusted"
@@ -1652,20 +1690,36 @@ def _make_router_handler(router: FleetRouter):
         def _index_store(self, x, emb, served_step) -> list:
             """Trust-gated index insert; [] when gated, unattached, or
             rejected (wrong step/dim). Never raises — a bad payload
-            must degrade to stored:0, not drop the connection."""
-            if router.index is None:
+            must degrade to stored:0, not drop the connection. With a
+            shard plane attached the rows ALSO fan out to their owner
+            shards (the IndexManager, when present, stays the id
+            authority; a bare shard plane allocates its own)."""
+            if router.index is None and router.shards is None:
                 return []
             if not pool.allow_cache_insert(served_step):
                 return []
             step = served_step if served_step is not None \
                 else pool.trusted_step
+            ids: list = []
             try:
-                return router.index.insert(x, emb, step=step)
+                if router.index is not None:
+                    ids = router.index.insert(x, emb, step=step)
             except Exception:  # noqa: BLE001 — the embed already
                 # succeeded; an index-side failure must not turn a
                 # 200 into a dropped connection.
                 logger.exception("index insert failed")
-                return []
+                ids = []
+            if router.shards is not None:
+                try:
+                    if router.index is None:
+                        ids = router.shards.insert_auto(emb)
+                    elif ids:
+                        router.shards.insert(
+                            np.asarray(ids, np.int64), emb)
+                except Exception:  # noqa: BLE001 — same contract as
+                    # the local-index failure above.
+                    logger.exception("shard insert failed")
+            return ids
 
         def _admit(self, reply, cost: int) -> bool:
             """Per-tenant admission check (no-op without a configured

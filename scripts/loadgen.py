@@ -243,23 +243,33 @@ def run_load(url: str, schedule: RateSchedule, keys: ZipfKeys,
              max_outstanding: int = 64,
              timeout_s: float = 30.0,
              route: str = "/embed",
-             search_k: int = 10) -> dict:
+             search_k: int = 10,
+             search_fraction: float = 0.0) -> dict:
     """Drive one open-loop replay; blocks until the last in-flight
-    request lands. Returns the summary dict (see ``summarize``)."""
+    request lands. Returns the summary dict (see ``summarize``).
+
+    ``search_fraction`` (ISSUE 17) mixes retrieval into the stream:
+    each arrival flips a coin and becomes a ``POST /search`` with that
+    probability (Zipf keys apply to both, so hot queries hit both the
+    embed cache AND the same probed IVF lists — the regime the fused
+    batched scan exists for). ``route="/search"`` still forces 100 %."""
     arrivals = arrival_times(schedule, rng)
     sem = threading.Semaphore(int(max_outstanding))
     lock = threading.Lock()
-    results: list[tuple[float, str, str, float]] = []  # (t, status,
-    #                                                     tenant, ms)
+    # (t, status, tenant, ms, route)
+    results: list[tuple[float, str, str, float, str]] = []
     shed = 0
     threads: list[threading.Thread] = []
-    target = url.rstrip("/") + route
+    base = url.rstrip("/")
+    search_fraction = 1.0 if route == "/search" \
+        else min(1.0, max(0.0, float(search_fraction)))
 
-    def _fire(offset: float, tenant: str, body: bytes) -> None:
+    def _fire(offset: float, tenant: str, body: bytes,
+              target_route: str) -> None:
         nonlocal shed
         t0 = time.monotonic()
         req = urllib.request.Request(
-            target, data=body, method="POST",
+            base + target_route, data=body, method="POST",
             headers={"Content-Type": "application/json",
                      "X-Tenant": tenant})
         try:
@@ -276,7 +286,7 @@ def run_load(url: str, schedule: RateSchedule, keys: ZipfKeys,
             status = "unreachable"
         ms = (time.monotonic() - t0) * 1e3
         with lock:
-            results.append((offset, status, tenant, ms))
+            results.append((offset, status, tenant, ms, target_route))
         sem.release()
 
     start = time.monotonic()
@@ -286,12 +296,16 @@ def run_load(url: str, schedule: RateSchedule, keys: ZipfKeys,
             time.sleep(delay)
         tenant = tenants.pick()
         key = keys.pick()
-        if route == "/search":
+        is_search = search_fraction > 0.0 \
+            and rng.random() < search_fraction
+        if is_search:
             obj = json.loads(keys.payload(key))
             obj["k"] = search_k
             body = json.dumps(obj).encode()
+            target_route = "/search"
         else:
             body = keys.payload(key)
+            target_route = route if route != "/search" else "/embed"
         if not sem.acquire(blocking=False):
             # Open loop: past the outstanding cap the arrival is shed
             # CLIENT-side and counted — blocking here would make later
@@ -299,7 +313,8 @@ def run_load(url: str, schedule: RateSchedule, keys: ZipfKeys,
             with lock:
                 shed += 1
             continue
-        t = threading.Thread(target=_fire, args=(offset, tenant, body),
+        t = threading.Thread(target=_fire,
+                             args=(offset, tenant, body, target_route),
                              daemon=True)
         t.start()
         threads.append(t)
@@ -309,21 +324,25 @@ def run_load(url: str, schedule: RateSchedule, keys: ZipfKeys,
     return summarize(results, shed, len(arrivals), wall_s, schedule)
 
 
-def summarize(results: list[tuple[float, str, str, float]], shed: int,
-              offered: int, wall_s: float,
+def summarize(results: list[tuple[float, str, str, float, str]],
+              shed: int, offered: int, wall_s: float,
               schedule: RateSchedule) -> dict:
-    """Aggregate one run: status counts, per-tenant outcomes, latency
-    percentiles, empirical-vs-driven rate, and a per-second timeline
-    (offered arrivals and worst latency per one-second bucket)."""
+    """Aggregate one run: status counts, per-route and per-tenant
+    outcomes, latency percentiles, empirical-vs-driven rate, and a
+    per-second timeline (offered arrivals and worst latency per
+    one-second bucket)."""
     status_counts: dict[str, int] = {}
     tenant_counts: dict[str, dict[str, int]] = {}
+    route_counts: dict[str, dict[str, int]] = {}
     latencies: list[float] = []
     ok_latencies: list[float] = []
     timeline: dict[int, dict] = {}
-    for offset, status, tenant, ms in results:
+    for offset, status, tenant, ms, target_route in results:
         status_counts[status] = status_counts.get(status, 0) + 1
         bucket = tenant_counts.setdefault(tenant, {})
         bucket[status] = bucket.get(status, 0) + 1
+        rbucket = route_counts.setdefault(target_route, {})
+        rbucket[status] = rbucket.get(status, 0) + 1
         latencies.append(ms)
         if status == "200":
             ok_latencies.append(ms)
@@ -352,6 +371,8 @@ def summarize(results: list[tuple[float, str, str, float]], shed: int,
         "expected_rate": round(expected
                                / max(1e-9, schedule.duration_s), 3),
         "status": dict(sorted(status_counts.items())),
+        "routes": {r: dict(sorted(c.items()))
+                   for r, c in sorted(route_counts.items())},
         "tenants": {t: dict(sorted(c.items()))
                     for t, c in sorted(tenant_counts.items())},
         "n_5xx": n_5xx,
@@ -377,6 +398,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--url", default="http://127.0.0.1:8080")
     p.add_argument("--route", default="/embed",
                    choices=("/embed", "/search"))
+    p.add_argument("--search-fraction", type=float, default=0.0,
+                   help="probability each arrival becomes a POST "
+                        "/search instead of --route (0..1; "
+                        "--route /search forces 1.0)")
     p.add_argument("--rate", type=float, default=20.0,
                    help="base arrival rate (requests/s)")
     p.add_argument("--duration", type=float, default=10.0,
@@ -435,7 +460,8 @@ def main(argv=None) -> int:
     summary = run_load(args.url, schedule, keys, tenants, rng,
                        max_outstanding=args.max_outstanding,
                        timeout_s=args.timeout, route=args.route,
-                       search_k=args.search_k)
+                       search_k=args.search_k,
+                       search_fraction=args.search_fraction)
     if not args.timeline:
         summary.pop("timeline", None)
     print(json.dumps(summary, indent=2, sort_keys=True))

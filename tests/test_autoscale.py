@@ -318,6 +318,23 @@ class TestStepSignals:
         with pytest.raises(ValueError):
             make_controller(1, min_workers=3, max_workers=2)
 
+    def test_maintenance_ok_is_the_idle_predicate_sans_size(self):
+        # ISSUE 17: the retrieval tier's heavy_gate rides this. It is
+        # the scale-down idle test MINUS the routable>1 term (a quiet
+        # one-worker fleet can afford a compaction), and True before
+        # federation delivers a first snapshot (no evidence != busy).
+        ctl, _, _ = make_controller(1)
+        assert ctl.maintenance_ok() is True          # no signals yet
+        ctl.last_signals = sig(ctl)                  # idle, 1 routable
+        assert ctl.maintenance_ok() is True
+        ctl.last_signals = sig(ctl, queue=1.0)       # queued work
+        assert ctl.maintenance_ok() is False
+        ctl.last_signals = sig(ctl, burn=1.5)        # SLO burning
+        assert ctl.maintenance_ok() is False
+        busy = sig(ctl, inflight=ctl.up_inflight)    # above half-mark
+        ctl.last_signals = busy
+        assert ctl.maintenance_ok() is False
+
 
 # ---------------------------------------------------------------------------
 # burn signal extraction (ring over the merged registry)
@@ -526,9 +543,11 @@ class TestLoadgen:
         assert 2.0 < ratio < 4.5
 
     def test_summarize_counts_5xx_and_ok_percentiles(self):
-        results = [(0.1, "200", "a", 10.0), (0.2, "200", "a", 20.0),
-                   (0.5, "429", "b", 1.0), (1.1, "502", "b", 5.0),
-                   (1.2, "unreachable", "a", 9.0)]
+        results = [(0.1, "200", "a", 10.0, "/embed"),
+                   (0.2, "200", "a", 20.0, "/search"),
+                   (0.5, "429", "b", 1.0, "/embed"),
+                   (1.1, "502", "b", 5.0, "/embed"),
+                   (1.2, "unreachable", "a", 9.0, "/search")]
         s = self.lg.RateSchedule(5.0, 2.0)
         out = self.lg.summarize(results, shed=1, offered=6, wall_s=2.0,
                                 schedule=s)
@@ -537,6 +556,7 @@ class TestLoadgen:
         assert out["status"]["429"] == 1
         assert out["latency_ms"]["ok_p99"] == 20.0
         assert out["tenants"]["b"] == {"429": 1, "502": 1}
+        assert out["routes"]["/search"] == {"200": 1, "unreachable": 1}
 
     def test_cli_parses_the_full_surface(self):
         argv = ["--url", "http://x", "--rate", "10", "--duration", "1",

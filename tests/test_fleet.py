@@ -1395,6 +1395,12 @@ class TestServingFleet:
              # must stay importable without paying backend init.
              "from ntxent_tpu.retrieval import (IndexManager, "
              "VectorIndex, SegmentStore, IVFIndex)\n"
+             # ISSUE 17: the PQ codec, fused batched scan, and shard
+             # plane join the same surface — shard workers restart on
+             # the router's schedule and must come up in milliseconds.
+             "from ntxent_tpu.retrieval import (PQCodec, CodedLists, "
+             "ScanBatcher, batched_scan, ShardFanout, ShardServer, "
+             "IndexShard)\n"
              "assert 'jax' not in sys.modules, 'jax leaked'\n"
              "print('\\n'.join(sorted(m for m in sys.modules\n"
              "                        if m.startswith('ntxent_tpu'))))\n"],

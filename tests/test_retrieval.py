@@ -457,16 +457,22 @@ class TestIndexManager:
         x = clustered(8, dim=4, seed=0)
         m.insert(x, x, step=1)
         gate = threading.Event()
+        entered = threading.Event()
         passes = []
 
         def reembed(rows):
             passes.append(rows.shape[0])
+            entered.set()
             if len(passes) == 1:
                 gate.wait(5.0)  # hold pass 1 open while a row lands
             return np.asarray(rows, np.float32)
 
         m.reembed = reembed
         assert m.rebuild_async("stale")
+        # Wait until pass 1 has SNAPSHOT the docstore (reembed runs
+        # after the snapshot) — inserting earlier would legitimately
+        # land the row inside pass 1 and converge in one pass.
+        assert entered.wait(5.0)
         late = clustered(1, dim=4, seed=9)
         ids = m.insert(late, late, step=1)  # mid-rebuild insert
         gate.set()
