@@ -1363,12 +1363,13 @@ httpd.serve_forever()
 
 def _autoscale_child() -> None:
     """--autoscale measurement: does the closed loop hold what a fixed
-    fleet breaches, and is scale-down zero-5xx? (ISSUE 16)
+    fleet breaches, is scale-down zero-5xx (ISSUE 16), and does the
+    predictive trigger land capacity BEFORE the ramp does (ISSUE 18)?
 
-    Three legs over pinned-service-time stub workers (25 ms/request ->
+    Four legs over pinned-service-time stub workers (25 ms/request ->
     one worker serves exactly 40 req/s anywhere), all driven by the
-    open-loop Poisson replay in scripts/loadgen.py at a 90 req/s hold
-    after a 10x warm ramp:
+    open-loop Poisson replay in scripts/loadgen.py — the first three at
+    a 90 req/s hold after a 10x warm ramp:
 
     * **fixed**      — ONE worker, no controller: offered rate is 2.25x
                        capacity, the bounded queue fills, latency and
@@ -1380,13 +1381,20 @@ def _autoscale_child() -> None:
                        stays a fraction of the fixed leg's;
     * **drain**      — load drops to a trickle; the idle policy drains
                        the elastic workers back to min with ZERO 5xx /
-                       connection resets observed by the client.
+                       connection resets observed by the client;
+    * **predictive** — a slow ramp toward the rated per-worker
+                       capacity under ``predict_horizon_s``: the
+                       Holt-Winters projection over the request-rate
+                       history must fire the ONE scale-up (reason
+                       ``forecast``) measurably before the measured
+                       rate reaches capacity, with zero 5xx.
 
     In-child hard bars (a BENCH_autoscale.json can only be committed
     passing, and every --check re-run re-asserts them): the fixed leg
     actually breaches; the autoscaled hold leg sees zero 5xx and p99
     <= 0.6x fixed; the pool reaches max_workers and returns to min;
-    the drain leg is zero-5xx and zero-unreachable. The gate-compared
+    the drain leg is zero-5xx and zero-unreachable; the predictive
+    leg's lead is positive and forecast-attributed. The gate-compared
     metrics are the stable booleans + the peak pool size — the
     latencies ride along as context, not comparisons."""
     import importlib.util
@@ -1520,6 +1528,77 @@ def _autoscale_child() -> None:
                       "pool_end": pool_end}
     assert workers_peak == 3, f"pool peaked at {workers_peak}, want 3"
 
+    # -- leg 4: predictive scale-up (ISSUE 18) ------------------------
+    # A fresh 1..2 pool whose rated per-worker capacity equals the
+    # stubs' real 40 req/s, under a ramp that crosses that capacity
+    # slowly enough for queue/in-flight pressure to stay silent below
+    # it: the controller's ONLY reason to grow before the breach tick
+    # is the Holt-Winters projection. The leg measures the lead — the
+    # gap between the forecast-triggered scale-up and the first
+    # (smoothed) tick where the measured rate actually reaches
+    # capacity — and it must be positive with zero 5xx.
+    predict_horizon_s = 6.0
+    predict_capacity = 40.0
+    workdir, registry, pool, fleet, router = build("predict")
+    aggregator = obs.FleetAggregator(
+        lambda: {w.worker_id: w.url for w in pool.workers() if w.url},
+        local={"router": registry}, interval_s=0.25)
+    history = obs.MetricHistory()
+    controller = AutoscaleController(
+        fleet, pool, registry=registry, min_workers=1, max_workers=2,
+        up_queue_depth=4.0, up_inflight=4.0, up_ticks=2,
+        idle_ticks=10 ** 6, up_cooldown_s=1.0, down_cooldown_s=60.0,
+        predict_horizon_s=predict_horizon_s,
+        predict_capacity=predict_capacity, history=history)
+    aggregator.on_merge.append(obs.HistoryRecorder(history).on_merge)
+    aggregator.on_merge.append(controller.observe)
+    first_up = {"t": None}
+
+    def _watch_up(_merged):
+        if first_up["t"] is None \
+                and counter_total(registry,
+                                  "fleet_scale_up_total") >= 1:
+            first_up["t"] = time.time()
+
+    aggregator.on_merge.append(_watch_up)
+    fleet.autoscaler = controller
+    aggregator.start()
+    try:
+        predict = run_leg(
+            router.port,
+            lg.RateSchedule(48.0, 16.0, ramp_s=14.0, ramp_from=0.1),
+            seed=6)
+        up_reasons = {
+            m["labels"].get("reason"): m["value"]
+            for m in registry.dump_state()["metrics"]
+            if m["name"] == "fleet_scale_up_total"}
+    finally:
+        aggregator.stop()
+        router.close()
+        fleet.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    # The breach tick: first smoothed crossing of the rated capacity
+    # (5-tick moving average — one Poisson-noised 250 ms sample must
+    # not count as "the ramp arrived").
+    pts = history.query("fleet_request_rate")["points"]
+    t_breach = None
+    for i in range(len(pts)):
+        window = [p["value"] for p in pts[max(0, i - 4):i + 1]]
+        if len(window) >= 3 \
+                and sum(window) / len(window) >= predict_capacity:
+            t_breach = pts[i]["t"]
+            break
+    assert t_breach is not None, "offered ramp never reached capacity"
+    assert first_up["t"] is not None, "predictive leg never scaled up"
+    # The single scale-up must carry reason=forecast — a reactive
+    # reason here means capacity arrived late, after the queue told us.
+    assert up_reasons == {"forecast": 1.0}, up_reasons
+    lead_s = t_breach - first_up["t"]
+    lead_ok = (lead_s > 0 and predict["n_5xx"] == 0
+               and predict["n_unreachable"] == 0)
+    assert lead_ok, {"lead_s": lead_s, "predict": predict}
+
     payload = {
         "metric": "fleet_autoscale",
         "platform": "cpu",  # stdlib stubs: no accelerator in this path
@@ -1540,6 +1619,11 @@ def _autoscale_child() -> None:
         "hold_ok": 1.0 if hold_ok else 0.0,
         "drain_ok": 1.0 if drain_ok else 0.0,
         "breach_ratio": round(fixed_p99 / max(auto_p99, 1e-6), 2),
+        "predictive": predict,
+        "predict_horizon_s": predict_horizon_s,
+        "predict_capacity": predict_capacity,
+        "lead_s": round(lead_s, 2),
+        "lead_ok": 1.0 if lead_ok else 0.0,
     }
     print(SENTINEL + json.dumps(payload), flush=True)
 
@@ -1574,8 +1658,9 @@ def _obs_child() -> None:
       off (no event log, no shadow, no federation) vs ON (async
       EventLog -> spans on every hop, a live undecided canary taking
       the configured fraction, the ShadowMirror diffing mirrored
-      requests, a FleetAggregator + SLOEngine ticking in the
-      background).
+      requests, a FleetAggregator + SLOEngine + the ISSUE 18 history
+      plane — MetricHistory fed by a HistoryRecorder with the
+      median+MAD AnomalyDetector — ticking in the background).
 
     The acceptance bar (enforced HERE, so a BENCH_obs.json can only
     ever be committed passing, and every ``--check`` re-run
@@ -1646,7 +1731,11 @@ def _obs_child() -> None:
                                             f"train_{rep}.jsonl"),
                                async_io=True)
             obs.install(log)
-            timeline = StepTimeline(registry=MetricsRegistry())
+            # history attached: every step also lands train_* series
+            # in the bounded store (ISSUE 18) — part of the shipped
+            # telemetry config, so part of the measured cost.
+            timeline = StepTimeline(registry=MetricsRegistry(),
+                                    history=obs.MetricHistory())
         try:
             t0 = time.monotonic()
             # Telemetry-on is the config the repo SHIPS for production
@@ -1765,6 +1854,15 @@ def _obs_child() -> None:
                                labels={"stage": "total"})],
                 store=router.alerts)
             aggregator.on_merge.append(engine.evaluate)
+            # The retained time-series plane rides the same tick: the
+            # recorder reduces every merged registry into history
+            # samples and the detector judges each one (ISSUE 18).
+            history = obs.MetricHistory()
+            recorder = obs.HistoryRecorder(
+                history,
+                detector=obs.AnomalyDetector(store=router.alerts))
+            aggregator.on_merge.append(recorder.on_merge)
+            router.history = history
             aggregator.start()
         router.start()
         try:
@@ -1833,7 +1931,9 @@ def _obs_child() -> None:
                                    "canary fraction 0.25",
                                    "shadow mirror fraction 0.25",
                                    "federation tick 0.5s",
-                                   "slo engine"]},
+                                   "slo engine",
+                                   "metrics history + anomaly "
+                                   "detector"]},
         "overhead_bar": 0.05,
     }
     # The acceptance bar: telemetry must cost <= 5% on BOTH paths.
@@ -2406,7 +2506,7 @@ def gate_metrics(name: str, payload: dict | None,
         # are context, not comparisons: they measure the scenario's
         # queueing, which the breach_ratio bar already bounds
         # in-child.
-        for key in ("hold_ok", "drain_ok"):
+        for key in ("hold_ok", "drain_ok", "lead_ok"):
             v = payload.get(key)
             if keep(v):
                 out[f"autoscale/{key}"] = {

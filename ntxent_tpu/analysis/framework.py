@@ -138,6 +138,10 @@ class LintConfig:
         # router bounds cardinality itself: at most max_tenants tracked
         # label values, everything past the cap melts into "other".
         "tenant",
+        # ISSUE 18: obs_anomalies_total{series=...} — bounded by the
+        # history store's own max_series cap (the detector only ever
+        # sees series the recorder admitted).
+        "series",
     )
 
 

@@ -1874,6 +1874,33 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                    metavar="SECONDS",
                    help="max drain wait before a still-busy victim is "
                         "retired anyway")
+    a.add_argument("--predict-horizon", type=float, default=None,
+                   metavar="SECONDS",
+                   help="predictive scale-up (ISSUE 18): feed Holt-"
+                        "Winters forecasters the request-rate and "
+                        "queue-depth series and treat the PROJECTED "
+                        "value this many seconds out as scale-up "
+                        "pressure ('forecast' reason) — capacity "
+                        "arrives before a diurnal ramp breaches. "
+                        "Scale-down stays purely reactive. Requires "
+                        "--autoscale")
+    a.add_argument("--predict-capacity", type=float, default=None,
+                   metavar="REQ_S",
+                   help="rated per-worker throughput (req/s) the "
+                        "forecast rate signal is judged against "
+                        "(default: off — only the forecast queue-"
+                        "depth signal fires)")
+    a.add_argument("--predict-season", type=float, default=None,
+                   metavar="SECONDS",
+                   help="seasonal period for the forecasters (e.g. "
+                        "86400 for a diurnal cycle; default: trend "
+                        "only)")
+    a.add_argument("--scale-up-rss-bytes", type=float, default=None,
+                   metavar="BYTES",
+                   help="worker vertical memory pressure (ISSUE 18): "
+                        "federated max serving_worker_rss_bytes at or "
+                        "over this counts as scale-up pressure "
+                        "(default: off)")
     a.add_argument("--tenant-quota", default=None,
                    metavar="NAME=RATE[:BURST],...",
                    help="arm per-tenant admission control (X-Tenant "
@@ -1912,12 +1939,42 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                    metavar="DIST",
                    help="drift SLO bound on fleet_shadow_drift p99 "
                         "(alerting view of the shadow bar)")
+    o.add_argument("--slo-retrieval-degraded", type=float, default=None,
+                   metavar="TARGET",
+                   help="retrieval health SLO target (e.g. 0.99): "
+                        "alert when the fraction of searches served "
+                        "degraded (shard timeouts/failures) burns the "
+                        "budget over both windows")
     o.add_argument("--slo-fast-window", type=float, default=60.0,
                    metavar="SECONDS")
     o.add_argument("--slo-slow-window", type=float, default=300.0,
                    metavar="SECONDS")
     o.add_argument("--slo-burn-factor", type=float, default=2.0,
                    help="error-budget burn multiple that pages")
+    o.add_argument("--history-dir", default=None, metavar="DIR",
+                   help="durable spill directory for the metrics-"
+                        "history plane (ISSUE 18): the per-series "
+                        "rollup store survives router restarts via "
+                        "stage-fsync-rename (default: in-memory only). "
+                        "History itself is always on with federation — "
+                        "/metrics/history")
+    o.add_argument("--history-raw", type=int, default=720,
+                   metavar="SAMPLES",
+                   help="raw ring length per series (rollups keep the "
+                        "same count at 10s and 1m resolution)")
+    o.add_argument("--anomaly-mad", type=float, default=6.0,
+                   metavar="FACTOR",
+                   help="anomaly detector sensitivity: |value - "
+                        "rolling median| over this many MADs fires a "
+                        "typed 'anomaly' alert + flight dump")
+    o.add_argument("--anomaly-warmup", type=int, default=20,
+                   metavar="SAMPLES",
+                   help="per-series samples before the anomaly "
+                        "detector arms")
+    o.add_argument("--anomaly-series", default=None,
+                   metavar="NAME,NAME,...",
+                   help="restrict the anomaly watch to these history "
+                        "series (default: every recorded series)")
 
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None, metavar="cpu|tpu")
@@ -2136,7 +2193,7 @@ def fleet_main(argv=None) -> int:
         router.attach_shadow(shadow)
 
     slo_flags = (args.slo_availability, args.slo_latency_ms,
-                 args.slo_drift)
+                 args.slo_drift, args.slo_retrieval_degraded)
     if any(f is not None for f in slo_flags) and args.fed_interval <= 0:
         # SLOs evaluate on federation ticks: accepting the flags while
         # silently never arming them would look like paging that is on
@@ -2144,6 +2201,7 @@ def fleet_main(argv=None) -> int:
         raise SystemExit("--slo-* objectives require federation "
                          "(--fed-interval > 0)")
     aggregator = None
+    history = None
     if args.fed_interval > 0:
         def _fed_targets() -> dict:
             return {w.worker_id: w.url for w in pool.workers()
@@ -2153,6 +2211,25 @@ def fleet_main(argv=None) -> int:
             _fed_targets, local={"router": registry},
             interval_s=args.fed_interval)
         router.aggregator = aggregator
+        # Metrics-history plane (ISSUE 18): every federation tick lands
+        # one sample per derived series in the rollup store, the MAD
+        # detector judges each as it arrives, and the router serves the
+        # retained view at /metrics/history. Always on with federation
+        # — the plane is bounded memory and off the hot path.
+        history = obs.MetricHistory(
+            raw_len=args.history_raw, rollup_len=args.history_raw,
+            spill_dir=args.history_dir, registry=registry)
+        watch = None
+        if args.anomaly_series:
+            watch = {s.strip() for s in args.anomaly_series.split(",")
+                     if s.strip()}
+        detector = obs.AnomalyDetector(
+            store=router.alerts, warmup=args.anomaly_warmup,
+            mad_factor=args.anomaly_mad, watch=watch,
+            registry=registry)
+        recorder = obs.HistoryRecorder(history, detector=detector)
+        aggregator.on_merge.append(recorder.on_merge)
+        router.history = history
         objectives = []
         if args.slo_availability is not None:
             objectives.append(obs.Objective(
@@ -2178,6 +2255,18 @@ def fleet_main(argv=None) -> int:
                 target=args.slo_drift,
                 metric="fleet_shadow_drift", q=0.99,
                 min_samples=args.shadow_min_samples))
+        if args.slo_retrieval_degraded is not None:
+            # Retrieval health rides the same burn machinery as
+            # availability (ISSUE 18 satellite): sustained degraded-
+            # search fraction over both windows pages through /alerts.
+            objectives.append(obs.Objective(
+                name="retrieval_degraded", kind="availability",
+                target=args.slo_retrieval_degraded,
+                total_metric="retrieval_searches_total",
+                bad_metric="retrieval_shard_degraded_searches_total",
+                fast_window_s=args.slo_fast_window,
+                slow_window_s=args.slo_slow_window,
+                burn_factor=args.slo_burn_factor))
         if objectives:
             engine = obs.SLOEngine(objectives, store=router.alerts)
             aggregator.on_merge.append(engine.evaluate)
@@ -2203,6 +2292,9 @@ def fleet_main(argv=None) -> int:
     # federation tick — accepting --autoscale without --fed-interval
     # would be a controller that never observes.
     controller = None
+    if args.predict_horizon is not None and not args.autoscale:
+        raise SystemExit("--predict-horizon is a scale-up input: it "
+                         "requires --autoscale")
     if args.autoscale:
         if attach:
             raise SystemExit("--autoscale is not available in "
@@ -2232,7 +2324,12 @@ def fleet_main(argv=None) -> int:
             up_cooldown_s=args.scale_up_cooldown,
             down_cooldown_s=args.scale_down_cooldown,
             drain_deadline_s=args.drain_deadline,
-            slo_target=args.scale_slo_target)
+            slo_target=args.scale_slo_target,
+            predict_horizon_s=args.predict_horizon,
+            predict_capacity=args.predict_capacity,
+            predict_season_s=args.predict_season,
+            up_rss_bytes=args.scale_up_rss_bytes,
+            history=history)
         aggregator.on_merge.append(controller.observe)
         fleet.autoscaler = controller
 
@@ -2267,6 +2364,12 @@ def fleet_main(argv=None) -> int:
                     "pressure tick(s), drain after %d idle tick(s)",
                     min_w, max_w, args.workers, args.scale_up_ticks,
                     args.scale_idle_ticks)
+        if args.predict_horizon is not None:
+            logger.info("autoscale: predictive scale-up armed — "
+                        "%.0fs horizon%s", args.predict_horizon,
+                        f", {args.predict_capacity:.0f} req/s/worker "
+                        "rated capacity"
+                        if args.predict_capacity is not None else "")
 
     stop = threading.Event()
 
@@ -2299,6 +2402,10 @@ def fleet_main(argv=None) -> int:
     finally:
         if aggregator is not None:
             aggregator.stop()
+        if history is not None:
+            # Final spill: a clean shutdown leaves the full retained
+            # view on disk for the next --history-dir reopen.
+            history.close()
         if shadow is not None:
             shadow.stop()
         if index_mgr is not None:

@@ -33,6 +33,14 @@ fact. The pieces:
   instance-labeled, histogram windows pooled so fleet percentiles use
   the same exact-window quantile rule) — the router's
   ``/metrics/fleet``;
+* ``history.MetricHistory`` — the retained time-series plane
+  (ISSUE 18): per-series ring buffers with staged raw → 10 s → 1 m
+  downsampling fed by every federation tick (``HistoryRecorder``),
+  durable via stage-fsync-rename spill, served as the router's
+  ``/metrics/history``; ``AnomalyDetector`` (rolling median + MAD)
+  raises typed ``anomaly`` alerts over it and ``Forecaster``
+  (Holt-Winters smoothing) gives the autoscaler its predictive
+  ``--predict-horizon`` lead-time signal;
 * ``slo.SLOEngine`` — declarative objectives (availability burn-rate
   over fast/slow windows, latency/drift quantile bounds) evaluated on
   every federation tick; breaches emit typed ``alert`` events, trip
@@ -56,6 +64,16 @@ from .events import (
     set_attempt,
 )
 from .exporters import PROMETHEUS_CONTENT_TYPE, MetricsServer, choose_format
+from .history import (
+    DEFAULT_SERIES,
+    AnomalyDetector,
+    Forecaster,
+    HistoryRecorder,
+    MetricHistory,
+    SeriesSpec,
+    gauge_reduce,
+    ingest_timeline,
+)
 from .profiler import ProfilerTrigger
 from .registry import (
     Counter,
@@ -80,11 +98,19 @@ from .trace import (
 
 __all__ = [
     "AlertStore",
+    "AnomalyDetector",
+    "DEFAULT_SERIES",
     "EVENT_TYPES",
     "EventLog",
     "FleetAggregator",
+    "Forecaster",
+    "HistoryRecorder",
+    "MetricHistory",
     "Objective",
     "SLOEngine",
+    "SeriesSpec",
+    "gauge_reduce",
+    "ingest_timeline",
     "merge_states",
     "dump_flight",
     "emit",

@@ -74,10 +74,17 @@ __all__ = ["EVENT_TYPES", "EventLog", "install", "get_event_log", "emit",
 # ntxent_tpu/retrieval/ — build/seal/compact/activate/promote/rollback/
 # drop/stale/rebuild). autoscale: a fleet-sizing control action
 # (ISSUE 16, serving/autoscale.py — scale_up/drain_start/drain_done/
-# hold decisions with the signal snapshot that drove them).
+# hold decisions with the signal snapshot that drove them). anomaly: a
+# history-series changepoint (ISSUE 18, obs/history.py — rolling
+# median+MAD breach/resolution; the firing transition also trips the
+# flight recorder, like an SLO breach). forecast: a predictive
+# scale-up trigger (ISSUE 18 — the Holt-Winters lead-time forecast
+# that crossed the controller's pressure bound, recorded with the
+# horizon and projected values that drove it).
 EVENT_TYPES = ("step", "retry", "divergence", "restart", "checkpoint",
                "compile", "trace", "span", "rollout", "fleet", "alert",
-               "comms_profile", "bench", "index", "autoscale")
+               "comms_profile", "bench", "index", "autoscale",
+               "anomaly", "forecast")
 
 
 class EventLog:

@@ -52,9 +52,13 @@ class StepTimeline:
 
     def __init__(self, registry: MetricsRegistry | None = None,
                  profiler=None, event_every: int = 1,
-                 histogram_window: int = 2048):
+                 histogram_window: int = 2048, history=None):
         self.registry = registry or default_registry()
         self.profiler = profiler
+        # Optional obs.MetricHistory (ISSUE 18): each step also lands
+        # in the retained time-series plane, so a training process gets
+        # the same rollup/anomaly machinery the serving fleet does.
+        self.history = history
         self.event_every = max(1, int(event_every))
         self.flops_per_step: float | None = None
         self._peak_flops: float | None = None
@@ -277,6 +281,12 @@ class StepTimeline:
             # (richer: tier decisions, scale), so skip the duplicate.
             events.emit("divergence", action="observed", step=int(step),
                         loss=float(loss), guarded=False)
+
+        if self.history is not None:
+            self.history.record("train_step_device_ms", device_s * 1e3)
+            self.history.record("train_steps_per_sec", steps_per_sec)
+            self.history.record("train_loss", loss)  # non-finite: dropped
+            self.history.maybe_spill()
 
         if self.profiler is not None:
             self.profiler.on_step(int(step), device_s * 1e3)

@@ -45,6 +45,7 @@ import urllib.request
 from collections import deque
 
 from ..obs import events as obs_events
+from ..obs.history import Forecaster, gauge_reduce
 from ..obs.registry import MetricsRegistry
 from ..obs.slo import counter_total, histogram_quantile
 
@@ -120,6 +121,19 @@ class AutoscaleController:
     the up-pressure in-flight bound + an expired down-cooldown marks
     ONE victim draining (highest ordinal first — the elastic workers
     retire in LIFO order, the seed workers stay put).
+
+    Predictive scale-up (ISSUE 18): with ``predict_horizon_s`` set,
+    the controller feeds Holt-Winters forecasters (obs/history.py)
+    the request-rate and fleet queue-depth series every tick and adds
+    one more pressure source — ``forecast`` — that trips when the
+    PROJECTED value at ``now + predict_horizon_s`` would breach the
+    queue bound (or, with ``predict_capacity`` req/s-per-worker set,
+    the fleet's rated throughput). The forecast only ever proposes:
+    it rides the same streak, cooldown, and ``max_workers`` gates as
+    every reactive source, and scale-DOWN stays purely reactive — a
+    forecast can buy lead time, never shed capacity. ``up_rss_bytes``
+    (off by default) adds the worker vertical memory signal the same
+    way: federated max RSS at/over the bound is pressure.
     """
 
     def __init__(self, fleet, pool,
@@ -137,6 +151,11 @@ class AutoscaleController:
                  drain_deadline_s: float = 30.0,
                  burn_window_s: float = 30.0,
                  slo_target: float = 0.999,
+                 predict_horizon_s: float | None = None,
+                 predict_capacity: float | None = None,
+                 predict_season_s: float | None = None,
+                 up_rss_bytes: float | None = None,
+                 history=None,
                  clock=time.monotonic):
         if min_workers < 1:
             raise ValueError(f"min_workers must be >= 1, got "
@@ -163,6 +182,48 @@ class AutoscaleController:
         self.drain_deadline_s = float(drain_deadline_s)
         self.burn_window_s = float(burn_window_s)
         self.budget = 1.0 - float(slo_target)
+        if predict_horizon_s is not None and predict_horizon_s <= 0:
+            raise ValueError(f"predict_horizon_s must be > 0, got "
+                             f"{predict_horizon_s}")
+        if predict_capacity is not None and predict_capacity <= 0:
+            raise ValueError(f"predict_capacity must be > 0, got "
+                             f"{predict_capacity}")
+        if up_rss_bytes is not None and up_rss_bytes <= 0:
+            raise ValueError(f"up_rss_bytes must be > 0, got "
+                             f"{up_rss_bytes}")
+        self.predict_horizon_s = (float(predict_horizon_s)
+                                  if predict_horizon_s is not None
+                                  else None)
+        self.predict_capacity = (float(predict_capacity)
+                                 if predict_capacity is not None
+                                 else None)
+        self.up_rss_bytes = (float(up_rss_bytes)
+                             if up_rss_bytes is not None else None)
+        # The metrics-history store (obs/history.py), when attached:
+        # forecasts are recorded back into it as *_forecast series so
+        # /metrics/history can show prediction against reality.
+        self.history = history
+        # Forecast hard bounds: a wild model may propose at most 10x
+        # the capacity the fleet could ever field — beyond that the
+        # clamp holds, and the action gates (streaks, cooldowns,
+        # max_workers) still apply to whatever survives.
+        if self.predict_horizon_s is not None:
+            self._rate_forecaster = Forecaster(
+                season_s=predict_season_s,
+                bound_max=(self.predict_capacity * max_workers * 10.0
+                           if self.predict_capacity is not None
+                           else None))
+            self._queue_forecaster = Forecaster(
+                season_s=predict_season_s,
+                bound_max=float(up_queue_depth) * max_workers * 10.0)
+        else:
+            self._rate_forecaster = None
+            self._queue_forecaster = None
+        # no_routable is a REPAIR signal (all workers wedged), so it
+        # only arms once the fleet has ever fielded a routable worker —
+        # a cold boot's not-ready-yet seed must not scale the pool to
+        # max before the first worker even finishes warming.
+        self._seen_routable = False
         self.clock = clock
         self._lock = threading.Lock()
         # (now, total, bad) samples for the windowed burn rate — the
@@ -209,6 +270,14 @@ class AutoscaleController:
         bad = counter_total(merged, "fleet_rejected_total",
                             exclude={"reason": "tenant_quota"})
         ring = self._burn_ring
+        # Instantaneous request rate from the previous tick's sample —
+        # read BEFORE this tick joins the ring. The forecasters smooth
+        # over it, so tick-to-tick jitter is fine.
+        rate = None
+        if ring:
+            prev_t, prev_total, _ = ring[-1]
+            if now > prev_t:
+                rate = max(0.0, (total - prev_total) / (now - prev_t))
         ring.append((now, total, bad))
         while ring and now - ring[0][0] > self.burn_window_s:
             ring.popleft()
@@ -225,13 +294,36 @@ class AutoscaleController:
         draining_ids = set(self._draining)
         routable = [w for w in workers
                     if w.ready and w.worker_id not in draining_ids]
+        queue_depth = gauge_total(merged, "serving_queue_depth")
+        rss = (gauge_reduce(merged, "serving_worker_rss_bytes", "max")
+               if self.up_rss_bytes is not None else None)
+        forecast_rate = forecast_queue = None
+        if self.predict_horizon_s is not None:
+            if rate is not None:
+                self._rate_forecaster.observe(now, rate)
+            self._queue_forecaster.observe(now, queue_depth)
+            forecast_rate = self._rate_forecaster.forecast(
+                self.predict_horizon_s)
+            forecast_queue = self._queue_forecaster.forecast(
+                self.predict_horizon_s)
+            if self.history is not None:
+                if forecast_rate is not None:
+                    self.history.record("fleet_request_rate_forecast",
+                                        forecast_rate)
+                if forecast_queue is not None:
+                    self.history.record("serving_queue_depth_forecast",
+                                        forecast_queue)
         return {
-            "queue_depth": gauge_total(merged, "serving_queue_depth"),
+            "queue_depth": queue_depth,
             "inflight": float(sum(w.inflight for w in routable)),
             "routable": len(routable),
             "size": self.pool_size(),
             "p99_ms": p99 if samples else None,
             "burn": burn,
+            "rate": rate,
+            "rss_bytes": rss,
+            "forecast_rate": forecast_rate,
+            "forecast_queue_depth": forecast_queue,
         }
 
     def pool_size(self) -> int:
@@ -259,8 +351,11 @@ class AutoscaleController:
             self._last_up_at = now
             return "up", "below_min"
         per_worker = max(1, routable)
+        if routable > 0:
+            self._seen_routable = True
         pressure: str | None = None
-        if routable == 0 and size < self.max_workers:
+        if (routable == 0 and self._seen_routable
+                and size < self.max_workers):
             pressure = "no_routable"
         elif signals["queue_depth"] / per_worker >= self.up_queue_depth:
             pressure = "queue_depth"
@@ -274,6 +369,21 @@ class AutoscaleController:
               and signals.get("burn") is not None
               and signals["burn"] >= self.up_burn):
             pressure = "burn"
+        elif (self.up_rss_bytes is not None
+              and signals.get("rss_bytes") is not None
+              and signals["rss_bytes"] >= self.up_rss_bytes):
+            pressure = "rss"
+        elif (self.predict_horizon_s is not None
+              and signals.get("forecast_queue_depth") is not None
+              and signals["forecast_queue_depth"] / per_worker
+              >= self.up_queue_depth):
+            pressure = "forecast"
+        elif (self.predict_horizon_s is not None
+              and self.predict_capacity is not None
+              and signals.get("forecast_rate") is not None
+              and signals["forecast_rate"]
+              >= self.predict_capacity * per_worker):
+            pressure = "forecast"
         if pressure is not None:
             self._idle_streak = 0
             self._up_streak += 1
@@ -362,6 +472,17 @@ class AutoscaleController:
         if worker is None:
             return
         self._count_scale("up", reason)
+        if reason == "forecast":
+            # The predictive trigger gets its own typed event: the
+            # smoke harness and post-mortems tell lead-time capacity
+            # apart from reactive repairs by this record alone.
+            obs_events.emit("forecast",
+                            horizon_s=self.predict_horizon_s,
+                            forecast_rate=signals.get("forecast_rate"),
+                            forecast_queue_depth=signals.get(
+                                "forecast_queue_depth"),
+                            rate=signals.get("rate"),
+                            queue_depth=signals.get("queue_depth"))
         obs_events.emit("autoscale", action="scale_up", reason=reason,
                         worker=worker.worker_id,
                         size=self.pool_size(), **_sig_fields(signals))
@@ -480,7 +601,9 @@ def _sig_fields(signals: dict) -> dict:
     an autoscale event must record what the controller actually saw,
     including 'no data')."""
     out = {}
-    for key in ("queue_depth", "inflight", "routable", "p99_ms", "burn"):
+    for key in ("queue_depth", "inflight", "routable", "p99_ms", "burn",
+                "rate", "forecast_rate", "forecast_queue_depth",
+                "rss_bytes"):
         v = signals.get(key)
         out[f"sig_{key}"] = round(v, 4) if isinstance(v, float) else v
     return out

@@ -194,6 +194,14 @@ class InferenceEngine:
                 daemon=True, name="ntxent-ladder-reaot")
             self._ladder_thread.start()
 
+    @property
+    def compile_cache_size(self) -> int:
+        """Live bucket-executable cache entries — the worker's
+        vertical compile-cache pressure signal (ISSUE 18), read at
+        /metrics scrape time."""
+        with self._lock:
+            return len(self._cache)
+
     # -- model lifecycle -------------------------------------------------
     def update_variables(self, variables) -> None:
         """Swap model weights (e.g. checkpoint reload on a live server).

@@ -25,12 +25,26 @@ cumulative count/sum never reset, exactly as before.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
 from ..obs.registry import Histogram, MetricsRegistry
 
-__all__ = ["LatencyWindow", "ServingMetrics"]
+__all__ = ["LatencyWindow", "ServingMetrics", "read_rss_bytes"]
+
+
+def read_rss_bytes() -> int | None:
+    """This process's resident set size from ``/proc/self/statm``
+    (resident pages x page size). Returns None where procfs (or the
+    sysconf key) is unavailable — a graceful no-op off Linux, per the
+    ISSUE 18 vertical-signals contract."""
+    try:
+        with open("/proc/self/statm") as f:
+            fields = f.read().split()
+        return int(fields[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        return None
 
 
 class LatencyWindow(Histogram):
@@ -174,9 +188,32 @@ class ServingMetrics:
         # the per-mode swap counters below.
         self._compile_cause_lock = threading.Lock()
         self._compile_causes: dict[str, object] = {}
+        # Worker vertical signals (ISSUE 18): per-process memory and
+        # compile-cache pressure, refreshed at scrape time (/metrics)
+        # rather than on a writer path — they are properties of the
+        # process, not of any request.
+        self._worker_rss = r.gauge(
+            "serving_worker_rss_bytes",
+            "resident set size of this worker process "
+            "(0 where procfs is unavailable)")
+        self._compile_cache_entries = r.gauge(
+            "serving_compile_cache_entries",
+            "entries in the engine's bucket-executable cache")
         # Cross-process correlation (ISSUE 7): run identity, stamped by
         # set_run_id. None until a run id is known (tests, bare engines).
         self.run_id: str | None = None
+
+    def update_vertical(self,
+                        compile_cache_entries: int | None = None) -> None:
+        """Refresh the per-process vertical gauges (scrape-time call
+        site: serving/server.py's /metrics handler). RSS read failure
+        leaves the gauge at its last value — absent procfs simply never
+        moves it off 0."""
+        rss = read_rss_bytes()
+        if rss is not None:
+            self._worker_rss.set(rss)
+        if compile_cache_entries is not None:
+            self._compile_cache_entries.set(int(compile_cache_entries))
 
     def set_run_id(self, run_id: str | None) -> None:
         """Label this serving process's metrics with a run id.

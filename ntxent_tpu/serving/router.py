@@ -884,6 +884,7 @@ class FleetRouter:
         self.shadow = None          # ShadowMirror (attach_shadow)
         self.admission = None       # TenantAdmission (ISSUE 16)
         self.aggregator = None      # obs.FleetAggregator -> /metrics/fleet
+        self.history = None         # obs.MetricHistory -> /metrics/history
         self.alerts = AlertStore(registry=self.registry)  # -> /alerts
         self._httpd: ThreadingHTTPServer | None = None
         self._http_thread: threading.Thread | None = None
@@ -1365,10 +1366,22 @@ class FleetRouter:
             out["tenants"] = self.admission.snapshot()
         if self.aggregator is not None:
             out["federation"] = self.aggregator.snapshot()
+        if self.history is not None:
+            out["history"] = self.history.snapshot()
         firing = self.alerts.active()
         if firing:
             out["alerts_firing"] = [a["name"] for a in firing]
         return out
+
+
+def _csv_cell(value) -> str:
+    """One history point field as a CSV cell (empty for absent/None —
+    a rollup never has missing stats, but raw/rollup share this path)."""
+    if value is None:
+        return ""
+    if isinstance(value, float):
+        return repr(value)
+    return str(value)
 
 
 def _make_router_handler(router: FleetRouter):
@@ -1435,6 +1448,47 @@ def _make_router_handler(router: FleetRouter):
                     self._reply(200, merged.dump_state())
                 else:
                     self._reply_prometheus(merged.render_prometheus())
+            elif route == "/metrics/history":
+                # The retained time-series plane (ISSUE 18): raw ring
+                # + 10s/1m rollups per series. ?series=NAME selects
+                # one series (else the store snapshot), ?step=raw|10s|1m
+                # picks the resolution, ?window=SECONDS trims relative
+                # to the newest sample, ?format=csv flattens for
+                # spreadsheet triage (JSON otherwise).
+                if router.history is None:
+                    self._reply(503, {"error": "no metrics history "
+                                               "attached"})
+                    return
+                query = parse_qs(urlparse(self.path).query)
+                series = query.get("series", [None])[0]
+                step = query.get("step", ["raw"])[0]
+                window = query.get("window", [None])[0]
+                fmt = query.get("format", ["json"])[0]
+                if series is None:
+                    self._reply(200, {
+                        **router.history.snapshot(),
+                        "series_names":
+                            router.history.series_names(),
+                    })
+                    return
+                try:
+                    window_s = float(window) if window is not None \
+                        else None
+                    payload = router.history.query(series, step=step,
+                                                   window_s=window_s)
+                except KeyError:
+                    self._reply(404, {"error": f"no series {series!r}",
+                                      "series":
+                                      router.history.series_names()})
+                    return
+                except ValueError as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                if fmt == "csv":
+                    self._reply_csv(series, payload["step"],
+                                    payload["points"])
+                else:
+                    self._reply(200, payload)
             elif route == "/alerts":
                 # SLO + canary-verdict breaches (obs/slo.py): active
                 # alerts and the recent history ring.
@@ -1460,6 +1514,25 @@ def _make_router_handler(router: FleetRouter):
             self.send_response(200)
             self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
             self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_csv(self, series: str, step: str,
+                       points: list[dict]) -> None:
+            # Raw points have (t, value); rollup points carry the full
+            # bucket stats. Header comes from the first point's keys so
+            # both shapes round-trip.
+            cols = list(points[0].keys()) if points \
+                else ["t", "value"]
+            lines = [",".join(cols)]
+            for p in points:
+                lines.append(",".join(_csv_cell(p.get(c)) for c in cols))
+            body = ("\n".join(lines) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/csv")
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Content-Disposition",
+                             f"inline; filename={series}.{step}.csv")
             self.end_headers()
             self.wfile.write(body)
 

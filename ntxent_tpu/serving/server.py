@@ -304,6 +304,13 @@ def _make_handler(server: EmbeddingServer):
                 # (existing dashboards/smoke parse it); a Prometheus
                 # scraper gets the SAME values from the same registry
                 # via ?format=prometheus or its Accept header.
+                # Vertical signals (ISSUE 18) refresh at scrape time —
+                # RSS and compile-cache pressure are process state, so
+                # the scrape is the natural sampling point and the
+                # request hot path never pays for them.
+                server.metrics.update_vertical(
+                    compile_cache_entries=getattr(
+                        server.engine, "compile_cache_size", None))
                 fmt = choose_format(self.path,
                                     self.headers.get("Accept"),
                                     default="json")

@@ -1,0 +1,320 @@
+#!/usr/bin/env bash
+# History-plane smoke (ISSUE 18): the retained time-series plane end to
+# end through the REAL ntxent-fleet in well under a minute of CPU. One
+# tiny-model worker boots under `--autoscale --predict-horizon` while an
+# open-loop diurnal ramp (scripts/loadgen.py) climbs toward the rated
+# per-worker capacity; then:
+#
+#   1. PREDICTIVE LEAD: the Holt-Winters forecast over the request-rate
+#      series crosses the rated capacity BEFORE the measured rate does,
+#      so the controller's first scale-up carries reason="forecast" and
+#      no reactive pressure reason ever fires — capacity arrives ahead
+#      of the ramp (positive lead, measured from /metrics/history:
+#      forecast-series crossing vs the 10s-rollup breach bucket);
+#   2. CLEAN RUN: before any injected fault, zero anomaly incidents;
+#   3. ANOMALY: `--chaos slowworker@N` SIGSTOPs a worker under load —
+#      the stalled in-flight requests spike the watched
+#      fleet_latency_max_ms series and trip the MAD detector EXACTLY
+#      once (one typed alert on /alerts, one obs_anomalies_total
+#      increment, one flight dump on disk);
+#   4. the replay observes ZERO 5xx across the whole arc;
+#   5. /metrics/history serves raw + rollups, and the 10s rollups are
+#      EXACTLY what brute-force bucketing of the raw ring gives
+#      (min/max/n/last equal, sum to float tolerance); unknown series
+#      404s, a bad window 400s, ?format=csv round-trips;
+#   6. the loadgen --timeline output ingests into a MetricHistory via
+#      obs.ingest_timeline (same series names end to end);
+#   7. shutdown spills the store durably (--history-dir) and a reopen
+#      finds the same series.
+# Any 5xx, hang, or failed assertion exits nonzero.
+# Pairs with `pytest -m history` (the same plane asserted in-process).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+t_start=$SECONDS
+
+workdir="$(mktemp -d)"
+fleet_pid=""
+load_pid=""
+cleanup() {
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "--- fleet log tail (rc=$rc) ---" >&2
+        tail -40 "$workdir/fleet.log" >&2 2>/dev/null || true
+        for wlog in "$workdir/fleet"/w*.log; do
+            [ -f "$wlog" ] || continue
+            echo "--- $(basename "$wlog") tail ---" >&2
+            tail -10 "$wlog" >&2
+        done
+    fi
+    [ -n "$load_pid" ] && kill "$load_pid" 2>/dev/null || true
+    [ -n "$fleet_pid" ] && kill "$fleet_pid" 2>/dev/null || true
+    [ -n "$fleet_pid" ] && wait "$fleet_pid" 2>/dev/null || true
+    rm -rf "$workdir"
+}
+trap cleanup EXIT
+
+echo "=== history smoke: workdir $workdir"
+
+# Phase 0 — the fleet: ONE worker, predictive autoscale 1..2 with a
+# deliberately low rated capacity (6 req/s/worker — the tiny model
+# actually serves far more, so reactive pressure NEVER fires and any
+# scale-up must come from the forecast). The anomaly watch is scoped to
+# fleet_latency_max_ms — the window MAX is the series a short stall
+# actually moves: supervision unroutes the stalled worker within one
+# poll, so only the requests already in flight hang (a 3 s latency is
+# invisible to a p99 pooled over hundreds of samples, unmissable in
+# the max). mad=100 puts the breach line ~10x above a clean run's max
+# while the stall lands ~100x above it; a clean run must stay silent
+# because this smoke asserts EXACTLY one incident.
+JAX_PLATFORMS=cpu python -c "
+import sys
+from ntxent_tpu.cli import fleet_main
+sys.exit(fleet_main(sys.argv[1:]))
+" --platform cpu --model tiny --image-size 8 --proj-hidden-dim 16 \
+  --proj-dim 8 --workers 1 --buckets 1,4 --no-cache \
+  --workdir "$workdir/fleet" --health-poll 1.0 --fed-interval 0.3 \
+  --autoscale --min-workers 1 --max-workers 2 \
+  --scale-up-ticks 2 --scale-up-cooldown 1 \
+  --scale-idle-ticks 200 --scale-down-cooldown 120 \
+  --predict-horizon 6 --predict-capacity 6 \
+  --history-dir "$workdir/history" \
+  --anomaly-series fleet_latency_max_ms --anomaly-warmup 20 \
+  --anomaly-mad 100 \
+  --chaos "slowworker@22" --seed 0 \
+  --log-jsonl "$workdir/router.jsonl" \
+  --port 0 --port-file "$workdir/router.port" \
+  >"$workdir/fleet.log" 2>&1 &
+fleet_pid=$!
+
+for _ in $(seq 200); do [ -s "$workdir/router.port" ] && break; sleep 0.1; done
+[ -s "$workdir/router.port" ] || { echo "router never bound"; exit 1; }
+PORT="$(cat "$workdir/router.port")"
+echo "=== router on :$PORT"
+
+python - "$PORT" <<'PY'
+import json, sys, time, urllib.request
+port = int(sys.argv[1])
+for _ in range(300):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+            if json.loads(r.read()).get("workers_ready", 0) >= 1:
+                sys.exit(0)
+    except Exception:
+        pass
+    time.sleep(0.2)
+sys.exit("seed worker never became ready")
+PY
+echo "=== seed worker ready (t=$((SECONDS - t_start))s)"
+
+# Phase 1 — the diurnal ramp: 0.1x -> 1x of 12 req/s over 20 s with a
+# sinusoidal "day" on top, crossing the 6 req/s rated line ~8 s in.
+python scripts/loadgen.py --url "http://127.0.0.1:$PORT" \
+    --rate 12 --duration 30 --ramp 20 \
+    --diurnal-amp 0.25 --diurnal-period 80 \
+    --shape 8,8,3 --rows 2 --keys 16 --max-outstanding 64 \
+    --timeout 20 --seed 1 --timeline \
+    >"$workdir/load.json" 2>"$workdir/load.log" &
+load_pid=$!
+
+# Phase 2 — predictive scale-up: reason MUST be "forecast" (below_min
+# repairs aside); any reactive reason here means the forecast gave no
+# lead. Then, still ahead of the chaos tick, zero anomaly incidents.
+python - "$PORT" <<'PY'
+import json, sys, time, urllib.request
+port = int(sys.argv[1])
+base = f"http://127.0.0.1:{port}"
+
+
+def state():
+    with urllib.request.urlopen(base + "/metrics?format=state",
+                                timeout=5) as r:
+        return json.loads(r.read())["metrics"]
+
+
+def scale_reasons():
+    out = {}
+    for m in state():
+        if m["name"] == "fleet_scale_up_total":
+            out[m["labels"]["reason"]] = out.get(
+                m["labels"]["reason"], 0) + m["value"]
+    return out
+
+
+deadline = time.monotonic() + 25.0
+reasons = {}
+while time.monotonic() < deadline:
+    reasons = scale_reasons()
+    if reasons.get("forecast", 0) >= 1:
+        break
+    time.sleep(0.3)
+assert reasons.get("forecast", 0) >= 1, \
+    f"no forecast scale-up: {reasons}"
+reactive = {r: n for r, n in reasons.items()
+            if r not in ("forecast", "below_min")}
+assert not reactive, f"reactive pressure fired first: {reasons}"
+anomalies = [m for m in state() if m["name"] == "obs_anomalies_total"]
+assert not anomalies, f"anomaly on a clean run: {anomalies}"
+print(f"smoke: predictive scale-up OK (reasons={reasons}, "
+      "clean run anomaly-free)")
+PY
+
+# Phase 3 — the injected regression: slowworker@22 SIGSTOPs a worker
+# ~22 s in; the requests in flight on it hang until SIGCONT, and their
+# ~3000 ms completions drive the pooled window max ~100x above the
+# clean baseline — the watched series must open EXACTLY one incident.
+python - "$PORT" <<'PY'
+import json, sys, time, urllib.request
+port = int(sys.argv[1])
+base = f"http://127.0.0.1:{port}"
+
+
+def state():
+    with urllib.request.urlopen(base + "/metrics?format=state",
+                                timeout=5) as r:
+        return json.loads(r.read())["metrics"]
+
+
+deadline = time.monotonic() + 40.0
+fired = []
+while time.monotonic() < deadline:
+    fired = [m for m in state() if m["name"] == "obs_anomalies_total"]
+    if fired:
+        break
+    time.sleep(0.5)
+assert fired, "anomaly never fired after slowworker injection"
+total = sum(m["value"] for m in fired)
+series = {m["labels"]["series"] for m in fired}
+assert total == 1.0 and series == {"fleet_latency_max_ms"}, \
+    f"want exactly one fleet_latency_max_ms incident, got {fired}"
+with urllib.request.urlopen(base + "/alerts", timeout=5) as r:
+    alerts = json.loads(r.read())
+names = {a["name"] for a in alerts["active"]}
+assert "anomaly:fleet_latency_max_ms" in names, alerts
+print(f"smoke: anomaly OK (exactly one incident, alerts={sorted(names)})")
+PY
+
+# The flight dump landed next to the JSONL log, header reason
+# anomaly:fleet_latency_max_ms.
+python - "$workdir" <<'PY'
+import glob, json, sys
+flights = glob.glob(sys.argv[1] + "/flight_*.jsonl")
+reasons = [json.loads(open(f).readline())["reason"] for f in flights]
+hits = [r for r in reasons if r == "anomaly:fleet_latency_max_ms"]
+assert len(hits) == 1, f"want one anomaly flight dump, got {reasons}"
+print("smoke: flight dump OK")
+PY
+
+# Phase 4 — the replay's verdict: zero 5xx through ramp, predictive
+# growth, AND a 3 s worker stall.
+wait "$load_pid"; load_pid=""
+python - "$workdir/load.json" <<'PY'
+import json, sys
+out = json.load(open(sys.argv[1]))
+assert out["completed"] > 100, out
+assert out["n_5xx"] == 0, out
+assert out["n_unreachable"] == 0, out
+print(f"smoke: replay OK ({out['completed']} requests, "
+      f"p99={out['latency_ms']['p99']:.0f}ms, zero 5xx)")
+PY
+
+# Phase 5 — the /metrics/history surface: rollups EXACTLY brute-force,
+# positive forecast lead, error handling, CSV.
+python - "$PORT" <<'PY'
+import json, sys, urllib.error, urllib.request
+port = int(sys.argv[1])
+base = f"http://127.0.0.1:{port}/metrics/history"
+
+
+def get(q=""):
+    with urllib.request.urlopen(base + q, timeout=5) as r:
+        return json.loads(r.read())
+
+
+names = get()["series_names"]
+for want in ("fleet_request_rate", "fleet_request_rate_forecast",
+             "serving_queue_depth", "fleet_p99_ms",
+             "serving_worker_rss_bytes", "serving_compile_cache_entries"):
+    assert want in names, f"{want} missing from history ({names})"
+
+raw = get("?series=fleet_request_rate")["points"]
+rolled = get("?series=fleet_request_rate&step=10s")["points"]
+assert len(raw) > 40 and rolled, (len(raw), len(rolled))
+brute = {}
+for p in raw:
+    t0 = (p["t"] // 10.0) * 10.0
+    b = brute.setdefault(t0, {"t": t0, "n": 0, "sum": 0.0,
+                              "min": p["value"], "max": p["value"]})
+    b["n"] += 1
+    b["sum"] += p["value"]
+    b["min"] = min(b["min"], p["value"])
+    b["max"] = max(b["max"], p["value"])
+    b["last"] = p["value"]
+for r in rolled:
+    b = brute[r["t"]]
+    assert (r["n"], r["min"], r["max"], r["last"]) == \
+        (b["n"], b["min"], b["max"], b["last"]), (r, b)
+    assert abs(r["mean"] - b["sum"] / b["n"]) < 1e-9, (r, b)
+print(f"smoke: rollups OK ({len(rolled)} 10s buckets == brute force)")
+
+# Positive lead: forecast crosses the 6 req/s rated line before the
+# measured rate's 10s-mean breach bucket starts.
+cap = 6.0
+fc = get("?series=fleet_request_rate_forecast")["points"]
+t_fc = next(p["t"] for p in fc if p["value"] >= cap)
+t_breach = next(r["t"] for r in rolled if r["mean"] >= cap)
+lead = t_breach - t_fc
+assert lead > 0, f"no predictive lead: forecast@{t_fc} breach@{t_breach}"
+print(f"smoke: forecast lead OK (+{lead:.1f}s before the breach bucket)")
+
+for q, code in (("?series=nope", 404), ("?series=fleet_p99_ms&window=-1",
+                                        400),
+                ("?series=fleet_p99_ms&step=7h", 400)):
+    try:
+        get(q)
+    except urllib.error.HTTPError as e:
+        e.read()
+        assert e.code == code, (q, e.code)
+    else:
+        raise AssertionError(f"{q} did not fail")
+with urllib.request.urlopen(
+        base + "?series=fleet_request_rate&step=10s&format=csv",
+        timeout=5) as r:
+    assert r.headers["Content-Type"] == "text/csv"
+    lines = r.read().decode().strip().splitlines()
+assert lines[0].split(",")[0] == "t" and len(lines) == len(rolled) + 1
+print("smoke: history HTTP surface OK (404/400/CSV)")
+PY
+
+# Phase 6 — loadgen timeline -> history round trip: same series names,
+# one sample per second, rollups immediately queryable.
+python - "$workdir/load.json" <<'PY'
+import json, sys
+from ntxent_tpu import obs
+out = json.load(open(sys.argv[1]))
+hist = obs.MetricHistory()
+n = obs.ingest_timeline(hist, out["timeline"])
+assert n > 50, f"thin ingest: {n} samples from the replay timeline"
+raw = hist.query("fleet_request_rate")["points"]
+rolled = hist.query("fleet_request_rate", step="10s")["points"]
+assert len(raw) > 20 and rolled, (len(raw), len(rolled))
+assert sum(p["value"] for p in raw) == out["offered"]
+print(f"smoke: timeline ingest OK ({n} samples, "
+      f"{len(rolled)} rollup buckets)")
+PY
+
+# Phase 7 — durable spill: SIGTERM the fleet; the store must land in
+# --history-dir and reopen with the same series.
+kill "$fleet_pid"; wait "$fleet_pid" 2>/dev/null || true; fleet_pid=""
+python - "$workdir/history" <<'PY'
+import sys
+from ntxent_tpu import obs
+hist = obs.MetricHistory(spill_dir=sys.argv[1])
+names = hist.series_names()
+assert "fleet_request_rate" in names and "fleet_p99_ms" in names, names
+assert hist.query("fleet_request_rate")["points"], \
+    "raw ring empty after reopen"
+print(f"smoke: durable reopen OK ({len(names)} series)")
+PY
+
+echo "=== history smoke PASSED in $((SECONDS - t_start))s"

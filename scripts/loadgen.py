@@ -346,13 +346,19 @@ def summarize(results: list[tuple[float, str, str, float, str]],
         latencies.append(ms)
         if status == "200":
             ok_latencies.append(ms)
-        sec = timeline.setdefault(int(offset), {"offered": 0,
-                                                "errors": 0,
-                                                "max_ms": 0.0})
-        sec["offered"] += 1
-        sec["max_ms"] = max(sec["max_ms"], round(ms, 1))
+        # Bucket keys ARE history series names (ISSUE 18): one-second
+        # buckets at one sample per second, so obs.ingest_timeline
+        # round-trips a saved replay straight into a MetricHistory and
+        # the rollup/anomaly machinery reads it like live federation.
+        sec = timeline.setdefault(int(offset),
+                                  {"fleet_request_rate": 0,
+                                   "fleet_error_rate": 0,
+                                   "fleet_latency_max_ms": 0.0})
+        sec["fleet_request_rate"] += 1
+        sec["fleet_latency_max_ms"] = max(sec["fleet_latency_max_ms"],
+                                          round(ms, 1))
         if status not in ("200", "429"):
-            sec["errors"] += 1
+            sec["fleet_error_rate"] += 1
     latencies.sort()
     ok_latencies.sort()
     n_5xx = sum(c for s, c in status_counts.items()

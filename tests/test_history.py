@@ -1,0 +1,693 @@
+"""Fleet time-series plane (ISSUE 18).
+
+The store is tested as the bounded data structure it is (staged
+rollups pinned EXACTLY against brute-force bucketing of the raw ring,
+retention caps, the stage-fsync-rename spill round-trip), the detector
+and forecaster as pure state machines on synthetic streams (warmup
+gating, exactly-one-incident lifecycle, breach-excluded baselines,
+Holt-Winters convergence and hard bounds), and the predictive
+autoscale path through ``step_signals`` on fake clocks — the forecast
+proposes, the reactive cascade still outranks it, and the controller's
+own gates keep commanding. Everything here is JAX-free stdlib.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import math
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ntxent_tpu import obs
+from ntxent_tpu.obs.history import (
+    DEFAULT_SERIES,
+    AnomalyDetector,
+    Forecaster,
+    HistoryRecorder,
+    MetricHistory,
+    SeriesSpec,
+    ingest_timeline,
+)
+from ntxent_tpu.obs.registry import MetricsRegistry
+from ntxent_tpu.serving import WorkerPool
+from ntxent_tpu.serving.autoscale import AutoscaleController
+from ntxent_tpu.serving.router import FleetRouter
+
+pytestmark = pytest.mark.history
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# the store: raw ring + staged rollups
+
+
+def brute_rollup(samples, step_s):
+    """Reference bucketing: what the staged rollup must equal."""
+    buckets: dict[float, list[float]] = {}
+    order: list[float] = []
+    for t, v in samples:
+        start = math.floor(t / step_s) * step_s
+        if start not in buckets:
+            buckets[start] = []
+            order.append(start)
+        buckets[start].append(v)
+    return [{"t": s, "min": min(vs), "max": max(vs),
+             "mean": sum(vs) / len(vs), "last": vs[-1], "n": len(vs)}
+            for s, vs in ((s, buckets[s]) for s in order)]
+
+
+class TestMetricHistory:
+    def test_rollups_match_brute_force_exactly(self):
+        hist = MetricHistory(raw_len=500, rollup_len=500)
+        samples = [(100.0 + 0.7 * i, math.sin(i) * 10.0 + i * 0.3)
+                   for i in range(200)]
+        for t, v in samples:
+            assert hist.record("s", v, t=t)
+        for step, step_s in (("10s", 10.0), ("1m", 60.0)):
+            got = hist.query("s", step=step)["points"]
+            want = brute_rollup(samples, step_s)
+            assert len(got) == len(want)
+            for g, w in zip(got, want):
+                assert g["t"] == w["t"]
+                assert g["n"] == w["n"]
+                assert g["min"] == w["min"]
+                assert g["max"] == w["max"]
+                assert g["last"] == w["last"]
+                assert g["mean"] == pytest.approx(w["mean"], abs=1e-9)
+
+    def test_raw_ring_keeps_newest(self):
+        hist = MetricHistory(raw_len=5, rollup_len=5)
+        for i in range(10):
+            hist.record("s", float(i), t=float(i))
+        pts = hist.query("s")["points"]
+        assert [p["value"] for p in pts] == [5.0, 6.0, 7.0, 8.0, 9.0]
+
+    def test_open_bucket_is_queryable(self):
+        # A query must see every recorded sample, sealed or not.
+        hist = MetricHistory()
+        hist.record("s", 3.0, t=12.0)
+        pts = hist.query("s", step="10s")["points"]
+        assert pts == [{"t": 10.0, "min": 3.0, "max": 3.0, "mean": 3.0,
+                        "last": 3.0, "n": 1}]
+
+    def test_clock_regression_folds_into_open_bucket(self):
+        # A backwards timestamp must never rewrite sealed history.
+        hist = MetricHistory()
+        hist.record("s", 1.0, t=25.0)
+        hist.record("s", 2.0, t=21.0)
+        pts = hist.query("s", step="10s")["points"]
+        assert len(pts) == 1 and pts[0]["n"] == 2
+
+    def test_nonfinite_and_garbage_refused(self):
+        hist = MetricHistory()
+        assert not hist.record("s", float("nan"))
+        assert not hist.record("s", float("inf"))
+        assert not hist.record("s", "bogus")
+        assert not hist.record("s", None)
+        assert hist.series_names() == []
+
+    def test_series_cap_drops_and_counts(self):
+        reg = MetricsRegistry()
+        hist = MetricHistory(max_series=2, registry=reg)
+        assert hist.record("a", 1.0, t=1.0)
+        assert hist.record("b", 1.0, t=1.0)
+        assert not hist.record("c", 1.0, t=1.0)
+        assert hist.record("a", 2.0, t=2.0)  # existing series still lands
+        dropped = [m for m in reg.dump_state()["metrics"]
+                   if m["name"] == "obs_history_dropped_series_total"]
+        assert dropped and dropped[0]["value"] == 1.0
+
+    def test_query_validates(self):
+        hist = MetricHistory()
+        hist.record("s", 1.0, t=1.0)
+        with pytest.raises(KeyError):
+            hist.query("nope")
+        with pytest.raises(ValueError):
+            hist.query("s", step="7h")
+        with pytest.raises(ValueError):
+            hist.query("s", window_s=-1.0)
+        # Numeric step spellings are accepted.
+        assert hist.query("s", step=10)["step"] == "10s"
+
+    def test_window_is_relative_to_the_data(self):
+        # A replayed timeline queries the same way a live fleet does.
+        hist = MetricHistory()
+        for t in (100.0, 150.0, 200.0):
+            hist.record("s", t, t=t)
+        pts = hist.query("s", window_s=60.0)["points"]
+        assert [p["t"] for p in pts] == [150.0, 200.0]
+
+
+class TestDurableSpill:
+    def test_spill_reopen_round_trip(self, tmp_path):
+        spill = str(tmp_path / "history")
+        hist = MetricHistory(spill_dir=spill)
+        for i in range(25):
+            hist.record("a", float(i), t=100.0 + i)
+        hist.record("b", 7.0, t=100.0)
+        path = hist.spill()
+        assert path is not None and os.path.exists(path)
+        reopened = MetricHistory(spill_dir=spill)
+        assert reopened.series_names() == ["a", "b"]
+        assert (reopened.query("a")["points"]
+                == hist.query("a")["points"])
+        assert (reopened.query("a", step="10s")["points"]
+                == hist.query("a", step="10s")["points"])
+
+    def test_spill_is_atomic_no_tmp_left_behind(self, tmp_path):
+        spill = str(tmp_path / "history")
+        hist = MetricHistory(spill_dir=spill)
+        hist.record("a", 1.0, t=1.0)
+        hist.spill()
+        leftovers = [f for f in os.listdir(spill) if ".tmp" in f]
+        assert leftovers == []
+
+    def test_maybe_spill_respects_interval(self, tmp_path):
+        clock = FakeClock()
+        hist = MetricHistory(spill_dir=str(tmp_path / "h"),
+                             spill_interval_s=30.0, clock=clock)
+        hist.record("a", 1.0)
+        assert hist.maybe_spill() is not None  # first call spills
+        assert hist.maybe_spill() is None      # interval not elapsed
+        clock.advance(31.0)
+        assert hist.maybe_spill() is not None
+
+    def test_close_spills_without_a_dir_is_noop(self):
+        hist = MetricHistory()
+        hist.record("a", 1.0, t=1.0)
+        assert hist.spill() is None
+        hist.close()
+
+
+# ---------------------------------------------------------------------------
+# the recorder: merged registry -> scalar series
+
+
+def _merged(total=0.0, depth=0.0, lat=(), rss=None):
+    reg = MetricsRegistry()
+    reg.counter("fleet_requests_total").inc(total)
+    reg.gauge("serving_queue_depth",
+              labels={"instance": "w0"}).set(depth)
+    h = reg.histogram("fleet_latency_ms", labels={"stage": "total"})
+    for v in lat:
+        h.observe(v)
+    if rss is not None:
+        reg.gauge("serving_worker_rss_bytes",
+                  labels={"instance": "w0"}).set(rss)
+    return reg
+
+
+class TestHistoryRecorder:
+    def test_counter_rate_needs_two_ticks_then_is_delta_over_dt(self):
+        clock = FakeClock()
+        hist = MetricHistory(clock=clock)
+        rec = HistoryRecorder(hist, clock=clock)
+        out = rec.on_merge(_merged(total=100.0))
+        assert "fleet_request_rate" not in out  # no prior sample yet
+        clock.advance(2.0)
+        out = rec.on_merge(_merged(total=150.0))
+        assert out["fleet_request_rate"] == pytest.approx(25.0)
+
+    def test_counter_reset_clamps_rate_to_zero(self):
+        # A restarted worker's counters drop; rate must read 0, never
+        # negative.
+        clock = FakeClock()
+        rec = HistoryRecorder(MetricHistory(clock=clock), clock=clock)
+        rec.on_merge(_merged(total=100.0))
+        clock.advance(1.0)
+        out = rec.on_merge(_merged(total=10.0))
+        assert out["fleet_request_rate"] == 0.0
+
+    def test_gauge_and_quantile_series_land_in_the_store(self):
+        clock = FakeClock()
+        hist = MetricHistory(clock=clock)
+        rec = HistoryRecorder(hist, clock=clock)
+        out = rec.on_merge(_merged(depth=4.0, lat=[10.0] * 99 + [500.0]))
+        assert out["serving_queue_depth"] == 4.0
+        assert out["fleet_p99_ms"] == 500.0
+        assert out["fleet_latency_max_ms"] == 500.0
+        assert hist.query("serving_queue_depth")["points"][-1]["value"] \
+            == 4.0
+
+    def test_max_series_sees_a_spike_p99_cannot(self):
+        # The reason fleet_latency_max_ms exists: a handful of stalled
+        # requests inside a big window move the max, not the p99.
+        rec = HistoryRecorder(MetricHistory())
+        out = rec.on_merge(_merged(lat=[10.0] * 400 + [3000.0] * 2))
+        assert out["fleet_p99_ms"] == 10.0
+        assert out["fleet_latency_max_ms"] == 3000.0
+
+    def test_recorder_never_raises(self):
+        rec = HistoryRecorder(MetricHistory())
+        assert rec.on_merge(object()) == {}
+
+    def test_recorder_feeds_the_detector(self):
+        clock = FakeClock()
+        det = AnomalyDetector(warmup=2, mad_factor=3.0)
+        rec = HistoryRecorder(MetricHistory(clock=clock),
+                              detector=det, clock=clock)
+        for v in (5.0, 5.1, 4.9, 5.0, 200.0):
+            rec.on_merge(_merged(depth=v))
+            clock.advance(1.0)
+        assert det.firing() == ["serving_queue_depth"]
+
+    def test_default_series_schema_is_the_contract(self):
+        names = [s.name for s in DEFAULT_SERIES]
+        assert len(names) == len(set(names))
+        for expected in ("fleet_request_rate", "serving_queue_depth",
+                         "fleet_p99_ms", "fleet_latency_max_ms",
+                         "serving_worker_rss_bytes",
+                         "serving_compile_cache_entries"):
+            assert expected in names
+
+    def test_series_spec_validates_mode(self):
+        with pytest.raises(ValueError):
+            SeriesSpec("x", "m", mode="bogus")
+
+
+# ---------------------------------------------------------------------------
+# the detector: rolling median + MAD, exactly-one-incident lifecycle
+
+
+def _feed(det, values, series="s", t0=0.0):
+    return [det.observe(series, v, t=t0 + i)
+            for i, v in enumerate(values)]
+
+
+class TestAnomalyDetector:
+    def test_warmup_gates_judgement(self):
+        det = AnomalyDetector(warmup=10, mad_factor=3.0)
+        # Wild values DURING warmup never fire — a cold start's ramp
+        # is not an incident.
+        assert not any(_feed(det, [1.0, 500.0, 2.0, 900.0, 3.0]))
+        assert det.firing() == []
+
+    def test_spike_fires_exactly_once_then_resolves(self):
+        store = obs.AlertStore()
+        det = AnomalyDetector(store=store, warmup=5, mad_factor=6.0,
+                              clear_ticks=3)
+        opened = _feed(det, [10.0, 10.1, 9.9, 10.0, 10.05])
+        assert not any(opened)
+        assert det.observe("s", 500.0, t=10.0) is True   # opens
+        assert det.observe("s", 510.0, t=11.0) is False  # refresh, no re-fire
+        assert det.firing() == ["s"]
+        assert [a["name"] for a in store.active()] == ["anomaly:s"]
+        for i in range(3):
+            det.observe("s", 10.0, t=20.0 + i)
+        assert det.firing() == []
+        assert store.active() == []
+
+    def test_breach_stays_out_of_its_own_baseline(self):
+        det = AnomalyDetector(warmup=5, mad_factor=6.0, clear_ticks=2)
+        _feed(det, [10.0, 10.1, 9.9, 10.0, 10.05])
+        for i in range(40):
+            det.observe("s", 500.0, t=100.0 + i)
+        # 40 breach ticks later the baseline still judges 500 anomalous
+        # — an incident must not normalize itself into the window.
+        assert det.firing() == ["s"]
+
+    def test_flat_series_needs_a_material_spike(self):
+        det = AnomalyDetector(warmup=5, mad_factor=6.0, rel_floor=0.05)
+        _feed(det, [100.0] * 5)
+        # MAD 0, rel floor 5 -> threshold 30: jitter stays silent.
+        assert det.observe("s", 120.0, t=10.0) is False
+        assert det.observe("s", 200.0, t=11.0) is True
+
+    def test_watch_set_scopes_the_pager(self):
+        det = AnomalyDetector(warmup=2, mad_factor=3.0,
+                              watch={"watched"})
+        _feed(det, [1.0, 1.0, 1.0, 900.0], series="ignored")
+        assert det.firing() == []
+        _feed(det, [1.0, 1.0, 1.0, 900.0], series="watched")
+        assert det.firing() == ["watched"]
+
+    def test_incident_counts_under_series_label(self):
+        reg = MetricsRegistry()
+        det = AnomalyDetector(warmup=2, mad_factor=3.0, registry=reg)
+        _feed(det, [1.0, 1.0, 1.0, 900.0])
+        fired = [m for m in reg.dump_state()["metrics"]
+                 if m["name"] == "obs_anomalies_total"]
+        assert len(fired) == 1
+        assert fired[0]["labels"] == {"series": "s"}
+        assert fired[0]["value"] == 1.0
+
+    def test_fire_emits_typed_event_and_one_flight_dump(self, tmp_path):
+        log = obs.EventLog(str(tmp_path / "events.jsonl"))
+        previous = obs.install(log)
+        try:
+            det = AnomalyDetector(warmup=2, mad_factor=3.0)
+            _feed(det, [1.0, 1.0, 1.0, 900.0, 905.0])
+            log.flush()
+            events = obs.read_events(str(tmp_path / "events.jsonl"),
+                                     event="anomaly")
+            assert len(events) == 1
+            assert events[0]["series"] == "s"
+            assert events[0]["state"] == "firing"
+            flights = list(tmp_path.glob("flight_*.jsonl"))
+            assert len(flights) == 1
+            header = json.loads(flights[0].read_text().splitlines()[0])
+            assert header["reason"] == "anomaly:s"
+        finally:
+            obs.install(previous)
+            log.close()
+
+    def test_constructor_validates(self):
+        with pytest.raises(ValueError):
+            AnomalyDetector(warmup=1)
+        with pytest.raises(ValueError):
+            AnomalyDetector(mad_factor=0.0)
+
+
+# ---------------------------------------------------------------------------
+# the forecaster: Holt-Winters on irregular ticks, hard-bounded
+
+
+class TestForecaster:
+    def test_no_opinion_until_min_samples(self):
+        f = Forecaster(min_samples=5)
+        for i in range(4):
+            f.observe(float(i), 10.0)
+            assert f.forecast(10.0) is None
+        f.observe(4.0, 10.0)
+        assert f.forecast(10.0) is not None
+
+    def test_linear_ramp_projects_ahead(self):
+        # value = 2t: after convergence the 10 s forecast must lead the
+        # last observation by roughly 2*10 (generous tolerance — double
+        # smoothing converges, it does not interpolate).
+        f = Forecaster(min_samples=8)
+        for i in range(60):
+            f.observe(float(i), 2.0 * i)
+        got = f.forecast(10.0)
+        want = 2.0 * (59 + 10)
+        assert got == pytest.approx(want, rel=0.15)
+
+    def test_forecast_is_hard_bounded(self):
+        f = Forecaster(min_samples=2, bound_min=0.0, bound_max=50.0)
+        for i in range(20):
+            f.observe(float(i), 100.0 * i)  # wild ramp
+        assert f.forecast(60.0) == 50.0
+        g = Forecaster(min_samples=2)
+        for i in range(20):
+            g.observe(float(i), 100.0 - 50.0 * i)
+        assert g.forecast(60.0) == 0.0  # default floor: never negative
+
+    def test_out_of_order_and_garbage_ticks_ignored(self):
+        f = Forecaster(min_samples=2)
+        f.observe(10.0, 5.0)
+        f.observe(9.0, 900.0)       # rewind: dropped
+        f.observe(10.0, 900.0)      # same tick: dropped
+        f.observe(11.0, float("nan"))
+        assert f.n == 1
+
+    def test_dt_normalized_trend_survives_tick_jitter(self):
+        # The same ramp at regular and jittered cadence must agree —
+        # federation-tick jitter is not trend.
+        reg, jit = Forecaster(), Forecaster()
+        t = 0.0
+        for i in range(40):
+            reg.observe(float(i), 3.0 * i)
+        for i in range(40):
+            t += 0.5 if i % 2 else 1.5
+            jit.observe(t, 3.0 * t)
+        assert jit.forecast(5.0) == pytest.approx(
+            3.0 * (t + 5.0), rel=0.2)
+
+    def test_seasonal_term_returns_finite_values(self):
+        f = Forecaster(season_s=60.0, min_samples=8)
+        for i in range(120):
+            f.observe(float(i), 10.0 + 5.0 * math.sin(
+                2 * math.pi * i / 60.0))
+        got = f.forecast(15.0)
+        assert got is not None and math.isfinite(got)
+
+    def test_constructor_validates(self):
+        with pytest.raises(ValueError):
+            Forecaster(alpha=0.0)
+        with pytest.raises(ValueError):
+            Forecaster(beta=1.5)
+        with pytest.raises(ValueError):
+            Forecaster(season_s=-1.0)
+
+
+# ---------------------------------------------------------------------------
+# predictive autoscale: the forecast proposes, the cascade decides
+
+
+class FakeWorkerRec:
+    def __init__(self, worker_id: str):
+        self.worker_id = worker_id
+
+
+class FakeFleet:
+    def __init__(self, ids):
+        self.members = list(ids)
+        self.autoscaler = None
+        self.on_spike = None
+
+    def workers_snapshot(self):
+        return [FakeWorkerRec(i) for i in self.members]
+
+    def add_worker(self):
+        wid = f"w{len(self.members)}"
+        self.members.append(wid)
+        return FakeWorkerRec(wid)
+
+    def retire_worker(self, worker_id, grace_s: float = 5.0) -> bool:
+        self.members.remove(worker_id)
+        return True
+
+
+def make_controller(n=1, clock=None, **kw):
+    fleet = FakeFleet([f"w{i}" for i in range(n)])
+    pool = WorkerPool()
+    for i in range(n):
+        pool.upsert(f"w{i}", f"http://127.0.0.1:{9000 + i}")
+        pool.set_health(f"w{i}", alive=True, ready=True,
+                        checkpoint_step=0)
+    kw.setdefault("min_workers", 1)
+    kw.setdefault("max_workers", 4)
+    kw.setdefault("up_ticks", 1)
+    kw.setdefault("idle_ticks", 3)
+    kw.setdefault("up_cooldown_s", 10.0)
+    kw.setdefault("down_cooldown_s", 20.0)
+    ctl = AutoscaleController(fleet, pool,
+                              clock=clock or FakeClock(), **kw)
+    return ctl, fleet, pool
+
+
+def sig(ctl, *, queue=0.0, inflight=0.0, p99=None, burn=None,
+        rss=None, f_rate=None, f_queue=None):
+    routable = sum(1 for w in ctl.pool.workers() if w.ready
+                   and w.worker_id not in ctl._draining)
+    return {"queue_depth": queue, "inflight": inflight,
+            "routable": routable, "size": ctl.pool_size(),
+            "p99_ms": p99, "burn": burn, "rss_bytes": rss,
+            "forecast_rate": f_rate, "forecast_queue_depth": f_queue}
+
+
+class TestPredictiveAutoscale:
+    def test_forecast_queue_projection_scales_up(self):
+        ctl, _, _ = make_controller(1, predict_horizon_s=30.0)
+        assert ctl.step_signals(sig(ctl, f_queue=8.0)) \
+            == ("up", "forecast")
+
+    def test_forecast_rate_projection_scales_up(self):
+        ctl, _, _ = make_controller(1, predict_horizon_s=30.0,
+                                    predict_capacity=6.0)
+        assert ctl.step_signals(sig(ctl, f_rate=6.0)) \
+            == ("up", "forecast")
+
+    def test_forecast_rate_needs_a_rated_capacity(self):
+        # Without --predict-capacity only the queue projection fires.
+        ctl, _, _ = make_controller(1, predict_horizon_s=30.0)
+        assert ctl.step_signals(sig(ctl, f_rate=999.0)) \
+            == ("hold", "steady")
+
+    def test_reactive_pressure_outranks_forecast(self):
+        # Scale-DOWN stays reactive and real breaches name themselves:
+        # the forecast is the LAST rung of the pressure cascade.
+        ctl, _, _ = make_controller(1, predict_horizon_s=30.0,
+                                    predict_capacity=6.0)
+        assert ctl.step_signals(
+            sig(ctl, queue=100.0, f_rate=999.0)) == ("up", "queue_depth")
+
+    def test_forecast_respects_streak_and_max(self):
+        clock = FakeClock()
+        ctl, fleet, _ = make_controller(1, clock=clock, up_ticks=2,
+                                        max_workers=2,
+                                        predict_horizon_s=30.0)
+        assert ctl.step_signals(sig(ctl, f_queue=8.0)) \
+            == ("hold", "forecast:streak")
+        assert ctl.step_signals(sig(ctl, f_queue=8.0)) \
+            == ("up", "forecast")
+        fleet.add_worker()
+        clock.advance(100.0)
+        assert ctl.step_signals(sig(ctl, f_queue=80.0)) \
+            == ("hold", "forecast:at_max")
+
+    def test_rss_pressure_scales_up_when_configured(self):
+        ctl, _, _ = make_controller(1, up_rss_bytes=1 << 30)
+        assert ctl.step_signals(sig(ctl, rss=float(1 << 30))) \
+            == ("up", "rss")
+        ctl2, _, _ = make_controller(1)  # unconfigured: ignored
+        assert ctl2.step_signals(sig(ctl2, rss=float(1 << 40))) \
+            == ("hold", "steady")
+
+    def test_no_routable_arms_only_after_first_routable_tick(self):
+        # A cold boot (seed worker still compiling) must not read as
+        # "all workers wedged" and scale the pool toward max.
+        ctl, _, pool = make_controller(1, predict_horizon_s=30.0)
+        pool.set_health("w0", alive=True, ready=False,
+                        checkpoint_step=0)
+        assert ctl.step_signals(sig(ctl)) == ("hold", "steady")
+        pool.set_health("w0", alive=True, ready=True,
+                        checkpoint_step=0)
+        assert ctl.step_signals(sig(ctl)) == ("hold", "steady")
+        pool.set_health("w0", alive=True, ready=False,
+                        checkpoint_step=0)
+        assert ctl.step_signals(sig(ctl)) == ("up", "no_routable")
+
+    def test_constructor_validates_predict_params(self):
+        with pytest.raises(ValueError):
+            make_controller(1, predict_horizon_s=0.0)
+        with pytest.raises(ValueError):
+            make_controller(1, predict_horizon_s=30.0,
+                            predict_capacity=-1.0)
+        with pytest.raises(ValueError):
+            make_controller(1, up_rss_bytes=0)
+
+    def test_signals_carry_rate_rss_and_forecasts(self):
+        clock = FakeClock()
+        hist = MetricHistory(clock=clock)
+        ctl, _, _ = make_controller(
+            1, clock=clock, predict_horizon_s=10.0,
+            predict_capacity=50.0, up_rss_bytes=1 << 40, history=hist)
+        ctl.signals(_merged(total=0.0, rss=123.0))
+        for i in range(1, 12):
+            clock.advance(1.0)
+            s = ctl.signals(_merged(total=100.0 * i, depth=2.0,
+                                    rss=123.0))
+        assert s["rate"] == pytest.approx(100.0)
+        assert s["rss_bytes"] == 123.0
+        assert s["forecast_rate"] is not None
+        assert s["forecast_queue_depth"] is not None
+        # The controller writes its projections back into the history
+        # so the smoke (and an operator) can chart forecast vs actual.
+        names = hist.series_names()
+        assert "fleet_request_rate_forecast" in names
+        assert "serving_queue_depth_forecast" in names
+
+
+# ---------------------------------------------------------------------------
+# loadgen timeline round-trip
+
+
+class TestIngestTimeline:
+    def test_timeline_buckets_are_history_samples(self):
+        hist = MetricHistory()
+        timeline = [
+            {"t": 0, "fleet_request_rate": 5,
+             "fleet_error_rate": 0, "fleet_latency_max_ms": 12.5},
+            {"t": 1, "fleet_request_rate": 7,
+             "fleet_error_rate": 1, "fleet_latency_max_ms": 80.0},
+        ]
+        n = ingest_timeline(hist, timeline, t0=1000.0)
+        assert n == 6
+        pts = hist.query("fleet_request_rate")["points"]
+        assert [(p["t"], p["value"]) for p in pts] \
+            == [(1000.0, 5.0), (1001.0, 7.0)]
+        assert hist.query("fleet_latency_max_ms",
+                          step="10s")["points"][0]["max"] == 80.0
+
+
+# ---------------------------------------------------------------------------
+# the HTTP surface: /metrics/history on the fleet router
+
+
+def _get(router, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.port}{path}", timeout=15) as r:
+        return r.headers.get("Content-Type", ""), r.read()
+
+
+class TestHistoryEndpoint:
+    def _router(self):
+        router = FleetRouter(WorkerPool(), example_shape=(2,), port=0)
+        hist = MetricHistory()
+        for i in range(15):
+            hist.record("fleet_request_rate", float(i), t=100.0 + i)
+        router.history = hist
+        router.start()
+        return router
+
+    def test_unattached_router_503s(self):
+        router = FleetRouter(WorkerPool(), example_shape=(2,), port=0)
+        router.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(router, "/metrics/history")
+            assert exc.value.code == 503
+        finally:
+            router.close()
+
+    def test_index_query_rollup_and_errors(self):
+        router = self._router()
+        try:
+            _, body = _get(router, "/metrics/history")
+            index = json.loads(body)
+            assert index["series_names"] == ["fleet_request_rate"]
+            assert index["raw_samples"] == 15
+            _, body = _get(
+                router, "/metrics/history?series=fleet_request_rate")
+            payload = json.loads(body)
+            assert payload["step"] == "raw"
+            assert len(payload["points"]) == 15
+            _, body = _get(router, "/metrics/history"
+                           "?series=fleet_request_rate&step=10s"
+                           "&window=20")
+            rolled = json.loads(body)
+            assert rolled["step"] == "10s"
+            assert all(p["n"] >= 1 for p in rolled["points"])
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(router, "/metrics/history?series=nope")
+            assert exc.value.code == 404
+            assert "series" in json.loads(exc.value.read())
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(router, "/metrics/history"
+                     "?series=fleet_request_rate&window=-5")
+            assert exc.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(router, "/metrics/history"
+                     "?series=fleet_request_rate&step=7h")
+            assert exc.value.code == 400
+        finally:
+            router.close()
+
+    def test_csv_round_trips(self):
+        router = self._router()
+        try:
+            ctype, body = _get(
+                router, "/metrics/history?series=fleet_request_rate"
+                "&format=csv")
+            assert ctype.startswith("text/csv")
+            rows = list(csv.DictReader(io.StringIO(body.decode())))
+            assert len(rows) == 15
+            assert float(rows[-1]["value"]) == 14.0
+        finally:
+            router.close()
