@@ -2229,6 +2229,203 @@ _QUANT_ENV = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8",
               "JAX_PLATFORMS": "cpu", "NTXENT_BENCH_FORCE_CPU": "1"}
 
 
+def _overlap_child() -> None:
+    """--overlap measurement: the chunked ring-overlap distributed loss
+    vs the monolithic all-gather schedule (ISSUE 19).
+
+    Runs on the same FORCED 8-virtual-device CPU mesh as --quant so the
+    collective byte model is trace-time static and the committed record
+    reproduces exactly on any host. Four arms over one seeded normalized
+    embedding batch, each timing the jitted fused value-and-grad step
+    (the train-step shape — the schedule must pay off through the
+    backward, not just the forward):
+
+    * ``monolithic_f32`` / ``chunked_f32`` — the structural A/B. The
+      committed claims: EXACT wire-byte parity (the chunked schedule is
+      a re-timing of the same ring traffic, N ppermutes in place of one
+      all-gather — never extra bytes), strictly more collective calls
+      (that is what buys the overlap window), and chunked steps/s at or
+      above monolithic. On CPU there is no async DMA to hide, so the
+      wall-clock floor is parity; the measured win here comes from the
+      blockwise fold's memory locality (never materializing the full
+      (2n, 2N) similarity row block). The on-chip overlap window itself
+      is the TPU-tier claim, measured by
+      ``training.trainer.measure_comms_overlap`` / ``--measure-overlap``.
+    * ``monolithic_int8`` / ``chunked_int8`` — the same A/B under the
+      PR 11 int8 wire policy: per-chunk quantization must preserve the
+      committed ``bytes_ratio_int8 >= 3`` (int8 payload + per-row f32
+      scale columns), i.e. the PR 11 byte cut SURVIVES chunking, and the
+      int8 arms must also hold exact byte parity with each other.
+    * loss/grad parity — the chunked f32 loss and embedding gradients
+      must match the monolithic ones to float tolerance (the online
+      softmax fold is a reassociation, not an approximation), and the
+      int8 arms must agree with each other (both quantize per row, so
+      they see the SAME wire values).
+
+    The chunk count comes from ``ops.autotune.resolve_ring_chunks`` —
+    the record pins what the CPU-safe deterministic heuristic actually
+    picks, not a hand-tuned constant.
+    """
+    import jax
+
+    if os.environ.get("NTXENT_BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    import contextlib
+    import statistics
+
+    import numpy as np
+
+    backend = _child_backend(jax)
+    n_dev = jax.device_count()
+
+    import jax.numpy as jnp
+
+    from ntxent_tpu.ops.autotune import resolve_ring_chunks
+    from ntxent_tpu.parallel import mesh as pm
+    from ntxent_tpu.parallel.dist_loss import make_sharded_ntxent
+    from ntxent_tpu.parallel.precision import collective_precision
+
+    # Sized so (a) each per-chunk ppermute block clears the int8
+    # eligibility floor (precision.MIN_QUANT_ELEMS) — the int8 arms
+    # really quantize — and (b) the per-step work is tens of ms, far
+    # above the CPU timer/scheduler noise floor.
+    n_local = int(os.environ.get("NTXENT_OVERLAP_N_LOCAL", "64"))
+    dim = int(os.environ.get("NTXENT_OVERLAP_DIM", "512"))
+    reps = int(os.environ.get("NTXENT_OVERLAP_REPS", "7"))
+    warmup = 2
+    temperature = 0.1
+
+    mesh = pm.create_mesh(axis_names=("data",))
+    acct = pm.comms_accounting()
+    chunks = resolve_ring_chunks(2 * n_local, dim, n_dev, jnp.float32)
+
+    rng = np.random.default_rng(0)
+    z1 = rng.standard_normal((n_local * n_dev, dim)).astype(np.float32)
+    z2 = rng.standard_normal((n_local * n_dev, dim)).astype(np.float32)
+    z1 /= np.linalg.norm(z1, axis=-1, keepdims=True)
+    z2 /= np.linalg.norm(z2, axis=-1, keepdims=True)
+
+    def measure(impl: str, policy: str | None) -> tuple[dict, np.ndarray]:
+        kwargs = {"ring_chunks": chunks} if impl == "chunked" else {}
+        loss = make_sharded_ntxent(mesh, temperature, impl=impl, **kwargs)
+        vg = jax.jit(jax.value_and_grad(lambda a, b: loss(a, b)))
+        ctx = collective_precision(policy) if policy \
+            else contextlib.nullcontext()
+        with ctx:  # policy is trace-time: must be active for EVERY trace
+            mark = acct.totals()
+            l0, g0 = vg(z1, z2)
+            jax.block_until_ready(g0)
+            # One jit traces once, so the bracketing delta IS the
+            # per-step static collective profile.
+            delta = acct.delta(mark)
+            for _ in range(warmup):
+                jax.block_until_ready(vg(z1, z2)[1])
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(vg(z1, z2)[1])
+                times.append(time.perf_counter() - t0)
+        med = statistics.median(times)
+        return {
+            "final_loss": round(float(l0), 6),
+            "comms_bytes_per_step": round(
+                sum(b for _, b in delta.values()), 1),
+            "comms_calls_per_step": sum(c for c, _ in delta.values()),
+            "step_ms": round(med * 1e3, 3),
+            "steps_per_sec": round(1.0 / med, 2),
+        }, np.asarray(g0)
+
+    arms, grads = {}, {}
+    for label, impl, policy in (
+            ("monolithic_f32", "strip", None),
+            ("chunked_f32", "chunked", None),
+            ("monolithic_int8", "strip", "int8"),
+            ("chunked_int8", "chunked", "int8")):
+        arms[label], grads[label] = measure(impl, policy)
+
+    mono, chk = arms["monolithic_f32"], arms["chunked_f32"]
+    mono8, chk8 = arms["monolithic_int8"], arms["chunked_int8"]
+    bytes_parity_f32 = abs(mono["comms_bytes_per_step"]
+                           - chk["comms_bytes_per_step"]) < 0.5
+    bytes_parity_int8 = abs(mono8["comms_bytes_per_step"]
+                            - chk8["comms_bytes_per_step"]) < 0.5
+    bytes_ratio_int8 = mono["comms_bytes_per_step"] \
+        / max(chk8["comms_bytes_per_step"], 1e-9)
+    grad_delta_f32 = float(np.max(np.abs(
+        grads["chunked_f32"] - grads["monolithic_f32"])))
+
+    payload = {
+        "metric": "comms_overlap",
+        "backend": backend,
+        "platform": backend,
+        "device_kind": jax.local_devices()[0].device_kind,
+        "devices": n_dev,
+        "n_local": n_local, "dim": dim, "chunks": chunks, "reps": reps,
+        "arms": arms,
+        "bytes_parity_f32": bytes_parity_f32,
+        "bytes_parity_int8": bytes_parity_int8,
+        "bytes_ratio_int8": round(bytes_ratio_int8, 3),
+        "speedup_chunked_f32": round(
+            chk["steps_per_sec"] / max(mono["steps_per_sec"], 1e-9), 3),
+        "speedup_chunked_int8": round(
+            chk8["steps_per_sec"] / max(mono8["steps_per_sec"], 1e-9), 3),
+        "loss_delta_f32": round(abs(chk["final_loss"]
+                                    - mono["final_loss"]), 8),
+        "loss_delta_int8": round(abs(chk8["final_loss"]
+                                     - mono8["final_loss"]), 8),
+        "grad_max_abs_delta_f32": grad_delta_f32,
+    }
+    # The acceptance bars (ISSUE 19), enforced HERE so a
+    # BENCH_overlap.json can only ever be committed passing and every
+    # --check re-run re-asserts them:
+    assert bytes_parity_f32, payload     # same ring bytes, re-timed
+    assert bytes_parity_int8, payload    # parity survives quantization
+    assert bytes_ratio_int8 >= 3.0, payload  # PR 11 cut survives chunking
+    assert chk["comms_calls_per_step"] \
+        > mono["comms_calls_per_step"], payload  # N ppermutes > 1 gather
+    assert payload["loss_delta_f32"] <= 1e-4, payload
+    assert payload["loss_delta_int8"] <= 1e-3, payload
+    assert grad_delta_f32 <= 1e-4, payload
+    # Wall-clock floor: parity (the overlap win is the TPU-tier claim);
+    # the 0.9 guard band absorbs CPU scheduler jitter on gate re-runs
+    # while the committed record itself shows the memory-locality win.
+    assert chk["steps_per_sec"] \
+        >= 0.9 * mono["steps_per_sec"], payload
+    print(SENTINEL + json.dumps(payload), flush=True)
+
+
+def _overlap_main() -> None:
+    """--overlap: A/B the chunked ring-overlap schedule against the
+    monolithic all-gather loss, write BENCH_overlap.json.
+
+    ALWAYS measured on the forced 8-virtual-device CPU mesh: byte
+    parity and the int8 ratio are trace-time static there, so the
+    committed structural claims reproduce exactly on any host. The
+    wall-clock columns are the CPU memory-locality picture; the on-chip
+    overlap window is measured separately (``--measure-overlap`` on the
+    training CLI) and belongs to the TPU tier.
+    """
+    payload, diag = _run_child(CHILD_TIMEOUT_S, force_cpu=True,
+                               child_flag="--overlap-child",
+                               extra_env=_OVERLAP_ENV)
+    if payload is None:
+        payload = {"metric": "comms_overlap", "error": diag}
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_overlap.json")
+    with open(out, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    _record_progress(payload)
+    print(json.dumps(payload))
+
+
+# The overlap A/B shares the quant tier's pinned environment: the byte
+# parity and the int8 ratio are (p-1)/p terms, comparable to the
+# committed record only at the committed device count.
+_OVERLAP_ENV = dict(_QUANT_ENV)
+
+
 def _probe_backend(timeout_s: float = 150.0) -> str | None:
     """Backend name the ambient config initializes to, probed in a
     disposable subprocess (backend init can wedge indefinitely here —
@@ -2299,7 +2496,7 @@ def _run_child(timeout_s: float, force_cpu: bool = False,
 #   they inform.
 
 GATE_CHECKS = ("pipeline", "serving", "fleet", "ragged", "obs", "quant",
-               "retrieval", "autoscale")
+               "retrieval", "autoscale", "overlap")
 GATE_TOL = 0.15
 GATE_SERVING_TOL = 0.30
 GATE_LATENCY_FLOOR_MS = 5.0
@@ -2344,6 +2541,13 @@ def _gate_spec(name: str) -> tuple[str, dict]:
         # a shortened leg would fail the in-child bars on timing, not
         # on regressions. ~45 s, stdlib-only, JAX-free.
         return "--autoscale-child", {}
+    if name == "overlap":
+        # Same pinned 8-virtual-device CPU mesh as quant — the byte
+        # parity and the int8 ratio carry (p-1)/p terms. No trimming:
+        # the child re-asserts exact f32/int8 byte parity, the >=3x
+        # int8 cut, loss/grad parity and the chunked>=monolithic
+        # wall-clock floor itself on every gate run.
+        return "--overlap-child", dict(_OVERLAP_ENV)
     raise ValueError(f"unknown gate {name!r}")
 
 
@@ -2517,6 +2721,37 @@ def gate_metrics(name: str, payload: dict | None,
             out["autoscale/workers_peak"] = {
                 "value": float(v), "higher_is_better": True,
                 "tol": GATE_TOL}
+    elif name == "overlap":
+        # The hard bars (exact byte parity, >=3x int8 cut, loss/grad
+        # parity, the wall-clock floor) live in the overlap child's own
+        # asserts; what gets COMPARED are the parity booleans
+        # (truthy-encoded: a current 0.0 fails against a committed 1.0
+        # — the structural claim itself is gated), the trace-time-
+        # static int8 byte ratio at the standard tolerance, and the
+        # chunked arm's throughput + speedup at the looser serving
+        # tolerance (CPU wall clock).
+        for key in ("bytes_parity_f32", "bytes_parity_int8"):
+            v = payload.get(key)
+            if keep(v):
+                out[f"overlap/{key}"] = {
+                    "value": float(v), "higher_is_better": True,
+                    "tol": GATE_TOL}
+        v = payload.get("bytes_ratio_int8")
+        if keep(v):
+            out["overlap/bytes_ratio_int8"] = {
+                "value": float(v), "higher_is_better": True,
+                "tol": GATE_TOL}
+        v = payload.get("speedup_chunked_f32")
+        if keep(v):
+            out["overlap/speedup_chunked_f32"] = {
+                "value": float(v), "higher_is_better": True,
+                "tol": GATE_SERVING_TOL}
+        v = (payload.get("arms") or {}).get("chunked_f32", {}) \
+            .get("steps_per_sec")
+        if keep(v):
+            out["overlap/chunked_f32/steps_per_sec"] = {
+                "value": float(v), "higher_is_better": True,
+                "tol": GATE_SERVING_TOL}
     elif name == "obs":
         # The hard <= 5% overhead bar lives in the obs child's own
         # asserts (a failing child fails the gate with an error); what
@@ -2608,9 +2843,37 @@ def compare_gate(current: dict, committed: dict,
             "failures": failures, "skipped": skipped}
 
 
+def _stray_fleet_pids() -> list[int]:
+    """PIDs of leaked fleet routers/workers (``pgrep -f fleet_main``)
+    still running when a gate measurement starts.
+
+    The ROADMAP gate-health note's first diagnostic: an aborted fleet
+    smoke leaves workers pinning cores, and every wall-clock gate
+    metric then regresses for reasons that have nothing to do with the
+    PR under test. Surfaced as a WARNING naming the PIDs — not a
+    failure, because the operator may know the load is unrelated — so
+    a red gate run carries its most likely benign explanation."""
+    try:
+        proc = subprocess.run(["pgrep", "-f", "fleet_main"],
+                              capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return []  # no pgrep (or it wedged): the pre-flight is advisory
+    me = os.getpid()
+    return [int(p) for p in proc.stdout.split()
+            if p.isdigit() and int(p) != me]
+
+
 def _check_main(args) -> int:
     """``--check``: measure quick profiles, gate against the committed
     records, append the verdict to PROGRESS.jsonl, rc 1 on regression."""
+    strays = _stray_fleet_pids()
+    if strays:
+        print("bench: WARNING stray fleet process(es) running before "
+              f"measurement — PIDs {strays} match 'pgrep -f "
+              "fleet_main'; wall-clock gate metrics may regress from "
+              "CPU contention, not from the change under test. Kill "
+              "them (or let the smoke finish) and re-run.",
+              file=sys.stderr)
     repo = os.path.dirname(os.path.abspath(__file__))
     against = args.check_against or repo
     committed: dict = {}
@@ -2658,6 +2921,7 @@ def _check_main(args) -> int:
         "metrics": result["metrics"],
         "tol_scale": args.check_tol_scale,
         "checked_against": against,
+        "stray_fleet_pids": strays,
     }
     _record_progress(record)
     print(json.dumps(record))
@@ -2785,6 +3049,16 @@ if __name__ == "__main__":
     parser.add_argument("--quant-child", action="store_true",
                         help="internal: run the quant measurement "
                              "in-process")
+    parser.add_argument("--overlap", action="store_true",
+                        help="A/B the chunked ring-overlap distributed "
+                             "loss vs the monolithic all-gather "
+                             "schedule (f32 + int8 arms on the "
+                             "8-virtual-device mesh: exact wire-byte "
+                             "parity, loss/grad parity, steps/s) and "
+                             "write BENCH_overlap.json")
+    parser.add_argument("--overlap-child", action="store_true",
+                        help="internal: run the overlap measurement "
+                             "in-process")
     parser.add_argument("--retrieval", action="store_true",
                         help="measure the ANN retrieval tier "
                              "(recall@10 vs brute force, search "
@@ -2873,6 +3147,10 @@ if __name__ == "__main__":
         _quant_child()
     elif _args.quant:
         _quant_main()
+    elif _args.overlap_child:
+        _overlap_child()
+    elif _args.overlap:
+        _overlap_main()
     elif _args.retrieval_child:
         _retrieval_child()
     elif _args.retrieval:

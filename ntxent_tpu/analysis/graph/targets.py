@@ -6,9 +6,10 @@ argument parsing never pay a trace) returning the callable + example
 args for one audit. The suite covers:
 
 * **census-fwd** — forward losses whose graph census must EXACTLY
-  match the shim-declared ring formulas (``dist_loss`` strip and the
-  ``ring`` scan path at the ambient device count): any drift means a
-  collective bypassed the shims or the byte model diverged.
+  match the shim-declared ring formulas (``dist_loss`` strip, the
+  ``ring`` scan path, and the ISSUE 19 chunked ring-overlap schedule,
+  all at the ambient device count): any drift means a collective
+  bypassed the shims or the byte model diverged.
 * **census-grad** — ``jax.grad`` through the same losses: the census
   sees the AD duals (and the old-jax transpose's residual recompute)
   the shims never fire for; the remainder over the declared sites is
@@ -84,6 +85,42 @@ def _dist_loss(mesh, grad: bool):
         loss = make_sharded_ntxent(mesh, temperature=0.1, impl="strip")
         fn = jax.grad(lambda a, b: loss(a, b)) if grad else loss
         return {"fn": fn, "args": _loss_args(mesh)}
+
+    return build
+
+
+def _dist_loss_chunked(mesh, grad: bool):
+    def build():
+        import jax
+
+        from ...parallel.dist_loss import make_sharded_ntxent
+
+        loss = make_sharded_ntxent(mesh, temperature=0.1, impl="chunked",
+                                   ring_chunks=2)
+        fn = jax.grad(lambda a, b: loss(a, b)) if grad else loss
+        return {"fn": fn, "args": _loss_args(mesh)}
+
+    return build
+
+
+def _dist_loss_chunked_int8(mesh):
+    """The chunked schedule under the int8 wire policy: every circulating
+    embedding block (2 rows x 512 dims = 1024 elems, exactly at the
+    quantization floor) must be int8 on the wire; the per-chunk scale
+    columns ride f32 legally below the floor."""
+
+    def build():
+        from ...parallel import mesh as pm
+        from ...parallel.dist_loss import make_sharded_ntxent
+
+        loss = make_sharded_ntxent(mesh, temperature=0.1, impl="chunked",
+                                   ring_chunks=2)
+
+        def fn(a, b):
+            with pm.collective_precision("int8"):
+                return loss(a, b)
+
+        return {"fn": fn, "args": _loss_args(mesh, dim=512)}
 
     return build
 
@@ -217,6 +254,10 @@ def default_targets(mesh=None) -> list[AuditTarget]:
     return [
         AuditTarget("dist_loss/fwd", "census-fwd", _dist_loss(mesh, False)),
         AuditTarget("dist_loss/grad", "census-grad", _dist_loss(mesh, True)),
+        AuditTarget("dist_loss_chunked/fwd", "census-fwd",
+                    _dist_loss_chunked(mesh, False)),
+        AuditTarget("dist_loss_chunked/grad", "census-grad",
+                    _dist_loss_chunked(mesh, True)),
         AuditTarget("ring/fwd", "census-fwd", _ring_loss(mesh, False)),
         AuditTarget("ring/grad", "census-grad", _ring_loss(mesh, True)),
         AuditTarget("gspmd/matmul", "census-gspmd", _gspmd_matmul(mesh)),
@@ -224,6 +265,8 @@ def default_targets(mesh=None) -> list[AuditTarget]:
                     _serving_rung_int8()),
         AuditTarget("grad_reduce/int8", "wire-dtype",
                     _grad_reduce(mesh, "int8"), policy="int8"),
+        AuditTarget("dist_loss_chunked/int8", "wire-dtype",
+                    _dist_loss_chunked_int8(mesh), policy="int8"),
         AuditTarget("grad_reduce/bf16", "wire-dtype",
                     _grad_reduce(mesh, "bf16"), policy="bf16"),
         AuditTarget("train_step/donated", "donation",

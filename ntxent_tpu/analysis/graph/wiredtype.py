@@ -11,11 +11,15 @@ int8/bf16 policy. The graph cannot lie about its own dtypes.
 Eligibility mirrors ``parallel/precision.py``: payloads under
 ``MIN_QUANT_ELEMS`` elements ride in full precision by design (scales
 cost more than they save — the per-chunk f32 scale columns of the int8
-schedule itself are the canonical example), and ``ppermute``/``pmax``
-never quantize (the ring losses own their schedule; a max over
-quantized values loses the extremes it exists to find). What remains —
-psum / all_gather / psum_scatter / all_to_all payloads at or above the
-floor — must be on the wire at the policy dtype.
+schedule itself are the canonical example), and ``pmax`` never
+quantizes (a max over quantized values loses the extremes it exists to
+find). Since ISSUE 19 ``ppermute`` rides the policy too — the chunked
+ring schedule circulates embedding blocks hop by hop, and a single f32
+hop would leak the whole PR 11 byte cut — so it is eligible here; the
+ring losses' small stat vectors and int32 gid blocks stay admitted by
+the element floor and the int-dtype allowance. What remains — psum /
+all_gather / psum_scatter / all_to_all / ppermute payloads at or above
+the floor — must be on the wire at the policy dtype.
 """
 
 from __future__ import annotations
@@ -24,9 +28,11 @@ from ..framework import Finding
 
 __all__ = ["ELIGIBLE_OPS", "ALLOWED_WIRE_DTYPES", "wire_dtype_findings"]
 
-# Ops the precision policy compresses (ppermute/pmax are exempt by
-# policy, annotation ops never appear in a census).
-ELIGIBLE_OPS = ("psum", "all_gather", "psum_scatter", "all_to_all")
+# Ops the precision policy compresses (pmax is exempt by policy,
+# annotation ops never appear in a census; ppermute joined with the
+# ISSUE 19 chunked ring schedule).
+ELIGIBLE_OPS = ("psum", "all_gather", "psum_scatter", "all_to_all",
+                "ppermute")
 
 # Per policy: the dtypes a payload may legally occupy on the wire.
 # float32 stays legal for int8's scale columns — but scales sit far

@@ -139,13 +139,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "--dcn-slices > 1, hybrid ZeRO (params confined "
                         "to the intra-slice ICI axis, replicated across "
                         "slices) (parallel/fsdp.py)")
-    t.add_argument("--dp-loss", default="strip", choices=["strip", "pair"],
+    t.add_argument("--dp-loss", default="strip",
+                   choices=["strip", "pair", "chunked"],
                    help="data-parallel NT-Xent decomposition: 'strip' "
-                        "(local rows x global cols per device) or 'pair' "
+                        "(local rows x global cols per device), 'pair' "
                         "(balanced shard-pair schedule — each global "
-                        "similarity tile computed once across the mesh); "
+                        "similarity tile computed once across the mesh), "
+                        "or 'chunked' (ISSUE 19: chunked ring-overlap — "
+                        "the embedding all-gather becomes ring-step "
+                        "ppermute chunks whose transfers overlap the "
+                        "similarity folds, same total wire bytes); "
                         "honored by the shard_map DP step and the "
                         "fused-loss FSDP and TP steps")
+    t.add_argument("--ring-chunks", type=int, default=None, metavar="C",
+                   help="per-hop chunk count for --dp-loss chunked "
+                        "(default: the ops.autotune cached/heuristic "
+                        "choice for the batch, dim and mesh; ignored "
+                        "with a warning for other --dp-loss values)")
+    t.add_argument("--measure-overlap", action="store_true",
+                   help="before training, A/B the chunked vs monolithic "
+                        "loss schedule on this backend and publish the "
+                        "measured overlap window through the step "
+                        "timeline (train_step_comms_overlap_ms / _frac "
+                        "+ one comms_overlap event); an accelerator "
+                        "effect — near zero on CPU, where the census "
+                        "byte parity is the meaningful claim")
     t.add_argument("--collective-dtype", default="float32",
                    choices=["float32", "bf16", "int8"],
                    help="wire precision for the distributed step's "
@@ -684,6 +702,9 @@ def main(argv=None) -> int:
     # Elastic rebuild seam, set by the data-parallel branch only (the
     # one whose world is rebuildable over a device subset in-process).
     elastic_builder = None
+    # Overlap A/B capture (--measure-overlap), set by the data-parallel
+    # branch only — the one whose loss owns the chunked ring schedule.
+    overlap_probe = None
     nan_policy = args.nan_policy
     guard_steps = nan_policy != "off"
 
@@ -805,12 +826,28 @@ def main(argv=None) -> int:
         from ntxent_tpu.training import init_error_feedback
 
         mesh = _data_mesh(args)
+        ring_chunks = args.ring_chunks if args.dp_loss == "chunked" else None
+        if args.ring_chunks is not None and args.dp_loss != "chunked":
+            logger.warning("--ring-chunks %d ignored: --dp-loss %s has no "
+                           "ring chunks (use --dp-loss chunked)",
+                           args.ring_chunks, args.dp_loss)
         step = make_sharded_train_step(mesh, cfg.temperature,
                                        remat=args.remat,
                                        loss_impl=args.dp_loss,
                                        moe_aux_weight=moe_aux,
                                        guard=guard_steps,
-                                       collective_dtype=args.collective_dtype)
+                                       collective_dtype=args.collective_dtype,
+                                       ring_chunks=ring_chunks)
+        if args.measure_overlap:
+            from ntxent_tpu.training.trainer import measure_comms_overlap
+
+            _mesh_probe, _nl = mesh, args.batch // n_dev
+
+            def overlap_probe(tl):
+                return measure_comms_overlap(
+                    _mesh_probe, _nl, args.proj_dim,
+                    temperature=cfg.temperature,
+                    ring_chunks=ring_chunks, timeline=tl)
         if args.collective_dtype != "float32":
             logger.info("quantized collectives: %s wire payloads%s",
                         args.collective_dtype,
@@ -889,11 +926,16 @@ def main(argv=None) -> int:
         data = _make_pipeline(args, per_process_batch, injector=injector)
         logger.info("single-device run")
 
+    if args.measure_overlap and overlap_probe is None:
+        logger.warning("--measure-overlap ignored: the overlap A/B "
+                       "measures the data-parallel shard_map loss "
+                       "schedule (multi-device --parallel dp, no --fsdp)")
     return _run_fit(data, state, step, args,
                     state_factory=lambda: prepare_state(base_state()),
                     step_guard=_make_step_guard(nan_policy),
                     injector=injector, sharding=batch_sharding,
-                    topology_builder=elastic_builder)
+                    topology_builder=elastic_builder,
+                    overlap_probe=overlap_probe)
 
 
 def _log_final(history) -> None:
@@ -905,7 +947,8 @@ def _log_final(history) -> None:
 
 
 def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
-             injector=None, sharding=None, topology_builder=None) -> int:
+             injector=None, sharding=None, topology_builder=None,
+             overlap_probe=None) -> int:
     """Shared training epilogue for both objectives.
 
     Unsupervised (default): one preemption-guarded ``fit`` — SIGTERM means
@@ -970,6 +1013,21 @@ def _run_fit(data, state, step, args, state_factory=None, step_guard=None,
 
     obs_ctx = _setup_observability(args)
     timeline = obs_ctx.timeline
+    if overlap_probe is not None:
+        # One pre-training A/B (--measure-overlap): the wall clock the
+        # chunked ring schedule hides on THIS backend, published through
+        # the timeline (trainer.measure_comms_overlap). Best-effort —
+        # a capture failure must not stop training.
+        try:
+            res = overlap_probe(timeline)
+            logger.info(
+                "comms overlap A/B on %s: monolithic %.3f ms vs chunked "
+                "%.3f ms (%d chunks) -> overlap %.3f ms (%.1f%%)",
+                res["backend"], res["monolithic_ms"], res["chunked_ms"],
+                res["chunks"], res["overlap_ms"],
+                100.0 * res["overlap_frac"])
+        except Exception:  # noqa: BLE001 — telemetry, not training
+            logger.warning("comms-overlap capture failed", exc_info=True)
     keep_last = getattr(args, "ckpt_keep_last", 3)
     ckpt_kwargs = dict(
         checkpoint_verify_writes=not getattr(args, "no_ckpt_verify", False),

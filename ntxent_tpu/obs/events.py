@@ -80,11 +80,15 @@ __all__ = ["EVENT_TYPES", "EventLog", "install", "get_event_log", "emit",
 # flight recorder, like an SLO breach). forecast: a predictive
 # scale-up trigger (ISSUE 18 — the Holt-Winters lead-time forecast
 # that crossed the controller's pressure bound, recorded with the
-# horizon and projected values that drove it).
+# horizon and projected values that drove it). comms_overlap: one
+# measured computation-collective overlap window (ISSUE 19,
+# obs/timeline.py — the monolithic-vs-chunked on-chip A/B that prices
+# the ring schedule's hidden transfer time; the CPU census pins bytes,
+# this event pins the milliseconds).
 EVENT_TYPES = ("step", "retry", "divergence", "restart", "checkpoint",
                "compile", "trace", "span", "rollout", "fleet", "alert",
                "comms_profile", "bench", "index", "autoscale",
-               "anomaly", "forecast")
+               "anomaly", "forecast", "comms_overlap")
 
 
 class EventLog:

@@ -118,6 +118,20 @@ class StepTimeline:
         self._comms_calls = r.gauge(
             "train_step_comms_calls",
             "collective ops per compiled step (trace-time static)")
+        # Measured computation-collective overlap (ISSUE 19): the wall
+        # clock the chunked ring schedule hides relative to the
+        # monolithic transfer, captured on-chip by an A/B bracket
+        # (trainer.measure_comms_overlap) — the byte census can't see
+        # time, so this is the dynamic half of the overlap claim.
+        self._overlap_ms = r.gauge(
+            "train_step_comms_overlap_ms",
+            "per-step wall clock hidden by the chunked ring schedule "
+            "(monolithic minus chunked step time, block_until_ready "
+            "bracketed; 0 until measured)")
+        self._overlap_frac = r.gauge(
+            "train_step_comms_overlap_frac",
+            "overlap window as a fraction of the monolithic step time "
+            "(0..1; 0 until measured)")
 
     # -- wiring ----------------------------------------------------------
     def set_flops_per_step(self, flops: float | None) -> None:
@@ -203,6 +217,35 @@ class StepTimeline:
                                           "bytes": float(b)}
                            for (op, ax), (c, b) in sorted(profile.items())},
                     **fields)
+
+    def set_comms_overlap(self, overlap_ms: float,
+                          monolithic_ms: float | None = None,
+                          chunked_ms: float | None = None,
+                          chunks: int | None = None) -> None:
+        """Publish one measured overlap window (ISSUE 19).
+
+        ``overlap_ms`` is the per-step wall clock the chunked ring
+        schedule hides — monolithic minus chunked step time, both
+        block_until_ready bracketed (``trainer.measure_comms_overlap``
+        produces the triple; callers may also feed profiler-derived
+        windows). Clamped at 0: a chunked schedule slower than the
+        monolithic one hides nothing (and the bench gate, not this
+        series, is where that regression fails). The fraction series
+        needs ``monolithic_ms``; without it only the ms gauge moves.
+        """
+        ms = max(float(overlap_ms), 0.0)
+        self._overlap_ms.set(ms)
+        fields = {"overlap_ms": round(ms, 3)}
+        if monolithic_ms and monolithic_ms > 0.0:
+            frac = min(max(ms / float(monolithic_ms), 0.0), 1.0)
+            self._overlap_frac.set(frac)
+            fields["overlap_frac"] = round(frac, 4)
+            fields["monolithic_ms"] = round(float(monolithic_ms), 3)
+        if chunked_ms is not None:
+            fields["chunked_ms"] = round(float(chunked_ms), 3)
+        if chunks is not None:
+            fields["chunks"] = int(chunks)
+        events.emit("comms_overlap", **fields)
 
     # -- per step --------------------------------------------------------
     def record_step(self, step: int, loss: float,

@@ -36,8 +36,9 @@ from .blocks import VMEM_BUDGET_BYTES, _working_set_bytes, round_up
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["autotune_blocks", "autotune_attention_blocks", "clear_cache",
-           "cache_path"]
+__all__ = ["autotune_blocks", "autotune_attention_blocks",
+           "choose_ring_chunks", "resolve_ring_chunks",
+           "autotune_ring_chunks", "clear_cache", "cache_path"]
 
 _CACHE: dict[tuple, tuple[int, int]] = {}
 # Values: [br, bc] = served full-sweep vote; the "...|partial" twin key
@@ -360,6 +361,137 @@ def _measured_sweep(key, candidates, make_loss, example, *, length, spans,
             _store_final(key, best)
         _CACHE[key] = best
     return best
+
+
+# ---------------------------------------------------------------------------
+# Ring transfer chunks (ISSUE 19): how many independent ppermutes one
+# ring hop of the chunked dist_loss splits into. Same cache machinery as
+# the tile sweeps — candidates ride as (chunks, 0) 2-tuples so the disk
+# format (a 2-element list per served vote) stays shared.
+# ---------------------------------------------------------------------------
+
+_RING_CHUNK_CANDIDATES = (1, 2, 4, 8, 16)
+# ~64 KiB per circulating chunk: small enough that the first chunk's
+# fold starts while the second is still on the wire, large enough that
+# per-collective launch latency doesn't eat the overlap.
+_RING_CHUNK_TARGET_BYTES = 64 * 1024
+
+
+def choose_ring_chunks(rows: int, dim: int, num_devices: int,
+                       itemsize: int = 4) -> int:
+    """CPU-safe static chunk-count heuristic for the chunked ring
+    schedule — a pure function of (rows, dim, mesh size, itemsize), so
+    interpreter-mode traces are deterministic across processes. ``rows``
+    is the circulating block's row count (2 * n_local for the stacked
+    NT-Xent block). One chunk per ~64 KiB of payload, capped at 8 and
+    at the row count; degenerate meshes (P <= 1) never chunk."""
+    if num_devices <= 1 or rows <= 1:
+        return 1
+    payload = int(rows) * int(dim) * int(itemsize)
+    return int(max(1, min(payload // _RING_CHUNK_TARGET_BYTES, 8, rows)))
+
+
+def _ring_chunk_key(rows: int, dim: int, num_devices: int, dtype) -> tuple:
+    return (f"v{_PROTOCOL_VERSION}", "ringchunks", rows, dim, num_devices,
+            jnp.dtype(dtype).str, jax.default_backend(), _device_kind())
+
+
+def resolve_ring_chunks(rows: int, dim: int, num_devices: int,
+                        dtype=jnp.float32, *,
+                        chunks: int | None = None) -> int:
+    """Trace-safe chunk-count resolution: explicit override -> cached
+    measured vote -> static heuristic. NEVER measures — this is called
+    at loss-build/trace time (dist_loss.local_ntxent_chunked), where a
+    sweep would compile the very function being traced; measurement
+    belongs to ``autotune_ring_chunks``."""
+    if chunks is not None:
+        return max(1, min(int(chunks), max(int(rows), 1)))
+    key = _ring_chunk_key(rows, dim, num_devices, dtype)
+    if key in _CACHE:
+        return int(_CACHE[key][0])
+    on_disk, _ = _disk_lookup(key)
+    if on_disk is not None:
+        _CACHE[key] = on_disk
+        return int(on_disk[0])
+    return choose_ring_chunks(rows, dim, num_devices,
+                              jnp.dtype(dtype).itemsize)
+
+
+def _ring_chunk_candidates(rows: int, near: tuple | None = None):
+    import math
+
+    cands = [(c, 0) for c in _RING_CHUNK_CANDIDATES
+             if c <= max(int(rows), 1)]
+    if near is not None and near[0] > 0:
+        cands.sort(key=lambda c: abs(math.log2(c[0] / near[0])))
+    yield from cands
+
+
+def autotune_ring_chunks(
+    mesh,
+    n_local: int,
+    dim: int,
+    dtype=jnp.float32,
+    *,
+    axis: str = "data",
+    temperature: float = 0.1,
+    include_backward: bool = True,
+    length: int = 50,
+    spans: int = 2,
+    budget_s: float | None | str = "env",
+) -> int:
+    """Measured transfer-chunk count for the chunked ring dist_loss.
+
+    Same contract as the tile sweeps: scanned-chain votes on the live
+    device, winner cached per (rows, dim, mesh size, dtype, device
+    kind), ``choose_ring_chunks`` as the off-device fallback. The vote
+    times the full sharded chunked loss (forward + backward when
+    ``include_backward``), so what wins is the chunk count whose
+    transfer/compute interleave the real schedule prefers — the
+    overlap window itself, not a proxy.
+    """
+    from ..utils.capability import is_tpu_backend
+
+    num_devices = int(mesh.shape[axis])
+    rows = 2 * int(n_local)
+    itemsize = jnp.dtype(dtype).itemsize
+    fallback = choose_ring_chunks(rows, dim, num_devices, itemsize)
+    if not is_tpu_backend():
+        return fallback
+
+    key = _ring_chunk_key(rows, dim, num_devices, dtype)
+    if key in _CACHE:
+        return int(_CACHE[key][0])
+    on_disk, partial = _disk_lookup(key)
+    if on_disk is not None:
+        _CACHE[key] = on_disk
+        return int(on_disk[0])
+    anchor = _partial_anchor(partial)
+
+    n_global = n_local * num_devices
+    z = jax.random.normal(jax.random.PRNGKey(0), (n_global, dim),
+                          jnp.float32)
+    z = (z / jnp.linalg.norm(z, axis=-1, keepdims=True)).astype(dtype)
+
+    def make_loss(cand):
+        from ..parallel.dist_loss import make_sharded_ntxent
+
+        fn = make_sharded_ntxent(mesh, temperature, axis=axis,
+                                 impl="chunked", ring_chunks=int(cand[0]))
+
+        def loss(zz, _c=cand[0]):
+            return fn(zz, zz)
+
+        return loss
+
+    best = _measured_sweep(
+        key, _ring_chunk_candidates(rows, near=anchor or (fallback, 0)),
+        make_loss, z, length=length, spans=spans,
+        with_grad=include_backward, budget_s=budget_s, prior=partial)
+    if best is None:
+        best = (fallback, 0)
+        _CACHE[key] = best
+    return int(best[0])
 
 
 def _attention_candidates(l_q: int, l_kv: int, d: int, itemsize: int,

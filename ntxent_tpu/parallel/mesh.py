@@ -55,6 +55,8 @@ __all__ = [
     "all_gather",
     "all_to_all",
     "ppermute",
+    "ppermute_chunked",
+    "chunk_bounds",
     "psum_scatter",
     "collective_precision",
     "collective_dtype",
@@ -157,6 +159,20 @@ def axis_index(axis: str):
         jnp.arange(n, dtype=jnp.int32), axis, scatter_dimension=0,
         tiled=True)
     return jnp.squeeze(scattered, 0) // n
+
+
+def axis_index_plain(axis: str):
+    """The native ``axis_index`` with no old-jax fallback.
+
+    For plain ``shard_map`` bodies (no ``custom_vjp`` in the way), where
+    the native op lowers fine everywhere and :func:`axis_index`'s old-jax
+    ``psum_scatter`` fallback would be worse than useless: it is a real
+    4-byte collective, and inside a censused region (e.g. the chunked
+    dist_loss ring scan) it would break the graph-census == declared
+    exactness the audits pin. ``axis_index`` is communication-free; only
+    the fallback spelling isn't.
+    """
+    return jax.lax.axis_index(axis)
 
 
 # ---------------------------------------------------------------------------
@@ -430,9 +446,13 @@ def _account(op: str, axis, x, factor) -> None:
 #
 # Eligibility: int8 applies per leaf to float payloads of >=
 # precision.MIN_QUANT_ELEMS elements; scalars (the psum'd loss),
-# small vectors and integer payloads ride in full precision. pmax and
-# ppermute never quantize (a max over quantized values loses the very
-# extremes it exists to find; the ring paths own their own schedule).
+# small vectors and integer payloads ride in full precision. pmax
+# never quantizes (a max over quantized values loses the very extremes
+# it exists to find). ppermute rides the policy too (ISSUE 19): the
+# ring-schedule paths (ring.py, the chunked dist_loss, ring attention)
+# spell every hop through the shim, so int8/bf16 reach the circulating
+# blocks — gid vectors (int32) and small stat vectors stay exempt via
+# the same per-leaf eligibility floor.
 
 
 def _tree_to_bf16(x):
@@ -488,6 +508,44 @@ def _int8_gather(axis):
 
     gather_q.defvjp(_fwd, _bwd)
     return gather_q
+
+
+@functools.lru_cache(maxsize=None)
+def _int8_permute(axis, perm):
+    """custom_vjp int8 neighbor send over ``axis`` along ``perm``:
+    quantize the payload per row, permute payload + scales, dequantize
+    on arrival. Backward is the exact ppermute transpose (the
+    reverse-direction permute) at full precision — the same
+    straight-through estimator as ``_int8_gather``, so quantization
+    noise never compounds around a ring's gradient pass.
+
+    No accounting in here: the ``ppermute`` wrapper declares the wire
+    payloads BEFORE entering the custom_vjp. Inside a ``lax.scan`` body
+    (the chunked ring schedule's home) the primal fn is staged when the
+    scan is built and the ``fwd`` thunk is traced AGAIN by the scan's
+    JVP rule — accounting placed inside either would double-declare
+    every hop under ``grad`` and break the census byte parity the fwd
+    audit pins. The wrapper's Python runs exactly once per body
+    staging, same as the f32 path's accounting."""
+    inverse = tuple((dst, src) for src, dst in perm)
+
+    @jax.custom_vjp
+    def permute_q(x):
+        return _fwd(x)[0]
+
+    def _fwd(x):
+        q, s = quantize_int8(x)
+        qp = jax.lax.ppermute(q, axis, perm)
+        sp = jax.lax.ppermute(s, axis, perm)
+        return (qp.astype(jnp.float32) * sp).astype(x.dtype), None
+
+    def _bwd(_, ct):
+        # Full-precision reverse hop; an AD dual, so (like every shim
+        # backward) it is NOT declared here — the graph census counts it.
+        return (jax.lax.ppermute(ct, axis, inverse),)
+
+    permute_q.defvjp(_fwd, _bwd)
+    return permute_q
 
 
 def _qallreduce_leaves(leaves, axis, op: str):
@@ -748,10 +806,61 @@ def all_gather(x, axis, **kwargs):
 
 def ppermute(x, axis, perm):
     """``jax.lax.ppermute`` with trace-time comms accounting (one
-    neighbor send of the full payload — the ring-step primitive).
-    Never quantized: the ring paths schedule their own precision."""
+    neighbor send of the full payload — the ring-step primitive) and
+    the ambient ``collective_precision`` wire policy (ISSUE 19): an
+    eligible single float array quantizes per row before the hop and
+    dequantizes on arrival; gid vectors (int32) and sub-floor stat
+    vectors pass through at full precision."""
+    dt = collective_dtype()
+    if dt == "int8" and _single_array(x) and quantizable(x):
+        # Declared HERE, on abstract wire descriptors, not inside the
+        # custom_vjp: scan stages the primal fn once and traces the fwd
+        # thunk again under its JVP rule, so inner accounting would
+        # double-declare every ring hop under grad (see _int8_permute).
+        _account("ppermute", axis,
+                 jax.ShapeDtypeStruct(x.shape, jnp.int8),
+                 lambda b, p: float(b))
+        _account("ppermute", axis,
+                 jax.ShapeDtypeStruct(x.shape[:-1] + (1,), jnp.float32),
+                 lambda b, p: float(b))
+        axis_key = axis if isinstance(axis, str) else tuple(axis)
+        return _int8_permute(axis_key, tuple(map(tuple, perm)))(x)
+    if dt == "bf16":
+        xw = _tree_to_bf16(x)
+        _account("ppermute", axis, xw, lambda b, p: float(b))
+        return _tree_cast_like(jax.lax.ppermute(xw, axis, perm), x)
     _account("ppermute", axis, x, lambda b, p: float(b))
     return jax.lax.ppermute(x, axis, perm)
+
+
+def chunk_bounds(n: int, chunks: int) -> list[tuple[int, int]]:
+    """Static ``[lo, hi)`` row bounds splitting ``n`` rows into
+    ``chunks`` contiguous pieces, remainder rows riding the leading
+    chunks (sizes differ by at most one; every chunk non-empty)."""
+    c = max(1, min(int(chunks), int(n))) if n else 1
+    base, rem = divmod(int(n), c)
+    bounds, lo = [], 0
+    for i in range(c):
+        hi = lo + base + (1 if i < rem else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def ppermute_chunked(x, axis, perm, chunks: int):
+    """One ring hop split into ``chunks`` independent ppermutes along
+    dim 0 (ISSUE 19 — the overlap primitive). Each chunk is its own
+    collective in the traced graph, so the async scheduler can start
+    chunk k+1's transfer while chunk k's consumer compute runs;
+    byte-identical to the monolithic send (the chunks partition the
+    payload) and each chunk rides the ambient wire-precision policy
+    independently. ``chunks <= 1`` degrades to one plain hop."""
+    c = max(int(chunks), 1)
+    if c <= 1 or getattr(x, "ndim", 0) < 1 or x.shape[0] <= 1:
+        return ppermute(x, axis, perm)
+    parts = [ppermute(x[lo:hi], axis, perm)
+             for lo, hi in chunk_bounds(x.shape[0], c)]
+    return jnp.concatenate(parts, axis=0)
 
 
 def psum_scatter(x, axis, **kwargs):

@@ -53,6 +53,7 @@ from ..ops.attention_pallas import _flat, _unflat
 from ..ops.ntxent_pallas import _exp0, _log_l
 from .mesh import all_to_all as _all_to_all_acct
 from .mesh import axis_index as _axis_index_compat
+from .mesh import chunk_bounds as _chunk_bounds
 from .mesh import comms_scaled as _comms_scaled
 from .mesh import pcast as _pcast_compat
 from .mesh import ppermute as _ppermute_acct
@@ -66,6 +67,23 @@ __all__ = [
 ]
 
 _NEG_INF = -1e30
+
+
+def _send_chunked(x, axis, perm, chunks):
+    """One ring hop of a (B, L, ...) block split into ``chunks``
+    independent ppermutes along the SEQUENCE dim (the ISSUE 19 overlap
+    schedule, transplanted from ``mesh.ppermute_chunked`` — which slices
+    dim 0 — to the attention layout where dim 1 is the long one). Total
+    wire bytes are identical to the monolithic hop, so the declared byte
+    model and the graph census agree either way; each slice rides the
+    ambient ``collective_precision`` policy independently.
+    ``chunks <= 1`` degrades to one plain hop."""
+    c = max(int(chunks or 1), 1)
+    if c <= 1 or getattr(x, "ndim", 0) < 2 or x.shape[1] <= 1:
+        return _ppermute_acct(x, axis, perm)
+    parts = [_ppermute_acct(x[:, lo:hi], axis, perm)
+             for lo, hi in _chunk_bounds(x.shape[1], c)]
+    return jnp.concatenate(parts, axis=1)
 
 
 def _varying(x, axis):
@@ -160,14 +178,15 @@ def blockwise_attention(q, k, v, *, block_kv: int | None = None,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_attention(q, k, v, axis, num_devices, causal, sc):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_attention(q, k, v, axis, num_devices, causal, sc, chunks):
     """Per-device ring attention body (call inside shard_map).
 
     q, k, v: (B, L/P, H, D) local sequence shards. Returns the local
-    (B, L/P, H, D) output block after all P hops.
+    (B, L/P, H, D) output block after all P hops. ``chunks`` splits each
+    K/V hop into that many sequence-dim ppermutes (ISSUE 19 overlap).
     """
-    return _ring_fwd(q, k, v, axis, num_devices, causal, sc)[0]
+    return _ring_fwd(q, k, v, axis, num_devices, causal, sc, chunks)[0]
 
 
 def _hop_perm(axis, num_devices):
@@ -182,7 +201,7 @@ def _positions(axis, l_loc):
     return d * l_loc + jnp.arange(l_loc)
 
 
-def _ring_fwd(q, k, v, axis, num_devices, causal, sc):
+def _ring_fwd(q, k, v, axis, num_devices, causal, sc, chunks=1):
     b, l_loc, h, d = q.shape
     perm = _hop_perm(axis, num_devices)
     qpos = _positions(axis, l_loc)
@@ -197,11 +216,14 @@ def _ring_fwd(q, k, v, axis, num_devices, causal, sc):
 
     def step(carry, _):
         kb, vb, kpos, m, l, o = carry
+        # Sends issued before the fold consumes the block: the chunked
+        # slices and the fold are independent, so chunk transfers overlap
+        # the similarity/output compute of the current hop.
+        kb_n = _send_chunked(kb, axis, perm, chunks)
+        vb_n = _send_chunked(vb, axis, perm, chunks)
+        kpos_n = _ppermute_acct(kpos, axis, perm)
         m, l, o = _fold(q_, kb, vb, qpos, kpos, m, l, o, sc, causal)
-        kb = _ppermute_acct(kb, axis, perm)
-        vb = _ppermute_acct(vb, axis, perm)
-        kpos = _ppermute_acct(kpos, axis, perm)
-        return (kb, vb, kpos, m, l, o), None
+        return (kb_n, vb_n, kpos_n, m, l, o), None
 
     # comms_scaled on every scanned ring below: the body's ppermutes
     # trace once but run `length` times.
@@ -213,9 +235,12 @@ def _ring_fwd(q, k, v, axis, num_devices, causal, sc):
     return out, (q, k, v, out, lse)
 
 
-def _ring_bwd(axis, num_devices, causal, sc, res, g):
+def _ring_bwd(axis, num_devices, causal, sc, chunks, res, g):
     """Second ring pass: each (K, V) block circulates WITH its (dK, dV)
-    accumulators and arrives home carrying every device's contribution."""
+    accumulators and arrives home carrying every device's contribution.
+    Reuses the forward's chunked schedule: the (K, V, dK, dV) sends are
+    sequence-dim chunked so the gradient exchange overlaps the hop's
+    einsum work the same way."""
     q, k, v, out, lse = res
     b, l_loc, h, d = q.shape
     perm = _hop_perm(axis, num_devices)
@@ -248,11 +273,11 @@ def _ring_bwd(axis, num_devices, causal, sc, res, g):
         ds = p * (dp - drow[..., None]) * sc
         dq = dq + jnp.einsum("bhlm,bmhd->bhld", ds, kb.astype(jnp.float32))
         dkb = dkb + jnp.einsum("bhlm,bhld->bmhd", ds, q_)
-        kb = _ppermute_acct(kb, axis, perm)
-        vb = _ppermute_acct(vb, axis, perm)
+        kb = _send_chunked(kb, axis, perm, chunks)
+        vb = _send_chunked(vb, axis, perm, chunks)
         kpos = _ppermute_acct(kpos, axis, perm)
-        dkb = _ppermute_acct(dkb, axis, perm)
-        dvb = _ppermute_acct(dvb, axis, perm)
+        dkb = _send_chunked(dkb, axis, perm, chunks)
+        dvb = _send_chunked(dvb, axis, perm, chunks)
         return (kb, vb, kpos, dkb, dvb, dq), None
 
     with _comms_scaled(num_devices):
@@ -268,9 +293,9 @@ _ring_attention.defvjp(_ring_fwd, _ring_bwd)
 # --- Fused (Pallas) ring: flash folds per hop, kernel-grade hot path ---
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _ring_attention_flash(q, k, v, axis, num_devices, causal, sc,
-                          bq=None, bk=None):
+                          bq=None, bk=None, chunks=1):
     """Ring attention whose per-hop fold runs the fused flash kernel
     (ops/attention_pallas.py:flash_fold) — carried (m, l, acc) statistics
     thread through the hops, so the across-hop softmax is exact and the
@@ -278,11 +303,11 @@ def _ring_attention_flash(q, k, v, axis, num_devices, causal, sc,
     The backward is the same second ring pass as the jnp form, but each
     hop's contribution comes from the flash dQ / dK-dV kernels."""
     return _ring_flash_fwd(q, k, v, axis, num_devices, causal, sc,
-                           bq, bk)[0]
+                           bq, bk, chunks)[0]
 
 
 def _ring_flash_fwd(q, k, v, axis, num_devices, causal, sc,
-                    bq=None, bk=None):
+                    bq=None, bk=None, chunks=1):
     from ..ops.attention_pallas import flash_fold
 
     b, l_loc, h, d = q.shape
@@ -301,14 +326,16 @@ def _ring_flash_fwd(q, k, v, axis, num_devices, causal, sc,
 
     def step(carry, _):
         kf, vf, k_off, m, l, acc = carry
+        # Chunked sends issued before the kernel folds the block (same
+        # overlap structure as the jnp ring).
+        kf_n = _send_chunked(kf, axis, perm, chunks)
+        vf_n = _send_chunked(vf, axis, perm, chunks)
+        k_off_n = _ppermute_acct(k_off, axis, perm)
         m, l, acc = flash_fold(qf, kf, vf, m, l, acc,
                                q_offset=q_off, k_offset=k_off[0],
                                scale=sc, causal=causal,
                                block_q=bq, block_kv=bk)
-        kf = _ppermute_acct(kf, axis, perm)
-        vf = _ppermute_acct(vf, axis, perm)
-        k_off = _ppermute_acct(k_off, axis, perm)
-        return (kf, vf, k_off, m, l, acc), None
+        return (kf_n, vf_n, k_off_n, m, l, acc), None
 
     with _comms_scaled(num_devices):
         (_, _, _, m, l, acc), _ = jax.lax.scan(step, init, None,
@@ -319,7 +346,7 @@ def _ring_flash_fwd(q, k, v, axis, num_devices, causal, sc,
     return out, (q, k, v, out, lse)
 
 
-def _ring_flash_bwd(axis, num_devices, causal, sc, bq, bk, res, g):
+def _ring_flash_bwd(axis, num_devices, causal, sc, bq, bk, chunks, res, g):
     from ..ops.attention_pallas import flash_dkv_hop, flash_dq_hop
 
     q, k, v, out, lse = res
@@ -346,11 +373,11 @@ def _ring_flash_bwd(axis, num_devices, causal, sc, bq, bk, res, g):
         dqf = dqf + flash_dq_hop(qf, kf, vf, dof, lse, delta, **kwargs)
         dkc, dvc = flash_dkv_hop(qf, kf, vf, dof, lse, delta, **kwargs)
         dkf, dvf = dkf + dkc, dvf + dvc
-        kf = _ppermute_acct(kf, axis, perm)
-        vf = _ppermute_acct(vf, axis, perm)
+        kf = _send_chunked(kf, axis, perm, chunks)
+        vf = _send_chunked(vf, axis, perm, chunks)
         k_off = _ppermute_acct(k_off, axis, perm)
-        dkf = _ppermute_acct(dkf, axis, perm)
-        dvf = _ppermute_acct(dvf, axis, perm)
+        dkf = _send_chunked(dkf, axis, perm, chunks)
+        dvf = _send_chunked(dvf, axis, perm, chunks)
         return (kf, vf, k_off, dkf, dvf, dqf), None
 
     with _comms_scaled(num_devices):
@@ -368,7 +395,8 @@ def make_ring_attention(mesh: Mesh, axis: str = "data", *,
                         causal: bool = False, scale=None,
                         impl: str = "jnp",
                         block_q: int | None = None,
-                        block_kv: int | None = None):
+                        block_kv: int | None = None,
+                        transfer_chunks: int | None = None):
     """Build a jit-able sequence-parallel ring attention over ``mesh``.
 
     Returns ``fn(q, k, v) -> out`` with all four (B, L, H, D) and L
@@ -388,6 +416,13 @@ def make_ring_attention(mesh: Mesh, axis: str = "data", *,
     l_local, head_dim, causal=causal)`` to run each hop at the
     measured-winner tile instead of the static heuristic (the tuned
     tile was worth up to 1.3x on the single-chip A/B ladder).
+
+    ``transfer_chunks`` (ISSUE 19) splits each K/V ring hop — forward
+    AND the backward gradient exchange — into that many sequence-dim
+    ppermutes issued before the hop's fold, so chunk k+1's transfer
+    overlaps chunk k's compute. Total wire bytes are unchanged (the
+    census pins this). Default ``None`` keeps the monolithic hop;
+    feed ``ops.autotune.resolve_ring_chunks`` for the tuned count.
     """
     if impl not in ("jnp", "flash"):
         raise ValueError(f"unknown ring attention impl {impl!r}")
@@ -396,13 +431,16 @@ def make_ring_attention(mesh: Mesh, axis: str = "data", *,
                          "jnp fold has no tiles — they would be silently "
                          "ignored")
     num_devices = mesh.shape[axis]
+    chunks = max(int(transfer_chunks or 1), 1)
 
     def body(q, k, v):
         sc = _resolve_scale(scale, q.shape[-1])
         if impl == "flash":
             return _ring_attention_flash(q, k, v, axis, num_devices,
-                                         causal, sc, block_q, block_kv)
-        return _ring_attention(q, k, v, axis, num_devices, causal, sc)
+                                         causal, sc, block_q, block_kv,
+                                         chunks)
+        return _ring_attention(q, k, v, axis, num_devices, causal, sc,
+                               chunks)
 
     return _shard_map_compat(
         body, mesh=mesh,

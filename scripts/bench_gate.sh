@@ -23,6 +23,19 @@ trap 'rm -rf "$workdir"' EXIT
 current="$workdir/current.json"
 verdict="$workdir/verdict.json"
 
+# Pre-flight (ISSUE 19 / ROADMAP gate-health note): leaked fleet
+# routers/workers from an aborted smoke pin cores and regress every
+# wall-clock gate metric for reasons unrelated to the change under
+# test. bench.py --check prints the same warning itself; surfacing it
+# here too makes the CI log's first line the likely benign explanation
+# of a red run. Advisory only — the operator may know the load.
+strays="$(pgrep -f fleet_main || true)"
+if [ -n "$strays" ]; then
+    echo "bench gate: WARNING stray fleet process(es) before measurement:" \
+         "PIDs $(echo "$strays" | tr '\n' ' ')(pgrep -f fleet_main)" \
+         "— wall-clock metrics may regress from CPU contention" >&2
+fi
+
 # Phase 1 — measure once, gate against the committed records.
 python bench.py --check --check-save-current "$current" >"$verdict"
 python - "$verdict" <<'PY'
@@ -41,6 +54,10 @@ assert any(k.startswith("quant/bytes_ratio") for k in gated), gated
 # BENCH_retrieval.json is enrolled (ISSUE 15): the recall@10 claim of
 # the ANN index must be among the gated metrics.
 assert "retrieval/recall_at_10" in gated, gated
+# BENCH_overlap.json is enrolled (ISSUE 19): the chunked ring schedule's
+# byte-parity and int8-ratio claims must be among the gated metrics.
+assert "overlap/bytes_parity_f32" in gated, gated
+assert "overlap/bytes_ratio_int8" in gated, gated
 print(f"bench gate: PASS on committed records ({len(gated)} metrics, "
       f"skipped: {list(rec['skipped']) or 'none'})")
 PY
@@ -84,6 +101,15 @@ ret = json.load(open("BENCH_retrieval.json"))
 ret["recall_at_10"] = round(min(1.25, ret["recall_at_10"] * 1.25), 4)
 with open(f"{out}/BENCH_retrieval.json", "w") as f:
     json.dump(ret, f, indent=2, sort_keys=True)
+# Doctored overlap record (ISSUE 19): an inflated chunked-vs-monolithic
+# speedup claim must read as a regression against the honest
+# measurement — the ring schedule's committed win is gated, not décor.
+ovl = json.load(open("BENCH_overlap.json"))
+# x2.0: far past the 0.30 serving tolerance even when the honest
+# re-measure lands on the lucky side of the CPU jitter band.
+ovl["speedup_chunked_f32"] = round(ovl["speedup_chunked_f32"] * 2.0, 3)
+with open(f"{out}/BENCH_overlap.json", "w") as f:
+    json.dump(ovl, f, indent=2, sort_keys=True)
 PY
 
 rc=0
@@ -100,6 +126,7 @@ assert rec["ok"] is False, rec
 assert any(k.startswith("pipeline/") for k in rec["failures"]), \
     rec["failures"]
 assert "retrieval/recall_at_10" in rec["failures"], rec["failures"]
+assert "overlap/speedup_chunked_f32" in rec["failures"], rec["failures"]
 print(f"bench gate: FAIL on injected 20% regression "
       f"({len(rec['failures'])} metric(s): {rec['failures'][:3]} ...)")
 PY

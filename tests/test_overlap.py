@@ -1,0 +1,503 @@
+"""Chunked ring-overlap distributed NT-Xent (ISSUE 19).
+
+The tentpole's contract, test-pinned from every side:
+
+* **loss/grad parity** — ``impl="chunked"`` is the SAME FUNCTION as the
+  dense all-gather loss (the online-softmax fold is a reassociation,
+  not an approximation), across mesh sizes, chunk counts that do NOT
+  divide the row count, and under the int8 wire policy.
+* **byte parity** (graphaudit) — the census proves the schedule: N
+  ppermutes whose bytes equal the dense path's two all-gathers exactly,
+  per (P, B, D), f32 AND int8, forward and grad; the wire-dtype
+  verifier passes the quantized chunks and a doctored f32 ppermute leak
+  fails the audit CLI with rc 1.
+* **autotune** — the chunk count is pure + cached (explicit override ->
+  cached vote -> disk -> CPU-safe heuristic; NEVER measured at trace
+  time), and the measured sweep persists its winner like the tile
+  sweeps do.
+* **observability** — ``StepTimeline.set_comms_overlap`` publishes the
+  gauges + ``comms_overlap`` event; ``trainer.measure_comms_overlap``
+  runs the on-chip A/B end to end.
+* **ring attention** — ``transfer_chunks`` splits the K/V hops with the
+  same function / same declared bytes guarantees.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from ntxent_tpu import obs
+from ntxent_tpu.analysis.graph import census as gc
+from ntxent_tpu.analysis.graph import targets as gt
+from ntxent_tpu.analysis.graph import wiredtype as gwd
+from ntxent_tpu.analysis.graph.cli import main as audit_main
+from ntxent_tpu.obs.registry import MetricsRegistry
+from ntxent_tpu.obs.timeline import StepTimeline
+from ntxent_tpu.ops import autotune
+from ntxent_tpu.parallel import mesh as pm
+from ntxent_tpu.parallel.dist_loss import make_sharded_ntxent
+from ntxent_tpu.parallel.mesh import chunk_bounds
+from ntxent_tpu.parallel.ring_attention import make_ring_attention
+
+needs_mesh = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs an 8-device mesh")
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return pm.create_mesh(axis_names=("data",))
+
+
+def _embeddings(n_global, dim, seed=0):
+    rng = np.random.default_rng(seed)
+    z1 = rng.standard_normal((n_global, dim)).astype(np.float32)
+    z2 = rng.standard_normal((n_global, dim)).astype(np.float32)
+    z1 /= np.linalg.norm(z1, axis=-1, keepdims=True)
+    z2 /= np.linalg.norm(z2, axis=-1, keepdims=True)
+    return z1, z2
+
+
+def _submesh(p):
+    return Mesh(np.array(jax.devices()[:p]), axis_names=("data",))
+
+
+# ---------------------------------------------------------------------------
+# loss/grad parity: chunked == dense, everywhere it must
+# ---------------------------------------------------------------------------
+
+
+@needs_mesh
+class TestLossParity:
+    # chunks=3 never divides rows=2*n_local (a power of two): the
+    # remainder rows ride the leading chunks, and the parity must hold.
+    @pytest.mark.parametrize("p", [4, 8])
+    @pytest.mark.parametrize("chunks", [1, 2, 3])
+    def test_chunked_matches_dense_fwd_and_grad(self, p, chunks):
+        mesh = _submesh(p)
+        n_local, dim = 4, 32
+        z1, z2 = _embeddings(n_local * p, dim)
+        dense = make_sharded_ntxent(mesh, 0.1)
+        chunked = make_sharded_ntxent(mesh, 0.1, impl="chunked",
+                                      ring_chunks=chunks)
+        np.testing.assert_allclose(np.asarray(chunked(z1, z2)),
+                                   np.asarray(dense(z1, z2)),
+                                   rtol=1e-6, atol=1e-6)
+        gd = jax.grad(lambda a, b: dense(a, b))(z1, z2)
+        gch = jax.grad(lambda a, b: chunked(a, b))(z1, z2)
+        np.testing.assert_allclose(np.asarray(gch), np.asarray(gd),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_chunked_matches_dense_under_int8_policy(self, mesh):
+        # dim=512 so each per-chunk block clears MIN_QUANT_ELEMS — the
+        # quantization really happens in BOTH schedules; both quantize
+        # per row, so they see the same wire values.
+        n_local, dim, chunks = 2, 512, 2
+        z1, z2 = _embeddings(n_local * 8, dim)
+        dense = make_sharded_ntxent(mesh, 0.1)
+        chunked = make_sharded_ntxent(mesh, 0.1, impl="chunked",
+                                      ring_chunks=chunks)
+        with pm.collective_precision("int8"):
+            ld = dense(z1, z2)
+            lc = chunked(z1, z2)
+            gd = jax.grad(lambda a, b: dense(a, b))(z1, z2)
+            gch = jax.grad(lambda a, b: chunked(a, b))(z1, z2)
+        np.testing.assert_allclose(np.asarray(lc), np.asarray(ld),
+                                   rtol=1e-4, atol=1e-4)
+        # Both arms see per-row int8 wire noise, but fold it in a
+        # different order through 1/T exponentials — bit-equality is
+        # not on offer, quantization-noise-scale agreement is.
+        np.testing.assert_allclose(np.asarray(gch), np.asarray(gd),
+                                   rtol=0, atol=1e-3)
+
+    def test_train_step_factory_rejects_orphan_ring_chunks(self, mesh):
+        from ntxent_tpu.training.trainer import make_sharded_train_step
+
+        with pytest.raises(ValueError, match="ring_chunks"):
+            make_sharded_train_step(mesh, 0.1, loss_impl="strip",
+                                    ring_chunks=4)
+
+
+# ---------------------------------------------------------------------------
+# chunk_bounds / ppermute_chunked: the slicing primitive
+# ---------------------------------------------------------------------------
+
+
+class TestChunkBounds:
+    @pytest.mark.parametrize("n,c", [(8, 1), (8, 3), (7, 3), (5, 8),
+                                     (1, 4)])
+    def test_bounds_partition_exactly(self, n, c):
+        bounds = chunk_bounds(n, c)
+        assert bounds[0][0] == 0 and bounds[-1][1] == n
+        assert all(hi > lo for lo, hi in bounds)          # non-empty
+        assert all(bounds[i][1] == bounds[i + 1][0]
+                   for i in range(len(bounds) - 1))       # contiguous
+        sizes = [hi - lo for lo, hi in bounds]
+        assert max(sizes) - min(sizes) <= 1               # balanced
+        assert sizes == sorted(sizes, reverse=True)       # remainder leads
+        assert len(bounds) == min(max(1, c), n)           # clamped
+
+    @needs_mesh
+    def test_ppermute_chunked_equals_monolithic(self, mesh):
+        from jax.sharding import PartitionSpec as P
+
+        perm = [(i, (i + 1) % 8) for i in range(8)]
+
+        def mono(x):
+            return pm.ppermute(x, "data", perm)
+
+        def chunked(x):
+            return pm.ppermute_chunked(x, "data", perm, 3)
+
+        x = np.arange(8 * 6 * 4, dtype=np.float32).reshape(48, 4)
+        kw = dict(mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+                  check_vma=False)
+        got = pm.shard_map(chunked, **kw)(x)
+        want = pm.shard_map(mono, **kw)(x)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# ---------------------------------------------------------------------------
+# graph census: N ppermutes, same ring bytes as the dense all-gather
+# ---------------------------------------------------------------------------
+
+
+@needs_mesh
+@pytest.mark.graphaudit
+class TestByteParity:
+    @pytest.mark.parametrize("p,n_local,dim,chunks",
+                             [(8, 2, 8, 2), (8, 4, 16, 3), (4, 4, 8, 2)])
+    def test_chunked_fwd_bytes_equal_dense_allgather_f32(
+            self, p, n_local, dim, chunks):
+        mesh = _submesh(p)
+        z1, z2 = _embeddings(n_local * p, dim)
+        dense = make_sharded_ntxent(mesh, 0.1)
+        chunked = make_sharded_ntxent(mesh, 0.1, impl="chunked",
+                                      ring_chunks=chunks)
+        de, dd = gc.census_of_callable(dense, z1, z2)
+        ce, cd = gc.census_of_callable(chunked, z1, z2)
+        dt, ct = gc.census_totals(de), gc.census_totals(ce)
+        # The dense gather ring bytes, reproduced by (P-1)*chunks
+        # ppermutes exactly — same psum tail, nothing else.
+        shard_b = 2 * n_local * dim * 4
+        assert dt[("all_gather", "data")] == (2, (p - 1) * shard_b)
+        assert ct[("ppermute", "data")] == \
+            ((p - 1) * chunks, (p - 1) * shard_b)
+        assert ct[("psum", "data")] == dt[("psum", "data")]
+        assert set(ct) == {("ppermute", "data"), ("psum", "data")}
+        assert gc.census_bytes(ce) == pytest.approx(gc.census_bytes(de))
+        # Graph == declared on BOTH sides (the exactness ntxent-audit
+        # gates on — no undeclared collective hides in the scan body).
+        assert ct == gc._declared_byte_totals(cd)
+        assert dt == gc._declared_byte_totals(dd)
+
+    def test_chunked_grad_keeps_byte_parity_and_ad_remainder(self, mesh):
+        n_local, dim, chunks = 2, 8, 2
+        z1, z2 = _embeddings(n_local * 8, dim)
+        dense = make_sharded_ntxent(mesh, 0.1)
+        chunked = make_sharded_ntxent(mesh, 0.1, impl="chunked",
+                                      ring_chunks=chunks)
+        de, dd = gc.census_of_callable(
+            jax.grad(lambda a, b: dense(a, b)), z1, z2)
+        ce, cd = gc.census_of_callable(
+            jax.grad(lambda a, b: chunked(a, b)), z1, z2)
+        d_sum = gc.graph_remainder(de, dd)
+        c_sum = gc.graph_remainder(ce, cd)
+        # Declared (forward-schedule) bytes identical; both backwards
+        # move real AD-dual bytes the shims never declared.
+        assert c_sum["declared_bytes"] == \
+            pytest.approx(d_sum["declared_bytes"])
+        assert c_sum["ad_bytes"] > 0 and d_sum["ad_bytes"] > 0
+        # The chunked dual is the reverse ring: ppermutes, not a
+        # reduce-scatter.
+        ops = {e.op for e in ce}
+        assert "ppermute" in ops and "all_gather" not in ops
+
+    def test_chunked_int8_bytes_equal_dense_int8(self, mesh):
+        # PR 11's byte cut survives chunking: per-chunk quantization
+        # declares the same q+scale wire bytes the dense int8 gather
+        # does (graph side AND shim side).
+        n_local, dim, chunks = 2, 512, 2
+        z1, z2 = _embeddings(n_local * 8, dim)
+        dense = make_sharded_ntxent(mesh, 0.1)
+        chunked = make_sharded_ntxent(mesh, 0.1, impl="chunked",
+                                      ring_chunks=chunks)
+
+        def dense8(a, b):
+            with pm.collective_precision("int8"):
+                return dense(a, b)
+
+        def chunked8(a, b):
+            with pm.collective_precision("int8"):
+                return chunked(a, b)
+
+        de, dd = gc.census_of_callable(dense8, z1, z2)
+        ce, cd = gc.census_of_callable(chunked8, z1, z2)
+        assert gc.census_bytes(ce) == pytest.approx(gc.census_bytes(de))
+        d_decl = sum(b for _, b in dd.values())
+        c_decl = sum(b for _, b in cd.values())
+        assert c_decl == pytest.approx(d_decl)
+        # And the chunks really ride the wire quantized.
+        assert any(e.op == "ppermute" and e.dtype == "int8" for e in ce)
+
+
+# ---------------------------------------------------------------------------
+# wire-dtype verifier: quantized chunks pass, a doctored f32 leak fails
+# ---------------------------------------------------------------------------
+
+
+@needs_mesh
+@pytest.mark.graphaudit
+class TestWireDtypeOverlap:
+    def test_registered_chunked_int8_target_is_clean(self):
+        mesh = gt.audit_mesh()
+        t = [t for t in gt.default_targets(mesh)
+             if t.name == "dist_loss_chunked/int8"][0]
+        built = t.build()
+        entries, _ = gc.census_of_callable(built["fn"], *built["args"])
+        assert gwd.wire_dtype_findings(entries, "int8", t.name) == []
+        assert any(e.op == "ppermute" and e.dtype == "int8"
+                   for e in entries)
+
+    def test_ppermute_is_policy_eligible(self):
+        assert "ppermute" in gwd.ELIGIBLE_OPS
+
+    def test_doctored_f32_ppermute_leak_fails_audit_cli(self, tmp_path,
+                                                        capsys):
+        # The incident shape for the chunked schedule: a ring hop
+        # spelled with raw lax.ppermute under the int8 policy — the
+        # shims never see it; the audit must rc 1 on the graph.
+        fixture = tmp_path / "fixture.py"
+        fixture.write_text(
+            "from ntxent_tpu.analysis.graph.targets import AuditTarget\n"
+            "\n\ndef targets(mesh):\n"
+            "    import jax\n"
+            "    import jax.numpy as jnp\n"
+            "    from jax.sharding import PartitionSpec as P\n"
+            "    from ntxent_tpu.parallel import mesh as pm\n"
+            "\n"
+            "    def leak():\n"
+            "        perm = [(i, (i + 1) % mesh.shape['data'])\n"
+            "                for i in range(mesh.shape['data'])]\n"
+            "        def body(t):\n"
+            "            with pm.collective_precision('int8'):\n"
+            "                return jax.lax.ppermute(t, 'data', perm)\n"
+            "        fn = pm.shard_map(body, mesh, in_specs=(P(),),\n"
+            "                          out_specs=P(), check_vma=False)\n"
+            "        return {'fn': fn,\n"
+            "                'args': (jnp.ones((4, 512), jnp.float32),)}\n"
+            "\n"
+            "    return [AuditTarget('doc/ring_leak', 'wire-dtype',\n"
+            "                        leak, policy='int8')]\n")
+        rc = audit_main(["--no-baseline", "--format", "json",
+                         "--no-publish", "--fixture-module", str(fixture)])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        leaks = [f for f in out["new"] if f["path"] == "graph://doc/"
+                 "ring_leak"]
+        assert leaks and all(f["rule"] == "wire-dtype" for f in leaks)
+        assert any("float32" in f["message"] for f in leaks)
+
+
+# ---------------------------------------------------------------------------
+# autotune: pure, cached, never measured at trace time
+# ---------------------------------------------------------------------------
+
+
+class TestRingChunkAutotune:
+    def test_heuristic_is_pure_and_capped(self):
+        f = autotune.choose_ring_chunks
+        assert f(128, 512, 8) == f(128, 512, 8)       # deterministic
+        assert f(128, 512, 8) == 4                    # 256 KiB -> 4
+        assert f(4096, 4096, 8) == 8                  # capped at 8
+        assert f(2, 4096, 8) <= 2                     # capped at rows
+        assert f(128, 512, 1) == 1                    # P<=1 never chunks
+        assert f(16, 4, 8) == 1                       # sub-target payload
+
+    def test_resolve_clamps_explicit_override(self):
+        assert autotune.resolve_ring_chunks(8, 64, 8, chunks=0) == 1
+        assert autotune.resolve_ring_chunks(8, 64, 8, chunks=100) == 8
+        assert autotune.resolve_ring_chunks(8, 64, 8, chunks=3) == 3
+
+    def test_resolve_on_cpu_is_deterministic_heuristic(self, monkeypatch,
+                                                       tmp_path):
+        autotune.clear_cache()
+        monkeypatch.setenv("NTXENT_TPU_CACHE", str(tmp_path))
+        # Trace-time purity: resolution must NEVER measure — any timer
+        # call is a bug (a sweep would compile the function being
+        # traced).
+        monkeypatch.setattr(
+            autotune, "time_fn_chained",
+            lambda *a, **k: pytest.fail("resolve_ring_chunks measured"))
+        got = autotune.resolve_ring_chunks(128, 512, 8, jnp.float32)
+        assert got == autotune.choose_ring_chunks(128, 512, 8)
+        assert got == autotune.resolve_ring_chunks(128, 512, 8,
+                                                   jnp.float32)
+        autotune.clear_cache()
+
+    def test_resolve_serves_cached_vote_without_measuring(self,
+                                                          monkeypatch,
+                                                          tmp_path):
+        autotune.clear_cache()
+        monkeypatch.setenv("NTXENT_TPU_CACHE", str(tmp_path))
+        monkeypatch.setattr(
+            autotune, "time_fn_chained",
+            lambda *a, **k: pytest.fail("cached resolve measured"))
+        key = autotune._ring_chunk_key(128, 512, 8, jnp.float32)
+        autotune._CACHE[key] = (16, 0)
+        assert autotune.resolve_ring_chunks(128, 512, 8,
+                                            jnp.float32) == 16
+        autotune.clear_cache()
+
+    @needs_mesh
+    def test_measured_sweep_picks_winner_and_persists(self, monkeypatch,
+                                                      tmp_path, mesh):
+        autotune.clear_cache()
+        monkeypatch.setenv("NTXENT_TPU_CACHE", str(tmp_path))
+        monkeypatch.setattr(autotune.jax, "default_backend",
+                            lambda: "tpu")
+        calls = []
+
+        def fake_timer(fn, z, length, spans, with_grad, **kw):
+            (c,) = fn.__defaults__
+            calls.append(c)
+            return (0.5 if c == 8 else 1.0 + c / 1e3), 0.0
+
+        monkeypatch.setattr(autotune, "time_fn_chained", fake_timer)
+        best = autotune.autotune_ring_chunks(mesh, 16, 64,
+                                             budget_s=None)
+        assert best == 8
+        assert set(calls) == {1, 2, 4, 8, 16}
+        # The vote persists: a fresh in-memory cache must resolve from
+        # DISK, still without measuring.
+        autotune._CACHE.clear()
+        monkeypatch.setattr(
+            autotune, "time_fn_chained",
+            lambda *a, **k: pytest.fail("resolve re-measured"))
+        assert autotune.resolve_ring_chunks(32, 64, 8,
+                                            jnp.float32) == 8
+        autotune.clear_cache()
+
+    def test_off_tpu_sweep_returns_heuristic_without_measuring(
+            self, monkeypatch, mesh):
+        autotune.clear_cache()
+        monkeypatch.setattr(
+            autotune, "time_fn_chained",
+            lambda *a, **k: pytest.fail("CPU sweep measured"))
+        got = autotune.autotune_ring_chunks(mesh, 16, 64)
+        assert got == autotune.choose_ring_chunks(32, 64,
+                                                  mesh.shape["data"])
+        autotune.clear_cache()
+
+
+# ---------------------------------------------------------------------------
+# observability: the overlap series
+# ---------------------------------------------------------------------------
+
+
+class TestOverlapTimeline:
+    def test_set_comms_overlap_publishes_gauges_and_event(self):
+        reg = MetricsRegistry()
+        tl = StepTimeline(registry=reg)
+        log = obs.EventLog(None)
+        obs.install(log)
+        try:
+            tl.set_comms_overlap(2.0, monolithic_ms=10.0, chunked_ms=8.0,
+                                 chunks=4)
+        finally:
+            obs.install(None)
+            log.close()
+        snap = reg.collect()
+        assert snap["train_step_comms_overlap_ms"] == 2.0
+        assert snap["train_step_comms_overlap_frac"] == \
+            pytest.approx(0.2)
+        assert "train_step_comms_overlap_ms" in reg.render_prometheus()
+        (ev,) = [r for r in log.tail(10)
+                 if r["event"] == "comms_overlap"]
+        assert ev["overlap_ms"] == 2.0 and ev["overlap_frac"] == 0.2
+        assert ev["monolithic_ms"] == 10.0 and ev["chunks"] == 4
+
+    def test_negative_overlap_clamps_to_zero(self):
+        reg = MetricsRegistry()
+        tl = StepTimeline(registry=reg)
+        tl.set_comms_overlap(-3.0, monolithic_ms=10.0)
+        assert reg.collect()["train_step_comms_overlap_ms"] == 0.0
+
+    def test_comms_overlap_is_a_known_event_type(self):
+        from ntxent_tpu.obs.events import EVENT_TYPES
+
+        assert "comms_overlap" in EVENT_TYPES
+
+    @needs_mesh
+    def test_measure_comms_overlap_end_to_end(self, mesh):
+        from ntxent_tpu.training.trainer import measure_comms_overlap
+
+        reg = MetricsRegistry()
+        tl = StepTimeline(registry=reg)
+        log = obs.EventLog(None)
+        obs.install(log)
+        try:
+            rep = measure_comms_overlap(mesh, 4, 64, ring_chunks=2,
+                                        repeats=2, warmup=1,
+                                        timeline=tl)
+        finally:
+            obs.install(None)
+            log.close()
+        assert rep["chunks"] == 2
+        assert rep["monolithic_ms"] > 0 and rep["chunked_ms"] > 0
+        assert rep["overlap_ms"] >= 0.0            # clamped on host
+        assert 0.0 <= rep["overlap_frac"] <= 1.0
+        assert "train_step_comms_overlap_ms" in reg.render_prometheus()
+        assert [r for r in log.tail(10) if r["event"] == "comms_overlap"]
+
+
+# ---------------------------------------------------------------------------
+# ring attention: transfer_chunks is the same function, same bytes
+# ---------------------------------------------------------------------------
+
+
+@needs_mesh
+class TestRingAttentionChunks:
+    @pytest.mark.parametrize("chunks", [2, 3])
+    def test_transfer_chunks_parity_fwd_and_grad(self, mesh, chunks):
+        B, L, H, D = 2, 32, 4, 8
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q, k, v = (jax.random.normal(kk, (B, L, H, D)) * 0.5 for kk in ks)
+        mono = make_ring_attention(mesh)
+        chk = make_ring_attention(mesh, transfer_chunks=chunks)
+        np.testing.assert_allclose(np.asarray(chk(q, k, v)),
+                                   np.asarray(mono(q, k, v)),
+                                   rtol=1e-5, atol=1e-6)
+
+        def loss(fn):
+            return lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+
+        gm = jax.grad(loss(mono), argnums=(0, 1, 2))(q, k, v)
+        gchk = jax.grad(loss(chk), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gchk, gm):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.graphaudit
+    def test_transfer_chunks_keep_declared_bytes(self, mesh):
+        B, L, H, D = 2, 32, 4, 8
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q, k, v = (jax.random.normal(kk, (B, L, H, D)) * 0.5 for kk in ks)
+        acct = pm.comms_accounting()
+
+        def declared(fn):
+            mark = acct.totals()
+            jax.jit(fn).lower(q, k, v)  # trace only: accounting fires
+            return acct.delta(mark)
+
+        mono = declared(make_ring_attention(mesh))
+        chk = declared(make_ring_attention(mesh, transfer_chunks=3))
+        mono_b = sum(b for _, b in mono.values())
+        chk_b = sum(b for _, b in chk.values())
+        mono_c = sum(c for c, _ in mono.values())
+        chk_c = sum(c for c, _ in chk.values())
+        assert chk_b == pytest.approx(mono_b)   # same ring bytes
+        assert chk_c > mono_c                   # more, smaller sends
