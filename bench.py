@@ -1086,6 +1086,115 @@ def _fleet_main() -> None:
     print(json.dumps(payload))
 
 
+def _retrieval_repair_arm() -> dict:
+    """The ``repair`` arm (ISSUE 20): a 3-shard plane with a durable
+    insert journal loses one shard under a live insert stream, the
+    shard restarts EMPTY on the same port, and the repair loop
+    resurrects it from the journal. Committed numbers: journal drain
+    throughput (rows/s through the normal insert path) and
+    time-to-recall-restored; in-child hard bars: the journal drains to
+    zero, the self-hit probe returns to its pre-kill value exactly
+    (zero net dropped rows), and availability never broke (searches
+    degraded, never failed)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from ntxent_tpu.retrieval import ShardFanout, ShardServer
+
+    dim, n_shards, n_base, n_live = 64, 3, 24_576, 8_192
+
+    def rows(n, seed):
+        r = np.random.RandomState(seed)
+        x = r.randn(n, dim).astype(np.float32)
+        return x / np.linalg.norm(x, axis=1, keepdims=True)
+
+    servers = [ShardServer(dim).start() for _ in range(n_shards)]
+    ports = [s.port for s in servers]
+    jdir = tempfile.mkdtemp(prefix="bench-shard-journal-")
+    # nprobe == n_centroids: exhaustive probing + exact re-rank makes
+    # the self-hit probe deterministic — recall moves ONLY with row
+    # coverage, which is the thing this arm measures.
+    fan = ShardFanout([s.url for s in servers], dim=dim,
+                      train_rows=2048, n_centroids=32, nprobe=32,
+                      pq_m=8, journal_dir=jdir, cooldown_s=0.2)
+    try:
+        fan.activate(100)
+        base = rows(n_base, 1)
+        for i in range(0, n_base, 2048):
+            fan.insert(np.arange(i, min(i + 2048, n_base)),
+                       base[i:i + 2048])
+        probe = base[:256]
+
+        def self_hit():
+            res = fan.search(probe, k=1)
+            return float(np.mean(res["ids"][:, 0]
+                                 == np.arange(probe.shape[0])))
+
+        base_hit = self_hit()
+        assert base_hit == 1.0, \
+            f"exhaustive self-hit {base_hit} != 1.0 pre-kill"
+
+        victim = 1
+        servers[victim].stop()
+        live = rows(n_live, 2)
+        for i in range(0, n_live, 1024):
+            fan.insert(np.arange(n_base + i,
+                                 n_base + min(i + 1024, n_live)),
+                       live[i:i + 1024])
+        res = fan.search(probe, k=1)
+        assert res["shards"]["degraded"], \
+            "dead shard not reported degraded"
+        dead_hit = self_hit()
+        assert dead_hit < 1.0, \
+            "probe unaffected by a dead shard (nothing to repair)"
+        depth_dead = fan.journal.depth(victim)
+        assert depth_dead > 0, "no journal debt accrued for the victim"
+
+        # Restart EMPTY on the same port; the repair loop must detect
+        # the reset (rows < acked), re-init, and resurrect from the
+        # full journal history.
+        servers[victim] = ShardServer(dim, port=ports[victim]).start()
+        rep0 = fan.repaired
+        t0 = time.perf_counter()
+        drain_s = None
+        while time.perf_counter() - t0 < 120.0:
+            fan.repair_tick()
+            if sum(fan.journal.depths().values()) == 0:
+                drain_s = time.perf_counter() - t0
+                break
+        assert drain_s is not None, "journal never drained to zero"
+        repaired_rows = fan.repaired - rep0
+        restored_s = None
+        while time.perf_counter() - t0 < 120.0:
+            if self_hit() >= base_hit:
+                restored_s = time.perf_counter() - t0
+                break
+            fan.repair_tick()
+        assert restored_s is not None, \
+            "self-hit never returned to the pre-kill value"
+        assert fan.dropped == 0, \
+            f"{fan.dropped} row(s) truly lost despite the journal"
+        return {
+            "shards": n_shards,
+            "rows": n_base + n_live,
+            "repaired_rows": int(repaired_rows),
+            "journal_depth_at_restart": int(depth_dead),
+            "drain_s": round(drain_s, 3),
+            "drain_rows_per_sec": round(repaired_rows
+                                        / max(drain_s, 1e-9), 1),
+            "time_to_recall_restored_s": round(restored_s, 3),
+            "self_hit_dead": round(dead_hit, 4),
+            "recall_restored": 1.0,
+        }
+    finally:
+        fan.close()
+        for s in servers:
+            s.stop()
+        shutil.rmtree(jdir, ignore_errors=True)
+
+
 def _retrieval_child() -> None:
     """--retrieval measurement: the ANN index tier (ISSUE 15/17).
 
@@ -1250,6 +1359,9 @@ def _retrieval_child() -> None:
         "ann_speedup": round(statistics.median(sorted(brute))
                              / max(statistics.median(sorted(quiet)),
                                    1e-6), 2),
+        # ISSUE 20: the self-healing arm — kill a shard under load,
+        # restart it empty, prove the journal refills it.
+        "repair": _retrieval_repair_arm(),
     }
     print(SENTINEL + json.dumps(payload))
 
@@ -2698,6 +2810,29 @@ def gate_metrics(name: str, payload: dict | None,
                 out[f"retrieval/{mode}/p50_ms"] = {
                     "value": float(lat), "higher_is_better": False,
                     "tol": GATE_SERVING_TOL}
+        # ISSUE 20 repair arm: drain throughput is the healing-speed
+        # claim (wall-clock-shaped, serving tolerance); recall_restored
+        # is the zero-net-dropped-rows invariant truthy-encoded — a
+        # 0.0 current value fails against the committed 1.0 while
+        # keep() stops a 0.0 from ever becoming the reference.
+        rep = payload.get("repair") or {}
+        v = rep.get("drain_rows_per_sec")
+        if keep(v):
+            out["retrieval/repair/drain_rows_per_sec"] = {
+                "value": float(v), "higher_is_better": True,
+                "tol": GATE_SERVING_TOL}
+        v = rep.get("recall_restored")
+        if keep(v):
+            out["retrieval/repair/recall_restored"] = {
+                "value": float(v), "higher_is_better": True,
+                "tol": GATE_TOL}
+        v = rep.get("time_to_recall_restored_s")
+        if keep(v) and (not reference or float(v) >= 0.2):
+            # Same floor rule as the latency series: a sub-200ms
+            # reference would gate on scheduler jitter, not healing.
+            out["retrieval/repair/time_to_recall_restored_s"] = {
+                "value": float(v), "higher_is_better": False,
+                "tol": GATE_SERVING_TOL}
     elif name == "autoscale":
         # The hard bars (fixed leg breaches, autoscaled hold is
         # zero-5xx at <= 0.6x the fixed p99, drain-down is zero-5xx
@@ -2844,18 +2979,18 @@ def compare_gate(current: dict, committed: dict,
 
 
 def _stray_fleet_pids() -> list[int]:
-    """PIDs of leaked fleet routers/workers (``pgrep -f fleet_main``)
-    still running when a gate measurement starts.
+    """PIDs of leaked fleet routers/workers/shards (``pgrep -f
+    'fleet_main|ntxent_tpu.retrieval.shard'``) still running when a
+    gate measurement starts.
 
     The ROADMAP gate-health note's first diagnostic: an aborted fleet
-    smoke leaves workers pinning cores, and every wall-clock gate
-    metric then regresses for reasons that have nothing to do with the
-    PR under test. Surfaced as a WARNING naming the PIDs — not a
-    failure, because the operator may know the load is unrelated — so
-    a red gate run carries its most likely benign explanation."""
+    or shard-chaos smoke leaves processes pinning cores, and every
+    wall-clock gate metric then regresses for reasons that have
+    nothing to do with the PR under test."""
     try:
-        proc = subprocess.run(["pgrep", "-f", "fleet_main"],
-                              capture_output=True, text=True, timeout=10)
+        proc = subprocess.run(
+            ["pgrep", "-f", r"fleet_main|ntxent_tpu\.retrieval\.shard"],
+            capture_output=True, text=True, timeout=10)
     except (OSError, subprocess.TimeoutExpired):
         return []  # no pgrep (or it wedged): the pre-flight is advisory
     me = os.getpid()
@@ -2865,15 +3000,36 @@ def _stray_fleet_pids() -> list[int]:
 
 def _check_main(args) -> int:
     """``--check``: measure quick profiles, gate against the committed
-    records, append the verdict to PROGRESS.jsonl, rc 1 on regression."""
+    records, append the verdict to PROGRESS.jsonl, rc 1 on regression.
+
+    HARD pre-flight (ISSUE 20, promoted from the PR 19 warning): a
+    stray fleet/shard process before measurement means every
+    wall-clock metric is measured under contention — the run answers a
+    different question than the gate asks, so it refuses to start
+    (rc 2, PID list printed). ``NTXENT_BENCH_ALLOW_STRAY=1`` overrides
+    for operators who know the load is unrelated."""
     strays = _stray_fleet_pids()
     if strays:
-        print("bench: WARNING stray fleet process(es) running before "
-              f"measurement — PIDs {strays} match 'pgrep -f "
-              "fleet_main'; wall-clock gate metrics may regress from "
-              "CPU contention, not from the change under test. Kill "
-              "them (or let the smoke finish) and re-run.",
-              file=sys.stderr)
+        if os.environ.get("NTXENT_BENCH_ALLOW_STRAY") == "1":
+            print("bench: WARNING stray fleet/shard process(es) "
+                  f"running — PIDs {strays}; proceeding under "
+                  "NTXENT_BENCH_ALLOW_STRAY=1, wall-clock metrics may "
+                  "regress from CPU contention.", file=sys.stderr)
+        else:
+            print("bench: REFUSING to gate — stray fleet/shard "
+                  f"process(es) running, PIDs {strays} (pgrep -f "
+                  "'fleet_main|ntxent_tpu.retrieval.shard'). "
+                  "Wall-clock gate metrics would measure CPU "
+                  "contention, not the change under test. Kill them "
+                  "(or let the smoke finish) and re-run, or set "
+                  "NTXENT_BENCH_ALLOW_STRAY=1 to override.",
+                  file=sys.stderr)
+            print(json.dumps({"metric": "bench_regression_gate",
+                              "ok": False,
+                              "error": "stray processes before "
+                                       "measurement",
+                              "stray_fleet_pids": strays}))
+            return 2
     repo = os.path.dirname(os.path.abspath(__file__))
     against = args.check_against or repo
     committed: dict = {}

@@ -101,6 +101,12 @@ class LintConfig:
         # an accelerator hold in a search path would be a regression
         # the tripwire test also pins end-to-end.
         "ntxent_tpu.retrieval",
+        # ISSUE 20: the shard worker + journal run as standalone
+        # subprocesses (python -m ntxent_tpu.retrieval.shard) — a JAX
+        # import there would pay backend init on every supervised
+        # restart, exactly when repair latency matters most.
+        "ntxent_tpu.retrieval.shard",
+        "ntxent_tpu.retrieval.journal",
     )
     boundary_forbidden: tuple[str, ...] = (
         # jax plus everything that eagerly imports it: any of these at
@@ -142,6 +148,10 @@ class LintConfig:
         # history store's own max_series cap (the detector only ever
         # sees series the recorder admitted).
         "series",
+        # ISSUE 20: retrieval_shard_up{shard=0..N-1} — one value per
+        # configured shard endpoint, bounded by --search-shards (the
+        # fan-out mints the gauges at attach, clients can't add more).
+        "shard",
     )
 
 

@@ -1857,10 +1857,26 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                          "codes, searches ADC-scan + exact re-rank")
     ix.add_argument("--search-shards", type=int, default=0,
                     metavar="N",
-                    help="start N in-process shard servers and fan "
-                         "/search out across them (IVF lists "
-                         "partitioned list%%N; a dead shard degrades "
-                         "recall, never availability)")
+                    help="start N shard servers and fan /search out "
+                         "across them (IVF lists placed by rendezvous "
+                         "hash over the ring; a dead shard degrades "
+                         "recall, never availability, and its rows "
+                         "are journaled + repaired on restart)")
+    ix.add_argument("--shard-procs", action="store_true",
+                    help="run --search-shards workers as supervised "
+                         "SUBPROCESSES (readiness probe, eject-after-"
+                         "streak, backoff restart) instead of "
+                         "in-process servers; a restarted shard is "
+                         "refilled from the insert journal")
+    ix.add_argument("--shard-journal-dir", default=None, metavar="DIR",
+                    help="durable per-shard insert journal (default: "
+                         "in-memory): every routed batch is logged "
+                         "before delivery, so rows a dead shard "
+                         "missed are replayed by the repair loop")
+    ix.add_argument("--shard-repair-interval", type=float, default=1.0,
+                    metavar="SECONDS",
+                    help="repair-loop tick: probe dead shards, drain "
+                         "journal debt through the insert path")
 
     f = p.add_argument_group("fleet supervision")
     f.add_argument("--workdir", default=None,
@@ -1883,11 +1899,13 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                    help="per-worker restart budget")
     f.add_argument("--chaos", default=None, metavar="PLAN",
                    help="fleet fault plan, e.g. 'killworker@10,"
-                        "slowworker@30,spike@20,drainworker@40' "
-                        "(ordinals are supervision ticks; "
-                        "resilience/faults.py grammar; spike/"
-                        "drainworker exercise the autoscaler and "
-                        "need --autoscale)")
+                        "slowworker@30,spike@20,drainworker@40,"
+                        "killshard@15,lagshard@25' (ordinals are "
+                        "supervision ticks; resilience/faults.py "
+                        "grammar; spike/drainworker exercise the "
+                        "autoscaler and need --autoscale; killshard/"
+                        "lagshard hit the shard plane and need "
+                        "--shard-procs)")
 
     a = p.add_argument_group("autoscaling (ISSUE 16: closed-loop pool "
                              "sizing over the federated signals — "
@@ -2078,20 +2096,28 @@ def fleet_main(argv=None) -> int:
     attach = args.attach_workdir is not None
     injector = None
     if args.chaos:
-        if attach:
-            logger.warning("--chaos is ignored in --attach-workdir "
-                           "mode: a replica router does not own the "
-                           "worker processes")
+        plan = FaultPlan.parse(args.chaos, seed=args.seed)
+        has_fleet = bool(plan.killworker_ticks or plan.slowworker_ticks
+                         or plan.spike_ticks or plan.drainworker_ticks)
+        has_shard = plan.has_shard_actions()
+        if attach and has_fleet:
+            # Shard chaos still applies: the shard fleet is owned by
+            # THIS router even when the embed workers belong to a
+            # primary elsewhere.
+            logger.warning("--chaos fleet actions are ignored in "
+                           "--attach-workdir mode: a replica router "
+                           "does not own the worker processes")
+            has_fleet = False
+        if has_shard and not (args.shard_procs and args.search_shards):
+            logger.warning("--chaos shard actions (killshard@T/"
+                           "lagshard@T) need --search-shards N with "
+                           "--shard-procs — ignored here")
+            has_shard = False
+        if has_fleet or has_shard:
+            injector = FaultInjector(plan)
         else:
-            plan = FaultPlan.parse(args.chaos, seed=args.seed)
-            if (plan.killworker_ticks or plan.slowworker_ticks
-                    or plan.spike_ticks or plan.drainworker_ticks):
-                injector = FaultInjector(plan)
-            else:
-                logger.warning("--chaos %r has no fleet actions "
-                               "(killworker@T/slowworker@T/spike@T/"
-                               "drainworker@T) — ignored here",
-                               args.chaos)
+            logger.warning("--chaos %r has no applicable actions — "
+                           "ignored here", args.chaos)
 
     if attach:
         workdir = Path(args.attach_workdir)
@@ -2218,27 +2244,74 @@ def fleet_main(argv=None) -> int:
                     args.index_train_rows, args.index_nprobe,
                     args.index_centroids, args.index_pq_m)
 
-    # Sharded index plane (ISSUE 17): N in-process shard servers, the
-    # router fans /search out and merges — the single-process capacity
-    # ceiling becomes a fleet-shaped one. In production the servers
-    # run on separate hosts (python -m ntxent_tpu.retrieval.shard).
+    # Sharded index plane (ISSUE 17/20): N shard servers, the router
+    # fans /search out and merges — the single-process capacity
+    # ceiling becomes a fleet-shaped one. --shard-procs runs them as
+    # SUPERVISED SUBPROCESSES through the same ServingFleet machinery
+    # the embed workers use (readiness probe, eject-after-streak,
+    # backoff restart), on a second fleet with its own WorkerPool and
+    # a PRIVATE registry (the shard pool's canary state machine must
+    # not fight the embed pool's on the shared metric names). In
+    # production the servers run on separate hosts
+    # (python -m ntxent_tpu.retrieval.shard).
     shard_servers = []
+    shard_fleet = None
     if args.search_shards > 0:
         from ntxent_tpu.retrieval import ShardFanout, ShardServer
 
         dim = args.proj_dim
-        shard_servers = [ShardServer(dim).start()
-                         for _ in range(args.search_shards)]
+        if args.shard_procs:
+            import socket as _socket
+
+            # FIXED pre-allocated ports: the fan-out routes by URL, so
+            # a shard restarted by supervision must rebind the exact
+            # port its clients already hold — an ephemeral port would
+            # orphan the ring entry and turn every restart into a
+            # permanent hole.
+            shard_ports = []
+            for _ in range(args.search_shards):
+                sk = _socket.socket()
+                sk.bind(("127.0.0.1", 0))
+                shard_ports.append(sk.getsockname()[1])
+                sk.close()
+            shard_workdir = workdir / "shards"
+
+            def make_shard_cmd(worker_id: str, port_file) -> list[str]:
+                idx = int(worker_id.lstrip("w"))
+                return [sys.executable, "-m",
+                        "ntxent_tpu.retrieval.shard",
+                        "--dim", str(dim),
+                        "--port", str(shard_ports[idx]),
+                        "--port-file", str(port_file)]
+
+            shard_pool = WorkerPool(registry=obs.MetricsRegistry())
+            shard_fleet = ServingFleet(
+                make_shard_cmd, n_workers=args.search_shards,
+                workdir=shard_workdir, pool=shard_pool,
+                poll_s=args.health_poll,
+                eject_after=args.eject_after,
+                max_restarts=args.worker_max_restarts,
+                injector=injector, registry=shard_pool.registry,
+                chaos_channel="shard")
+            shard_urls = [f"http://127.0.0.1:{p}" for p in shard_ports]
+        else:
+            shard_servers = [ShardServer(dim).start()
+                             for _ in range(args.search_shards)]
+            shard_urls = [s.url for s in shard_servers]
         fanout = ShardFanout(
-            [s.url for s in shard_servers], dim=dim,
+            shard_urls, dim=dim,
             train_rows=args.index_train_rows,
             n_centroids=args.index_centroids,
             nprobe=args.index_nprobe, pq_m=max(1, args.index_pq_m),
+            journal_dir=args.shard_journal_dir,
             registry=registry)
         router.attach_shards(fanout)
-        logger.info("retrieval: shard plane live — %d shard(s), "
-                    "lists partitioned list%%%d",
-                    args.search_shards, args.search_shards)
+        logger.info("retrieval: shard plane live — %d shard(s)%s, "
+                    "rendezvous list placement, journal %s",
+                    args.search_shards,
+                    " (supervised subprocesses)" if args.shard_procs
+                    else "",
+                    args.shard_journal_dir or "in-memory")
 
     # Fleet observability plane (ISSUE 10): shadow mirror, metric
     # federation, SLO engine. All off-hot-path; all optional.
@@ -2439,6 +2512,12 @@ def fleet_main(argv=None) -> int:
     _signal.signal(_signal.SIGINT, _on_signal)
 
     fleet.start()
+    if shard_fleet is not None:
+        shard_fleet.start()
+    if router.shards is not None:
+        # Repair loop (ISSUE 20): probe dead shards, drain journal
+        # debt through the normal insert path once they answer.
+        router.shards.start(args.shard_repair_interval)
     router.start()
     if index_mgr is not None:
         index_mgr.start()
@@ -2470,6 +2549,8 @@ def fleet_main(argv=None) -> int:
             index_mgr.stop()
         for srv in shard_servers:
             srv.stop()
+        if shard_fleet is not None:
+            shard_fleet.stop()
         if router.shards is not None:
             router.shards.close()
         router.close()

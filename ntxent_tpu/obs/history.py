@@ -404,7 +404,12 @@ class SeriesSpec:
     * ``ratio`` — ``d(metric) / (d(metric) + d(denom))`` per tick —
       the hit-rate shape (hits vs misses);
     * ``per`` — ``d(metric) / d(denom)`` per tick — the unit-economy
-      shape (bytes per query).
+      shape (bytes per query);
+    * ``gauge_labeled`` — one history series PER value of
+      ``label_key`` (named ``{name}.{label_value}``), so per-instance
+      state like ``retrieval_shard_up{shard=N}`` stays per-instance:
+      a sum would hide one dead shard among N-1 live ones, exactly the
+      signal the anomaly detector exists to catch (ISSUE 20).
     """
 
     name: str
@@ -413,14 +418,19 @@ class SeriesSpec:
     labels: dict = field(default_factory=dict)
     q: float = 0.99
     denom: str | None = None
+    label_key: str | None = None
 
     def __post_init__(self):
         if self.mode not in ("gauge_sum", "gauge_max", "counter_rate",
-                             "quantile", "ratio", "per"):
+                             "quantile", "ratio", "per",
+                             "gauge_labeled"):
             raise ValueError(f"unknown series mode {self.mode!r}")
         if self.mode in ("ratio", "per") and not self.denom:
             raise ValueError(f"series {self.name!r} mode {self.mode!r} "
                              "needs a denom metric")
+        if self.mode == "gauge_labeled" and not self.label_key:
+            raise ValueError(f"series {self.name!r} mode gauge_labeled "
+                             "needs a label_key")
 
 
 # The default watch set: the series the ISSUE 18 detector/forecaster
@@ -453,6 +463,11 @@ DEFAULT_SERIES = (
                mode="gauge_max"),
     SeriesSpec("serving_compile_cache_entries",
                "serving_compile_cache_entries", mode="gauge_max"),
+    # Per-shard liveness (ISSUE 20): one series per shard id, so a
+    # single shard dropping 1.0 -> 0.0 is a step the detector flags
+    # even while the plane as a whole keeps answering.
+    SeriesSpec("retrieval_shard_up", "retrieval_shard_up",
+               mode="gauge_labeled", label_key="shard"),
 )
 
 
@@ -488,6 +503,13 @@ class HistoryRecorder:
         now = self.clock()
         out: dict[str, float] = {}
         for spec in self.series:
+            if spec.mode == "gauge_labeled":
+                for name, value in self._extract_labeled(spec, merged):
+                    out[name] = value
+                    self.history.record(name, value, t=now)
+                    if self.detector is not None:
+                        self.detector.observe(name, value, t=now)
+                continue
             value = self._extract(spec, merged, now)
             if value is None:
                 continue
@@ -508,6 +530,24 @@ class HistoryRecorder:
         if dt <= 0:
             return None
         return total - prev[1], dt
+
+    def _extract_labeled(self, spec: SeriesSpec,
+                         merged: MetricsRegistry,
+                         ) -> list[tuple[str, float]]:
+        """Expand a ``gauge_labeled`` spec: one ``(series_name,
+        value)`` per distinct ``label_key`` value of the gauge, named
+        ``{spec.name}.{label_value}``. Label-sets missing the key are
+        skipped (they belong to some other instrumentation)."""
+        out: list[tuple[str, float]] = []
+        for e in merged.dump_state()["metrics"]:
+            if e["name"] != spec.metric or e["kind"] != "gauge":
+                continue
+            lv = e.get("labels", {}).get(spec.label_key)
+            if lv is None:
+                continue
+            out.append((f"{spec.name}.{lv}",
+                        float(e.get("value", 0.0))))
+        return out
 
     def _extract(self, spec: SeriesSpec, merged: MetricsRegistry,
                  now: float) -> float | None:

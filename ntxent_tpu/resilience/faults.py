@@ -67,7 +67,17 @@ schedule randomization):
                    tick, mid-load: the victim stops receiving routes,
                    in-flight completes, SIGTERM only after → exercises
                    the zero-5xx scale-down contract and the
-                   below-min-repair path (serving/autoscale.py).
+                   below-min-repair path (serving/autoscale.py);
+* ``killshard@t`` — SIGKILL one retrieval SHARD worker on the t-th
+                   shard-fleet supervision tick (its own ordinal,
+                   counted from the shard fleet's all-ready point) →
+                   exercises the degraded-recall-never-5xx merge, the
+                   insert journal, and journal-drain repair on restart
+                   (ISSUE 20);
+* ``lagshard@t`` — SIGSTOP one shard worker on the t-th shard-fleet
+                   tick (the gray shard: alive, answering nothing) →
+                   exercises the ShardClient timeout cooldown + free
+                   retry and the fan-out's degraded merge.
 
 ``FaultPlan`` is the parsed, immutable spec; ``FaultInjector`` carries the
 runtime counters and the wrapping hooks call sites use. Batch-path
@@ -94,7 +104,7 @@ __all__ = ["ChaosError", "TopologyChange", "FaultPlan", "FaultInjector",
 
 _KINDS = ("nan", "sigterm", "kill", "crash", "fetch", "diskfull",
           "shrink", "grow", "truncate", "killworker", "slowworker",
-          "spike", "drainworker")
+          "spike", "drainworker", "killshard", "lagshard")
 
 
 class ChaosError(RuntimeError):
@@ -130,6 +140,8 @@ class FaultPlan:
     slowworker_ticks: tuple[int, ...] = ()
     spike_ticks: tuple[int, ...] = ()
     drainworker_ticks: tuple[int, ...] = ()
+    killshard_ticks: tuple[int, ...] = ()
+    lagshard_ticks: tuple[int, ...] = ()
     seed: int = 0
 
     @classmethod
@@ -170,6 +182,8 @@ class FaultPlan:
                    slowworker_ticks=tuple(buckets["slowworker"]),
                    spike_ticks=tuple(buckets["spike"]),
                    drainworker_ticks=tuple(buckets["drainworker"]),
+                   killshard_ticks=tuple(buckets["killshard"]),
+                   lagshard_ticks=tuple(buckets["lagshard"]),
                    seed=seed)
 
     def empty(self) -> bool:
@@ -179,7 +193,13 @@ class FaultPlan:
                     or self.shrink_batches or self.grow_batches
                     or self.truncate_attempts or self.killworker_ticks
                     or self.slowworker_ticks or self.spike_ticks
-                    or self.drainworker_ticks)
+                    or self.drainworker_ticks or self.killshard_ticks
+                    or self.lagshard_ticks)
+
+    def has_shard_actions(self) -> bool:
+        """True when the plan targets the retrieval shard fleet (the
+        CLI hands those ticks to the shard fleet's injector channel)."""
+        return bool(self.killshard_ticks or self.lagshard_ticks)
 
 
 def _poison_leaf(x):
@@ -240,6 +260,7 @@ class FaultInjector:
         self._ckpt_writes = 0
         self._attempts = 0
         self._fleet_ticks = 0
+        self._shard_ticks = 0
         self.fired: list[str] = []
 
     # -- batch-path faults (wrap the training data iterator) -------------
@@ -336,6 +357,21 @@ class FaultInjector:
             due.append(f"spike@{t}")
         if t in self.plan.drainworker_ticks:
             due.append(f"drainworker@{t}")
+        self.fired.extend(due)
+        return due
+
+    def on_shard_tick(self) -> list[str]:
+        """The SHARD fleet's tick channel: its own ordinal (counted
+        from the shard fleet's all-ready point — two fleets booting at
+        different speeds must not skew each other's chaos schedules),
+        dispensing only the shard actions."""
+        self._shard_ticks += 1
+        t = self._shard_ticks
+        due: list[str] = []
+        if t in self.plan.killshard_ticks:
+            due.append(f"killshard@{t}")
+        if t in self.plan.lagshard_ticks:
+            due.append(f"lagshard@{t}")
         self.fired.extend(due)
         return due
 

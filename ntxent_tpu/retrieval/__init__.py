@@ -18,6 +18,12 @@ merges; a dead shard degrades recall, never availability). Durable
 state (docstore log + centroid/codebook snapshots) lives in
 ``versioned``/``index``/``pq`` so a restart reopens trained.
 
+ISSUE 20 makes the shard plane self-healing: rendezvous-hashed list
+placement with live rebalancing, checkpoint-step plane versions wired
+to the rollout state machine, and a durable per-shard insert journal
+(``journal``) whose repair loop redelivers every row a dead shard
+missed — degraded briefly, then healed.
+
 JAX-free at import by construction: numpy + stdlib only. The
 import-boundary lint (``LintConfig.boundary_roots``) and the runtime
 tripwire (tests/test_fleet.py) both enforce it — search must never pay
@@ -26,10 +32,12 @@ backend-init latency or hold an accelerator.
 
 from .index import RetrievalMetrics, VectorIndex
 from .ivf import IVFIndex, brute_force_topk, kmeans
+from .journal import ShardJournal
 from .pq import PQCodec
 from .scan import CodedLists, ScanBatcher, batched_scan
 from .segments import MutableSegment, SealedSegment, SegmentStore
-from .shard import IndexShard, ShardClient, ShardFanout, ShardServer
+from .shard import (IndexShard, ShardClient, ShardFanout, ShardServer,
+                    shard_owner)
 from .versioned import IndexManager
 
 __all__ = [
@@ -45,9 +53,11 @@ __all__ = [
     "SegmentStore",
     "ShardClient",
     "ShardFanout",
+    "ShardJournal",
     "ShardServer",
     "VectorIndex",
     "batched_scan",
     "brute_force_topk",
     "kmeans",
+    "shard_owner",
 ]

@@ -148,6 +148,23 @@ class CodedLists:
             self._lists[int(c)].append(ids[mask], codes[mask],
                                        src_arr[mask], rows[mask])
 
+    def list_view(self, c: int) -> tuple[np.ndarray, np.ndarray,
+                                         np.ndarray]:
+        """``(ids, src, row)`` committed-prefix snapshot of one
+        inverted list — the migration read: the raw vectors are
+        ``sources[src][row]`` per element."""
+        ids, _, src, row = self._lists[int(c)].view()
+        return ids, src, row
+
+    def drop_list(self, c: int) -> int:
+        """Swap one list for an empty buffer (rebalance hand-off after
+        the new owner acks). Pointer swap — in-flight scans keep the
+        old buffer alive and stay consistent. Returns rows dropped."""
+        old = self._lists[int(c)]
+        n = old.rows
+        self._lists[int(c)] = _ListBuf(self.codec.m)
+        return n
+
     def assign(self, vectors: np.ndarray) -> np.ndarray:
         """Max-inner-product IVF list per row (same rule as
         ``ivf._nearest`` — unit-norm embeddings, dot == cosine)."""

@@ -100,7 +100,8 @@ class ServingFleet:
                  slowworker_s: float = 3.0,
                  env: dict | None = None,
                  registry: MetricsRegistry | None = None,
-                 attach: bool = False):
+                 attach: bool = False,
+                 chaos_channel: str = "fleet"):
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1, got {n_workers}")
         self.make_cmd = make_cmd
@@ -115,6 +116,7 @@ class ServingFleet:
             max_attempts=max_restarts + 1, base_delay_s=0.5,
             multiplier=2.0, max_delay_s=15.0, jitter=0.1)
         self.injector = injector
+        self.chaos_channel = str(chaos_channel)
         self.slowworker_s = float(slowworker_s)
         self.env = env
         self.registry = registry if registry is not None \
@@ -375,7 +377,14 @@ class ServingFleet:
                    if w.ready) < len(self.workers_snapshot()):
                 return
             self._chaos_armed = True
-        for action in self.injector.on_fleet_tick():
+        if self.chaos_channel == "shard":
+            # A shard fleet pulls its OWN ordinal stream: killshard@3
+            # means "3 ticks after the shard plane armed", independent
+            # of how many embed-fleet ticks the same injector served.
+            actions = self.injector.on_shard_tick()
+        else:
+            actions = self.injector.on_fleet_tick()
+        for action in actions:
             if action.startswith("spike"):
                 # Flash crowd (ISSUE 16): no process to signal — the
                 # CLI wires on_spike to a loadgen burst against the
@@ -409,7 +418,7 @@ class ServingFleet:
                 logger.warning("fleet chaos: %s due but no live worker",
                                action)
                 continue
-            if action.startswith("killworker"):
+            if action.startswith(("killworker", "killshard")):
                 target = live[self._chaos_kills % len(live)]
                 self._chaos_kills += 1
                 logger.warning("fleet chaos: SIGKILL %s (pid %s)",
@@ -418,7 +427,7 @@ class ServingFleet:
                     os.kill(target.pid, signal.SIGKILL)
                 except OSError:
                     pass
-            elif action.startswith("slowworker"):
+            elif action.startswith(("slowworker", "lagshard")):
                 target = live[self._chaos_slows % len(live)]
                 self._chaos_slows += 1
                 logger.warning("fleet chaos: SIGSTOP %s for %.1fs "

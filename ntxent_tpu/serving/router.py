@@ -921,28 +921,40 @@ class FleetRouter:
         """Wire a ``retrieval.ShardFanout`` (ISSUE 17): ``POST
         /search`` fans out to the shard plane and merges top-k; a dead
         shard degrades recall (``shards.degraded`` in the payload),
-        never availability. The plane is unversioned — when an
-        ``IndexManager`` is ALSO attached it stays the id/docstore
-        authority and the shards mirror its inserts; wiring the
-        rollout state machine through the fan-out is a ROADMAP
-        follow-up."""
+        never availability. Since ISSUE 20 the plane is VERSIONED: the
+        rollout state machine drives it exactly like the in-process
+        ``IndexManager`` — promote cuts every shard to the promoted
+        step's generation, rollback restores the retained one fleet-
+        wide, and the fan-out rejects any shard response carrying the
+        wrong version, so a rollback can never serve mixed-model
+        neighbors across shards. When an ``IndexManager`` is ALSO
+        attached it stays the id/docstore authority and the shards
+        mirror its inserts."""
         self.shards = fanout
+        if self.pool.trusted_step is not None:
+            # Attached after the fleet already adopted: the shard
+            # plane must version against the step actually serving.
+            fanout.activate(self.pool.trusted_step)
 
     def _on_trusted_adopt(self, step: int) -> None:
         if self.cache is not None:
             self.cache.clear(reason="adopt")
         if self.index is not None:
             self.index.activate(step)
+        if self.shards is not None:
+            self.shards.activate(step)
 
     def _on_trusted_rollback(self, new_step: int, old_step: int) -> None:
         """The fleet reverted beneath the router (WorkerPool demotion):
         embeddings of the demoted model must not outlive it, and the
         retrieval tier atomically restores the prior step's retained
-        index version."""
+        index version — the in-process index AND the shard plane."""
         if self.cache is not None:
             self.cache.clear(reason="rollback")
         if self.index is not None:
             self.index.rollback_to(new_step)
+        if self.shards is not None:
+            self.shards.rollback_to(new_step)
         _events.emit("rollout", action="trusted_demoted",
                      step=new_step, from_step=old_step)
 
@@ -1104,6 +1116,9 @@ class FleetRouter:
                 # forces a rebuild (ISSUE 15).
                 self.index.on_canary_rollback(
                     step, verdict.get("reason", "canary_breach"))
+            if self.shards is not None:
+                self.shards.on_canary_rollback(
+                    step, verdict.get("reason", "canary_breach"))
         elif action == "promote":
             if self.cache is not None:
                 # Embeddings from the previous model must not outlive
@@ -1128,6 +1143,14 @@ class FleetRouter:
                 # retained inputs through the now-trusted fleet); the
                 # prior version stays retained for rollback.
                 self.index.promote(step)
+            if self.shards is not None:
+                # Cut the WHOLE shard plane to the promoted step in one
+                # broadcast: every shard opens a fresh generation at
+                # ``step`` and retains the prior one, so a later
+                # rollback restores the exact pre-promote fleet — no
+                # shard can serve the old model's neighbors next to a
+                # peer serving the new one.
+                self.shards.promote(step)
 
     def _warm_cache(self, rows: list) -> int:
         """Replay hot input rows through the (now trusted) fleet and
@@ -1702,6 +1725,7 @@ def _make_router_handler(router: FleetRouter):
                     "k": k, "rows": int(x.shape[0]),
                     "index_rows": res["rows"],
                     "shards": res["shards"],
+                    "index_step": res["version"],
                     "served_step": served_step})
                 return
             index_dim = router.index.dim

@@ -1401,6 +1401,12 @@ class TestServingFleet:
              "from ntxent_tpu.retrieval import (PQCodec, CodedLists, "
              "ScanBatcher, batched_scan, ShardFanout, ShardServer, "
              "IndexShard)\n"
+             # ISSUE 20: the insert journal + rendezvous placement are
+             # the self-healing machinery — they load on every shard
+             # worker boot, the path where restart latency IS repair
+             # latency.
+             "from ntxent_tpu.retrieval import (ShardJournal, "
+             "shard_owner)\n"
              "assert 'jax' not in sys.modules, 'jax leaked'\n"
              "print('\\n'.join(sorted(m for m in sys.modules\n"
              "                        if m.startswith('ntxent_tpu'))))\n"],

@@ -1,0 +1,668 @@
+"""Self-healing shard plane (ISSUE 20).
+
+The proofs that make the shard tier survivable rather than merely
+degradable:
+
+ - rendezvous list placement: growing N -> N+1 moves ~1/N of the
+   lists, and every moved list lands on the NEW shard (no shuffle
+   among survivors);
+ - the durable insert journal: write-ahead of every routed batch,
+   kill-9 mid-append truncates to a whole-record boundary on reopen,
+   replay through the normal insert path is idempotent by id;
+ - plane versioning: promote cuts EVERY shard to the new generation,
+   rollback restores the retained one fleet-wide, and the fan-out
+   rejects any response on the wrong version — merged neighbors can
+   never mix model generations;
+ - repair: a shard that dies, restarts EMPTY, and rejoins is refilled
+   from its journal history — zero net dropped rows;
+ - live rebalance: 2 -> 3 moves a bounded fraction of rows, runs ZERO
+   k-means (booby-trapped), and merged search stays row-identical;
+ - chaos grammar: killshard@T / lagshard@T ride their own tick
+   ordinal and the ServingFleet dispatch, so shard chaos schedules
+   don't skew against embed-fleet ones.
+
+JAX-free by construction (the tripwire here and in test_fleet pins
+it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ntxent_tpu.obs.registry import MetricsRegistry
+from ntxent_tpu.resilience import FaultInjector, FaultPlan
+from ntxent_tpu.retrieval import (
+    ShardFanout,
+    ShardJournal,
+    ShardServer,
+    shard_owner,
+)
+from ntxent_tpu.retrieval import shard as shard_mod
+from ntxent_tpu.retrieval.shard import ShardClient
+
+pytestmark = pytest.mark.shardchaos
+
+DIM = 16
+
+
+def unit_rows(n, seed=0, dim=DIM):
+    r = np.random.RandomState(seed)
+    x = r.randn(n, dim).astype(np.float32)
+    return x / np.linalg.norm(x, axis=1, keepdims=True)
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def make_plane(tmp_path, n_shards=3, n_rows=1024, step=100, **kw):
+    """Trained fan-out over real localhost shard servers, exhaustive
+    probing (nprobe == n_centroids) so recall moves ONLY with row
+    coverage."""
+    servers = [ShardServer(DIM).start() for _ in range(n_shards)]
+    kw.setdefault("journal_dir", tmp_path / "journal")
+    kw.setdefault("cooldown_s", 0.2)
+    fan = ShardFanout([s.url for s in servers], dim=DIM,
+                      train_rows=256, n_centroids=16, nprobe=16,
+                      pq_m=8, **kw)
+    fan.activate(step)
+    base = unit_rows(n_rows, seed=1)
+    for i in range(0, n_rows, 256):
+        fan.insert(np.arange(i, min(i + 256, n_rows)),
+                   base[i:i + 256])
+    assert fan.trained
+    return servers, fan, base
+
+
+def self_hit(fan, rows, ids=None):
+    res = fan.search(rows, k=1)
+    want = np.arange(rows.shape[0]) if ids is None else ids
+    return float(np.mean(res["ids"][:, 0] == want))
+
+
+# ---------------------------------------------------------------------------
+# rendezvous placement
+
+
+class TestRendezvousOwner:
+    def test_deterministic_and_in_range(self):
+        lists = np.arange(4096)
+        for n in (1, 2, 3, 7, 16):
+            o = shard_owner(lists, n)
+            assert o.min() >= 0 and o.max() < n
+            np.testing.assert_array_equal(o, shard_owner(lists, n))
+
+    def test_grow_by_one_moves_about_one_over_n_to_the_new_shard(self):
+        lists = np.arange(8192)
+        o2, o3 = shard_owner(lists, 2), shard_owner(lists, 3)
+        moved = o2 != o3
+        frac = float(moved.mean())
+        # Ideal 1/3; the hash is uniform enough to land near it — the
+        # mod-N scheme this replaces moves ~2/3 here.
+        assert 0.25 < frac < 0.42, frac
+        # HRW stability: a list only ever moves TO the shard that
+        # joined, never between survivors.
+        assert np.all(o3[moved] == 2)
+
+    def test_shrink_reassigns_exactly_the_dead_shards_lists(self):
+        lists = np.arange(8192)
+        o3, o2 = shard_owner(lists, 3), shard_owner(lists, 2)
+        moved = o3 != o2
+        # Everything that moved was owned by the shard that left.
+        assert np.all(o3[moved] == 2)
+        # Nothing else moved.
+        assert np.all(o2[~moved] == o3[~moved])
+
+
+# ---------------------------------------------------------------------------
+# client cooldown split (satellite)
+
+
+class TestShardClientCooldowns:
+    def _dead_port(self):
+        sk = socket.socket()
+        sk.bind(("127.0.0.1", 0))
+        port = sk.getsockname()[1]
+        sk.close()
+        return port
+
+    def test_connect_refused_takes_the_long_cooldown_no_retry(self):
+        cl = ShardClient(f"http://127.0.0.1:{self._dead_port()}",
+                         timeout_s=1.0, cooldown_s=30.0,
+                         timeout_cooldown_s=0.1)
+        assert cl.call("/healthz") is None
+        assert cl.failures == 1 and cl.timeouts == 0
+        # Long bench, no free retry: the process is GONE.
+        assert not cl.available
+        assert cl.call("/healthz") is None  # gated, no attempt
+        assert cl.failures == 1
+
+    def test_timeout_takes_short_cooldown_plus_one_free_retry(self):
+        # A socket that accepts the TCP handshake (kernel backlog) but
+        # never answers: the HTTP read times out — the SIGSTOP/GC
+        # shape, not the dead-process shape.
+        sk = socket.socket()
+        sk.bind(("127.0.0.1", 0))
+        sk.listen(1)
+        try:
+            cl = ShardClient(f"http://127.0.0.1:{sk.getsockname()[1]}",
+                             timeout_s=0.3, cooldown_s=30.0,
+                             timeout_cooldown_s=30.0)
+            assert cl.call("/healthz") is None
+            assert cl.timeouts == 1
+            # Short-cooldown path grants ONE free retry immediately.
+            assert cl.available
+            assert cl.call("/healthz") is None
+            assert cl.timeouts == 2
+            # The retry itself does not renew the pass.
+            assert not cl.available
+            assert cl.call("/healthz") is None  # gated, no attempt
+            assert cl.failures == 2
+        finally:
+            sk.close()
+
+    def test_force_bypasses_the_cooldown_gate(self):
+        cl = ShardClient(f"http://127.0.0.1:{self._dead_port()}",
+                         timeout_s=0.5, cooldown_s=30.0)
+        assert cl.call("/healthz") is None
+        assert not cl.available
+        # The repair loop's probe must still reach the wire.
+        assert cl.call("/healthz", force=True) is None
+        assert cl.failures == 2
+
+
+# ---------------------------------------------------------------------------
+# durable journal
+
+
+class TestShardJournal:
+    def test_ack_watermark_tolerates_out_of_order_and_gaps(self, tmp_path):
+        j = ShardJournal(tmp_path)
+        ids = np.arange(4, dtype=np.int64)
+        vecs = unit_rows(4, seed=3)
+        o0 = j.append(0, ids, vecs, 100)
+        o1 = j.append(0, ids + 10, vecs, 100)
+        o2 = j.append(0, ids + 20, vecs, 100)
+        assert (o0, o1, o2) == (0, 1, 2)
+        assert j.depth(0) == 12
+        j.ack(0, o0, 4)
+        j.ack(0, o2, 4)          # delivered above a gap: held pending
+        assert j.depth(0) == 8   # batch 1 still owed
+        j.ack(0, o1, 4)          # gap closes -> watermark jumps to 3
+        assert j.depth(0) == 0
+        # Durability: a reopen sees the same watermark.
+        j.close()
+        j2 = ShardJournal(tmp_path)
+        assert j2.depth(0) == 0
+        b, r = j2.totals(0)
+        assert (b, r) == (3, 12)
+        j2.close()
+
+    def test_kill9_mid_append_truncates_torn_tail_on_reopen(
+            self, tmp_path):
+        root = tmp_path / "j"
+        script = textwrap.dedent(f"""
+            import numpy as np
+            from ntxent_tpu.retrieval import ShardJournal
+            j = ShardJournal({str(root)!r})
+            vecs = np.random.RandomState(0).randn(64, 8).astype(
+                np.float32)
+            i = 0
+            while True:
+                j.append(0, np.arange(i, i + 64), vecs, 100)
+                i += 64
+        """)
+        proc = subprocess.Popen([sys.executable, "-c", script],
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        deadline = time.monotonic() + 20.0
+        log = root / "shard-0.log"
+        # Let it write long enough that a kill lands mid-stream.
+        while time.monotonic() < deadline:
+            if log.exists() and log.stat().st_size > 256_000:
+                break
+            time.sleep(0.02)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(10)
+        assert log.exists() and log.stat().st_size > 0
+        # Simulate the torn tail a crash can leave even past the last
+        # flush: chop the file mid-record.
+        with open(log, "r+b") as f:
+            f.truncate(log.stat().st_size - 13)
+        j = ShardJournal(root)
+        batches, rows = j.totals(0)
+        assert batches > 0 and rows == batches * 64
+        # Every surviving record replays whole — ids contiguous, the
+        # torn tail gone, nothing duplicated.
+        seen = []
+        for ver, ids, vecs in j.replay(0, from_start=True):
+            assert ver == 100
+            assert ids.shape[0] == 64 and vecs.shape == (64, 8)
+            seen.extend(ids.tolist())
+        assert seen == list(range(batches * 64))
+        assert len(seen) == len(set(seen))
+        j.close()
+
+    def test_compaction_dedups_by_id_and_resets_watermark(
+            self, tmp_path):
+        j = ShardJournal(tmp_path, compact_rows=4)
+        ids = np.arange(4, dtype=np.int64)
+        old = unit_rows(4, seed=1)
+        new = unit_rows(4, seed=2)
+        j.ack(0, j.append(0, ids, old, 100), 4)
+        j.ack(0, j.append(0, ids, new, 100), 4)  # same ids, newer rows
+        assert j.maybe_compact(0, 100)
+        batches, rows = j.totals(0)
+        assert (batches, rows) == (1, 4) and j.depth(0) == 0
+        (got,) = list(j.replay(0, from_start=True))
+        np.testing.assert_array_equal(np.sort(got[1]), ids)
+        # Last record won.
+        order = np.argsort(got[1])
+        np.testing.assert_allclose(got[2][order], new, rtol=1e-6)
+        j.close()
+
+
+# ---------------------------------------------------------------------------
+# versioned plane: promote / rollback / mixed-version rejection
+
+
+class TestVersionedPlane:
+    def test_promote_cuts_all_shards_rollback_restores_warm(
+            self, tmp_path):
+        servers, fan, base = make_plane(tmp_path, step=100)
+        try:
+            assert self_hit(fan, base[:128]) == 1.0
+            assert fan.search(base[:4], k=1)["version"] == 100
+            for s in servers:
+                assert s.shard.version == 100
+            pre_rows = [s.shard.rows for s in servers]
+
+            fan.promote(200)
+            for s in servers:
+                assert s.shard.version == 200
+                assert s.shard.rows == 0  # fresh generation
+            # New-model rows land in the new generation only.
+            fresh = unit_rows(256, seed=9)
+            fan.insert(np.arange(5000, 5256), fresh)
+            assert self_hit(fan, fresh, np.arange(5000, 5256)) == 1.0
+            assert fan.search(fresh[:4], k=1)["version"] == 200
+
+            # Forced rollback: every shard restores the retained
+            # generation — row counts and answers exactly pre-promote.
+            assert fan.rollback_to(100) is True
+            for s, rows in zip(servers, pre_rows):
+                assert s.shard.version == 100 and s.shard.rows == rows
+            assert self_hit(fan, base[:128]) == 1.0
+            assert fan.search(base[:4], k=1)["version"] == 100
+        finally:
+            fan.close()
+            for s in servers:
+                s.stop()
+
+    def test_mixed_version_search_response_rejected_then_healed(
+            self, tmp_path):
+        servers, fan, base = make_plane(tmp_path, step=100)
+        try:
+            # Shard 1 drifts to another generation BEHIND the fan-out's
+            # back (a lagging cut, a split-brain restart).
+            _post(servers[1].url + "/shard/cut", {"step": 999})
+            res = fan.search(base[:64], k=1)
+            assert res["shards"]["ok"] == 2
+            assert res["shards"]["degraded"] is True
+            assert fan.version_mismatches >= 1
+            # No id served by the drifted shard survives the merge: the
+            # plane answers from 2/3 coverage, never from mixed models.
+            assert 1 in fan._resync
+            # The repair loop re-inits the drifted shard at the plane
+            # version and resurrects its rows from the journal.
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                fan.repair_tick()
+                if servers[1].shard.version == 100 \
+                        and sum(fan.journal.depths().values()) == 0 \
+                        and self_hit(fan, base[:128]) == 1.0:
+                    break
+                time.sleep(0.05)
+            res = fan.search(base[:64], k=1)
+            assert res["shards"]["ok"] == 3
+            assert self_hit(fan, base[:128]) == 1.0
+        finally:
+            fan.close()
+            for s in servers:
+                s.stop()
+
+    def test_insert_to_drifted_shard_journals_not_stores(self, tmp_path):
+        servers, fan, base = make_plane(tmp_path, step=100)
+        try:
+            _post(servers[1].url + "/shard/cut", {"step": 999})
+            before = servers[1].shard.rows  # new gen: 0
+            fan.insert(np.arange(9000, 9256), unit_rows(256, seed=11))
+            # The drifted shard refused its slice
+            # (version_mismatch) — those rows are journal debt, not
+            # silently stored under the wrong model.
+            assert servers[1].shard.rows == before == 0
+            assert fan.journal.depth(1) > 0
+            assert 1 in fan._resync
+        finally:
+            fan.close()
+            for s in servers:
+                s.stop()
+
+
+# ---------------------------------------------------------------------------
+# repair: die -> journal -> restart empty -> resurrect
+
+
+class TestRepair:
+    def test_restarted_empty_shard_resurrects_zero_net_loss(
+            self, tmp_path):
+        servers, fan, base = make_plane(tmp_path, n_rows=1024,
+                                        step=100)
+        try:
+            port = servers[1].port
+            servers[1].stop()
+            live = unit_rows(512, seed=5)
+            fan.insert(np.arange(2000, 2512), live)
+            assert fan.journal.depth(1) > 0
+            assert fan.search(base[:16], k=1)["shards"]["degraded"]
+            # Restart EMPTY on the same port; the repair loop detects
+            # the reset (rows < acked) and replays the FULL history.
+            servers[1] = ShardServer(DIM, port=port).start()
+            deadline = time.monotonic() + 30.0
+            healed = False
+            while time.monotonic() < deadline:
+                fan.repair_tick()
+                if sum(fan.journal.depths().values()) == 0 \
+                        and self_hit(fan, base) == 1.0 \
+                        and self_hit(fan, live,
+                                     np.arange(2000, 2512)) == 1.0:
+                    healed = True
+                    break
+                time.sleep(0.05)
+            assert healed, "journal never drained to a full-recall plane"
+            assert fan.dropped == 0
+            res = fan.search(base[:16], k=1)
+            assert res["shards"]["ok"] == 3
+            assert not res["shards"]["degraded"]
+        finally:
+            fan.close()
+            for s in servers:
+                s.stop()
+
+    def test_duplicate_redelivery_does_not_phantom_resync(
+            self, tmp_path):
+        """A client timeout on a push the server actually completed
+        leaves the batch as journal debt; the tail drain then
+        redelivers it and the shard dedups (stored == 0). The acked
+        ledger must track the shard's STORED rows, not delivered
+        batch sizes — an inflated ledger makes `rows < acked` read as
+        a phantom restart and the repair loop wipes a HEALTHY shard
+        (the thrash observed as repaired >> corpus in the smoke)."""
+        servers, fan, base = make_plane(tmp_path, n_rows=512,
+                                        step=100)
+        try:
+            # Redeliver already-stored slices: the exact shape a tail
+            # drain produces after a timed-out-but-completed push.
+            for _ in range(3):
+                fan.insert(np.arange(0, 256), base[:256])
+            for sid, cl in enumerate(fan.clients):
+                got = cl.call("/healthz", force=True)
+                assert int(got["rows"]) >= fan._acked.get(sid, 0), (
+                    f"shard {sid}: acked ledger inflated past real "
+                    f"rows ({fan._acked.get(sid, 0)} > {got['rows']})")
+            out = fan.repair_tick()
+            assert out["resynced"] == [], (
+                "duplicate redelivery phantom-resynced a healthy "
+                f"shard: {out}")
+            assert fan.repaired == 0
+            assert self_hit(fan, base) == 1.0
+        finally:
+            fan.close()
+            for s in servers:
+                s.stop()
+
+
+# ---------------------------------------------------------------------------
+# live rebalance 2 -> 3: bounded movement, zero k-means, row-identical
+
+
+class TestRebalance:
+    def test_grow_2_to_3_bounded_no_reclustering_row_identical(
+            self, tmp_path, monkeypatch):
+        servers, fan, base = make_plane(tmp_path, n_shards=2,
+                                        n_rows=1024, step=100)
+        new_srv = ShardServer(DIM).start()
+        try:
+            queries = unit_rows(64, seed=21)
+            before = fan.search(queries, k=5)
+            assert before["shards"]["ok"] == 2
+
+            def boom(*a, **kw):
+                raise AssertionError(
+                    "rebalance must not re-cluster or retrain")
+
+            # Booby-trap every training entry point reachable from the
+            # fan-out: a migration is a STREAM of rows between owners,
+            # never a rebuild.
+            monkeypatch.setattr(shard_mod, "kmeans", boom)
+            monkeypatch.setattr(shard_mod.PQCodec, "train", boom)
+
+            stats = fan.rebalance([s.url for s in servers]
+                                  + [new_srv.url])
+            assert stats["lists_skipped"] == 0
+            assert stats["rows_total"] == 1024
+            # Rendezvous bound: ~1/3 of rows move, far under the 60%
+            # ceiling (mod-N would move ~2/3).
+            assert 0 < stats["rows_moved"] <= 0.6 * stats["rows_total"]
+            assert new_srv.shard.rows == stats["rows_moved"]
+            # Row-identical merged search across the resize: same ids,
+            # same order, for every query.
+            after = fan.search(queries, k=5)
+            assert after["shards"]["ok"] == 3
+            np.testing.assert_array_equal(before["ids"], after["ids"])
+            # And the moved rows still self-hit exactly.
+            assert self_hit(fan, base) == 1.0
+            # No shard holds a row it does not own under the new ring.
+            assert sum(s.shard.rows for s in servers) \
+                + new_srv.shard.rows == 1024
+        finally:
+            fan.close()
+            for s in servers:
+                s.stop()
+            new_srv.stop()
+
+    def test_insert_during_migration_window_routes_new_ring(
+            self, tmp_path):
+        # After the ring swap (phase 1) but before any list streams,
+        # fresh inserts must route under the NEW ring — the journal +
+        # id-dedup make the window safe even when a row lands where a
+        # migrating list is still being served by the old owner.
+        servers, fan, base = make_plane(tmp_path, n_shards=2,
+                                        n_rows=512, step=100)
+        new_srv = ShardServer(DIM).start()
+        try:
+            fan.rebalance([s.url for s in servers] + [new_srv.url])
+            fresh = unit_rows(256, seed=23)
+            fan.insert(np.arange(4000, 4256), fresh)
+            assert self_hit(fan, fresh, np.arange(4000, 4256)) == 1.0
+            assert sum(fan.journal.depths().values()) == 0
+        finally:
+            fan.close()
+            for s in servers:
+                s.stop()
+            new_srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos grammar + fleet dispatch
+
+
+class TestShardChaos:
+    def test_plan_parses_shard_actions(self):
+        plan = FaultPlan.parse("killshard@2,lagshard@5,killworker@3")
+        assert plan.killshard_ticks == (2,)
+        assert plan.lagshard_ticks == (5,)
+        assert plan.has_shard_actions()
+        assert not FaultPlan.parse("killworker@3").has_shard_actions()
+
+    def test_shard_ticks_ride_their_own_ordinal(self):
+        inj = FaultInjector(FaultPlan.parse("killshard@2,killworker@2"))
+        # Three embed-fleet ticks pass: the shard ordinal must not move.
+        assert inj.on_fleet_tick() == []
+        assert inj.on_fleet_tick() == ["killworker@2"]
+        assert inj.on_fleet_tick() == []
+        assert inj.on_shard_tick() == []
+        assert inj.on_shard_tick() == ["killshard@2"]
+        assert "killshard@2" in inj.fired
+
+    def test_fleet_kills_shard_worker_and_supervision_restarts_it(
+            self, tmp_path):
+        # The tentpole supervision arc end-to-end with the REAL shard
+        # subprocess entry: boot through ServingFleet's port-file
+        # handshake, killshard@2 SIGKILLs it, backoff restart brings it
+        # back ready on the same fixed port.
+        from ntxent_tpu.resilience import RetryPolicy
+        from ntxent_tpu.serving import ServingFleet
+
+        sk = socket.socket()
+        sk.bind(("127.0.0.1", 0))
+        port = sk.getsockname()[1]
+        sk.close()
+
+        def make_cmd(worker_id, port_file):
+            return [sys.executable, "-m", "ntxent_tpu.retrieval.shard",
+                    "--dim", "8", "--port", str(port),
+                    "--port-file", str(port_file)]
+
+        inj = FaultInjector(FaultPlan.parse("killshard@2"))
+        fleet = ServingFleet(
+            make_cmd, n_workers=1, workdir=tmp_path / "shards",
+            poll_s=0.1, health_timeout_s=2.0, injector=inj,
+            chaos_channel="shard",
+            backoff=RetryPolicy(max_attempts=10, base_delay_s=0.05,
+                                multiplier=1.0, jitter=0.0))
+        worker = fleet.workers[0]
+        fleet._spawn(worker)
+        try:
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                fleet.tick()
+                if any(w.ready for w in fleet.pool.workers()):
+                    break
+                time.sleep(0.05)
+            assert any(w.ready for w in fleet.pool.workers())
+            first_pid = worker.proc.pid
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                fleet.tick()
+                if inj.fired and worker.restarts >= 1 \
+                        and worker.proc is not None \
+                        and worker.proc.poll() is None \
+                        and worker.proc.pid != first_pid:
+                    break
+                time.sleep(0.05)
+            assert inj.fired == ["killshard@2"]
+            assert worker.restarts >= 1
+            # Back ready on the SAME port: the fan-out's URL survives.
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                fleet.tick()
+                if any(w.ready for w in fleet.pool.workers()):
+                    break
+                time.sleep(0.05)
+            got = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz", timeout=5).read())
+            assert got["ok"] is True
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-shard history series (satellite)
+
+
+class TestShardUpHistory:
+    def test_gauge_labeled_needs_a_label_key(self):
+        from ntxent_tpu.obs.history import SeriesSpec
+        with pytest.raises(ValueError, match="label_key"):
+            SeriesSpec("x", "x", mode="gauge_labeled")
+
+    def test_recorder_expands_per_shard_series_and_detector_fires(self):
+        from ntxent_tpu.obs import AlertStore
+        from ntxent_tpu.obs.history import (AnomalyDetector,
+                                            HistoryRecorder,
+                                            MetricHistory, SeriesSpec)
+
+        reg = MetricsRegistry()
+        up0 = reg.gauge("retrieval_shard_up", "up",
+                        labels={"shard": "0"})
+        up1 = reg.gauge("retrieval_shard_up", "up",
+                        labels={"shard": "1"})
+        up0.set(1.0)
+        up1.set(1.0)
+        store = AlertStore()
+        clock = [1000.0]
+        detector = AnomalyDetector(store=store, warmup=5)
+        history = MetricHistory(raw_len=64, rollup_len=64)
+        rec = HistoryRecorder(
+            history,
+            series=(SeriesSpec("retrieval_shard_up",
+                               "retrieval_shard_up",
+                               mode="gauge_labeled",
+                               label_key="shard"),),
+            detector=detector, clock=lambda: clock[0])
+        for _ in range(8):
+            out = rec.on_merge(reg)
+            assert out == {"retrieval_shard_up.0": 1.0,
+                           "retrieval_shard_up.1": 1.0}
+            clock[0] += 1.0
+        # Shard 1 dies: its OWN series steps 1 -> 0 — unmissable,
+        # where a summed gauge would read 2 -> 1 against a flat-1
+        # history of... 2. Per-shard is the whole point.
+        up1.set(0.0)
+        out = rec.on_merge(reg)
+        assert out["retrieval_shard_up.1"] == 0.0
+        firing = set(store.snapshot()["firing"])
+        assert "anomaly:retrieval_shard_up.1" in firing
+        assert "anomaly:retrieval_shard_up.0" not in firing
+
+
+# ---------------------------------------------------------------------------
+# import boundary (satellite)
+
+
+class TestImportBoundary:
+    def test_shard_and_journal_import_jax_free(self):
+        # The shard worker boots on the supervisor's restart schedule:
+        # its import chain paying backend init would turn every repair
+        # into a cold start. Subprocess, so a jax already imported by
+        # the test session cannot mask a leak.
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import sys\n"
+             "import ntxent_tpu.retrieval.shard\n"
+             "import ntxent_tpu.retrieval.journal\n"
+             "assert 'jax' not in sys.modules, 'jax leaked'\n"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+
+    def test_lint_boundary_covers_shard_and_journal(self):
+        from ntxent_tpu.analysis import LintConfig
+        roots = LintConfig().boundary_roots
+        assert "ntxent_tpu.retrieval.shard" in roots
+        assert "ntxent_tpu.retrieval.journal" in roots
